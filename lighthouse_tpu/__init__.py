@@ -1,0 +1,22 @@
+"""lighthouse_tpu — a TPU-native Ethereum consensus framework.
+
+A ground-up rebuild of the capabilities of ParaState/lighthouse (Rust) with a
+JAX/XLA/Pallas execution backend for the cryptographic hot paths (batched
+BLS12-381 signature verification, KZG blob-commitment checks) and host-side
+C++/Python for the runtime around them (scheduler, store, networking, APIs).
+
+Layer map (mirrors reference SURVEY.md §1):
+  crypto/    — L0: BLS12-381 + KZG primitives, three backends (cpu/tpu/fake)
+               like the reference's blst/fake_crypto seam
+               (reference: crypto/bls/src/lib.rs:87-142)
+  consensus/ — L1-L2: types, state transition, fork choice
+  scheduler/ — L6: prioritized multi-queue work scheduler (beacon_processor)
+  net/       — L7: gossip/req-resp distributed plane (host-side)
+  node/      — L8-L9: assembly, APIs, processes
+  ops/       — JAX/Pallas kernels (big-int limb arithmetic, curve ops, pairing)
+  parallel/  — device-mesh sharding of crypto batches (psum over ICI)
+  models/    — flagship end-to-end pipelines (attestation batch verifier)
+  utils/     — cross-cutting commons (metrics, slot clock, task executor)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,68 @@
+"""Backend-pluggable BLS12-381 — the north-star seam.
+
+Public API mirroring the reference crate crypto/bls (crypto/bls/src/lib.rs:
+87-142): key/signature types, SignatureSet, and `verify_signature_sets`
+dispatched to a selected backend (cpu | tpu | fake). Random batch scalars
+are always host-generated CSPRNG (never device-side), per
+crypto/bls/src/impls/blst.rs:16,48-68 (RAND_BITS=64, nonzero).
+"""
+
+import os
+import secrets
+
+from . import params
+from .keys import (
+    SecretKey,
+    PublicKey,
+    Signature,
+    SignatureSet,
+    aggregate_signatures,
+    aggregate_pubkey_point,
+)
+from . import backends as _backends
+
+_DEFAULT_BACKEND = os.environ.get("LIGHTHOUSE_TPU_BLS_BACKEND", "cpu")
+
+
+def gen_batch_scalars(n: int):
+    """n nonzero RAND_BITS-bit CSPRNG scalars (blst.rs:48-68 semantics)."""
+    out = []
+    for _ in range(n):
+        r = 0
+        while r == 0:
+            r = secrets.randbits(params.RAND_BITS)
+        out.append(r)
+    return out
+
+
+def verify_signature_sets(sets, backend: str = None, rand_scalars=None) -> bool:
+    """Batch-verify independently-signed SignatureSets.
+
+    The entry point every verifier in the framework funnels into — gossip
+    attestation batches, whole-block signature batches, sync-committee
+    batches (reference call sites: attestation_verification/batch.rs:195,
+    block_signature_verifier.rs:380-397)."""
+    b = _backends.get(backend or _DEFAULT_BACKEND)
+    if rand_scalars is None:
+        rand_scalars = gen_batch_scalars(len(sets))
+    return b.verify_signature_sets(sets, rand_scalars)
+
+
+def verify(signature, pubkey, message: bytes, backend: str = None) -> bool:
+    """Single-signature verification."""
+    b = _backends.get(backend or _DEFAULT_BACKEND)
+    return b.verify_single(signature, pubkey, message)
+
+
+__all__ = [
+    "params",
+    "SecretKey",
+    "PublicKey",
+    "Signature",
+    "SignatureSet",
+    "aggregate_signatures",
+    "aggregate_pubkey_point",
+    "gen_batch_scalars",
+    "verify_signature_sets",
+    "verify",
+]

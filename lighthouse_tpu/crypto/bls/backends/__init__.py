@@ -1,0 +1,26 @@
+"""BLS execution backends.
+
+Three backends, like the reference's feature-selected impls
+(crypto/bls/src/lib.rs:130-142: blst | fake_crypto, plus the seam this
+project exists to fill — a TPU backend):
+
+  cpu  — pure-Python oracle (control / correctness baseline)
+  tpu  — JAX/XLA batched kernels (lighthouse_tpu.ops), the hot path
+  fake — always-valid stub for fast consensus-logic tests
+         (crypto/bls/src/impls/fake_crypto.rs:31-35)
+"""
+
+from . import cpu, fake
+
+_BACKENDS = {"cpu": cpu, "fake": fake}
+
+
+def get(name: str):
+    if name == "tpu":
+        from . import tpu  # deferred: importing jax is slow
+
+        _BACKENDS["tpu"] = tpu
+    try:
+        return _BACKENDS[name]
+    except KeyError:
+        raise ValueError(f"unknown BLS backend {name!r}") from None

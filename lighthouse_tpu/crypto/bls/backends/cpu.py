@@ -1,0 +1,52 @@
+"""CPU (pure-Python) BLS backend — the control implementation.
+
+Implements the same batch verification scheme as the reference's blst
+backend (crypto/bls/src/impls/blst.rs:37-119): per set draw a nonzero
+64-bit random scalar r_i, subgroup-check the signature, aggregate the set's
+pubkeys; then check
+
+    prod_i e([r_i] apk_i, H(m_i)) * e(-g1, sum_i [r_i] sig_i) == 1
+
+with one shared final exponentiation (blst.rs:114-116 semantics;
+"fast verification of multiple BLS signatures", random linear combination).
+"""
+
+from .. import params, curve as C, pairing as PR, hash_to_curve as H2C
+
+
+def verify_signature_sets(sets, rand_scalars) -> bool:
+    """Batch-verify. Returns False on empty input or any set with no keys
+    (blst.rs:42,80-89 rejection semantics)."""
+    if not sets:
+        return False
+    if len(rand_scalars) != len(sets):
+        raise ValueError("need one random scalar per set")
+    pairs = []
+    sig_acc = None
+    for s, r in zip(sets, rand_scalars):
+        if not s.signing_keys:
+            return False
+        if not (0 < r < 2**params.RAND_BITS):
+            raise ValueError("batch scalar out of range")
+        if s.signature.point is None:
+            return False  # infinity signature
+        apk = None
+        for k in s.signing_keys:
+            apk = C.g1_add(apk, k.point)
+        if apk is None:
+            return False
+        pairs.append((C.g1_mul(apk, r), H2C.hash_to_g2(s.message)))
+        sig_acc = C.g2_add(sig_acc, C.g2_mul(s.signature.point, r))
+    pairs.append((C.g1_neg(C.G1_GEN), sig_acc))
+    return PR.pairings_product_is_one(pairs)
+
+
+def verify_single(signature, pubkey, message: bytes) -> bool:
+    """Plain (non-batch) verification: e(pk, H(m)) == e(g1, sig)."""
+    if signature.point is None:
+        return False
+    pairs = [
+        (pubkey.point, H2C.hash_to_g2(message)),
+        (C.g1_neg(C.G1_GEN), signature.point),
+    ]
+    return PR.pairings_product_is_one(pairs)

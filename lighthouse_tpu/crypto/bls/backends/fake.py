@@ -1,0 +1,14 @@
+"""Fake BLS backend: every verification succeeds.
+
+Mirrors the reference's fake_crypto backend
+(crypto/bls/src/impls/fake_crypto.rs:31-35), used to test consensus logic
+at speed without paying for crypto.
+"""
+
+
+def verify_signature_sets(sets, rand_scalars) -> bool:
+    return True
+
+
+def verify_single(signature, pubkey, message: bytes) -> bool:
+    return True

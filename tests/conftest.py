@@ -1,0 +1,14 @@
+"""Test configuration: force an 8-device virtual CPU mesh BEFORE jax imports.
+
+Mirrors the reference's testing posture (multi-node tested in-process,
+SURVEY.md §4.5): multi-chip sharding is exercised on virtual CPU devices;
+real-TPU runs happen in bench.py / the driver's dryrun.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

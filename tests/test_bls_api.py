@@ -1,0 +1,91 @@
+"""Sign/verify/batch-verify semantics tests (cpu + fake backends).
+
+Mirrors the reference's crypto/bls/tests/tests.rs macro-driven multi-backend
+suite and the batch-verification rejection rules of
+crypto/bls/src/impls/blst.rs:37-119.
+"""
+import pytest
+
+import lighthouse_tpu.crypto.bls as bls
+from lighthouse_tpu.crypto.bls import hash_to_curve as H2C, curve as C
+
+
+def keypair(i: int):
+    sk = bls.SecretKey.from_seed(i.to_bytes(4, "big"))
+    return sk, sk.public_key()
+
+
+def test_sign_verify_roundtrip():
+    sk, pk = keypair(1)
+    msg = b"hello beacon chain"
+    sig = sk.sign(msg)
+    assert bls.verify(sig, pk, msg)
+    assert not bls.verify(sig, pk, b"other message")
+    sk2, pk2 = keypair(2)
+    assert not bls.verify(sig, pk2, msg)
+
+
+def test_pubkey_signature_serialization_roundtrip():
+    sk, pk = keypair(3)
+    sig = sk.sign(b"msg")
+    assert bls.PublicKey.from_bytes(pk.to_bytes()) == pk
+    assert bls.Signature.from_bytes(sig.to_bytes()) == sig
+    assert len(pk.to_bytes()) == 48 and len(sig.to_bytes()) == 96
+
+
+def test_aggregate_verify_multiple_pubkeys():
+    msg = b"same message, many signers"
+    sks, pks = zip(*(keypair(i) for i in range(4, 8)))
+    agg = bls.aggregate_signatures([sk.sign(msg) for sk in sks])
+    s = bls.SignatureSet.multiple_pubkeys(agg, list(pks), msg)
+    assert bls.verify_signature_sets([s])
+
+
+def test_batch_verify_mixed_sets():
+    sets = []
+    for i in range(8, 12):
+        sk, pk = keypair(i)
+        msg = b"msg-%d" % i
+        sets.append(bls.SignatureSet.single_pubkey(sk.sign(msg), pk, msg))
+    assert bls.verify_signature_sets(sets)
+    # poison one set → whole batch fails (the poisoning tradeoff the
+    # scheduler's fallback handles, beacon_processor/src/lib.rs:219-229)
+    sk_bad, _ = keypair(99)
+    sets[2] = bls.SignatureSet.single_pubkey(
+        sk_bad.sign(b"msg-10"), keypair(10)[1], b"msg-10"
+    )
+    assert not bls.verify_signature_sets(sets)
+
+
+def test_batch_rejects_empty_and_keyless():
+    assert not bls.verify_signature_sets([])
+    sk, pk = keypair(12)
+    s = bls.SignatureSet(signature=sk.sign(b"m"), signing_keys=[], message=b"m")
+    assert not bls.verify_signature_sets([s])
+
+
+def test_fake_backend_accepts_anything():
+    sk, pk = keypair(13)
+    bad = bls.SignatureSet.single_pubkey(sk.sign(b"x"), pk, b"y")
+    assert bls.verify_signature_sets([bad], backend="fake")
+    assert bls.verify_signature_sets([], backend="fake")
+
+
+def test_hash_to_g2_lands_in_subgroup_and_separates():
+    p1 = H2C.hash_to_g2(b"message one")
+    p2 = H2C.hash_to_g2(b"message two")
+    assert p1 != p2
+    assert C.g2_subgroup_check(p1)
+    assert C.g2_subgroup_check(p2)
+    # DST separation
+    p3 = H2C.hash_to_g2(b"message one", dst=b"OTHER_DST_")
+    assert p3 != p1
+    # determinism
+    assert H2C.hash_to_g2(b"message one") == p1
+
+
+def test_expand_message_xmd_shape():
+    out = H2C.expand_message_xmd(b"abc", b"DST", 256)
+    assert len(out) == 256
+    assert H2C.expand_message_xmd(b"abc", b"DST", 256) == out
+    assert H2C.expand_message_xmd(b"abd", b"DST", 256) != out
