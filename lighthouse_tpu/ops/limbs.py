@@ -1,0 +1,249 @@
+"""Multiprecision Fp arithmetic over BLS12-381's 381-bit prime, as batched
+JAX ops on signed int32 limb vectors.
+
+Layout
+------
+An Fp element is an int32 array [..., N] (N = 35 limbs, B = 11 bits each,
+385 bits capacity). Limb i holds (roughly) bits [11i, 11i+11). Limbs are
+*lazy*: after `norm3` they lie in (-2, 2^11 + 2); add/sub may push them to
+|x| < 2^12 which is still safe as multiplier input.
+
+Why 11x35 on TPU: products of 12-bit-bounded limbs are < 2^24 and a
+35-term convolution plus Montgomery's m*p rows stays < 2^30 — inside
+int32 without 64-bit carry chains, which TPUs don't have. All ops are
+elementwise/VPU-friendly and vectorize over arbitrary leading batch dims.
+
+Montgomery domain
+-----------------
+Field values are kept in Montgomery form a*R mod p, R = 2^385. `mont_mul`
+is conv + word-serial REDC (35 unrolled steps, each a fused
+multiply-accumulate over the limb axis). Out-of-domain conversion and
+canonicalization happen only at boundaries (compare/serialize).
+
+This module is the TPU replacement for the reference's blst field core
+(crypto/bls/src/impls/blst.rs binds it; SURVEY.md §2.7 item 1).
+"""
+
+from functools import partial
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..crypto.bls.params import P
+
+B = 11                      # bits per limb
+N = 35                      # limbs (385 bits >= 381)
+MASK = (1 << B) - 1
+R_MONT = 1 << (B * N)       # Montgomery radix 2^385
+R2 = R_MONT * R_MONT % P    # for encoding into Montgomery form
+P_PRIME = (-pow(P, -1, 1 << B)) % (1 << B)  # -p^-1 mod 2^B
+
+WIDE = 2 * N  # wide accumulator length for products (2N-1 used, padded to 2N)
+
+
+# ---------------------------------------------------------------- host codecs
+
+def to_limbs_raw(x: int) -> np.ndarray:
+    """Nonneg int < 2^385 -> limb vector, NO mod-p reduction (host side)."""
+    out = np.zeros(N, dtype=np.int32)
+    for i in range(N):
+        out[i] = x & MASK
+        x >>= B
+    assert x == 0, "value exceeds limb capacity"
+    return out
+
+
+def to_limbs(x: int) -> np.ndarray:
+    """Python int -> canonical limb vector of x mod p (host side)."""
+    return to_limbs_raw(x % P)
+
+
+def from_limbs(v) -> int:
+    """Limb vector (any lazy/signed form) -> Python int mod P (host side)."""
+    v = np.asarray(v)
+    acc = 0
+    for i in reversed(range(v.shape[-1])):
+        acc = (acc << B) + int(v[..., i])
+    return acc % P
+
+
+def pack(ints, batch_shape=None) -> np.ndarray:
+    """List of python ints -> [len, N] int32 canonical limbs."""
+    return np.stack([to_limbs(i) for i in ints])
+
+
+P_LIMBS = to_limbs_raw(P)
+P_LIMBS_J = jnp.asarray(P_LIMBS)
+R2_LIMBS = to_limbs(R2)
+ONE_MONT = to_limbs(R_MONT % P)   # 1 in Montgomery form
+ZERO = np.zeros(N, dtype=np.int32)
+
+
+# ---------------------------------------------------------------- carries
+
+def norm1(x):
+    """One shift-add carry pass (signed-safe: >> is arithmetic)."""
+    lo = jnp.bitwise_and(x, MASK)
+    hi = jnp.right_shift(x, B)
+    return lo + jnp.pad(hi[..., :-1], [(0, 0)] * (x.ndim - 1) + [(1, 0)])
+
+
+def norm3(x):
+    """Three passes: limbs land in (-2, 2^B + 2) for any |x| < 2^30 input."""
+    return norm1(norm1(norm1(x)))
+
+
+# ---------------------------------------------------------------- add/sub
+
+def add(a, b):
+    return a + b
+
+
+def sub(a, b):
+    return a - b
+
+
+def neg(a):
+    return -a
+
+
+# ---------------------------------------------------------------- multiply
+
+def _conv(a, b):
+    """Schoolbook product: [..., N] x [..., N] -> [..., 2N] int32.
+
+    35 shifted multiply-accumulates over the limb axis; coefficients are
+    bounded by 35 * 2^24 < 2^30 for |limbs| <= 2^12.
+    """
+    shape = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
+    out = jnp.zeros((*shape, WIDE), dtype=jnp.int32)
+    for i in range(N):
+        out = out.at[..., i : i + N].add(a[..., i : i + 1] * b)
+    return out
+
+
+def _redc(wide):
+    """Word-serial Montgomery reduction: [..., 2N] -> [..., N] lazy limbs.
+
+    Each of the N steps clears the lowest live limb by adding m*p, then
+    pushes its (exact) carry up. Accumulators stay < 2^31.
+    """
+    for i in range(N):
+        # mask BEFORE multiplying: the accumulator can be ~2^30 and
+        # 2^30 * P_PRIME overflows int32
+        m = jnp.bitwise_and(jnp.bitwise_and(wide[..., i], MASK) * P_PRIME, MASK)
+        wide = wide.at[..., i : i + N].add(m[..., None] * P_LIMBS_J)
+        carry = jnp.right_shift(wide[..., i], B)
+        wide = wide.at[..., i + 1].add(carry)
+    return norm3(wide[..., N:])
+
+
+def mont_mul(a, b):
+    """Montgomery product: (a * b / R) mod p, lazy limbs in, lazy out."""
+    return _redc(_conv(a, b))
+
+
+def mont_sqr(a):
+    return mont_mul(a, a)
+
+
+def to_mont(a):
+    """Canonical-value limbs -> Montgomery form."""
+    return mont_mul(a, jnp.asarray(R2_LIMBS))
+
+
+def from_mont(a):
+    """Montgomery form -> plain value (still lazy limbs)."""
+    wide = jnp.zeros((*a.shape[:-1], WIDE), dtype=jnp.int32)
+    wide = wide.at[..., :N].set(a)
+    return _redc(wide)
+
+
+# ---------------------------------------------------------------- canonical
+
+def _ripple(v):
+    """Exact carry ripple (lax.scan over limbs, batched over elements).
+    Arithmetic shifts make borrows of negative limbs correct too."""
+
+    def step(carry, limb):
+        s = limb + carry
+        return jnp.right_shift(s, B), jnp.bitwise_and(s, MASK)
+
+    carry, limbs = jax.lax.scan(
+        step, jnp.zeros(v.shape[:-1], jnp.int32), jnp.moveaxis(v, -1, 0)
+    )
+    return jnp.moveaxis(limbs, 0, -1)
+
+
+def canonical_plain(x):
+    """Reduce a lazy *plain-domain* (non-Montgomery) element to its unique
+    representative in [0, p), canonical limbs. Boundary-only op.
+
+    Round-tripping through the Montgomery domain (x -> xR -> x) bounds the
+    value into (-2, 2p) regardless of how lazy the input was; one +p offset,
+    a ripple, and two conditional subtracts finish the job.
+    """
+    x = from_mont(to_mont(x))            # value now in (-2, 2p)
+    x = _ripple(x + P_LIMBS_J)           # value in (p-2, 3p), canonical limbs
+    for _ in range(2):
+        ge = _geq(x, P_LIMBS_J)
+        x = jnp.where(ge[..., None], _ripple(x - P_LIMBS_J), x)
+    return x
+
+
+def canonical_from_mont(x):
+    """Montgomery-domain lazy element -> canonical plain limbs in [0, p)."""
+    x = from_mont(x)                     # value in (-2, 2p)
+    x = _ripple(x + P_LIMBS_J)
+    for _ in range(2):
+        ge = _geq(x, P_LIMBS_J)
+        x = jnp.where(ge[..., None], _ripple(x - P_LIMBS_J), x)
+    return x
+
+
+def _geq(x, y):
+    """Lexicographic x >= y over canonical-ish limb vectors (elementwise)."""
+    # scan from most-significant: result = first differing limb decides
+    gt = jnp.zeros(x.shape[:-1], dtype=jnp.bool_)
+    lt = jnp.zeros(x.shape[:-1], dtype=jnp.bool_)
+    for i in reversed(range(N)):
+        xi, yi = x[..., i], y[..., i]
+        gt = gt | (~lt & (xi > yi))
+        lt = lt | (~gt & (xi < yi))
+    return ~lt
+
+
+def eq_zero_mod_p(x):
+    """True where lazy Montgomery-domain x ≡ 0 (mod p)."""
+    c = canonical_from_mont(x)
+    return jnp.all(c == 0, axis=-1)
+
+
+def eq_mod_p(x, y):
+    """True where two lazy Montgomery-domain elements are equal mod p."""
+    return eq_zero_mod_p(x - y)
+
+
+# ---------------------------------------------------------------- pow / inv
+
+def mont_pow(a, exponent: int):
+    """a^e in Montgomery domain, e a static Python int. lax.scan over bits
+    (LSB-first square-and-multiply), so compile size is O(1) in e."""
+    nbits = max(exponent.bit_length(), 1)
+    bits = jnp.asarray([(exponent >> i) & 1 for i in range(nbits)], dtype=jnp.bool_)
+    one = jnp.broadcast_to(jnp.asarray(ONE_MONT), a.shape).astype(jnp.int32)
+
+    def step(carry, bit):
+        acc, base = carry
+        acc = jnp.where(bit, mont_mul(acc, base), acc)
+        base = mont_sqr(base)
+        return (acc, base), None
+
+    (acc, _), _ = jax.lax.scan(step, (one, a), bits)
+    return acc
+
+
+def mont_inv(a):
+    """a^(p-2) — Fermat inversion in Montgomery domain (0 maps to 0)."""
+    return mont_pow(a, P - 2)
