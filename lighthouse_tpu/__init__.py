@@ -20,3 +20,26 @@ Layer map (mirrors reference SURVEY.md §1):
 """
 
 __version__ = "0.1.0"
+
+
+def enable_compilation_cache(path: str = None) -> None:
+    """Point JAX's persistent compilation cache at a repo-local dir.
+
+    The fused verification kernel is a large XLA program; caching makes
+    every process after the first (tests, bench, driver compile-checks)
+    load it instead of recompiling. Call before the first jit execution.
+    """
+    import os
+
+    import jax
+
+    if path is None:
+        path = os.path.join(os.path.dirname(os.path.dirname(__file__)), ".jax_cache")
+    os.makedirs(path, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    try:
+        jax.config.update("jax_persistent_cache_enable_xla_caches", "all")
+    except Exception:  # flag renamed across jax versions; cache still works
+        pass

@@ -6,9 +6,27 @@ real-TPU runs happen in bench.py / the driver's dryrun.
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force, don't setdefault: the host environment may preset JAX_PLATFORMS
+# to the real-TPU tunnel platform, which tests must never touch (the
+# bench/driver own the real chip; a second client blocks on its lock).
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# A TPU-tunnel PJRT plugin may have already run at interpreter startup
+# (sitecustomize) and overridden jax_platforms via jax.config — the env
+# var alone is then ignored. Reset the config value before any backend
+# initializes; initializing the tunnel backend from tests would block on
+# the chip's single-client lock.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+# Persistent compilation cache: the verify kernel is a large XLA program;
+# cache hits turn multi-minute test-session compiles into loads.
+import lighthouse_tpu
+
+lighthouse_tpu.enable_compilation_cache()
