@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""North-star benchmark: BLS signature-set verification throughput.
+
+BASELINE config 1: `verify_signature_sets` on a batch of random
+single-pubkey SignatureSets (the gossip-attestation shape,
+attestation_verification/batch.rs:133-214). Reports sets verified per
+second on the available accelerator vs the in-repo CPU control backend
+(pure-Python optimized pairing; blst is unavailable in this image — see
+BASELINE.md for how the blst control is defined).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "sets/s", "vs_baseline": N}
+
+Env knobs: BENCH_SETS (default 256), BENCH_REPS (default 3),
+BENCH_CPU_SETS (default 4).
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    n_sets = int(os.environ.get("BENCH_SETS", "256"))
+    reps = int(os.environ.get("BENCH_REPS", "3"))
+    cpu_sets = int(os.environ.get("BENCH_CPU_SETS", "4"))
+
+    import lighthouse_tpu
+
+    lighthouse_tpu.enable_compilation_cache()
+    from lighthouse_tpu.crypto import bls
+    from lighthouse_tpu.crypto.bls.keys import SecretKey, SignatureSet
+    from lighthouse_tpu.crypto.bls.backends import tpu as TB, cpu as CB
+
+    # -- build the workload (distinct messages, single pubkey per set) --
+    keys = [SecretKey.from_seed(i.to_bytes(4, "big")) for i in range(64)]
+    pubs = [k.public_key() for k in keys]
+    sets = []
+    for i in range(n_sets):
+        k = i % len(keys)
+        msg = b"bench-attestation-%d" % i
+        sets.append(SignatureSet.single_pubkey(keys[k].sign(msg), pubs[k], msg))
+    scalars = bls.gen_batch_scalars(n_sets)
+
+    # -- device timing (prepared inputs; kernel includes h2c, subgroup
+    # checks, ladders, pairings — everything but SHA-256 and packing) --
+    args = TB.prepare_batch(sets, scalars)
+    assert args is not None
+    import jax
+
+    out = jax.block_until_ready(TB._verify_kernel(*args))  # compile+warm
+    assert bool(np.asarray(out)), "bench batch must verify"
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(TB._verify_kernel(*args))
+        times.append(time.perf_counter() - t0)
+    dev_rate = n_sets / min(times)
+
+    # -- CPU control --
+    t0 = time.perf_counter()
+    ok = CB.verify_signature_sets(sets[:cpu_sets], scalars[:cpu_sets])
+    cpu_dt = time.perf_counter() - t0
+    assert ok
+    cpu_rate = cpu_sets / cpu_dt
+
+    print(
+        json.dumps(
+            {
+                "metric": "bls_verify_signature_sets_throughput",
+                "value": round(dev_rate, 2),
+                "unit": "sets/s",
+                "vs_baseline": round(dev_rate / cpu_rate, 2),
+                "detail": {
+                    "batch": n_sets,
+                    "device": str(jax.devices()[0]),
+                    "best_batch_seconds": round(min(times), 4),
+                    "cpu_control_sets_per_s": round(cpu_rate, 2),
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

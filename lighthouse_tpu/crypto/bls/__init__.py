@@ -35,7 +35,7 @@ def gen_batch_scalars(n: int):
     return out
 
 
-def verify_signature_sets(sets, backend: str = None, rand_scalars=None) -> bool:
+def verify_signature_sets(sets, *, backend: str = None, rand_scalars=None) -> bool:
     """Batch-verify independently-signed SignatureSets.
 
     The entry point every verifier in the framework funnels into — gossip
@@ -48,7 +48,7 @@ def verify_signature_sets(sets, backend: str = None, rand_scalars=None) -> bool:
     return b.verify_signature_sets(sets, rand_scalars)
 
 
-def verify(signature, pubkey, message: bytes, backend: str = None) -> bool:
+def verify(signature, pubkey, message: bytes, *, backend: str = None) -> bool:
     """Single-signature verification."""
     b = _backends.get(backend or _DEFAULT_BACKEND)
     return b.verify_single(signature, pubkey, message)

@@ -11,7 +11,7 @@ with one shared final exponentiation (blst.rs:114-116 semantics;
 "fast verification of multiple BLS signatures", random linear combination).
 """
 
-from .. import params, curve as C, pairing as PR, hash_to_curve as H2C
+from .. import params, curve as C, pairing_fast as PR, hash_to_curve as H2C
 
 
 def verify_signature_sets(sets, rand_scalars) -> bool:
@@ -38,7 +38,7 @@ def verify_signature_sets(sets, rand_scalars) -> bool:
         pairs.append((C.g1_mul(apk, r), H2C.hash_to_g2(s.message)))
         sig_acc = C.g2_add(sig_acc, C.g2_mul(s.signature.point, r))
     pairs.append((C.g1_neg(C.G1_GEN), sig_acc))
-    return PR.pairings_product_is_one(pairs)
+    return PR.pairings_product_is_one_fast(pairs)
 
 
 def verify_single(signature, pubkey, message: bytes) -> bool:
@@ -49,4 +49,4 @@ def verify_single(signature, pubkey, message: bytes) -> bool:
         (pubkey.point, H2C.hash_to_g2(message)),
         (C.g1_neg(C.G1_GEN), signature.point),
     ]
-    return PR.pairings_product_is_one(pairs)
+    return PR.pairings_product_is_one_fast(pairs)

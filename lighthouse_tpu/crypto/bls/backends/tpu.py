@@ -1,0 +1,189 @@
+"""TPU BLS backend — the reason this framework exists.
+
+Same semantics as the CPU control (backends/cpu.py, mirroring
+crypto/bls/src/impls/blst.rs:37-119): random-linear-combination batch
+verification,
+
+    prod_i e([r_i] apk_i, H(m_i)) * e(-g1, sum_i [r_i] sig_i) == 1,
+
+but with every expensive step — hash-to-curve maps, G2 subgroup checks
+of the signatures, the 64-bit scalar ladders, the point-sum tree, n+1
+Miller loops, one final exponentiation — fused into ONE jitted XLA
+program over the whole batch. Batch sizes are padded to power-of-two
+buckets so recompilation is rare; padding slots use r = 0 and are masked
+out of the pairing product.
+
+Division of labor:
+  host   — input policy checks (empty sets, infinity points), per-set
+           pubkey aggregation (the decompressed-pubkey-cache role,
+           validator_pubkey_cache.rs:138), SHA-256 message expansion,
+           CSPRNG scalars, packing.
+  device — all field/curve/pairing arithmetic, batched.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .. import params
+from lighthouse_tpu.ops import fp, tower, jacobian as J, pairing as OP, htc
+
+W = fp.W
+
+_G1_GEN_NEG_X = fp.to_limbs(params.G1X)
+_G1_GEN_NEG_Y = fp.to_limbs((-params.G1Y) % params.P)
+_G2_GEN_X = tower.f2_pack(params.G2X)
+_G2_GEN_Y = tower.f2_pack(params.G2Y)
+
+
+def _to_affine_g1(p):
+    X, Y, Z = p
+    zi = fp.inv(Z)
+    zi2 = fp.sqr(zi)
+    return fp.mul(X, zi2), fp.mul(fp.mul(Y, zi2), zi)
+
+
+def _to_affine_g2(p):
+    X, Y, Z = p
+    zi = tower.f2inv(Z)
+    zi2 = tower.f2sqr(zi)
+    return tower.f2mul(X, zi2), tower.f2mul(tower.f2mul(Y, zi2), zi)
+
+
+def local_phase(apk_x, apk_y, sig_x, sig_y, t0, t1, rbits, pad):
+    """The per-shard portion of batch verification: everything except
+    the global signature aggregate. Returns
+      (f_local, r_sig, sub_ok_all):
+      f_local [2,3,2,W]  — product of this shard's n Miller values
+      r_sig              — this shard's SUM of [r_i]sig_i (Jacobian G2)
+      sub_ok_all []      — AND of this shard's subgroup checks.
+    Used unsharded by `_verify_kernel` and per-device by
+    lighthouse_tpu.parallel.verify under shard_map (SURVEY.md §5.7: the
+    batch axis is this project's sequence-parallel analog)."""
+    n = apk_x.shape[0]
+    one1 = tower.bcast(jnp.asarray(fp.ONE), (n,))
+    one2 = tower.bcast(jnp.asarray(np.stack([fp.ONE, fp.ZERO])), (n,))
+
+    # hash-to-curve for all messages
+    hm = htc.hash_draws_to_g2(t0, t1)                    # [n] Jacobian G2
+
+    # Two scalar multiplications of the SAME base (subgroup check's
+    # [|u|]S and the random-combination [r]S) share one doubling chain:
+    # a single scan with two conditional-add accumulators — half the
+    # ladder cost and one compiled body instead of two.
+    sig_jac = (sig_x, sig_y, one2)
+    mbits = htc._m_bits(n)
+    m_sig, r_sig = J.scalar_mul2(J.FP2, sig_jac, mbits, rbits)
+
+    # signature subgroup checks: psi(S) == [u]S = -[|u|]S
+    sub_ok = J.jac_eq(J.FP2, J.psi(sig_jac), J.neg(J.FP2, m_sig)) | pad
+
+    s_local = J.sum_tree(J.FP2, r_sig, n)                # shard's sum
+    r_apk = J.scalar_mul(J.FP1, (apk_x, apk_y, one1), rbits)
+
+    # to affine for the Miller loop
+    px, py = _to_affine_g1(r_apk)
+    qx, qy = _to_affine_g2(hm)
+    q_inf = J.FP2.is_zero_struct(hm[2]) | pad
+
+    fs = OP.miller_loop(px, py, qx, qy, p_inf=pad, q_inf=q_inf)
+    f_local = OP.f12_product_tree(fs, n)
+    return f_local, s_local, jnp.all(sub_ok)
+
+
+def finish_phase(f_prod, s_agg, sub_ok_all):
+    """Global finish: the (-g1, S) pair, final exponentiation, verdict."""
+    sx, sy = _to_affine_g2(tuple(c[None] for c in s_agg))
+    s_inf = J.FP2.is_zero_struct(s_agg[2])[None]
+    xP = tower.bcast(jnp.asarray(_G1_GEN_NEG_X), (1,))
+    yP = tower.bcast(jnp.asarray(_G1_GEN_NEG_Y), (1,))
+    f_last = OP.miller_loop(xP, yP, sx, sy, q_inf=s_inf)[0]
+    prod = tower.f12mul(f_prod, f_last)
+    ok = tower.f12_eq_one(OP.final_exp(prod))
+    return ok & sub_ok_all
+
+
+@jax.jit
+def _verify_kernel(apk_x, apk_y, sig_x, sig_y, t0, t1, rbits, pad):
+    """One fused single-device batch verification."""
+    f_local, s_local, sub_ok = local_phase(
+        apk_x, apk_y, sig_x, sig_y, t0, t1, rbits, pad
+    )
+    return finish_phase(f_local, s_local, sub_ok)
+
+
+def _bucket(n: int) -> int:
+    return 1 << max(3, (n - 1).bit_length())
+
+
+def prepare_batch(sets, rand_scalars):
+    """Host packing: sets -> kernel inputs, or None if policy-rejected
+    (empty input / empty keys / infinity points — blst.rs:42,80-89)."""
+    n = len(sets)
+    if n == 0:
+        return None
+    apk_pts, sig_pts, msgs = [], [], []
+    for s, r in zip(sets, rand_scalars):
+        if not s.signing_keys:
+            return None
+        if not (0 < r < 2**params.RAND_BITS):
+            raise ValueError("batch scalar out of range")
+        if s.signature.point is None:
+            return None
+        apk = None
+        from .. import curve as C
+
+        for k in s.signing_keys:
+            apk = C.g1_add(apk, k.point)
+        if apk is None:
+            return None
+        apk_pts.append(apk)
+        sig_pts.append(s.signature.point)
+        msgs.append(s.message)
+
+    npad = _bucket(n)
+    apk_x = np.stack(
+        [fp.to_limbs(p[0]) for p in apk_pts]
+        + [_G1_GEN_NEG_X] * (npad - n)
+    )
+    apk_y = np.stack(
+        [fp.to_limbs(p[1]) for p in apk_pts]
+        + [fp.to_limbs(params.G1Y)] * (npad - n)
+    )
+    sig_x = np.stack(
+        [tower.f2_pack(p[0]) for p in sig_pts] + [_G2_GEN_X] * (npad - n)
+    )
+    sig_y = np.stack(
+        [tower.f2_pack(p[1]) for p in sig_pts] + [_G2_GEN_Y] * (npad - n)
+    )
+    t0, t1 = htc.pack_draws(msgs + [b""] * (npad - n))
+    rbits = np.zeros((npad, 64), dtype=np.int32)
+    rbits[:n] = J.scalars_to_bits(rand_scalars, 64)
+    pad = np.zeros(npad, dtype=bool)
+    pad[n:] = True
+    return (
+        jnp.asarray(apk_x),
+        jnp.asarray(apk_y),
+        jnp.asarray(sig_x),
+        jnp.asarray(sig_y),
+        t0,
+        t1,
+        jnp.asarray(rbits),
+        jnp.asarray(pad),
+    )
+
+
+def verify_signature_sets(sets, rand_scalars) -> bool:
+    args = prepare_batch(sets, rand_scalars)
+    if args is None:
+        return False
+    return bool(np.asarray(_verify_kernel(*args)))
+
+
+def verify_single(signature, pubkey, message: bytes) -> bool:
+    from ..keys import SignatureSet
+
+    if signature.point is None:
+        return False
+    s = SignatureSet.single_pubkey(signature, pubkey, message)
+    return verify_signature_sets([s], [1])

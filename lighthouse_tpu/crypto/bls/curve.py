@@ -57,31 +57,88 @@ def _make_ops(add, sub, mul, sqr, inv, neg, zero, one, b_coeff):
         y3 = sub(mul(lam, sub(x1, x3)), y1)
         return (x3, y3)
 
-    def pt_mul(pt, k):
-        k = k % R if k >= R else k
-        if k < 0:
-            k = k % R
+    # Scalar ladders run in Jacobian coordinates internally: affine
+    # add/double pay a field inversion PER STEP (Fermat pow — the
+    # dominant cost in profiles), Jacobian pays ONE at the end.
+
+    def _jac_double(p):
+        if p is None:
+            return None
+        X, Y, Z = p
+        A = sqr(X)
+        Bv = sqr(Y)
+        Cv = sqr(Bv)
+        D = sub(sub(sqr(add(X, Bv)), A), Cv)
+        D = add(D, D)
+        E = add(add(A, A), A)
+        Fv = sqr(E)
+        X3 = sub(Fv, add(D, D))
+        c8 = add(Cv, Cv)
+        c8 = add(c8, c8)
+        c8 = add(c8, c8)
+        Y3 = sub(mul(E, sub(D, X3)), c8)
+        Z3 = mul(add(Y, Y), Z)
+        return (X3, Y3, Z3)
+
+    def _jac_add(p, q):
+        if p is None:
+            return q
+        if q is None:
+            return p
+        X1, Y1, Z1 = p
+        X2, Y2, Z2 = q
+        Z1Z1 = sqr(Z1)
+        Z2Z2 = sqr(Z2)
+        U1 = mul(X1, Z2Z2)
+        U2 = mul(X2, Z1Z1)
+        S1 = mul(mul(Y1, Z2), Z2Z2)
+        S2 = mul(mul(Y2, Z1), Z1Z1)
+        if U1 == U2:
+            if S1 == S2:
+                return _jac_double(p)
+            return None
+        H = sub(U2, U1)
+        I = sqr(add(H, H))
+        J = mul(H, I)
+        r2 = sub(S2, S1)
+        rr = add(r2, r2)
+        V = mul(U1, I)
+        X3 = sub(sub(sqr(rr), J), add(V, V))
+        SJ = mul(S1, J)
+        Y3 = sub(mul(rr, sub(V, X3)), add(SJ, SJ))
+        Z3 = mul(sub(sub(sqr(add(Z1, Z2)), Z1Z1), Z2Z2), H)
+        return (X3, Y3, Z3)
+
+    def _jac_from_affine(pt):
+        return None if pt is None else (pt[0], pt[1], one)
+
+    def _jac_to_affine(p):
+        if p is None or p[2] == zero:
+            return None
+        X, Y, Z = p
+        zi = inv(Z)
+        zi2 = sqr(zi)
+        return (mul(X, zi2), mul(mul(Y, zi2), zi))
+
+    def _ladder(pt, k):
         out = None
-        acc = pt
+        acc = _jac_from_affine(pt)
         while k:
             if k & 1:
-                out = pt_add(out, acc)
-            acc = pt_double(acc)
+                out = _jac_add(out, acc)
+            acc = _jac_double(acc)
             k >>= 1
-        return out
+        return _jac_to_affine(out)
+
+    def pt_mul(pt, k):
+        k = k % R
+        return _ladder(pt, k)
 
     def pt_mul_raw(pt, k):
         """Scalar mul WITHOUT reducing k mod R (for cofactor clearing)."""
         if k < 0:
             return pt_mul_raw(pt_neg(pt), -k)
-        out = None
-        acc = pt
-        while k:
-            if k & 1:
-                out = pt_add(out, acc)
-            acc = pt_double(acc)
-            k >>= 1
-        return out
+        return _ladder(pt, k)
 
     return on_curve, pt_neg, pt_double, pt_add, pt_mul, pt_mul_raw
 

@@ -1,0 +1,306 @@
+"""Batched Fp arithmetic over the BLS12-381 prime — the TPU performance core.
+
+Replaces the reference's blst field layer (bound at crypto/bls/src/impls/
+blst.rs; SURVEY.md §2.7 item 1) with a design chosen for the TPU's
+compilation and execution model rather than for scalar CPUs:
+
+Layout
+------
+An Fp element is an int32 array [..., W] (W = 36 limbs, B = 11 bits,
+396-bit capacity). Limbs are *lazy and signed*: the encoded value is
+sum(limb[i] << (11*i)), interpreted mod p. Products of 13-bit-bounded
+limbs accumulate across a 36-term convolution inside int32 — no 64-bit
+carry chains, which TPUs don't have.
+
+Reduction by constant-matrix folding (NOT word-serial Montgomery)
+-----------------------------------------------------------------
+After a limb convolution, the high limbs (weight >= 2^385) are folded
+down by one batched matmul with a *precomputed constant matrix*:
+FOLD[i] = limbs(2^(11*(35+i)) mod p). Folding is a single dense
+[hi, 36] contraction — VPU/MXU-shaped, fully parallel over the batch —
+where Montgomery REDC would be W serially-dependent carry steps. Three
+fold rounds bound every product at value < 2^392.2 ("standard").
+
+Contract (the only rules callers must respect)
+----------------------------------------------
+- `mul`/`sqr` inputs: sums/differences of at most THREE standard
+  elements (limb bound 3*(2^11+2) keeps conv coefficients < 2^31).
+- `normalize` accepts any |limbs| < 2^30 with |value| < capacity and
+  returns standard-limbed output; use it to reset deeper add chains
+  (sums of up to 12 standard elements).
+- Exact compare/serialize only via `canonical` (boundary op).
+
+All ops broadcast over arbitrary leading batch dims.
+"""
+
+from functools import partial
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..crypto.bls.params import P
+
+B = 11                       # bits per limb
+W = 36                       # working limbs (396-bit capacity)
+MASK = (1 << B) - 1
+CONVW = 2 * W + 1            # conv output width incl. carry headroom (73)
+FOLD_AT = 35                 # fold everything with weight >= 2^(11*35)
+
+# ---------------------------------------------------------------- host codecs
+
+
+def to_limbs(x: int, width: int = W) -> np.ndarray:
+    """Python int (any sign) -> canonical-ish limb vector of x mod p."""
+    x = x % P
+    out = np.zeros(width, dtype=np.int32)
+    for i in range(width):
+        out[i] = x & MASK
+        x >>= B
+    assert x == 0, "value exceeds limb capacity"
+    return out
+
+
+def from_limbs(v) -> int:
+    """Limb vector (any lazy/signed form, any width) -> int mod p."""
+    v = np.asarray(v)
+    acc = 0
+    for i in reversed(range(v.shape[-1])):
+        acc = (acc << B) + int(v[..., i])
+    return acc % P
+
+
+def pack(ints) -> np.ndarray:
+    """Iterable of python ints -> [len, W] int32 canonical limbs."""
+    return np.stack([to_limbs(i) for i in ints]).astype(np.int32)
+
+
+# Fold matrices: row i = limbs of (2^(11*(FOLD_AT+i)) mod p). Entries < 2^11.
+def _fold_matrix(n_hi: int) -> np.ndarray:
+    return np.stack(
+        [to_limbs(pow(2, B * (FOLD_AT + i), P)) for i in range(n_hi)]
+    ).astype(np.int32)
+
+
+FOLD_FULL = jnp.asarray(_fold_matrix(CONVW - FOLD_AT))   # [38, 36]
+FOLD_2 = jnp.asarray(_fold_matrix(2))                    # [2, 36]
+FOLD_1 = jnp.asarray(_fold_matrix(1))                    # [1, 36]
+
+ZERO = np.zeros(W, dtype=np.int32)
+ONE = to_limbs(1)
+P_LIMBS = to_limbs(P)
+
+# For canonicalization: K*p >= 2^396 offset, and p*2^k ladders (37-limb).
+def _limbs_raw(x: int, width: int) -> np.ndarray:
+    return np.array([(x >> (B * i)) & MASK for i in range(width)], dtype=np.int32)
+
+
+_KP = ((1 << 386) // P + 1) * P          # canonical() offset: see below
+KP_37 = jnp.asarray(_limbs_raw(_KP, 37))
+_LADDER_ROUNDS = 7                        # covers values < p * 2^7
+PK_LADDER = jnp.asarray(
+    np.stack([_limbs_raw(P << k, 37) for k in range(_LADDER_ROUNDS)])
+)
+
+
+# ---------------------------------------------------------------- carries
+
+
+_TOPFOLD_CACHE = {}
+
+
+def _topfold(width: int) -> jnp.ndarray:
+    """limbs(2^(B*width) mod p) at `width` — re-absorbs the top limb's
+    carry-out instead of dropping it (crucial for NEGATIVE lazy values,
+    whose top carry is -1). Entries canonical (< 2^11, top limbs zero)."""
+    if width not in _TOPFOLD_CACHE:
+        _TOPFOLD_CACHE[width] = jnp.asarray(
+            _limbs_raw(pow(2, B * width, P), width)
+        )
+    return _TOPFOLD_CACHE[width]
+
+
+def norm1(x):
+    """One shift-add carry pass (arithmetic >> keeps signs exact). The
+    top limb's carry-out is folded back mod p, never dropped."""
+    lo = jnp.bitwise_and(x, MASK)
+    hi = jnp.right_shift(x, B)
+    out = lo + jnp.pad(hi[..., :-1], [(0, 0)] * (x.ndim - 1) + [(1, 0)])
+    return out + hi[..., -1:] * _topfold(x.shape[-1])
+
+
+def norm3(x):
+    """Three passes: limbs land in (-2, 2^B+2 + 2^B) ⊂ (-2^12, 2^12) for
+    any input with |limbs| < 2^30 and a top limb small enough that its
+    carry-fold stays in int32 (true everywhere in this codebase: conv
+    outputs are zero-padded on top; add-chain norms see small sums)."""
+    return norm1(norm1(norm1(x)))
+
+
+def _pad_to(x, width):
+    return jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(0, width - x.shape[-1])])
+
+
+def normalize(x, width: int = W):
+    """Pad to `width` then carry-normalize. Caller guarantees the value
+    fits `width` limbs (dropped top carries would corrupt silently)."""
+    return norm3(_pad_to(x, width))
+
+
+# ---------------------------------------------------------------- fold
+
+
+def _fold(x, matrix):
+    """Fold limbs [FOLD_AT:] down via the constant matrix; returns [..., W].
+
+    Congruence: sum_i hi_i * 2^(11*(35+i)) == hi @ matrix (mod p); holds
+    for signed lazy limbs too.
+    """
+    lo = _pad_to(x[..., :FOLD_AT], W)
+    hi = x[..., FOLD_AT:]
+    n = hi.shape[-1]
+    folded = jnp.einsum(
+        "...k,kw->...w", hi, matrix[:n], preferred_element_type=jnp.int32
+    )
+    return lo + folded
+
+
+# ---------------------------------------------------------------- multiply
+
+
+def _conv(a, b):
+    """Schoolbook limb product: [..., W] x [..., W] -> [..., CONVW] int32.
+
+    W shifted multiply-accumulates; coefficients < 36 * 6150^2 < 2^31 for
+    inputs bounded by 3 normalized summands.
+    """
+    shape = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
+    out = jnp.zeros((*shape, CONVW), dtype=jnp.int32)
+    for i in range(W):
+        out = out.at[..., i : i + W].add(a[..., i : i + 1] * b)
+    return out
+
+
+def mul(a, b, norm_a: bool = True, norm_b: bool = True):
+    """(a * b) mod p -> standard output (< 2^392.2, normalized limbs).
+
+    Inputs are carry-normalized on entry, so ANY lazy sums are accepted
+    provided |limbs| < 2^30 and |value| < 2^396 (<= 12 standard units) —
+    the tower never has to track limb depth. Set norm_a/norm_b=False only
+    when the operand is provably already normalized (hot-loop shaving).
+    """
+    if norm_a:
+        a = norm3(a)
+    if norm_b:
+        b = norm3(b)
+    wide = norm3(_conv(a, b))               # 73 normalized limbs
+    x = norm3(_pad_to(_fold(wide, FOLD_FULL), 37))   # value < 2^397.4
+    x = norm3(_fold(x, FOLD_2))             # value < 2^393.1, 36 limbs
+    x = norm3(_fold(x, FOLD_1))             # value < 2^392.2
+    return x
+
+
+def sqr(a, norm: bool = True):
+    if norm:
+        a = norm3(a)
+    return mul(a, a, norm_a=False, norm_b=False)
+
+
+def reduce_light(x):
+    """Re-standardize a deep add chain ([..., W], |value| < 2^396):
+    normalize then two fold rounds -> standard bound (< 2^390.3)."""
+    x = norm3(x)
+    x = norm3(_fold(x, FOLD_1))
+    x = norm3(_fold(x, FOLD_1))
+    return x
+
+
+# ---------------------------------------------------------------- canonical
+
+
+def _ripple_carry(v):
+    """Exact carry ripple via lax.scan; returns (limbs, final_carry).
+    final_carry < 0 iff the encoded value is negative."""
+
+    def step(carry, limb):
+        s = limb + carry
+        return jnp.right_shift(s, B), jnp.bitwise_and(s, MASK)
+
+    carry, limbs = jax.lax.scan(
+        step, jnp.zeros(v.shape[:-1], jnp.int32), jnp.moveaxis(v, -1, 0)
+    )
+    return jnp.moveaxis(limbs, 0, -1), carry
+
+
+def _ripple(v):
+    return _ripple_carry(v)[0]
+
+
+def _geq(x, y):
+    """Lexicographic x >= y over canonical limb vectors (batched)."""
+    gt = jnp.zeros(x.shape[:-1], dtype=jnp.bool_)
+    lt = jnp.zeros(x.shape[:-1], dtype=jnp.bool_)
+    for i in reversed(range(x.shape[-1])):
+        xi = x[..., i]
+        yi = y[..., i]
+        gt = gt | (~lt & (xi > yi))
+        lt = lt | (~gt & (xi < yi))
+    return ~lt
+
+
+def canonical(x):
+    """Unique representative in [0, p), canonical limbs [..., W].
+
+    Boundary-only op (compare/serialize). Fold rounds first shrink the
+    value into (-2^385.6, 2^385.6) ⊂ (-32p, 32p), so the binary
+    conditional-subtract ladder needs only 6 rounds (vs ~20 from raw
+    lazy range) — this op sits inside every exact point-add, so its HLO
+    footprint matters.
+    """
+    x = reduce_light(x)                      # |value| < 2^390.3
+    x = norm3(_fold(x, FOLD_1))              # |value| < 2^387.5
+    x = norm3(_fold(x, FOLD_1))              # |value| < 2^385.6
+    x = _ripple(_pad_to(x, 37) + KP_37)      # value in (0, p*2^7), canonical
+    for k in reversed(range(_LADDER_ROUNDS)):
+        # subtract p*2^k when it doesn't underflow: detect via the
+        # ripple's final borrow instead of a lexicographic compare
+        d, borrow = _ripple_carry(x - PK_LADDER[k])
+        x = jnp.where((borrow >= 0)[..., None], d, x)
+    return x[..., :W]
+
+
+def eq_zero(x):
+    """True where lazy x === 0 (mod p). Boundary op."""
+    return jnp.all(canonical(x) == 0, axis=-1)
+
+
+def eq(x, y):
+    """True where two lazy elements are equal mod p. Boundary op."""
+    return eq_zero(x - y)
+
+
+# ---------------------------------------------------------------- pow / inv
+
+
+def pow_const(a, exponent: int):
+    """a^e for a static Python int e, via LSB-first square-and-multiply
+    under lax.scan (compile size O(1) in e)."""
+    nbits = max(exponent.bit_length(), 1)
+    bits = jnp.asarray(
+        [(exponent >> i) & 1 for i in range(nbits)], dtype=jnp.bool_
+    )
+    one = jnp.broadcast_to(jnp.asarray(ONE), a.shape).astype(jnp.int32)
+
+    def step(carry, bit):
+        acc, base = carry
+        acc = jnp.where(bit, mul(acc, base), acc)
+        base = sqr(base)
+        return (acc, base), None
+
+    (acc, _), _ = jax.lax.scan(step, (one, norm3(a)), bits)
+    return acc
+
+
+def inv(a):
+    """a^(p-2) — Fermat inversion (0 maps to 0)."""
+    return pow_const(a, P - 2)

@@ -1,0 +1,250 @@
+"""Device map-to-curve for G2 hash-to-curve — batched, branchless.
+
+Split of labor (mirrors the host oracle crypto/bls/hash_to_curve.py):
+the host runs expand_message_xmd (SHA-256, cheap, sequential) and ships
+field draws t0, t1 per message; the device runs everything expensive —
+simplified SWU on E2', the 3-isogeny (projectively, no inversions), the
+Jacobian sum q0+q1 and Budroni–Pintore cofactor clearing — over the whole
+message batch at once.
+
+Square roots use the q = p^2 ≡ 9 (mod 16) structure: one exponentiation
+c = s^((q+7)/16). For square s, c^2/s = s^((q-1)/8) is a FOURTH root of
+unity (s^((q-1)/2) = 1), so the true root is c times one of the four
+correctors {1, u, sqrt(u), sqrt(-u)} (squares {1, -1, u, -u} = mu_4;
+RFC 9380 F.1's sqrt_q_9_mod_16 candidate set). The non-square branch
+reuses c via the SWU identity g(x2) = Z^3 t^6 g(x1): candidate
+t^3 * Z^(3(q+7)/16) * c, corrected by the same four roots (Z^3 t^6 g(x1)
+is square whenever g(x1) is not). One big pow per map total, everything
+else where-selects.
+
+Reference parity: blst's hash-to-curve inside verify paths
+(crypto/bls/src/impls/blst.rs:15 DST; SURVEY.md §2.1).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..crypto.bls.params import P, X
+from ..crypto.bls import fields as FF, hash_to_curve as H2C
+from ..crypto.bls import _g2_isogeny_consts as ISO
+from . import fp, tower, jacobian as J
+from .tower import f2mul, f2sqr, f2mul_xi
+
+W = fp.W
+Q = P * P
+_EXP = (Q + 7) // 16
+assert Q % 16 == 9
+
+# ---------------------------------------------------------------- constants
+
+_A = tower.f2_pack(H2C.A_PRIME)
+_B = tower.f2_pack(H2C.B_PRIME)
+_Z = tower.f2_pack(H2C.Z)
+_NEG_B = tower.f2_pack(FF.f2neg(H2C.B_PRIME))
+# fallback x1 when tv1 == 0: B' / (Z * A')
+_X1_0 = tower.f2_pack(
+    FF.f2mul(H2C.B_PRIME, FF.f2inv(FF.f2mul(H2C.Z, H2C.A_PRIME)))
+)
+# C2 = (Z^3)^((q+7)/16): corrector for the non-square branch
+_C2 = tower.f2_pack(FF.f2pow(FF.f2mul(FF.f2sqr(H2C.Z), H2C.Z), _EXP))
+# sqrt correction roots {1, u, sqrt(u), sqrt(-u)}: squares are the four
+# fourth roots of unity, covering every c^2/s for square s
+_ROOT_U = FF.f2sqrt((0, 1))
+_ROOT_NU = FF.f2sqrt((0, P - 1))
+assert _ROOT_U is not None and _ROOT_NU is not None
+_ROOTS = np.stack(
+    [
+        tower.f2_pack(FF.F2_ONE),
+        tower.f2_pack((0, 1)),
+        tower.f2_pack(_ROOT_U),
+        tower.f2_pack(_ROOT_NU),
+    ]
+)  # [4, 2, W]
+
+_ISO_XNUM = np.stack([tower.f2_pack(c) for c in ISO.XNUM])
+_ISO_XDEN = np.stack([tower.f2_pack(c) for c in ISO.XDEN])
+_ISO_YNUM = np.stack([tower.f2_pack(c) for c in ISO.YNUM])
+_ISO_YDEN = np.stack([tower.f2_pack(c) for c in ISO.YDEN])
+
+
+def _bc(const, batch):
+    return tower.bcast(jnp.asarray(const), batch)
+
+
+# ---------------------------------------------------------------- fp2 pow
+
+
+def f2_pow_const(a, exponent: int):
+    """a^e in Fp2, static e, square-and-multiply under lax.scan."""
+    nbits = max(exponent.bit_length(), 1)
+    bits = jnp.asarray(
+        [(exponent >> i) & 1 for i in range(nbits)], dtype=jnp.bool_
+    )
+    one = _bc(np.stack([fp.ONE, fp.ZERO]), a.shape[:-2])
+
+    def step(carry, bit):
+        acc, base = carry
+        nxt = f2mul(acc, base)
+        acc = jnp.where(bit, nxt, acc)
+        base = f2sqr(base)
+        return (acc, base), None
+
+    (acc, _), _ = jax.lax.scan(step, (one, fp.norm3(a)), bits)
+    return acc
+
+
+# ---------------------------------------------------------------- sgn0
+
+
+def f2_sgn0(a):
+    """RFC 9380 sgn0 for Fp2 (batched): needs canonical limbs."""
+    c = fp.canonical(a)
+    a0, a1 = c[..., 0, :], c[..., 1, :]
+    s0 = a0[..., 0] & 1
+    z0 = jnp.all(a0 == 0, axis=-1)
+    s1 = a1[..., 0] & 1
+    return s0 | (z0.astype(jnp.int32) & s1)
+
+
+# ---------------------------------------------------------------- SSWU
+
+
+def _g_prime(x, batch):
+    """g'(x) = x^3 + A'x + B' on E2'."""
+    x2 = f2sqr(x)
+    return fp.reduce_light(
+        f2mul(x2, x) + f2mul(_bc(_A, batch), x) + _bc(_B, batch)
+    )
+
+
+def _pick_root(cand, target, batch):
+    """(y, found): y = cand * root for the first correction root with
+    y^2 == target; found = any. ONE stacked f2sqr over the 4 candidates."""
+    roots = _bc(_ROOTS, batch)                       # [..., 4, 2, W]
+    cands = f2mul(roots, cand[..., None, :, :])      # [..., 4, 2, W]
+    ok = tower.f2_eq(f2sqr(cands), target[..., None, :, :])  # [..., 4]
+    found = jnp.any(ok, axis=-1)
+    # first-match select: walk the 4 candidates with where-chains
+    y = cands[..., 0, :, :]
+    for k in (1, 2, 3):
+        take = ok[..., k] & ~jnp.any(ok[..., :k], axis=-1)
+        y = jnp.where(take[..., None, None], cands[..., k, :, :], y)
+    return y, found
+
+
+def map_to_curve(t):
+    """Batched SSWU: Fp2 draws [..., 2, W] -> E2'(Fp2) affine (x, y)."""
+    batch = t.shape[:-2]
+    t2 = f2sqr(t)
+    zt2 = f2mul(_bc(_Z, batch), t2)
+    zt2sq = f2sqr(zt2)
+    tv1 = fp.reduce_light(zt2sq + zt2)
+    tv1_zero = tower.f2_eq_zero(tv1)
+    # x1 = -B (tv1 + 1) * inv(A * tv1); tv1==0 -> constant fallback
+    inv_atv1 = tower.f2inv(f2mul(_bc(_A, batch), tv1))
+    one2 = _bc(np.stack([fp.ONE, fp.ZERO]), batch)
+    x1 = f2mul(f2mul(_bc(_NEG_B, batch), fp.reduce_light(tv1 + one2)), inv_atv1)
+    x1 = jnp.where(tv1_zero[..., None, None], _bc(_X1_0, batch), x1)
+    s = _g_prime(x1, batch)
+    # candidate root of s, corrected by the four roots (module doc)
+    c = f2_pow_const(s, _EXP)
+    y1, is_sq = _pick_root(c, s, batch)
+    # non-square branch: x2 = Z t^2 x1, y2 = t^3 C2 c (corrected)
+    x2 = f2mul(zt2, x1)
+    gx2 = _g_prime(x2, batch)
+    t3 = f2mul(t2, t)
+    y2a = f2mul(f2mul(t3, _bc(_C2, batch)), c)
+    y2, _ = _pick_root(y2a, gx2, batch)
+    x = jnp.where(is_sq[..., None, None], x1, x2)
+    y = jnp.where(is_sq[..., None, None], y1, y2)
+    # sign fix: sgn0(y) == sgn0(t)
+    flip = f2_sgn0(y) != f2_sgn0(t)
+    y = jnp.where(flip[..., None, None], -y, y)
+    return x, y
+
+
+# ---------------------------------------------------------------- isogeny
+
+
+def _eval_poly(coeffs, x, batch):
+    acc = _bc(coeffs[-1], batch)
+    for c in reversed(coeffs[:-1]):
+        acc = fp.reduce_light(f2mul(acc, x) + _bc(c, batch))
+    return acc
+
+
+def iso_map(x, y):
+    """Projective 3-isogeny E2' -> E2: returns Jacobian (X, Y, Z) with
+    Z = xd*yd (kernel abscissa -> Z = 0 = infinity, automatically)."""
+    batch = x.shape[:-2]
+    xn = _eval_poly(_ISO_XNUM, x, batch)
+    xd = _eval_poly(_ISO_XDEN, x, batch)
+    yn = _eval_poly(_ISO_YNUM, x, batch)
+    yd = _eval_poly(_ISO_YDEN, x, batch)
+    Z = f2mul(xd, yd)
+    Xo = f2mul(f2mul(xn, xd), f2sqr(yd))
+    xd2 = f2sqr(xd)
+    Yo = f2mul(f2mul(y, yn), f2mul(f2mul(xd2, xd), f2sqr(yd)))
+    return (Xo, Yo, Z)
+
+
+# ---------------------------------------------------------------- clearing
+
+_M_ABS = -X  # |u|, positive
+_M_BITS = None
+
+
+def _m_bits(batch_n):
+    global _M_BITS
+    if _M_BITS is None or _M_BITS.shape[0] != batch_n:
+        _M_BITS = jnp.asarray(
+            np.broadcast_to(
+                np.array([(_M_ABS >> i) & 1 for i in range(64)], np.int32),
+                (batch_n, 64),
+            )
+        )
+    return _M_BITS
+
+
+def clear_cofactor(p):
+    """Budroni–Pintore: h_eff·P = [m^2]P + [m]P - P - psi([m]P + P)
+    + psi^2(2P), with m = |u| (signs folded for u < 0)."""
+    n = p[0].shape[0]
+    bits = _m_bits(n)
+    a1 = J.scalar_mul(J.FP2, p, bits)          # [m]P
+    a2 = J.scalar_mul(J.FP2, a1, bits)         # [m^2]P
+    s1 = J.add(J.FP2, a1, p, exact=True)       # [m]P + P
+    res = J.add(J.FP2, a2, a1, exact=True)
+    res = J.add(J.FP2, res, J.neg(J.FP2, p), exact=True)
+    res = J.add(J.FP2, res, J.neg(J.FP2, J.psi(s1)), exact=True)
+    dbl = J.double(J.FP2, p)
+    res = J.add(J.FP2, res, J.psi(J.psi(dbl)), exact=True)
+    return res
+
+
+def hash_draws_to_g2(t0, t1):
+    """Two Fp2 draws per message -> G2 point (Jacobian), batched.
+
+    The two SWU maps run as ONE doubled batch (compile-size: the whole
+    map/isogeny subgraph appears once in the HLO, not twice)."""
+    n = t0.shape[0]
+    t = jnp.concatenate([t0, t1], axis=0)
+    q = iso_map(*map_to_curve(t))
+    q0 = tuple(c[:n] for c in q)
+    q1 = tuple(c[n:] for c in q)
+    return clear_cofactor(J.add(J.FP2, q0, q1, exact=True))
+
+
+# ---------------------------------------------------------------- host feed
+
+
+def pack_draws(messages, dst=None):
+    """Host: messages -> (t0, t1) Fp2 limb arrays [n, 2, W] each."""
+    t0s, t1s = [], []
+    for m in messages:
+        kwargs = {"dst": dst} if dst is not None else {}
+        u0, u1 = H2C.hash_to_field_fp2(m, 2, **kwargs)
+        t0s.append(tower.f2_pack(u0))
+        t1s.append(tower.f2_pack(u1))
+    return jnp.asarray(np.stack(t0s)), jnp.asarray(np.stack(t1s))

@@ -1,0 +1,359 @@
+"""Batched Jacobian-coordinate group ops for G1 (over Fp) and G2 (over Fp2).
+
+One generic implementation parametrized by a field namespace — the TPU
+replacement for blst's G1/G2 point pipelines (reference seam:
+crypto/bls/src/impls/blst.rs aggregation + scalar multiplication).
+
+Representation: a point is a tuple (X, Y, Z) of field arrays (Fp:
+[..., W]; Fp2: [..., 2, W]); affine x = X/Z^2, y = Y/Z^3. Infinity is
+STRUCTURAL Z == 0 (all limbs zero), which formulas propagate on their
+own (Z3 = 2*Y*Z etc.), so infinity tests are cheap limb tests, not
+canonical compares — a batch/SIMD-friendly completeness scheme:
+
+- `double` and the scalar-multiplication ladder use branchless formulas
+  only: the equal/negative collision cases are impossible there by group
+  order (acc = m*P vs addend = 2^j*P with m < 2^j << r).
+- `add(..., exact=True)` (the point-sum reduction tree over adversarial
+  inputs) additionally resolves H==0 collisions mod p with canonical
+  equality, selecting double/infinity — complete addition.
+
+Formulas: dbl-2009-l and add-2007-bl (EFD), a = 0 curves. Every op
+returns standardized (reduce_light) components so results compose and
+carry through lax.scan without limb growth.
+"""
+
+from types import SimpleNamespace
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..crypto.bls import curve as C
+from . import fp, tower
+
+W = fp.W
+
+
+def _wh(flag, a, b, elem_ndim):
+    f = flag.reshape(flag.shape + (1,) * elem_ndim)
+    return jnp.where(f, a, b)
+
+
+FP1 = SimpleNamespace(
+    name="fp",
+    ndim=1,
+    mul=lambda a, b: fp.mul(a, b),
+    sqr=lambda a: fp.sqr(a),
+    reduce=fp.reduce_light,
+    eq_zero=fp.eq_zero,
+    is_zero_struct=lambda a: jnp.all(a == 0, axis=-1),
+    wh=lambda f, a, b: _wh(f, a, b, 1),
+    zeros=lambda shape: jnp.zeros((*shape, W), dtype=jnp.int32),
+)
+
+FP2 = SimpleNamespace(
+    name="fp2",
+    ndim=2,
+    mul=tower.f2mul,
+    sqr=tower.f2sqr,
+    reduce=fp.reduce_light,
+    eq_zero=tower.f2_eq_zero,
+    is_zero_struct=lambda a: jnp.all(a == 0, axis=(-2, -1)),
+    wh=lambda f, a, b: _wh(f, a, b, 2),
+    zeros=lambda shape: jnp.zeros((*shape, 2, W), dtype=jnp.int32),
+)
+
+
+# ---------------------------------------------------------------- host codecs
+
+
+def pack_g1(points) -> tuple:
+    """Affine points/None -> (X, Y, Z) [n, W] arrays; None -> Z = 0."""
+    xs, ys, zs = [], [], []
+    for pt in points:
+        if pt is None:
+            xs.append(fp.ZERO)
+            ys.append(fp.ZERO)
+            zs.append(fp.ZERO)
+        else:
+            xs.append(fp.to_limbs(pt[0]))
+            ys.append(fp.to_limbs(pt[1]))
+            zs.append(fp.ONE)
+    return (
+        jnp.asarray(np.stack(xs)),
+        jnp.asarray(np.stack(ys)),
+        jnp.asarray(np.stack(zs)),
+    )
+
+
+def pack_g2(points) -> tuple:
+    xs, ys, zs = [], [], []
+    zero2 = np.zeros((2, W), dtype=np.int32)
+    one2 = np.stack([fp.ONE, fp.ZERO])
+    for pt in points:
+        if pt is None:
+            xs.append(zero2)
+            ys.append(zero2)
+            zs.append(zero2)
+        else:
+            xs.append(tower.f2_pack(pt[0]))
+            ys.append(tower.f2_pack(pt[1]))
+            zs.append(one2)
+    return (
+        jnp.asarray(np.stack(xs)),
+        jnp.asarray(np.stack(ys)),
+        jnp.asarray(np.stack(zs)),
+    )
+
+
+def unpack_g1(pt):
+    """Device Jacobian point(s) -> list of affine tuples/None (host)."""
+    X, Y, Z = (np.asarray(a) for a in pt)
+    out = []
+    flat = X.reshape(-1, W), Y.reshape(-1, W), Z.reshape(-1, W)
+    for x, y, z in zip(*flat):
+        zv = fp.from_limbs(z)
+        if zv == 0:
+            out.append(None)
+            continue
+        zi = pow(zv, C.P - 2, C.P)
+        out.append(
+            (
+                fp.from_limbs(x) * zi * zi % C.P,
+                fp.from_limbs(y) * zi * zi % C.P * zi % C.P,
+            )
+        )
+    return out
+
+
+def unpack_g2(pt):
+    X, Y, Z = (np.asarray(a) for a in pt)
+    out = []
+    n = int(np.prod(X.shape[:-2])) if X.ndim > 2 else 1
+    Xf = X.reshape(n, 2, W)
+    Yf = Y.reshape(n, 2, W)
+    Zf = Z.reshape(n, 2, W)
+    from ..crypto.bls import fields as FF
+
+    for i in range(n):
+        z = tower.f2_unpack(Zf[i])
+        if z == (0, 0):
+            out.append(None)
+            continue
+        zi = FF.f2inv(z)
+        zi2 = FF.f2sqr(zi)
+        zi3 = FF.f2mul(zi2, zi)
+        out.append(
+            (
+                FF.f2mul(tower.f2_unpack(Xf[i]), zi2),
+                FF.f2mul(tower.f2_unpack(Yf[i]), zi3),
+            )
+        )
+    return out
+
+
+# ---------------------------------------------------------------- core ops
+
+
+def double(ops, p):
+    """dbl-2009-l. Branchless; infinity (Z=0) propagates structurally."""
+    X, Y, Z = p
+    A = ops.sqr(X)
+    Bv = ops.sqr(Y)
+    Cv = ops.sqr(Bv)
+    D = ops.reduce(ops.sqr(X + Bv) - A - Cv)          # (X+B)^2 - A - C
+    D = D + D
+    E = A + A + A
+    F = ops.sqr(E)
+    X3 = ops.reduce(F - D - D)
+    Y3 = ops.reduce(ops.mul(E, D - X3) - 8 * Cv)
+    Z3 = ops.reduce(2 * ops.mul(Y, Z))
+    return (X3, Y3, Z3)
+
+
+def add(ops, p1, p2, exact: bool = False):
+    """add-2007-bl with structural-infinity selection.
+
+    exact=True additionally resolves the H == 0 (mod p) cases: doubling
+    when r == 0, infinity otherwise — required wherever adversarial
+    coincidences are possible (the aggregation tree).
+    """
+    X1, Y1, Z1 = p1
+    X2, Y2, Z2 = p2
+    Z1Z1 = ops.sqr(Z1)
+    Z2Z2 = ops.sqr(Z2)
+    U1 = ops.mul(X1, Z2Z2)
+    U2 = ops.mul(X2, Z1Z1)
+    S1 = ops.mul(ops.mul(Y1, Z2), Z2Z2)
+    S2 = ops.mul(ops.mul(Y2, Z1), Z1Z1)
+    H = U2 - U1
+    I = ops.sqr(H + H)
+    J = ops.mul(H, I)
+    r = 2 * (S2 - S1)
+    V = ops.mul(U1, I)
+    X3 = ops.reduce(ops.sqr(r) - J - 2 * V)
+    Y3 = ops.reduce(ops.mul(r, V - X3) - 2 * ops.mul(S1, J))
+    Z3 = ops.reduce(
+        ops.mul(ops.reduce(ops.sqr(Z1 + Z2) - Z1Z1 - Z2Z2), H)
+    )
+    out = (X3, Y3, Z3)
+
+    if exact:
+        h_zero = ops.eq_zero(H)
+        r_zero = ops.eq_zero(r)
+        dbl = double(ops, p1)
+        inf = tuple(ops.zeros(X3.shape[: X3.ndim - ops.ndim]) for _ in range(3))
+        out = tuple(
+            ops.wh(h_zero & r_zero, d, ops.wh(h_zero, i, o))
+            for d, i, o in zip(dbl, inf, out)
+        )
+
+    p1_inf = ops.is_zero_struct(Z1)
+    p2_inf = ops.is_zero_struct(Z2)
+    return tuple(
+        ops.wh(p1_inf, b, ops.wh(p2_inf, a, o))
+        for a, b, o in zip(p1, p2, out)
+    )
+
+
+def neg(ops, p):
+    return (p[0], -p[1], p[2])
+
+
+def scalar_mul(ops, base, bits):
+    """[k]base for per-element scalars given as a bit array.
+
+    base: Jacobian point arrays with batch shape S; bits: int32/bool
+    [*S, nbits] (LSB first). lax.scan over bit position; branchless
+    conditional add (collision-free by group order, see module doc).
+    """
+    nbits = bits.shape[-1]
+    acc0 = tuple(ops.zeros(bits.shape[:-1]) for _ in range(3))
+
+    def step(carry, bit):
+        acc, addend = carry
+        added = add(ops, acc, addend)
+        acc = tuple(ops.wh(bit, a, o) for a, o in zip(added, acc))
+        addend = double(ops, addend)
+        return (acc, addend), None
+
+    (acc, _), _ = jax.lax.scan(
+        step, (acc0, base), jnp.moveaxis(bits, -1, 0).astype(bool)
+    )
+    return acc
+
+
+def scalar_mul2(ops, base, bits_a, bits_b):
+    """([ka]base, [kb]base) for two per-element scalar bit arrays,
+    sharing ONE doubling chain (one scan body in the HLO — used where
+    the verify kernel multiplies the same point by two scalars)."""
+    acc0 = tuple(ops.zeros(bits_a.shape[:-1]) for _ in range(3))
+
+    def step(carry, bits):
+        bit_a, bit_b = bits
+        acc_a, acc_b, addend = carry
+        added_a = add(ops, acc_a, addend)
+        acc_a = tuple(ops.wh(bit_a, x, o) for x, o in zip(added_a, acc_a))
+        added_b = add(ops, acc_b, addend)
+        acc_b = tuple(ops.wh(bit_b, x, o) for x, o in zip(added_b, acc_b))
+        addend = double(ops, addend)
+        return (acc_a, acc_b, addend), None
+
+    (acc_a, acc_b, _), _ = jax.lax.scan(
+        step,
+        (acc0, acc0, base),
+        (
+            jnp.moveaxis(bits_a, -1, 0).astype(bool),
+            jnp.moveaxis(bits_b, -1, 0).astype(bool),
+        ),
+    )
+    return acc_a, acc_b
+
+
+def sum_tree(ops, p, n: int, lanes: int = 8):
+    """Complete sum of n points stacked along axis 0.
+
+    Compile-size-aware reduction: reshape to [steps, lanes] and lax.scan
+    an accumulator over steps (ONE compiled add body regardless of n),
+    then fold the `lanes` accumulators with a SECOND scan (one more add
+    body) — the exact-add subgraph appears exactly twice in the HLO no
+    matter how large n or lanes are. Exact (complete) adds throughout —
+    adversarial equal/negated points fold correctly. Returns the
+    single-point (batch-less) sum."""
+    lanes = max(1, min(lanes, n))
+    lanes = 1 << (lanes.bit_length() - 1)   # round down to a power of two
+    steps = -(-n // lanes)
+    pad_to = steps * lanes
+    if pad_to != n:
+        p = tuple(
+            jnp.concatenate([comp, ops.zeros((pad_to - n,))], axis=0)
+            for comp in p
+        )
+    chunked = tuple(
+        comp.reshape((steps, lanes) + comp.shape[1:]) for comp in p
+    )
+
+    def body(acc, chunk):
+        return add(ops, acc, chunk, exact=True), None
+
+    acc0 = tuple(ops.zeros((lanes,)) for _ in range(3))
+    acc, _ = jax.lax.scan(body, acc0, chunked)
+
+    def fold(acc1, lane):
+        return add(ops, acc1, lane, exact=True), None
+
+    acc1 = tuple(ops.zeros(()) for _ in range(3))
+    acc1, _ = jax.lax.scan(fold, acc1, acc)
+    return acc1
+
+
+def scalars_to_bits(scalars, nbits: int) -> np.ndarray:
+    """Host: python ints -> [n, nbits] int32 LSB-first bit matrix."""
+    out = np.zeros((len(scalars), nbits), dtype=np.int32)
+    for i, s in enumerate(scalars):
+        for j in range(nbits):
+            out[i, j] = (s >> j) & 1
+    return out
+
+
+# ---------------------------------------------------------------- G2 psi
+
+_PSI_CX = None
+_PSI_CY = None
+
+
+def _psi_consts():
+    global _PSI_CX, _PSI_CY
+    if _PSI_CX is None:
+        from ..crypto.bls import fields as FF
+
+        _PSI_CX = jnp.asarray(tower.f2_pack(FF.PSI_CX))
+        _PSI_CY = jnp.asarray(tower.f2_pack(FF.PSI_CY))
+    return _PSI_CX, _PSI_CY
+
+
+def psi(p):
+    """G2 twist endomorphism, Jacobian: psi(X,Y,Z) = (cx X̄, cy Ȳ, Z̄)."""
+    cx, cy = _psi_consts()
+    X, Y, Z = p
+    return (
+        tower.f2mul(tower.f2conj(X), tower.bcast(cx, X.shape[:-2])),
+        tower.f2mul(tower.f2conj(Y), tower.bcast(cy, Y.shape[:-2])),
+        tower.f2conj(Z),
+    )
+
+
+def jac_eq(ops, p1, p2):
+    """Exact equality: X1 Z2^2 == X2 Z1^2 and Y1 Z2^3 == Y2 Z1^3, with
+    infinity handled (both-inf == True, one-inf == False)."""
+    X1, Y1, Z1 = p1
+    X2, Y2, Z2 = p2
+    Z1Z1 = ops.sqr(Z1)
+    Z2Z2 = ops.sqr(Z2)
+    ex = ops.eq_zero(ops.mul(X1, Z2Z2) - ops.mul(X2, Z1Z1))
+    ey = ops.eq_zero(
+        ops.mul(ops.mul(Y1, Z2), Z2Z2) - ops.mul(ops.mul(Y2, Z1), Z1Z1)
+    )
+    i1 = ops.is_zero_struct(Z1)
+    i2 = ops.is_zero_struct(Z2)
+    return jnp.where(i1 | i2, i1 & i2, ex & ey)

@@ -1,0 +1,286 @@
+"""Batched Fp2/Fp6/Fp12 tower for BLS12-381 — the stacking design.
+
+Tower (same as the oracle, lighthouse_tpu/crypto/bls/fields.py):
+    Fp2  = Fp[u]  / (u^2 + 1)
+    Fp6  = Fp2[v] / (v^3 - xi),  xi = 1 + u
+    Fp12 = Fp6[w] / (w^2 - v)
+
+Array layouts (trailing dims; arbitrary leading batch dims broadcast):
+    Fp2  : [..., 2, W]
+    Fp6  : [..., 3, 2, W]
+    Fp12 : [..., 2, 3, 2, W]
+
+The TPU-first idea: every Karatsuba level STACKS its sub-products along a
+new axis, so one f12mul bottoms out in a single batched limb convolution
+of 27 Fp products (3 x 6 x 3 Karatsuba tree, minus shared work) rather
+than a tree of small kernels — big uniform vector ops are what the
+VPU/MXU want, and the HLO graph stays small enough to scan the Miller
+loop. Laziness policy (see ops/fp.py): fp.mul carry-normalizes on entry;
+f2/f6 muls re-standardize outputs (1 unit), f12 muls return <=3-unit lazy
+sums that every consumer re-normalizes for free on entry.
+
+Frobenius maps use gamma constants computed at import time from the pure
+tower (no magic numbers): gamma1[k] = xi^(k(p-1)/6).
+"""
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..crypto.bls.params import P, XI
+from ..crypto.bls import fields as FF
+from . import fp
+
+W = fp.W
+
+# ---------------------------------------------------------------- host codecs
+
+
+def f2_pack(t) -> np.ndarray:
+    return np.stack([fp.to_limbs(t[0]), fp.to_limbs(t[1])]).astype(np.int32)
+
+
+def f6_pack(t) -> np.ndarray:
+    return np.stack([f2_pack(c) for c in t])
+
+
+def f12_pack(t) -> np.ndarray:
+    return np.stack([f6_pack(c) for c in t])
+
+
+def f2_unpack(a):
+    a = np.asarray(a)
+    return (fp.from_limbs(a[..., 0, :]), fp.from_limbs(a[..., 1, :]))
+
+
+def f6_unpack(a):
+    a = np.asarray(a)
+    return tuple(f2_unpack(a[..., i, :, :]) for i in range(3))
+
+
+def f12_unpack(a):
+    a = np.asarray(a)
+    return tuple(f6_unpack(a[..., j, :, :, :]) for j in range(2))
+
+
+F2_ONE = jnp.asarray(f2_pack(FF.F2_ONE))
+F2_ZERO = jnp.zeros((2, W), dtype=jnp.int32)
+F12_ONE = jnp.asarray(f12_pack(FF.F12_ONE))
+
+
+def bcast(const, batch_shape):
+    """Broadcast a constant element to leading batch dims."""
+    return jnp.broadcast_to(const, (*batch_shape, *const.shape)).astype(jnp.int32)
+
+
+# ---------------------------------------------------------------- Fp2
+
+_CONJ_SIGN = jnp.asarray(np.array([1, -1], dtype=np.int32)[:, None])
+
+
+def f2conj(a):
+    return a * _CONJ_SIGN
+
+
+def f2mul(a, b):
+    """Karatsuba: 3 stacked Fp muls; standard (1-unit) output."""
+    a0, a1 = a[..., 0, :], a[..., 1, :]
+    b0, b1 = b[..., 0, :], b[..., 1, :]
+    aa = jnp.stack([a0, a1, a0 + a1], -2)
+    bb = jnp.stack([b0, b1, b0 + b1], -2)
+    t = fp.mul(aa, bb)
+    c0 = t[..., 0, :] - t[..., 1, :]
+    c1 = t[..., 2, :] - t[..., 0, :] - t[..., 1, :]
+    return fp.reduce_light(jnp.stack([c0, c1], -2))
+
+
+def f2sqr(a):
+    """(a0+a1)(a0-a1), 2*a0*a1 — 2 stacked muls, standard output."""
+    a0, a1 = a[..., 0, :], a[..., 1, :]
+    aa = jnp.stack([a0 + a1, a0], -2)
+    bb = jnp.stack([a0 - a1, a1 + a1], -2)
+    t = fp.mul(aa, bb)
+    return t  # already [..., 2, W]: (c0, c1)
+
+
+def f2mul_xi(a):
+    """Multiply by xi = 1 + u: (a0 - a1, a0 + a1). Lazy (2x units)."""
+    a0, a1 = a[..., 0, :], a[..., 1, :]
+    return jnp.stack([a0 - a1, a0 + a1], -2)
+
+
+def f2smul_fp(a, s):
+    """Fp2 x Fp scalar: s broadcasts over the component axis."""
+    return fp.mul(a, s[..., None, :] if s.ndim == a.ndim - 1 else s)
+
+
+def f2inv(a):
+    """1/(a0 + a1 u) = (a0 - a1 u)/(a0^2 + a1^2). One Fermat inversion."""
+    a = fp.norm3(a)
+    a0, a1 = a[..., 0, :], a[..., 1, :]
+    sq = fp.mul(jnp.stack([a0, a1], -2), jnp.stack([a0, a1], -2))
+    norm = sq[..., 0, :] + sq[..., 1, :]
+    ninv = fp.inv(norm)
+    return fp.mul(jnp.stack([a0, -a1], -2), ninv[..., None, :])
+
+
+def f2_eq(a, b):
+    return jnp.all(fp.eq(a, b), axis=-1)
+
+
+def f2_eq_zero(a):
+    return jnp.all(fp.eq_zero(a), axis=-1)
+
+
+# ---------------------------------------------------------------- Fp6
+
+
+def f6mul(a, b):
+    """6 stacked f2muls (Toom-lite), standard output."""
+    a0, a1, a2 = a[..., 0, :, :], a[..., 1, :, :], a[..., 2, :, :]
+    b0, b1, b2 = b[..., 0, :, :], b[..., 1, :, :], b[..., 2, :, :]
+    aa = jnp.stack([a0, a1, a2, a0 + a1, a0 + a2, a1 + a2], -3)
+    bb = jnp.stack([b0, b1, b2, b0 + b1, b0 + b2, b1 + b2], -3)
+    t = f2mul(aa, bb)
+    t0, t1, t2 = t[..., 0, :, :], t[..., 1, :, :], t[..., 2, :, :]
+    u01, u02, u12 = t[..., 3, :, :], t[..., 4, :, :], t[..., 5, :, :]
+    c0 = t0 + f2mul_xi(u12 - t1 - t2)
+    c1 = u01 - t0 - t1 + f2mul_xi(t2)
+    c2 = u02 - t0 - t2 + t1
+    return fp.reduce_light(jnp.stack([c0, c1, c2], -3))
+
+
+def f6sqr(a):
+    return f6mul(a, a)
+
+
+def f6mul_by_v(a):
+    """(a0 + a1 v + a2 v^2) v = xi a2 + a0 v + a1 v^2. Lazy (2x units)."""
+    return jnp.stack(
+        [f2mul_xi(a[..., 2, :, :]), a[..., 0, :, :], a[..., 1, :, :]], -3
+    )
+
+
+def f6neg(a):
+    return -a
+
+
+def f6inv(a):
+    """Norm-based inversion (fields.py:171-178 formulas), batched."""
+    a = fp.norm3(a)
+    a0, a1, a2 = a[..., 0, :, :], a[..., 1, :, :], a[..., 2, :, :]
+    sq = f2sqr(jnp.stack([a0, a2, a1], -3))
+    s0, s2, s1 = sq[..., 0, :, :], sq[..., 1, :, :], sq[..., 2, :, :]
+    pr = f2mul(
+        jnp.stack([a1, a0, a0], -3), jnp.stack([a2, a1, a2], -3)
+    )
+    a1a2, a0a1, a0a2 = pr[..., 0, :, :], pr[..., 1, :, :], pr[..., 2, :, :]
+    c0 = s0 - f2mul_xi(a1a2)
+    c1 = f2mul_xi(s2) - a0a1
+    c2 = s1 - a0a2
+    tt = f2mul(jnp.stack([a0, a2, a1], -3), jnp.stack([c0, c1, c2], -3))
+    t = tt[..., 0, :, :] + f2mul_xi(tt[..., 1, :, :] + tt[..., 2, :, :])
+    ti = f2inv(t)
+    return f2mul(jnp.stack([c0, c1, c2], -3), ti[..., None, :, :])
+
+
+# ---------------------------------------------------------------- Fp12
+
+
+def f12mul(a, b):
+    """3 stacked f6muls; returns <=3-unit lazy output (consumers norm)."""
+    a0, a1 = a[..., 0, :, :, :], a[..., 1, :, :, :]
+    b0, b1 = b[..., 0, :, :, :], b[..., 1, :, :, :]
+    aa = jnp.stack([a0, a1, a0 + a1], -4)
+    bb = jnp.stack([b0, b1, b0 + b1], -4)
+    t = f6mul(aa, bb)
+    t0, t1, t2 = t[..., 0, :, :, :], t[..., 1, :, :, :], t[..., 2, :, :, :]
+    c0 = t0 + f6mul_by_v(t1)
+    c1 = t2 - t0 - t1
+    return jnp.stack([c0, c1], -4)
+
+
+def f12sqr(a):
+    """Complex-method squaring: 2 stacked f6muls; <=4-unit lazy output."""
+    a0, a1 = a[..., 0, :, :, :], a[..., 1, :, :, :]
+    aa = jnp.stack([a0 + a1, a0], -4)
+    bb = jnp.stack([a0 + f6mul_by_v(a1), a1], -4)
+    t = f6mul(aa, bb)
+    m, n = t[..., 0, :, :, :], t[..., 1, :, :, :]
+    c0 = m - n - f6mul_by_v(n)
+    c1 = n + n
+    return jnp.stack([c0, c1], -4)
+
+
+def f12conj(a):
+    """Fp12 conjugation (Frobenius^6): negate the w-part."""
+    return jnp.concatenate([a[..., :1, :, :, :], -a[..., 1:, :, :, :]], -4)
+
+
+def f12inv(a):
+    t = f6inv(
+        fp.reduce_light(
+            f6sqr(a[..., 0, :, :, :]) - f6mul_by_v(f6sqr(a[..., 1, :, :, :]))
+        )
+    )
+    c0 = f6mul(a[..., 0, :, :, :], t)
+    c1 = f6neg(f6mul(a[..., 1, :, :, :], t))
+    return jnp.stack([c0, c1], -4)
+
+
+def f12_eq(a, b):
+    return jnp.all(fp.eq(a, b), axis=(-3, -2, -1))
+
+
+def f12_eq_one(a):
+    return f12_eq(a, bcast(F12_ONE, a.shape[:-4]))
+
+
+# ---------------------------------------------------------------- Frobenius
+
+# gamma1[k] = xi^(k (p-1)/6); slot (j, i) of Fp12 is basis w^(2i+j).
+_G1 = [FF.f2pow(XI, k * ((P - 1) // 6)) for k in range(6)]
+_G2 = [FF.f2mul(g, FF.f2conj(g)) for g in _G1]          # real (Fp)
+_G3 = [FF.f2mul(_G1[k], _G2[k]) for k in range(6)]
+
+assert all(g[1] == 0 for g in _G2), "gamma2 must be real"
+
+
+def _coeff_const(gammas) -> jnp.ndarray:
+    """[2, 3, 2, W] constant: slot (j, i) holds gammas[2i+j] as Fp2."""
+    arr = np.zeros((2, 3, 2, W), dtype=np.int32)
+    for j in range(2):
+        for i in range(3):
+            arr[j, i] = f2_pack(gammas[2 * i + j])
+    return jnp.asarray(arr)
+
+
+_G1C = _coeff_const(_G1)
+_G3C = _coeff_const(_G3)
+_G2C = jnp.asarray(
+    np.stack(
+        [
+            np.stack([fp.to_limbs(_G2[2 * i + j][0]) for i in range(3)])
+            for j in range(2)
+        ]
+    )[:, :, None, :]
+)  # [2, 3, 1, W], broadcasts over the Fp2 component axis
+
+
+def _coeff_conj(a):
+    """Conjugate every Fp2 coefficient (NOT f12conj)."""
+    return a * _CONJ_SIGN
+
+
+def frob1(a):
+    """a^p."""
+    return f2mul(_coeff_conj(a), bcast(_G1C, a.shape[:-4]))
+
+
+def frob2(a):
+    """a^(p^2): coefficients scaled by real gamma2 — one stacked Fp mul."""
+    return fp.mul(a, bcast(_G2C, a.shape[:-4]))
+
+
+def frob3(a):
+    """a^(p^3)."""
+    return f2mul(_coeff_conj(a), bcast(_G3C, a.shape[:-4]))

@@ -1,0 +1,15 @@
+"""Multi-chip parallelism: mesh construction and sharded batch verify.
+
+The reference's distributed plane is libp2p between hosts (SURVEY.md
+§5.8); the TPU build adds the plane the reference never needed — XLA
+collectives over ICI inside a pod slice. The one large axis in this
+workload is the signature-set batch (SURVEY.md §5.7), so the design
+shards it: each device runs the full per-set pipeline on its shard, and
+only two tiny objects cross the interconnect per batch — one Fp12
+Miller-product ([2,3,2,36] int32) and one Jacobian G2 partial sum —
+via all_gather, followed by a replicated final exponentiation.
+"""
+
+from .verify import make_mesh, sharded_verify_fn
+
+__all__ = ["make_mesh", "sharded_verify_fn"]

@@ -1,0 +1,102 @@
+"""ops/fp.py (fold-reduction Fp core) vs the pure-Python oracle.
+
+Property tests over random and adversarial inputs, exercising the lazy
+contract at its documented limits (3-term sums into mul, 12-term into
+normalize)."""
+
+import secrets
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from lighthouse_tpu.crypto.bls.params import P
+from lighthouse_tpu.ops import fp
+
+
+def rand_elems(n, bits=381):
+    return [secrets.randbits(bits) % P for _ in range(n)]
+
+
+def test_codec_roundtrip():
+    for x in rand_elems(20) + [0, 1, P - 1]:
+        assert fp.from_limbs(fp.to_limbs(x)) == x
+
+
+def test_mul_random_batch():
+    a = rand_elems(64)
+    b = rand_elems(64)
+    got = fp.mul(jnp.asarray(fp.pack(a)), jnp.asarray(fp.pack(b)))
+    got = np.asarray(got)
+    for i in range(64):
+        assert fp.from_limbs(got[i]) == a[i] * b[i] % P
+        # standard-bound invariant: limbs normalized
+        assert got[i].max() < 2**11 + 2 and got[i].min() > -2
+
+
+def test_mul_three_term_lazy_sums():
+    # worst-case documented input: (a+b-c) * (d+e-f) with standard operands
+    a, b, c, d, e, f = (jnp.asarray(fp.pack(rand_elems(32))) for _ in range(6))
+    got = np.asarray(fp.mul(a + b - c, d + e - f))
+    for i in range(32):
+        lhs = (fp.from_limbs(a[i]) + fp.from_limbs(b[i]) - fp.from_limbs(c[i])) % P
+        rhs = (fp.from_limbs(d[i]) + fp.from_limbs(e[i]) - fp.from_limbs(f[i])) % P
+        assert fp.from_limbs(got[i]) == lhs * rhs % P
+
+
+def test_mul_adversarial_max_limbs():
+    # all limbs at the normalized maximum on both operands
+    x = np.full((4, fp.W), 2**11 + 1, dtype=np.int32)
+    val = fp.from_limbs(x[0])
+    got = np.asarray(fp.mul(jnp.asarray(3 * x), jnp.asarray(3 * x)))
+    lhs = (3 * val) % P
+    for i in range(4):
+        assert fp.from_limbs(got[i]) == lhs * lhs % P
+
+
+def test_normalize_deep_chain():
+    elems = [jnp.asarray(fp.pack(rand_elems(8))) for _ in range(12)]
+    acc = elems[0]
+    for e in elems[1:]:
+        acc = acc + e
+    normed = fp.normalize(acc)
+    prod = np.asarray(fp.mul(normed, normed))
+    want = sum(fp.from_limbs(np.asarray(e)[3]) for e in elems) % P
+    assert fp.from_limbs(np.asarray(normed)[3]) == want
+    assert fp.from_limbs(prod[3]) == want * want % P
+
+
+def test_canonical_and_eq():
+    a = rand_elems(16)
+    av = jnp.asarray(fp.pack(a))
+    bv = jnp.asarray(fp.pack([x + 1 for x in a]))
+    # canonical of a negated lazy value
+    neg = np.asarray(fp.canonical(-av))
+    for i in range(16):
+        assert fp.from_limbs(neg[i]) == (-a[i]) % P
+        assert int(neg[i].max()) <= fp.MASK and int(neg[i].min()) >= 0
+    assert bool(np.all(np.asarray(fp.eq(av, av + 0))))
+    assert not bool(np.any(np.asarray(fp.eq(av, bv))))
+    # x and x + p are equal mod p
+    shifted = av + jnp.asarray(fp.P_LIMBS)
+    assert bool(np.all(np.asarray(fp.eq(av, shifted))))
+
+
+def test_eq_zero():
+    z = jnp.zeros((3, fp.W), dtype=jnp.int32)
+    assert bool(np.all(np.asarray(fp.eq_zero(z))))
+    assert bool(np.all(np.asarray(fp.eq_zero(jnp.asarray(fp.pack([P, 2 * P, 0]))))))
+    nz = jnp.asarray(fp.pack([1, P - 1, 12345]))
+    assert not bool(np.any(np.asarray(fp.eq_zero(nz))))
+
+
+def test_pow_and_inv():
+    a = rand_elems(4)
+    av = jnp.asarray(fp.pack(a))
+    e = 0xDEADBEEFCAFE1234
+    got = np.asarray(fp.canonical(fp.pow_const(av, e)))
+    for i in range(4):
+        assert fp.from_limbs(got[i]) == pow(a[i], e, P)
+    ivs = np.asarray(fp.canonical(fp.inv(av)))
+    for i in range(4):
+        assert fp.from_limbs(ivs[i]) == pow(a[i], P - 2, P)

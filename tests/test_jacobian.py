@@ -1,0 +1,101 @@
+"""ops/jacobian.py (batched Jacobian G1/G2) vs the affine curve oracle."""
+
+import secrets
+
+import numpy as np
+import jax.numpy as jnp
+
+from lighthouse_tpu.crypto.bls import params, curve as C
+from lighthouse_tpu.ops import jacobian as J
+
+
+def rand_g1(n):
+    return [C.g1_mul(C.G1_GEN, secrets.randbits(200) % params.R) for _ in range(n)]
+
+
+def rand_g2(n):
+    return [C.g2_mul(C.G2_GEN, secrets.randbits(200) % params.R) for _ in range(n)]
+
+
+def test_pack_unpack_roundtrip():
+    pts1 = rand_g1(3) + [None]
+    pts2 = rand_g2(3) + [None]
+    assert J.unpack_g1(J.pack_g1(pts1)) == pts1
+    assert J.unpack_g2(J.pack_g2(pts2)) == pts2
+
+
+def test_double():
+    pts1 = rand_g1(4) + [None]
+    pts2 = rand_g2(2) + [None]
+    got1 = J.unpack_g1(J.double(J.FP1, J.pack_g1(pts1)))
+    got2 = J.unpack_g2(J.double(J.FP2, J.pack_g2(pts2)))
+    assert got1 == [C.g1_double(p) for p in pts1]
+    assert got2 == [C.g2_double(p) for p in pts2]
+
+
+def test_add_generic_and_inf():
+    a = rand_g1(4)
+    b = rand_g1(4)
+    cases_a = a + [None, a[0], None]
+    cases_b = b + [b[0], None, None]
+    got = J.unpack_g1(J.add(J.FP1, J.pack_g1(cases_a), J.pack_g1(cases_b)))
+    want = [C.g1_add(x, y) for x, y in zip(cases_a, cases_b)]
+    assert got == want
+
+
+def test_add_exact_collisions():
+    p = rand_g1(1)[0]
+    q = rand_g1(1)[0]
+    cases_a = [p, p, p, q]
+    cases_b = [p, C.g1_neg(p), q, q]  # double, inf, generic, double
+    got = J.unpack_g1(
+        J.add(J.FP1, J.pack_g1(cases_a), J.pack_g1(cases_b), exact=True)
+    )
+    want = [C.g1_add(x, y) for x, y in zip(cases_a, cases_b)]
+    assert got == want
+    # same for G2
+    p2 = rand_g2(1)[0]
+    got2 = J.unpack_g2(
+        J.add(J.FP2, J.pack_g2([p2, p2]), J.pack_g2([p2, C.g2_neg(p2)]), exact=True)
+    )
+    assert got2 == [C.g2_double(p2), None]
+
+
+def test_scalar_mul64():
+    pts = rand_g1(4)
+    ks = [secrets.randbits(64) | 1 for _ in range(3)] + [0]
+    bits = jnp.asarray(J.scalars_to_bits(ks, 64))
+    got = J.unpack_g1(J.scalar_mul(J.FP1, J.pack_g1(pts), bits))
+    assert got == [C.g1_mul(p, k) for p, k in zip(pts, ks)]
+
+    pts2 = rand_g2(2)
+    ks2 = [secrets.randbits(64), secrets.randbits(64)]
+    bits2 = jnp.asarray(J.scalars_to_bits(ks2, 64))
+    got2 = J.unpack_g2(J.scalar_mul(J.FP2, J.pack_g2(pts2), bits2))
+    assert got2 == [C.g2_mul(p, k) for p, k in zip(pts2, ks2)]
+
+
+def test_sum_tree():
+    pts = rand_g1(6) + [None]
+    got = J.unpack_g1(J.sum_tree(J.FP1, J.pack_g1(pts), 7))
+    want = None
+    for p in pts:
+        want = C.g1_add(want, p)
+    assert got == [want]
+    # adversarial: equal and negated points in the tree
+    p = rand_g1(1)[0]
+    pts2 = [p, p, C.g1_neg(p), p]
+    got2 = J.unpack_g1(J.sum_tree(J.FP1, J.pack_g1(pts2), 4))
+    assert got2 == [C.g1_double(p)]
+
+
+def test_psi_and_eq():
+    pts = rand_g2(3)
+    got = J.unpack_g2(J.psi(J.pack_g2(pts)))
+    assert got == [C.psi(p) for p in pts]
+    a = J.pack_g2(pts)
+    d = J.double(J.FP2, a)
+    eq_self = np.asarray(J.jac_eq(J.FP2, d, J.pack_g2([C.g2_double(p) for p in pts])))
+    assert eq_self.all()
+    neq = np.asarray(J.jac_eq(J.FP2, a, d))
+    assert not neq.any()

@@ -1,0 +1,65 @@
+"""pairing_fast.py (optimized host pairing, the TPU pipeline prototype)
+vs the generic oracle. Pure-host tests (no jax)."""
+
+import secrets
+
+from lighthouse_tpu.crypto.bls.params import P, R, X
+from lighthouse_tpu.crypto.bls import fields as F, curve as C
+from lighthouse_tpu.crypto.bls import pairing as PR, pairing_fast as PF
+
+
+def rg1():
+    return C.g1_mul(C.G1_GEN, secrets.randbits(220) % R)
+
+
+def rg2():
+    return C.g2_mul(C.G2_GEN, secrets.randbits(220) % R)
+
+
+def rf12():
+    return (
+        tuple((secrets.randbits(380) % P, secrets.randbits(380) % P) for _ in range(3)),
+        tuple((secrets.randbits(380) % P, secrets.randbits(380) % P) for _ in range(3)),
+    )
+
+
+def test_hht_identity():
+    assert 3 * (P**4 - P**2 + 1) // R == (X - 1) ** 2 * (X + P) * (
+        X**2 + P**2 - 1
+    ) + 3
+
+
+def test_frobenius_consts():
+    f = rf12()
+    assert PF._frob1(f) == F.f12pow(f, P)
+    assert PF.frob(f, 2) == F.f12pow(f, P * P)
+
+
+def test_cyclotomic_sqr_and_pow():
+    f = rf12()
+    t = F.f12mul(F.f12conj(f), F.f12inv(f))
+    m = F.f12mul(PF.frob(t, 2), t)  # cyclotomic subgroup element
+    assert PF.cyclotomic_sqr(m) == F.f12sqr(m)
+    assert PF.cyc_pow_abs_u(m) == F.f12pow(m, -X)
+
+
+def test_pairing_is_oracle_cubed():
+    p, q = rg1(), rg2()
+    want = PR.pairing(p, q)
+    got = PF.final_exp_fast(PF.miller_loop_fast(p, q))
+    assert got == F.f12mul(F.f12mul(want, want), want)
+
+
+def test_bilinearity_product():
+    q = rg2()
+    a = secrets.randbits(100)
+    pairs = [(C.g1_mul(C.G1_GEN, a), q), (C.g1_neg(C.G1_GEN), C.g2_mul(q, a))]
+    assert PF.pairings_product_is_one_fast(pairs)
+    # broken pair must fail
+    bad = [(C.g1_mul(C.G1_GEN, a + 1), q), (C.g1_neg(C.G1_GEN), C.g2_mul(q, a))]
+    assert not PF.pairings_product_is_one_fast(bad)
+
+
+def test_infinity_pairs():
+    assert PF.miller_loop_fast(None, rg2()) == F.F12_ONE
+    assert PF.miller_loop_fast(rg1(), None) == F.F12_ONE

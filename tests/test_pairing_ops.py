@@ -1,0 +1,80 @@
+"""ops/pairing.py (batched JAX pairing) vs the validated host prototype
+(pairing_fast.py) — elementwise pre-final-exp, then full verdicts."""
+
+import secrets
+
+import numpy as np
+import jax.numpy as jnp
+
+from lighthouse_tpu.crypto.bls.params import P, R, X
+from lighthouse_tpu.crypto.bls import fields as F, curve as C
+from lighthouse_tpu.crypto.bls import pairing_fast as PF
+from lighthouse_tpu.ops import fp, tower, pairing as OP
+
+
+def rg1():
+    return C.g1_mul(C.G1_GEN, secrets.randbits(220) % R)
+
+
+def rg2():
+    return C.g2_mul(C.G2_GEN, secrets.randbits(220) % R)
+
+
+def pack_pairs(pairs):
+    xP = jnp.asarray(np.stack([fp.to_limbs(p[0]) for p, q in pairs]))
+    yP = jnp.asarray(np.stack([fp.to_limbs(p[1]) for p, q in pairs]))
+    xQ = jnp.asarray(np.stack([tower.f2_pack(q[0]) for p, q in pairs]))
+    yQ = jnp.asarray(np.stack([tower.f2_pack(q[1]) for p, q in pairs]))
+    return xP, yP, xQ, yQ
+
+
+def test_miller_loop_elementwise():
+    pairs = [(rg1(), rg2()) for _ in range(2)]
+    got = np.asarray(OP.miller_loop(*pack_pairs(pairs)))
+    for i, (p, q) in enumerate(pairs):
+        assert tower.f12_unpack(got[i]) == PF.miller_loop_fast(p, q)
+
+
+def test_cyclotomic_ops():
+    # build a cyclotomic element on host, compare device GS square + pow
+    f_host = PF.miller_loop_fast(rg1(), rg2())
+    t = F.f12mul(F.f12conj(f_host), F.f12inv(f_host))
+    m = F.f12mul(PF.frob(t, 2), t)
+    mv = jnp.asarray(tower.f12_pack(m))[None]
+    got_sqr = tower.f12_unpack(np.asarray(OP.cyclotomic_sqr(mv))[0])
+    assert got_sqr == PF.cyclotomic_sqr(m)
+    got_pow = tower.f12_unpack(np.asarray(OP.cyc_pow_abs_u(mv))[0])
+    assert got_pow == PF.cyc_pow_abs_u(m)
+
+
+def test_final_exp_matches_host():
+    f_host = PF.miller_loop_fast(rg1(), rg2())
+    fv = jnp.asarray(tower.f12_pack(f_host))[None]
+    got = tower.f12_unpack(np.asarray(OP.final_exp(fv))[0])
+    assert got == PF.final_exp_fast(f_host)
+
+
+def test_product_verdict():
+    # e(aG1, Q) * e(-G1, aQ) == 1, batched on device
+    q = rg2()
+    a = secrets.randbits(100)
+    good = [
+        (C.g1_mul(C.G1_GEN, a), q),
+        (C.g1_neg(C.G1_GEN), C.g2_mul(q, a)),
+    ]
+    fs = OP.miller_loop(*pack_pairs(good))
+    assert bool(np.asarray(OP.pairing_product_is_one(fs, 2)))
+    bad = [
+        (C.g1_mul(C.G1_GEN, a + 1), q),
+        (C.g1_neg(C.G1_GEN), C.g2_mul(q, a)),
+    ]
+    fs_bad = OP.miller_loop(*pack_pairs(bad))
+    assert not bool(np.asarray(OP.pairing_product_is_one(fs_bad, 2)))
+
+
+def test_infinity_masks():
+    pairs = [(rg1(), rg2())]
+    xP, yP, xQ, yQ = pack_pairs(pairs)
+    inf = jnp.asarray([True])
+    got = np.asarray(OP.miller_loop(xP, yP, xQ, yQ, q_inf=inf))
+    assert tower.f12_unpack(got[0]) == F.F12_ONE

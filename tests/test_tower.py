@@ -1,0 +1,120 @@
+"""ops/tower.py (stacked JAX tower) vs the pure-Python field oracle."""
+
+import secrets
+
+import numpy as np
+import jax.numpy as jnp
+
+from lighthouse_tpu.crypto.bls.params import P
+from lighthouse_tpu.crypto.bls import fields as FF
+from lighthouse_tpu.ops import fp, tower
+
+
+def rf():
+    return secrets.randbits(400) % P
+
+
+def rf2():
+    return (rf(), rf())
+
+
+def rf6():
+    return (rf2(), rf2(), rf2())
+
+
+def rf12():
+    return (rf6(), rf6())
+
+
+def batch2(n):
+    els = [rf2() for _ in range(n)]
+    return els, jnp.asarray(np.stack([tower.f2_pack(e) for e in els]))
+
+
+def batch6(n):
+    els = [rf6() for _ in range(n)]
+    return els, jnp.asarray(np.stack([tower.f6_pack(e) for e in els]))
+
+
+def batch12(n):
+    els = [rf12() for _ in range(n)]
+    return els, jnp.asarray(np.stack([tower.f12_pack(e) for e in els]))
+
+
+def test_f2_ops():
+    a_el, a = batch2(8)
+    b_el, b = batch2(8)
+    got_mul = np.asarray(tower.f2mul(a, b))
+    got_sqr = np.asarray(tower.f2sqr(a))
+    got_inv = np.asarray(tower.f2inv(a))
+    got_xi = np.asarray(tower.f2mul_xi(a))
+    for i in range(8):
+        assert tower.f2_unpack(got_mul[i]) == FF.f2mul(a_el[i], b_el[i])
+        assert tower.f2_unpack(got_sqr[i]) == FF.f2sqr(a_el[i])
+        assert tower.f2_unpack(got_inv[i]) == FF.f2inv(a_el[i])
+        assert tower.f2_unpack(got_xi[i]) == FF.f2mul_xi(a_el[i])
+
+
+def test_f2_mul_lazy_inputs():
+    # muls must accept multi-unit lazy sums (entry normalization)
+    a_el, a = batch2(4)
+    b_el, b = batch2(4)
+    lazy_a = a + a + a + a - a          # 3a, 5 terms deep
+    got = np.asarray(tower.f2mul(lazy_a, b - b + b))
+    for i in range(4):
+        want = FF.f2mul(FF.f2smul(a_el[i], 3), b_el[i])
+        assert tower.f2_unpack(got[i]) == want
+
+
+def test_f6_ops():
+    a_el, a = batch6(4)
+    b_el, b = batch6(4)
+    got_mul = np.asarray(tower.f6mul(a, b))
+    got_v = np.asarray(tower.f6mul_by_v(a))
+    got_inv = np.asarray(tower.f6inv(a))
+    for i in range(4):
+        assert tower.f6_unpack(got_mul[i]) == FF.f6mul(a_el[i], b_el[i])
+        assert tower.f6_unpack(got_v[i]) == FF.f6mul_by_v(a_el[i])
+        assert tower.f6_unpack(got_inv[i]) == FF.f6inv(a_el[i])
+
+
+def test_f12_ops():
+    a_el, a = batch12(3)
+    b_el, b = batch12(3)
+    got_mul = np.asarray(tower.f12mul(a, b))
+    got_sqr = np.asarray(tower.f12sqr(a))
+    got_conj = np.asarray(tower.f12conj(a))
+    got_inv = np.asarray(tower.f12inv(a))
+    for i in range(3):
+        assert tower.f12_unpack(got_mul[i]) == FF.f12mul(a_el[i], b_el[i])
+        assert tower.f12_unpack(got_sqr[i]) == FF.f12sqr(a_el[i])
+        assert tower.f12_unpack(got_conj[i]) == FF.f12conj(a_el[i])
+        assert tower.f12_unpack(got_inv[i]) == FF.f12inv(a_el[i])
+
+
+def test_f12_mul_chain_lazy():
+    # chained muls/squares exercise the lazy-unit policy end to end
+    a_el, a = batch12(2)
+    b_el, b = batch12(2)
+    got = np.asarray(tower.f12mul(tower.f12sqr(tower.f12mul(a, b)), b))
+    for i in range(2):
+        want = FF.f12mul(FF.f12sqr(FF.f12mul(a_el[i], b_el[i])), b_el[i])
+        assert tower.f12_unpack(got[i]) == want
+
+
+def test_frobenius():
+    a_el, a = batch12(2)
+    g1 = np.asarray(tower.frob1(a))
+    g2 = np.asarray(tower.frob2(a))
+    g3 = np.asarray(tower.frob3(a))
+    for i in range(2):
+        assert tower.f12_unpack(g1[i]) == FF.f12pow(a_el[i], P)
+        assert tower.f12_unpack(g2[i]) == FF.f12pow(a_el[i], P * P)
+        assert tower.f12_unpack(g3[i]) == FF.f12pow(a_el[i], P * P * P)
+
+
+def test_eq_one():
+    one = tower.bcast(tower.F12_ONE, (3,))
+    assert bool(np.all(np.asarray(tower.f12_eq_one(one))))
+    _, a = batch12(3)
+    assert not bool(np.any(np.asarray(tower.f12_eq_one(a))))
