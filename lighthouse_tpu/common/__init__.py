@@ -1,0 +1,1 @@
+"""Cross-cutting commons (SURVEY.md §2.6 LX): slot clock, metrics."""

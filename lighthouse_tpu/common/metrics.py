@@ -1,0 +1,125 @@
+"""Global metrics registry (common/metrics analog, SURVEY.md §5.1).
+
+Prometheus-text-format counters/gauges/histograms with a process-global
+registry; the HTTP scrape endpoint lives in the node layer. Histogram
+timers mirror the reference's start_timer/stop_timer idiom
+(common/metrics/src/lib.rs:1-50)."""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+
+_REGISTRY = {}
+_LOCK = threading.Lock()
+
+_DEFAULT_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0,
+)
+
+
+class Counter:
+    def __init__(self, name: str, help_: str):
+        self.name = name
+        self.help = help_
+        self.value = 0.0
+
+    def inc(self, amount: float = 1.0):
+        with _LOCK:
+            self.value += amount
+
+    def render(self) -> str:
+        return (
+            f"# HELP {self.name} {self.help}\n# TYPE {self.name} counter\n"
+            f"{self.name} {self.value}\n"
+        )
+
+
+class Gauge:
+    def __init__(self, name: str, help_: str):
+        self.name = name
+        self.help = help_
+        self.value = 0.0
+
+    def set(self, v: float):
+        with _LOCK:
+            self.value = v
+
+    def render(self) -> str:
+        return (
+            f"# HELP {self.name} {self.help}\n# TYPE {self.name} gauge\n"
+            f"{self.name} {self.value}\n"
+        )
+
+
+class Histogram:
+    def __init__(self, name: str, help_: str, buckets=_DEFAULT_BUCKETS):
+        self.name = name
+        self.help = help_
+        self.buckets = list(buckets)
+        self.counts = [0] * (len(self.buckets) + 1)
+        self.total = 0.0
+        self.n = 0
+
+    def observe(self, v: float):
+        with _LOCK:
+            self.total += v
+            self.n += 1
+            for i, b in enumerate(self.buckets):
+                if v <= b:
+                    self.counts[i] += 1
+                    return
+            self.counts[-1] += 1
+
+    @contextmanager
+    def time(self):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.observe(time.perf_counter() - t0)
+
+    def render(self) -> str:
+        out = [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} histogram",
+        ]
+        acc = 0
+        for b, c in zip(self.buckets, self.counts):
+            acc += c
+            out.append(f'{self.name}_bucket{{le="{b}"}} {acc}')
+        acc += self.counts[-1]
+        out.append(f'{self.name}_bucket{{le="+Inf"}} {acc}')
+        out.append(f"{self.name}_sum {self.total}")
+        out.append(f"{self.name}_count {self.n}")
+        return "\n".join(out) + "\n"
+
+
+def counter(name: str, help_: str = "") -> Counter:
+    with _LOCK:
+        if name not in _REGISTRY:
+            _REGISTRY[name] = Counter(name, help_)
+    return _REGISTRY[name]
+
+
+def gauge(name: str, help_: str = "") -> Gauge:
+    with _LOCK:
+        if name not in _REGISTRY:
+            _REGISTRY[name] = Gauge(name, help_)
+    return _REGISTRY[name]
+
+
+def histogram(name: str, help_: str = "", buckets=_DEFAULT_BUCKETS) -> Histogram:
+    with _LOCK:
+        if name not in _REGISTRY:
+            _REGISTRY[name] = Histogram(name, help_, buckets)
+    return _REGISTRY[name]
+
+
+def gather() -> str:
+    """Render the whole registry in Prometheus text format."""
+    with _LOCK:
+        items = list(_REGISTRY.values())
+    return "".join(m.render() for m in items)

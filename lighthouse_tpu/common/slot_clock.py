@@ -1,0 +1,45 @@
+"""Slot clocks (common/slot_clock analog): wall-clock -> slot mapping,
+plus a manual clock for deterministic tests (the reference's
+ManualSlotClock pattern, SURVEY.md §4.3)."""
+
+from __future__ import annotations
+
+import time
+
+
+class SlotClock:
+    def __init__(self, genesis_time: int, seconds_per_slot: int):
+        self.genesis_time = genesis_time
+        self.seconds_per_slot = seconds_per_slot
+
+    def now(self) -> float:
+        return time.time()
+
+    def current_slot(self) -> int:
+        t = self.now()
+        if t < self.genesis_time:
+            return 0
+        return int(t - self.genesis_time) // self.seconds_per_slot
+
+    def slot_start(self, slot: int) -> float:
+        return self.genesis_time + slot * self.seconds_per_slot
+
+    def seconds_into_slot(self) -> float:
+        return (self.now() - self.genesis_time) % self.seconds_per_slot
+
+
+class ManualSlotClock(SlotClock):
+    """Deterministic clock for tests: time advances only on demand."""
+
+    def __init__(self, genesis_time: int = 0, seconds_per_slot: int = 12):
+        super().__init__(genesis_time, seconds_per_slot)
+        self._now = float(genesis_time)
+
+    def now(self) -> float:
+        return self._now
+
+    def set_slot(self, slot: int):
+        self._now = self.slot_start(slot)
+
+    def advance(self, seconds: float):
+        self._now += seconds

@@ -1,0 +1,9 @@
+"""Consensus layer: SSZ types, state transition, fork choice.
+
+The host-side control plane of the framework (SURVEY.md §2.2) — the
+analog of the reference's consensus/{types,state_processing,fork_choice,
+proto_array} crates. Control-flow-heavy and hash-heavy, so it stays on
+CPU; everything signature-shaped funnels into crypto.bls SignatureSets
+that the TPU backend batch-verifies (signature_sets.py ==
+consensus/state_processing/src/per_block_processing/signature_sets.rs).
+"""

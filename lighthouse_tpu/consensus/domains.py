@@ -1,0 +1,44 @@
+"""Domain separation + signing roots (spec helpers the reference keeps
+in consensus/types/src/chain_spec.rs + signing machinery)."""
+
+from . import types as T
+from .spec import ChainSpec
+
+
+def compute_fork_data_root(current_version: bytes, genesis_validators_root: bytes) -> bytes:
+    return T.ForkData.make(
+        current_version=current_version,
+        genesis_validators_root=genesis_validators_root,
+    ).hash_tree_root()
+
+
+def compute_fork_digest(current_version: bytes, genesis_validators_root: bytes) -> bytes:
+    return compute_fork_data_root(current_version, genesis_validators_root)[:4]
+
+
+def compute_domain(
+    domain_type: bytes,
+    fork_version: bytes,
+    genesis_validators_root: bytes,
+) -> bytes:
+    root = compute_fork_data_root(fork_version, genesis_validators_root)
+    return domain_type + root[:28]
+
+
+def get_domain(
+    spec: ChainSpec,
+    domain_type: bytes,
+    epoch: int,
+    fork,
+    genesis_validators_root: bytes,
+) -> bytes:
+    version = (
+        fork.previous_version if epoch < fork.epoch else fork.current_version
+    )
+    return compute_domain(domain_type, version, genesis_validators_root)
+
+
+def compute_signing_root(ssz_value, domain: bytes) -> bytes:
+    return T.SigningData.make(
+        object_root=ssz_value.hash_tree_root(), domain=domain
+    ).hash_tree_root()

@@ -1,0 +1,293 @@
+"""LMD-GHOST proto-array fork choice.
+
+Analog of consensus/proto_array (proto_array_fork_choice.rs): a flat
+node array in insertion order (parents before children), vote-delta
+accumulation (compute_deltas :900), one O(nodes) backward pass to
+propagate weights and select best descendants, and find_head (:463-501)
+as a forward walk over best_child pointers. Includes proposer boost,
+execution-status (optimistic sync) invalidation, and finality pruning.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Optional
+
+
+class ExecutionStatus(Enum):
+    VALID = "valid"
+    INVALID = "invalid"
+    OPTIMISTIC = "optimistic"  # not yet verified by the execution layer
+    IRRELEVANT = "irrelevant"  # pre-merge
+
+
+@dataclass
+class ProtoNode:
+    slot: int
+    root: bytes
+    parent: Optional[int]           # index into the array
+    justified_epoch: int
+    finalized_epoch: int
+    weight: int = 0
+    best_child: Optional[int] = None
+    best_descendant: Optional[int] = None
+    execution_status: ExecutionStatus = ExecutionStatus.IRRELEVANT
+
+
+@dataclass
+class VoteTracker:
+    current_root: bytes = b"\x00" * 32
+    next_root: bytes = b"\x00" * 32
+    next_epoch: int = 0
+
+
+class ProtoArrayForkChoice:
+    def __init__(
+        self,
+        finalized_root: bytes,
+        finalized_slot: int,
+        justified_epoch: int,
+        finalized_epoch: int,
+    ):
+        self.nodes: list[ProtoNode] = []
+        self.index_by_root: dict[bytes, int] = {}
+        self.votes: dict[int, VoteTracker] = {}  # validator index -> tracker
+        self.balances: list[int] = []
+        self.justified_epoch = justified_epoch
+        self.finalized_epoch = finalized_epoch
+        self.proposer_boost_root: bytes = b"\x00" * 32
+        self.proposer_boost_amount: int = 0
+        self._applied_boost: tuple = (b"\x00" * 32, 0)
+        self.on_block(
+            slot=finalized_slot,
+            root=finalized_root,
+            parent_root=None,
+            justified_epoch=justified_epoch,
+            finalized_epoch=finalized_epoch,
+        )
+
+    # ------------------------------------------------------------ mutation
+
+    def on_block(
+        self,
+        slot: int,
+        root: bytes,
+        parent_root: Optional[bytes],
+        justified_epoch: int,
+        finalized_epoch: int,
+        execution_status: ExecutionStatus = ExecutionStatus.IRRELEVANT,
+    ) -> None:
+        if root in self.index_by_root:
+            return
+        parent = self.index_by_root.get(parent_root) if parent_root else None
+        node = ProtoNode(
+            slot=slot,
+            root=root,
+            parent=parent,
+            justified_epoch=justified_epoch,
+            finalized_epoch=finalized_epoch,
+            execution_status=execution_status,
+        )
+        self.index_by_root[root] = len(self.nodes)
+        self.nodes.append(node)
+
+    def process_attestation(
+        self, validator_index: int, block_root: bytes, target_epoch: int
+    ) -> None:
+        """LMD vote update (latest message per validator)."""
+        fresh = validator_index not in self.votes
+        v = self.votes.setdefault(validator_index, VoteTracker())
+        # A brand-new tracker must accept its first vote even at target
+        # epoch 0 (the tracker default), hence the `fresh` escape.
+        if fresh or target_epoch > v.next_epoch:
+            v.next_epoch = target_epoch
+            v.next_root = block_root
+
+    def apply_proposer_boost(self, root: bytes, amount: int) -> None:
+        self.proposer_boost_root = root
+        self.proposer_boost_amount = amount
+
+    # ------------------------------------------------------------ deltas
+
+    def _compute_deltas(self, new_balances: list[int]) -> list[int]:
+        """Per-node weight delta from vote movement + balance changes
+        (proto_array_fork_choice.rs:900)."""
+        deltas = [0] * len(self.nodes)
+        for vi, vote in self.votes.items():
+            old_bal = self.balances[vi] if vi < len(self.balances) else 0
+            new_bal = new_balances[vi] if vi < len(new_balances) else 0
+            if vote.current_root in self.index_by_root and old_bal:
+                deltas[self.index_by_root[vote.current_root]] -= old_bal
+            if vote.next_root in self.index_by_root and new_bal:
+                deltas[self.index_by_root[vote.next_root]] += new_bal
+            # The old vote is subtracted exactly once: advance the
+            # tracker unconditionally (even when the new target is
+            # unknown or the new balance is 0), or the next pass would
+            # subtract it again.
+            vote.current_root = vote.next_root
+        self.balances = list(new_balances)
+        return deltas
+
+    # ------------------------------------------------------------ scoring
+
+    def _node_viable(self, node: ProtoNode) -> bool:
+        if node.execution_status == ExecutionStatus.INVALID:
+            return False
+        return (
+            node.justified_epoch == self.justified_epoch
+            or self.justified_epoch == 0
+        ) and (
+            node.finalized_epoch == self.finalized_epoch
+            or self.finalized_epoch == 0
+        )
+
+    def _viable_for_head(self, idx: int) -> bool:
+        node = self.nodes[idx]
+        if node.best_descendant is not None:
+            return self._node_viable(self.nodes[node.best_descendant])
+        return self._node_viable(node)
+
+    def apply_score_changes(
+        self,
+        new_balances: list[int],
+        justified_epoch: int = None,
+        finalized_epoch: int = None,
+    ) -> None:
+        """Backward pass: apply deltas, bubble weights to parents, and
+        maintain best_child/best_descendant pointers."""
+        if justified_epoch is not None:
+            self.justified_epoch = justified_epoch
+        if finalized_epoch is not None:
+            self.finalized_epoch = finalized_epoch
+        deltas = self._compute_deltas(new_balances)
+        # proposer boost is transient: remove last pass's boost, apply
+        # the currently-set one, then mark it consumed
+        prev_root, prev_amount = self._applied_boost
+        if prev_amount:
+            prev_idx = self.index_by_root.get(prev_root)
+            if prev_idx is not None:
+                deltas[prev_idx] -= prev_amount
+        cur_idx = self.index_by_root.get(self.proposer_boost_root)
+        if cur_idx is not None and self.proposer_boost_amount:
+            deltas[cur_idx] += self.proposer_boost_amount
+            self._applied_boost = (
+                self.proposer_boost_root,
+                self.proposer_boost_amount,
+            )
+        else:
+            self._applied_boost = (b"\x00" * 32, 0)
+        self.proposer_boost_root = b"\x00" * 32
+        self.proposer_boost_amount = 0
+
+        # best_child/best_descendant pointers are NOT maintained here:
+        # find_head recomputes them from scratch (one authoritative
+        # computation over final weights; maintaining them mid-delta-pass
+        # would compare against stale sibling weights).
+        for i in range(len(self.nodes) - 1, -1, -1):
+            node = self.nodes[i]
+            node.weight += deltas[i]
+            if node.parent is not None:
+                deltas[node.parent] += deltas[i]
+
+    def _maybe_update_best_child(self, parent_idx: int, child_idx: int):
+        parent = self.nodes[parent_idx]
+        child = self.nodes[child_idx]
+        child_viable = self._viable_for_head(child_idx)
+        child_leads = False
+        if parent.best_child is None:
+            child_leads = child_viable
+        else:
+            best = self.nodes[parent.best_child]
+            best_viable = self._viable_for_head(parent.best_child)
+            if child_viable and not best_viable:
+                child_leads = True
+            elif child_viable and (
+                child.weight > best.weight
+                or (child.weight == best.weight and child.root > best.root)
+            ):
+                child_leads = True
+        if child_leads:
+            parent.best_child = child_idx
+            parent.best_descendant = (
+                child.best_descendant
+                if child.best_descendant is not None
+                else child_idx
+            )
+
+    # ------------------------------------------------------------ head
+
+    def find_head(self, justified_root: bytes) -> bytes:
+        """Walk best_child pointers from the justified root
+        (proto_array_fork_choice.rs:463-501). Recomputes pointers with a
+        full backward sweep first for simplicity+correctness."""
+        # full refresh of best pointers (O(nodes), same complexity class
+        # as the reference's delta pass)
+        for node in self.nodes:
+            node.best_child = None
+            node.best_descendant = None
+        for i in range(len(self.nodes) - 1, -1, -1):
+            node = self.nodes[i]
+            if node.parent is None:
+                continue
+            self._maybe_update_best_child(node.parent, i)
+
+        start = self.index_by_root.get(justified_root)
+        if start is None:
+            raise KeyError("unknown justified root")
+        node = self.nodes[start]
+        if node.best_descendant is not None and self._viable_for_head(
+            node.best_descendant
+        ):
+            return self.nodes[node.best_descendant].root
+        idx = start
+        while self.nodes[idx].best_child is not None:
+            idx = self.nodes[idx].best_child
+        return self.nodes[idx].root
+
+    # ------------------------------------------------------------ optimism
+
+    def on_execution_status(self, root: bytes, status: ExecutionStatus):
+        """Optimistic-sync resolution: VALID propagates to ancestors,
+        INVALID propagates to all descendants."""
+        idx = self.index_by_root.get(root)
+        if idx is None:
+            return
+        self.nodes[idx].execution_status = status
+        if status == ExecutionStatus.VALID:
+            p = self.nodes[idx].parent
+            while p is not None and self.nodes[p].execution_status == ExecutionStatus.OPTIMISTIC:
+                self.nodes[p].execution_status = ExecutionStatus.VALID
+                p = self.nodes[p].parent
+        elif status == ExecutionStatus.INVALID:
+            invalid = {idx}
+            for i in range(idx + 1, len(self.nodes)):
+                if self.nodes[i].parent in invalid:
+                    self.nodes[i].execution_status = ExecutionStatus.INVALID
+                    invalid.add(i)
+
+    # ------------------------------------------------------------ pruning
+
+    def prune(self, finalized_root: bytes) -> int:
+        """Drop everything not descended from the new finalized root."""
+        fidx = self.index_by_root.get(finalized_root)
+        if fidx is None:
+            raise KeyError("unknown finalized root")
+        keep = {fidx}
+        for i in range(fidx + 1, len(self.nodes)):
+            if self.nodes[i].parent in keep:
+                keep.add(i)
+        remap = {}
+        new_nodes = []
+        for i in sorted(keep):
+            remap[i] = len(new_nodes)
+            node = self.nodes[i]
+            node.parent = remap.get(node.parent) if i != fidx else None
+            new_nodes.append(node)
+        pruned = len(self.nodes) - len(new_nodes)
+        self.nodes = new_nodes
+        self.index_by_root = {n.root: i for i, n in enumerate(self.nodes)}
+        for n in self.nodes:
+            n.best_child = None
+            n.best_descendant = None
+        return pruned

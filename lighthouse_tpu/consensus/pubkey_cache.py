@@ -1,0 +1,54 @@
+"""Decompressed validator pubkey cache.
+
+The verify hot path must never pay point decompression per message —
+the reference keeps every validator's pubkey decompressed in memory and
+persists the cache (beacon_node/beacon_chain/src/validator_pubkey_cache.rs:1-24,138).
+Same role here: bytes -> PublicKey (affine point, subgroup-checked once
+at insert), indexed by validator index, append-only.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..crypto.bls.keys import PublicKey
+
+
+class ValidatorPubkeyCache:
+    def __init__(self):
+        self._keys: list[PublicKey] = []
+        self._by_bytes: dict[bytes, int] = {}
+
+    def __len__(self) -> int:
+        return len(self._keys)
+
+    def import_new_pubkeys(self, pubkey_bytes_list) -> None:
+        """Append validators in registry order (decompression +
+        subgroup check happen here, once per validator ever)."""
+        for pb in pubkey_bytes_list:
+            pb = bytes(pb)
+            # Decompress/validate BEFORE recording the index mapping, so
+            # a rejected key can't leave a stale bytes->index entry that
+            # would later resolve to a different validator.
+            key = PublicKey.from_bytes(pb)
+            self._by_bytes[pb] = len(self._keys)
+            self._keys.append(key)
+
+    def get(self, validator_index: int) -> Optional[PublicKey]:
+        if 0 <= validator_index < len(self._keys):
+            return self._keys[validator_index]
+        return None
+
+    def get_index(self, pubkey_bytes: bytes) -> Optional[int]:
+        return self._by_bytes.get(bytes(pubkey_bytes))
+
+    def getter(self):
+        """get_pubkey callable for the signature-set constructors."""
+
+        def get_pubkey(index: int) -> PublicKey:
+            pk = self.get(index)
+            if pk is None:
+                raise KeyError(f"unknown validator index {index}")
+            return pk
+
+        return get_pubkey

@@ -1,0 +1,81 @@
+"""Swap-or-not committee shuffling (consensus/swap_or_not_shuffle analog).
+
+Implements the spec's compute_shuffled_index and the whole-list
+single-pass shuffle the reference benches
+(consensus/swap_or_not_shuffle/benches/benches.rs), plus committee
+assignment helpers built on it.
+"""
+
+import hashlib
+
+
+def _hash(data: bytes) -> bytes:
+    return hashlib.sha256(data).digest()
+
+
+def compute_shuffled_index(
+    index: int, index_count: int, seed: bytes, rounds: int
+) -> int:
+    """Spec swap-or-not network, one index at a time."""
+    assert 0 <= index < index_count
+    for r in range(rounds):
+        pivot = (
+            int.from_bytes(_hash(seed + bytes([r]))[:8], "little") % index_count
+        )
+        flip = (pivot + index_count - index) % index_count
+        position = max(index, flip)
+        source = _hash(
+            seed + bytes([r]) + (position // 256).to_bytes(4, "little")
+        )
+        byte = source[(position % 256) // 8]
+        if (byte >> (position % 8)) & 1:
+            index = flip
+    return index
+
+
+def shuffle_list(indices: list, seed: bytes, rounds: int) -> list:
+    """Whole-list shuffle: shuffled[i] = indices[shuffled_index(i)].
+
+    Batched hash reuse per (round, position-block) keeps it O(n * rounds)
+    hashes worst case with a small cache; a numpy-vectorized whole-list
+    pass (the form the reference optimizes and benches) is a planned
+    speedup — semantics fixed by compute_shuffled_index.
+    """
+    n = len(indices)
+    cache = {}
+
+    def src(r: int, block: int) -> bytes:
+        key = (r, block)
+        if key not in cache:
+            cache[key] = _hash(seed + bytes([r]) + block.to_bytes(4, "little"))
+        return cache[key]
+
+    pivots = [
+        int.from_bytes(_hash(seed + bytes([r]))[:8], "little") % n
+        for r in range(rounds)
+    ]
+    out = []
+    for i in range(n):
+        idx = i
+        for r in range(rounds):
+            pivot = pivots[r]
+            flip = (pivot + n - idx) % n
+            position = max(idx, flip)
+            byte = src(r, position // 256)[(position % 256) // 8]
+            if (byte >> (position % 8)) & 1:
+                idx = flip
+        out.append(indices[idx])
+    return out
+
+
+def compute_committee(
+    indices: list, seed: bytes, index: int, count: int, rounds: int
+) -> list:
+    """Slice `index` of `count` committees over the shuffled indices."""
+    n = len(indices)
+    start = n * index // count
+    end = n * (index + 1) // count
+    return [
+        indices[compute_shuffled_index(i, n, seed, rounds)]
+        for i in range(start, end)
+    ]

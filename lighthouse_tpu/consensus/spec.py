@@ -1,0 +1,188 @@
+"""Chain specification: runtime constants + fork schedule.
+
+The two-level config of the reference (SURVEY.md §5.6): compile-time
+presets (mainnet/minimal — consensus/types/src/eth_spec.rs:605) become
+`Preset` instances; runtime constants (consensus/types/src/chain_spec.rs)
+become `ChainSpec` fields, YAML-free but dict round-trippable.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, asdict
+
+FAR_FUTURE_EPOCH = 2**64 - 1
+GENESIS_EPOCH = 0
+GENESIS_SLOT = 0
+
+
+@dataclass(frozen=True)
+class Preset:
+    """Compile-time-ish size constants (eth_spec.rs presets)."""
+
+    name: str
+    slots_per_epoch: int
+    max_committees_per_slot: int
+    target_committee_size: int
+    max_validators_per_committee: int
+    shuffle_round_count: int
+    epochs_per_eth1_voting_period: int
+    slots_per_historical_root: int
+    epochs_per_historical_vector: int
+    epochs_per_slashings_vector: int
+    historical_roots_limit: int
+    validator_registry_limit: int
+    max_proposer_slashings: int
+    max_attester_slashings: int
+    max_attestations: int
+    max_deposits: int
+    max_voluntary_exits: int
+    max_bls_to_execution_changes: int
+    max_blob_commitments_per_block: int
+    sync_committee_size: int
+    sync_committee_subnet_count: int
+    epochs_per_sync_committee_period: int
+
+
+MAINNET_PRESET = Preset(
+    name="mainnet",
+    slots_per_epoch=32,
+    max_committees_per_slot=64,
+    target_committee_size=128,
+    max_validators_per_committee=2048,
+    shuffle_round_count=90,
+    epochs_per_eth1_voting_period=64,
+    slots_per_historical_root=8192,
+    epochs_per_historical_vector=65536,
+    epochs_per_slashings_vector=8192,
+    historical_roots_limit=2**24,
+    validator_registry_limit=2**40,
+    max_proposer_slashings=16,
+    max_attester_slashings=2,
+    max_attestations=128,
+    max_deposits=16,
+    max_voluntary_exits=16,
+    max_bls_to_execution_changes=16,
+    max_blob_commitments_per_block=4096,
+    sync_committee_size=512,
+    sync_committee_subnet_count=4,
+    epochs_per_sync_committee_period=256,
+)
+
+MINIMAL_PRESET = Preset(
+    name="minimal",
+    slots_per_epoch=8,
+    max_committees_per_slot=4,
+    target_committee_size=4,
+    max_validators_per_committee=2048,
+    shuffle_round_count=10,
+    epochs_per_eth1_voting_period=4,
+    slots_per_historical_root=64,
+    epochs_per_historical_vector=64,
+    epochs_per_slashings_vector=64,
+    historical_roots_limit=2**24,
+    validator_registry_limit=2**40,
+    max_proposer_slashings=16,
+    max_attester_slashings=2,
+    max_attestations=128,
+    max_deposits=16,
+    max_voluntary_exits=16,
+    max_bls_to_execution_changes=16,
+    max_blob_commitments_per_block=4096,
+    sync_committee_size=32,
+    sync_committee_subnet_count=4,
+    epochs_per_sync_committee_period=8,
+)
+
+
+FORK_ORDER = ["phase0", "altair", "bellatrix", "capella", "deneb", "electra"]
+
+
+@dataclass
+class ChainSpec:
+    """Runtime constants (chain_spec.rs analog)."""
+
+    preset: Preset = MAINNET_PRESET
+    config_name: str = "mainnet"
+    seconds_per_slot: int = 12
+    min_genesis_time: int = 0
+    genesis_delay: int = 604800
+    min_genesis_active_validator_count: int = 16384
+    min_deposit_amount: int = 10**9
+    max_effective_balance: int = 32 * 10**9
+    effective_balance_increment: int = 10**9
+    ejection_balance: int = 16 * 10**9
+    min_attestation_inclusion_delay: int = 1
+    min_seed_lookahead: int = 1
+    max_seed_lookahead: int = 4
+    min_epochs_to_inactivity_penalty: int = 4
+    min_validator_withdrawability_delay: int = 256
+    shard_committee_period: int = 256
+    base_reward_factor: int = 64
+    whistleblower_reward_quotient: int = 512
+    proposer_reward_quotient: int = 8
+    inactivity_penalty_quotient: int = 2**26
+    min_slashing_penalty_quotient: int = 128
+    proportional_slashing_multiplier: int = 1
+    min_per_epoch_churn_limit: int = 4
+    churn_limit_quotient: int = 65536
+    proposer_score_boost: int = 40
+    target_aggregators_per_committee: int = 16
+    # domain types (4-byte little-endian constants, spec values)
+    domain_beacon_proposer: bytes = bytes.fromhex("00000000")
+    domain_beacon_attester: bytes = bytes.fromhex("01000000")
+    domain_randao: bytes = bytes.fromhex("02000000")
+    domain_deposit: bytes = bytes.fromhex("03000000")
+    domain_voluntary_exit: bytes = bytes.fromhex("04000000")
+    domain_selection_proof: bytes = bytes.fromhex("05000000")
+    domain_aggregate_and_proof: bytes = bytes.fromhex("06000000")
+    domain_sync_committee: bytes = bytes.fromhex("07000000")
+    domain_sync_committee_selection_proof: bytes = bytes.fromhex("08000000")
+    domain_contribution_and_proof: bytes = bytes.fromhex("09000000")
+    domain_bls_to_execution_change: bytes = bytes.fromhex("0A000000")
+    domain_application_mask: bytes = bytes.fromhex("00000001")
+    # fork schedule: name -> (version bytes, activation epoch)
+    genesis_fork_version: bytes = bytes.fromhex("00000000")
+    fork_versions: dict = field(
+        default_factory=lambda: {
+            "phase0": bytes.fromhex("00000000"),
+            "altair": bytes.fromhex("01000000"),
+            "bellatrix": bytes.fromhex("02000000"),
+            "capella": bytes.fromhex("03000000"),
+            "deneb": bytes.fromhex("04000000"),
+            "electra": bytes.fromhex("05000000"),
+        }
+    )
+    fork_epochs: dict = field(
+        default_factory=lambda: {
+            "phase0": 0,
+            "altair": 74240,
+            "bellatrix": 144896,
+            "capella": 194048,
+            "deneb": 269568,
+            "electra": FAR_FUTURE_EPOCH,
+        }
+    )
+
+    def fork_name_at_epoch(self, epoch: int) -> str:
+        current = "phase0"
+        for name in FORK_ORDER:
+            e = self.fork_epochs.get(name, FAR_FUTURE_EPOCH)
+            if e <= epoch:
+                current = name
+        return current
+
+    def fork_version_at_epoch(self, epoch: int) -> bytes:
+        return self.fork_versions[self.fork_name_at_epoch(epoch)]
+
+    def to_dict(self) -> dict:
+        d = asdict(self)
+        d["preset"] = self.preset.name
+        return d
+
+
+def mainnet_spec() -> ChainSpec:
+    return ChainSpec()
+
+
+def minimal_spec() -> ChainSpec:
+    return ChainSpec(preset=MINIMAL_PRESET, config_name="minimal")

@@ -1,0 +1,566 @@
+"""SSZ (SimpleSerialize) encode/decode + Merkle hash-tree-root.
+
+Clean-room implementation of the Ethereum consensus SSZ spec (the
+reference consumes it via the `ethereum_ssz`/`tree_hash` crates across
+consensus/types). Covers the full type algebra the beacon types need:
+uintN, boolean, Bytes{N}, Vector, List, Bitvector, Bitlist, Container,
+and Union is omitted (unused by the types we model).
+
+Types are *descriptors* (instances of SSZType subclasses); values are
+plain Python (ints, bytes, lists, dataclass-like Containers). This keeps
+the host layer simple and keeps hashing vectorizable later (hash-tree-
+root of big state objects is a flagged TPU-offload candidate,
+SURVEY.md §7 P4 note).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+from typing import Any, Sequence
+
+BYTES_PER_CHUNK = 32
+OFFSET_SIZE = 4
+
+
+def _hash(a: bytes, b: bytes) -> bytes:
+    return hashlib.sha256(a + b).digest()
+
+
+_ZERO_CHUNKS = [b"\x00" * 32]
+for _ in range(64):
+    _ZERO_CHUNKS.append(_hash(_ZERO_CHUNKS[-1], _ZERO_CHUNKS[-1]))
+
+
+def _next_pow2(n: int) -> int:
+    return 1 if n <= 1 else 1 << (n - 1).bit_length()
+
+
+def merkleize(chunks: Sequence[bytes], limit: int = None) -> bytes:
+    """Binary Merkle tree over 32-byte chunks, padded with zero-subtrees
+    to `limit` (or to the chunk count) leaves."""
+    count = len(chunks)
+    width = _next_pow2(limit if limit is not None else count)
+    if limit is not None and count > limit:
+        raise ValueError("chunk count exceeds limit")
+    depth = width.bit_length() - 1
+    if count == 0:
+        return _ZERO_CHUNKS[depth]
+    layer = list(chunks)
+    for d in range(depth):
+        if len(layer) % 2:
+            layer.append(_ZERO_CHUNKS[d])
+        layer = [_hash(layer[i], layer[i + 1]) for i in range(0, len(layer), 2)]
+    return layer[0]
+
+
+def mix_in_length(root: bytes, length: int) -> bytes:
+    return _hash(root, length.to_bytes(32, "little"))
+
+
+def _pack_bytes(data: bytes) -> list:
+    if len(data) % BYTES_PER_CHUNK:
+        data = data + b"\x00" * (BYTES_PER_CHUNK - len(data) % BYTES_PER_CHUNK)
+    return [data[i : i + 32] for i in range(0, len(data), 32)] or [b"\x00" * 32]
+
+
+# ---------------------------------------------------------------- descriptors
+
+
+class SSZType:
+    def is_fixed_size(self) -> bool:
+        raise NotImplementedError
+
+    def fixed_size(self) -> int:
+        raise NotImplementedError
+
+    def serialize(self, value) -> bytes:
+        raise NotImplementedError
+
+    def deserialize(self, data: bytes):
+        raise NotImplementedError
+
+    def hash_tree_root(self, value) -> bytes:
+        raise NotImplementedError
+
+    def default(self):
+        raise NotImplementedError
+
+
+class Uint(SSZType):
+    def __init__(self, bits: int):
+        self.bits = bits
+
+    def is_fixed_size(self):
+        return True
+
+    def fixed_size(self):
+        return self.bits // 8
+
+    def serialize(self, value) -> bytes:
+        return int(value).to_bytes(self.bits // 8, "little")
+
+    def deserialize(self, data: bytes):
+        if len(data) != self.bits // 8:
+            raise ValueError("bad uint size")
+        return int.from_bytes(data, "little")
+
+    def hash_tree_root(self, value) -> bytes:
+        return merkleize(_pack_bytes(self.serialize(value)))
+
+    def default(self):
+        return 0
+
+
+class Boolean(SSZType):
+    def is_fixed_size(self):
+        return True
+
+    def fixed_size(self):
+        return 1
+
+    def serialize(self, value) -> bytes:
+        return b"\x01" if value else b"\x00"
+
+    def deserialize(self, data: bytes):
+        if data == b"\x00":
+            return False
+        if data == b"\x01":
+            return True
+        raise ValueError("bad boolean")
+
+    def hash_tree_root(self, value) -> bytes:
+        return merkleize(_pack_bytes(self.serialize(value)))
+
+    def default(self):
+        return False
+
+
+class ByteVector(SSZType):
+    def __init__(self, length: int):
+        self.length = length
+
+    def is_fixed_size(self):
+        return True
+
+    def fixed_size(self):
+        return self.length
+
+    def serialize(self, value) -> bytes:
+        if len(value) != self.length:
+            raise ValueError("bad bytes length")
+        return bytes(value)
+
+    def deserialize(self, data: bytes):
+        if len(data) != self.length:
+            raise ValueError("bad bytes length")
+        return bytes(data)
+
+    def hash_tree_root(self, value) -> bytes:
+        return merkleize(_pack_bytes(self.serialize(value)))
+
+    def default(self):
+        return b"\x00" * self.length
+
+
+class ByteList(SSZType):
+    def __init__(self, limit: int):
+        self.limit = limit
+
+    def is_fixed_size(self):
+        return False
+
+    def serialize(self, value) -> bytes:
+        if len(value) > self.limit:
+            raise ValueError("byte list too long")
+        return bytes(value)
+
+    def deserialize(self, data: bytes):
+        if len(data) > self.limit:
+            raise ValueError("byte list too long")
+        return bytes(data)
+
+    def hash_tree_root(self, value) -> bytes:
+        chunks = _pack_bytes(bytes(value)) if value else []
+        return mix_in_length(
+            merkleize(chunks, (self.limit + 31) // 32), len(value)
+        )
+
+    def default(self):
+        return b""
+
+
+class Vector(SSZType):
+    def __init__(self, elem: SSZType, length: int):
+        self.elem = elem
+        self.length = length
+
+    def is_fixed_size(self):
+        return self.elem.is_fixed_size()
+
+    def fixed_size(self):
+        return self.elem.fixed_size() * self.length
+
+    def serialize(self, value) -> bytes:
+        if len(value) != self.length:
+            raise ValueError("bad vector length")
+        return _serialize_seq(self.elem, value)
+
+    def deserialize(self, data: bytes):
+        out = _deserialize_seq(self.elem, data)
+        if len(out) != self.length:
+            raise ValueError("bad vector length")
+        return out
+
+    def hash_tree_root(self, value) -> bytes:
+        return _seq_root(self.elem, value, None)
+
+    def default(self):
+        return [self.elem.default() for _ in range(self.length)]
+
+
+class List(SSZType):
+    def __init__(self, elem: SSZType, limit: int):
+        self.elem = elem
+        self.limit = limit
+
+    def is_fixed_size(self):
+        return False
+
+    def serialize(self, value) -> bytes:
+        if len(value) > self.limit:
+            raise ValueError("list too long")
+        return _serialize_seq(self.elem, value)
+
+    def deserialize(self, data: bytes):
+        out = _deserialize_seq(self.elem, data)
+        if len(out) > self.limit:
+            raise ValueError("list too long")
+        return out
+
+    def hash_tree_root(self, value) -> bytes:
+        if isinstance(self.elem, (Uint, Boolean)):
+            limit_chunks = (self.limit * self.elem.fixed_size() + 31) // 32
+        else:
+            limit_chunks = self.limit
+        return mix_in_length(
+            _seq_root(self.elem, value, limit_chunks), len(value)
+        )
+
+    def default(self):
+        return []
+
+
+class Bitvector(SSZType):
+    def __init__(self, length: int):
+        self.length = length
+
+    def is_fixed_size(self):
+        return True
+
+    def fixed_size(self):
+        return (self.length + 7) // 8
+
+    def serialize(self, value) -> bytes:
+        if len(value) != self.length:
+            raise ValueError("bad bitvector length")
+        out = bytearray((self.length + 7) // 8)
+        for i, bit in enumerate(value):
+            if bit:
+                out[i // 8] |= 1 << (i % 8)
+        return bytes(out)
+
+    def deserialize(self, data: bytes):
+        if len(data) != self.fixed_size():
+            raise ValueError("bad bitvector size")
+        bits = [bool((data[i // 8] >> (i % 8)) & 1) for i in range(self.length)]
+        # excess bits must be zero
+        for i in range(self.length, len(data) * 8):
+            if (data[i // 8] >> (i % 8)) & 1:
+                raise ValueError("nonzero padding bit")
+        return bits
+
+    def hash_tree_root(self, value) -> bytes:
+        return merkleize(
+            _pack_bytes(self.serialize(value)), (self.length + 255) // 256
+        )
+
+    def default(self):
+        return [False] * self.length
+
+
+class Bitlist(SSZType):
+    def __init__(self, limit: int):
+        self.limit = limit
+
+    def is_fixed_size(self):
+        return False
+
+    def serialize(self, value) -> bytes:
+        if len(value) > self.limit:
+            raise ValueError("bitlist too long")
+        out = bytearray(len(value) // 8 + 1)
+        for i, bit in enumerate(value):
+            if bit:
+                out[i // 8] |= 1 << (i % 8)
+        out[len(value) // 8] |= 1 << (len(value) % 8)  # delimiter
+        return bytes(out)
+
+    def deserialize(self, data: bytes):
+        if not data or data[-1] == 0:
+            raise ValueError("missing bitlist delimiter")
+        total = (len(data) - 1) * 8 + data[-1].bit_length() - 1
+        if total > self.limit:
+            raise ValueError("bitlist too long")
+        return [bool((data[i // 8] >> (i % 8)) & 1) for i in range(total)]
+
+    def hash_tree_root(self, value) -> bytes:
+        out = bytearray(((len(value) + 7) // 8) or 0)
+        for i, bit in enumerate(value):
+            if bit:
+                out[i // 8] |= 1 << (i % 8)
+        chunks = _pack_bytes(bytes(out)) if value else []
+        return mix_in_length(
+            merkleize(chunks, (self.limit + 255) // 256), len(value)
+        )
+
+    def default(self):
+        return []
+
+
+def _serialize_seq(elem: SSZType, values) -> bytes:
+    if elem.is_fixed_size():
+        return b"".join(elem.serialize(v) for v in values)
+    parts = [elem.serialize(v) for v in values]
+    offset = OFFSET_SIZE * len(parts)
+    out = bytearray()
+    for p in parts:
+        out += offset.to_bytes(OFFSET_SIZE, "little")
+        offset += len(p)
+    for p in parts:
+        out += p
+    return bytes(out)
+
+
+def _deserialize_seq(elem: SSZType, data: bytes):
+    if elem.is_fixed_size():
+        size = elem.fixed_size()
+        if size == 0 or len(data) % size:
+            raise ValueError("bad sequence size")
+        return [
+            elem.deserialize(data[i : i + size]) for i in range(0, len(data), size)
+        ]
+    if not data:
+        return []
+    first = int.from_bytes(data[:OFFSET_SIZE], "little")
+    if first % OFFSET_SIZE or first > len(data) or first == 0:
+        raise ValueError("bad first offset")
+    n = first // OFFSET_SIZE
+    offsets = [
+        int.from_bytes(data[i * 4 : i * 4 + 4], "little") for i in range(n)
+    ] + [len(data)]
+    out = []
+    for i in range(n):
+        if offsets[i + 1] < offsets[i] or offsets[i] > len(data):
+            raise ValueError("offsets not monotonic / out of bounds")
+        out.append(elem.deserialize(data[offsets[i] : offsets[i + 1]]))
+    return out
+
+
+# Content-keyed root cache for big sequences: beacon-state vectors
+# (randao mixes, block/state roots) are re-rooted every slot but change
+# in at most one entry; one C-speed sha256 over the joined leaves is
+# ~100x cheaper than the 2N python-level hash calls it skips. Bounded
+# FIFO (dict preserves insertion order).
+_ROOT_CACHE: dict = {}
+_ROOT_CACHE_MAX = 4096
+_CACHE_MIN_CHUNKS = 256
+
+
+def _cached_merkleize(chunks: list, limit_chunks) -> bytes:
+    if len(chunks) < _CACHE_MIN_CHUNKS:
+        return merkleize(chunks, limit_chunks)
+    key = (hashlib.sha256(b"".join(chunks)).digest(), len(chunks), limit_chunks)
+    root = _ROOT_CACHE.get(key)
+    if root is None:
+        root = merkleize(chunks, limit_chunks)
+        if len(_ROOT_CACHE) >= _ROOT_CACHE_MAX:
+            _ROOT_CACHE.pop(next(iter(_ROOT_CACHE)))
+        _ROOT_CACHE[key] = root
+    return root
+
+
+def _seq_root(elem: SSZType, values, limit_chunks) -> bytes:
+    if isinstance(elem, (Uint, Boolean)):
+        data = b"".join(elem.serialize(v) for v in values)
+        chunks = _pack_bytes(data) if data else []
+        return _cached_merkleize(chunks, limit_chunks)
+    if isinstance(elem, ByteVector) and elem.length == 32:
+        # a 32-byte leaf IS its own chunk root — skip per-element calls
+        roots = [bytes(v) for v in values]
+    else:
+        roots = [elem.hash_tree_root(v) for v in values]
+    return _cached_merkleize(roots, limit_chunks)
+
+
+# ---------------------------------------------------------------- containers
+
+
+class Container(SSZType):
+    """A named, ordered set of typed fields. Subclass-free: built from a
+    field spec, producing lightweight value objects (SSZValue)."""
+
+    def __init__(self, name: str, fields: Sequence[tuple]):
+        self.name = name
+        self.fields = list(fields)  # [(name, SSZType), ...]
+
+    def is_fixed_size(self):
+        return all(t.is_fixed_size() for _, t in self.fields)
+
+    def fixed_size(self):
+        return sum(t.fixed_size() for _, t in self.fields)
+
+    def serialize(self, value) -> bytes:
+        fixed_parts = []
+        var_parts = []
+        for fname, ftype in self.fields:
+            v = getattr(value, fname)
+            if ftype.is_fixed_size():
+                fixed_parts.append(ftype.serialize(v))
+                var_parts.append(None)
+            else:
+                fixed_parts.append(None)
+                var_parts.append(ftype.serialize(v))
+        fixed_len = sum(
+            len(p) if p is not None else OFFSET_SIZE for p in fixed_parts
+        )
+        out = bytearray()
+        offset = fixed_len
+        for p, v in zip(fixed_parts, var_parts):
+            if p is not None:
+                out += p
+            else:
+                out += offset.to_bytes(OFFSET_SIZE, "little")
+                offset += len(v)
+        for v in var_parts:
+            if v is not None:
+                out += v
+        return bytes(out)
+
+    def deserialize(self, data: bytes):
+        pos = 0
+        offsets = []
+        fixed_vals = {}
+        for fname, ftype in self.fields:
+            if ftype.is_fixed_size():
+                size = ftype.fixed_size()
+                if pos + size > len(data):
+                    raise ValueError("container truncated")
+                fixed_vals[fname] = ftype.deserialize(data[pos : pos + size])
+                pos += size
+            else:
+                offsets.append(
+                    (fname, int.from_bytes(data[pos : pos + 4], "little"))
+                )
+                pos += OFFSET_SIZE
+        if offsets:
+            # the first variable offset must land exactly at the end of
+            # the fixed part — anything else is a non-canonical encoding
+            if offsets[0][1] != pos:
+                raise ValueError("first offset != fixed-part length")
+        elif pos != len(data):
+            raise ValueError("trailing bytes after fixed container")
+        offsets.append((None, len(data)))
+        for i in range(len(offsets) - 1):
+            fname, start = offsets[i]
+            _, end = offsets[i + 1]
+            ftype = dict(self.fields)[fname]
+            if end < start or start > len(data):
+                raise ValueError("offsets not monotonic / out of bounds")
+            fixed_vals[fname] = ftype.deserialize(data[start:end])
+        return SSZValue(self, fixed_vals)
+
+    def hash_tree_root(self, value) -> bytes:
+        roots = [
+            ftype.hash_tree_root(getattr(value, fname))
+            for fname, ftype in self.fields
+        ]
+        return merkleize(roots)
+
+    def default(self):
+        return SSZValue(
+            self, {fname: ftype.default() for fname, ftype in self.fields}
+        )
+
+    def make(self, **kwargs):
+        vals = {}
+        for fname, ftype in self.fields:
+            vals[fname] = kwargs.pop(fname) if fname in kwargs else ftype.default()
+        if kwargs:
+            raise TypeError(f"unknown fields {list(kwargs)} for {self.name}")
+        return SSZValue(self, vals)
+
+
+class SSZValue:
+    """A container instance: attribute access + copy-on-write updates."""
+
+    __slots__ = ("_type", "_vals")
+
+    def __init__(self, ctype: Container, vals: dict):
+        object.__setattr__(self, "_type", ctype)
+        object.__setattr__(self, "_vals", vals)
+
+    def __getattr__(self, name):
+        try:
+            return object.__getattribute__(self, "_vals")[name]
+        except KeyError:
+            raise AttributeError(name) from None
+
+    def __setattr__(self, name, value):
+        vals = object.__getattribute__(self, "_vals")
+        if name not in vals:
+            raise AttributeError(f"no field {name}")
+        vals[name] = value
+
+    def copy(self) -> "SSZValue":
+        import copy as _copy
+
+        return _copy.deepcopy(self)
+
+    def __deepcopy__(self, memo) -> "SSZValue":
+        # __slots__ + guarded __setattr__ break default deepcopy (it
+        # setattrs into a shell object before _vals exists); rebuild
+        # through __init__ instead.
+        import copy as _copy
+
+        return SSZValue(self._type, _copy.deepcopy(self._vals, memo))
+
+    def serialize(self) -> bytes:
+        return self._type.serialize(self)
+
+    def hash_tree_root(self) -> bytes:
+        return self._type.hash_tree_root(self)
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, SSZValue)
+            and self._type is other._type
+            and self.serialize() == other.serialize()
+        )
+
+    def __repr__(self):
+        return f"<{self._type.name} {self._vals}>"
+
+
+# common aliases
+uint8 = Uint(8)
+uint16 = Uint(16)
+uint32 = Uint(32)
+uint64 = Uint(64)
+uint256 = Uint(256)
+boolean = Boolean()
+Bytes4 = ByteVector(4)
+Bytes20 = ByteVector(20)
+Bytes32 = ByteVector(32)
+Bytes48 = ByteVector(48)
+Bytes96 = ByteVector(96)

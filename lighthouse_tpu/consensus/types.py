@@ -1,0 +1,355 @@
+"""Beacon chain SSZ containers (consensus/types analog).
+
+One canonical (Deneb-shaped) set of containers built on consensus.ssz
+descriptors. The reference stamps per-fork variants with superstruct
+(consensus/types/src/beacon_block.rs); here fork-awareness lives in the
+spec's fork schedule + domains, and the container set carries the union
+of fields the signature constructors need. Per-fork SSZ-exact variants
+are a widening item (tracked for later rounds), not a structural change.
+"""
+
+from .ssz import (
+    Container,
+    List,
+    Vector,
+    Bitlist,
+    Bitvector,
+    ByteList,
+    uint8,
+    uint64,
+    uint256,
+    boolean,
+    Bytes4,
+    Bytes20,
+    Bytes32,
+    Bytes48,
+    Bytes96,
+)
+from .spec import MAINNET_PRESET as _P
+
+# ---------------------------------------------------------------- basics
+
+Fork = Container(
+    "Fork",
+    [
+        ("previous_version", Bytes4),
+        ("current_version", Bytes4),
+        ("epoch", uint64),
+    ],
+)
+
+ForkData = Container(
+    "ForkData",
+    [("current_version", Bytes4), ("genesis_validators_root", Bytes32)],
+)
+
+SigningData = Container(
+    "SigningData", [("object_root", Bytes32), ("domain", Bytes32)]
+)
+
+Checkpoint = Container("Checkpoint", [("epoch", uint64), ("root", Bytes32)])
+
+Validator = Container(
+    "Validator",
+    [
+        ("pubkey", Bytes48),
+        ("withdrawal_credentials", Bytes32),
+        ("effective_balance", uint64),
+        ("slashed", boolean),
+        ("activation_eligibility_epoch", uint64),
+        ("activation_epoch", uint64),
+        ("exit_epoch", uint64),
+        ("withdrawable_epoch", uint64),
+    ],
+)
+
+Eth1Data = Container(
+    "Eth1Data",
+    [
+        ("deposit_root", Bytes32),
+        ("deposit_count", uint64),
+        ("block_hash", Bytes32),
+    ],
+)
+
+# ---------------------------------------------------------------- attestations
+
+AttestationData = Container(
+    "AttestationData",
+    [
+        ("slot", uint64),
+        ("index", uint64),
+        ("beacon_block_root", Bytes32),
+        ("source", Checkpoint),
+        ("target", Checkpoint),
+    ],
+)
+
+Attestation = Container(
+    "Attestation",
+    [
+        ("aggregation_bits", Bitlist(_P.max_validators_per_committee)),
+        ("data", AttestationData),
+        ("signature", Bytes96),
+    ],
+)
+
+IndexedAttestation = Container(
+    "IndexedAttestation",
+    [
+        ("attesting_indices", List(uint64, _P.max_validators_per_committee)),
+        ("data", AttestationData),
+        ("signature", Bytes96),
+    ],
+)
+
+AggregateAndProof = Container(
+    "AggregateAndProof",
+    [
+        ("aggregator_index", uint64),
+        ("aggregate", Attestation),
+        ("selection_proof", Bytes96),
+    ],
+)
+
+SignedAggregateAndProof = Container(
+    "SignedAggregateAndProof",
+    [("message", AggregateAndProof), ("signature", Bytes96)],
+)
+
+# ---------------------------------------------------------------- blocks
+
+BeaconBlockHeader = Container(
+    "BeaconBlockHeader",
+    [
+        ("slot", uint64),
+        ("proposer_index", uint64),
+        ("parent_root", Bytes32),
+        ("state_root", Bytes32),
+        ("body_root", Bytes32),
+    ],
+)
+
+SignedBeaconBlockHeader = Container(
+    "SignedBeaconBlockHeader",
+    [("message", BeaconBlockHeader), ("signature", Bytes96)],
+)
+
+ProposerSlashing = Container(
+    "ProposerSlashing",
+    [
+        ("signed_header_1", SignedBeaconBlockHeader),
+        ("signed_header_2", SignedBeaconBlockHeader),
+    ],
+)
+
+AttesterSlashing = Container(
+    "AttesterSlashing",
+    [
+        ("attestation_1", IndexedAttestation),
+        ("attestation_2", IndexedAttestation),
+    ],
+)
+
+DepositData = Container(
+    "DepositData",
+    [
+        ("pubkey", Bytes48),
+        ("withdrawal_credentials", Bytes32),
+        ("amount", uint64),
+        ("signature", Bytes96),
+    ],
+)
+
+DepositMessage = Container(
+    "DepositMessage",
+    [
+        ("pubkey", Bytes48),
+        ("withdrawal_credentials", Bytes32),
+        ("amount", uint64),
+    ],
+)
+
+Deposit = Container(
+    "Deposit",
+    [("proof", Vector(Bytes32, 33)), ("data", DepositData)],
+)
+
+VoluntaryExit = Container(
+    "VoluntaryExit", [("epoch", uint64), ("validator_index", uint64)]
+)
+
+SignedVoluntaryExit = Container(
+    "SignedVoluntaryExit",
+    [("message", VoluntaryExit), ("signature", Bytes96)],
+)
+
+BLSToExecutionChange = Container(
+    "BLSToExecutionChange",
+    [
+        ("validator_index", uint64),
+        ("from_bls_pubkey", Bytes48),
+        ("to_execution_address", Bytes20),
+    ],
+)
+
+SignedBLSToExecutionChange = Container(
+    "SignedBLSToExecutionChange",
+    [("message", BLSToExecutionChange), ("signature", Bytes96)],
+)
+
+SyncAggregate = Container(
+    "SyncAggregate",
+    [
+        ("sync_committee_bits", Bitvector(_P.sync_committee_size)),
+        ("sync_committee_signature", Bytes96),
+    ],
+)
+
+ExecutionPayloadHeader = Container(
+    "ExecutionPayloadHeader",
+    [
+        ("parent_hash", Bytes32),
+        ("fee_recipient", Bytes20),
+        ("state_root", Bytes32),
+        ("receipts_root", Bytes32),
+        ("logs_bloom", ByteList(256)),
+        ("prev_randao", Bytes32),
+        ("block_number", uint64),
+        ("gas_limit", uint64),
+        ("gas_used", uint64),
+        ("timestamp", uint64),
+        ("extra_data", ByteList(32)),
+        ("base_fee_per_gas", uint256),
+        ("block_hash", Bytes32),
+        ("transactions_root", Bytes32),
+        ("withdrawals_root", Bytes32),
+        ("blob_gas_used", uint64),
+        ("excess_blob_gas", uint64),
+    ],
+)
+
+BeaconBlockBody = Container(
+    "BeaconBlockBody",
+    [
+        ("randao_reveal", Bytes96),
+        ("eth1_data", Eth1Data),
+        ("graffiti", Bytes32),
+        ("proposer_slashings", List(ProposerSlashing, _P.max_proposer_slashings)),
+        ("attester_slashings", List(AttesterSlashing, _P.max_attester_slashings)),
+        ("attestations", List(Attestation, _P.max_attestations)),
+        ("deposits", List(Deposit, _P.max_deposits)),
+        ("voluntary_exits", List(SignedVoluntaryExit, _P.max_voluntary_exits)),
+        ("sync_aggregate", SyncAggregate),
+        ("execution_payload_header", ExecutionPayloadHeader),
+        (
+            "bls_to_execution_changes",
+            List(SignedBLSToExecutionChange, _P.max_bls_to_execution_changes),
+        ),
+        (
+            "blob_kzg_commitments",
+            List(Bytes48, _P.max_blob_commitments_per_block),
+        ),
+    ],
+)
+
+BeaconBlock = Container(
+    "BeaconBlock",
+    [
+        ("slot", uint64),
+        ("proposer_index", uint64),
+        ("parent_root", Bytes32),
+        ("state_root", Bytes32),
+        ("body", BeaconBlockBody),
+    ],
+)
+
+SignedBeaconBlock = Container(
+    "SignedBeaconBlock",
+    [("message", BeaconBlock), ("signature", Bytes96)],
+)
+
+# ---------------------------------------------------------------- sync duty
+
+SyncCommitteeMessage = Container(
+    "SyncCommitteeMessage",
+    [
+        ("slot", uint64),
+        ("beacon_block_root", Bytes32),
+        ("validator_index", uint64),
+        ("signature", Bytes96),
+    ],
+)
+
+SyncCommitteeContribution = Container(
+    "SyncCommitteeContribution",
+    [
+        ("slot", uint64),
+        ("beacon_block_root", Bytes32),
+        ("subcommittee_index", uint64),
+        (
+            "aggregation_bits",
+            Bitvector(_P.sync_committee_size // _P.sync_committee_subnet_count),
+        ),
+        ("signature", Bytes96),
+    ],
+)
+
+ContributionAndProof = Container(
+    "ContributionAndProof",
+    [
+        ("aggregator_index", uint64),
+        ("contribution", SyncCommitteeContribution),
+        ("selection_proof", Bytes96),
+    ],
+)
+
+SignedContributionAndProof = Container(
+    "SignedContributionAndProof",
+    [("message", ContributionAndProof), ("signature", Bytes96)],
+)
+
+SyncAggregatorSelectionData = Container(
+    "SyncAggregatorSelectionData",
+    [("slot", uint64), ("subcommittee_index", uint64)],
+)
+
+SyncCommittee = Container(
+    "SyncCommittee",
+    [
+        ("pubkeys", Vector(Bytes48, _P.sync_committee_size)),
+        ("aggregate_pubkey", Bytes48),
+    ],
+)
+
+# ---------------------------------------------------------------- state
+
+BeaconState = Container(
+    "BeaconState",
+    [
+        ("genesis_time", uint64),
+        ("genesis_validators_root", Bytes32),
+        ("slot", uint64),
+        ("fork", Fork),
+        ("latest_block_header", BeaconBlockHeader),
+        ("block_roots", Vector(Bytes32, _P.slots_per_historical_root)),
+        ("state_roots", Vector(Bytes32, _P.slots_per_historical_root)),
+        ("historical_roots", List(Bytes32, _P.historical_roots_limit)),
+        ("eth1_data", Eth1Data),
+        ("eth1_data_votes", List(Eth1Data, _P.epochs_per_eth1_voting_period * _P.slots_per_epoch)),
+        ("eth1_deposit_index", uint64),
+        ("validators", List(Validator, _P.validator_registry_limit)),
+        ("balances", List(uint64, _P.validator_registry_limit)),
+        ("randao_mixes", Vector(Bytes32, _P.epochs_per_historical_vector)),
+        ("slashings", Vector(uint64, _P.epochs_per_slashings_vector)),
+        ("previous_epoch_participation", List(uint8, _P.validator_registry_limit)),
+        ("current_epoch_participation", List(uint8, _P.validator_registry_limit)),
+        ("justification_bits", Bitvector(4)),
+        ("previous_justified_checkpoint", Checkpoint),
+        ("current_justified_checkpoint", Checkpoint),
+        ("finalized_checkpoint", Checkpoint),
+        ("inactivity_scores", List(uint64, _P.validator_registry_limit)),
+        ("current_sync_committee", SyncCommittee),
+        ("next_sync_committee", SyncCommittee),
+    ],
+)

@@ -1,0 +1,114 @@
+"""Fork-choice scenario tests in the style of the reference's scripted
+fork_choice_test_definition DSL (proto_array/src/fork_choice_test_definition)."""
+
+from lighthouse_tpu.consensus.proto_array import (
+    ExecutionStatus,
+    ProtoArrayForkChoice,
+)
+
+
+def root(n: int) -> bytes:
+    return n.to_bytes(32, "little")
+
+
+def make_chain():
+    """genesis -> a -> b ; a -> c (fork)"""
+    fc = ProtoArrayForkChoice(
+        finalized_root=root(0), finalized_slot=0, justified_epoch=0, finalized_epoch=0
+    )
+    fc.on_block(1, root(1), root(0), 0, 0)
+    fc.on_block(2, root(2), root(1), 0, 0)
+    fc.on_block(2, root(3), root(1), 0, 0)
+    return fc
+
+
+def test_no_votes_tiebreak_by_root():
+    fc = make_chain()
+    # no votes: equal weights, higher root wins the tie
+    assert fc.find_head(root(0)) == root(3)
+
+
+def test_votes_move_head():
+    fc = make_chain()
+    fc.process_attestation(0, root(2), 1)
+    fc.process_attestation(1, root(2), 1)
+    fc.process_attestation(2, root(3), 1)
+    fc.apply_score_changes([10, 10, 10])
+    assert fc.find_head(root(0)) == root(2)
+    # votes move: validators 0,1 switch to the fork
+    fc.process_attestation(0, root(3), 2)
+    fc.process_attestation(1, root(3), 2)
+    fc.apply_score_changes([10, 10, 10])
+    assert fc.find_head(root(0)) == root(3)
+
+
+def test_balance_changes_change_head():
+    fc = make_chain()
+    fc.process_attestation(0, root(2), 1)
+    fc.process_attestation(1, root(3), 1)
+    fc.apply_score_changes([10, 11])
+    assert fc.find_head(root(0)) == root(3)
+    fc.apply_score_changes([20, 11])  # validator 0 got richer
+    assert fc.find_head(root(0)) == root(2)
+
+
+def test_proposer_boost_is_transient():
+    fc = make_chain()
+    fc.process_attestation(0, root(2), 1)
+    fc.apply_score_changes([10])
+    assert fc.find_head(root(0)) == root(2)
+    fc.apply_proposer_boost(root(3), 100)
+    fc.apply_score_changes([10])
+    assert fc.find_head(root(0)) == root(3)
+    fc.apply_score_changes([10])  # boost expires
+    assert fc.find_head(root(0)) == root(2)
+
+
+def test_invalid_execution_excluded():
+    fc = make_chain()
+    fc.process_attestation(0, root(2), 1)
+    fc.apply_score_changes([100])
+    fc.on_execution_status(root(2), ExecutionStatus.INVALID)
+    assert fc.find_head(root(0)) == root(3)
+
+
+def test_invalid_propagates_to_descendants():
+    fc = make_chain()
+    fc.on_block(3, root(4), root(2), 0, 0)
+    fc.on_execution_status(root(2), ExecutionStatus.INVALID)
+    assert fc.nodes[fc.index_by_root[root(4)]].execution_status == ExecutionStatus.INVALID
+
+
+def test_prune():
+    fc = make_chain()
+    fc.on_block(3, root(4), root(2), 0, 0)
+    pruned = fc.prune(root(2))
+    assert pruned == 3  # genesis, a, and the c-fork are gone
+    assert set(fc.index_by_root) == {root(2), root(4)}
+    assert fc.find_head(root(2)) == root(4)
+
+
+def test_first_vote_at_target_epoch_zero_counts():
+    # regression: a fresh tracker must accept target_epoch == 0 (the
+    # tracker default) — genesis-epoch attestations carry weight
+    fc = make_chain()
+    fc.process_attestation(0, root(2), 0)
+    fc.apply_score_changes([100])
+    assert fc.find_head(root(0)) == root(2)
+
+
+def test_vote_to_unknown_block_not_subtracted_twice():
+    # regression: moving a vote to a block the proto-array doesn't know
+    # yet must subtract the old vote exactly once
+    fc = make_chain()
+    fc.process_attestation(0, root(2), 1)
+    fc.process_attestation(1, root(3), 1)
+    fc.apply_score_changes([100, 60])
+    assert fc.find_head(root(0)) == root(2)
+    unknown = root(99)
+    fc.process_attestation(0, unknown, 2)
+    fc.apply_score_changes([100, 60])   # vote leaves b; must not double-subtract
+    fc.apply_score_changes([100, 60])
+    b_idx = fc.index_by_root[root(2)]
+    assert fc.nodes[b_idx].weight == 0
+    assert fc.find_head(root(0)) == root(3)

@@ -1,0 +1,206 @@
+"""The 16 SignatureSet constructors + BlockSignatureVerifier, verified
+end-to-end with real keys against the CPU backend (signature_sets.rs
+/ block_signature_verifier.rs parity)."""
+
+import pytest
+
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.crypto.bls.keys import SecretKey
+from lighthouse_tpu.consensus import types as T, signature_sets as SS
+from lighthouse_tpu.consensus.domains import compute_signing_root, get_domain, compute_domain
+from lighthouse_tpu.consensus.spec import mainnet_spec
+from lighthouse_tpu.consensus.pubkey_cache import ValidatorPubkeyCache
+
+
+SPEC = mainnet_spec()
+GVR = b"\x42" * 32
+N_KEYS = 8
+KEYS = [SecretKey.from_seed(bytes([i + 1]) * 3) for i in range(N_KEYS)]
+FORK = T.Fork.make(
+    previous_version=b"\x00" * 4, current_version=b"\x01\x00\x00\x00", epoch=0
+)
+
+
+@pytest.fixture(scope="module")
+def cache():
+    c = ValidatorPubkeyCache()
+    c.import_new_pubkeys([k.public_key().to_bytes() for k in KEYS])
+    return c
+
+
+def sign(sk, obj, domain_type, epoch):
+    domain = get_domain(SPEC, domain_type, epoch, FORK, GVR)
+    return sk.sign(compute_signing_root(obj, domain))
+
+
+def test_block_proposal_and_randao(cache):
+    block = T.BeaconBlock.default()
+    block.slot = 33
+    block.proposer_index = 2
+    epoch = 33 // SPEC.preset.slots_per_epoch
+    sig = sign(KEYS[2], block, SPEC.domain_beacon_proposer, epoch)
+    signed = T.SignedBeaconBlock.make(message=block, signature=sig.to_bytes())
+    s = SS.block_proposal_signature_set(
+        SPEC, cache.getter(), signed, FORK, GVR
+    )
+    assert bls.verify_signature_sets([s])
+
+    # randao: signature over the epoch
+    domain = get_domain(SPEC, SPEC.domain_randao, epoch, FORK, GVR)
+    reveal = KEYS[2].sign(
+        compute_signing_root(SS._EpochSSZ(epoch), domain)
+    )
+    block.body.randao_reveal = reveal.to_bytes()
+    s2 = SS.randao_signature_set(SPEC, cache.getter(), block, FORK, GVR)
+    assert bls.verify_signature_sets([s2])
+    # wrong proposer fails
+    s_bad = SS.block_proposal_signature_set(
+        SPEC,
+        lambda i: KEYS[3].public_key(),
+        signed,
+        FORK,
+        GVR,
+    )
+    assert not bls.verify_signature_sets([s_bad])
+
+
+def make_indexed(indices, slot=12, epoch_target=0):
+    data = T.AttestationData.make(
+        slot=slot,
+        index=0,
+        beacon_block_root=b"\x07" * 32,
+        source=T.Checkpoint.make(epoch=0, root=b"\x00" * 32),
+        target=T.Checkpoint.make(epoch=epoch_target, root=b"\x09" * 32),
+    )
+    domain = get_domain(
+        SPEC, SPEC.domain_beacon_attester, epoch_target, FORK, GVR
+    )
+    root = compute_signing_root(data, domain)
+    agg = bls.aggregate_signatures([KEYS[i].sign(root) for i in indices])
+    return T.IndexedAttestation.make(
+        attesting_indices=list(indices), data=data, signature=agg.to_bytes()
+    )
+
+
+def test_indexed_attestation(cache):
+    ia = make_indexed([1, 3, 5])
+    s = SS.indexed_attestation_signature_set(SPEC, cache.getter(), ia, FORK, GVR)
+    assert bls.verify_signature_sets([s])
+    # tampered data fails
+    ia2 = make_indexed([1, 3, 5])
+    ia2.data.beacon_block_root = b"\xff" * 32
+    s_bad = SS.indexed_attestation_signature_set(
+        SPEC, cache.getter(), ia2, FORK, GVR
+    )
+    assert not bls.verify_signature_sets([s_bad])
+
+
+def test_slashing_sets(cache):
+    h1 = T.BeaconBlockHeader.make(
+        slot=40, proposer_index=4, parent_root=b"\x01" * 32,
+        state_root=b"\x02" * 32, body_root=b"\x03" * 32,
+    )
+    h2 = T.BeaconBlockHeader.make(
+        slot=40, proposer_index=4, parent_root=b"\x01" * 32,
+        state_root=b"\x04" * 32, body_root=b"\x03" * 32,
+    )
+    epoch = 40 // SPEC.preset.slots_per_epoch
+    sh1 = T.SignedBeaconBlockHeader.make(
+        message=h1,
+        signature=sign(KEYS[4], h1, SPEC.domain_beacon_proposer, epoch).to_bytes(),
+    )
+    sh2 = T.SignedBeaconBlockHeader.make(
+        message=h2,
+        signature=sign(KEYS[4], h2, SPEC.domain_beacon_proposer, epoch).to_bytes(),
+    )
+    ps = T.ProposerSlashing.make(signed_header_1=sh1, signed_header_2=sh2)
+    sets = SS.proposer_slashing_signature_sets(
+        SPEC, cache.getter(), ps, FORK, GVR
+    )
+    assert len(sets) == 2 and bls.verify_signature_sets(sets)
+
+    asl = T.AttesterSlashing.make(
+        attestation_1=make_indexed([1, 2]), attestation_2=make_indexed([2, 3])
+    )
+    sets2 = SS.attester_slashing_signature_sets(
+        SPEC, cache.getter(), asl, FORK, GVR
+    )
+    assert len(sets2) == 2 and bls.verify_signature_sets(sets2)
+
+
+def test_deposit_and_exit_and_bls_change(cache):
+    dd = T.DepositData.make(
+        pubkey=KEYS[6].public_key().to_bytes(),
+        withdrawal_credentials=b"\x00" * 32,
+        amount=32 * 10**9,
+    )
+    msg_obj = T.DepositMessage.make(
+        pubkey=dd.pubkey, withdrawal_credentials=dd.withdrawal_credentials,
+        amount=dd.amount,
+    )
+    domain = compute_domain(
+        SPEC.domain_deposit, SPEC.genesis_fork_version, b"\x00" * 32
+    )
+    dd.signature = KEYS[6].sign(compute_signing_root(msg_obj, domain)).to_bytes()
+    assert bls.verify_signature_sets([SS.deposit_signature_set(SPEC, dd)])
+
+    ve = T.VoluntaryExit.make(epoch=100, validator_index=5)
+    sve = T.SignedVoluntaryExit.make(
+        message=ve,
+        signature=sign(KEYS[5], ve, SPEC.domain_voluntary_exit, 100).to_bytes(),
+    )
+    assert bls.verify_signature_sets(
+        [SS.exit_signature_set(SPEC, cache.getter(), sve, FORK, GVR)]
+    )
+
+    ch = T.BLSToExecutionChange.make(
+        validator_index=7,
+        from_bls_pubkey=KEYS[7].public_key().to_bytes(),
+        to_execution_address=b"\x11" * 20,
+    )
+    domain = compute_domain(
+        SPEC.domain_bls_to_execution_change, SPEC.genesis_fork_version, GVR
+    )
+    sch = T.SignedBLSToExecutionChange.make(
+        message=ch,
+        signature=KEYS[7].sign(compute_signing_root(ch, domain)).to_bytes(),
+    )
+    assert bls.verify_signature_sets(
+        [SS.bls_execution_change_signature_set(SPEC, sch, GVR)]
+    )
+
+
+def test_block_signature_verifier_full_batch(cache):
+    """All of a block's sets verified in ONE batch
+    (block_signature_verifier.rs:127-138)."""
+    block = T.BeaconBlock.default()
+    block.slot = 65
+    block.proposer_index = 1
+    epoch = 65 // SPEC.preset.slots_per_epoch
+    domain = get_domain(SPEC, SPEC.domain_randao, epoch, FORK, GVR)
+    block.body.randao_reveal = (
+        KEYS[1].sign(compute_signing_root(SS._EpochSSZ(epoch), domain)).to_bytes()
+    )
+    att = make_indexed([2, 4], slot=60)
+    block.body.attestations = [
+        T.Attestation.make(
+            aggregation_bits=[True, True],
+            data=att.data,
+            signature=att.signature,
+        )
+    ]
+    signed = T.SignedBeaconBlock.make(
+        message=block,
+        signature=sign(
+            KEYS[1], block, SPEC.domain_beacon_proposer, epoch
+        ).to_bytes(),
+    )
+    v = SS.BlockSignatureVerifier(SPEC, cache.getter(), FORK, GVR)
+    v.include_block_proposal(signed)
+    v.include_randao_reveal(block)
+    v.include_attestations(block, lambda a: att)
+    assert len(v.sets) == 3
+    assert v.verify()
+    # flip one byte anywhere -> whole batch fails
+    v.sets[1].message = b"\x00" * 32
+    assert not v.verify()

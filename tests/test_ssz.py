@@ -1,0 +1,107 @@
+"""SSZ encode/decode/hash-tree-root: spec-derived known-answer tests
+plus roundtrips over the beacon containers."""
+
+import hashlib
+
+from lighthouse_tpu.consensus import ssz
+from lighthouse_tpu.consensus import types as T
+
+
+def h(a, b):
+    return hashlib.sha256(a + b).digest()
+
+
+def test_uint_serialization():
+    assert ssz.uint64.serialize(0xDEADBEEF) == (0xDEADBEEF).to_bytes(8, "little")
+    assert ssz.uint64.deserialize(b"\x01" + b"\x00" * 7) == 1
+    # hash tree root of a uint64 is the 32-byte little-endian padding
+    assert ssz.uint64.hash_tree_root(7) == (7).to_bytes(8, "little") + b"\x00" * 24
+
+
+def test_merkleize_known_shapes():
+    z = b"\x00" * 32
+    a = b"\xaa" * 32
+    b = b"\xbb" * 32
+    assert ssz.merkleize([a]) == a
+    assert ssz.merkleize([a, b]) == h(a, b)
+    assert ssz.merkleize([a, b, a]) == h(h(a, b), h(a, z))
+    # limit pads with zero subtrees
+    assert ssz.merkleize([a], limit=4) == h(h(a, z), h(z, z))
+
+
+def test_list_roots_and_roundtrip():
+    t = ssz.List(ssz.uint64, 1024)
+    vals = [1, 2, 3]
+    data = t.serialize(vals)
+    assert t.deserialize(data) == vals
+    # packed chunks + mix_in_length
+    packed = b"".join(v.to_bytes(8, "little") for v in vals)
+    chunk = packed + b"\x00" * (32 - len(packed) % 32)
+    want = ssz.mix_in_length(ssz.merkleize([chunk], (1024 * 8 + 31) // 32), 3)
+    assert t.hash_tree_root(vals) == want
+
+
+def test_bitlist_roundtrip_and_delimiter():
+    t = ssz.Bitlist(2048)
+    bits = [True, False, True, True, False]
+    data = t.serialize(bits)
+    assert t.deserialize(data) == bits
+    assert t.serialize([]) == b"\x01"
+    assert t.deserialize(b"\x01") == []
+
+
+def test_bitvector_roundtrip():
+    t = ssz.Bitvector(10)
+    bits = [True, False] * 5
+    assert t.deserialize(t.serialize(bits)) == bits
+
+
+def test_container_roundtrip_fixed():
+    cp = T.Checkpoint.make(epoch=7, root=b"\x11" * 32)
+    data = cp.serialize()
+    assert len(data) == 40
+    back = T.Checkpoint.deserialize(data)
+    assert back.epoch == 7 and back.root == b"\x11" * 32
+    assert cp.hash_tree_root() == h(
+        (7).to_bytes(8, "little") + b"\x00" * 24, b"\x11" * 32
+    )
+
+
+def test_container_roundtrip_variable():
+    att = T.Attestation.make(
+        aggregation_bits=[True, True, False, True],
+        data=T.AttestationData.make(
+            slot=5,
+            index=2,
+            beacon_block_root=b"\x22" * 32,
+            source=T.Checkpoint.make(epoch=1, root=b"\x01" * 32),
+            target=T.Checkpoint.make(epoch=2, root=b"\x02" * 32),
+        ),
+        signature=b"\x33" * 96,
+    )
+    back = T.Attestation.deserialize(att.serialize())
+    assert back == att
+    assert back.data.target.epoch == 2
+    assert len(att.hash_tree_root()) == 32
+
+
+def test_block_roundtrip():
+    block = T.BeaconBlock.default()
+    block.slot = 42
+    block.proposer_index = 9
+    signed = T.SignedBeaconBlock.make(message=block, signature=b"\x05" * 96)
+    back = T.SignedBeaconBlock.deserialize(signed.serialize())
+    assert back.message.slot == 42
+    assert back.message.proposer_index == 9
+    assert back == signed
+
+
+def test_state_default_roots():
+    state = T.BeaconState.default()
+    state.slot = 3
+    r1 = state.hash_tree_root()
+    state2 = T.BeaconState.default()
+    state2.slot = 3
+    assert r1 == state2.hash_tree_root()
+    state2.slot = 4
+    assert r1 != state2.hash_tree_root()
