@@ -1,0 +1,1 @@
+"""Node layer: scheduler, stores, chain service (SURVEY.md §2.3)."""

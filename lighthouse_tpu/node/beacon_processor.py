@@ -1,0 +1,239 @@
+"""The work scheduler — beacon_node/beacon_processor reimagined for a
+TPU-backed verifier.
+
+Reference economics preserved (beacon_processor/src/lib.rs):
+  - 20+ typed, bounded queues with an explicit priority chain
+    (lib.rs:1036-1260): chain segments > rpc blocks > gossip blocks >
+    P0 API > aggregates > attestations > ... > P1 API > backfill.
+  - LIFO for attestations/aggregates — "validator profits rely upon
+    getting fresh" (lib.rs:846) — FIFO elsewhere.
+  - Bounded queues with drop-and-count backpressure (lib.rs:77-99).
+  - Opportunistic batch formation for attestations/aggregates
+    (lib.rs:230-231,1067-1135) with a documented poisoning tradeoff:
+    each batchable Work carries BOTH process_batch and
+    process_individual closures; on batch failure the worker falls back
+    to individual verification (attestation_verification/batch.rs
+    :203-211 defense).
+  - A reprocessing queue re-schedules early work
+    (work_reprocessing_queue.rs:42-54 delays).
+
+TPU-first change: max batch size defaults far above the reference's 64
+— the whole point of the TPU backend is that batch cost is sublinear in
+batch size — and the batch former drains up to a full bucket instead of
+64. The deterministic core is synchronous (`step()` pulls and executes
+the next highest-priority work), so scheduling policy is unit-testable
+without threads; `run_worker_loop` adds the threaded driver.
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+from collections import deque
+from dataclasses import dataclass, field
+from enum import IntEnum
+from typing import Callable, Optional
+
+from ..common import metrics
+
+
+class WorkType(IntEnum):
+    """Priority order: LOWER value = HIGHER priority (lib.rs:1036-1260)."""
+
+    CHAIN_SEGMENT = 0
+    RPC_BLOCK = 1
+    DELAYED_IMPORT_BLOCK = 2
+    GOSSIP_BLOCK = 3
+    API_REQUEST_P0 = 4
+    GOSSIP_AGGREGATE = 5
+    GOSSIP_ATTESTATION = 6
+    GOSSIP_SYNC_CONTRIBUTION = 7
+    GOSSIP_SYNC_SIGNATURE = 8
+    GOSSIP_VOLUNTARY_EXIT = 9
+    GOSSIP_PROPOSER_SLASHING = 10
+    GOSSIP_ATTESTER_SLASHING = 11
+    GOSSIP_BLS_TO_EXECUTION_CHANGE = 12
+    RPC_REQUEST = 13
+    API_REQUEST_P1 = 14
+    CHAIN_SEGMENT_BACKFILL = 15
+
+
+_LIFO_TYPES = {WorkType.GOSSIP_ATTESTATION, WorkType.GOSSIP_AGGREGATE}
+_BATCH_TYPES = {WorkType.GOSSIP_ATTESTATION, WorkType.GOSSIP_AGGREGATE}
+
+
+@dataclass
+class Work:
+    """One unit of work. Batchable work carries both closures
+    (network_beacon_processor/mod.rs:88-131 pattern)."""
+
+    kind: WorkType
+    process_individual: Callable[[object], None]
+    payload: object = None
+    process_batch: Optional[Callable[[list], bool]] = None
+    # process_batch returns False to request individual fallback
+
+
+@dataclass
+class BeaconProcessorConfig:
+    """beacon_processor/src/lib.rs:238-245 analog, TPU-scale batches."""
+
+    max_workers: int = 1
+    max_gossip_attestation_batch_size: int = 1024
+    max_gossip_aggregate_batch_size: int = 256
+    queue_capacities: dict = field(default_factory=dict)
+    default_capacity: int = 16384
+
+    @classmethod
+    def for_validator_count(cls, active_validators: int, **kw):
+        """Queue sizes partly derived from validator count
+        (lib.rs:144-210)."""
+        cap = max(1024, active_validators // 32)
+        caps = {
+            WorkType.GOSSIP_ATTESTATION: cap,
+            WorkType.GOSSIP_AGGREGATE: max(256, active_validators // 64),
+        }
+        return cls(queue_capacities=caps, **kw)
+
+
+class BeaconProcessor:
+    def __init__(self, config: BeaconProcessorConfig = None):
+        self.config = config or BeaconProcessorConfig()
+        self._queues: dict[WorkType, deque] = {
+            t: deque() for t in WorkType
+        }
+        self._lock = threading.Lock()
+        self._event = threading.Event()
+        self._reprocess: list = []  # heap of (due_time, seq, Work)
+        self._seq = 0
+        self._shutdown = False
+        self.m_received = metrics.counter(
+            "beacon_processor_work_events_received_total"
+        )
+        self.m_dropped = metrics.counter(
+            "beacon_processor_work_events_dropped_total"
+        )
+        self.m_processed = metrics.counter(
+            "beacon_processor_work_events_processed_total"
+        )
+        self.m_batches = metrics.counter("beacon_processor_batches_formed_total")
+        self.m_batch_fallbacks = metrics.counter(
+            "beacon_processor_batch_individual_fallbacks_total"
+        )
+
+    # ---------------------------------------------------------- submission
+
+    def submit(self, work: Work) -> bool:
+        """Enqueue; returns False when dropped by backpressure."""
+        self.m_received.inc()
+        cap = self.config.queue_capacities.get(
+            work.kind, self.config.default_capacity
+        )
+        with self._lock:
+            q = self._queues[work.kind]
+            if len(q) >= cap:
+                if work.kind in _LIFO_TYPES:
+                    # LIFO queues drop the OLDEST (stale) item instead
+                    q.popleft()
+                    self.m_dropped.inc()
+                else:
+                    self.m_dropped.inc()
+                    return False
+            q.append(work)
+        self._event.set()
+        return True
+
+    def submit_delayed(self, work: Work, due_time: float) -> None:
+        """Reprocessing queue: early attestations (+12 s), unknown-parent
+        blocks etc. re-enter the main queues at due_time
+        (work_reprocessing_queue.rs:42-54)."""
+        with self._lock:
+            self._seq += 1
+            heapq.heappush(self._reprocess, (due_time, self._seq, work))
+
+    def pump_reprocess(self, now: float) -> int:
+        """Move due delayed work into the live queues."""
+        moved = 0
+        while True:
+            with self._lock:
+                if not self._reprocess or self._reprocess[0][0] > now:
+                    break
+                _, _, work = heapq.heappop(self._reprocess)
+            self.submit(work)
+            moved += 1
+        return moved
+
+    # ---------------------------------------------------------- dispatch
+
+    def _pop_next(self) -> Optional[list]:
+        """Highest-priority work, batch-formed where applicable. Returns
+        a list of Work sharing one process_batch, or a single-item list."""
+        with self._lock:
+            for kind in WorkType:
+                q = self._queues[kind]
+                if not q:
+                    continue
+                if kind in _BATCH_TYPES:
+                    limit = (
+                        self.config.max_gossip_attestation_batch_size
+                        if kind == WorkType.GOSSIP_ATTESTATION
+                        else self.config.max_gossip_aggregate_batch_size
+                    )
+                    batch = []
+                    while q and len(batch) < limit:
+                        batch.append(q.pop())  # LIFO: freshest first
+                    return batch
+                if kind in _LIFO_TYPES:
+                    return [q.pop()]
+                return [q.popleft()]
+        return None
+
+    def step(self) -> bool:
+        """Process one work item (or one formed batch). Returns False
+        when idle. Deterministic core — tests drive this directly."""
+        batch = self._pop_next()
+        if batch is None:
+            return False
+        if len(batch) > 1 and batch[0].process_batch is not None:
+            self.m_batches.inc()
+            try:
+                ok = batch[0].process_batch([w.payload for w in batch])
+            except Exception:
+                # a raising batch path must not kill the worker loop —
+                # treat it exactly like a poisoned batch
+                ok = False
+            if ok is False:
+                # poisoned batch: fall back to individual verification
+                self.m_batch_fallbacks.inc()
+                for w in batch:
+                    w.process_individual(w.payload)
+        else:
+            for w in batch:
+                w.process_individual(w.payload)
+        self.m_processed.inc(len(batch))
+        return True
+
+    # ---------------------------------------------------------- thread loop
+
+    def run_worker_loop(self, poll_interval: float = 0.01):
+        """Blocking worker loop (threaded driver over the sync core)."""
+        while not self._shutdown:
+            if not self.step():
+                self._event.clear()
+                self._event.wait(timeout=poll_interval)
+
+    def start_workers(self) -> list:
+        threads = []
+        for _ in range(self.config.max_workers):
+            t = threading.Thread(target=self.run_worker_loop, daemon=True)
+            t.start()
+            threads.append(t)
+        return threads
+
+    def shutdown(self):
+        self._shutdown = True
+        self._event.set()
+
+    def queue_lengths(self) -> dict:
+        with self._lock:
+            return {t.name: len(q) for t, q in self._queues.items() if q}

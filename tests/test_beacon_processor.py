@@ -1,0 +1,113 @@
+"""Scheduler policy tests (beacon_processor analog): priority order,
+LIFO freshness, batch formation, poisoning fallback, backpressure,
+reprocessing — mirroring network_beacon_processor/tests.rs assertions."""
+
+from lighthouse_tpu.node.beacon_processor import (
+    BeaconProcessor,
+    BeaconProcessorConfig,
+    Work,
+    WorkType,
+)
+
+
+def test_priority_order():
+    bp = BeaconProcessor()
+    log = []
+    for kind in [
+        WorkType.GOSSIP_ATTESTATION,
+        WorkType.CHAIN_SEGMENT,
+        WorkType.GOSSIP_BLOCK,
+        WorkType.API_REQUEST_P1,
+    ]:
+        bp.submit(Work(kind=kind, process_individual=lambda p, k=kind: log.append(k)))
+    while bp.step():
+        pass
+    assert log == [
+        WorkType.CHAIN_SEGMENT,
+        WorkType.GOSSIP_BLOCK,
+        WorkType.GOSSIP_ATTESTATION,
+        WorkType.API_REQUEST_P1,
+    ]
+
+
+def test_attestation_batch_formation_lifo():
+    bp = BeaconProcessor(
+        BeaconProcessorConfig(max_gossip_attestation_batch_size=3)
+    )
+    batches = []
+    for i in range(5):
+        bp.submit(
+            Work(
+                kind=WorkType.GOSSIP_ATTESTATION,
+                payload=i,
+                process_individual=lambda p: batches.append(("ind", p)),
+                process_batch=lambda ps: batches.append(("batch", ps)) or True,
+            )
+        )
+    bp.step()
+    bp.step()
+    # freshest first (LIFO), chunked at 3
+    assert batches == [("batch", [4, 3, 2]), ("batch", [1, 0])]
+
+
+def test_poisoned_batch_falls_back_to_individual():
+    bp = BeaconProcessor()
+    seen = []
+    for i in range(4):
+        bp.submit(
+            Work(
+                kind=WorkType.GOSSIP_ATTESTATION,
+                payload=i,
+                process_individual=lambda p: seen.append(p),
+                process_batch=lambda ps: False,  # poisoned
+            )
+        )
+    bp.step()
+    assert sorted(seen) == [0, 1, 2, 3]
+    assert bp.m_batch_fallbacks.value == 1
+
+
+def test_backpressure_drop_counts():
+    bp = BeaconProcessor(
+        BeaconProcessorConfig(queue_capacities={WorkType.RPC_REQUEST: 2})
+    )
+    ok = [bp.submit(Work(kind=WorkType.RPC_REQUEST, process_individual=lambda p: None)) for _ in range(4)]
+    assert ok == [True, True, False, False]
+    assert bp.m_dropped.value == 2
+    # LIFO queues drop the stale end instead of rejecting
+    bp2 = BeaconProcessor(
+        BeaconProcessorConfig(
+            queue_capacities={WorkType.GOSSIP_ATTESTATION: 2},
+            max_gossip_attestation_batch_size=10,
+        )
+    )
+    got = []
+    for i in range(4):
+        assert bp2.submit(
+            Work(
+                kind=WorkType.GOSSIP_ATTESTATION,
+                payload=i,
+                process_individual=lambda p: got.append(p),
+            )
+        )
+    bp2.step()
+    assert sorted(got) == [2, 3]  # 0 and 1 were dropped as stale
+
+
+def test_reprocessing_queue():
+    bp = BeaconProcessor()
+    log = []
+    bp.submit_delayed(
+        Work(kind=WorkType.DELAYED_IMPORT_BLOCK, process_individual=lambda p: log.append("late")),
+        due_time=100.0,
+    )
+    assert bp.pump_reprocess(now=50.0) == 0
+    assert not bp.step()
+    assert bp.pump_reprocess(now=100.0) == 1
+    assert bp.step()
+    assert log == ["late"]
+
+
+def test_validator_count_scaling():
+    cfg = BeaconProcessorConfig.for_validator_count(500_000)
+    assert cfg.queue_capacities[WorkType.GOSSIP_ATTESTATION] == 500_000 // 32
