@@ -1,0 +1,1304 @@
+"""The spec state-transition function (consensus/state_processing analog).
+
+Covers the reference's `per_slot_processing` (per_slot_processing.rs:28),
+`per_block_processing` (per_block_processing.rs:100 + process_operations.rs),
+and `per_epoch_processing` for the Altair+ participation-flag family —
+the canonical fork shape of `consensus.types` (one Deneb-shaped container
+set, SURVEY.md §2.2).
+
+TPU-first design choice: epoch processing is the validator-set-sized
+"big dimension" (SURVEY.md §5.7), so it runs as ONE vectorized pass over
+numpy arrays mirroring the reference's fused
+`per_epoch_processing/single_pass.rs` — flag tallies, justification,
+inactivity, rewards/penalties, effective-balance hysteresis and slashing
+penalties are all array expressions (batch-offloadable later), never
+per-validator Python loops.
+
+Signature policy mirrors the reference: the transition itself can run
+with signature verification OFF (`verify_signatures=False`) while
+`BlockSignatureVerifier` (consensus/signature_sets.py) collects every
+set of the block for one TPU batch — block_signature_verifier.rs:127-138.
+Randao reveal, deposit signatures and operation signatures each have an
+individual check path for `verify_signatures=True`.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Iterable, Optional
+
+import numpy as np
+
+from ..crypto import bls
+from ..crypto.bls.keys import PublicKey, Signature, SignatureSet
+from . import types as T
+from .domains import compute_domain, compute_signing_root, get_domain
+from .shuffling import compute_committee, compute_shuffled_index
+from .spec import ChainSpec, FAR_FUTURE_EPOCH, GENESIS_EPOCH
+
+# Altair participation flags (participation_flags.rs analog)
+TIMELY_SOURCE_FLAG_INDEX = 0
+TIMELY_TARGET_FLAG_INDEX = 1
+TIMELY_HEAD_FLAG_INDEX = 2
+PARTICIPATION_FLAG_WEIGHTS = [14, 26, 14]  # source, target, head
+SYNC_REWARD_WEIGHT = 2
+PROPOSER_WEIGHT = 8
+WEIGHT_DENOMINATOR = 64
+
+INACTIVITY_SCORE_BIAS = 4
+INACTIVITY_SCORE_RECOVERY_RATE = 16
+# Bellatrix+ values (the canonical container set models the merged chain)
+INACTIVITY_PENALTY_QUOTIENT = 2**24
+MIN_SLASHING_PENALTY_QUOTIENT = 32
+PROPORTIONAL_SLASHING_MULTIPLIER = 3
+
+DEPOSIT_CONTRACT_TREE_DEPTH = 32
+
+
+class BlockProcessingError(Exception):
+    pass
+
+
+def _hash(data: bytes) -> bytes:
+    return hashlib.sha256(data).digest()
+
+
+# ---------------------------------------------------------------- accessors
+
+
+def compute_epoch_at_slot(spec: ChainSpec, slot: int) -> int:
+    return slot // spec.preset.slots_per_epoch
+
+
+def compute_start_slot_at_epoch(spec: ChainSpec, epoch: int) -> int:
+    return epoch * spec.preset.slots_per_epoch
+
+
+def get_current_epoch(spec: ChainSpec, state) -> int:
+    return compute_epoch_at_slot(spec, state.slot)
+
+
+def get_previous_epoch(spec: ChainSpec, state) -> int:
+    cur = get_current_epoch(spec, state)
+    return cur - 1 if cur > GENESIS_EPOCH else GENESIS_EPOCH
+
+
+def is_active_validator(v, epoch: int) -> bool:
+    return v.activation_epoch <= epoch < v.exit_epoch
+
+
+def get_active_validator_indices(state, epoch: int) -> list:
+    return [
+        i for i, v in enumerate(state.validators) if is_active_validator(v, epoch)
+    ]
+
+
+def get_randao_mix(spec: ChainSpec, state, epoch: int) -> bytes:
+    return state.randao_mixes[epoch % spec.preset.epochs_per_historical_vector]
+
+
+def get_seed(spec: ChainSpec, state, epoch: int, domain_type: bytes) -> bytes:
+    mix = get_randao_mix(
+        spec,
+        state,
+        epoch
+        + spec.preset.epochs_per_historical_vector
+        - spec.min_seed_lookahead
+        - 1,
+    )
+    return _hash(domain_type + epoch.to_bytes(8, "little") + mix)
+
+
+def get_total_balance(spec: ChainSpec, state, indices: Iterable[int]) -> int:
+    total = sum(state.validators[i].effective_balance for i in indices)
+    return max(spec.effective_balance_increment, total)
+
+
+def get_total_active_balance(spec: ChainSpec, state) -> int:
+    return get_total_balance(
+        spec, state, get_active_validator_indices(state, get_current_epoch(spec, state))
+    )
+
+
+def get_validator_churn_limit(spec: ChainSpec, state) -> int:
+    active = len(get_active_validator_indices(state, get_current_epoch(spec, state)))
+    return max(
+        spec.min_per_epoch_churn_limit, active // spec.churn_limit_quotient
+    )
+
+
+def increase_balance(state, index: int, delta: int) -> None:
+    state.balances[index] += delta
+
+
+def decrease_balance(state, index: int, delta: int) -> None:
+    state.balances[index] = max(0, state.balances[index] - delta)
+
+
+# ---------------------------------------------------------------- committees
+
+
+def get_committee_count_per_slot(spec: ChainSpec, state, epoch: int) -> int:
+    active = len(get_active_validator_indices(state, epoch))
+    p = spec.preset
+    return max(
+        1,
+        min(
+            p.max_committees_per_slot,
+            active // p.slots_per_epoch // p.target_committee_size,
+        ),
+    )
+
+
+def get_beacon_committee(spec: ChainSpec, state, slot: int, index: int) -> list:
+    epoch = compute_epoch_at_slot(spec, slot)
+    per_slot = get_committee_count_per_slot(spec, state, epoch)
+    indices = get_active_validator_indices(state, epoch)
+    seed = get_seed(spec, state, epoch, spec.domain_beacon_attester)
+    return compute_committee(
+        indices,
+        seed,
+        (slot % spec.preset.slots_per_epoch) * per_slot + index,
+        per_slot * spec.preset.slots_per_epoch,
+        spec.preset.shuffle_round_count,
+    )
+
+
+def compute_proposer_index(
+    spec: ChainSpec, state, indices: list, seed: bytes
+) -> int:
+    """Effective-balance-weighted rejection sampling over the shuffled
+    active set (beacon_state.rs get_beacon_proposer_index path)."""
+    assert indices
+    max_byte = 255
+    i = 0
+    total = len(indices)
+    while True:
+        shuffled = compute_shuffled_index(
+            i % total, total, seed, spec.preset.shuffle_round_count
+        )
+        candidate = indices[shuffled]
+        rand_byte = _hash(seed + (i // 32).to_bytes(8, "little"))[i % 32]
+        eff = state.validators[candidate].effective_balance
+        if eff * max_byte >= spec.max_effective_balance * rand_byte:
+            return candidate
+        i += 1
+
+
+def get_beacon_proposer_index(spec: ChainSpec, state) -> int:
+    epoch = get_current_epoch(spec, state)
+    seed = _hash(
+        get_seed(spec, state, epoch, spec.domain_beacon_proposer)
+        + state.slot.to_bytes(8, "little")
+    )
+    return compute_proposer_index(
+        spec, state, get_active_validator_indices(state, epoch), seed
+    )
+
+
+def get_next_sync_committee_indices(spec: ChainSpec, state) -> list:
+    """Seeded, balance-weighted sampling WITH replacement
+    (sync_committee.rs get_next_sync_committee)."""
+    epoch = get_current_epoch(spec, state) + 1
+    indices = get_active_validator_indices(state, epoch)
+    seed = get_seed(spec, state, epoch, spec.domain_sync_committee)
+    total = len(indices)
+    out = []
+    i = 0
+    while len(out) < spec.preset.sync_committee_size:
+        shuffled = compute_shuffled_index(
+            i % total, total, seed, spec.preset.shuffle_round_count
+        )
+        candidate = indices[shuffled]
+        rand_byte = _hash(seed + (i // 32).to_bytes(8, "little"))[i % 32]
+        eff = state.validators[candidate].effective_balance
+        if eff * 255 >= spec.max_effective_balance * rand_byte:
+            out.append(candidate)
+        i += 1
+    return out
+
+
+def get_next_sync_committee(spec: ChainSpec, state):
+    indices = get_next_sync_committee_indices(spec, state)
+    pubkeys = [bytes(state.validators[i].pubkey) for i in indices]
+    # sampling is WITH replacement: decompress each distinct key once
+    uniq = {pk: PublicKey.from_bytes(pk).point for pk in set(pubkeys)}
+    agg = None
+    from ..crypto.bls import curve as C
+
+    for pk in pubkeys:
+        agg = C.g1_add(agg, uniq[pk])
+    agg_bytes = PublicKey(agg).to_bytes() if agg is not None else b"\xc0" + b"\x00" * 47
+    return T.SyncCommittee.make(pubkeys=pubkeys, aggregate_pubkey=agg_bytes)
+
+
+# ---------------------------------------------------------------- mutators
+
+
+def initiate_validator_exit(spec: ChainSpec, state, index: int) -> None:
+    v = state.validators[index]
+    if v.exit_epoch != FAR_FUTURE_EPOCH:
+        return
+    exit_epochs = [
+        w.exit_epoch
+        for w in state.validators
+        if w.exit_epoch != FAR_FUTURE_EPOCH
+    ]
+    activation_exit = get_current_epoch(spec, state) + 1 + spec.max_seed_lookahead
+    exit_queue_epoch = max(exit_epochs + [activation_exit])
+    churn = len(
+        [w for w in state.validators if w.exit_epoch == exit_queue_epoch]
+    )
+    if churn >= get_validator_churn_limit(spec, state):
+        exit_queue_epoch += 1
+    v.exit_epoch = exit_queue_epoch
+    v.withdrawable_epoch = (
+        exit_queue_epoch + spec.min_validator_withdrawability_delay
+    )
+
+
+def slash_validator(
+    spec: ChainSpec, state, index: int, whistleblower_index: Optional[int] = None
+) -> None:
+    epoch = get_current_epoch(spec, state)
+    initiate_validator_exit(spec, state, index)
+    v = state.validators[index]
+    v.slashed = True
+    v.withdrawable_epoch = max(
+        v.withdrawable_epoch, epoch + spec.preset.epochs_per_slashings_vector
+    )
+    state.slashings[epoch % spec.preset.epochs_per_slashings_vector] += (
+        v.effective_balance
+    )
+    decrease_balance(
+        state, index, v.effective_balance // MIN_SLASHING_PENALTY_QUOTIENT
+    )
+    proposer_index = get_beacon_proposer_index(spec, state)
+    if whistleblower_index is None:
+        whistleblower_index = proposer_index
+    whistleblower_reward = (
+        v.effective_balance // spec.whistleblower_reward_quotient
+    )
+    proposer_reward = (
+        whistleblower_reward * PROPOSER_WEIGHT // WEIGHT_DENOMINATOR
+    )
+    increase_balance(state, proposer_index, proposer_reward)
+    increase_balance(
+        state, whistleblower_index, whistleblower_reward - proposer_reward
+    )
+
+
+# ---------------------------------------------------------------- slots
+
+
+def process_slots(spec: ChainSpec, state, slot: int) -> None:
+    """per_slot_processing.rs:28: advance state to `slot`, running epoch
+    processing at each epoch boundary."""
+    if state.slot >= slot:
+        raise BlockProcessingError("state is ahead of target slot")
+    while state.slot < slot:
+        _process_slot(spec, state)
+        if (state.slot + 1) % spec.preset.slots_per_epoch == 0:
+            process_epoch(spec, state)
+        state.slot += 1
+
+
+def _process_slot(spec: ChainSpec, state) -> None:
+    previous_state_root = state.hash_tree_root()
+    state.state_roots[state.slot % spec.preset.slots_per_historical_root] = (
+        previous_state_root
+    )
+    if state.latest_block_header.state_root == b"\x00" * 32:
+        state.latest_block_header.state_root = previous_state_root
+    previous_block_root = state.latest_block_header.hash_tree_root()
+    state.block_roots[state.slot % spec.preset.slots_per_historical_root] = (
+        previous_block_root
+    )
+
+
+def get_block_root_at_slot(spec: ChainSpec, state, slot: int) -> bytes:
+    if not (
+        slot < state.slot
+        and state.slot <= slot + spec.preset.slots_per_historical_root
+    ):
+        raise BlockProcessingError("slot out of block-root range")
+    return state.block_roots[slot % spec.preset.slots_per_historical_root]
+
+
+def get_block_root(spec: ChainSpec, state, epoch: int) -> bytes:
+    return get_block_root_at_slot(
+        spec, state, compute_start_slot_at_epoch(spec, epoch)
+    )
+
+
+# ---------------------------------------------------------------- block
+
+
+def state_transition(
+    spec: ChainSpec, state, signed_block, verify_signatures: bool = True
+) -> None:
+    """Full transition: slots -> block -> state-root check
+    (per_block_processing.rs:100 entry semantics)."""
+    block = signed_block.message
+    if state.slot < block.slot:
+        process_slots(spec, state, block.slot)
+    if verify_signatures:
+        from .signature_sets import block_proposal_signature_set
+
+        s = block_proposal_signature_set(
+            spec,
+            _pubkey_getter(state),
+            signed_block,
+            state.fork,
+            state.genesis_validators_root,
+        )
+        if not bls.verify_signature_sets([s]):
+            raise BlockProcessingError("invalid block signature")
+    process_block(spec, state, block, verify_signatures=verify_signatures)
+    if bytes(block.state_root) != state.hash_tree_root():
+        raise BlockProcessingError("state root mismatch")
+
+
+def _pubkey_getter(state):
+    cache = {}
+
+    def get_pubkey(index: int) -> PublicKey:
+        if index not in cache:
+            cache[index] = PublicKey.from_bytes(
+                bytes(state.validators[index].pubkey)
+            )
+        return cache[index]
+
+    return get_pubkey
+
+
+def process_block(
+    spec: ChainSpec, state, block, verify_signatures: bool = True
+) -> None:
+    process_block_header(spec, state, block)
+    process_randao(spec, state, block, verify_signatures)
+    process_eth1_data(spec, state, block.body)
+    process_operations(spec, state, block.body, verify_signatures)
+    process_sync_aggregate(spec, state, block.body.sync_aggregate, verify_signatures)
+
+
+def process_block_header(spec: ChainSpec, state, block) -> None:
+    if block.slot != state.slot:
+        raise BlockProcessingError("block/state slot mismatch")
+    if block.slot <= state.latest_block_header.slot:
+        raise BlockProcessingError("block older than latest header")
+    if block.proposer_index != get_beacon_proposer_index(spec, state):
+        raise BlockProcessingError("wrong proposer")
+    if bytes(block.parent_root) != state.latest_block_header.hash_tree_root():
+        raise BlockProcessingError("parent root mismatch")
+    state.latest_block_header = T.BeaconBlockHeader.make(
+        slot=block.slot,
+        proposer_index=block.proposer_index,
+        parent_root=bytes(block.parent_root),
+        state_root=b"\x00" * 32,
+        body_root=block.body.hash_tree_root(),
+    )
+    proposer = state.validators[block.proposer_index]
+    if proposer.slashed:
+        raise BlockProcessingError("proposer slashed")
+
+
+def process_randao(spec: ChainSpec, state, block, verify_signatures: bool) -> None:
+    epoch = get_current_epoch(spec, state)
+    body = block.body
+    if verify_signatures:
+        from .signature_sets import randao_signature_set
+
+        s = randao_signature_set(
+            spec,
+            _pubkey_getter(state),
+            block,
+            state.fork,
+            state.genesis_validators_root,
+        )
+        if not bls.verify_signature_sets([s]):
+            raise BlockProcessingError("invalid randao reveal")
+    mix = bytes(
+        a ^ b
+        for a, b in zip(
+            get_randao_mix(spec, state, epoch), _hash(bytes(body.randao_reveal))
+        )
+    )
+    state.randao_mixes[epoch % spec.preset.epochs_per_historical_vector] = mix
+
+
+def process_eth1_data(spec: ChainSpec, state, body) -> None:
+    state.eth1_data_votes = list(state.eth1_data_votes) + [body.eth1_data]
+    period_slots = (
+        spec.preset.epochs_per_eth1_voting_period * spec.preset.slots_per_epoch
+    )
+    votes = [
+        v for v in state.eth1_data_votes if v == body.eth1_data
+    ]
+    if len(votes) * 2 > period_slots:
+        state.eth1_data = body.eth1_data
+
+
+class BlockContext:
+    """Per-block caches for values that are constant across a block's
+    operations (the reference's ConsensusContext role): proposer index,
+    base reward per increment, pubkey->index map. All lazy."""
+
+    def __init__(self, spec: ChainSpec, state):
+        self.spec = spec
+        self.state = state
+        self._proposer = None
+        self._brpi = None
+        self._pk_index = None
+
+    def proposer_index(self) -> int:
+        if self._proposer is None:
+            self._proposer = get_beacon_proposer_index(self.spec, self.state)
+        return self._proposer
+
+    def base_reward_per_increment(self) -> int:
+        if self._brpi is None:
+            self._brpi = get_base_reward_per_increment(self.spec, self.state)
+        return self._brpi
+
+    def pubkey_index(self, pubkey: bytes) -> Optional[int]:
+        if self._pk_index is None:
+            self._pk_index = {
+                bytes(v.pubkey): i for i, v in enumerate(self.state.validators)
+            }
+        return self._pk_index.get(pubkey)
+
+    def register_new_validator(self, pubkey: bytes, index: int) -> None:
+        if self._pk_index is not None:
+            self._pk_index[pubkey] = index
+
+
+def process_operations(
+    spec: ChainSpec, state, body, verify_signatures: bool, ctx=None
+) -> None:
+    ctx = ctx or BlockContext(spec, state)
+    expected_deposits = min(
+        spec.preset.max_deposits,
+        state.eth1_data.deposit_count - state.eth1_deposit_index,
+    )
+    if len(body.deposits) != expected_deposits:
+        raise BlockProcessingError("wrong deposit count")
+    for op in body.proposer_slashings:
+        process_proposer_slashing(spec, state, op, verify_signatures)
+    for op in body.attester_slashings:
+        process_attester_slashing(spec, state, op, verify_signatures)
+    for op in body.attestations:
+        process_attestation(spec, state, op, verify_signatures, ctx=ctx)
+    for op in body.deposits:
+        process_deposit(spec, state, op, ctx=ctx)
+    for op in body.voluntary_exits:
+        process_voluntary_exit(spec, state, op, verify_signatures)
+    for op in body.bls_to_execution_changes:
+        process_bls_to_execution_change(spec, state, op, verify_signatures)
+
+
+def is_slashable_validator(v, epoch: int) -> bool:
+    return (not v.slashed) and (
+        v.activation_epoch <= epoch < v.withdrawable_epoch
+    )
+
+
+def process_proposer_slashing(
+    spec: ChainSpec, state, slashing, verify_signatures: bool
+) -> None:
+    h1 = slashing.signed_header_1.message
+    h2 = slashing.signed_header_2.message
+    if h1.slot != h2.slot:
+        raise BlockProcessingError("slashing headers differ in slot")
+    if h1.proposer_index != h2.proposer_index:
+        raise BlockProcessingError("slashing headers differ in proposer")
+    if h1.hash_tree_root() == h2.hash_tree_root():
+        raise BlockProcessingError("slashing headers identical")
+    proposer = state.validators[h1.proposer_index]
+    if not is_slashable_validator(proposer, get_current_epoch(spec, state)):
+        raise BlockProcessingError("proposer not slashable")
+    if verify_signatures:
+        from .signature_sets import proposer_slashing_signature_sets
+
+        sets = proposer_slashing_signature_sets(
+            spec,
+            _pubkey_getter(state),
+            slashing,
+            state.fork,
+            state.genesis_validators_root,
+        )
+        if not bls.verify_signature_sets(sets):
+            raise BlockProcessingError("invalid slashing signatures")
+    slash_validator(spec, state, h1.proposer_index)
+
+
+def is_slashable_attestation_data(d1, d2) -> bool:
+    double = (
+        d1.hash_tree_root() != d2.hash_tree_root()
+        and d1.target.epoch == d2.target.epoch
+    )
+    surround = (
+        d1.source.epoch < d2.source.epoch and d2.target.epoch < d1.target.epoch
+    )
+    return double or surround
+
+
+def process_attester_slashing(
+    spec: ChainSpec, state, slashing, verify_signatures: bool
+) -> None:
+    a1, a2 = slashing.attestation_1, slashing.attestation_2
+    if not is_slashable_attestation_data(a1.data, a2.data):
+        raise BlockProcessingError("attestations not slashable")
+    for a in (a1, a2):
+        if not _is_valid_indexed_attestation(spec, state, a, verify_signatures):
+            raise BlockProcessingError("invalid indexed attestation")
+    slashed_any = False
+    epoch = get_current_epoch(spec, state)
+    common = sorted(
+        set(a1.attesting_indices) & set(a2.attesting_indices)
+    )
+    for index in common:
+        if is_slashable_validator(state.validators[index], epoch):
+            slash_validator(spec, state, index)
+            slashed_any = True
+    if not slashed_any:
+        raise BlockProcessingError("no one slashed")
+
+
+def _is_valid_indexed_attestation(
+    spec: ChainSpec, state, indexed, verify_signatures: bool
+) -> bool:
+    idx = list(indexed.attesting_indices)
+    if not idx or idx != sorted(set(idx)):
+        return False
+    if verify_signatures:
+        from .signature_sets import indexed_attestation_signature_set
+
+        s = indexed_attestation_signature_set(
+            spec,
+            _pubkey_getter(state),
+            indexed,
+            state.fork,
+            state.genesis_validators_root,
+        )
+        return bls.verify_signature_sets([s])
+    return True
+
+
+def get_attestation_participation_flag_indices(
+    spec: ChainSpec, state, data, inclusion_delay: int
+) -> list:
+    justified = (
+        state.current_justified_checkpoint
+        if data.target.epoch == get_current_epoch(spec, state)
+        else state.previous_justified_checkpoint
+    )
+    is_matching_source = (
+        data.source.epoch == justified.epoch
+        and bytes(data.source.root) == bytes(justified.root)
+    )
+    if not is_matching_source:
+        raise BlockProcessingError("source checkpoint mismatch")
+    is_matching_target = bytes(data.target.root) == get_block_root(
+        spec, state, data.target.epoch
+    )
+    is_matching_head = is_matching_target and bytes(
+        data.beacon_block_root
+    ) == get_block_root_at_slot(spec, state, data.slot)
+    flags = []
+    sqrt_epoch = _integer_sqrt(spec.preset.slots_per_epoch)
+    if is_matching_source and inclusion_delay <= sqrt_epoch:
+        flags.append(TIMELY_SOURCE_FLAG_INDEX)
+    if is_matching_target and inclusion_delay <= spec.preset.slots_per_epoch:
+        flags.append(TIMELY_TARGET_FLAG_INDEX)
+    if is_matching_head and inclusion_delay == spec.min_attestation_inclusion_delay:
+        flags.append(TIMELY_HEAD_FLAG_INDEX)
+    return flags
+
+
+def _integer_sqrt(n: int) -> int:
+    # exact integer sqrt: float sqrt is off-by-one above 2^52, which
+    # would skew every base reward at mainnet balance scale
+    import math
+
+    return math.isqrt(n)
+
+
+def get_base_reward_per_increment(spec: ChainSpec, state) -> int:
+    return (
+        spec.effective_balance_increment
+        * spec.base_reward_factor
+        // _integer_sqrt(get_total_active_balance(spec, state))
+    )
+
+
+def get_base_reward(spec: ChainSpec, state, index: int) -> int:
+    increments = (
+        state.validators[index].effective_balance
+        // spec.effective_balance_increment
+    )
+    return increments * get_base_reward_per_increment(spec, state)
+
+
+def get_attesting_indices(spec: ChainSpec, state, attestation) -> set:
+    committee = get_beacon_committee(
+        spec, state, attestation.data.slot, attestation.data.index
+    )
+    bits = attestation.aggregation_bits
+    if len(bits) != len(committee):
+        raise BlockProcessingError("aggregation bits length mismatch")
+    return {committee[i] for i, b in enumerate(bits) if b}
+
+
+def process_attestation(
+    spec: ChainSpec, state, attestation, verify_signatures: bool, ctx=None
+) -> None:
+    ctx = ctx or BlockContext(spec, state)
+    data = attestation.data
+    cur = get_current_epoch(spec, state)
+    prev = get_previous_epoch(spec, state)
+    if data.target.epoch not in (cur, prev):
+        raise BlockProcessingError("attestation target epoch out of range")
+    if data.target.epoch != compute_epoch_at_slot(spec, data.slot):
+        raise BlockProcessingError("target epoch != slot epoch")
+    if not (
+        data.slot + spec.min_attestation_inclusion_delay <= state.slot
+    ):
+        raise BlockProcessingError("attestation too fresh")
+    if data.index >= get_committee_count_per_slot(spec, state, data.target.epoch):
+        raise BlockProcessingError("committee index out of range")
+
+    inclusion_delay = state.slot - data.slot
+    flag_indices = get_attestation_participation_flag_indices(
+        spec, state, data, inclusion_delay
+    )
+    attesting = get_attesting_indices(spec, state, attestation)
+    if verify_signatures:
+        indexed = T.IndexedAttestation.make(
+            attesting_indices=sorted(attesting),
+            data=data,
+            signature=bytes(attestation.signature),
+        )
+        if not _is_valid_indexed_attestation(spec, state, indexed, True):
+            raise BlockProcessingError("invalid attestation signature")
+
+    participation = (
+        state.current_epoch_participation
+        if data.target.epoch == cur
+        else state.previous_epoch_participation
+    )
+    base_reward_per_inc = ctx.base_reward_per_increment()
+    proposer_reward_numerator = 0
+    for index in attesting:
+        increments = (
+            state.validators[index].effective_balance
+            // spec.effective_balance_increment
+        )
+        base_reward = increments * base_reward_per_inc
+        for flag_index, weight in enumerate(PARTICIPATION_FLAG_WEIGHTS):
+            if flag_index in flag_indices and not (
+                participation[index] & (1 << flag_index)
+            ):
+                participation[index] |= 1 << flag_index
+                proposer_reward_numerator += base_reward * weight
+    proposer_reward_denominator = (
+        (WEIGHT_DENOMINATOR - PROPOSER_WEIGHT)
+        * WEIGHT_DENOMINATOR
+        // PROPOSER_WEIGHT
+    )
+    increase_balance(
+        state,
+        ctx.proposer_index(),
+        proposer_reward_numerator // proposer_reward_denominator,
+    )
+
+
+def is_valid_merkle_branch(
+    leaf: bytes, branch, depth: int, index: int, root: bytes
+) -> bool:
+    value = leaf
+    for i in range(depth):
+        if (index >> i) & 1:
+            value = _hash(bytes(branch[i]) + value)
+        else:
+            value = _hash(value + bytes(branch[i]))
+    return value == bytes(root)
+
+
+def process_deposit(spec: ChainSpec, state, deposit, ctx=None) -> None:
+    if not is_valid_merkle_branch(
+        deposit.data.hash_tree_root(),
+        deposit.proof,
+        DEPOSIT_CONTRACT_TREE_DEPTH + 1,  # +1 for the length mix-in
+        state.eth1_deposit_index,
+        state.eth1_data.deposit_root,
+    ):
+        raise BlockProcessingError("bad deposit proof")
+    state.eth1_deposit_index += 1
+    apply_deposit(
+        spec,
+        state,
+        bytes(deposit.data.pubkey),
+        bytes(deposit.data.withdrawal_credentials),
+        deposit.data.amount,
+        bytes(deposit.data.signature),
+        ctx=ctx,
+    )
+
+
+def apply_deposit(
+    spec: ChainSpec,
+    state,
+    pubkey: bytes,
+    withdrawal_credentials: bytes,
+    amount: int,
+    signature: bytes,
+    ctx=None,
+) -> None:
+    ctx = ctx or BlockContext(spec, state)
+    existing = ctx.pubkey_index(pubkey)
+    if existing is None:
+        # new validator: deposit signature must verify (its own domain,
+        # genesis fork, NO genesis_validators_root) or it is skipped
+        deposit_message = T.DepositMessage.make(
+            pubkey=pubkey,
+            withdrawal_credentials=withdrawal_credentials,
+            amount=amount,
+        )
+        domain = compute_domain(
+            spec.domain_deposit, spec.genesis_fork_version, b"\x00" * 32
+        )
+        signing_root = compute_signing_root(deposit_message, domain)
+        try:
+            pk = PublicKey.from_bytes(pubkey)
+            sig = Signature.from_bytes(signature)
+        except Exception:
+            return
+        if not bls.verify(sig, pk, signing_root):
+            return
+        index = len(state.validators)
+        state.validators.append(
+            _validator_from_deposit(spec, pubkey, withdrawal_credentials, amount)
+        )
+        state.balances.append(amount)
+        state.previous_epoch_participation.append(0)
+        state.current_epoch_participation.append(0)
+        state.inactivity_scores.append(0)
+        ctx.register_new_validator(pubkey, index)
+    else:
+        increase_balance(state, existing, amount)
+
+
+def _validator_from_deposit(
+    spec: ChainSpec, pubkey: bytes, withdrawal_credentials: bytes, amount: int
+):
+    effective = min(
+        amount - amount % spec.effective_balance_increment,
+        spec.max_effective_balance,
+    )
+    return T.Validator.make(
+        pubkey=pubkey,
+        withdrawal_credentials=withdrawal_credentials,
+        effective_balance=effective,
+        slashed=False,
+        activation_eligibility_epoch=FAR_FUTURE_EPOCH,
+        activation_epoch=FAR_FUTURE_EPOCH,
+        exit_epoch=FAR_FUTURE_EPOCH,
+        withdrawable_epoch=FAR_FUTURE_EPOCH,
+    )
+
+
+def process_voluntary_exit(
+    spec: ChainSpec, state, signed_exit, verify_signatures: bool
+) -> None:
+    exit_msg = signed_exit.message
+    v = state.validators[exit_msg.validator_index]
+    cur = get_current_epoch(spec, state)
+    if not is_active_validator(v, cur):
+        raise BlockProcessingError("exiting validator not active")
+    if v.exit_epoch != FAR_FUTURE_EPOCH:
+        raise BlockProcessingError("exit already initiated")
+    if cur < exit_msg.epoch:
+        raise BlockProcessingError("exit not yet valid")
+    if cur < v.activation_epoch + spec.shard_committee_period:
+        raise BlockProcessingError("validator too young to exit")
+    if verify_signatures:
+        from .signature_sets import exit_signature_set
+
+        s = exit_signature_set(
+            spec,
+            _pubkey_getter(state),
+            signed_exit,
+            state.fork,
+            state.genesis_validators_root,
+        )
+        if not bls.verify_signature_sets([s]):
+            raise BlockProcessingError("invalid exit signature")
+    initiate_validator_exit(spec, state, exit_msg.validator_index)
+
+
+def process_bls_to_execution_change(
+    spec: ChainSpec, state, signed_change, verify_signatures: bool
+) -> None:
+    change = signed_change.message
+    v = state.validators[change.validator_index]
+    wc = bytes(v.withdrawal_credentials)
+    if wc[:1] != b"\x00":
+        raise BlockProcessingError("not a BLS withdrawal credential")
+    if wc[1:] != _hash(bytes(change.from_bls_pubkey))[1:]:
+        raise BlockProcessingError("withdrawal credential mismatch")
+    if verify_signatures:
+        from .signature_sets import bls_execution_change_signature_set
+
+        s = bls_execution_change_signature_set(
+            spec, signed_change, state.genesis_validators_root
+        )
+        if not bls.verify_signature_sets([s]):
+            raise BlockProcessingError("invalid bls-change signature")
+    v.withdrawal_credentials = (
+        b"\x01" + b"\x00" * 11 + bytes(change.to_execution_address)
+    )
+
+
+def process_sync_aggregate(
+    spec: ChainSpec, state, aggregate, verify_signatures: bool
+) -> None:
+    committee_pubkeys = list(state.current_sync_committee.pubkeys)
+    participant_pubkeys = [
+        pk
+        for pk, bit in zip(committee_pubkeys, aggregate.sync_committee_bits)
+        if bit
+    ]
+    if verify_signatures:
+        from .signature_sets import sync_aggregate_signature_set
+
+        prev_slot = max(state.slot - 1, 0)
+        s = sync_aggregate_signature_set(
+            spec,
+            [PublicKey.from_bytes(bytes(pk)) for pk in participant_pubkeys],
+            aggregate,
+            state.slot,
+            get_block_root_at_slot(spec, state, prev_slot),
+            state.fork,
+            state.genesis_validators_root,
+        )
+        if s is not None and not bls.verify_signature_sets([s]):
+            raise BlockProcessingError("invalid sync aggregate signature")
+
+    total_active_increments = (
+        get_total_active_balance(spec, state) // spec.effective_balance_increment
+    )
+    base_reward_per_inc = get_base_reward_per_increment(spec, state)
+    total_base_rewards = base_reward_per_inc * total_active_increments
+    max_participant_rewards = (
+        total_base_rewards
+        * SYNC_REWARD_WEIGHT
+        // WEIGHT_DENOMINATOR
+        // spec.preset.slots_per_epoch
+    )
+    participant_reward = max_participant_rewards // spec.preset.sync_committee_size
+    proposer_reward = (
+        participant_reward
+        * PROPOSER_WEIGHT
+        // (WEIGHT_DENOMINATOR - PROPOSER_WEIGHT)
+    )
+    pubkey_to_index = {bytes(v.pubkey): i for i, v in enumerate(state.validators)}
+    proposer_index = get_beacon_proposer_index(spec, state)
+    for pk, bit in zip(committee_pubkeys, aggregate.sync_committee_bits):
+        index = pubkey_to_index[bytes(pk)]
+        if bit:
+            increase_balance(state, index, participant_reward)
+            increase_balance(state, proposer_index, proposer_reward)
+        else:
+            decrease_balance(state, index, participant_reward)
+
+
+# ---------------------------------------------------------------- epoch
+#
+# One vectorized pass (single_pass.rs analog): arrays in, arrays out.
+
+
+def _epoch_arrays(state):
+    """Extract the per-validator columns once."""
+    n = len(state.validators)
+    eff = np.fromiter(
+        (v.effective_balance for v in state.validators), np.uint64, n
+    )
+    slashed = np.fromiter((v.slashed for v in state.validators), np.bool_, n)
+    act = np.fromiter(
+        (min(v.activation_epoch, 2**62) for v in state.validators), np.int64, n
+    )
+    exit_e = np.fromiter(
+        (min(v.exit_epoch, 2**62) for v in state.validators), np.int64, n
+    )
+    withdrawable = np.fromiter(
+        (min(v.withdrawable_epoch, 2**62) for v in state.validators), np.int64, n
+    )
+    prev_part = np.fromiter(state.previous_epoch_participation, np.uint8, n)
+    cur_part = np.fromiter(state.current_epoch_participation, np.uint8, n)
+    return eff, slashed, act, exit_e, withdrawable, prev_part, cur_part
+
+
+def process_epoch(spec: ChainSpec, state) -> None:
+    (
+        eff,
+        slashed,
+        act,
+        exit_e,
+        withdrawable,
+        prev_part,
+        cur_part,
+    ) = _epoch_arrays(state)
+    cur = get_current_epoch(spec, state)
+    prev = get_previous_epoch(spec, state)
+    active_cur = (act <= cur) & (cur < exit_e)
+    active_prev = (act <= prev) & (prev < exit_e)
+
+    total_active = max(
+        int(eff[active_cur].sum()), spec.effective_balance_increment
+    )
+
+    # participating (unslashed) balances per flag, previous epoch
+    unslashed_prev = active_prev & ~slashed
+    flag_balances_prev = [
+        int(eff[unslashed_prev & ((prev_part & (1 << f)) != 0)].sum())
+        for f in range(3)
+    ]
+    unslashed_cur = active_cur & ~slashed
+    target_balance_cur = int(
+        eff[unslashed_cur & ((cur_part & (1 << TIMELY_TARGET_FLAG_INDEX)) != 0)].sum()
+    )
+
+    process_justification_and_finalization(
+        spec,
+        state,
+        total_active,
+        flag_balances_prev[TIMELY_TARGET_FLAG_INDEX],
+        target_balance_cur,
+    )
+    process_inactivity_updates(spec, state, unslashed_prev, prev_part, active_prev)
+    process_rewards_and_penalties(
+        spec,
+        state,
+        eff,
+        active_prev,
+        unslashed_prev,
+        prev_part,
+        flag_balances_prev,
+        total_active,
+    )
+    process_registry_updates(spec, state)
+    process_slashings_epoch(spec, state, total_active)
+    process_eth1_data_reset(spec, state)
+    process_effective_balance_updates(spec, state)
+    process_slashings_reset(spec, state)
+    process_randao_mixes_reset(spec, state)
+    process_historical_roots_update(spec, state)
+    process_participation_flag_updates(state)
+    process_sync_committee_updates(spec, state)
+
+
+def process_justification_and_finalization(
+    spec: ChainSpec,
+    state,
+    total_active: int,
+    prev_target_balance: int,
+    cur_target_balance: int,
+) -> None:
+    cur = get_current_epoch(spec, state)
+    if cur <= GENESIS_EPOCH + 1:
+        return
+    prev = get_previous_epoch(spec, state)
+    old_prev_justified = state.previous_justified_checkpoint
+    old_cur_justified = state.current_justified_checkpoint
+
+    state.previous_justified_checkpoint = state.current_justified_checkpoint
+    bits = list(state.justification_bits)
+    bits = [False] + bits[:3]
+    if prev_target_balance * 3 >= total_active * 2:
+        state.current_justified_checkpoint = T.Checkpoint.make(
+            epoch=prev, root=get_block_root(spec, state, prev)
+        )
+        bits[1] = True
+    if cur_target_balance * 3 >= total_active * 2:
+        state.current_justified_checkpoint = T.Checkpoint.make(
+            epoch=cur, root=get_block_root(spec, state, cur)
+        )
+        bits[0] = True
+    state.justification_bits = bits
+
+    # finalization rules (2nd/4th cases use the pre-update checkpoints)
+    if all(bits[1:4]) and old_prev_justified.epoch + 3 == cur:
+        state.finalized_checkpoint = old_prev_justified
+    if all(bits[1:3]) and old_prev_justified.epoch + 2 == cur:
+        state.finalized_checkpoint = old_prev_justified
+    if all(bits[0:3]) and old_cur_justified.epoch + 2 == cur:
+        state.finalized_checkpoint = old_cur_justified
+    if all(bits[0:2]) and old_cur_justified.epoch + 1 == cur:
+        state.finalized_checkpoint = old_cur_justified
+
+
+def is_in_inactivity_leak(spec: ChainSpec, state) -> bool:
+    return (
+        get_previous_epoch(spec, state) - state.finalized_checkpoint.epoch
+        > spec.min_epochs_to_inactivity_penalty
+    )
+
+
+def process_inactivity_updates(
+    spec: ChainSpec, state, unslashed_prev, prev_part, active_prev
+) -> None:
+    if get_current_epoch(spec, state) == GENESIS_EPOCH:
+        return
+    scores = np.fromiter(
+        state.inactivity_scores, np.uint64, len(state.inactivity_scores)
+    ).astype(np.int64)
+    participated_target = unslashed_prev & (
+        (prev_part & (1 << TIMELY_TARGET_FLAG_INDEX)) != 0
+    )
+    eligible = active_prev | (
+        np.fromiter(
+            (v.slashed for v in state.validators), np.bool_, len(state.validators)
+        )
+        & (
+            get_previous_epoch(spec, state) + 1
+            < np.fromiter(
+                (min(v.withdrawable_epoch, 2**62) for v in state.validators),
+                np.int64,
+                len(state.validators),
+            )
+        )
+    )
+    delta = np.where(participated_target, -np.minimum(1, scores), INACTIVITY_SCORE_BIAS)
+    scores = np.where(eligible, scores + delta, scores)
+    if not is_in_inactivity_leak(spec, state):
+        scores = np.where(
+            eligible,
+            scores - np.minimum(INACTIVITY_SCORE_RECOVERY_RATE, scores),
+            scores,
+        )
+    state.inactivity_scores = [int(s) for s in scores]
+
+
+def process_rewards_and_penalties(
+    spec: ChainSpec,
+    state,
+    eff,
+    active_prev,
+    unslashed_prev,
+    prev_part,
+    flag_balances_prev,
+    total_active: int,
+) -> None:
+    if get_current_epoch(spec, state) == GENESIS_EPOCH:
+        return
+    n = len(state.validators)
+    balances = np.fromiter(state.balances, np.int64, n)
+    base_reward_per_inc = (
+        spec.effective_balance_increment
+        * spec.base_reward_factor
+        // _integer_sqrt(total_active)
+    )
+    increments = (eff // spec.effective_balance_increment).astype(np.int64)
+    base_rewards = increments * base_reward_per_inc
+    total_active_increments = total_active // spec.effective_balance_increment
+
+    # eligibility: active prev epoch, or slashed and not yet withdrawable
+    withdrawable = np.fromiter(
+        (min(v.withdrawable_epoch, 2**62) for v in state.validators), np.int64, n
+    )
+    slashed = np.fromiter((v.slashed for v in state.validators), np.bool_, n)
+    eligible = active_prev | (
+        slashed & (get_previous_epoch(spec, state) + 1 < withdrawable)
+    )
+
+    leak = is_in_inactivity_leak(spec, state)
+    delta = np.zeros(n, dtype=np.int64)
+    for flag_index, weight in enumerate(PARTICIPATION_FLAG_WEIGHTS):
+        has_flag = unslashed_prev & ((prev_part & (1 << flag_index)) != 0)
+        unslashed_increments = (
+            flag_balances_prev[flag_index] // spec.effective_balance_increment
+        )
+        reward_num = base_rewards * weight * unslashed_increments
+        rewards = reward_num // (total_active_increments * WEIGHT_DENOMINATOR)
+        if not leak:
+            delta = np.where(eligible & has_flag, delta + rewards, delta)
+        if flag_index != TIMELY_HEAD_FLAG_INDEX:
+            penalty = base_rewards * weight // WEIGHT_DENOMINATOR
+            delta = np.where(eligible & ~has_flag, delta - penalty, delta)
+
+    # inactivity penalties (target non-participants pay score-scaled)
+    scores = np.fromiter(state.inactivity_scores, np.uint64, n).astype(np.int64)
+    has_target = unslashed_prev & (
+        (prev_part & (1 << TIMELY_TARGET_FLAG_INDEX)) != 0
+    )
+    penalty_num = eff.astype(np.int64) * scores
+    penalty_den = INACTIVITY_SCORE_BIAS * INACTIVITY_PENALTY_QUOTIENT
+    inactivity_penalty = penalty_num // penalty_den
+    delta = np.where(eligible & ~has_target, delta - inactivity_penalty, delta)
+
+    balances = np.maximum(balances + delta, 0)
+    state.balances = [int(b) for b in balances]
+
+
+def process_registry_updates(spec: ChainSpec, state) -> None:
+    cur = get_current_epoch(spec, state)
+    # eligibility + ejection
+    for i, v in enumerate(state.validators):
+        if (
+            v.activation_eligibility_epoch == FAR_FUTURE_EPOCH
+            and v.effective_balance == spec.max_effective_balance
+        ):
+            v.activation_eligibility_epoch = cur + 1
+        if (
+            is_active_validator(v, cur)
+            and v.effective_balance <= spec.ejection_balance
+        ):
+            initiate_validator_exit(spec, state, i)
+    # activation queue, FIFO by (eligibility epoch, index), churn-limited
+    queue = sorted(
+        (
+            i
+            for i, v in enumerate(state.validators)
+            if v.activation_eligibility_epoch <= state.finalized_checkpoint.epoch
+            and v.activation_epoch == FAR_FUTURE_EPOCH
+        ),
+        key=lambda i: (
+            state.validators[i].activation_eligibility_epoch,
+            i,
+        ),
+    )
+    for i in queue[: get_validator_churn_limit(spec, state)]:
+        state.validators[i].activation_epoch = (
+            cur + 1 + spec.max_seed_lookahead
+        )
+
+
+def process_slashings_epoch(spec: ChainSpec, state, total_active: int) -> None:
+    epoch = get_current_epoch(spec, state)
+    total_slashings = sum(state.slashings)
+    adjusted = min(
+        total_slashings * PROPORTIONAL_SLASHING_MULTIPLIER, total_active
+    )
+    for i, v in enumerate(state.validators):
+        if (
+            v.slashed
+            and epoch + spec.preset.epochs_per_slashings_vector // 2
+            == v.withdrawable_epoch
+        ):
+            increment = spec.effective_balance_increment
+            penalty_numerator = v.effective_balance // increment * adjusted
+            penalty = penalty_numerator // total_active * increment
+            decrease_balance(state, i, penalty)
+
+
+def process_eth1_data_reset(spec: ChainSpec, state) -> None:
+    next_epoch = get_current_epoch(spec, state) + 1
+    if next_epoch % spec.preset.epochs_per_eth1_voting_period == 0:
+        state.eth1_data_votes = []
+
+
+def process_effective_balance_updates(spec: ChainSpec, state) -> None:
+    hysteresis_increment = spec.effective_balance_increment // 4
+    downward = hysteresis_increment  # HYSTERESIS_DOWNWARD_MULTIPLIER = 1
+    upward = hysteresis_increment * 2  # HYSTERESIS_UPWARD_MULTIPLIER = 2
+    for i, v in enumerate(state.validators):
+        balance = state.balances[i]
+        if (
+            balance + downward < v.effective_balance
+            or v.effective_balance + upward < balance
+        ):
+            v.effective_balance = min(
+                balance - balance % spec.effective_balance_increment,
+                spec.max_effective_balance,
+            )
+
+
+def process_slashings_reset(spec: ChainSpec, state) -> None:
+    next_epoch = get_current_epoch(spec, state) + 1
+    state.slashings[next_epoch % spec.preset.epochs_per_slashings_vector] = 0
+
+
+def process_randao_mixes_reset(spec: ChainSpec, state) -> None:
+    cur = get_current_epoch(spec, state)
+    next_epoch = cur + 1
+    state.randao_mixes[
+        next_epoch % spec.preset.epochs_per_historical_vector
+    ] = get_randao_mix(spec, state, cur)
+
+
+def _state_field_type(name: str):
+    return dict(T.BeaconState.fields)[name]
+
+
+def process_historical_roots_update(spec: ChainSpec, state) -> None:
+    next_epoch = get_current_epoch(spec, state) + 1
+    epochs_per_period = (
+        spec.preset.slots_per_historical_root // spec.preset.slots_per_epoch
+    )
+    if next_epoch % epochs_per_period == 0:
+        batch_root = _hash(
+            _state_field_type("block_roots").hash_tree_root(state.block_roots)
+            + _state_field_type("state_roots").hash_tree_root(state.state_roots)
+        )
+        state.historical_roots = list(state.historical_roots) + [batch_root]
+
+
+def process_participation_flag_updates(state) -> None:
+    state.previous_epoch_participation = list(state.current_epoch_participation)
+    state.current_epoch_participation = [0] * len(state.validators)
+
+
+def process_sync_committee_updates(spec: ChainSpec, state) -> None:
+    next_epoch = get_current_epoch(spec, state) + 1
+    if next_epoch % spec.preset.epochs_per_sync_committee_period == 0:
+        state.current_sync_committee = state.next_sync_committee
+        state.next_sync_committee = get_next_sync_committee(spec, state)
+
+
+# ---------------------------------------------------------------- genesis
+
+
+def interop_genesis_state(
+    spec: ChainSpec, pubkeys: list, genesis_time: int = 0
+):
+    """Deterministic test-net genesis from a pubkey list (the
+    eth2_interop_keypairs + interop genesis path the reference's
+    BeaconChainHarness uses, test_utils.rs)."""
+    state = T.BeaconState.default()
+    state.genesis_time = genesis_time
+    state.fork = T.Fork.make(
+        previous_version=spec.genesis_fork_version,
+        current_version=spec.genesis_fork_version,
+        epoch=GENESIS_EPOCH,
+    )
+    state.latest_block_header = T.BeaconBlockHeader.make(
+        body_root=T.BeaconBlockBody.default().hash_tree_root()
+    )
+    state.randao_mixes = [b"\x00" * 32] * spec.preset.epochs_per_historical_vector
+    state.block_roots = [b"\x00" * 32] * spec.preset.slots_per_historical_root
+    state.state_roots = [b"\x00" * 32] * spec.preset.slots_per_historical_root
+    state.slashings = [0] * spec.preset.epochs_per_slashings_vector
+    state.justification_bits = [False] * 4
+
+    validators, balances = [], []
+    for pk in pubkeys:
+        wc = b"\x00" + _hash(bytes(pk))[1:]
+        v = _validator_from_deposit(spec, bytes(pk), wc, spec.max_effective_balance)
+        v.activation_eligibility_epoch = GENESIS_EPOCH
+        v.activation_epoch = GENESIS_EPOCH
+        validators.append(v)
+        balances.append(spec.max_effective_balance)
+    state.validators = validators
+    state.balances = balances
+    state.previous_epoch_participation = [0] * len(validators)
+    state.current_epoch_participation = [0] * len(validators)
+    state.inactivity_scores = [0] * len(validators)
+
+    state.genesis_validators_root = _state_field_type(
+        "validators"
+    ).hash_tree_root(state.validators)
+    committee = get_next_sync_committee(spec, state)
+    state.current_sync_committee = committee
+    state.next_sync_committee = get_next_sync_committee(spec, state)
+    return state

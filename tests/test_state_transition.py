@@ -1,0 +1,252 @@
+"""State-transition tests in the BeaconChainHarness style (test_utils.rs):
+interop genesis -> slot/epoch advance -> produced blocks applied, plus
+operation-level unit checks. Signature verification is exercised once
+(randao) and otherwise disabled, mirroring the reference's fake_crypto
+posture for logic tests (SURVEY.md §4)."""
+
+import pytest
+
+from lighthouse_tpu.consensus import types as T
+from lighthouse_tpu.consensus.spec import mainnet_spec, FAR_FUTURE_EPOCH
+from lighthouse_tpu.consensus import state_transition as st
+from lighthouse_tpu.crypto.bls.keys import SecretKey
+
+N_VALIDATORS = 64
+
+
+@pytest.fixture(scope="module")
+def keys():
+    return [SecretKey.from_seed(i.to_bytes(4, "big")) for i in range(N_VALIDATORS)]
+
+
+@pytest.fixture(scope="module")
+def genesis(keys):
+    spec = mainnet_spec()
+    pubkeys = [k.public_key().to_bytes() for k in keys]
+    state = st.interop_genesis_state(spec, pubkeys, genesis_time=1600000000)
+    return spec, state
+
+
+def _fresh(genesis):
+    spec, state = genesis
+    return spec, state.copy()
+
+
+def _empty_block(spec, state, slot):
+    """Build a structurally-valid empty block for `slot` on a COPY of
+    state, returning (block, post_state)."""
+    pre = state.copy()
+    st.process_slots(spec, pre, slot)
+    proposer = st.get_beacon_proposer_index(spec, pre)
+    body = T.BeaconBlockBody.default()
+    body.sync_aggregate = T.SyncAggregate.make(
+        sync_committee_bits=[False] * spec.preset.sync_committee_size,
+        sync_committee_signature=b"\xc0" + b"\x00" * 95,
+    )
+    body.eth1_data = pre.eth1_data
+    block = T.BeaconBlock.make(
+        slot=slot,
+        proposer_index=proposer,
+        parent_root=pre.latest_block_header.hash_tree_root(),
+        state_root=b"\x00" * 32,
+        body=body,
+    )
+    st.process_block(spec, pre, block, verify_signatures=False)
+    block.state_root = pre.hash_tree_root()
+    return block, pre
+
+
+def test_genesis_shape(genesis):
+    spec, state = genesis
+    assert len(state.validators) == N_VALIDATORS
+    assert state.slot == 0
+    active = st.get_active_validator_indices(state, 0)
+    assert len(active) == N_VALIDATORS
+    assert (
+        st.get_total_active_balance(spec, state)
+        == N_VALIDATORS * spec.max_effective_balance
+    )
+
+
+def test_slot_advance_fills_roots(genesis):
+    spec, state = _fresh(genesis)
+    st.process_slots(spec, state, 3)
+    assert state.slot == 3
+    # block roots for past slots are filled with the genesis header root
+    r0 = state.block_roots[0]
+    assert r0 != b"\x00" * 32
+    assert st.get_block_root_at_slot(spec, state, 0) == r0
+
+
+def test_epoch_boundary_rotates_participation(genesis):
+    spec, state = _fresh(genesis)
+    state.current_epoch_participation = [7] * N_VALIDATORS
+    st.process_slots(spec, state, spec.preset.slots_per_epoch)
+    assert list(state.previous_epoch_participation) == [7] * N_VALIDATORS
+    assert list(state.current_epoch_participation) == [0] * N_VALIDATORS
+
+
+def test_empty_block_applies(genesis):
+    spec, state = _fresh(genesis)
+    block, post = _empty_block(spec, state, 1)
+    signed = T.SignedBeaconBlock.make(message=block, signature=b"\x00" * 96)
+    st.state_transition(spec, state, signed, verify_signatures=False)
+    assert state.slot == 1
+    assert state.hash_tree_root() == post.hash_tree_root()
+
+
+def test_wrong_proposer_rejected(genesis):
+    spec, state = _fresh(genesis)
+    block, _ = _empty_block(spec, state, 1)
+    st.process_slots(spec, state, 1)
+    block.proposer_index = (block.proposer_index + 1) % N_VALIDATORS
+    with pytest.raises(st.BlockProcessingError):
+        st.process_block(spec, state, block, verify_signatures=False)
+
+
+def test_state_root_mismatch_rejected(genesis):
+    spec, state = _fresh(genesis)
+    block, _ = _empty_block(spec, state, 1)
+    block.state_root = b"\x11" * 32
+    signed = T.SignedBeaconBlock.make(message=block, signature=b"\x00" * 96)
+    with pytest.raises(st.BlockProcessingError):
+        st.state_transition(spec, state, signed, verify_signatures=False)
+
+
+def test_randao_reveal_verifies(genesis, keys):
+    from lighthouse_tpu.consensus.domains import (
+        compute_signing_root,
+        get_domain,
+    )
+    from lighthouse_tpu.consensus.signature_sets import _EpochSSZ
+
+    spec, state = _fresh(genesis)
+    block, _ = _empty_block(spec, state, 1)
+    st.process_slots(spec, state, 1)
+    epoch = st.get_current_epoch(spec, state)
+    domain = get_domain(
+        spec, spec.domain_randao, epoch, state.fork, state.genesis_validators_root
+    )
+    msg = compute_signing_root(_EpochSSZ(epoch), domain)
+    block.body.randao_reveal = keys[block.proposer_index].sign(msg).to_bytes()
+    st.process_randao(spec, state, block, verify_signatures=True)
+    # and a bad reveal is rejected
+    block.body.randao_reveal = keys[block.proposer_index].sign(b"wrong").to_bytes()
+    with pytest.raises(st.BlockProcessingError):
+        st.process_randao(spec, state, block, verify_signatures=True)
+
+
+def test_voluntary_exit_lifecycle(genesis):
+    spec, state = _fresh(genesis)
+    # too young to exit
+    exit_msg = T.SignedVoluntaryExit.make(
+        message=T.VoluntaryExit.make(epoch=0, validator_index=5),
+        signature=b"\x00" * 96,
+    )
+    with pytest.raises(st.BlockProcessingError):
+        st.process_voluntary_exit(spec, state, exit_msg, verify_signatures=False)
+    # age the validator past the shard committee period
+    state.validators[5].activation_epoch = 0
+    state.slot = (spec.shard_committee_period + 1) * spec.preset.slots_per_epoch
+    st.process_voluntary_exit(spec, state, exit_msg, verify_signatures=False)
+    v = state.validators[5]
+    assert v.exit_epoch != FAR_FUTURE_EPOCH
+    assert (
+        v.withdrawable_epoch
+        == v.exit_epoch + spec.min_validator_withdrawability_delay
+    )
+    # double exit rejected
+    with pytest.raises(st.BlockProcessingError):
+        st.process_voluntary_exit(spec, state, exit_msg, verify_signatures=False)
+
+
+def test_proposer_slashing(genesis):
+    spec, state = _fresh(genesis)
+    st.process_slots(spec, state, 1)
+    proposer = 7
+    h1 = T.SignedBeaconBlockHeader.make(
+        message=T.BeaconBlockHeader.make(
+            slot=1, proposer_index=proposer, parent_root=b"\x01" * 32
+        ),
+        signature=b"\x00" * 96,
+    )
+    h2 = T.SignedBeaconBlockHeader.make(
+        message=T.BeaconBlockHeader.make(
+            slot=1, proposer_index=proposer, parent_root=b"\x02" * 32
+        ),
+        signature=b"\x00" * 96,
+    )
+    slashing = T.ProposerSlashing.make(signed_header_1=h1, signed_header_2=h2)
+    bal_before = state.balances[proposer]
+    st.process_proposer_slashing(spec, state, slashing, verify_signatures=False)
+    v = state.validators[proposer]
+    assert v.slashed
+    assert state.balances[proposer] < bal_before
+    # identical headers rejected
+    s2 = T.ProposerSlashing.make(signed_header_1=h1, signed_header_2=h1)
+    with pytest.raises(st.BlockProcessingError):
+        st.process_proposer_slashing(spec, state, s2, verify_signatures=False)
+
+
+def test_attestation_flow(genesis):
+    spec, state = _fresh(genesis)
+    # advance into epoch 1 so slot-0 attestations are includable
+    st.process_slots(spec, state, 2)
+    data = T.AttestationData.make(
+        slot=0,
+        index=0,
+        beacon_block_root=st.get_block_root_at_slot(spec, state, 0),
+        source=T.Checkpoint.make(
+            epoch=state.current_justified_checkpoint.epoch,
+            root=bytes(state.current_justified_checkpoint.root),
+        ),
+        target=T.Checkpoint.make(epoch=0, root=st.get_block_root(spec, state, 0)),
+    )
+    committee = st.get_beacon_committee(spec, state, 0, 0)
+    att = T.Attestation.make(
+        aggregation_bits=[True] * len(committee),
+        data=data,
+        signature=b"\x00" * 96,
+    )
+    st.process_attestation(spec, state, att, verify_signatures=False)
+    part = state.current_epoch_participation
+    for i in committee:
+        assert part[i] & (1 << st.TIMELY_SOURCE_FLAG_INDEX)
+        assert part[i] & (1 << st.TIMELY_TARGET_FLAG_INDEX)
+
+
+def test_effective_balance_hysteresis(genesis):
+    spec, state = _fresh(genesis)
+    v = state.validators[0]
+    assert v.effective_balance == spec.max_effective_balance
+    # small dip: no change
+    state.balances[0] = spec.max_effective_balance - 10**8
+    st.process_effective_balance_updates(spec, state)
+    assert state.validators[0].effective_balance == spec.max_effective_balance
+    # big dip: effective balance follows
+    state.balances[0] = spec.max_effective_balance - 2 * 10**9
+    st.process_effective_balance_updates(spec, state)
+    assert state.validators[0].effective_balance == 30 * 10**9
+
+
+def test_registry_activation_queue(genesis):
+    spec, state = _fresh(genesis)
+    new = st._validator_from_deposit(
+        spec, b"\x17" * 48, b"\x00" * 32, spec.max_effective_balance
+    )
+    state.validators = list(state.validators) + [new]
+    state.balances = list(state.balances) + [spec.max_effective_balance]
+    state.previous_epoch_participation = list(
+        state.previous_epoch_participation
+    ) + [0]
+    state.current_epoch_participation = list(
+        state.current_epoch_participation
+    ) + [0]
+    state.inactivity_scores = list(state.inactivity_scores) + [0]
+    st.process_registry_updates(spec, state)
+    idx = len(state.validators) - 1
+    assert state.validators[idx].activation_eligibility_epoch == 1
+    # next epoch, once finalized catches up, it activates
+    state.finalized_checkpoint = T.Checkpoint.make(epoch=1, root=b"\x00" * 32)
+    st.process_registry_updates(spec, state)
+    assert state.validators[idx].activation_epoch != FAR_FUTURE_EPOCH
