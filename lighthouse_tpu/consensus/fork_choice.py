@@ -1,0 +1,183 @@
+"""Spec fork choice wrapped around the proto-array
+(consensus/fork_choice/src/fork_choice.rs analog).
+
+`ForkChoice` owns a `ProtoArrayForkChoice` plus the store-level
+checkpoint state the spec tracks (justified / finalized / unrealized
+justification), and exposes the reference's surface: `on_block`
+(fork_choice.rs:648), `on_attestation` (:1045), `on_attester_slashing`
+(:1099), `get_head` (:474), proposer boost, and queued attestations
+(attestations for the current slot are applied starting the NEXT slot).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from .proto_array import ExecutionStatus, ProtoArrayForkChoice
+from .spec import ChainSpec
+
+
+class ForkChoiceError(Exception):
+    pass
+
+
+@dataclass
+class QueuedAttestation:
+    slot: int
+    validator_index: int
+    block_root: bytes
+    target_epoch: int
+
+
+class ForkChoice:
+    def __init__(
+        self,
+        spec: ChainSpec,
+        genesis_root: bytes,
+        genesis_slot: int = 0,
+        justified_epoch: int = 0,
+        finalized_epoch: int = 0,
+    ):
+        self.spec = spec
+        self.proto = ProtoArrayForkChoice(
+            finalized_root=genesis_root,
+            finalized_slot=genesis_slot,
+            justified_epoch=justified_epoch,
+            finalized_epoch=finalized_epoch,
+        )
+        self.justified_checkpoint = (justified_epoch, genesis_root)
+        self.finalized_checkpoint = (finalized_epoch, genesis_root)
+        self.queued_attestations: list[QueuedAttestation] = []
+        self._balances: list[int] = []
+        self._equivocating: set[int] = set()
+
+    # ------------------------------------------------------------ blocks
+
+    def on_block(
+        self,
+        current_slot: int,
+        block_slot: int,
+        block_root: bytes,
+        parent_root: bytes,
+        state_justified: tuple,
+        state_finalized: tuple,
+        balances: list,
+        execution_status: ExecutionStatus = ExecutionStatus.IRRELEVANT,
+        proposer_index: Optional[int] = None,
+    ) -> None:
+        """Register an imported block (fork_choice.rs:648). The caller
+        (beacon chain) has already fully verified it; `state_justified`/
+        `state_finalized` are (epoch, root) from the post-state."""
+        if block_slot > current_slot:
+            raise ForkChoiceError("block from the future")
+        if block_root in self.proto.index_by_root:
+            return
+        if parent_root not in self.proto.index_by_root:
+            raise ForkChoiceError("unknown parent")
+
+        # checkpoint bubbling: adopt the best justified/finalized seen
+        if state_justified[0] > self.justified_checkpoint[0]:
+            self.justified_checkpoint = tuple(state_justified)
+        if state_finalized[0] > self.finalized_checkpoint[0]:
+            self.finalized_checkpoint = tuple(state_finalized)
+
+        self.proto.on_block(
+            slot=block_slot,
+            root=block_root,
+            parent_root=parent_root,
+            justified_epoch=state_justified[0],
+            finalized_epoch=state_finalized[0],
+            execution_status=execution_status,
+        )
+        self._balances = list(balances)
+
+        # proposer boost: block arriving in its own slot gets the boost
+        if block_slot == current_slot:
+            committee_weight = (
+                sum(balances) // self.spec.preset.slots_per_epoch
+                if balances
+                else 0
+            )
+            boost = committee_weight * self.spec.proposer_score_boost // 100
+            self.proto.apply_proposer_boost(block_root, boost)
+
+    # ------------------------------------------------------------ votes
+
+    def on_attestation(
+        self,
+        current_slot: int,
+        validator_index: int,
+        block_root: bytes,
+        target_epoch: int,
+        attestation_slot: int,
+        is_from_block: bool = False,
+    ) -> None:
+        """LMD vote (fork_choice.rs:1045). Gossip attestations for the
+        current slot are queued and applied next slot (spec rule:
+        attestations only influence fork choice one slot later)."""
+        if validator_index in self._equivocating:
+            return
+        if not is_from_block and attestation_slot >= current_slot:
+            self.queued_attestations.append(
+                QueuedAttestation(
+                    slot=attestation_slot,
+                    validator_index=validator_index,
+                    block_root=block_root,
+                    target_epoch=target_epoch,
+                )
+            )
+            return
+        self.proto.process_attestation(validator_index, block_root, target_epoch)
+
+    def on_attester_slashing(self, attester_indices) -> None:
+        """Equivocating validators stop contributing weight forever
+        (fork_choice.rs:1099)."""
+        for i in attester_indices:
+            self._equivocating.add(i)
+            v = self.proto.votes.get(i)
+            if v is not None:
+                # zero the balance contribution on the next delta pass
+                v.next_root = b"\x00" * 32
+                v.next_epoch = 2**62
+
+    def process_queued_attestations(self, current_slot: int) -> None:
+        """Called at each slot tick: release queued votes older than the
+        current slot."""
+        still = []
+        for q in self.queued_attestations:
+            if q.slot < current_slot:
+                self.proto.process_attestation(
+                    q.validator_index, q.block_root, q.target_epoch
+                )
+            else:
+                still.append(q)
+        self.queued_attestations = still
+
+    # ------------------------------------------------------------ head
+
+    def get_head(self, current_slot: int) -> bytes:
+        """Recompute the canonical head (fork_choice.rs:474 →
+        proto_array find_head:463)."""
+        self.process_queued_attestations(current_slot)
+        balances = [
+            0 if i in self._equivocating else b
+            for i, b in enumerate(self._balances)
+        ]
+        self.proto.apply_score_changes(
+            balances,
+            justified_epoch=self.justified_checkpoint[0],
+            finalized_epoch=self.finalized_checkpoint[0],
+        )
+        return self.proto.find_head(self.justified_checkpoint[1])
+
+    # ------------------------------------------------------------ misc
+
+    def on_execution_status(self, root: bytes, status: ExecutionStatus) -> None:
+        self.proto.on_execution_status(root, status)
+
+    def prune(self) -> int:
+        return self.proto.prune(self.finalized_checkpoint[1])
+
+    def contains_block(self, root: bytes) -> bool:
+        return root in self.proto.index_by_root

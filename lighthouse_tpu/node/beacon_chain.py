@@ -1,0 +1,435 @@
+"""BeaconChain — the chain service tying store, fork choice, state
+transition and the batched signature verifier together
+(beacon_node/beacon_chain analog, beacon_chain.rs).
+
+The import pipeline mirrors the reference's type-state stages
+(block_verification.rs:670-700):
+
+    gossip checks -> signature batch (ONE verify_signature_sets call for
+    the whole block, block_signature_verifier.rs:127-138) -> state
+    transition -> fork choice -> store -> head recompute.
+
+Attestation gossip follows attestation_verification/batch.rs: per-item
+spec checks and committee resolution produce SignatureSets; crypto is
+ONE batched call sized for the TPU backend, with per-item fallback on
+batch failure (the poisoning defense, batch.rs:203-211).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..common import metrics
+from ..consensus import state_transition as st
+from ..consensus import types as T
+from ..consensus.fork_choice import ForkChoice, ForkChoiceError
+from ..consensus.pubkey_cache import ValidatorPubkeyCache
+from ..consensus.signature_sets import (
+    BlockSignatureVerifier,
+    indexed_attestation_signature_set,
+)
+from ..consensus.spec import ChainSpec
+from ..crypto import bls
+from .store import HotColdDB
+
+
+class BlockError(Exception):
+    pass
+
+
+class AttestationError(Exception):
+    pass
+
+
+@dataclass
+class VerifiedAttestation:
+    """An attestation that passed all non-crypto gossip checks; carries
+    its resolved indexed form + signature set."""
+
+    attestation: object
+    indexed_indices: list
+    signature_set: object
+
+
+@dataclass
+class ChainHead:
+    root: bytes
+    slot: int
+    state_root: bytes
+
+
+class BeaconChain:
+    def __init__(
+        self,
+        spec: ChainSpec,
+        genesis_state,
+        store: HotColdDB = None,
+        bls_backend: Optional[str] = None,
+    ):
+        self.spec = spec
+        self.store = store or HotColdDB(spec)
+        self.bls_backend = bls_backend
+        self._lock = threading.RLock()
+
+        genesis_state = genesis_state.copy()
+        # the genesis BLOCK root: the latest header with its state_root
+        # filled in — exactly what per-slot processing derives as the
+        # parent root of the first real block
+        sroot0 = genesis_state.hash_tree_root()
+        hdr = T.BeaconBlockHeader.make(
+            slot=genesis_state.latest_block_header.slot,
+            proposer_index=genesis_state.latest_block_header.proposer_index,
+            parent_root=bytes(genesis_state.latest_block_header.parent_root),
+            state_root=sroot0,
+            body_root=bytes(genesis_state.latest_block_header.body_root),
+        )
+        genesis_root = hdr.hash_tree_root()
+        self.genesis_root = genesis_root
+        self.genesis_validators_root = bytes(genesis_state.genesis_validators_root)
+
+        self.fork_choice = ForkChoice(spec, genesis_root)
+        self.pubkey_cache = ValidatorPubkeyCache()
+        self.pubkey_cache.import_new_pubkeys(
+            bytes(v.pubkey) for v in genesis_state.validators
+        )
+
+        # hot state bookkeeping: head + states by block root.
+        # _block_info records (slot, parent_root, state_root) per block;
+        # the canonical slot->roots mapping is DERIVED by walking
+        # parents from the finalized root at migration time, so fork
+        # blocks can never poison the archived chain.
+        sroot = genesis_state.hash_tree_root()
+        self.store.put_state(sroot, genesis_state)
+        self._state_roots: dict[bytes, bytes] = {genesis_root: sroot}
+        self._states: dict[bytes, object] = {genesis_root: genesis_state}
+        self._block_info: dict[bytes, tuple] = {
+            genesis_root: (0, None, sroot)
+        }
+        self.head = ChainHead(root=genesis_root, slot=0, state_root=sroot)
+        self.current_slot = 0
+
+        # gossip duplicate filters (observed_attesters role)
+        self._observed_attesters: set = set()
+
+        self.m_blocks = metrics.counter("beacon_chain_blocks_imported_total")
+        self.m_atts = metrics.counter(
+            "beacon_chain_attestations_verified_total"
+        )
+        self.m_batch_fallback = metrics.counter(
+            "beacon_chain_attestation_batch_fallbacks_total"
+        )
+
+    # ------------------------------------------------------------ time
+
+    def on_slot(self, slot: int) -> None:
+        self.current_slot = max(self.current_slot, slot)
+
+    # ------------------------------------------------------------ state access
+
+    def state_for_block(self, block_root: bytes):
+        state = self._states.get(block_root)
+        if state is not None:
+            return state
+        sroot = self._state_roots.get(block_root)
+        if sroot is None:
+            return None
+        return self.store.get_hot_state(sroot)
+
+    def head_state(self):
+        return self.state_for_block(self.head.root)
+
+    # ------------------------------------------------------------ blocks
+
+    def process_block(self, signed_block, verify_signatures: bool = True):
+        """Full import pipeline (beacon_chain.rs:3289 process_block →
+        :3717 import_block)."""
+        with self._lock:
+            block = signed_block.message
+            block_root = block.hash_tree_root()
+            if self.fork_choice.contains_block(block_root):
+                return block_root  # duplicate
+            parent_root = bytes(block.parent_root)
+            parent_state = self.state_for_block(parent_root)
+            if parent_state is None:
+                raise BlockError("unknown parent")
+            if block.slot > self.current_slot:
+                raise BlockError("block from the future")
+
+            state = parent_state.copy()
+            if state.slot < block.slot:
+                st.process_slots(self.spec, state, block.slot)
+
+            if verify_signatures:
+                # ONE batch for every signature in the block
+                verifier = BlockSignatureVerifier(
+                    self.spec,
+                    self._get_pubkey,
+                    state.fork,
+                    self.genesis_validators_root,
+                )
+                verifier.include_all(self.spec, state, signed_block)
+                if not verifier.verify(backend=self.bls_backend):
+                    raise BlockError("block signature batch invalid")
+
+            st.process_block(
+                self.spec, state, block, verify_signatures=False
+            )
+            if bytes(block.state_root) != state.hash_tree_root():
+                raise BlockError("state root mismatch")
+
+            self._import_block(signed_block, block_root, state)
+            return block_root
+
+    def _import_block(self, signed_block, block_root: bytes, state) -> None:
+        block = signed_block.message
+        state_root = bytes(block.state_root)
+        self.store.put_block(block_root, signed_block)
+        self.store.put_state(state_root, state)
+        self._state_roots[block_root] = state_root
+        self._states[block_root] = state
+        self._block_info[block_root] = (
+            block.slot,
+            bytes(block.parent_root),
+            state_root,
+        )
+
+        # grow the pubkey cache with any new validators
+        if len(state.validators) > len(self.pubkey_cache):
+            self.pubkey_cache.import_new_pubkeys(
+                bytes(v.pubkey)
+                for v in state.validators[len(self.pubkey_cache) :]
+            )
+
+        # fork-choice weights: only ACTIVE, UNSLASHED validators count
+        # (a stale vote from an exited/slashed validator must not move
+        # the head; fork_choice.rs uses the justified state's filtered
+        # balances — the imported state is our closest analog)
+        epoch = st.get_current_epoch(self.spec, state)
+        balances = [
+            v.effective_balance
+            if (st.is_active_validator(v, epoch) and not v.slashed)
+            else 0
+            for v in state.validators
+        ]
+        try:
+            self.fork_choice.on_block(
+                current_slot=max(self.current_slot, block.slot),
+                block_slot=block.slot,
+                block_root=block_root,
+                parent_root=bytes(block.parent_root),
+                state_justified=(
+                    state.current_justified_checkpoint.epoch,
+                    bytes(state.current_justified_checkpoint.root),
+                ),
+                state_finalized=(
+                    state.finalized_checkpoint.epoch,
+                    bytes(state.finalized_checkpoint.root),
+                ),
+                balances=balances,
+            )
+        except ForkChoiceError as e:
+            raise BlockError(str(e)) from None
+        self.m_blocks.inc()
+        self.recompute_head()
+
+    def recompute_head(self) -> bytes:
+        """canonical_head.rs:474 recompute_head_at_current_slot."""
+        head_root = self.fork_choice.get_head(self.current_slot)
+        node = self.fork_choice.proto.nodes[
+            self.fork_choice.proto.index_by_root[head_root]
+        ]
+        self.head = ChainHead(
+            root=head_root,
+            slot=node.slot,
+            state_root=self._state_roots.get(head_root, b""),
+        )
+        return head_root
+
+    # ------------------------------------------------------------ attestations
+
+    def verify_attestation_for_gossip(self, attestation) -> VerifiedAttestation:
+        """Spec/gossip checks WITHOUT crypto (batch.rs:147 per-item
+        stage): slot window, known target/head block, committee
+        resolution, first-seen filter."""
+        data = attestation.data
+        epoch = st.compute_epoch_at_slot(self.spec, data.slot)
+        cur_epoch = st.compute_epoch_at_slot(self.spec, self.current_slot)
+        if epoch not in (cur_epoch, max(cur_epoch - 1, 0)):
+            raise AttestationError("attestation epoch not current or previous")
+        with self._lock:
+            return self._verify_attestation_locked(attestation, data, epoch)
+
+    def _verify_attestation_locked(self, attestation, data, epoch):
+        target_root = bytes(data.target.root)
+        if not self.fork_choice.contains_block(target_root):
+            raise AttestationError("unknown target block")
+        head_root = bytes(data.beacon_block_root)
+        if not self.fork_choice.contains_block(head_root):
+            raise AttestationError("unknown head block")
+
+        state = self.state_for_block(target_root)
+        if state is None:
+            raise AttestationError("no state for target")
+        committee = st.get_beacon_committee(
+            self.spec, state, data.slot, data.index
+        )
+        bits = attestation.aggregation_bits
+        if len(bits) != len(committee):
+            raise AttestationError("bad aggregation bits length")
+        indices = [committee[i] for i, b in enumerate(bits) if b]
+        if len(indices) != 1:
+            raise AttestationError("gossip attestation must have one bit set")
+        # duplicate CHECK here; observation is registered only after the
+        # signature verifies (batch_verify_attestations) — otherwise a
+        # garbage-signature attestation would censor the validator's
+        # real one for the whole epoch
+        if (indices[0], epoch) in self._observed_attesters:
+            raise AttestationError("duplicate attestation")
+
+        indexed = T.IndexedAttestation.make(
+            attesting_indices=indices,
+            data=data,
+            signature=bytes(attestation.signature),
+        )
+        sset = indexed_attestation_signature_set(
+            self.spec,
+            self._get_pubkey,
+            indexed,
+            state.fork,
+            self.genesis_validators_root,
+        )
+        return VerifiedAttestation(
+            attestation=attestation,
+            indexed_indices=indices,
+            signature_set=sset,
+        )
+
+    def batch_verify_attestations(self, verified: list) -> list:
+        """ONE crypto batch over pre-checked attestations
+        (attestation_verification/batch.rs:133-214). Returns the subset
+        that verified; falls back to per-item verification if the batch
+        fails (poisoning defense)."""
+        if not verified:
+            return []
+        sets = [v.signature_set for v in verified]
+        if bls.verify_signature_sets(sets, backend=self.bls_backend):
+            good = list(verified)
+        else:
+            self.m_batch_fallback.inc()
+            good = [
+                v
+                for v in verified
+                if bls.verify_signature_sets(
+                    [v.signature_set], backend=self.bls_backend
+                )
+            ]
+        with self._lock:
+            for v in good:
+                epoch = st.compute_epoch_at_slot(
+                    self.spec, v.attestation.data.slot
+                )
+                for index in v.indexed_indices:
+                    self._observed_attesters.add((index, epoch))
+                self.apply_attestation_to_fork_choice(v)
+        self.m_atts.inc(len(good))
+        return good
+
+    def apply_attestation_to_fork_choice(self, v: VerifiedAttestation) -> None:
+        data = v.attestation.data
+        with self._lock:
+            for index in v.indexed_indices:
+                self.fork_choice.on_attestation(
+                    current_slot=self.current_slot,
+                    validator_index=index,
+                    block_root=bytes(data.beacon_block_root),
+                    target_epoch=data.target.epoch,
+                    attestation_slot=data.slot,
+                )
+
+    # ------------------------------------------------------------ production
+
+    def produce_block(self, slot: int, randao_reveal: bytes = b"\x00" * 96):
+        """Minimal block production on the canonical head (empty body;
+        op-pool packing arrives with the operation pool component)."""
+        with self._lock:
+            head_state = self.head_state()
+            if head_state is None:
+                raise BlockError("no head state")
+            state = head_state.copy()
+            if state.slot < slot:
+                st.process_slots(self.spec, state, slot)
+            proposer = st.get_beacon_proposer_index(self.spec, state)
+            body = T.BeaconBlockBody.default()
+            body.randao_reveal = randao_reveal
+            body.eth1_data = state.eth1_data
+            body.sync_aggregate = T.SyncAggregate.make(
+                sync_committee_bits=[False]
+                * self.spec.preset.sync_committee_size,
+                sync_committee_signature=b"\xc0" + b"\x00" * 95,
+            )
+            block = T.BeaconBlock.make(
+                slot=slot,
+                proposer_index=proposer,
+                parent_root=state.latest_block_header.hash_tree_root(),
+                state_root=b"\x00" * 32,
+                body=body,
+            )
+            st.process_block(self.spec, state, block, verify_signatures=False)
+            block.state_root = state.hash_tree_root()
+            return block
+
+    # ------------------------------------------------------------ finality
+
+    def canonical_roots_through(self, anchor_root: bytes) -> dict:
+        """slot -> (block_root, state_root) for the ancestor chain of
+        `anchor_root` — derived by walking parents, so competing fork
+        blocks can never leak into the canonical mapping."""
+        out = {}
+        root = anchor_root
+        while root is not None and root in self._block_info:
+            slot, parent, state_root = self._block_info[root]
+            out[slot] = (root, state_root)
+            root = parent
+        return out
+
+    def migrate_finalized(self) -> int:
+        """Finality-driven hot->cold migration (migrate.rs role):
+        archive the finalized canonical chain, then prune every
+        below-finality hot state (canonical AND orphaned forks) plus
+        the in-memory bookkeeping and stale gossip filters."""
+        with self._lock:
+            fin_epoch, fin_root = self.fork_choice.finalized_checkpoint
+            if fin_root not in self._block_info:
+                return 0
+            fin_slot = st.compute_start_slot_at_epoch(self.spec, fin_epoch)
+            canonical = self.canonical_roots_through(fin_root)
+            moved = self.store.migrate(fin_slot, canonical)
+
+            # drop below-finality bookkeeping + orphaned fork states
+            for root in list(self._block_info):
+                slot, _, state_root = self._block_info[root]
+                if slot >= fin_slot or root == fin_root:
+                    continue
+                self.store.delete_state(state_root)
+                self._block_info.pop(root, None)
+                self._state_roots.pop(root, None)
+                self._states.pop(root, None)
+
+            # gossip filters older than the previous epoch are stale
+            cur_epoch = st.compute_epoch_at_slot(self.spec, self.current_slot)
+            self._observed_attesters = {
+                (i, e)
+                for (i, e) in self._observed_attesters
+                if e + 1 >= cur_epoch
+            }
+            return moved
+
+    # ------------------------------------------------------------ helpers
+
+    def _get_pubkey(self, index: int):
+        pk = self.pubkey_cache.get(index)
+        if pk is None:
+            raise KeyError(f"unknown validator {index}")
+        return pk

@@ -1,0 +1,339 @@
+"""Storage layer (beacon_node/store analog).
+
+Two engines behind one `KVStore` interface, like the reference's
+`MemoryStore` / LevelDB split (beacon_node/store/src/memory_store.rs,
+leveldb_store.rs):
+
+  MemoryStore — dict-backed, for tests (EphemeralHarnessType role).
+  LogStore    — log-structured file store: one append-only segment per
+                column, in-memory index rebuilt on open, explicit
+                compaction. Durable without native deps; the C++
+                engine slot-in replaces this class (same interface).
+
+`HotColdDB` (hot_cold_store.rs:52-79 role) sits on top: blocks and
+recent states in the hot section, finalized history migrated to the
+cold section at a `split` slot. Cold states are stored as periodic full
+snapshots every `slots_per_restore_point`; intermediate states are
+reconstructed by replaying blocks through the state transition
+(the reference's freezer + BlockReplayer design, block_replayer.rs:316).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+from typing import Iterator, Optional
+
+from ..consensus import types as T
+from ..consensus.spec import ChainSpec
+
+
+# ---------------------------------------------------------------- interface
+
+
+class Column:
+    BLOCK = b"blk"
+    STATE = b"ste"
+    COLD_STATE = b"cst"
+    BLOCK_ROOT_BY_SLOT = b"brs"  # cold chain index
+    METADATA = b"met"
+
+
+class KVStore:
+    def get(self, column: bytes, key: bytes) -> Optional[bytes]:
+        raise NotImplementedError
+
+    def put(self, column: bytes, key: bytes, value: bytes) -> None:
+        raise NotImplementedError
+
+    def delete(self, column: bytes, key: bytes) -> None:
+        raise NotImplementedError
+
+    def keys(self, column: bytes) -> Iterator[bytes]:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class MemoryStore(KVStore):
+    def __init__(self):
+        self._data: dict[tuple, bytes] = {}
+        self._lock = threading.Lock()
+
+    def get(self, column, key):
+        return self._data.get((column, key))
+
+    def put(self, column, key, value):
+        with self._lock:
+            self._data[(column, key)] = bytes(value)
+
+    def delete(self, column, key):
+        with self._lock:
+            self._data.pop((column, key), None)
+
+    def keys(self, column):
+        with self._lock:
+            return iter([k for c, k in list(self._data) if c == column])
+
+
+class LogStore(KVStore):
+    """Append-only segment per column + in-memory index.
+
+    Record format: [klen u32][vlen u32 | 0xFFFFFFFF = tombstone][key][value].
+    Crash-safe by construction (torn tails are detected by length checks
+    on open and truncated). `compact()` rewrites live records only.
+    """
+
+    _TOMB = 0xFFFFFFFF
+
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(path, exist_ok=True)
+        self._files: dict[bytes, object] = {}
+        self._index: dict[bytes, dict[bytes, tuple]] = {}
+        self._lock = threading.Lock()
+
+    def _segment(self, column: bytes) -> str:
+        return os.path.join(self.path, column.decode() + ".log")
+
+    def _open(self, column: bytes):
+        if column in self._files:
+            return self._files[column]
+        seg = self._segment(column)
+        index: dict[bytes, tuple] = {}
+        if os.path.exists(seg):
+            with open(seg, "rb") as f:
+                data = f.read()
+            pos = 0
+            valid_end = 0
+            while pos + 8 <= len(data):
+                klen, vlen = struct.unpack_from("<II", data, pos)
+                body = 8 + klen + (0 if vlen == self._TOMB else vlen)
+                if pos + body > len(data):
+                    break  # torn tail
+                key = data[pos + 8 : pos + 8 + klen]
+                if vlen == self._TOMB:
+                    index.pop(key, None)
+                else:
+                    index[key] = (pos + 8 + klen, vlen)
+                pos += body
+                valid_end = pos
+            if valid_end != len(data):
+                with open(seg, "r+b") as f:
+                    f.truncate(valid_end)
+        f = open(seg, "a+b")
+        self._files[column] = f
+        self._index[column] = index
+        return f
+
+    def get(self, column, key):
+        with self._lock:
+            f = self._open(column)
+            ent = self._index[column].get(bytes(key))
+            if ent is None:
+                return None
+            off, vlen = ent
+            # read through the append handle (a+b is read/write); the
+            # next put seeks to END itself, so no seek-back is needed
+            f.flush()
+            f.seek(off)
+            return f.read(vlen)
+
+    def put(self, column, key, value):
+        key, value = bytes(key), bytes(value)
+        with self._lock:
+            f = self._open(column)
+            f.seek(0, os.SEEK_END)
+            pos = f.tell()
+            f.write(struct.pack("<II", len(key), len(value)) + key + value)
+            f.flush()
+            self._index[column][key] = (pos + 8 + len(key), len(value))
+
+    def delete(self, column, key):
+        key = bytes(key)
+        with self._lock:
+            f = self._open(column)
+            if key not in self._index[column]:
+                return
+            f.seek(0, os.SEEK_END)
+            f.write(struct.pack("<II", len(key), self._TOMB) + key)
+            f.flush()
+            self._index[column].pop(key, None)
+
+    def keys(self, column):
+        with self._lock:
+            self._open(column)
+            return iter(list(self._index[column]))
+
+    def compact(self, column: bytes) -> None:
+        """Rewrite the segment with live records only."""
+        with self._lock:
+            f = self._open(column)
+            f.flush()
+            live = []
+            for key in list(self._index[column]):
+                off, vlen = self._index[column][key]
+                f.seek(off)
+                live.append((key, f.read(vlen)))
+            self._files[column].close()
+            tmp = self._segment(column) + ".tmp"
+            index = {}
+            with open(tmp, "wb") as f:
+                pos = 0
+                for key, value in live:
+                    f.write(
+                        struct.pack("<II", len(key), len(value)) + key + value
+                    )
+                    index[key] = (pos + 8 + len(key), len(value))
+                    pos += 8 + len(key) + len(value)
+            os.replace(tmp, self._segment(column))
+            self._files[column] = open(self._segment(column), "a+b")
+            self._index[column] = index
+
+    def close(self):
+        with self._lock:
+            for f in self._files.values():
+                f.close()
+            self._files.clear()
+
+
+# ---------------------------------------------------------------- hot/cold
+
+
+class HotColdDB:
+    """Hot (recent, by root) / cold (finalized history, by slot) split.
+
+    hot:  block_root -> SignedBeaconBlock; state_root -> BeaconState
+    cold: restore-point states every `slots_per_restore_point`; block
+          roots indexed by slot for replay-based reconstruction.
+    """
+
+    def __init__(
+        self,
+        spec: ChainSpec,
+        kv: KVStore = None,
+        slots_per_restore_point: int = None,
+    ):
+        self.spec = spec
+        self.kv = kv or MemoryStore()
+        self.split_slot = 0
+        self.sprp = slots_per_restore_point or (
+            2 * spec.preset.slots_per_epoch
+        )
+
+    # -- blocks
+
+    def put_block(self, root: bytes, signed_block) -> None:
+        self.kv.put(Column.BLOCK, root, signed_block.serialize())
+
+    def get_block(self, root: bytes):
+        raw = self.kv.get(Column.BLOCK, root)
+        return None if raw is None else T.SignedBeaconBlock.deserialize(raw)
+
+    # -- hot states
+
+    def put_state(self, state_root: bytes, state) -> None:
+        self.kv.put(Column.STATE, state_root, state.serialize())
+
+    def get_hot_state(self, state_root: bytes):
+        raw = self.kv.get(Column.STATE, state_root)
+        return None if raw is None else T.BeaconState.deserialize(raw)
+
+    def delete_state(self, state_root: bytes) -> None:
+        self.kv.delete(Column.STATE, state_root)
+
+    # -- cold section
+
+    def put_cold_block_root(self, slot: int, block_root: bytes) -> None:
+        self.kv.put(
+            Column.BLOCK_ROOT_BY_SLOT, struct.pack("<Q", slot), block_root
+        )
+
+    def get_cold_block_root(self, slot: int) -> Optional[bytes]:
+        return self.kv.get(Column.BLOCK_ROOT_BY_SLOT, struct.pack("<Q", slot))
+
+    def put_restore_point(self, slot: int, state) -> None:
+        self.kv.put(Column.COLD_STATE, struct.pack("<Q", slot), state.serialize())
+
+    def get_restore_point(self, slot: int):
+        raw = self.kv.get(Column.COLD_STATE, struct.pack("<Q", slot))
+        return None if raw is None else T.BeaconState.deserialize(raw)
+
+    def get_cold_state(self, slot: int):
+        """Reconstruct a historical state: nearest restore point at or
+        below `slot`, then replay stored blocks (BlockReplayer role)."""
+        from ..consensus import state_transition as st
+
+        rp_slot = slot - slot % self.sprp
+        state = self.get_restore_point(rp_slot)
+        if state is None:
+            return None
+        state = state.copy()
+        for s in range(rp_slot + 1, slot + 1):
+            root = self.get_cold_block_root(s)
+            if root is not None:
+                block = self.get_block(root)
+                if block is not None and block.message.slot == s:
+                    st.process_slots(self.spec, state, s)
+                    st.process_block(
+                        self.spec, state, block.message, verify_signatures=False
+                    )
+        if state.slot < slot:
+            st.process_slots(self.spec, state, slot)
+        return state
+
+    # -- migration (beacon_chain/src/migrate.rs role)
+
+    def migrate(self, finalized_slot: int, canonical_roots: dict) -> int:
+        """Advance the split: archive canonical block roots, write a
+        restore point at EVERY boundary in the window (skip-slot
+        boundaries get the nearest prior canonical state advanced with
+        empty slots — otherwise the whole following window would be
+        unreconstructable), then drop migrated hot states.
+        `canonical_roots`: slot -> (block_root, state_root)."""
+        from ..consensus import state_transition as st
+
+        moved = 0
+        carry_state = None  # latest canonical state seen in this walk
+        for slot in range(self.split_slot, finalized_slot + 1):
+            entry = canonical_roots.get(slot)
+            if entry is not None:
+                self.put_cold_block_root(slot, entry[0])
+                state = self.get_hot_state(entry[1])
+                if state is not None:
+                    carry_state = state
+            if slot % self.sprp == 0:
+                if carry_state is not None and carry_state.slot == slot:
+                    self.put_restore_point(slot, carry_state)
+                    moved += 1
+                else:
+                    # skip-slot boundary: advance the nearest prior
+                    # canonical state (fall back to replaying the
+                    # previous cold window before its hot states go)
+                    base = carry_state
+                    if base is None and slot > 0:
+                        base = self.get_cold_state(
+                            max(self.split_slot - 1, 0)
+                        )
+                    if base is not None:
+                        adv = base.copy()
+                        if adv.slot < slot:
+                            st.process_slots(self.spec, adv, slot)
+                        self.put_restore_point(slot, adv)
+                        moved += 1
+        for slot in range(self.split_slot, finalized_slot + 1):
+            entry = canonical_roots.get(slot)
+            if entry is not None:
+                self.delete_state(entry[1])
+        self.split_slot = finalized_slot + 1
+        self.kv.put(
+            Column.METADATA, b"split_slot", struct.pack("<Q", self.split_slot)
+        )
+        return moved
+
+    def load_split(self) -> None:
+        raw = self.kv.get(Column.METADATA, b"split_slot")
+        if raw is not None:
+            self.split_slot = struct.unpack("<Q", raw)[0]

@@ -1,0 +1,246 @@
+"""Chain-level integration in the BeaconChainHarness style
+(beacon_node/beacon_chain/src/test_utils.rs): interop genesis, REAL
+signatures on blocks and attestations (cpu backend), gossip attestation
+batch verification, fork choice head movement."""
+
+import pytest
+
+from lighthouse_tpu.consensus import state_transition as st
+from lighthouse_tpu.consensus import types as T
+from lighthouse_tpu.consensus.domains import compute_signing_root, get_domain
+from lighthouse_tpu.consensus.signature_sets import _EpochSSZ
+from lighthouse_tpu.consensus.spec import mainnet_spec
+from lighthouse_tpu.crypto.bls.keys import SecretKey
+from lighthouse_tpu.node.beacon_chain import (
+    AttestationError,
+    BeaconChain,
+    BlockError,
+)
+
+# mainnet preset: 32 slots/epoch, so >= 256 validators keeps every
+# per-slot committee at 8 members (the tests index into position 5)
+N = 256
+
+
+class Harness:
+    def __init__(self):
+        self.spec = mainnet_spec()
+        self.keys = [SecretKey.from_seed(i.to_bytes(4, "big")) for i in range(N)]
+        pubkeys = [k.public_key().to_bytes() for k in self.keys]
+        self.genesis = st.interop_genesis_state(self.spec, pubkeys)
+        self.chain = BeaconChain(self.spec, self.genesis)
+
+    def sign_block(self, block) -> T.SignedBeaconBlock:
+        state = self.chain.head_state()
+        epoch = st.compute_epoch_at_slot(self.spec, block.slot)
+        domain = get_domain(
+            self.spec,
+            self.spec.domain_beacon_proposer,
+            epoch,
+            state.fork,
+            self.chain.genesis_validators_root,
+        )
+        root = compute_signing_root(block, domain)
+        sig = self.keys[block.proposer_index].sign(root)
+        return T.SignedBeaconBlock.make(message=block, signature=sig.to_bytes())
+
+    def randao_reveal(self, slot: int, proposer: int) -> bytes:
+        state = self.chain.head_state()
+        epoch = st.compute_epoch_at_slot(self.spec, slot)
+        domain = get_domain(
+            self.spec,
+            self.spec.domain_randao,
+            epoch,
+            state.fork,
+            self.chain.genesis_validators_root,
+        )
+        return self.keys[proposer].sign(
+            compute_signing_root(_EpochSSZ(epoch), domain)
+        ).to_bytes()
+
+    def extend_chain(self, slot: int) -> bytes:
+        """Produce, sign and import a block at `slot`."""
+        self.chain.on_slot(slot)
+        state = self.chain.head_state().copy()
+        if state.slot < slot:
+            st.process_slots(self.spec, state, slot)
+        proposer = st.get_beacon_proposer_index(self.spec, state)
+        block = self.chain.produce_block(
+            slot, randao_reveal=self.randao_reveal(slot, proposer)
+        )
+        signed = self.sign_block(block)
+        return self.chain.process_block(signed)
+
+    def make_attestation(self, slot: int, committee_pos: int):
+        """A single-bit gossip attestation by the committee member at
+        `committee_pos` of (slot, committee 0), properly signed."""
+        state = self.chain.head_state()
+        adv = state.copy()
+        if adv.slot < slot:
+            st.process_slots(self.spec, adv, slot)
+        committee = st.get_beacon_committee(self.spec, adv, slot, 0)
+        validator = committee[committee_pos]
+        epoch = st.compute_epoch_at_slot(self.spec, slot)
+        data = T.AttestationData.make(
+            slot=slot,
+            index=0,
+            beacon_block_root=self.chain.head.root,
+            source=T.Checkpoint.make(
+                epoch=adv.current_justified_checkpoint.epoch,
+                root=bytes(adv.current_justified_checkpoint.root),
+            ),
+            target=T.Checkpoint.make(
+                epoch=epoch, root=self._target_root(adv, epoch)
+            ),
+        )
+        domain = get_domain(
+            self.spec,
+            self.spec.domain_beacon_attester,
+            epoch,
+            adv.fork,
+            self.chain.genesis_validators_root,
+        )
+        sig = self.keys[validator].sign(compute_signing_root(data, domain))
+        bits = [False] * len(committee)
+        bits[committee_pos] = True
+        return T.Attestation.make(
+            aggregation_bits=bits, data=data, signature=sig.to_bytes()
+        )
+
+    def _target_root(self, state, epoch: int) -> bytes:
+        start = st.compute_start_slot_at_epoch(self.spec, epoch)
+        if start >= state.slot:
+            return self.chain.head.root
+        return st.get_block_root_at_slot(self.spec, state, start)
+
+
+@pytest.fixture(scope="module")
+def harness():
+    return Harness()
+
+
+def test_signed_block_import_moves_head(harness):
+    h = harness
+    root1 = h.extend_chain(1)
+    assert h.chain.head.root == root1
+    root2 = h.extend_chain(2)
+    assert h.chain.head.root == root2
+    assert h.chain.head.slot == 2
+
+
+def test_bad_proposal_signature_rejected(harness):
+    h = harness
+    slot = h.chain.head.slot + 1
+    h.chain.on_slot(slot)
+    state = h.chain.head_state().copy()
+    st.process_slots(h.spec, state, slot)
+    proposer = st.get_beacon_proposer_index(h.spec, state)
+    block = h.chain.produce_block(
+        slot, randao_reveal=h.randao_reveal(slot, proposer)
+    )
+    wrong_signer = (proposer + 1) % N
+    epoch = st.compute_epoch_at_slot(h.spec, slot)
+    domain = get_domain(
+        h.spec,
+        h.spec.domain_beacon_proposer,
+        epoch,
+        state.fork,
+        h.chain.genesis_validators_root,
+    )
+    sig = h.keys[wrong_signer].sign(compute_signing_root(block, domain))
+    bad = T.SignedBeaconBlock.make(message=block, signature=sig.to_bytes())
+    with pytest.raises(BlockError):
+        h.chain.process_block(bad)
+
+
+def test_gossip_attestation_batch(harness):
+    h = harness
+    head_slot = h.chain.head.slot
+    att_slot = head_slot  # attest to the head block at its own slot
+    h.chain.on_slot(att_slot + 1)  # inclusion window open
+    atts = [h.make_attestation(att_slot, pos) for pos in range(3)]
+    verified = [h.chain.verify_attestation_for_gossip(a) for a in atts]
+    good = h.chain.batch_verify_attestations(verified)
+    assert len(good) == 3
+
+
+def test_duplicate_attestation_filtered(harness):
+    h = harness
+    att = h.make_attestation(h.chain.head.slot, 3)
+    v = h.chain.verify_attestation_for_gossip(att)
+    h.chain.batch_verify_attestations([v])
+    with pytest.raises(AttestationError):
+        h.chain.verify_attestation_for_gossip(att)
+
+
+def test_poisoned_batch_falls_back(harness):
+    h = harness
+    att_slot = h.chain.head.slot
+    good_att = h.make_attestation(att_slot, 4)
+    bad_att = h.make_attestation(att_slot, 5)
+    bad_att.signature = good_att.signature  # wrong signer's signature
+    vs = [
+        h.chain.verify_attestation_for_gossip(good_att),
+        h.chain.verify_attestation_for_gossip(bad_att),
+    ]
+    good = h.chain.batch_verify_attestations(vs)
+    assert len(good) == 1
+    assert good[0].attestation is good_att
+
+
+def test_unknown_parent_rejected(harness):
+    h = harness
+    block = T.BeaconBlock.make(
+        slot=h.chain.head.slot + 1,
+        proposer_index=0,
+        parent_root=b"\xab" * 32,
+        state_root=b"\x00" * 32,
+        body=T.BeaconBlockBody.default(),
+    )
+    signed = T.SignedBeaconBlock.make(message=block, signature=b"\x00" * 96)
+    with pytest.raises(BlockError):
+        h.chain.process_block(signed)
+
+
+def test_finalized_migration_prunes_forks():
+    # fresh harness: a short canonical chain plus one orphaned fork block
+    h = Harness()
+    r1 = h.extend_chain(1)
+    # fork block at slot 2 on top of r1 (import, then abandon)
+    h.chain.on_slot(2)
+    state = h.chain.state_for_block(r1).copy()
+    st.process_slots(h.spec, state, 2)
+    proposer = st.get_beacon_proposer_index(h.spec, state)
+    fork_block = T.BeaconBlock.make(
+        slot=2,
+        proposer_index=proposer,
+        parent_root=r1,
+        state_root=b"\x00" * 32,
+        body=h.chain.produce_block(
+            2, randao_reveal=h.randao_reveal(2, proposer)
+        ).body,
+    )
+    st.process_block(h.spec, state.copy(), fork_block, verify_signatures=False)
+    tmp = state.copy()
+    st.process_block(h.spec, tmp, fork_block, verify_signatures=False)
+    fork_block.state_root = tmp.hash_tree_root()
+    fork_root = h.chain.process_block(
+        h.sign_block(fork_block), verify_signatures=True
+    )
+    # canonical chain continues from r1's child at slot 2 as well
+    r2 = h.extend_chain(3)
+    r3 = h.extend_chain(4)
+    assert h.chain.head.root == r3
+
+    # force finality at epoch 1 on the canonical head's chain
+    h.chain.on_slot(33)
+    h.chain.fork_choice.finalized_checkpoint = (1, r3)
+    h.chain.migrate_finalized()
+
+    # canonical history reconstructable from cold
+    cold = h.chain.store.get_cold_state(1)
+    assert cold is not None and cold.slot == 1
+    # orphaned fork state dropped from hot bookkeeping
+    assert fork_root not in h.chain._block_info
+    # canonical archive has the right roots (parent-walk, not overwrite)
+    assert h.chain.store.get_cold_block_root(3) == r2

@@ -1,0 +1,80 @@
+"""ForkChoice wrapper tests (consensus/fork_choice scenario style)."""
+
+import pytest
+
+from lighthouse_tpu.consensus.fork_choice import ForkChoice, ForkChoiceError
+from lighthouse_tpu.consensus.proto_array import ExecutionStatus
+from lighthouse_tpu.consensus.spec import mainnet_spec
+
+
+def root(n: int) -> bytes:
+    return n.to_bytes(32, "little")
+
+
+def make_fc():
+    fc = ForkChoice(mainnet_spec(), genesis_root=root(0))
+    bal = [32 * 10**9] * 4
+    # genesis -> 1 -> 2 ; 1 -> 3 (fork)
+    fc.on_block(5, 1, root(1), root(0), (0, root(0)), (0, root(0)), bal)
+    fc.on_block(5, 2, root(2), root(1), (0, root(0)), (0, root(0)), bal)
+    fc.on_block(5, 2, root(3), root(1), (0, root(0)), (0, root(0)), bal)
+    return fc
+
+
+def test_unknown_parent_rejected():
+    fc = ForkChoice(mainnet_spec(), genesis_root=root(0))
+    with pytest.raises(ForkChoiceError):
+        fc.on_block(5, 1, root(1), root(99), (0, root(0)), (0, root(0)), [])
+
+
+def test_future_block_rejected():
+    fc = ForkChoice(mainnet_spec(), genesis_root=root(0))
+    with pytest.raises(ForkChoiceError):
+        fc.on_block(1, 5, root(1), root(0), (0, root(0)), (0, root(0)), [])
+
+
+def test_votes_decide_head():
+    fc = make_fc()
+    fc.on_attestation(5, 0, root(2), 0, 2, is_from_block=True)
+    fc.on_attestation(5, 1, root(2), 0, 2, is_from_block=True)
+    fc.on_attestation(5, 2, root(3), 0, 2, is_from_block=True)
+    assert fc.get_head(5) == root(2)
+
+
+def test_current_slot_attestations_queued():
+    fc = make_fc()
+    # attestation for the current slot: queued, not applied
+    fc.on_attestation(5, 0, root(3), 0, 5)
+    assert fc.get_head(5) == root(3)  # tiebreak by root, no votes yet
+    fc.on_attestation(5, 1, root(2), 0, 5)
+    fc.on_attestation(5, 2, root(2), 0, 5)
+    # next slot they count
+    assert fc.get_head(6) == root(2)
+
+
+def test_equivocating_validators_lose_weight():
+    fc = make_fc()
+    fc.on_attestation(5, 0, root(2), 0, 2, is_from_block=True)
+    fc.on_attestation(5, 1, root(3), 0, 2, is_from_block=True)
+    fc.on_attestation(5, 2, root(3), 0, 2, is_from_block=True)
+    assert fc.get_head(5) == root(3)
+    fc.on_attester_slashing([1, 2])
+    assert fc.get_head(5) == root(2)
+
+
+def test_invalid_payload_moves_head():
+    fc = make_fc()
+    fc.on_attestation(5, 0, root(2), 0, 2, is_from_block=True)
+    assert fc.get_head(5) == root(2)
+    fc.on_execution_status(root(2), ExecutionStatus.INVALID)
+    assert fc.get_head(5) == root(3)
+
+
+def test_prune_keeps_finalized_subtree():
+    fc = make_fc()
+    fc.finalized_checkpoint = (1, root(1))
+    pruned = fc.prune()
+    assert pruned == 1  # genesis dropped
+    assert fc.contains_block(root(2))
+    assert fc.contains_block(root(3))
+    assert not fc.contains_block(root(0))

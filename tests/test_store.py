@@ -1,0 +1,171 @@
+"""Store tests: KV engines + hot/cold DB with replay reconstruction
+(beacon_node/store test posture: MemoryStore for logic, the durable
+engine exercised over reopen/compaction/torn-tail recovery)."""
+
+import pytest
+
+from lighthouse_tpu.consensus import types as T
+from lighthouse_tpu.consensus.spec import mainnet_spec
+from lighthouse_tpu.consensus import state_transition as st
+from lighthouse_tpu.node.store import (
+    Column,
+    HotColdDB,
+    LogStore,
+    MemoryStore,
+)
+from lighthouse_tpu.crypto.bls.keys import SecretKey
+
+
+@pytest.mark.parametrize("engine", ["memory", "log"])
+def test_kv_roundtrip(tmp_path, engine):
+    kv = MemoryStore() if engine == "memory" else LogStore(str(tmp_path))
+    kv.put(Column.BLOCK, b"k1", b"v1")
+    kv.put(Column.BLOCK, b"k2", b"v2")
+    kv.put(Column.STATE, b"k1", b"other-column")
+    assert kv.get(Column.BLOCK, b"k1") == b"v1"
+    assert kv.get(Column.STATE, b"k1") == b"other-column"
+    kv.put(Column.BLOCK, b"k1", b"v1b")  # overwrite
+    assert kv.get(Column.BLOCK, b"k1") == b"v1b"
+    kv.delete(Column.BLOCK, b"k2")
+    assert kv.get(Column.BLOCK, b"k2") is None
+    assert set(kv.keys(Column.BLOCK)) == {b"k1"}
+    kv.close()
+
+
+def test_log_store_reopen(tmp_path):
+    kv = LogStore(str(tmp_path))
+    kv.put(Column.BLOCK, b"a", b"1")
+    kv.put(Column.BLOCK, b"b", b"2")
+    kv.delete(Column.BLOCK, b"a")
+    kv.close()
+    kv2 = LogStore(str(tmp_path))
+    assert kv2.get(Column.BLOCK, b"a") is None
+    assert kv2.get(Column.BLOCK, b"b") == b"2"
+    kv2.close()
+
+
+def test_log_store_torn_tail(tmp_path):
+    kv = LogStore(str(tmp_path))
+    kv.put(Column.BLOCK, b"a", b"1")
+    kv.close()
+    # simulate a crash mid-append
+    with open(tmp_path / "blk.log", "ab") as f:
+        f.write(b"\x10\x00\x00\x00\x20")  # truncated record
+    kv2 = LogStore(str(tmp_path))
+    assert kv2.get(Column.BLOCK, b"a") == b"1"
+    kv2.put(Column.BLOCK, b"b", b"2")  # append still works after truncate
+    assert kv2.get(Column.BLOCK, b"b") == b"2"
+    kv2.close()
+
+
+def test_log_store_compaction(tmp_path):
+    kv = LogStore(str(tmp_path))
+    for i in range(50):
+        kv.put(Column.BLOCK, b"key", b"v%d" % i)
+    size_before = (tmp_path / "blk.log").stat().st_size
+    kv.compact(Column.BLOCK)
+    size_after = (tmp_path / "blk.log").stat().st_size
+    assert size_after < size_before
+    assert kv.get(Column.BLOCK, b"key") == b"v49"
+    kv.close()
+
+
+@pytest.fixture(scope="module")
+def chain():
+    """A small canonical chain: genesis + empty blocks at slots 1..4."""
+    spec = mainnet_spec()
+    pubkeys = [
+        SecretKey.from_seed(i.to_bytes(4, "big")).public_key().to_bytes()
+        for i in range(16)
+    ]
+    state = st.interop_genesis_state(spec, pubkeys)
+    blocks = []
+    for slot in range(1, 5):
+        pre = state.copy()
+        st.process_slots(spec, pre, slot)
+        proposer = st.get_beacon_proposer_index(spec, pre)
+        body = T.BeaconBlockBody.default()
+        body.sync_aggregate = T.SyncAggregate.make(
+            sync_committee_bits=[False] * spec.preset.sync_committee_size,
+            sync_committee_signature=b"\xc0" + b"\x00" * 95,
+        )
+        body.eth1_data = pre.eth1_data
+        block = T.BeaconBlock.make(
+            slot=slot,
+            proposer_index=proposer,
+            parent_root=pre.latest_block_header.hash_tree_root(),
+            state_root=b"\x00" * 32,
+            body=body,
+        )
+        st.process_block(spec, pre, block, verify_signatures=False)
+        block.state_root = pre.hash_tree_root()
+        blocks.append(
+            T.SignedBeaconBlock.make(message=block, signature=b"\x00" * 96)
+        )
+        state = pre
+    return spec, blocks, state
+
+
+def test_hot_cold_migration_and_replay(chain, tmp_path):
+    spec, blocks, final_state = chain
+    db = HotColdDB(spec, LogStore(str(tmp_path)), slots_per_restore_point=4)
+
+    # genesis restore point
+    genesis = None
+    canonical = {}
+    states = {}
+    state = None
+    # rebuild the chain states for storage
+    from lighthouse_tpu.crypto.bls.keys import SecretKey as SK
+
+    pubkeys = [
+        SK.from_seed(i.to_bytes(4, "big")).public_key().to_bytes()
+        for i in range(16)
+    ]
+    state = st.interop_genesis_state(spec, pubkeys)
+    genesis = state.copy()
+    db.put_restore_point(0, genesis)
+    canonical[0] = (genesis.latest_block_header.hash_tree_root(), b"\x00" * 32)
+    for sb in blocks:
+        block = sb.message
+        root = block.hash_tree_root()
+        db.put_block(root, sb)
+        st.process_slots(spec, state, block.slot)
+        st.process_block(spec, state, block, verify_signatures=False)
+        sroot = state.hash_tree_root()
+        db.put_state(sroot, state)
+        canonical[block.slot] = (root, sroot)
+        states[block.slot] = sroot
+
+    # block round-trips through SSZ
+    got = db.get_block(blocks[0].message.hash_tree_root())
+    assert got.message.slot == 1
+    assert got.serialize() == blocks[0].serialize()
+
+    # migrate finalized slots 0..3 to cold
+    db.migrate(3, canonical)
+    assert db.split_slot == 4
+    assert db.get_hot_state(states[2]) is None  # dropped from hot
+
+    # cold reconstruction replays blocks from the restore point
+    cold2 = db.get_cold_state(2)
+    assert cold2 is not None
+    assert cold2.slot == 2
+    # replayed state must match the state stored during import, minus
+    # nothing — exact root equality
+    from_replay = cold2.hash_tree_root()
+    # recompute expected by replaying manually
+    expect = genesis.copy()
+    for sb in blocks[:2]:
+        st.process_slots(spec, expect, sb.message.slot)
+        st.process_block(spec, expect, sb.message, verify_signatures=False)
+    assert from_replay == expect.hash_tree_root()
+
+
+def test_split_slot_persisted(chain, tmp_path):
+    spec, _, _ = chain
+    db = HotColdDB(spec, LogStore(str(tmp_path)))
+    db.migrate(7, {})
+    db2 = HotColdDB(spec, LogStore(str(tmp_path)))
+    db2.load_split()
+    assert db2.split_slot == 8
