@@ -109,14 +109,16 @@ PK_LADDER = jnp.asarray(
 _TOPFOLD_CACHE = {}
 
 
-def _topfold(width: int) -> jnp.ndarray:
+def _topfold(width: int) -> np.ndarray:
     """limbs(2^(B*width) mod p) at `width` — re-absorbs the top limb's
     carry-out instead of dropping it (crucial for NEGATIVE lazy values,
-    whose top carry is -1). Entries canonical (< 2^11, top limbs zero)."""
+    whose top carry is -1). Entries canonical (< 2^11, top limbs zero).
+
+    Cached as NUMPY (never jnp): a jnp constant materialized inside a
+    jit/scan trace is a tracer, and caching a tracer leaks it into
+    later traces (UnexpectedTracerError)."""
     if width not in _TOPFOLD_CACHE:
-        _TOPFOLD_CACHE[width] = jnp.asarray(
-            _limbs_raw(pow(2, B * width, P), width)
-        )
+        _TOPFOLD_CACHE[width] = _limbs_raw(pow(2, B * width, P), width)
     return _TOPFOLD_CACHE[width]
 
 

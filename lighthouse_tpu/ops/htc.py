@@ -196,9 +196,11 @@ _M_BITS = None
 
 
 def _m_bits(batch_n):
+    # numpy, never jnp: a jnp constant cached from inside a trace would
+    # be a leaked tracer (see fp._topfold)
     global _M_BITS
     if _M_BITS is None or _M_BITS.shape[0] != batch_n:
-        _M_BITS = jnp.asarray(
+        _M_BITS = np.ascontiguousarray(
             np.broadcast_to(
                 np.array([(_M_ABS >> i) & 1 for i in range(64)], np.int32),
                 (batch_n, 64),

@@ -323,12 +323,14 @@ _PSI_CY = None
 
 
 def _psi_consts():
+    # numpy, never jnp: a jnp constant cached from inside a trace would
+    # be a leaked tracer (see fp._topfold)
     global _PSI_CX, _PSI_CY
     if _PSI_CX is None:
         from ..crypto.bls import fields as FF
 
-        _PSI_CX = jnp.asarray(tower.f2_pack(FF.PSI_CX))
-        _PSI_CY = jnp.asarray(tower.f2_pack(FF.PSI_CY))
+        _PSI_CX = tower.f2_pack(FF.PSI_CX)
+        _PSI_CY = tower.f2_pack(FF.PSI_CY)
     return _PSI_CX, _PSI_CY
 
 

@@ -1,0 +1,51 @@
+"""Batched G1 multi-scalar multiplication on device.
+
+The KZG hot op (SURVEY.md §2.7 item 2): a blob commitment is a
+4096-term MSM over the Lagrange trusted setup. TPU-first shape: instead
+of Pippenger's data-dependent bucketing (scatter-heavy, serial on the
+VPU), run ONE shared double-and-add ladder over the whole point batch —
+255 scan steps of [n]-wide branchless Jacobian adds — then fold with
+the exact-add sum tree. All lanes progress in lockstep; the batch axis
+is the SIMD axis, and compile size is O(1) in n (one scan body + the
+two sum_tree bodies).
+
+`msm_g1(points, scalars)` is the host-facing wrapper: packs python
+points/ints, runs the jitted kernel (per padded bucket size), unpacks
+one affine point.
+"""
+
+from functools import partial
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..crypto.bls.params import R
+from . import fp, jacobian as J
+
+
+@partial(jax.jit, static_argnums=())
+def _msm_kernel(xs, ys, zs, bits):
+    """[sum_i scalar_i * P_i] for Jacobian G1 arrays [n, W] + bit
+    matrix [n, 255]."""
+    prod = J.scalar_mul(J.FP1, (xs, ys, zs), bits)
+    return J.sum_tree(J.FP1, prod, xs.shape[0])
+
+
+def _bucket(n: int) -> int:
+    return 1 << max(3, (n - 1).bit_length())
+
+
+def msm_g1(points: list, scalars: list):
+    """Host wrapper: affine points (or None) x python ints -> affine
+    point or None. Pads to power-of-two buckets for compile reuse."""
+    n = len(points)
+    if n == 0:
+        return None
+    npad = _bucket(n)
+    pts = list(points) + [None] * (npad - n)
+    sc = [s % R for s in scalars] + [0] * (npad - n)
+    xs, ys, zs = J.pack_g1(pts)
+    bits = jnp.asarray(J.scalars_to_bits(sc, 255))
+    out = _msm_kernel(xs, ys, zs, bits)
+    return J.unpack_g1(tuple(c[None] for c in out))[0]

@@ -1,0 +1,235 @@
+"""Slashing protection database (validator_client/slashing_protection
+analog): SQLite low/high-watermark checks before EVERY signature, plus
+EIP-3076 interchange import/export.
+
+The reference's invariant (slashing_protection crate): a validator may
+never sign (a) two different blocks at the same or lower slot, (b) an
+attestation whose source is older than a previously signed source
+(surround-vulnerable), or (c) an attestation whose target is at or
+below a previously signed target (double/surrounded). Enforced here
+with the same conservative monotonic-watermark scheme.
+"""
+
+from __future__ import annotations
+
+import json
+import sqlite3
+import threading
+from typing import Optional
+
+
+class SlashingProtectionError(Exception):
+    pass
+
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS validators (
+    id INTEGER PRIMARY KEY,
+    pubkey BLOB UNIQUE NOT NULL
+);
+CREATE TABLE IF NOT EXISTS signed_blocks (
+    validator_id INTEGER NOT NULL REFERENCES validators(id),
+    slot INTEGER NOT NULL,
+    signing_root BLOB,
+    UNIQUE (validator_id, slot)
+);
+CREATE TABLE IF NOT EXISTS signed_attestations (
+    validator_id INTEGER NOT NULL REFERENCES validators(id),
+    source_epoch INTEGER NOT NULL,
+    target_epoch INTEGER NOT NULL,
+    signing_root BLOB,
+    UNIQUE (validator_id, target_epoch)
+);
+"""
+
+
+class SlashingProtectionDB:
+    def __init__(self, path: str = ":memory:"):
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._conn.executescript(_SCHEMA)
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------ registry
+
+    def register_validator(self, pubkey: bytes) -> None:
+        with self._lock:
+            self._conn.execute(
+                "INSERT OR IGNORE INTO validators (pubkey) VALUES (?)",
+                (bytes(pubkey),),
+            )
+            self._conn.commit()
+
+    def _vid(self, pubkey: bytes) -> int:
+        row = self._conn.execute(
+            "SELECT id FROM validators WHERE pubkey = ?", (bytes(pubkey),)
+        ).fetchone()
+        if row is None:
+            raise SlashingProtectionError("validator not registered")
+        return row[0]
+
+    # ------------------------------------------------------------ blocks
+
+    def check_and_insert_block_proposal(
+        self, pubkey: bytes, slot: int, signing_root: bytes
+    ) -> None:
+        """Raise unless signing this proposal is safe; record it."""
+        with self._lock:
+            vid = self._vid(pubkey)
+            row = self._conn.execute(
+                "SELECT slot, signing_root FROM signed_blocks "
+                "WHERE validator_id = ? AND slot = ?",
+                (vid, slot),
+            ).fetchone()
+            if row is not None:
+                if row[1] == signing_root:
+                    return  # exact re-sign of the same block: safe
+                raise SlashingProtectionError(
+                    f"double block proposal at slot {slot}"
+                )
+            max_slot = self._conn.execute(
+                "SELECT MAX(slot) FROM signed_blocks WHERE validator_id = ?",
+                (vid,),
+            ).fetchone()[0]
+            if max_slot is not None and slot <= max_slot:
+                raise SlashingProtectionError(
+                    f"slot {slot} not above watermark {max_slot}"
+                )
+            self._conn.execute(
+                "INSERT INTO signed_blocks VALUES (?, ?, ?)",
+                (vid, slot, signing_root),
+            )
+            self._conn.commit()
+
+    # ------------------------------------------------------------ attestations
+
+    def check_and_insert_attestation(
+        self,
+        pubkey: bytes,
+        source_epoch: int,
+        target_epoch: int,
+        signing_root: bytes,
+    ) -> None:
+        if source_epoch > target_epoch:
+            raise SlashingProtectionError("source after target")
+        with self._lock:
+            vid = self._vid(pubkey)
+            row = self._conn.execute(
+                "SELECT source_epoch, signing_root FROM signed_attestations "
+                "WHERE validator_id = ? AND target_epoch = ?",
+                (vid, target_epoch),
+            ).fetchone()
+            if row is not None:
+                if row[1] == signing_root and row[0] == source_epoch:
+                    return  # exact duplicate: safe
+                raise SlashingProtectionError(
+                    f"double vote for target {target_epoch}"
+                )
+            ms, mt = self._conn.execute(
+                "SELECT MAX(source_epoch), MAX(target_epoch) "
+                "FROM signed_attestations WHERE validator_id = ?",
+                (vid,),
+            ).fetchone()
+            if ms is not None and source_epoch < ms:
+                raise SlashingProtectionError(
+                    f"surround-vulnerable: source {source_epoch} < {ms}"
+                )
+            if mt is not None and target_epoch <= mt:
+                raise SlashingProtectionError(
+                    f"target {target_epoch} not above watermark {mt}"
+                )
+            self._conn.execute(
+                "INSERT INTO signed_attestations VALUES (?, ?, ?, ?)",
+                (vid, source_epoch, target_epoch, signing_root),
+            )
+            self._conn.commit()
+
+    # ------------------------------------------------------------ interchange
+
+    def export_interchange(self, genesis_validators_root: bytes) -> dict:
+        """EIP-3076 interchange format export."""
+        out = {
+            "metadata": {
+                "interchange_format_version": "5",
+                "genesis_validators_root": "0x"
+                + bytes(genesis_validators_root).hex(),
+            },
+            "data": [],
+        }
+        with self._lock:
+            for vid, pubkey in self._conn.execute(
+                "SELECT id, pubkey FROM validators"
+            ).fetchall():
+                blocks = [
+                    {
+                        "slot": str(slot),
+                        **(
+                            {"signing_root": "0x" + sr.hex()}
+                            if sr
+                            else {}
+                        ),
+                    }
+                    for slot, sr in self._conn.execute(
+                        "SELECT slot, signing_root FROM signed_blocks "
+                        "WHERE validator_id = ?",
+                        (vid,),
+                    ).fetchall()
+                ]
+                atts = [
+                    {
+                        "source_epoch": str(se),
+                        "target_epoch": str(te),
+                        **(
+                            {"signing_root": "0x" + sr.hex()}
+                            if sr
+                            else {}
+                        ),
+                    }
+                    for se, te, sr in self._conn.execute(
+                        "SELECT source_epoch, target_epoch, signing_root "
+                        "FROM signed_attestations WHERE validator_id = ?",
+                        (vid,),
+                    ).fetchall()
+                ]
+                out["data"].append(
+                    {
+                        "pubkey": "0x" + pubkey.hex(),
+                        "signed_blocks": blocks,
+                        "signed_attestations": atts,
+                    }
+                )
+        return out
+
+    def import_interchange(self, obj: dict) -> int:
+        """Import (merge, keeping the most restrictive watermarks)."""
+        count = 0
+        for entry in obj.get("data", []):
+            pubkey = bytes.fromhex(entry["pubkey"][2:])
+            self.register_validator(pubkey)
+            for b in entry.get("signed_blocks", []):
+                try:
+                    self.check_and_insert_block_proposal(
+                        pubkey,
+                        int(b["slot"]),
+                        bytes.fromhex(b["signing_root"][2:])
+                        if "signing_root" in b
+                        else b"",
+                    )
+                except SlashingProtectionError:
+                    pass  # keep existing, more restrictive record
+            for a in entry.get("signed_attestations", []):
+                try:
+                    self.check_and_insert_attestation(
+                        pubkey,
+                        int(a["source_epoch"]),
+                        int(a["target_epoch"]),
+                        bytes.fromhex(a["signing_root"][2:])
+                        if "signing_root" in a
+                        else b"",
+                    )
+                except SlashingProtectionError:
+                    pass
+            count += 1
+        return count
+
+    def to_json(self, genesis_validators_root: bytes) -> str:
+        return json.dumps(self.export_interchange(genesis_validators_root))

@@ -1,0 +1,103 @@
+"""KZG tests on a small dev domain (the EF KZG vector suite's role,
+SURVEY.md §4.1 — run against the internal oracle since ceremony files
+aren't available offline)."""
+
+import secrets
+
+import pytest
+
+from lighthouse_tpu.crypto import kzg as K
+from lighthouse_tpu.crypto.bls import curve as C
+from lighthouse_tpu.crypto.bls.params import R
+
+N = 64  # small domain: same math as 4096, test-speed setup
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    return K.Kzg(K.TrustedSetup.dev(N))
+
+
+def rand_blob(seed: int = 0) -> bytes:
+    out = b""
+    x = seed
+    for i in range(N):
+        x = (x * 6364136223846793005 + 1442695040888963407) % 2**64
+        out += ((x * 31 + i) % R).to_bytes(32, "big")
+    return out
+
+
+def test_roots_of_unity_form_a_group():
+    roots = K.compute_roots_of_unity(N)
+    assert len(set(roots)) == N
+    for w in roots:
+        assert pow(w, N, R) == 1
+
+
+def test_commitment_matches_direct_evaluation(ctx):
+    """C == [p(tau)]G1: the Lagrange-form MSM must equal committing to
+    the polynomial evaluated at the (known, dev) tau."""
+    blob = rand_blob(1)
+    fields = K.blob_to_field_elements(blob, N)
+    cm = ctx.blob_to_kzg_commitment(blob)
+    import hashlib
+
+    tau = (
+        int.from_bytes(
+            hashlib.sha256(b"lighthouse-tpu insecure dev tau").digest(), "big"
+        )
+        % R
+    )
+    p_tau = ctx.evaluate_polynomial(fields, tau)
+    assert cm == C.g1_mul(K.G1_GEN, p_tau)
+
+
+def test_evaluate_on_domain_returns_stored_value(ctx):
+    blob = rand_blob(2)
+    fields = K.blob_to_field_elements(blob, N)
+    for i in (0, 3, N - 1):
+        assert ctx.evaluate_polynomial(fields, ctx.setup.roots[i]) == fields[i]
+
+
+def test_proof_roundtrip_off_domain(ctx):
+    blob = rand_blob(3)
+    z = 123456789
+    proof, y = ctx.compute_kzg_proof(blob, z)
+    assert ctx.verify_kzg_proof(ctx.blob_to_kzg_commitment(blob), z, y, proof)
+    # wrong evaluation rejected
+    assert not ctx.verify_kzg_proof(
+        ctx.blob_to_kzg_commitment(blob), z, (y + 1) % R, proof
+    )
+
+
+def test_proof_roundtrip_on_domain(ctx):
+    blob = rand_blob(4)
+    z = ctx.setup.roots[5]
+    proof, y = ctx.compute_kzg_proof(blob, z)
+    fields = K.blob_to_field_elements(blob, N)
+    assert y == fields[5]
+    assert ctx.verify_kzg_proof(ctx.blob_to_kzg_commitment(blob), z, y, proof)
+
+
+def test_blob_proof_and_batch(ctx):
+    blobs = [rand_blob(i) for i in range(3)]
+    cms = [ctx.blob_to_kzg_commitment(b) for b in blobs]
+    proofs = [ctx.compute_blob_kzg_proof(b, c)[0] for b, c in zip(blobs, cms)]
+    for b, c, p in zip(blobs, cms, proofs):
+        assert ctx.verify_blob_kzg_proof(b, c, p)
+    assert ctx.verify_blob_kzg_proof_batch(blobs, cms, proofs)
+    # corrupt one proof: batch must fail
+    bad = list(proofs)
+    bad[1] = proofs[0]
+    assert not ctx.verify_blob_kzg_proof_batch(blobs, cms, bad)
+    # empty batch succeeds
+    assert ctx.verify_blob_kzg_proof_batch([], [], [])
+
+
+def test_msm_device_matches_host(ctx):
+    """The device MSM path must agree with the host control."""
+    from lighthouse_tpu.ops.msm import msm_g1
+
+    pts = ctx.setup.g1_lagrange[:8]
+    scalars = [secrets.randbelow(R) for _ in range(8)]
+    assert msm_g1(pts, scalars) == K._msm_host(pts, scalars)
