@@ -9,14 +9,13 @@ Layer map (mirrors reference SURVEY.md §1):
   crypto/    — L0: BLS12-381 + KZG primitives, three backends (cpu/tpu/fake)
                like the reference's blst/fake_crypto seam
                (reference: crypto/bls/src/lib.rs:87-142)
-  consensus/ — L1-L2: types, state transition, fork choice
-  scheduler/ — L6: prioritized multi-queue work scheduler (beacon_processor)
-  net/       — L7: gossip/req-resp distributed plane (host-side)
-  node/      — L8-L9: assembly, APIs, processes
+  consensus/ — L1-L2: types, state transition, fork choice, proto-array
+  node/      — L3-L6: BeaconChain core, beacon_processor scheduler, store
+  validator/ — L-VC: validator-client components (slashing protection, ...)
   ops/       — JAX/Pallas kernels (big-int limb arithmetic, curve ops, pairing)
-  parallel/  — device-mesh sharding of crypto batches (psum over ICI)
-  models/    — flagship end-to-end pipelines (attestation batch verifier)
-  utils/     — cross-cutting commons (metrics, slot clock, task executor)
+  parallel/  — device-mesh sharding of crypto batches over ICI (shard_map)
+  common/    — cross-cutting commons (metrics registry, slot clock)
+  tools/     — offline derivation utilities (G2 isogeny constants)
 """
 
 __version__ = "0.1.0"

@@ -1,0 +1,118 @@
+"""Generalized merkle proofs over the SSZ tree (consensus/merkle_proof
+analog, merkle_proof/src/lib.rs:607).
+
+Proof convention: sibling hashes bottom-up; `index` is the leaf's
+position flattened under the proof's root (gindex minus 2^depth), so
+bit i of `index` says whether the node at level i is a right child.
+`verify_merkle_branch` is the spec's is_valid_merkle_branch.
+
+The concrete proof this round exists for: BlobSidecar's 17-deep
+kzg_commitment inclusion proof into the block body
+(deneb verify_blob_sidecar_inclusion_proof; the reference builds these
+in beacon_chain/src/kzg_utils.rs blob->sidecar construction).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Sequence
+
+from . import types as T
+from .ssz import _ZERO_CHUNKS, _next_pow2
+
+
+def _hash(a: bytes, b: bytes) -> bytes:
+    return hashlib.sha256(a + b).digest()
+
+
+def merkle_branch(chunks: Sequence[bytes], limit: int, index: int) -> list:
+    """Sibling path (bottom-up) for leaf `index` in the zero-padded tree
+    of `limit` leaves over `chunks`."""
+    width = _next_pow2(limit)
+    depth = width.bit_length() - 1
+    layer = list(chunks)
+    branch = []
+    for d in range(depth):
+        if len(layer) % 2:
+            layer.append(_ZERO_CHUNKS[d])
+        sib = index ^ 1
+        branch.append(layer[sib] if sib < len(layer) else _ZERO_CHUNKS[d])
+        layer = [
+            _hash(layer[i], layer[i + 1]) for i in range(0, len(layer), 2)
+        ]
+        index //= 2
+    return branch
+
+
+def verify_merkle_branch(
+    leaf: bytes, branch: Sequence[bytes], depth: int, index: int, root: bytes
+) -> bool:
+    """Spec is_valid_merkle_branch."""
+    node = leaf
+    for i in range(depth):
+        if (index >> i) & 1:
+            node = _hash(bytes(branch[i]), node)
+        else:
+            node = _hash(node, bytes(branch[i]))
+    return node == root
+
+
+# ------------------------------------------------- blob inclusion proofs
+
+_BODY_FIELDS = [name for name, _ in T.BeaconBlockBody.fields]
+_COMMITMENTS_FIELD_INDEX = _BODY_FIELDS.index("blob_kzg_commitments")
+_COMMITMENTS_TYPE = dict(T.BeaconBlockBody.fields)["blob_kzg_commitments"]
+_BODY_WIDTH = _next_pow2(len(_BODY_FIELDS))
+_BODY_DEPTH = _BODY_WIDTH.bit_length() - 1  # 4
+_LIST_DEPTH = _next_pow2(_COMMITMENTS_TYPE.limit).bit_length() - 1  # 12
+
+# flattened leaf index under the body root for commitment i:
+#   body field (depth 4) -> left child of length mix-in (depth 1)
+#   -> list leaf (depth 12)
+assert (
+    _BODY_DEPTH + 1 + _LIST_DEPTH == T.KZG_COMMITMENT_INCLUSION_PROOF_DEPTH
+)
+
+
+def blob_inclusion_index(blob_index: int) -> int:
+    return (
+        _COMMITMENTS_FIELD_INDEX * 2 ** (1 + _LIST_DEPTH)  # body levels
+        + 0 * 2**_LIST_DEPTH  # list root is the LEFT child of the mix-in
+        + blob_index
+    )
+
+
+def compute_blob_inclusion_proof(body, blob_index: int) -> list:
+    """The 17 siblings proving body.blob_kzg_commitments[blob_index]
+    against the body root (KZG_COMMITMENT_INCLUSION_PROOF_DEPTH)."""
+    commitments = list(body.blob_kzg_commitments)
+    elem = _COMMITMENTS_TYPE.elem
+    leaves = [elem.hash_tree_root(c) for c in commitments]
+    proof = merkle_branch(leaves, _COMMITMENTS_TYPE.limit, blob_index)
+    # length mix-in: the sibling is the length chunk (we sit on the left)
+    proof.append(len(commitments).to_bytes(32, "little"))
+    # body container levels
+    field_roots = [
+        ftype.hash_tree_root(getattr(body, fname))
+        for fname, ftype in T.BeaconBlockBody.fields
+    ]
+    proof.extend(
+        merkle_branch(field_roots, _BODY_WIDTH, _COMMITMENTS_FIELD_INDEX)
+    )
+    return proof
+
+
+def verify_blob_inclusion_proof(
+    body_root: bytes, commitment: bytes, blob_index: int, proof: Sequence[bytes]
+) -> bool:
+    """deneb verify_blob_sidecar_inclusion_proof."""
+    if len(proof) != T.KZG_COMMITMENT_INCLUSION_PROOF_DEPTH:
+        return False
+    leaf = _COMMITMENTS_TYPE.elem.hash_tree_root(commitment)
+    return verify_merkle_branch(
+        leaf,
+        proof,
+        T.KZG_COMMITMENT_INCLUSION_PROOF_DEPTH,
+        blob_inclusion_index(blob_index),
+        body_root,
+    )

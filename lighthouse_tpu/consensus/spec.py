@@ -41,6 +41,17 @@ class Preset:
     sync_committee_size: int
     sync_committee_subnet_count: int
     epochs_per_sync_committee_period: int
+    # execution payload (Bellatrix+)
+    max_bytes_per_transaction: int
+    max_transactions_per_payload: int
+    bytes_per_logs_bloom: int
+    max_extra_data_bytes: int
+    # withdrawals (Capella+)
+    max_withdrawals_per_payload: int
+    max_validators_per_withdrawals_sweep: int
+    # blobs (Deneb+)
+    field_elements_per_blob: int
+    max_blobs_per_block: int
 
 
 MAINNET_PRESET = Preset(
@@ -66,6 +77,14 @@ MAINNET_PRESET = Preset(
     sync_committee_size=512,
     sync_committee_subnet_count=4,
     epochs_per_sync_committee_period=256,
+    max_bytes_per_transaction=2**30,
+    max_transactions_per_payload=2**20,
+    bytes_per_logs_bloom=256,
+    max_extra_data_bytes=32,
+    max_withdrawals_per_payload=16,
+    max_validators_per_withdrawals_sweep=16384,
+    field_elements_per_blob=4096,
+    max_blobs_per_block=6,
 )
 
 MINIMAL_PRESET = Preset(
@@ -91,6 +110,14 @@ MINIMAL_PRESET = Preset(
     sync_committee_size=32,
     sync_committee_subnet_count=4,
     epochs_per_sync_committee_period=8,
+    max_bytes_per_transaction=2**30,
+    max_transactions_per_payload=2**20,
+    bytes_per_logs_bloom=256,
+    max_extra_data_bytes=32,
+    max_withdrawals_per_payload=4,
+    max_validators_per_withdrawals_sweep=16,
+    field_elements_per_blob=4,
+    max_blobs_per_block=6,
 )
 
 

@@ -34,7 +34,7 @@ from ..crypto.bls.keys import PublicKey, Signature, SignatureSet
 from . import types as T
 from .domains import compute_domain, compute_signing_root, get_domain
 from .shuffling import compute_committee, compute_shuffled_index
-from .spec import ChainSpec, FAR_FUTURE_EPOCH, GENESIS_EPOCH
+from .spec import ChainSpec, FAR_FUTURE_EPOCH, GENESIS_EPOCH, GENESIS_SLOT
 
 # Altair participation flags (participation_flags.rs analog)
 TIMELY_SOURCE_FLAG_INDEX = 0
@@ -375,11 +375,153 @@ def _pubkey_getter(state):
 def process_block(
     spec: ChainSpec, state, block, verify_signatures: bool = True
 ) -> None:
+    """per_block_processing.rs:100 order: header, (withdrawals, payload)
+    for the execution forks, randao, eth1, operations, sync aggregate."""
     process_block_header(spec, state, block)
+    process_withdrawals(spec, state, block.body.execution_payload)
+    process_execution_payload(spec, state, block.body)
     process_randao(spec, state, block, verify_signatures)
     process_eth1_data(spec, state, block.body)
     process_operations(spec, state, block.body, verify_signatures)
     process_sync_aggregate(spec, state, block.body.sync_aggregate, verify_signatures)
+
+
+# ------------------------------------------------------- execution payload
+
+
+def compute_timestamp_at_slot(spec: ChainSpec, state, slot: int) -> int:
+    return state.genesis_time + (slot - GENESIS_SLOT) * spec.seconds_per_slot
+
+
+def is_merge_transition_complete(state) -> bool:
+    """True once the state carries a real payload header.
+    `interop_genesis_state` pre-fills a genesis EL block hash, so interop
+    chains are post-merge from birth and payload ancestry is enforced
+    from the first block; only a pristine pre-merge state is False."""
+    return (
+        bytes(state.latest_execution_payload_header.block_hash) != b"\x00" * 32
+        or state.latest_execution_payload_header.block_number != 0
+        or bytes(state.latest_execution_payload_header.prev_randao) != b"\x00" * 32
+    )
+
+
+def process_execution_payload(spec: ChainSpec, state, body) -> None:
+    """Consensus-side payload checks + header rotation
+    (process_execution_payload in per_block_processing.rs; the EL-side
+    validity check is notify_new_payload through the engine API, which
+    the chain layer drives asynchronously)."""
+    payload = body.execution_payload
+    if is_merge_transition_complete(state):
+        if bytes(payload.parent_hash) != bytes(
+            state.latest_execution_payload_header.block_hash
+        ):
+            raise BlockProcessingError("payload parent hash mismatch")
+    if bytes(payload.prev_randao) != get_randao_mix(
+        spec, state, get_current_epoch(spec, state)
+    ):
+        raise BlockProcessingError("payload prev_randao mismatch")
+    if payload.timestamp != compute_timestamp_at_slot(spec, state, state.slot):
+        raise BlockProcessingError("payload timestamp mismatch")
+    if len(body.blob_kzg_commitments) > spec.preset.max_blobs_per_block:
+        raise BlockProcessingError("too many blob commitments")
+    state.latest_execution_payload_header = T.execution_payload_to_header(
+        payload
+    )
+
+
+# ------------------------------------------------------------ withdrawals
+
+
+def has_eth1_withdrawal_credential(validator) -> bool:
+    return bytes(validator.withdrawal_credentials)[:1] == b"\x01"
+
+
+def is_fully_withdrawable_validator(validator, balance: int, epoch: int) -> bool:
+    return (
+        has_eth1_withdrawal_credential(validator)
+        and validator.withdrawable_epoch <= epoch
+        and balance > 0
+    )
+
+
+def is_partially_withdrawable_validator(spec: ChainSpec, validator, balance: int) -> bool:
+    return (
+        has_eth1_withdrawal_credential(validator)
+        and validator.effective_balance == spec.max_effective_balance
+        and balance > spec.max_effective_balance
+    )
+
+
+def get_expected_withdrawals(spec: ChainSpec, state) -> list:
+    """The deterministic sweep (capella get_expected_withdrawals):
+    bounded scan from next_withdrawal_validator_index collecting full
+    and excess-balance withdrawals."""
+    epoch = get_current_epoch(spec, state)
+    widx = state.next_withdrawal_index
+    vidx = state.next_withdrawal_validator_index
+    withdrawals = []
+    n = len(state.validators)
+    for _ in range(min(n, spec.preset.max_validators_per_withdrawals_sweep)):
+        v = state.validators[vidx]
+        balance = state.balances[vidx]
+        if is_fully_withdrawable_validator(v, balance, epoch):
+            withdrawals.append(
+                T.Withdrawal.make(
+                    index=widx,
+                    validator_index=vidx,
+                    address=bytes(v.withdrawal_credentials)[12:],
+                    amount=balance,
+                )
+            )
+            widx += 1
+        elif is_partially_withdrawable_validator(spec, v, balance):
+            withdrawals.append(
+                T.Withdrawal.make(
+                    index=widx,
+                    validator_index=vidx,
+                    address=bytes(v.withdrawal_credentials)[12:],
+                    amount=balance - spec.max_effective_balance,
+                )
+            )
+            widx += 1
+        if len(withdrawals) == spec.preset.max_withdrawals_per_payload:
+            break
+        vidx = (vidx + 1) % n
+    return withdrawals
+
+
+def process_withdrawals(spec: ChainSpec, state, payload) -> None:
+    """capella process_withdrawals: the payload's withdrawals must equal
+    the state-derived expectation; balances decrease; sweep cursors
+    advance."""
+    expected = get_expected_withdrawals(spec, state)
+    got = list(payload.withdrawals)
+    if len(got) != len(expected):
+        raise BlockProcessingError("withdrawal count mismatch")
+    for w, e in zip(got, expected):
+        if (
+            w.index != e.index
+            or w.validator_index != e.validator_index
+            or bytes(w.address) != bytes(e.address)
+            or w.amount != e.amount
+        ):
+            raise BlockProcessingError("withdrawal mismatch")
+    for w in expected:
+        decrease_balance(state, w.validator_index, w.amount)
+    if expected:
+        state.next_withdrawal_index = expected[-1].index + 1
+    n = len(state.validators)
+    if len(expected) == spec.preset.max_withdrawals_per_payload:
+        state.next_withdrawal_validator_index = (
+            expected[-1].validator_index + 1
+        ) % n
+    else:
+        # spec: advance by the UNclamped sweep constant (clamping to n
+        # diverges from other clients whenever sweep % n != 0)
+        state.next_withdrawal_validator_index = (
+            state.next_withdrawal_validator_index
+            + spec.preset.max_validators_per_withdrawals_sweep
+        ) % n
 
 
 def process_block_header(spec: ChainSpec, state, block) -> None:
@@ -1232,16 +1374,23 @@ def _state_field_type(name: str):
 
 
 def process_historical_roots_update(spec: ChainSpec, state) -> None:
+    """Capella+ accumulates HistoricalSummary records (the pre-Capella
+    historical_roots list is frozen, per_epoch_processing historical
+    summaries update)."""
     next_epoch = get_current_epoch(spec, state) + 1
     epochs_per_period = (
         spec.preset.slots_per_historical_root // spec.preset.slots_per_epoch
     )
     if next_epoch % epochs_per_period == 0:
-        batch_root = _hash(
-            _state_field_type("block_roots").hash_tree_root(state.block_roots)
-            + _state_field_type("state_roots").hash_tree_root(state.state_roots)
+        summary = T.HistoricalSummary.make(
+            block_summary_root=_state_field_type("block_roots").hash_tree_root(
+                state.block_roots
+            ),
+            state_summary_root=_state_field_type("state_roots").hash_tree_root(
+                state.state_roots
+            ),
         )
-        state.historical_roots = list(state.historical_roots) + [batch_root]
+        state.historical_summaries = list(state.historical_summaries) + [summary]
 
 
 def process_participation_flag_updates(state) -> None:
@@ -1254,6 +1403,27 @@ def process_sync_committee_updates(spec: ChainSpec, state) -> None:
     if next_epoch % spec.preset.epochs_per_sync_committee_period == 0:
         state.current_sync_committee = state.next_sync_committee
         state.next_sync_committee = get_next_sync_committee(spec, state)
+
+
+def mock_execution_payload(spec: ChainSpec, state):
+    """A payload consistent with `state` (advanced to the block's slot)
+    that process_execution_payload/process_withdrawals will accept — the
+    MockExecutionLayer role (execution_layer/src/test_utils in the
+    reference): parent linked to the state's header, fresh fake block
+    hash, expected withdrawals included. Replaced by engine-API
+    get_payload when a real EL is attached."""
+    parent = bytes(state.latest_execution_payload_header.block_hash)
+    return T.ExecutionPayload.make(
+        parent_hash=parent,
+        prev_randao=get_randao_mix(spec, state, get_current_epoch(spec, state)),
+        block_number=state.latest_execution_payload_header.block_number + 1,
+        gas_limit=30_000_000,
+        timestamp=compute_timestamp_at_slot(spec, state, state.slot),
+        block_hash=_hash(
+            b"mock-el-block" + parent + state.slot.to_bytes(8, "little")
+        ),
+        withdrawals=get_expected_withdrawals(spec, state),
+    )
 
 
 # ---------------------------------------------------------------- genesis
@@ -1301,4 +1471,14 @@ def interop_genesis_state(
     committee = get_next_sync_committee(spec, state)
     state.current_sync_committee = committee
     state.next_sync_committee = get_next_sync_committee(spec, state)
+    # post-merge from birth: a synthetic genesis EL block anchors the
+    # payload parent-hash chain starting at the FIRST block (otherwise
+    # is_merge_transition_complete is False and slot-1 payload ancestry
+    # would go unchecked)
+    state.latest_execution_payload_header = T.ExecutionPayloadHeader.make(
+        block_hash=_hash(
+            b"interop-genesis-el-block" + bytes(state.genesis_validators_root)
+        ),
+        timestamp=genesis_time,
+    )
     return state

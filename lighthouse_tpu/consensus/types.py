@@ -15,6 +15,7 @@ from .ssz import (
     Bitlist,
     Bitvector,
     ByteList,
+    ByteVector,
     uint8,
     uint64,
     uint256,
@@ -206,28 +207,76 @@ SyncAggregate = Container(
     ],
 )
 
+Withdrawal = Container(
+    "Withdrawal",
+    [
+        ("index", uint64),
+        ("validator_index", uint64),
+        ("address", Bytes20),
+        ("amount", uint64),
+    ],
+)
+
+# EL transactions are opaque SSZ byte lists (engine boundary)
+Transaction = ByteList(_P.max_bytes_per_transaction)
+
+# the common (parent_hash .. base_fee_per_gas) prefix of payload/header
+_PAYLOAD_PREFIX = [
+    ("parent_hash", Bytes32),
+    ("fee_recipient", Bytes20),
+    ("state_root", Bytes32),
+    ("receipts_root", Bytes32),
+    ("logs_bloom", ByteVector(_P.bytes_per_logs_bloom)),
+    ("prev_randao", Bytes32),
+    ("block_number", uint64),
+    ("gas_limit", uint64),
+    ("gas_used", uint64),
+    ("timestamp", uint64),
+    ("extra_data", ByteList(_P.max_extra_data_bytes)),
+    ("base_fee_per_gas", uint256),
+    ("block_hash", Bytes32),
+]
+
+# Full payload as carried in block bodies (Deneb shape,
+# consensus/types/src/execution_payload.rs)
+ExecutionPayload = Container(
+    "ExecutionPayload",
+    _PAYLOAD_PREFIX
+    + [
+        ("transactions", List(Transaction, _P.max_transactions_per_payload)),
+        ("withdrawals", List(Withdrawal, _P.max_withdrawals_per_payload)),
+        ("blob_gas_used", uint64),
+        ("excess_blob_gas", uint64),
+    ],
+)
+
+# Header form kept in the state (and in blinded blocks,
+# consensus/types/src/execution_payload_header.rs)
 ExecutionPayloadHeader = Container(
     "ExecutionPayloadHeader",
-    [
-        ("parent_hash", Bytes32),
-        ("fee_recipient", Bytes20),
-        ("state_root", Bytes32),
-        ("receipts_root", Bytes32),
-        ("logs_bloom", ByteList(256)),
-        ("prev_randao", Bytes32),
-        ("block_number", uint64),
-        ("gas_limit", uint64),
-        ("gas_used", uint64),
-        ("timestamp", uint64),
-        ("extra_data", ByteList(32)),
-        ("base_fee_per_gas", uint256),
-        ("block_hash", Bytes32),
+    _PAYLOAD_PREFIX
+    + [
         ("transactions_root", Bytes32),
         ("withdrawals_root", Bytes32),
         ("blob_gas_used", uint64),
         ("excess_blob_gas", uint64),
     ],
 )
+
+
+def execution_payload_to_header(payload) -> "ExecutionPayloadHeader":
+    """payload -> header: roots replace the variable-size lists
+    (ExecutionPayloadHeader::from in the reference)."""
+    fields = {name: getattr(payload, name) for name, _ in _PAYLOAD_PREFIX}
+    fields["transactions_root"] = List(
+        Transaction, _P.max_transactions_per_payload
+    ).hash_tree_root(payload.transactions)
+    fields["withdrawals_root"] = List(
+        Withdrawal, _P.max_withdrawals_per_payload
+    ).hash_tree_root(payload.withdrawals)
+    fields["blob_gas_used"] = payload.blob_gas_used
+    fields["excess_blob_gas"] = payload.excess_blob_gas
+    return ExecutionPayloadHeader.make(**fields)
 
 BeaconBlockBody = Container(
     "BeaconBlockBody",
@@ -241,7 +290,7 @@ BeaconBlockBody = Container(
         ("deposits", List(Deposit, _P.max_deposits)),
         ("voluntary_exits", List(SignedVoluntaryExit, _P.max_voluntary_exits)),
         ("sync_aggregate", SyncAggregate),
-        ("execution_payload_header", ExecutionPayloadHeader),
+        ("execution_payload", ExecutionPayload),
         (
             "bls_to_execution_changes",
             List(SignedBLSToExecutionChange, _P.max_bls_to_execution_changes),
@@ -322,6 +371,40 @@ SyncCommittee = Container(
     ],
 )
 
+# ---------------------------------------------------------------- blobs / DA
+
+# Blob = FIELD_ELEMENTS_PER_BLOB 32-byte scalars (Deneb, EIP-4844)
+Blob = ByteVector(_P.field_elements_per_blob * 32)
+
+# depth of blob_kzg_commitments[i] in the body merkle tree: 4 bits for
+# the 12-field body (padded to 16) + 1 length mix-in + 12 for the
+# 4096-limit commitment list = 17 (KZG_COMMITMENT_INCLUSION_PROOF_DEPTH)
+KZG_COMMITMENT_INCLUSION_PROOF_DEPTH = 17
+
+BlobSidecar = Container(
+    "BlobSidecar",
+    [
+        ("index", uint64),
+        ("blob", Blob),
+        ("kzg_commitment", Bytes48),
+        ("kzg_proof", Bytes48),
+        ("signed_block_header", SignedBeaconBlockHeader),
+        (
+            "kzg_commitment_inclusion_proof",
+            Vector(Bytes32, KZG_COMMITMENT_INCLUSION_PROOF_DEPTH),
+        ),
+    ],
+)
+
+BlobIdentifier = Container(
+    "BlobIdentifier", [("block_root", Bytes32), ("index", uint64)]
+)
+
+HistoricalSummary = Container(
+    "HistoricalSummary",
+    [("block_summary_root", Bytes32), ("state_summary_root", Bytes32)],
+)
+
 # ---------------------------------------------------------------- state
 
 BeaconState = Container(
@@ -351,5 +434,11 @@ BeaconState = Container(
         ("inactivity_scores", List(uint64, _P.validator_registry_limit)),
         ("current_sync_committee", SyncCommittee),
         ("next_sync_committee", SyncCommittee),
+        # Bellatrix+
+        ("latest_execution_payload_header", ExecutionPayloadHeader),
+        # Capella+
+        ("next_withdrawal_index", uint64),
+        ("next_withdrawal_validator_index", uint64),
+        ("historical_summaries", List(HistoricalSummary, _P.historical_roots_limit)),
     ],
 )

@@ -8,12 +8,10 @@ The reference delegates this to blst's hash-to-curve inside signing and
 inside signature-set verification (crypto/bls/src/impls/blst.rs message
 hashing with DST crypto/bls/src/impls/blst.rs:15).
 
-KNOWN DEVIATION RISK: the 3-isogeny and the SSWU sign/normalization choices
-were derived offline and verified self-consistently (map lands on E2, output
-is in the r-torsion, distribution covers the subgroup); byte-exactness
-against the RFC ciphersuite could not be confirmed without the official
-fixture vectors. The seam is isolated here so a constant swap fixes any
-mismatch without touching callers.
+Byte-exactness is anchored by the RFC 9380 appendix J.10.1 known-answer
+vectors in tests/test_h2c_vectors.py (host oracle AND device ops/htc path);
+the Vélu derivation's [-1] sign ambiguity is pinned there too
+(tools/derive_g2_isogeny.py).
 """
 
 import hashlib

@@ -32,11 +32,17 @@ from ..consensus.signature_sets import (
 )
 from ..consensus.spec import ChainSpec
 from ..crypto import bls
+from .blob_verification import DataAvailabilityChecker
 from .store import HotColdDB
 
 
 class BlockError(Exception):
     pass
+
+
+class AvailabilityPending(BlockError):
+    """The block commits to blobs that have not all arrived yet
+    (data_availability_checker role): retry once the sidecars land."""
 
 
 class AttestationError(Exception):
@@ -67,11 +73,19 @@ class BeaconChain:
         genesis_state,
         store: HotColdDB = None,
         bls_backend: Optional[str] = None,
+        kzg=None,
     ):
         self.spec = spec
         self.store = store or HotColdDB(spec)
         self.bls_backend = bls_backend
         self._lock = threading.RLock()
+        # Deneb data availability: sidecars buffer here until the block's
+        # commitment list is satisfied. kzg=None runs blob-free (blocks
+        # with commitments are then rejected rather than unverified).
+        self.kzg = kzg
+        self.da_checker = (
+            DataAvailabilityChecker(spec, kzg) if kzg is not None else None
+        )
 
         genesis_state = genesis_state.copy()
         # the genesis BLOCK root: the latest header with its state_root
@@ -89,11 +103,16 @@ class BeaconChain:
         self.genesis_root = genesis_root
         self.genesis_validators_root = bytes(genesis_state.genesis_validators_root)
 
-        self.fork_choice = ForkChoice(spec, genesis_root)
+        self.fork_choice = ForkChoice(
+            spec,
+            genesis_root,
+            justified_balances_provider=self._justified_balances,
+        )
         self.pubkey_cache = ValidatorPubkeyCache()
         self.pubkey_cache.import_new_pubkeys(
             bytes(v.pubkey) for v in genesis_state.validators
         )
+        self._persisted_pubkeys = 0
 
         # hot state bookkeeping: head + states by block root.
         # _block_info records (slot, parent_root, state_root) per block;
@@ -121,6 +140,113 @@ class BeaconChain:
             "beacon_chain_attestation_batch_fallbacks_total"
         )
 
+    # ------------------------------------------------------------ persistence
+
+    def persist(self) -> None:
+        """Snapshot fork choice + head + pubkey cache to the store
+        (persisted_beacon_chain.rs / persisted_fork_choice.rs role).
+        Called on every finality migration and at shutdown; `resume`
+        restores the chain from it.
+
+        Write order matters: new pubkey chunks first (append-only data),
+        then ONE snapshot record referencing them by count — a crash
+        between the two leaves the previous snapshot fully consistent."""
+        from .store import Column
+        from . import persistence as per
+
+        with self._lock:
+            n = len(self.pubkey_cache)
+            if n > self._persisted_pubkeys:
+                self.store.kv.put(
+                    Column.METADATA,
+                    per.pubkey_chunk_key(self._persisted_pubkeys),
+                    per.serialize_pubkey_chunk(
+                        self.pubkey_cache, self._persisted_pubkeys, n
+                    ),
+                )
+                self._persisted_pubkeys = n
+            self.store.kv.put(
+                Column.METADATA,
+                per.SNAPSHOT_KEY,
+                per.serialize_snapshot(
+                    self.fork_choice,
+                    self.genesis_root,
+                    self.genesis_validators_root,
+                    self.current_slot,
+                    self.head.root,
+                    self._block_info,
+                    pubkey_count=n,
+                ),
+            )
+
+    @classmethod
+    def resume(
+        cls,
+        spec: ChainSpec,
+        store: HotColdDB,
+        bls_backend: Optional[str] = None,
+        kzg=None,
+    ) -> "BeaconChain":
+        """Rebuild a chain from a persisted store (the reference's
+        `ClientGenesis::Resume` path, client/src/builder.rs:268-471):
+        fork choice, head, and the decompressed pubkey cache come back
+        exactly as persisted; states load lazily from the hot store."""
+        from .store import Column
+        from . import persistence as per
+
+        raw = store.kv.get(Column.METADATA, per.SNAPSHOT_KEY)
+        if raw is None:
+            raise ValueError("store holds no persisted chain to resume from")
+        meta = per.restore_snapshot(raw)
+
+        self = cls.__new__(cls)
+        self.spec = spec
+        self.store = store
+        self.bls_backend = bls_backend
+        self._lock = threading.RLock()
+        self.kzg = kzg
+        self.da_checker = (
+            DataAvailabilityChecker(spec, kzg) if kzg is not None else None
+        )
+        self.genesis_root = meta["genesis_root"]
+        self.genesis_validators_root = meta["genesis_validators_root"]
+        self.current_slot = meta["current_slot"]
+        self._block_info = meta["block_info"]
+        self._state_roots = {
+            root: info[2] for root, info in self._block_info.items()
+        }
+        self._states = {}
+        self.fork_choice = per.restore_fork_choice(
+            spec,
+            meta["fork_choice_raw"],
+            justified_balances_provider=self._justified_balances,
+        )
+        # pubkey chunks up to the snapshot's watermark (later chunks from
+        # a torn later persist are ignored; re-persisted next time)
+        self.pubkey_cache = ValidatorPubkeyCache()
+        while len(self.pubkey_cache) < meta["pubkey_count"]:
+            chunk = store.kv.get(
+                Column.METADATA, per.pubkey_chunk_key(len(self.pubkey_cache))
+            )
+            if chunk is None:
+                raise ValueError("persisted pubkey chunks incomplete")
+            per.restore_pubkey_chunk(
+                self.pubkey_cache, chunk, len(self.pubkey_cache)
+            )
+        self._persisted_pubkeys = len(self.pubkey_cache)
+        self._observed_attesters = set()
+        self.m_blocks = metrics.counter("beacon_chain_blocks_imported_total")
+        self.m_atts = metrics.counter(
+            "beacon_chain_attestations_verified_total"
+        )
+        self.m_batch_fallback = metrics.counter(
+            "beacon_chain_attestation_batch_fallbacks_total"
+        )
+        store.load_split()
+        self.head = ChainHead(root=b"", slot=0, state_root=b"")
+        self.recompute_head()
+        return self
+
     # ------------------------------------------------------------ time
 
     def on_slot(self, slot: int) -> None:
@@ -140,6 +266,22 @@ class BeaconChain:
     def head_state(self):
         return self.state_for_block(self.head.root)
 
+    def _justified_balances(self, justified_root: bytes, justified_epoch: int):
+        """Vote weights for fork choice: the JUSTIFIED state's active,
+        unslashed effective balances (fork_choice.rs justified-balances;
+        a stale vote from an exited/slashed validator must not move the
+        head). Returns None if the state is unavailable so the caller
+        keeps its previous weights."""
+        state = self.state_for_block(justified_root)
+        if state is None:
+            return None
+        return [
+            v.effective_balance
+            if (st.is_active_validator(v, justified_epoch) and not v.slashed)
+            else 0
+            for v in state.validators
+        ]
+
     # ------------------------------------------------------------ blocks
 
     def process_block(self, signed_block, verify_signatures: bool = True):
@@ -156,6 +298,21 @@ class BeaconChain:
                 raise BlockError("unknown parent")
             if block.slot > self.current_slot:
                 raise BlockError("block from the future")
+
+            # Deneb data availability gate (data_availability_checker
+            # role): a block committing to blobs imports only once every
+            # sidecar has arrived and batch-verified.
+            commitments = list(block.body.blob_kzg_commitments)
+            if commitments:
+                if self.da_checker is None:
+                    raise BlockError(
+                        "block commits to blobs but chain has no kzg"
+                    )
+                self.da_checker.expect(block_root, len(commitments))
+                if not self.da_checker.is_available(block_root):
+                    raise AvailabilityPending(
+                        f"{len(commitments)} blobs committed, not all seen"
+                    )
 
             state = parent_state.copy()
             if state.slot < block.slot:
@@ -182,11 +339,59 @@ class BeaconChain:
             self._import_block(signed_block, block_root, state)
             return block_root
 
+    def receive_blob_sidecars(self, sidecars) -> list:
+        """Gossip/RPC sidecar arrival: verify the proposer signature on
+        the embedded header (blob_verification.rs gossip rule — without
+        it anyone could flood self-consistent sidecar sets and evict
+        honest pending DA entries), then inclusion proofs + ONE KZG
+        batch, then buffer. Returns block roots that just became fully
+        available so the caller can retry their pending blocks."""
+        from ..consensus.signature_sets import block_header_signature_set
+
+        if self.da_checker is None:
+            raise BlockError("chain has no kzg configured")
+        by_root: dict[bytes, list] = {}
+        for sc in sidecars:
+            header = sc.signed_block_header.message
+            root = header.hash_tree_root()
+            by_root.setdefault(root, []).append(sc)
+        ready = []
+        with self._lock:
+            fork = self.head_state().fork
+            sig_sets = []
+            for root, group in by_root.items():
+                try:
+                    sig_sets.append(
+                        block_header_signature_set(
+                            self.spec,
+                            self._get_pubkey,
+                            group[0].signed_block_header,
+                            fork,
+                            self.genesis_validators_root,
+                        )
+                    )
+                except Exception as e:
+                    raise BlockError(f"sidecar header unverifiable: {e}") from None
+            if sig_sets and not bls.verify_signature_sets(
+                sig_sets, backend=self.bls_backend
+            ):
+                raise BlockError("sidecar proposer signature invalid")
+            for root, group in by_root.items():
+                body_root = bytes(group[0].signed_block_header.message.body_root)
+                self.da_checker.put_sidecars(root, body_root, group)
+                if self.da_checker.is_available(root):
+                    ready.append(root)
+        return ready
+
     def _import_block(self, signed_block, block_root: bytes, state) -> None:
         block = signed_block.message
         state_root = bytes(block.state_root)
         self.store.put_block(block_root, signed_block)
         self.store.put_state(state_root, state)
+        if self.da_checker is not None:
+            sidecars = self.da_checker.take(block_root)
+            if sidecars:
+                self.store.put_blobs(block_root, sidecars)
         self._state_roots[block_root] = state_root
         self._states[block_root] = state
         self._block_info[block_root] = (
@@ -202,10 +407,10 @@ class BeaconChain:
                 for v in state.validators[len(self.pubkey_cache) :]
             )
 
-        # fork-choice weights: only ACTIVE, UNSLASHED validators count
-        # (a stale vote from an exited/slashed validator must not move
-        # the head; fork_choice.rs uses the justified state's filtered
-        # balances — the imported state is our closest analog)
+        # fallback fork-choice weights from the imported state; the real
+        # weights come from _justified_balances (the justified state)
+        # which ForkChoice consults whenever the justified checkpoint
+        # moves — these are only used if that state is unavailable
         epoch = st.get_current_epoch(self.spec, state)
         balances = [
             v.effective_balance
@@ -369,6 +574,7 @@ class BeaconChain:
                 * self.spec.preset.sync_committee_size,
                 sync_committee_signature=b"\xc0" + b"\x00" * 95,
             )
+            body.execution_payload = st.mock_execution_payload(self.spec, state)
             block = T.BeaconBlock.make(
                 slot=slot,
                 proposer_index=proposer,
@@ -424,7 +630,10 @@ class BeaconChain:
                 for (i, e) in self._observed_attesters
                 if e + 1 >= cur_epoch
             }
-            return moved
+        # finality advanced: snapshot so a crash after migration resumes
+        # at this head (reference persists fork choice on migration)
+        self.persist()
+        return moved
 
     # ------------------------------------------------------------ helpers
 

@@ -1,0 +1,161 @@
+"""Blob sidecar verification + data availability checking (Deneb).
+
+Mirrors the reference's import-time DA machinery:
+- per-sidecar structural checks + the 17-deep commitment inclusion proof
+  (beacon_node/beacon_chain/src/blob_verification.rs),
+- KZG proof verification BATCHED across all of a block's blobs
+  (kzg_utils.rs validate_blobs -> crypto/kzg verify_blob_kzg_proof_batch,
+  crypto/kzg/src/lib.rs:156-183) — on this framework's device MSM seam,
+- an availability cache holding verified blobs/pending blocks until both
+  halves arrive (data_availability_checker/overflow_lru_cache.rs:1338).
+
+Sidecars are produced from a block + blobs by `blobs_to_sidecars`
+(kzg_utils.rs blob->sidecar construction role).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+from ..consensus import merkle_proof as mp
+from ..consensus import types as T
+from ..crypto.bls import curve as C
+from ..crypto.kzg import Kzg
+
+
+class BlobError(Exception):
+    pass
+
+
+# ---------------------------------------------------------------- produce
+
+
+def blobs_to_sidecars(
+    spec, signed_block, blobs: Sequence[bytes], proofs: Sequence[bytes], kzg: Kzg
+) -> list:
+    """Build the gossip-able BlobSidecar set for a signed block whose
+    body commits to `blobs` (block production / EL fetch path)."""
+    block = signed_block.message
+    commitments = list(block.body.blob_kzg_commitments)
+    if not (len(blobs) == len(proofs) == len(commitments)):
+        raise BlobError("blobs/proofs/commitments length mismatch")
+    header = T.BeaconBlockHeader.make(
+        slot=block.slot,
+        proposer_index=block.proposer_index,
+        parent_root=bytes(block.parent_root),
+        state_root=bytes(block.state_root),
+        body_root=block.body.hash_tree_root(),
+    )
+    signed_header = T.SignedBeaconBlockHeader.make(
+        message=header, signature=bytes(signed_block.signature)
+    )
+    return [
+        T.BlobSidecar.make(
+            index=i,
+            blob=bytes(blobs[i]),
+            kzg_commitment=bytes(commitments[i]),
+            kzg_proof=bytes(proofs[i]),
+            signed_block_header=signed_header,
+            kzg_commitment_inclusion_proof=mp.compute_blob_inclusion_proof(
+                block.body, i
+            ),
+        )
+        for i in range(len(blobs))
+    ]
+
+
+# ---------------------------------------------------------------- verify
+
+
+def verify_blob_sidecars(
+    spec, block_root: bytes, body_root: bytes, sidecars: Sequence, kzg: Kzg
+) -> None:
+    """All non-gossip checks for a block's sidecar set, crypto batched:
+    index bounds, header linkage to the block, inclusion proofs, then ONE
+    KZG batch verification over every (blob, commitment, proof) triple.
+    Raises BlobError on the first failure."""
+    seen = set()
+    blobs, commitments, proofs = [], [], []
+    for sc in sidecars:
+        if sc.index >= spec.preset.max_blobs_per_block:
+            raise BlobError(f"blob index {sc.index} out of range")
+        if sc.index in seen:
+            raise BlobError(f"duplicate blob index {sc.index}")
+        seen.add(sc.index)
+        header = sc.signed_block_header.message
+        if header.hash_tree_root() != block_root:
+            raise BlobError("sidecar header does not match block")
+        if bytes(header.body_root) != body_root:
+            raise BlobError("sidecar body root mismatch")
+        if not mp.verify_blob_inclusion_proof(
+            body_root,
+            bytes(sc.kzg_commitment),
+            sc.index,
+            [bytes(p) for p in sc.kzg_commitment_inclusion_proof],
+        ):
+            raise BlobError(f"blob {sc.index} inclusion proof invalid")
+        blobs.append(bytes(sc.blob))
+        try:
+            # decompression subgroup-checks the points (spec requirement)
+            commitments.append(C.g1_decompress(bytes(sc.kzg_commitment)))
+            proofs.append(C.g1_decompress(bytes(sc.kzg_proof)))
+        except Exception as e:
+            raise BlobError(f"blob {sc.index} bad point encoding: {e}") from None
+    if blobs and not kzg.verify_blob_kzg_proof_batch(blobs, commitments, proofs):
+        raise BlobError("KZG batch verification failed")
+
+
+# ---------------------------------------------------------------- checker
+
+
+@dataclass
+class _PendingBlock:
+    sidecars: dict = field(default_factory=dict)  # index -> sidecar
+    expected: Optional[int] = None  # commitments count once block seen
+
+
+class DataAvailabilityChecker:
+    """Holds per-block blob sets until the block's full commitment list
+    is satisfied (overflow_lru_cache.rs role, capacity-bounded)."""
+
+    def __init__(self, spec, kzg: Kzg, capacity: int = 64):
+        self.spec = spec
+        self.kzg = kzg
+        self.capacity = capacity
+        self._pending: dict[bytes, _PendingBlock] = {}
+
+    def put_sidecars(self, block_root: bytes, body_root: bytes, sidecars) -> None:
+        """Verify + buffer sidecars for a block (gossip/RPC arrival)."""
+        verify_blob_sidecars(
+            self.spec, block_root, body_root, sidecars, self.kzg
+        )
+        entry = self._pending.setdefault(block_root, _PendingBlock())
+        for sc in sidecars:
+            entry.sidecars[sc.index] = sc
+        self._evict()
+
+    def expect(self, block_root: bytes, commitment_count: int) -> None:
+        """Record how many blobs the imported block commits to."""
+        entry = self._pending.setdefault(block_root, _PendingBlock())
+        entry.expected = commitment_count
+        self._evict()
+
+    def is_available(self, block_root: bytes) -> bool:
+        """True iff every committed blob has arrived (a block with no
+        commitments is trivially available)."""
+        entry = self._pending.get(block_root)
+        if entry is None or entry.expected is None:
+            return False
+        return set(entry.sidecars) == set(range(entry.expected))
+
+    def take(self, block_root: bytes) -> list:
+        """Pop the complete sidecar set for storage at import."""
+        entry = self._pending.pop(block_root, None)
+        if entry is None:
+            return []
+        return [entry.sidecars[i] for i in sorted(entry.sidecars)]
+
+    def _evict(self) -> None:
+        while len(self._pending) > self.capacity:
+            self._pending.pop(next(iter(self._pending)))

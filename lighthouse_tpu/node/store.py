@@ -37,6 +37,7 @@ class Column:
     STATE = b"ste"
     COLD_STATE = b"cst"
     BLOCK_ROOT_BY_SLOT = b"brs"  # cold chain index
+    BLOBS = b"blb"  # BlobSidecar lists by block root (Deneb DA)
     METADATA = b"met"
 
 
@@ -231,6 +232,29 @@ class HotColdDB:
     def get_block(self, root: bytes):
         raw = self.kv.get(Column.BLOCK, root)
         return None if raw is None else T.SignedBeaconBlock.deserialize(raw)
+
+    # -- blob sidecars (Deneb; blobs_db role in hot_cold_store.rs)
+
+    _BLOB_LIST = None  # lazy List(BlobSidecar, max) descriptor
+
+    @classmethod
+    def _blob_list_type(cls):
+        if cls._BLOB_LIST is None:
+            from ..consensus.ssz import List
+
+            cls._BLOB_LIST = List(T.BlobSidecar, 4096)
+        return cls._BLOB_LIST
+
+    def put_blobs(self, block_root: bytes, sidecars) -> None:
+        self.kv.put(
+            Column.BLOBS,
+            block_root,
+            self._blob_list_type().serialize(list(sidecars)),
+        )
+
+    def get_blobs(self, block_root: bytes) -> list:
+        raw = self.kv.get(Column.BLOBS, block_root)
+        return [] if raw is None else self._blob_list_type().deserialize(raw)
 
     # -- hot states
 

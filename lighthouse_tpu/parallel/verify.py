@@ -12,16 +12,38 @@ batches across a TPU pod slice"): throughput scales with devices because
 the heavy math never leaves the shard.
 """
 
+import inspect
 from functools import partial
 
 import numpy as np
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
 
 from ..crypto.bls.backends import tpu as TB
 from ..ops import jacobian as J, pairing as OP
+
+
+def _shard_map(f, *, mesh, in_specs, out_specs, relaxed_replication):
+    """Version-portable shard_map: the replication-checking kwarg was
+    renamed check_rep -> check_vma across JAX releases, and the modern
+    entry point moved from jax.experimental.shard_map to jax.shard_map.
+    Feature-detect instead of pinning a spelling (VERDICT r1 #1)."""
+    sm = getattr(jax, "shard_map", None)
+    if sm is None:  # pragma: no cover - older JAX
+        from jax.experimental.shard_map import shard_map as sm
+    params = inspect.signature(sm).parameters
+    kw = {"mesh": mesh, "in_specs": in_specs, "out_specs": out_specs}
+    for name in ("check_vma", "check_rep"):
+        if name in params:
+            kw[name] = not relaxed_replication
+            break
+    else:
+        raise RuntimeError(
+            "shard_map exposes neither check_vma nor check_rep; "
+            "update _shard_map for this JAX version"
+        )
+    return sm(f, **kw)
 
 
 def make_mesh(n_devices: int = None) -> Mesh:
@@ -45,11 +67,11 @@ def sharded_verify_fn(mesh: Mesh):
     # scan init throughout the kernel stack.
     @jax.jit
     @partial(
-        shard_map,
+        _shard_map,
         mesh=mesh,
         in_specs=(spec,) * 8,
         out_specs=P(),
-        check_vma=False,
+        relaxed_replication=True,
     )
     def kernel(apk_x, apk_y, sig_x, sig_y, t0, t1, rbits, pad):
         f_local, s_local, sub_ok = TB.local_phase(

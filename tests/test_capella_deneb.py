@@ -1,0 +1,362 @@
+"""The Capella/Deneb fork surface (VERDICT r1 #6): execution payload in
+the body, withdrawals processing, blob sidecar inclusion proofs, and the
+data-availability gate at import.
+
+Reference parity: per_block_processing.rs:100 (payload+withdrawals
+order), capella get_expected_withdrawals/process_withdrawals,
+blob_verification.rs + data_availability_checker (DA gate),
+kzg_utils.rs (blob->sidecar construction).
+"""
+
+import pytest
+
+from lighthouse_tpu.consensus import merkle_proof as mp
+from lighthouse_tpu.consensus import state_transition as st
+from lighthouse_tpu.consensus import types as T
+from lighthouse_tpu.consensus.spec import mainnet_spec
+from lighthouse_tpu.crypto.bls import curve as C
+from lighthouse_tpu.crypto.bls.keys import SecretKey
+from lighthouse_tpu.node.beacon_chain import (
+    AvailabilityPending,
+    BeaconChain,
+    BlockError,
+)
+from lighthouse_tpu.node.blob_verification import (
+    BlobError,
+    blobs_to_sidecars,
+    verify_blob_sidecars,
+)
+
+N = 16
+SPEC = mainnet_spec()
+
+
+@pytest.fixture(scope="module")
+def genesis():
+    pubkeys = [
+        SecretKey.from_seed(i.to_bytes(4, "big")).public_key().to_bytes()
+        for i in range(N)
+    ]
+    return st.interop_genesis_state(SPEC, pubkeys)
+
+
+def _block_on(spec, state, slot, body_mutate=None):
+    pre = state.copy()
+    if pre.slot < slot:
+        st.process_slots(spec, pre, slot)
+    proposer = st.get_beacon_proposer_index(spec, pre)
+    body = T.BeaconBlockBody.default()
+    body.sync_aggregate = T.SyncAggregate.make(
+        sync_committee_bits=[False] * spec.preset.sync_committee_size,
+        sync_committee_signature=b"\xc0" + b"\x00" * 95,
+    )
+    body.eth1_data = pre.eth1_data
+    body.execution_payload = st.mock_execution_payload(spec, pre)
+    if body_mutate:
+        body_mutate(body, pre)
+    block = T.BeaconBlock.make(
+        slot=slot,
+        proposer_index=proposer,
+        parent_root=pre.latest_block_header.hash_tree_root(),
+        state_root=b"\x00" * 32,
+        body=body,
+    )
+    st.process_block(spec, pre, block, verify_signatures=False)
+    block.state_root = pre.hash_tree_root()
+    # infinity-point signature: parseable (sidecar header checks build a
+    # SignatureSet from it) and accepted by the fake backend
+    sig = b"\xc0" + b"\x00" * 95
+    return T.SignedBeaconBlock.make(message=block, signature=sig), pre
+
+
+# ------------------------------------------------------------ payload
+
+
+def test_payload_chains_block_hashes(genesis):
+    s1, post1 = _block_on(SPEC, genesis, 1)
+    assert bytes(
+        post1.latest_execution_payload_header.block_hash
+    ) == bytes(s1.message.body.execution_payload.block_hash)
+    s2, post2 = _block_on(SPEC, post1, 2)
+    assert bytes(s2.message.body.execution_payload.parent_hash) == bytes(
+        post1.latest_execution_payload_header.block_hash
+    )
+    assert post2.latest_execution_payload_header.block_number == 2
+
+
+def test_payload_wrong_parent_hash_rejected(genesis):
+    _, post1 = _block_on(SPEC, genesis, 1)
+
+    def wreck(body, pre):
+        body.execution_payload.parent_hash = b"\xaa" * 32
+
+    with pytest.raises(st.BlockProcessingError, match="parent hash"):
+        _block_on(SPEC, post1, 2, body_mutate=wreck)
+
+
+def test_payload_wrong_timestamp_rejected(genesis):
+    def wreck(body, pre):
+        body.execution_payload.timestamp += 1
+
+    with pytest.raises(st.BlockProcessingError, match="timestamp"):
+        _block_on(SPEC, genesis, 1, body_mutate=wreck)
+
+
+def test_payload_header_roundtrip():
+    p = T.ExecutionPayload.default()
+    p.block_number = 7
+    p.transactions = [b"\x01\x02", b"\x03"]
+    p.withdrawals = [
+        T.Withdrawal.make(index=1, validator_index=2, address=b"\x11" * 20, amount=9)
+    ]
+    h = T.execution_payload_to_header(p)
+    assert h.block_number == 7
+    assert bytes(h.transactions_root) != b"\x00" * 32
+    assert bytes(h.withdrawals_root) != b"\x00" * 32
+
+
+# ------------------------------------------------------------ withdrawals
+
+
+def _with_eth1_creds(state, index):
+    v = state.validators[index]
+    v.withdrawal_credentials = b"\x01" + b"\x00" * 11 + bytes([index]) * 20
+
+
+def test_partial_withdrawal_sweeps_excess(genesis):
+    state = genesis.copy()
+    _with_eth1_creds(state, 3)
+    state.balances[3] = SPEC.max_effective_balance + 5 * 10**9
+
+    expected = st.get_expected_withdrawals(SPEC, state)
+    assert [w.validator_index for w in expected] == [3]
+    assert expected[0].amount == 5 * 10**9
+
+    signed, post = _block_on(SPEC, state, 1)
+    assert len(signed.message.body.execution_payload.withdrawals) == 1
+    # exactly the excess is withdrawn (small delta: sync-committee
+    # non-participation penalties also land in this block)
+    assert 0 <= SPEC.max_effective_balance - post.balances[3] < 10**7
+    assert post.next_withdrawal_index == 1
+
+
+def test_full_withdrawal_of_exited_validator(genesis):
+    state = genesis.copy()
+    _with_eth1_creds(state, 5)
+    v = state.validators[5]
+    v.exit_epoch = 0
+    v.withdrawable_epoch = 0
+
+    expected = st.get_expected_withdrawals(SPEC, state)
+    assert [w.validator_index for w in expected] == [5]
+    assert expected[0].amount == state.balances[5]
+
+    _, post = _block_on(SPEC, state, 1)
+    assert post.balances[5] == 0
+
+
+def test_wrong_withdrawals_rejected(genesis):
+    state = genesis.copy()
+    _with_eth1_creds(state, 3)
+    state.balances[3] = SPEC.max_effective_balance + 10**9
+
+    def wreck(body, pre):
+        ws = list(body.execution_payload.withdrawals)
+        ws[0].amount += 1
+        body.execution_payload.withdrawals = ws
+
+    with pytest.raises(st.BlockProcessingError, match="withdrawal"):
+        _block_on(SPEC, state, 1, body_mutate=wreck)
+
+
+def test_sweep_cursor_advances(genesis):
+    state = genesis.copy()
+    state.next_withdrawal_validator_index = 3
+    _, post = _block_on(SPEC, state, 1)
+    # spec formula: UNclamped sweep constant mod n (16384 % 16 == 0 here,
+    # so the cursor returns to 3; clamping to n would give the same for
+    # divisible fixtures — the divergent case is covered below)
+    assert post.next_withdrawal_validator_index == (
+        3 + SPEC.preset.max_validators_per_withdrawals_sweep
+    ) % N
+
+
+def test_sweep_cursor_unclamped_when_not_divisible():
+    """Consensus-split guard: with a validator count that does NOT divide
+    the sweep constant (16384 % 12 == 4), the cursor must advance by the
+    unclamped constant — clamping to n would leave it unmoved."""
+    pubkeys = [
+        SecretKey.from_seed((100 + i).to_bytes(4, "big")).public_key().to_bytes()
+        for i in range(12)
+    ]
+    state = st.interop_genesis_state(SPEC, pubkeys)
+    state.next_withdrawal_validator_index = 5
+    st.process_withdrawals(
+        SPEC,
+        state,
+        T.ExecutionPayload.make(
+            withdrawals=st.get_expected_withdrawals(SPEC, state)
+        ),
+    )
+    sweep = SPEC.preset.max_validators_per_withdrawals_sweep
+    assert state.next_withdrawal_validator_index == (5 + sweep) % 12  # == 9
+
+
+# ------------------------------------------------------------ blobs / DA
+
+_G1 = C.g1_compress(C.G1_GEN)
+_BLOB = bytes(SPEC.preset.field_elements_per_blob * 32)
+
+
+class _FakeKzg:
+    """Crypto stub for DA *plumbing* tests (the real batched KZG math is
+    covered at small domain size in test_kzg.py and by bench config 5);
+    the inclusion proofs and header linkage here are real."""
+
+    def __init__(self, ok=True):
+        self.ok = ok
+        self.calls = 0
+
+    def verify_blob_kzg_proof_batch(self, blobs, commitments, proofs):
+        self.calls += 1
+        return self.ok
+
+
+def _chain_with_blob_block(kzg):
+    pubkeys = [
+        SecretKey.from_seed(i.to_bytes(4, "big")).public_key().to_bytes()
+        for i in range(N)
+    ]
+    genesis_state = st.interop_genesis_state(SPEC, pubkeys)
+    chain = BeaconChain(SPEC, genesis_state, kzg=kzg, bls_backend="fake")
+    state = chain.head_state()
+
+    def add_commitments(body, pre):
+        body.blob_kzg_commitments = [_G1, _G1]
+
+    chain.on_slot(1)
+    signed, _ = _block_on(SPEC, state, 1, body_mutate=add_commitments)
+    sidecars = blobs_to_sidecars(
+        SPEC, signed, [_BLOB, _BLOB], [_G1, _G1], kzg
+    )
+    return chain, signed, sidecars
+
+
+def test_inclusion_proof_roundtrip(genesis):
+    def add_commitments(body, pre):
+        body.blob_kzg_commitments = [_G1]
+
+    signed, _ = _block_on(SPEC, genesis, 1, body_mutate=add_commitments)
+    body = signed.message.body
+    proof = mp.compute_blob_inclusion_proof(body, 0)
+    root = body.hash_tree_root()
+    assert mp.verify_blob_inclusion_proof(root, _G1, 0, proof)
+    # wrong commitment, wrong index, truncated proof all fail
+    assert not mp.verify_blob_inclusion_proof(root, b"\x02" + _G1[1:], 0, proof)
+    assert not mp.verify_blob_inclusion_proof(root, _G1, 1, proof)
+    assert not mp.verify_blob_inclusion_proof(root, _G1, 0, proof[:-1])
+
+
+def test_da_gate_blocks_until_sidecars_arrive():
+    kzg = _FakeKzg()
+    chain, signed, sidecars = _chain_with_blob_block(kzg)
+    with pytest.raises(AvailabilityPending):
+        chain.process_block(signed, verify_signatures=False)
+    ready = chain.receive_blob_sidecars(sidecars)
+    block_root = signed.message.hash_tree_root()
+    assert ready == [block_root]
+    assert kzg.calls == 1  # ONE batch for both sidecars
+    root = chain.process_block(signed, verify_signatures=False)
+    assert root == block_root
+    assert len(chain.store.get_blobs(block_root)) == 2
+
+
+def test_failed_kzg_batch_rejected():
+    kzg = _FakeKzg(ok=False)
+    chain, signed, sidecars = _chain_with_blob_block(kzg)
+    with pytest.raises(BlobError, match="KZG"):
+        chain.receive_blob_sidecars(sidecars)
+
+
+def test_tampered_inclusion_proof_rejected():
+    kzg = _FakeKzg()
+    chain, signed, sidecars = _chain_with_blob_block(kzg)
+    bad = sidecars[1]
+    proof = [bytes(p) for p in bad.kzg_commitment_inclusion_proof]
+    proof[0] = b"\xee" * 32
+    bad.kzg_commitment_inclusion_proof = proof
+    with pytest.raises(BlobError, match="inclusion"):
+        chain.receive_blob_sidecars(sidecars)
+
+
+def test_sidecar_proposer_signature_enforced():
+    """Unauthenticated sidecars must not enter the DA cache: a header
+    signed by the wrong key is rejected on a real-crypto backend, the
+    right key's is accepted (blob gossip rule)."""
+    from lighthouse_tpu.consensus.domains import compute_signing_root, get_domain
+
+    kzg = _FakeKzg()
+    keys = [SecretKey.from_seed(i.to_bytes(4, "big")) for i in range(N)]
+    pubkeys = [k.public_key().to_bytes() for k in keys]
+    genesis_state = st.interop_genesis_state(SPEC, pubkeys)
+    chain = BeaconChain(SPEC, genesis_state, kzg=kzg, bls_backend="cpu")
+    state = chain.head_state()
+
+    def add_commitments(body, pre):
+        body.blob_kzg_commitments = [_G1]
+
+    chain.on_slot(1)
+    signed, _ = _block_on(SPEC, state, 1, body_mutate=add_commitments)
+    block = signed.message
+
+    def sign_header(key):
+        header = T.BeaconBlockHeader.make(
+            slot=block.slot,
+            proposer_index=block.proposer_index,
+            parent_root=bytes(block.parent_root),
+            state_root=bytes(block.state_root),
+            body_root=block.body.hash_tree_root(),
+        )
+        domain = get_domain(
+            SPEC,
+            SPEC.domain_beacon_proposer,
+            st.compute_epoch_at_slot(SPEC, block.slot),
+            state.fork,
+            chain.genesis_validators_root,
+        )
+        return key.sign(compute_signing_root(header, domain)).to_bytes()
+
+    wrong = T.SignedBeaconBlock.make(
+        message=block, signature=sign_header(keys[(block.proposer_index + 1) % N])
+    )
+    bad_sidecars = blobs_to_sidecars(SPEC, wrong, [_BLOB], [_G1], kzg)
+    with pytest.raises(BlockError, match="signature"):
+        chain.receive_blob_sidecars(bad_sidecars)
+
+    right = T.SignedBeaconBlock.make(
+        message=block, signature=sign_header(keys[block.proposer_index])
+    )
+    good_sidecars = blobs_to_sidecars(SPEC, right, [_BLOB], [_G1], kzg)
+    chain.receive_blob_sidecars(good_sidecars)  # accepted (no error)
+    # and the block imports now that its blobs are available
+    assert (
+        chain.process_block(right, verify_signatures=False)
+        == block.hash_tree_root()
+    )
+
+
+def test_no_kzg_chain_rejects_blob_blocks():
+    pubkeys = [
+        SecretKey.from_seed(i.to_bytes(4, "big")).public_key().to_bytes()
+        for i in range(N)
+    ]
+    chain = BeaconChain(SPEC, st.interop_genesis_state(SPEC, pubkeys))
+    state = chain.head_state()
+
+    def add_commitments(body, pre):
+        body.blob_kzg_commitments = [_G1]
+
+    chain.on_slot(1)
+    signed, _ = _block_on(SPEC, state, 1, body_mutate=add_commitments)
+    with pytest.raises(BlockError, match="no kzg"):
+        chain.process_block(signed, verify_signatures=False)

@@ -1,0 +1,124 @@
+"""Known-answer vectors for hash-to-curve and BLS serialization.
+
+External correctness anchors (VERDICT r1 #3): until round 2 every crypto
+test was self-consistency; a shared-constant bug would have passed. These
+vectors pin the implementation to the public standards byte-for-byte:
+
+- RFC 9380 appendix J.10.1: BLS12381G2_XMD:SHA-256_SSWU_RO_ full
+  hash_to_curve outputs for the five standard messages.
+- RFC 9380 appendix K.1: expand_message_xmd(SHA-256) vector.
+- The ubiquitous BLS12-381 G1/G2 generator compressed encodings
+  (ZCash serialization convention, used by all Ethereum clients).
+- The Ethereum BLS signature ciphersuite DST
+  (reference: /root/reference/crypto/bls/src/impls/blst.rs:15).
+
+Run on the host oracle AND the device (ops/htc) map so the TPU path is
+anchored too, not just cross-checked against the host.
+"""
+
+import pytest
+
+from lighthouse_tpu.crypto.bls import hash_to_curve as H2C, curve as C, params
+from lighthouse_tpu.crypto.bls.keys import SecretKey, PublicKey, Signature
+from lighthouse_tpu.ops import jacobian as J, htc
+
+# RFC 9380 §8.8.2 ciphersuite DST for the appendix J.10.1 vectors.
+RFC_DST = b"QUUX-V01-CS02-with-BLS12381G2_XMD:SHA-256_SSWU_RO_"
+
+# msg -> (x_c0, x_c1, y_c0, y_c1), RFC 9380 appendix J.10.1 P outputs.
+H2C_G2_VECTORS = {
+    b"": (
+        0x0141EBFBDCA40EB85B87142E130AB689C673CF60F1A3E98D69335266F30D9B8D4AC44C1038E9DCDD5393FAF5C41FB78A,
+        0x05CB8437535E20ECFFAEF7752BADDF98034139C38452458BAEEFAB379BA13DFF5BF5DD71B72418717047F5B0F37DA03D,
+        0x0503921D7F6A12805E72940B963C0CF3471C7B2A524950CA195D11062EE75EC076DAF2D4BC358C4B190C0C98064FDD92,
+        0x12424AC32561493F3FE3C260708A12B7C620E7BE00099A974E259DDC7D1F6395C3C811CDD19F1E8DBF3E9ECFDCBAB8D6,
+    ),
+    b"abc": (
+        0x02C2D18E033B960562AAE3CAB37A27CE00D80CCD5BA4B7FE0E7A210245129DBEC7780CCC7954725F4168AFF2787776E6,
+        0x139CDDBCCDC5E91B9623EFD38C49F81A6F83F175E80B06FC374DE9EB4B41DFE4CA3A230ED250FBE3A2ACF73A41177FD8,
+        0x1787327B68159716A37440985269CF584BCB1E621D3A7202BE6EA05C4CFE244AEB197642555A0645FB87BF7466B2BA48,
+        0x00AA65DAE3C8D732D10ECD2C50F8A1BAF3001578F71C694E03866E9F3D49AC1E1CE70DD94A733534F106D4CEC0EDDD16,
+    ),
+    b"abcdef0123456789": (
+        0x121982811D2491FDE9BA7ED31EF9CA474F0E1501297F68C298E9F4C0028ADD35AEA8BB83D53C08CFC007C1E005723CD0,
+        0x190D119345B94FBD15497BCBA94ECF7DB2CBFD1E1FE7DA034D26CBBA169FB3968288B3FAFB265F9EBD380512A71C3F2C,
+        0x05571A0F8D3C08D094576981F4A3B8EDA0A8E771FCDCC8ECCEAF1356A6ACF17574518ACB506E435B639353C2E14827C8,
+        0x0BB5E7572275C567462D91807DE765611490205A941A5A6AF3B1691BFE596C31225D3AABDF15FAFF860CB4EF17C7C3BE,
+    ),
+    b"q128_" + b"q" * 128: (
+        0x19A84DD7248A1066F737CC34502EE5555BD3C19F2ECDB3C7D9E24DC65D4E25E50D83F0F77105E955D78F4762D33C17DA,
+        0x0934ABA516A52D8AE479939A91998299C76D39CC0C035CD18813BEC433F587E2D7A4FEF038260EEF0CEF4D02AAE3EB91,
+        0x14F81CD421617428BC3B9FE25AFBB751D934A00493524BC4E065635B0555084DD54679DF1536101B2C979C0152D09192,
+        0x09BCCCFA036B4847C9950780733633F13619994394C23FF0B32FA6B795844F4A0673E20282D07BC69641CEE04F5E5662,
+    ),
+    b"a512_" + b"a" * 512: (
+        0x01A6BA2F9A11FA5598B2D8ACE0FBE0A0EACB65DECEB476FBBCB64FD24557C2F4B18ECFC5663E54AE16A84F5AB7F62534,
+        0x11FCA2FF525572795A801EED17EB12785887C7B63FB77A42BE46CE4A34131D71F7A73E95FEE3F812AEA3DE78B4D01569,
+        0x0B6798718C8AED24BC19CB27F866F1C9EFFCDBF92397AD6448B5C9DB90D2B9DA6CBABF48ADC1ADF59A1A28344E79D57E,
+        0x03A47F8E6D1763BA0CAD63D6114C0ACCBEF65707825A511B251A660A9B3994249AE4E63FAC38B23DA0C398689EE2AB52,
+    ),
+}
+
+
+def test_expand_message_xmd_rfc_vector():
+    # RFC 9380 appendix K.1 (expander DST, len_in_bytes = 0x20, msg = "").
+    out = H2C.expand_message_xmd(
+        b"", b"QUUX-V01-CS02-with-expander-SHA256-128", 0x20
+    )
+    assert out.hex() == (
+        "68a985b87eb6b46952128911f2a4412bbc302a9d759667f87f7a21d803f07235"
+    )
+
+
+@pytest.mark.parametrize("msg", list(H2C_G2_VECTORS), ids=lambda m: repr(m[:12]))
+def test_hash_to_g2_rfc_vectors_host(msg):
+    x0, x1, y0, y1 = H2C_G2_VECTORS[msg]
+    got = H2C.hash_to_g2(msg, RFC_DST)
+    assert got == ((x0, x1), (y0, y1))
+
+
+def test_hash_to_g2_rfc_vectors_device():
+    """The device SSWU/isogeny/cofactor path (ops/htc) against the same
+    RFC outputs — anchors the TPU kernel constants independently of the
+    host oracle it is usually cross-checked with."""
+    msgs = [b"", b"abc"]
+    t0, t1 = htc.pack_draws(msgs, dst=RFC_DST)
+    pts = J.unpack_g2(htc.hash_draws_to_g2(t0, t1))
+    for msg, got in zip(msgs, pts):
+        x0, x1, y0, y1 = H2C_G2_VECTORS[msg]
+        assert got == ((x0, x1), (y0, y1)), msg
+
+
+def test_generator_serialization_anchors():
+    # ZCash-convention compressed encodings of the standard generators.
+    assert C.g1_compress(C.G1_GEN).hex() == (
+        "97f1d3a73197d7942695638c4fa9ac0fc3688c4f9774b905a14e3a3f171bac58"
+        "6c55e83ff97a1aeffb3af00adb22c6bb"
+    )
+    assert C.g2_compress(C.G2_GEN).hex() == (
+        "93e02b6052719f607dacd3a088274f65596bd0d09920b61ab5da61bbdc7f5049"
+        "334cf11213945d57e5ac7d055d042b7e"
+        "024aa2b2f08f0a91260805272dc51051c6e47ad4fa403b02b4510b647ae3d177"
+        "0bac0326a805bbefd48056c8c121bdb8"
+    )
+    # round-trip
+    assert C.g1_decompress(C.g1_compress(C.G1_GEN)) == C.G1_GEN
+    assert C.g2_decompress(C.g2_compress(C.G2_GEN)) == C.G2_GEN
+
+
+def test_eth_ciphersuite_dst():
+    # The proof-of-possession ciphersuite tag every Ethereum client signs
+    # with (reference: crypto/bls/src/impls/blst.rs:15).
+    assert params.DST == b"BLS_SIG_BLS12381G2_XMD:SHA-256_SSWU_RO_POP_"
+
+
+def test_sk_one_signature_is_message_hash():
+    """sign(sk=1, m) must equal hash_to_g2(m) under the eth DST: ties the
+    signing path's scalar mul + serialization to the vector-anchored h2c."""
+    sk = SecretKey(1)
+    assert sk.public_key().to_bytes() == C.g1_compress(C.G1_GEN)
+    for msg in (b"", b"graft-kat"):
+        sig = sk.sign(msg)
+        assert sig.point == H2C.hash_to_g2(msg)
+        # and the compressed form round-trips with subgroup check
+        assert Signature.from_bytes(sig.to_bytes()).point == sig.point

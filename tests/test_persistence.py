@@ -1,0 +1,140 @@
+"""Chain persistence/resume (persisted_fork_choice.rs role, VERDICT r1 #10):
+fork choice, head, votes, and the pubkey cache survive a restart from the
+same store; the resumed chain keeps importing blocks."""
+
+import pytest
+
+from lighthouse_tpu.consensus import state_transition as st
+from lighthouse_tpu.consensus import types as T
+from lighthouse_tpu.consensus.spec import mainnet_spec
+from lighthouse_tpu.crypto.bls.keys import SecretKey
+from lighthouse_tpu.node.beacon_chain import BeaconChain
+from lighthouse_tpu.node.store import HotColdDB, LogStore
+
+N = 16
+
+
+def _empty_block(spec, state, slot, parent_root):
+    pre = state.copy()
+    if pre.slot < slot:
+        st.process_slots(spec, pre, slot)
+    proposer = st.get_beacon_proposer_index(spec, pre)
+    body = T.BeaconBlockBody.default()
+    body.sync_aggregate = T.SyncAggregate.make(
+        sync_committee_bits=[False] * spec.preset.sync_committee_size,
+        sync_committee_signature=b"\xc0" + b"\x00" * 95,
+    )
+    body.eth1_data = pre.eth1_data
+    body.execution_payload = st.mock_execution_payload(spec, pre)
+    block = T.BeaconBlock.make(
+        slot=slot,
+        proposer_index=proposer,
+        parent_root=parent_root,
+        state_root=b"\x00" * 32,
+        body=body,
+    )
+    st.process_block(spec, pre, block, verify_signatures=False)
+    block.state_root = pre.hash_tree_root()
+    return T.SignedBeaconBlock.make(message=block, signature=b"\x00" * 96), pre
+
+
+def _build_chain(store):
+    spec = mainnet_spec()
+    pubkeys = [
+        SecretKey.from_seed(i.to_bytes(4, "big")).public_key().to_bytes()
+        for i in range(N)
+    ]
+    genesis = st.interop_genesis_state(spec, pubkeys)
+    chain = BeaconChain(spec, genesis, store=store)
+    state = chain.head_state()
+    parent = chain.head.root
+    for slot in range(1, 6):
+        chain.on_slot(slot)
+        signed, state = _empty_block(spec, state, slot, parent)
+        parent = chain.process_block(signed, verify_signatures=False)
+    # a couple of LMD votes so vote trackers have content to persist
+    chain.fork_choice.on_attestation(6, 0, parent, 0, 5, is_from_block=True)
+    chain.fork_choice.on_attestation(6, 1, parent, 0, 5, is_from_block=True)
+    chain.recompute_head()
+    return spec, chain
+
+
+def test_persist_resume_roundtrip(tmp_path):
+    store = HotColdDB(mainnet_spec(), LogStore(str(tmp_path)))
+    spec, chain = _build_chain(store)
+    chain.persist()
+
+    resumed = BeaconChain.resume(spec, store)
+    assert resumed.head.root == chain.head.root
+    assert resumed.head.slot == chain.head.slot
+    assert (
+        resumed.fork_choice.justified_checkpoint
+        == chain.fork_choice.justified_checkpoint
+    )
+    assert (
+        resumed.fork_choice.finalized_checkpoint
+        == chain.fork_choice.finalized_checkpoint
+    )
+    assert len(resumed.fork_choice.proto.nodes) == len(
+        chain.fork_choice.proto.nodes
+    )
+    assert resumed.fork_choice.proto.votes.keys() == chain.fork_choice.proto.votes.keys()
+    assert resumed.fork_choice._balances == chain.fork_choice._balances
+    # pubkey cache restored decompressed (no per-key sqrt on resume)
+    assert len(resumed.pubkey_cache) == N
+    for i in range(N):
+        assert (
+            resumed.pubkey_cache.get(i).point == chain.pubkey_cache.get(i).point
+        )
+
+    # the resumed chain continues: import the next block on top
+    state = resumed.head_state()
+    assert state is not None  # loads from the store, not memory
+    resumed.on_slot(6)
+    signed, _ = _empty_block(spec, state, 6, resumed.head.root)
+    new_root = resumed.process_block(signed, verify_signatures=False)
+    assert resumed.head.root == new_root
+
+
+def test_resume_without_snapshot_raises(tmp_path):
+    store = HotColdDB(mainnet_spec(), LogStore(str(tmp_path)))
+    with pytest.raises(ValueError):
+        BeaconChain.resume(mainnet_spec(), store)
+
+
+def test_resumed_weights_decide_head_on_fork(tmp_path):
+    """Node weights must survive resume: with settled vote trackers the
+    delta pass contributes zero, so without persisted weights a resumed
+    node would tie-break forks by root bytes instead of LMD weight."""
+    store = HotColdDB(mainnet_spec(), LogStore(str(tmp_path)))
+    spec, chain = _build_chain(store)
+    # fork at the head's parent: two children compete
+    head_slot, parent, _ = chain._block_info[chain.head.root]
+    base_state = chain.state_for_block(parent)
+    chain.on_slot(head_slot + 1)
+    forked, _ = _empty_block(spec, base_state, head_slot + 1, parent)
+    fork_root = chain.process_block(forked, verify_signatures=False)
+    main_root = chain.head.root if chain.head.root != fork_root else None
+    assert main_root is not None  # votes from _build_chain hold the head
+    winner = chain.head.root
+    chain.persist()
+
+    resumed = BeaconChain.resume(spec, store)
+    assert resumed.head.root == winner
+    # and head stays put after a fresh score pass too
+    assert resumed.fork_choice.get_head(resumed.current_slot) == winner
+
+
+def test_corrupted_pubkey_chunk_rejected(tmp_path):
+    from lighthouse_tpu.node import persistence as per
+    from lighthouse_tpu.node.store import Column
+
+    store = HotColdDB(mainnet_spec(), LogStore(str(tmp_path)))
+    spec, chain = _build_chain(store)
+    chain.persist()
+    key = per.pubkey_chunk_key(0)
+    raw = bytearray(store.kv.get(Column.METADATA, key))
+    raw[40] ^= 0xFF  # flip a coordinate bit
+    store.kv.put(Column.METADATA, key, bytes(raw))
+    with pytest.raises(ValueError):
+        BeaconChain.resume(spec, store)

@@ -90,6 +90,7 @@ def chain():
             sync_committee_signature=b"\xc0" + b"\x00" * 95,
         )
         body.eth1_data = pre.eth1_data
+        body.execution_payload = st.mock_execution_payload(spec, pre)
         block = T.BeaconBlock.make(
             slot=slot,
             proposer_index=proposer,
