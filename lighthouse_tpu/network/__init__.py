@@ -1,0 +1,41 @@
+"""L7: the networking plane (beacon_node/lighthouse_network +
+beacon_node/network analogs).
+
+Two sub-layers, mirroring the reference's split:
+
+  transport/gossip/rpc/peers — the p2p stack
+    (lighthouse_network: gossipsub fork service/mod.rs:111-135, req/resp
+    rpc/protocol.rs:294-334, peer manager peer_manager/peerdb.rs). Here
+    the stack is host-side Python around a pluggable `Transport`; the
+    in-process hub transport gives the reference's "multi-node in one
+    process" testing posture (SURVEY.md §4.5) and a C++ socket transport
+    slots into the same seam.
+
+  router / network_beacon_processor / sync — the chain bridge
+    (network/src/router.rs:34, network_beacon_processor/mod.rs:88-131,
+    sync/manager.rs:224): gossip messages become batchable Work for the
+    beacon_processor; range sync drives whole-segment signature batches.
+"""
+
+from .transport import InProcessHub, Endpoint
+from .gossip import GossipRouter, topic_for
+from .rpc import RpcHandler, Protocol, Status
+from .peer_manager import PeerManager
+from .service import NetworkService, NetworkEvent
+from .network_beacon_processor import NetworkBeaconProcessor
+from .sync import SyncManager
+
+__all__ = [
+    "InProcessHub",
+    "Endpoint",
+    "GossipRouter",
+    "topic_for",
+    "RpcHandler",
+    "Protocol",
+    "Status",
+    "PeerManager",
+    "NetworkService",
+    "NetworkEvent",
+    "NetworkBeaconProcessor",
+    "SyncManager",
+]

@@ -1,0 +1,133 @@
+"""Gossip pub/sub (the vendored-gossipsub role, lighthouse_network/gossipsub).
+
+Kept to the parts that shape system behavior rather than wire
+compatibility:
+  - fork-digest-scoped topics (types/pubsub.rs:482 style),
+  - a per-topic MESH of peers messages are eagerly forwarded to,
+  - a seen-cache so each message id propagates once (the IDONTWANT
+    economy reduced to its effect: no duplicate re-entry),
+  - per-peer delivery accounting feeding peer scoring
+    (gossipsub/src/peer_score.rs role).
+
+Message ids are content hashes (sha256 of topic+data, like the
+reference's message-id function over decompressed payloads).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import struct
+from collections import OrderedDict
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from .transport import CHANNEL_GOSSIP, Endpoint
+
+MESH_SIZE = 8  # gossipsub D
+SEEN_CACHE_SIZE = 4096
+
+# topic name templates (fork digest scoping like topics in pubsub.rs)
+TOPIC_BLOCK = "beacon_block"
+TOPIC_AGGREGATE = "beacon_aggregate_and_proof"
+TOPIC_ATTESTATION_SUBNET = "beacon_attestation_{subnet}"
+TOPIC_VOLUNTARY_EXIT = "voluntary_exit"
+TOPIC_PROPOSER_SLASHING = "proposer_slashing"
+TOPIC_ATTESTER_SLASHING = "attester_slashing"
+TOPIC_SYNC_CONTRIBUTION = "sync_committee_contribution_and_proof"
+TOPIC_SYNC_COMMITTEE_SUBNET = "sync_committee_{subnet}"
+TOPIC_BLS_TO_EXECUTION_CHANGE = "bls_to_execution_change"
+TOPIC_BLOB_SIDECAR = "blob_sidecar_{subnet}"
+
+
+def topic_for(template: str, fork_digest: bytes, subnet: int = None) -> str:
+    name = template.format(subnet=subnet) if "{subnet}" in template else template
+    return f"/eth2/{fork_digest.hex()}/{name}/ssz_snappy"
+
+
+def _message_id(topic: str, data: bytes) -> bytes:
+    return hashlib.sha256(topic.encode() + b"\x00" + data).digest()[:20]
+
+
+def _encode(topic: str, data: bytes) -> bytes:
+    t = topic.encode()
+    return struct.pack("<H", len(t)) + t + data
+
+
+def _decode(payload: bytes) -> tuple:
+    (tlen,) = struct.unpack("<H", payload[:2])
+    topic = payload[2 : 2 + tlen].decode()
+    return topic, payload[2 + tlen :]
+
+
+class GossipRouter:
+    """Publish/forward over the mesh with at-most-once handling."""
+
+    def __init__(self, endpoint: Endpoint, on_message: Callable = None):
+        self.endpoint = endpoint
+        self.on_message = on_message  # (peer_id, topic, data) -> None
+        self.subscriptions: set[str] = set()
+        self.mesh: dict[str, set] = {}
+        self._seen: OrderedDict[bytes, None] = OrderedDict()
+        # delivery stats for peer scoring: peer -> (first, duplicate)
+        self.delivery_stats: dict[str, list] = {}
+
+    # -- membership
+
+    def subscribe(self, topic: str) -> None:
+        self.subscriptions.add(topic)
+        self.mesh.setdefault(topic, set())
+
+    def unsubscribe(self, topic: str) -> None:
+        self.subscriptions.discard(topic)
+        self.mesh.pop(topic, None)
+
+    def graft(self, topic: str, peer_id: str) -> None:
+        self.mesh.setdefault(topic, set())
+        if len(self.mesh[topic]) < MESH_SIZE:
+            self.mesh[topic].add(peer_id)
+
+    def prune(self, peer_id: str) -> None:
+        for peers in self.mesh.values():
+            peers.discard(peer_id)
+        self.delivery_stats.pop(peer_id, None)
+
+    # -- data plane
+
+    def publish(self, topic: str, data: bytes) -> int:
+        """Originate a message: mark seen, forward to the mesh."""
+        mid = _message_id(topic, data)
+        self._mark_seen(mid)
+        return self._forward(topic, data, exclude=None)
+
+    def handle_frame(self, sender: str, payload: bytes) -> Optional[tuple]:
+        """Inbound gossip frame: dedup, deliver locally, forward on.
+        Returns (sender, topic, data) for fresh messages on subscribed
+        topics, else None."""
+        topic, data = _decode(payload)
+        mid = _message_id(topic, data)
+        stats = self.delivery_stats.setdefault(sender, [0, 0])
+        if mid in self._seen:
+            stats[1] += 1  # duplicate: mesh overlap, mild negative signal
+            return None
+        stats[0] += 1
+        self._mark_seen(mid)
+        self._forward(topic, data, exclude=sender)
+        if topic in self.subscriptions:
+            if self.on_message is not None:
+                self.on_message(sender, topic, data)
+            return (sender, topic, data)
+        return None
+
+    def _forward(self, topic: str, data: bytes, exclude: Optional[str]) -> int:
+        n = 0
+        for peer in self.mesh.get(topic, ()):
+            if peer != exclude and self.endpoint.send(
+                peer, CHANNEL_GOSSIP, _encode(topic, data)
+            ):
+                n += 1
+        return n
+
+    def _mark_seen(self, mid: bytes) -> None:
+        self._seen[mid] = None
+        while len(self._seen) > SEEN_CACHE_SIZE:
+            self._seen.popitem(last=False)

@@ -1,0 +1,231 @@
+"""NetworkBeaconProcessor: the bridge from network events to chain work
+(network/src/network_beacon_processor/mod.rs:88-131 + gossip_methods.rs,
+rpc_methods.rs analog).
+
+Inbound gossip becomes `Work` for the beacon_processor — attestations
+carry BOTH process_individual and process_batch closures so the
+scheduler can form TPU-scale batches with the per-item fallback
+(mod.rs:88-131; batch path gossip_methods.rs:230-241). RPC server
+handlers serve blocks/blobs out of the chain's store (rpc_methods.rs).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from ..consensus import types as T
+from ..node.beacon_chain import AttestationError, AvailabilityPending, BlockError
+from ..node.beacon_processor import Work, WorkType
+from .gossip import (
+    TOPIC_ATTESTATION_SUBNET,
+    TOPIC_BLOB_SIDECAR,
+    TOPIC_BLOCK,
+    topic_for,
+)
+from .peer_manager import PeerAction
+from .rpc import (
+    BlocksByRangeRequest,
+    Protocol,
+    ResponseCode,
+    Status,
+)
+
+
+class NetworkBeaconProcessor:
+    def __init__(self, chain, processor, service, fork_digest: bytes = b"\x00" * 4):
+        self.chain = chain
+        self.processor = processor
+        self.service = service
+        self.fork_digest = fork_digest
+        self._register_rpc()
+        # gossip verification stats for tests/metrics
+        self.imported_blocks = 0
+        self.verified_attestations = 0
+        self.on_unknown_parent: Optional[Callable] = None  # sync hook
+        # blocks parked on data availability: root -> signed block
+        # (bounded; honest Deneb ordering is block-before-blobs)
+        self._awaiting_blobs: dict[bytes, object] = {}
+        self._AWAITING_CAP = 64
+
+    # ------------------------------------------------------------ gossip in
+
+    def handle_gossip(self, peer_id: str, topic: str, data: bytes) -> None:
+        """Router dispatch (router.rs:34 handle_gossip)."""
+        if f"/{TOPIC_BLOCK}/" in topic:
+            self._on_gossip_block(peer_id, data)
+        elif "/beacon_attestation_" in topic:
+            self._on_gossip_attestation(peer_id, data)
+        elif "/blob_sidecar_" in topic:
+            self._on_gossip_blob(peer_id, data)
+
+    def _on_gossip_block(self, peer_id: str, data: bytes) -> None:
+        try:
+            signed = T.SignedBeaconBlock.deserialize(data)
+        except Exception:
+            self.service.report_peer(peer_id, PeerAction.LOW_TOLERANCE)
+            return
+
+        def process(_payload) -> None:
+            try:
+                self.chain.process_block(signed)
+                self.imported_blocks += 1
+            except AvailabilityPending:
+                # honest Deneb ordering (block before trailing blobs):
+                # park, NO penalty; retried when the sidecars land
+                if len(self._awaiting_blobs) < self._AWAITING_CAP:
+                    self._awaiting_blobs[
+                        signed.message.hash_tree_root()
+                    ] = signed
+            except BlockError as e:
+                if "unknown parent" in str(e) and self.on_unknown_parent:
+                    # park the child with the lookup; it re-enters the
+                    # queue once the ancestor chain lands (the
+                    # reprocessing-queue role for orphans)
+                    self.on_unknown_parent(
+                        peer_id, bytes(signed.message.parent_root), signed
+                    )
+                else:
+                    self.service.report_peer(peer_id, PeerAction.MID_TOLERANCE)
+
+        self.processor.submit(
+            Work(kind=WorkType.GOSSIP_BLOCK, process_individual=process)
+        )
+
+    def _on_gossip_attestation(self, peer_id: str, data: bytes) -> None:
+        try:
+            att = T.Attestation.deserialize(data)
+        except Exception:
+            self.service.report_peer(peer_id, PeerAction.LOW_TOLERANCE)
+            return
+
+        def individual(payload) -> None:
+            try:
+                v = self.chain.verify_attestation_for_gossip(payload)
+            except AttestationError:
+                self.service.report_peer(peer_id, PeerAction.HIGH_TOLERANCE)
+                return
+            good = self.chain.batch_verify_attestations([v])
+            self.verified_attestations += len(good)
+
+        def batch(payloads: list) -> bool:
+            verified = []
+            for p in payloads:
+                try:
+                    verified.append(self.chain.verify_attestation_for_gossip(p))
+                except AttestationError:
+                    continue
+            # ONE crypto batch; poisoning fallback happens inside
+            good = self.chain.batch_verify_attestations(verified)
+            self.verified_attestations += len(good)
+            return True
+
+        self.processor.submit(
+            Work(
+                kind=WorkType.GOSSIP_ATTESTATION,
+                process_individual=individual,
+                process_batch=batch,
+                payload=att,
+            )
+        )
+
+    def _on_gossip_blob(self, peer_id: str, data: bytes) -> None:
+        try:
+            sidecar = T.BlobSidecar.deserialize(data)
+        except Exception:
+            self.service.report_peer(peer_id, PeerAction.LOW_TOLERANCE)
+            return
+
+        def process(_payload) -> None:
+            try:
+                ready = self.chain.receive_blob_sidecars([sidecar])
+            except Exception:
+                self.service.report_peer(peer_id, PeerAction.MID_TOLERANCE)
+                return
+            # sidecar completed a parked block's blob set: import it now
+            for root in ready:
+                parked = self._awaiting_blobs.pop(root, None)
+                if parked is not None:
+                    try:
+                        self.chain.process_block(parked)
+                        self.imported_blocks += 1
+                    except BlockError:
+                        self.service.report_peer(
+                            peer_id, PeerAction.MID_TOLERANCE
+                        )
+
+        self.processor.submit(
+            Work(kind=WorkType.GOSSIP_BLOCK, process_individual=process)
+        )
+
+    # ------------------------------------------------------------ gossip out
+
+    def publish_block(self, signed_block) -> None:
+        topic = topic_for(TOPIC_BLOCK, self.fork_digest)
+        self.service.publish(topic, T.SignedBeaconBlock.serialize(signed_block))
+
+    def publish_attestation(self, attestation, subnet: int = 0) -> None:
+        topic = topic_for(TOPIC_ATTESTATION_SUBNET, self.fork_digest, subnet)
+        self.service.publish(topic, T.Attestation.serialize(attestation))
+
+    def publish_blob_sidecar(self, sidecar) -> None:
+        topic = topic_for(
+            TOPIC_BLOB_SIDECAR, self.fork_digest, int(sidecar.index)
+        )
+        self.service.publish(topic, T.BlobSidecar.serialize(sidecar))
+
+    # ------------------------------------------------------------ rpc server
+
+    def _register_rpc(self) -> None:
+        self.service.rpc.register(Protocol.STATUS, self._serve_status)
+        self.service.rpc.register(
+            Protocol.BLOCKS_BY_RANGE, self._serve_blocks_by_range
+        )
+        self.service.rpc.register(
+            Protocol.BLOCKS_BY_ROOT, self._serve_blocks_by_root
+        )
+        self.service.rpc.register(
+            Protocol.BLOBS_BY_ROOT, self._serve_blobs_by_root
+        )
+
+    def local_status(self):
+        fin_epoch, fin_root = self.chain.fork_choice.finalized_checkpoint
+        return Status.make(
+            fork_digest=self.fork_digest,
+            finalized_root=fin_root,
+            finalized_epoch=fin_epoch,
+            head_root=self.chain.head.root,
+            head_slot=self.chain.head.slot,
+        )
+
+    def _serve_status(self, peer_id: str, body: bytes):
+        return ResponseCode.SUCCESS, [Status.serialize(self.local_status())]
+
+    def _serve_blocks_by_range(self, peer_id: str, body: bytes):
+        req = BlocksByRangeRequest.deserialize(body)
+        count = min(int(req.count), 1024)
+        chunks = []
+        for slot in range(req.start_slot, req.start_slot + count):
+            root = self.chain.block_root_at_slot(slot)
+            if root is None:
+                continue  # skipped slot
+            block = self.chain.store.get_block(root)
+            if block is not None:
+                chunks.append(T.SignedBeaconBlock.serialize(block))
+        return ResponseCode.SUCCESS, chunks
+
+    def _serve_blocks_by_root(self, peer_id: str, body: bytes):
+        roots = [body[i : i + 32] for i in range(0, len(body), 32)]
+        chunks = []
+        for root in roots[:128]:
+            block = self.chain.store.get_block(root)
+            if block is not None:
+                chunks.append(T.SignedBeaconBlock.serialize(block))
+        return ResponseCode.SUCCESS, chunks
+
+    def _serve_blobs_by_root(self, peer_id: str, body: bytes):
+        roots = [body[i : i + 32] for i in range(0, len(body), 32)]
+        chunks = []
+        for root in roots[:128]:
+            for sc in self.chain.store.get_blobs(root):
+                chunks.append(T.BlobSidecar.serialize(sc))
+        return ResponseCode.SUCCESS, chunks

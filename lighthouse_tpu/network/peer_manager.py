@@ -1,0 +1,117 @@
+"""Peer manager: scoring, ban lifecycle, peer targets
+(lighthouse_network/src/peer_manager/mod.rs + peerdb.rs analog).
+
+Score model is the reference's shape reduced to its moving parts: a
+real-valued score per peer, actions adjust it, decay pulls it back to
+zero each heartbeat, thresholds gate {healthy > MIN_SCORE_BEFORE_DISCONNECT
+> MIN_SCORE_BEFORE_BAN} transitions (peerdb scoring constants).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Optional
+
+MIN_SCORE_BEFORE_DISCONNECT = -20.0
+MIN_SCORE_BEFORE_BAN = -50.0
+SCORE_DECAY_HALFLIFE = 600.0  # seconds
+TARGET_PEERS = 16
+
+
+class PeerAction(Enum):
+    """peer_manager PeerAction / ReportSource reduced to score deltas."""
+
+    FATAL = -100.0  # instant ban (invalid block, attack)
+    LOW_TOLERANCE = -20.0
+    MID_TOLERANCE = -10.0
+    HIGH_TOLERANCE = -1.0
+    VALUABLE = +1.0  # served useful data
+
+
+class PeerStatus(Enum):
+    CONNECTED = "connected"
+    DISCONNECTED = "disconnected"
+    BANNED = "banned"
+
+
+@dataclass
+class PeerInfo:
+    peer_id: str
+    score: float = 0.0
+    status: PeerStatus = PeerStatus.CONNECTED
+    last_seen: float = 0.0
+    chain_status: object = None  # last Status handshake
+    subnets: set = field(default_factory=set)
+
+
+class PeerManager:
+    def __init__(self, clock=time.monotonic, target_peers: int = TARGET_PEERS):
+        self._clock = clock
+        self.target_peers = target_peers
+        self.peers: dict[str, PeerInfo] = {}
+
+    # -- lifecycle
+
+    def connect(self, peer_id: str) -> PeerInfo:
+        info = self.peers.get(peer_id)
+        if info is None:
+            info = self.peers[peer_id] = PeerInfo(peer_id=peer_id)
+        if info.status == PeerStatus.BANNED:
+            return info  # stays banned; caller must not use it
+        info.status = PeerStatus.CONNECTED
+        info.last_seen = self._clock()
+        return info
+
+    def disconnect(self, peer_id: str) -> None:
+        info = self.peers.get(peer_id)
+        if info is not None and info.status != PeerStatus.BANNED:
+            info.status = PeerStatus.DISCONNECTED
+
+    # -- scoring
+
+    def report(self, peer_id: str, action: PeerAction) -> PeerStatus:
+        """Apply a score delta; returns the possibly-updated status the
+        caller should act on (disconnect/ban)."""
+        info = self.connect(peer_id)
+        info.score += action.value
+        if info.score <= MIN_SCORE_BEFORE_BAN:
+            info.status = PeerStatus.BANNED
+        elif info.score <= MIN_SCORE_BEFORE_DISCONNECT:
+            info.status = PeerStatus.DISCONNECTED
+        return info.status
+
+    def heartbeat(self, dt: float = None) -> None:
+        """Exponential score decay toward zero (peer_score decay)."""
+        if dt is None:
+            dt = 1.0
+        decay = 0.5 ** (dt / SCORE_DECAY_HALFLIFE)
+        for info in self.peers.values():
+            info.score *= decay
+            if (
+                info.status == PeerStatus.BANNED
+                and info.score > MIN_SCORE_BEFORE_BAN / 2
+            ):
+                info.status = PeerStatus.DISCONNECTED  # ban expiry path
+
+    # -- selection
+
+    def connected(self) -> list:
+        return [
+            p.peer_id
+            for p in self.peers.values()
+            if p.status == PeerStatus.CONNECTED
+        ]
+
+    def is_usable(self, peer_id: str) -> bool:
+        info = self.peers.get(peer_id)
+        return info is not None and info.status == PeerStatus.CONNECTED
+
+    def best_peers(self, n: int = None) -> list:
+        """Connected peers, best score first (sync target selection)."""
+        out = sorted(
+            (p for p in self.peers.values() if p.status == PeerStatus.CONNECTED),
+            key=lambda p: -p.score,
+        )
+        return [p.peer_id for p in out[: n or len(out)]]

@@ -1,0 +1,212 @@
+"""Req/resp RPC (lighthouse_network rpc/protocol.rs:294-334 analog).
+
+Protocols carried: Status, Goodbye, Ping, MetaData, BlocksByRange,
+BlocksByRoot, BlobsByRange, BlobsByRoot — the sync-critical subset of
+the reference's 14 (light-client and PeerDAS column protocols slot into
+the same enum when those subsystems land).
+
+Framing over the transport's RPC channel:
+  request : <req_id u32><proto u8><is_resp=0><ssz payload>
+  response: <req_id u32><proto u8><is_resp=1><code u8><n u16><len-prefixed chunks>
+
+Responses are chunk lists (a BlocksByRange response is a chunk per
+block, like the reference's streamed chunks, rpc/codec.rs). An inbound
+token-bucket rate limiter guards each protocol (rpc/rate_limiter.rs:531
+role).
+"""
+
+from __future__ import annotations
+
+import struct
+import time
+from dataclasses import dataclass
+from enum import IntEnum
+from typing import Callable, Optional
+
+from ..consensus.ssz import Container, uint64, Bytes4, Bytes32
+from .transport import CHANNEL_RPC, Endpoint
+
+
+class Protocol(IntEnum):
+    STATUS = 0
+    GOODBYE = 1
+    PING = 2
+    METADATA = 3
+    BLOCKS_BY_RANGE = 4
+    BLOCKS_BY_ROOT = 5
+    BLOBS_BY_RANGE = 6
+    BLOBS_BY_ROOT = 7
+
+
+class ResponseCode(IntEnum):
+    SUCCESS = 0
+    INVALID_REQUEST = 1
+    SERVER_ERROR = 2
+    RESOURCE_UNAVAILABLE = 3
+    RATE_LIMITED = 4
+
+
+Status = Container(
+    "Status",
+    [
+        ("fork_digest", Bytes4),
+        ("finalized_root", Bytes32),
+        ("finalized_epoch", uint64),
+        ("head_root", Bytes32),
+        ("head_slot", uint64),
+    ],
+)
+
+BlocksByRangeRequest = Container(
+    "BlocksByRangeRequest",
+    [("start_slot", uint64), ("count", uint64), ("step", uint64)],
+)
+
+Ping = Container("Ping", [("seq_number", uint64)])
+
+MetaData = Container(
+    "MetaData", [("seq_number", uint64), ("attnets", uint64)]
+)
+
+
+@dataclass
+class _Bucket:
+    tokens: float
+    last: float
+
+
+class RateLimiter:
+    """Per-(peer, protocol) token bucket (rpc/rate_limiter.rs role)."""
+
+    # protocol -> (capacity, refill per second)
+    LIMITS = {
+        Protocol.STATUS: (8, 4.0),
+        Protocol.GOODBYE: (2, 1.0),
+        Protocol.PING: (8, 4.0),
+        Protocol.METADATA: (4, 2.0),
+        Protocol.BLOCKS_BY_RANGE: (512, 128.0),
+        Protocol.BLOCKS_BY_ROOT: (256, 128.0),
+        Protocol.BLOBS_BY_RANGE: (512, 128.0),
+        Protocol.BLOBS_BY_ROOT: (256, 128.0),
+    }
+
+    def __init__(self, clock: Callable[[], float] = time.monotonic):
+        self._clock = clock
+        self._buckets: dict[tuple, _Bucket] = {}
+
+    def allow(self, peer_id: str, proto: Protocol, cost: int = 1) -> bool:
+        cap, rate = self.LIMITS[proto]
+        key = (peer_id, proto)
+        now = self._clock()
+        b = self._buckets.get(key)
+        if b is None:
+            b = self._buckets[key] = _Bucket(tokens=float(cap), last=now)
+        b.tokens = min(cap, b.tokens + (now - b.last) * rate)
+        b.last = now
+        if b.tokens >= cost:
+            b.tokens -= cost
+            return True
+        return False
+
+
+class MalformedFrame(Exception):
+    """Raised on unparseable RPC frames so the service can penalize the
+    sender instead of letting a remote byte string kill the event loop."""
+
+
+class RpcHandler:
+    """Owns request issue/dispatch over an endpoint. Server behavior is
+    supplied as per-protocol callables returning (code, [chunks])."""
+
+    def __init__(self, endpoint: Endpoint, clock=time.monotonic):
+        self.endpoint = endpoint
+        self.handlers: dict[Protocol, Callable] = {}
+        self.limiter = RateLimiter(clock)
+        self._next_req = 0
+        # req_id -> (protocol, callback(peer, code, chunks))
+        self._pending: dict[int, tuple] = {}
+        self.goodbyes: list = []
+
+    def register(self, proto: Protocol, handler: Callable) -> None:
+        """handler(peer_id, request_bytes) -> (ResponseCode, list[bytes])"""
+        self.handlers[proto] = handler
+
+    # -- client side
+
+    def request(
+        self, peer_id: str, proto: Protocol, payload: bytes, callback: Callable
+    ) -> int:
+        req_id = self._next_req
+        self._next_req += 1
+        # the target peer is recorded so another peer cannot forge or
+        # cancel this request's response with a guessed req_id
+        self._pending[req_id] = (proto, peer_id, callback)
+        frame = struct.pack("<IBB", req_id, proto, 0) + payload
+        if not self.endpoint.send(peer_id, CHANNEL_RPC, frame):
+            self._pending.pop(req_id, None)
+            callback(peer_id, ResponseCode.RESOURCE_UNAVAILABLE, [])
+            return -1
+        return req_id
+
+    # -- inbound
+
+    def handle_frame(self, sender: str, payload: bytes) -> None:
+        """Raises MalformedFrame on garbage — remote input must never be
+        able to crash the poll loop."""
+        try:
+            req_id, proto_raw, is_resp = struct.unpack("<IBB", payload[:6])
+            proto = Protocol(proto_raw)
+        except (struct.error, ValueError) as e:
+            raise MalformedFrame(str(e)) from None
+        body = payload[6:]
+        if is_resp:
+            entry = self._pending.get(req_id)
+            if entry is None:
+                return
+            _, expected_peer, callback = entry
+            if sender != expected_peer:
+                raise MalformedFrame("response from wrong peer")
+            self._pending.pop(req_id, None)
+            try:
+                code, chunks = _decode_response(body)
+            except (struct.error, ValueError) as e:
+                raise MalformedFrame(str(e)) from None
+            callback(sender, code, chunks)
+            return
+        # request path
+        if not self.limiter.allow(sender, proto):
+            self._respond(sender, req_id, proto, ResponseCode.RATE_LIMITED, [])
+            return
+        if proto == Protocol.GOODBYE:
+            self.goodbyes.append(sender)
+            return
+        handler = self.handlers.get(proto)
+        if handler is None:
+            self._respond(
+                sender, req_id, proto, ResponseCode.INVALID_REQUEST, []
+            )
+            return
+        try:
+            code, chunks = handler(sender, body)
+        except Exception:
+            code, chunks = ResponseCode.SERVER_ERROR, []
+        self._respond(sender, req_id, proto, code, chunks)
+
+    def _respond(self, peer, req_id, proto, code, chunks) -> None:
+        frame = (
+            struct.pack("<IBB", req_id, proto, 1)
+            + struct.pack("<BH", code, len(chunks))
+            + b"".join(struct.pack("<I", len(c)) + c for c in chunks)
+        )
+        self.endpoint.send(peer, CHANNEL_RPC, frame)
+
+
+def _decode_response(body: bytes) -> tuple:
+    code, n = struct.unpack("<BH", body[:3])
+    chunks, pos = [], 3
+    for _ in range(n):
+        (ln,) = struct.unpack("<I", body[pos : pos + 4])
+        pos += 4
+        chunks.append(body[pos : pos + ln])
+        pos += ln
+    return ResponseCode(code), chunks

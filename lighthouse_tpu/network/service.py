@@ -1,0 +1,108 @@
+"""NetworkService: composes transport endpoint + gossip + rpc + peer
+manager into one pollable unit emitting NetworkEvents
+(lighthouse_network Network behaviour + NetworkEvent,
+service/mod.rs:59,111-135).
+
+`poll()` drains the endpoint inbox and returns events; the node drives
+it from its event loop (or a thread). Connecting two services grafts
+their gossip meshes both ways — discovery's role collapsed to its
+effect, with the discv5 logic a later slot-in at `connect_peer`.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from enum import Enum
+from typing import Optional
+
+from .gossip import GossipRouter
+from .peer_manager import PeerAction, PeerManager
+from .rpc import MalformedFrame, Protocol, ResponseCode, RpcHandler
+from .transport import CHANNEL_GOSSIP, CHANNEL_RPC, Endpoint, InProcessHub
+
+
+class EventKind(Enum):
+    GOSSIP = "gossip"
+    RPC_REQUEST = "rpc_request"  # handled inside RpcHandler; informational
+    PEER_CONNECTED = "peer_connected"
+    PEER_DISCONNECTED = "peer_disconnected"
+
+
+@dataclass
+class NetworkEvent:
+    kind: EventKind
+    peer_id: str
+    topic: Optional[str] = None
+    data: Optional[bytes] = None
+
+
+class NetworkService:
+    def __init__(self, hub: InProcessHub, peer_id: str):
+        self.peer_id = peer_id
+        self.endpoint = hub.join(peer_id)
+        self.gossip = GossipRouter(self.endpoint)
+        self.rpc = RpcHandler(self.endpoint)
+        self.peers = PeerManager()
+
+    # -- topology
+
+    def connect_peer(self, other: "NetworkService") -> None:
+        """Bidirectional connect + mesh graft on all shared topics (the
+        effect of discovery + gossipsub GRAFT control messages)."""
+        self.peers.connect(other.peer_id)
+        other.peers.connect(self.peer_id)
+        for topic in self.gossip.subscriptions & other.gossip.subscriptions:
+            self.gossip.graft(topic, other.peer_id)
+            other.gossip.graft(topic, self.peer_id)
+
+    def subscribe(self, topic: str) -> None:
+        self.gossip.subscribe(topic)
+
+    def resubscribe_meshes(self, others: list) -> None:
+        """Re-graft after subscription changes (subnet rotation)."""
+        for other in others:
+            self.connect_peer(other)
+
+    # -- data plane
+
+    def publish(self, topic: str, data: bytes) -> int:
+        return self.gossip.publish(topic, data)
+
+    def request(self, peer_id: str, proto: Protocol, payload: bytes, callback):
+        if not self.peers.is_usable(peer_id):
+            callback(peer_id, ResponseCode.RESOURCE_UNAVAILABLE, [])
+            return -1
+        return self.rpc.request(peer_id, proto, payload, callback)
+
+    def report_peer(self, peer_id: str, action: PeerAction) -> None:
+        status = self.peers.report(peer_id, action)
+        if status.value != "connected":
+            self.gossip.prune(peer_id)
+
+    # -- event loop
+
+    def poll(self) -> list:
+        """Drain inbound frames into events; rpc responses fire their
+        callbacks inline, gossip yields events for the router."""
+        events = []
+        for frame in self.endpoint.drain():
+            if not self.peers.is_usable(frame.sender):
+                continue  # banned/unknown peers are silenced
+            if frame.channel == CHANNEL_GOSSIP:
+                fresh = self.gossip.handle_frame(frame.sender, frame.payload)
+                if fresh is not None:
+                    sender, topic, data = fresh
+                    events.append(
+                        NetworkEvent(
+                            kind=EventKind.GOSSIP,
+                            peer_id=sender,
+                            topic=topic,
+                            data=data,
+                        )
+                    )
+            elif frame.channel == CHANNEL_RPC:
+                try:
+                    self.rpc.handle_frame(frame.sender, frame.payload)
+                except MalformedFrame:
+                    self.report_peer(frame.sender, PeerAction.LOW_TOLERANCE)
+        return events

@@ -1,0 +1,222 @@
+"""SyncManager: range sync + single-block lookups
+(network/src/sync/manager.rs:224, range_sync/chain.rs, block_lookups/).
+
+Reduced to the reference's load-bearing structure:
+  - Status handshake discovers how far ahead a peer's finalized/head
+    chain is (range.rs peer classification).
+  - Range sync requests fixed-size slot batches (batch.rs:563 role)
+    from the best peer and imports each response as ONE chain segment —
+    the whole-segment signature batch is the TPU-relevant property
+    (signature_verify_chain_segment, block_verification.rs:599).
+  - Failed batches penalize the serving peer and retry from the next
+    best (batch retry/penalization, range_sync/batch.rs).
+  - Unknown-parent gossip blocks trigger a BlocksByRoot lookup walking
+    back to a known ancestor (block_lookups/ role).
+
+The manager is synchronous and event-driven (`tick()` + callbacks), so
+sync policy is unit-testable without a runtime; the node's loop drives
+it alongside NetworkService.poll().
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Optional
+
+from ..consensus import types as T
+from ..node.beacon_chain import BlockError
+from ..node.beacon_processor import Work, WorkType
+from .peer_manager import PeerAction
+from .rpc import BlocksByRangeRequest, Protocol, ResponseCode, Status
+
+BATCH_SLOTS = 64  # EPOCHS_PER_BATCH * 32 in the reference
+MAX_PARENT_DEPTH = 32  # block_lookups parent-chain length cap
+
+
+class SyncState(Enum):
+    IDLE = "idle"  # in sync (or no better peer known)
+    RANGE = "range"  # catching up a long gap
+    STALLED = "stalled"  # no usable peer serves the target
+
+
+@dataclass
+class _PendingBatch:
+    start_slot: int
+    count: int
+    peer: str
+
+
+class SyncManager:
+    def __init__(self, chain, processor, service, nbp):
+        self.chain = chain
+        self.processor = processor
+        self.service = service
+        self.nbp = nbp
+        self.state = SyncState.IDLE
+        self.peer_status: dict[str, object] = {}
+        self._pending: Optional[_PendingBatch] = None
+        self._parent_requests: dict[bytes, int] = {}  # root -> depth
+        # orphans parked until their ancestor chain lands
+        self._awaiting_parent: dict[bytes, list] = {}
+        nbp.on_unknown_parent = self.on_unknown_parent
+
+    # ------------------------------------------------------------ status
+
+    def add_peer(self, peer_id: str) -> None:
+        """Handshake: ask for the peer's chain status."""
+        self.service.request(
+            peer_id,
+            Protocol.STATUS,
+            Status.serialize(self.nbp.local_status()),
+            self._on_status,
+        )
+
+    def _on_status(self, peer_id: str, code, chunks) -> None:
+        if code != ResponseCode.SUCCESS or not chunks:
+            return
+        status = Status.deserialize(chunks[0])
+        self.peer_status[peer_id] = status
+        info = self.service.peers.peers.get(peer_id)
+        if info is not None:
+            info.chain_status = status
+
+    # ------------------------------------------------------------ range sync
+
+    def target_slot(self) -> int:
+        """Highest head slot any usable peer advertises."""
+        best = self.chain.head.slot
+        for peer, status in self.peer_status.items():
+            if self.service.peers.is_usable(peer):
+                best = max(best, int(status.head_slot))
+        return best
+
+    def tick(self) -> None:
+        """Drive sync: issue the next batch request if behind and no
+        request is in flight."""
+        if self._pending is not None:
+            return
+        target = self.target_slot()
+        local = self.chain.head.slot
+        if target <= local:
+            self.state = SyncState.IDLE
+            return
+        peer = self._best_peer_for(local + 1)
+        if peer is None:
+            self.state = SyncState.STALLED
+            return
+        self.state = SyncState.RANGE
+        count = min(BATCH_SLOTS, target - local)
+        self._pending = _PendingBatch(
+            start_slot=local + 1, count=count, peer=peer
+        )
+        req = BlocksByRangeRequest.make(
+            start_slot=local + 1, count=count, step=1
+        )
+        self.service.request(
+            peer,
+            Protocol.BLOCKS_BY_RANGE,
+            BlocksByRangeRequest.serialize(req),
+            self._on_batch,
+        )
+
+    def _best_peer_for(self, slot: int) -> Optional[str]:
+        for peer in self.service.peers.best_peers():
+            status = self.peer_status.get(peer)
+            if status is not None and int(status.head_slot) >= slot:
+                return peer
+        return None
+
+    def _on_batch(self, peer_id: str, code, chunks) -> None:
+        pending, self._pending = self._pending, None
+        if code != ResponseCode.SUCCESS:
+            self.service.report_peer(peer_id, PeerAction.MID_TOLERANCE)
+            return
+        blocks = []
+        for raw in chunks:
+            try:
+                blocks.append(T.SignedBeaconBlock.deserialize(raw))
+            except Exception:
+                self.service.report_peer(peer_id, PeerAction.LOW_TOLERANCE)
+                return
+
+        def process(_payload) -> None:
+            try:
+                imported = self.chain.process_chain_segment(blocks)
+            except BlockError:
+                self.service.report_peer(peer_id, PeerAction.LOW_TOLERANCE)
+                return
+            if blocks and not imported:
+                # served a batch that contained nothing importable
+                self.service.report_peer(peer_id, PeerAction.MID_TOLERANCE)
+            elif imported:
+                self.service.report_peer(peer_id, PeerAction.VALUABLE)
+
+        # chain segments take the HIGHEST priority lane (lib.rs:1037)
+        self.processor.submit(
+            Work(kind=WorkType.CHAIN_SEGMENT, process_individual=process)
+        )
+
+    # ------------------------------------------------------------ lookups
+
+    def on_unknown_parent(
+        self, peer_id: str, parent_root: bytes, child=None, depth: int = 0
+    ) -> None:
+        """Gossip block with unknown parent: park the child and fetch
+        the ancestor chain from the serving peer (single-block lookup
+        role; the child re-imports once its parent lands). `depth`
+        carries the length of the ancestor WALK — each hop increments it
+        so a fabricated deep chain stops at MAX_PARENT_DEPTH instead of
+        driving unbounded lookups + parked-block memory growth."""
+        if depth >= MAX_PARENT_DEPTH or len(self._awaiting_parent) >= 4 * MAX_PARENT_DEPTH:
+            self.service.report_peer(peer_id, PeerAction.MID_TOLERANCE)
+            return
+        if child is not None:
+            self._awaiting_parent.setdefault(parent_root, []).append(child)
+        if parent_root in self._parent_requests:
+            return  # lookup already in flight for this ancestor
+        self._parent_requests[parent_root] = depth
+        self.service.request(
+            peer_id,
+            Protocol.BLOCKS_BY_ROOT,
+            parent_root,
+            lambda p, c, ch: self._on_lookup(p, c, ch, depth),
+        )
+
+    def _on_lookup(self, peer_id: str, code, chunks, depth: int = 0) -> None:
+        if code != ResponseCode.SUCCESS or not chunks:
+            return
+        try:
+            block = T.SignedBeaconBlock.deserialize(chunks[0])
+        except Exception:
+            self.service.report_peer(peer_id, PeerAction.LOW_TOLERANCE)
+            return
+
+        def process(_payload) -> None:
+            self._parent_requests.pop(block.message.hash_tree_root(), None)
+            try:
+                root = self.chain.process_block(block)
+            except BlockError as e:
+                if "unknown parent" in str(e):
+                    self.on_unknown_parent(
+                        peer_id,
+                        bytes(block.message.parent_root),
+                        block,
+                        depth + 1,
+                    )
+                return
+            self._release_children(peer_id, root)
+
+        self.processor.submit(
+            Work(kind=WorkType.RPC_BLOCK, process_individual=process)
+        )
+
+    def _release_children(self, peer_id: str, parent_root: bytes) -> None:
+        """An ancestor landed: re-import every orphan that was waiting
+        on it (recursively — a whole parked chain unwinds)."""
+        for child in self._awaiting_parent.pop(parent_root, []):
+            try:
+                child_root = self.chain.process_block(child)
+            except BlockError:
+                continue
+            self._release_children(peer_id, child_root)

@@ -1,0 +1,104 @@
+"""Pluggable frame transport.
+
+The reference's TCP/QUIC sockets (lighthouse_network/src/service/utils.rs
+:52-63) are a process boundary; what the upper layers actually need is
+"send framed bytes to peer X, receive framed bytes from anyone". That
+seam is `Transport`. `InProcessHub` implements it with thread-safe
+queues so N full nodes run in one process — the reference's own
+multi-node testing posture (testing/node_test_rig, SURVEY.md §4.5) —
+and a C++ socket transport can implement the same two methods.
+
+Frames are (sender_peer_id, channel, payload bytes); `channel` splits
+gossip from rpc without a real multiplexer.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+CHANNEL_GOSSIP = 0
+CHANNEL_RPC = 1
+
+
+@dataclass
+class Frame:
+    sender: str
+    channel: int
+    payload: bytes
+
+
+class Endpoint:
+    """One node's attachment to the hub: an inbox + a send method."""
+
+    def __init__(self, hub: "InProcessHub", peer_id: str):
+        self.hub = hub
+        self.peer_id = peer_id
+        self._inbox: deque[Frame] = deque()
+        self._lock = threading.Lock()
+
+    def send(self, to_peer: str, channel: int, payload: bytes) -> bool:
+        return self.hub.deliver(self.peer_id, to_peer, channel, payload)
+
+    def push(self, frame: Frame) -> None:
+        with self._lock:
+            self._inbox.append(frame)
+
+    def poll(self) -> Optional[Frame]:
+        with self._lock:
+            return self._inbox.popleft() if self._inbox else None
+
+    def drain(self) -> list:
+        with self._lock:
+            out = list(self._inbox)
+            self._inbox.clear()
+            return out
+
+
+class InProcessHub:
+    """All endpoints in one process; delivery is an append to the
+    target's inbox. Supports fault injection: `partition(a, b)` drops
+    frames both ways (failure-detection tests)."""
+
+    def __init__(self):
+        self._endpoints: dict[str, Endpoint] = {}
+        self._partitions: set[frozenset] = set()
+        self._lock = threading.Lock()
+        self.dropped = 0
+
+    def join(self, peer_id: str) -> Endpoint:
+        ep = Endpoint(self, peer_id)
+        with self._lock:
+            self._endpoints[peer_id] = ep
+        return ep
+
+    def leave(self, peer_id: str) -> None:
+        with self._lock:
+            self._endpoints.pop(peer_id, None)
+
+    def peers(self) -> list:
+        with self._lock:
+            return list(self._endpoints)
+
+    def deliver(self, sender: str, to_peer: str, channel: int, payload: bytes) -> bool:
+        with self._lock:
+            if frozenset((sender, to_peer)) in self._partitions:
+                self.dropped += 1
+                return False
+            ep = self._endpoints.get(to_peer)
+        if ep is None:
+            return False
+        ep.push(Frame(sender=sender, channel=channel, payload=payload))
+        return True
+
+    # -- fault injection
+
+    def partition(self, a: str, b: str) -> None:
+        with self._lock:
+            self._partitions.add(frozenset((a, b)))
+
+    def heal(self, a: str, b: str) -> None:
+        with self._lock:
+            self._partitions.discard(frozenset((a, b)))

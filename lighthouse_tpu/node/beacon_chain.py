@@ -267,14 +267,22 @@ class BeaconChain:
         return self.state_for_block(self.head.root)
 
     def _justified_balances(self, justified_root: bytes, justified_epoch: int):
-        """Vote weights for fork choice: the JUSTIFIED state's active,
-        unslashed effective balances (fork_choice.rs justified-balances;
-        a stale vote from an exited/slashed validator must not move the
-        head). Returns None if the state is unavailable so the caller
-        keeps its previous weights."""
+        """Vote weights for fork choice: the JUSTIFIED checkpoint
+        state's active, unslashed effective balances (fork_choice.rs
+        justified-balances; a stale vote from an exited/slashed
+        validator must not move the head). The spec's checkpoint state
+        is the block's state ADVANCED to the checkpoint epoch boundary —
+        effective-balance updates and activations at that transition
+        must be reflected or weights diverge from other clients. Runs
+        once per justification change. Returns None if the state is
+        unavailable so the caller keeps its previous weights."""
         state = self.state_for_block(justified_root)
         if state is None:
             return None
+        boundary = st.compute_start_slot_at_epoch(self.spec, justified_epoch)
+        if state.slot < boundary:
+            state = state.copy()
+            st.process_slots(self.spec, state, boundary)
         return [
             v.effective_balance
             if (st.is_active_validator(v, justified_epoch) and not v.slashed)
@@ -299,21 +307,6 @@ class BeaconChain:
             if block.slot > self.current_slot:
                 raise BlockError("block from the future")
 
-            # Deneb data availability gate (data_availability_checker
-            # role): a block committing to blobs imports only once every
-            # sidecar has arrived and batch-verified.
-            commitments = list(block.body.blob_kzg_commitments)
-            if commitments:
-                if self.da_checker is None:
-                    raise BlockError(
-                        "block commits to blobs but chain has no kzg"
-                    )
-                self.da_checker.expect(block_root, len(commitments))
-                if not self.da_checker.is_available(block_root):
-                    raise AvailabilityPending(
-                        f"{len(commitments)} blobs committed, not all seen"
-                    )
-
             state = parent_state.copy()
             if state.slot < block.slot:
                 st.process_slots(self.spec, state, block.slot)
@@ -329,6 +322,23 @@ class BeaconChain:
                 verifier.include_all(self.spec, state, signed_block)
                 if not verifier.verify(backend=self.bls_backend):
                     raise BlockError("block signature batch invalid")
+
+            # Deneb data availability gate (data_availability_checker
+            # role): a block committing to blobs imports only once every
+            # sidecar has arrived and batch-verified. AFTER the signature
+            # batch: unsigned junk must never register DA expectations
+            # (it could FIFO-evict honest pending entries).
+            commitments = list(block.body.blob_kzg_commitments)
+            if commitments:
+                if self.da_checker is None:
+                    raise BlockError(
+                        "block commits to blobs but chain has no kzg"
+                    )
+                self.da_checker.expect(block_root, len(commitments))
+                if not self.da_checker.is_available(block_root):
+                    raise AvailabilityPending(
+                        f"{len(commitments)} blobs committed, not all seen"
+                    )
 
             st.process_block(
                 self.spec, state, block, verify_signatures=False
@@ -382,6 +392,115 @@ class BeaconChain:
                 if self.da_checker.is_available(root):
                     ready.append(root)
         return ready
+
+    def process_chain_segment(
+        self, signed_blocks, verify_signatures: bool = True
+    ) -> list:
+        """Import a linked run of blocks with ONE signature batch across
+        the whole segment (block_verification.rs:599
+        signature_verify_chain_segment -> the range-sync fast path,
+        sync_methods.rs process_chain_segment). Returns imported roots.
+
+        On batch failure falls back to per-block import so one bad block
+        poisons only itself (the scheduler's poisoning defense applied
+        at segment scale)."""
+        if not signed_blocks:
+            return []
+        with self._lock:
+            blocks = [sb.message for sb in signed_blocks]
+            for a, b in zip(blocks, blocks[1:]):
+                if bytes(b.parent_root) != a.hash_tree_root():
+                    raise BlockError("segment not linked")
+            # skip already-imported prefix
+            start = 0
+            while start < len(blocks) and self.fork_choice.contains_block(
+                blocks[start].hash_tree_root()
+            ):
+                start += 1
+            signed_blocks = signed_blocks[start:]
+            blocks = blocks[start:]
+            if not blocks:
+                return []
+            parent_state = self.state_for_block(bytes(blocks[0].parent_root))
+            if parent_state is None:
+                raise BlockError("unknown parent for segment")
+
+            # ONE transition pass: advance through the segment capturing
+            # per-block post-states (reused at import — no second
+            # transition), accumulating every signature set on the way.
+            verifier = (
+                BlockSignatureVerifier(
+                    self.spec,
+                    self._get_pubkey,
+                    parent_state.fork,
+                    self.genesis_validators_root,
+                )
+                if verify_signatures
+                else None
+            )
+            state = parent_state
+            post_states, valid_prefix = [], len(signed_blocks)
+            for i, sb in enumerate(signed_blocks):
+                state = state.copy()
+                try:
+                    if state.slot < sb.message.slot:
+                        st.process_slots(self.spec, state, sb.message.slot)
+                    if verifier is not None:
+                        verifier.include_all(self.spec, state, sb)
+                    st.process_block(
+                        self.spec, state, sb.message, verify_signatures=False
+                    )
+                    if bytes(sb.message.state_root) != state.hash_tree_root():
+                        raise st.BlockProcessingError("state root mismatch")
+                except Exception:
+                    # transition-invalid (or malformed) block: keep the
+                    # valid prefix, re-batch its signatures alone (the
+                    # failed block's sets may already be in the verifier)
+                    valid_prefix = i
+                    break
+                post_states.append(state)
+            if valid_prefix < len(signed_blocks):
+                if valid_prefix == 0:
+                    raise BlockError("segment head invalid")
+                return self.process_chain_segment(
+                    signed_blocks[:valid_prefix], verify_signatures
+                )
+            if verifier is not None and not verifier.verify(
+                backend=self.bls_backend
+            ):
+                # poisoned segment: per-block fallback identifies the
+                # first invalid block and imports the good prefix
+                imported = []
+                for sb in signed_blocks:
+                    try:
+                        imported.append(self.process_block(sb))
+                    except BlockError:
+                        break
+                return imported
+            imported = []
+            for sb, post in zip(signed_blocks, post_states):
+                root = sb.message.hash_tree_root()
+                # DA gate applies per block even on the segment path
+                commitments = list(sb.message.body.blob_kzg_commitments)
+                if commitments:
+                    if self.da_checker is None:
+                        raise BlockError("blob block but chain has no kzg")
+                    self.da_checker.expect(root, len(commitments))
+                    if not self.da_checker.is_available(root):
+                        break  # stop at the first unavailable block
+                self._import_block(sb, root, post)
+                imported.append(root)
+            return imported
+
+    def block_root_at_slot(self, slot: int):
+        """Canonical block root at `slot` (hot: walk from head; cold:
+        the archived slot index). None for skipped slots."""
+        with self._lock:
+            if slot < self.store.split_slot:
+                return self.store.get_cold_block_root(slot)
+            canonical = self.canonical_roots_through(self.head.root)
+            entry = canonical.get(slot)
+            return entry[0] if entry else None
 
     def _import_block(self, signed_block, block_root: bytes, state) -> None:
         block = signed_block.message

@@ -46,6 +46,7 @@ def _block_on(spec, state, slot, body_mutate=None):
         st.process_slots(spec, pre, slot)
     proposer = st.get_beacon_proposer_index(spec, pre)
     body = T.BeaconBlockBody.default()
+    body.randao_reveal = b"\xc0" + b"\x00" * 95  # parseable infinity sig
     body.sync_aggregate = T.SyncAggregate.make(
         sync_committee_bits=[False] * spec.preset.sync_committee_size,
         sync_committee_signature=b"\xc0" + b"\x00" * 95,
@@ -343,6 +344,39 @@ def test_sidecar_proposer_signature_enforced():
         chain.process_block(right, verify_signatures=False)
         == block.hash_tree_root()
     )
+
+
+def test_block_before_blobs_parks_then_imports():
+    """Honest Deneb gossip ordering (block first, sidecars trailing):
+    the block parks without peer penalty and imports automatically when
+    the last sidecar lands."""
+    from lighthouse_tpu.network import (
+        InProcessHub,
+        NetworkBeaconProcessor,
+        NetworkService,
+    )
+    from lighthouse_tpu.node.beacon_processor import BeaconProcessor
+
+    kzg = _FakeKzg()
+    chain, signed, sidecars = _chain_with_blob_block(kzg)
+    hub = InProcessHub()
+    svc = NetworkService(hub, "n")
+    proc = BeaconProcessor()
+    nbp = NetworkBeaconProcessor(chain, proc, svc)
+
+    nbp._on_gossip_block("peer", T.SignedBeaconBlock.serialize(signed))
+    while proc.step():
+        pass
+    root = signed.message.hash_tree_root()
+    assert root in nbp._awaiting_blobs  # parked, not dropped
+    assert chain.head.root != root
+
+    for sc in sidecars:
+        nbp._on_gossip_blob("peer", T.BlobSidecar.serialize(sc))
+    while proc.step():
+        pass
+    assert chain.head.root == root  # retried and imported
+    assert nbp._awaiting_blobs == {}
 
 
 def test_no_kzg_chain_rejects_blob_blocks():

@@ -1,0 +1,264 @@
+"""Networking plane tests (VERDICT r1 #5 "done" criteria): two
+in-process nodes gossip blocks/attestations and a syncing node catches
+up via range sync driving whole-segment signature batches.
+
+Mirrors the reference's in-process multi-node posture
+(testing/node_test_rig / simulator, SURVEY.md §4.5): full stacks —
+transport hub, gossip mesh, rpc, peer manager, router,
+NetworkBeaconProcessor, beacon_processor scheduler, SyncManager, chain —
+wired together in one process, no real sockets.
+"""
+
+import pytest
+
+from lighthouse_tpu.consensus import state_transition as st
+from lighthouse_tpu.consensus import types as T
+from lighthouse_tpu.consensus.spec import mainnet_spec
+from lighthouse_tpu.crypto.bls.keys import SecretKey
+from lighthouse_tpu.network import (
+    InProcessHub,
+    NetworkBeaconProcessor,
+    NetworkService,
+    SyncManager,
+)
+from lighthouse_tpu.network.gossip import TOPIC_ATTESTATION_SUBNET, TOPIC_BLOCK, topic_for
+from lighthouse_tpu.network.peer_manager import PeerAction, PeerStatus
+from lighthouse_tpu.network.rpc import Protocol, ResponseCode, Status
+from lighthouse_tpu.network.transport import CHANNEL_GOSSIP
+from lighthouse_tpu.node.beacon_chain import BeaconChain
+from lighthouse_tpu.node.beacon_processor import BeaconProcessor
+
+N = 16
+SPEC = mainnet_spec()
+DIGEST = b"\xaa\xbb\xcc\xdd"
+
+
+def _genesis():
+    pubkeys = [
+        SecretKey.from_seed(i.to_bytes(4, "big")).public_key().to_bytes()
+        for i in range(N)
+    ]
+    return st.interop_genesis_state(SPEC, pubkeys)
+
+
+class Node:
+    """Minimal in-process node assembly (ClientBuilder role for tests)."""
+
+    def __init__(self, hub, name, genesis_state):
+        self.chain = BeaconChain(SPEC, genesis_state, bls_backend="fake")
+        self.processor = BeaconProcessor()
+        self.service = NetworkService(hub, name)
+        self.service.subscribe(topic_for(TOPIC_BLOCK, DIGEST))
+        self.service.subscribe(topic_for(TOPIC_ATTESTATION_SUBNET, DIGEST, 0))
+        self.nbp = NetworkBeaconProcessor(
+            self.chain, self.processor, self.service, fork_digest=DIGEST
+        )
+        self.sync = SyncManager(self.chain, self.processor, self.service, self.nbp)
+
+    def pump(self) -> int:
+        """One round: drain network events into work, run the scheduler."""
+        n = 0
+        for ev in self.service.poll():
+            self.nbp.handle_gossip(ev.peer_id, ev.topic, ev.data)
+            n += 1
+        while self.processor.step():
+            n += 1
+        return n
+
+
+def _settle(nodes, rounds=30):
+    for _ in range(rounds):
+        if sum(node.pump() for node in nodes) == 0:
+            break
+
+
+def _extend(node, slot, others=()):
+    """Produce+import a block on `node`; advance every node's slot clock
+    (in production the per-node timer does this from wall time — peers
+    are behind in BLOCKS, never in TIME)."""
+    for n in (node, *others):
+        n.chain.on_slot(slot)
+    sig = b"\xc0" + b"\x00" * 95  # parseable; fake backend accepts
+    block = node.chain.produce_block(slot, randao_reveal=sig)
+    signed = T.SignedBeaconBlock.make(message=block, signature=sig)
+    node.chain.process_block(signed)
+    return signed
+
+
+@pytest.fixture()
+def pair():
+    hub = InProcessHub()
+    genesis = _genesis()
+    a = Node(hub, "a", genesis.copy())
+    b = Node(hub, "b", genesis.copy())
+    a.service.connect_peer(b.service)
+    return hub, a, b
+
+
+# ------------------------------------------------------------ gossip
+
+
+def test_gossip_block_propagates(pair):
+    hub, a, b = pair
+    signed = _extend(a, 1, others=[b])
+    a.nbp.publish_block(signed)
+    _settle([a, b])
+    assert b.chain.head.root == a.chain.head.root
+    assert b.nbp.imported_blocks == 1
+
+
+def test_gossip_dedup_no_loop(pair):
+    hub, a, b = pair
+    c = Node(hub, "c", _genesis().copy())
+    for x, y in [(a, c), (b, c)]:
+        x.service.connect_peer(y.service)
+    signed = _extend(a, 1, others=[b, c])
+    a.nbp.publish_block(signed)
+    _settle([a, b, c])
+    # triangle topology: everyone got it exactly once despite re-forwarding
+    assert b.nbp.imported_blocks == 1
+    assert c.nbp.imported_blocks == 1
+
+
+def test_gossip_attestations_form_batches(pair):
+    hub, a, b = pair
+    signed = _extend(a, 1, others=[b])
+    a.nbp.publish_block(signed)
+    _settle([a, b])
+    # collect attestations from several committee members on node A
+    state = a.chain.head_state().copy()
+    st.process_slots(SPEC, state, 2)
+    committee = st.get_beacon_committee(SPEC, state, 1, 0)
+    a.chain.on_slot(3)
+    b.chain.on_slot(3)
+    sent = 0
+    for pos in range(len(committee)):
+        bits = [i == pos for i in range(len(committee))]
+        att = T.Attestation.make(
+            aggregation_bits=bits,
+            data=T.AttestationData.make(
+                slot=1,
+                index=0,
+                beacon_block_root=a.chain.head.root,
+                source=T.Checkpoint.make(
+                    epoch=state.current_justified_checkpoint.epoch,
+                    root=bytes(state.current_justified_checkpoint.root),
+                ),
+                target=T.Checkpoint.make(epoch=0, root=a.chain.genesis_root),
+            ),
+            signature=b"\xc0" + b"\x00" * 95,
+        )
+        a.nbp.publish_attestation(att, subnet=0)
+        sent += 1
+    _settle([a, b])
+    assert b.nbp.verified_attestations == sent
+
+
+# ------------------------------------------------------------ rpc + peers
+
+
+def test_status_handshake(pair):
+    hub, a, b = pair
+    _extend(a, 1, others=[b])
+    b.sync.add_peer("a")
+    _settle([a, b])
+    status = b.sync.peer_status["a"]
+    assert status.head_slot == 1
+    assert bytes(status.head_root) == a.chain.head.root
+
+
+def test_banned_peer_is_silenced(pair):
+    hub, a, b = pair
+    b.service.report_peer("a", PeerAction.FATAL)
+    assert b.service.peers.peers["a"].status == PeerStatus.BANNED
+    signed = _extend(a, 1, others=[b])
+    a.nbp.publish_block(signed)
+    _settle([a, b])
+    assert b.nbp.imported_blocks == 0  # frames from banned peer dropped
+
+
+def test_partition_drops_frames(pair):
+    hub, a, b = pair
+    hub.partition("a", "b")
+    signed = _extend(a, 1, others=[b])
+    a.nbp.publish_block(signed)
+    _settle([a, b])
+    assert b.nbp.imported_blocks == 0
+    hub.heal("a", "b")
+    a.nbp.publish_block(signed)  # seen-cache: won't re-forward
+    # direct republish by re-gossip from A's chain: use rpc path instead
+    b.sync.add_peer("a")
+    _settle([a, b])
+    b.sync.tick()
+    _settle([a, b])
+    assert b.chain.head.root == a.chain.head.root
+
+
+# ------------------------------------------------------------ range sync
+
+
+def test_range_sync_catches_up(pair):
+    hub, a, b = pair
+    for slot in range(1, 9):
+        _extend(a, slot, others=[b])
+    b.sync.add_peer("a")
+    _settle([a, b])
+    b.sync.tick()  # one batch covers the whole gap
+    _settle([a, b])
+    assert b.chain.head.slot == 8
+    assert b.chain.head.root == a.chain.head.root
+    # the server peer earned positive score for useful data
+    assert b.service.peers.peers["a"].score > 0
+
+
+def test_malformed_rpc_frame_penalized_not_fatal(pair):
+    hub, a, b = pair
+    from lighthouse_tpu.network.transport import CHANNEL_RPC
+
+    b.service.endpoint.send("a", CHANNEL_RPC, b"\x01")  # 1-byte garbage
+    a.pump()  # must not raise (remote input can't kill the loop)
+    assert a.service.peers.peers["b"].score < 0
+
+
+def test_forged_rpc_response_from_wrong_peer_ignored(pair):
+    import struct
+
+    from lighthouse_tpu.network.rpc import Protocol as P
+    from lighthouse_tpu.network.transport import CHANNEL_RPC
+
+    hub, a, b = pair
+    c = Node(hub, "c", _genesis().copy())
+    b.service.connect_peer(c.service)
+    _extend(a, 1, others=[b, c])
+    b.sync.add_peer("a")  # b's req_id 0 now pending, addressed to a
+    # c forges a response to req_id 0 claiming empty status
+    forged = struct.pack("<IBB", 0, P.STATUS, 1) + struct.pack("<BH", 0, 0)
+    c.service.endpoint.send("b", CHANNEL_RPC, forged)
+    _settle([a, b, c])
+    # the forgery was rejected (c penalized) and a's REAL answer landed
+    assert b.service.peers.peers["c"].score < 0
+    assert b.sync.peer_status["a"].head_slot == 1
+
+
+def test_parent_walk_depth_bounded(pair, monkeypatch):
+    from lighthouse_tpu.network import sync as sync_mod
+
+    monkeypatch.setattr(sync_mod, "MAX_PARENT_DEPTH", 3)
+    hub, a, b = pair
+    signeds = [_extend(a, s, others=[b]) for s in range(1, 8)]
+    # b sees only the tip; the ancestor walk must stop after 3 hops
+    a.nbp.publish_block(signeds[-1])
+    _settle([a, b])
+    assert b.chain.head.slot == 0  # never connected to genesis
+    assert len(b.sync._awaiting_parent) <= 4 * 3
+
+
+def test_unknown_parent_lookup(pair):
+    hub, a, b = pair
+    _extend(a, 1, others=[b])
+    signed2 = _extend(a, 2, others=[b])
+    # B never saw block 1; gossip of block 2 triggers a parent lookup
+    a.nbp.publish_block(signed2)
+    _settle([a, b])
+    assert b.chain.head.slot == 2
+    assert b.chain.head.root == a.chain.head.root
