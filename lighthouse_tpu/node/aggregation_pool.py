@@ -1,0 +1,145 @@
+"""Naive aggregation pool: unaggregated gossip items merge into local
+aggregates (beacon_chain/src/naive_aggregation_pool.rs:976 analog).
+
+One map per slot window: SSZ-root of the attestation data (or sync
+contribution id) -> the best-known local aggregate. Inserting a new
+signature ORs the participation bits and adds the G2 points — by the
+time an aggregator duty fires, the pool already holds the aggregate to
+publish. Pruned by slot.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..consensus import types as T
+from ..crypto.bls import curve as C
+
+SLOT_RETENTION = 32  # prune aggregates older than this many slots
+
+
+class AggregationError(Exception):
+    pass
+
+
+def _merge_signatures(sig_a: bytes, sig_b: bytes) -> bytes:
+    from ..crypto.bls.keys import Signature, aggregate_signatures
+
+    return aggregate_signatures(
+        [Signature.from_bytes(bytes(sig_a)), Signature.from_bytes(bytes(sig_b))]
+    ).to_bytes()
+
+
+class NaiveAggregationPool:
+    def __init__(self):
+        # data_root -> (slot, Attestation aggregate, validator indices)
+        self._atts: dict[bytes, tuple] = {}
+        # (slot, block_root, subcommittee) -> SyncCommitteeContribution
+        self._sync: dict[tuple, object] = {}
+
+    # ------------------------------------------------------------ attestations
+
+    def insert_attestation(self, attestation, indices=()) -> None:
+        """Merge a (possibly single-bit) attestation into the local
+        aggregate for its data. `indices` are the attesting validator
+        indices the caller resolved from the bits (tracked so the op
+        pool can know exactly whom the aggregate covers)."""
+        data = attestation.data
+        root = T.AttestationData.hash_tree_root(data)
+        bits = list(attestation.aggregation_bits)
+        entry = self._atts.get(root)
+        if entry is None:
+            self._atts[root] = (
+                data.slot,
+                T.Attestation.make(
+                    aggregation_bits=bits,
+                    data=data,
+                    signature=bytes(attestation.signature),
+                ),
+                frozenset(indices),
+            )
+            return
+        slot, agg, agg_idx = entry
+        agg_bits = list(agg.aggregation_bits)
+        if any(a and b for a, b in zip(agg_bits, bits)):
+            # overlapping signer: the aggregate already covers it (the
+            # reference refuses double-merge rather than de-duplicate)
+            if all(b <= a for a, b in zip(agg_bits, bits)):
+                return
+            raise AggregationError("partially overlapping attestation")
+        # REBUILD, never mutate: previously-handed-out aggregates may be
+        # embedded in signed blocks / the op pool — in-place updates
+        # would silently change stored block bodies
+        self._atts[root] = (
+            slot,
+            T.Attestation.make(
+                aggregation_bits=[a or b for a, b in zip(agg_bits, bits)],
+                data=agg.data,
+                signature=_merge_signatures(
+                    agg.signature, attestation.signature
+                ),
+            ),
+            agg_idx | frozenset(indices),
+        )
+
+    def get_aggregate(self, data) -> Optional[object]:
+        root = T.AttestationData.hash_tree_root(data)
+        entry = self._atts.get(root)
+        return entry[1] if entry else None
+
+    def get_indices(self, data) -> frozenset:
+        root = T.AttestationData.hash_tree_root(data)
+        entry = self._atts.get(root)
+        return entry[2] if entry else frozenset()
+
+    def aggregates_for_slot(self, slot: int) -> list:
+        return [a for s, a, _ in self._atts.values() if s == slot]
+
+    # ------------------------------------------------------------ sync msgs
+
+    def insert_sync_message(
+        self, msg, subcommittee: int, position_in_subcommittee: int, subnet_size: int
+    ) -> None:
+        """Merge a SyncCommitteeMessage into the per-subcommittee
+        contribution (sync_committee_verification + naive pool roles)."""
+        key = (int(msg.slot), bytes(msg.beacon_block_root), subcommittee)
+        entry = self._sync.get(key)
+        if entry is None:
+            bits = [False] * subnet_size
+            bits[position_in_subcommittee] = True
+            self._sync[key] = T.SyncCommitteeContribution.make(
+                slot=msg.slot,
+                beacon_block_root=bytes(msg.beacon_block_root),
+                subcommittee_index=subcommittee,
+                aggregation_bits=bits,
+                signature=bytes(msg.signature),
+            )
+            return
+        bits = list(entry.aggregation_bits)
+        if bits[position_in_subcommittee]:
+            return  # already merged
+        bits[position_in_subcommittee] = True
+        # rebuild (same no-mutation rule as attestations)
+        self._sync[key] = T.SyncCommitteeContribution.make(
+            slot=entry.slot,
+            beacon_block_root=bytes(entry.beacon_block_root),
+            subcommittee_index=entry.subcommittee_index,
+            aggregation_bits=bits,
+            signature=_merge_signatures(entry.signature, msg.signature),
+        )
+
+    def get_contribution(
+        self, slot: int, block_root: bytes, subcommittee: int
+    ) -> Optional[object]:
+        return self._sync.get((slot, bytes(block_root), subcommittee))
+
+    # ------------------------------------------------------------ pruning
+
+    def prune(self, current_slot: int) -> None:
+        cutoff = max(0, current_slot - SLOT_RETENTION)
+        self._atts = {
+            r: entry for r, entry in self._atts.items() if entry[0] >= cutoff
+        }
+        self._sync = {
+            k: v for k, v in self._sync.items() if k[0] >= cutoff
+        }

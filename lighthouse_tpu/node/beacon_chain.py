@@ -32,7 +32,9 @@ from ..consensus.signature_sets import (
 )
 from ..consensus.spec import ChainSpec
 from ..crypto import bls
+from .aggregation_pool import NaiveAggregationPool
 from .blob_verification import DataAvailabilityChecker
+from .operation_pool import OperationPool
 from .store import HotColdDB
 
 
@@ -64,6 +66,15 @@ class ChainHead:
     root: bytes
     slot: int
     state_root: bytes
+
+
+@dataclass
+class _IndexedView:
+    """Duck-typed IndexedAttestation for the signature-set constructor."""
+
+    attesting_indices: list
+    data: object
+    signature: bytes
 
 
 class BeaconChain:
@@ -131,6 +142,10 @@ class BeaconChain:
 
         # gossip duplicate filters (observed_attesters role)
         self._observed_attesters: set = set()
+        self._observed_aggregators: set = set()
+        # pools: local aggregation + block packing
+        self.agg_pool = NaiveAggregationPool()
+        self.op_pool = OperationPool(spec)
 
         self.m_blocks = metrics.counter("beacon_chain_blocks_imported_total")
         self.m_atts = metrics.counter(
@@ -235,6 +250,9 @@ class BeaconChain:
             )
         self._persisted_pubkeys = len(self.pubkey_cache)
         self._observed_attesters = set()
+        self._observed_aggregators = set()
+        self.agg_pool = NaiveAggregationPool()
+        self.op_pool = OperationPool(spec)
         self.m_blocks = metrics.counter("beacon_chain_blocks_imported_total")
         self.m_atts = metrics.counter(
             "beacon_chain_attestations_verified_total"
@@ -657,8 +675,190 @@ class BeaconChain:
                 for index in v.indexed_indices:
                     self._observed_attesters.add((index, epoch))
                 self.apply_attestation_to_fork_choice(v)
+                # feed local aggregation + packing (naive pool merges
+                # signatures and tracks the covered indices; the op pool
+                # stores the widened aggregate with ITS OWN index set)
+                try:
+                    self.agg_pool.insert_attestation(
+                        v.attestation, v.indexed_indices
+                    )
+                except Exception:
+                    pass  # overlap with existing aggregate: nothing new
+                agg = self.agg_pool.get_aggregate(v.attestation.data)
+                if agg is not None:
+                    self.op_pool.insert_attestation(
+                        agg, self.agg_pool.get_indices(v.attestation.data)
+                    )
+                else:
+                    self.op_pool.insert_attestation(
+                        v.attestation, v.indexed_indices
+                    )
         self.m_atts.inc(len(good))
         return good
+
+    def verify_aggregate_for_gossip(self, signed_aggregate):
+        """Aggregate-and-proof gossip verification: spec checks +
+        is_aggregator selection, then THREE signature sets — selection
+        proof, aggregator signature, aggregate attestation — verified in
+        ONE batch (attestation_verification/batch.rs:28-128, 3 sets per
+        aggregate). Returns the VerifiedAttestation for the inner
+        aggregate; applies fork choice + pools."""
+        from ..consensus.signature_sets import (
+            signed_aggregate_selection_proof_signature_set,
+            signed_aggregate_signature_set,
+        )
+
+        msg = signed_aggregate.message
+        aggregate = msg.aggregate
+        data = aggregate.data
+        epoch = st.compute_epoch_at_slot(self.spec, data.slot)
+        cur_epoch = st.compute_epoch_at_slot(self.spec, self.current_slot)
+        if epoch not in (cur_epoch, max(cur_epoch - 1, 0)):
+            raise AttestationError("aggregate epoch not current or previous")
+        with self._lock:
+            key = (int(msg.aggregator_index), int(data.slot), int(data.index))
+            if key in self._observed_aggregators:
+                raise AttestationError("aggregator already seen (observed_aggregates)")
+            target_root = bytes(data.target.root)
+            if not self.fork_choice.contains_block(target_root):
+                raise AttestationError("unknown target block")
+            state = self.state_for_block(target_root)
+            if state is None:
+                raise AttestationError("no state for target")
+            adv = state
+            if adv.slot < data.slot:
+                adv = state.copy()
+                st.process_slots(self.spec, adv, data.slot)
+            committee = st.get_beacon_committee(
+                self.spec, adv, data.slot, data.index
+            )
+            if int(msg.aggregator_index) not in committee:
+                raise AttestationError("aggregator not in committee")
+            if not self._is_aggregator(
+                len(committee), bytes(msg.selection_proof)
+            ):
+                raise AttestationError("invalid aggregator selection")
+            bits = list(aggregate.aggregation_bits)
+            if len(bits) != len(committee) or not any(bits):
+                raise AttestationError("bad aggregation bits")
+            indices = [c for c, b in zip(committee, bits) if b]
+
+            fork = adv.fork
+            sets = [
+                signed_aggregate_selection_proof_signature_set(
+                    self.spec,
+                    self._get_pubkey,
+                    signed_aggregate,
+                    fork,
+                    self.genesis_validators_root,
+                ),
+                signed_aggregate_signature_set(
+                    self.spec,
+                    self._get_pubkey,
+                    signed_aggregate,
+                    fork,
+                    self.genesis_validators_root,
+                ),
+                indexed_attestation_signature_set(
+                    self.spec,
+                    self._get_pubkey,
+                    _IndexedView(indices, data, bytes(aggregate.signature)),
+                    fork,
+                    self.genesis_validators_root,
+                ),
+            ]
+            if not bls.verify_signature_sets(sets, backend=self.bls_backend):
+                raise AttestationError("aggregate signature batch invalid")
+            self._observed_aggregators.add(key)
+            v = VerifiedAttestation(
+                attestation=aggregate,
+                indexed_indices=indices,
+                signature_set=sets[2],
+            )
+            for index in indices:
+                self._observed_attesters.add((index, epoch))
+            self.apply_attestation_to_fork_choice(v)
+            self.op_pool.insert_attestation(aggregate, indices)
+            self.m_atts.inc()
+            return v
+
+    def _is_aggregator(self, committee_len: int, selection_proof: bytes) -> bool:
+        """spec is_aggregator: hash(selection_proof)[:8] mod
+        (committee_len // TARGET_AGGREGATORS) == 0."""
+        import hashlib
+
+        modulo = max(
+            1, committee_len // self.spec.target_aggregators_per_committee
+        )
+        h = hashlib.sha256(selection_proof).digest()
+        return int.from_bytes(h[:8], "little") % modulo == 0
+
+    # -------------------------------------------------- gossip operations
+
+    def receive_voluntary_exit(self, signed_exit) -> None:
+        """Gossip-level exit verification (verify_operation.rs role) +
+        pool insert."""
+        from ..consensus.signature_sets import exit_signature_set
+
+        with self._lock:
+            state = self.head_state()
+            epoch = st.get_current_epoch(self.spec, state)
+            if not self.op_pool._exit_valid(state, signed_exit, epoch):
+                raise BlockError("exit not valid against head state")
+            s = exit_signature_set(
+                self.spec,
+                self._get_pubkey,
+                signed_exit,
+                state.fork,
+                self.genesis_validators_root,
+            )
+            if not bls.verify_signature_sets([s], backend=self.bls_backend):
+                raise BlockError("exit signature invalid")
+            self.op_pool.insert_voluntary_exit(signed_exit)
+
+    def receive_attester_slashing(self, slashing) -> None:
+        """Verify + pool + fork-choice equivocation marking
+        (on_attester_slashing, fork_choice.rs:1099)."""
+        from ..consensus.signature_sets import attester_slashing_signature_sets
+
+        with self._lock:
+            state = self.head_state()
+            epoch = st.get_current_epoch(self.spec, state)
+            if not self.op_pool._attester_slashing_valid(state, slashing, epoch):
+                raise BlockError("attester slashing not slashable")
+            sets = attester_slashing_signature_sets(
+                self.spec,
+                self._get_pubkey,
+                slashing,
+                state.fork,
+                self.genesis_validators_root,
+            )
+            if not bls.verify_signature_sets(sets, backend=self.bls_backend):
+                raise BlockError("attester slashing signatures invalid")
+            self.op_pool.insert_attester_slashing(slashing)
+            both = set(slashing.attestation_1.attesting_indices) & set(
+                slashing.attestation_2.attesting_indices
+            )
+            self.fork_choice.on_attester_slashing(both)
+
+    def receive_proposer_slashing(self, slashing) -> None:
+        from ..consensus.signature_sets import proposer_slashing_signature_sets
+
+        with self._lock:
+            state = self.head_state()
+            epoch = st.get_current_epoch(self.spec, state)
+            if not self.op_pool._proposer_slashing_valid(state, slashing, epoch):
+                raise BlockError("proposer slashing not slashable")
+            sets = proposer_slashing_signature_sets(
+                self.spec,
+                self._get_pubkey,
+                slashing,
+                state.fork,
+                self.genesis_validators_root,
+            )
+            if not bls.verify_signature_sets(sets, backend=self.bls_backend):
+                raise BlockError("proposer slashing signatures invalid")
+            self.op_pool.insert_proposer_slashing(slashing)
 
     def apply_attestation_to_fork_choice(self, v: VerifiedAttestation) -> None:
         data = v.attestation.data
@@ -675,12 +875,15 @@ class BeaconChain:
     # ------------------------------------------------------------ production
 
     def produce_block(self, slot: int, randao_reveal: bytes = b"\x00" * 96):
-        """Minimal block production on the canonical head (empty body;
-        op-pool packing arrives with the operation pool component)."""
+        """Block production on the canonical head with FULL bodies
+        packed from the pools (operation_pool get_attestations max-cover
+        + slashings/exits/bls changes + the naive pool's sync aggregate;
+        produce_block.rs role)."""
         with self._lock:
             head_state = self.head_state()
             if head_state is None:
                 raise BlockError("no head state")
+            parent_root = self.head.root
             state = head_state.copy()
             if state.slot < slot:
                 st.process_slots(self.spec, state, slot)
@@ -688,10 +891,16 @@ class BeaconChain:
             body = T.BeaconBlockBody.default()
             body.randao_reveal = randao_reveal
             body.eth1_data = state.eth1_data
-            body.sync_aggregate = T.SyncAggregate.make(
-                sync_committee_bits=[False]
-                * self.spec.preset.sync_committee_size,
-                sync_committee_signature=b"\xc0" + b"\x00" * 95,
+            prop_sl, att_sl, exits, bls_changes = (
+                self.op_pool.get_slashings_and_exits(state)
+            )
+            body.proposer_slashings = prop_sl
+            body.attester_slashings = att_sl
+            body.attestations = self.op_pool.get_attestations(state)
+            body.voluntary_exits = exits
+            body.bls_to_execution_changes = bls_changes
+            body.sync_aggregate = self.op_pool.get_sync_aggregate(
+                self.agg_pool, state, parent_root
             )
             body.execution_payload = st.mock_execution_payload(self.spec, state)
             block = T.BeaconBlock.make(
@@ -749,6 +958,11 @@ class BeaconChain:
                 for (i, e) in self._observed_attesters
                 if e + 1 >= cur_epoch
             }
+            # pool pruning rides the same finality tick
+            head_state = self.head_state()
+            if head_state is not None:
+                self.op_pool.prune(head_state)
+            self.agg_pool.prune(self.current_slot)
         # finality advanced: snapshot so a crash after migration resumes
         # at this head (reference persists fork choice on migration)
         self.persist()

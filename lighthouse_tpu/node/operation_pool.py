@@ -1,0 +1,284 @@
+"""Operation pool with greedy max-cover attestation packing
+(beacon_node/operation_pool analog; max_cover.rs:11,49-56,
+attestation_storage.rs compaction).
+
+Block production pulls from here: attestations chosen by greedy maximum
+coverage over not-yet-included attesting indices, slashings/exits/bls
+changes deduplicated per validator and re-validated against the target
+state at packing time (verify_operation.rs role — ops can go stale
+between gossip and inclusion).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from ..consensus import state_transition as st
+from ..consensus import types as T
+
+
+# ---------------------------------------------------------------- max cover
+
+
+@dataclass
+class CoverItem:
+    """One candidate: `obj` contributes `covering` elements."""
+
+    obj: object
+    covering: set
+
+
+def maximum_cover(items: list, limit: int) -> list:
+    """Greedy max-cover (max_cover.rs:49-56): repeatedly take the item
+    covering the most uncovered elements, shrink the rest, stop at
+    `limit` or when nothing adds coverage. O(limit * n)."""
+    work = [CoverItem(i.obj, set(i.covering)) for i in items]
+    chosen = []
+    for _ in range(limit):
+        best = None
+        for it in work:
+            if it.covering and (best is None or len(it.covering) > len(best.covering)):
+                best = it
+        if best is None:
+            break
+        chosen.append(best.obj)
+        covered = best.covering
+        best.covering = set()
+        for it in work:
+            it.covering -= covered
+    return chosen
+
+
+# ---------------------------------------------------------------- the pool
+
+
+class OperationPool:
+    MAX_AGGREGATES_PER_DATA = 8  # attestation_storage keeps several
+
+    def __init__(self, spec):
+        self.spec = spec
+        # data_root -> (slot, [(attestation, attesting_indices), ...])
+        # several aggregates per data: an entry's indices are EXACTLY
+        # what its own aggregate carries, so max-cover never marks a
+        # validator covered by an attestation that doesn't include it
+        self._attestations: dict[bytes, tuple] = {}
+        self._exits: dict[int, object] = {}  # validator index -> SignedVoluntaryExit
+        self._proposer_slashings: dict[int, object] = {}
+        self._attester_slashings: dict[bytes, object] = {}  # by ssz root
+        self._bls_changes: dict[int, object] = {}
+
+    # ------------------------------------------------------------ inserts
+
+    def insert_attestation(self, attestation, attesting_indices) -> None:
+        """Store an aggregate for packing (op_pool insert_attestation).
+        Aggregates whose signers are a subset of an existing one are
+        dropped; supersets replace their subsets."""
+        root = T.AttestationData.hash_tree_root(attestation.data)
+        indices = frozenset(attesting_indices)
+        slot = int(attestation.data.slot)
+        _, entries = self._attestations.get(root, (slot, []))
+        kept = []
+        for att, idx in entries:
+            if indices <= idx:
+                return  # nothing new: an existing aggregate covers us
+            if not (idx <= indices):
+                kept.append((att, idx))  # keep non-subset entries
+        kept.append((attestation, indices))
+        self._attestations[root] = (
+            slot,
+            kept[-self.MAX_AGGREGATES_PER_DATA :],
+        )
+
+    def insert_voluntary_exit(self, signed_exit) -> None:
+        self._exits.setdefault(int(signed_exit.message.validator_index), signed_exit)
+
+    def insert_proposer_slashing(self, slashing) -> None:
+        self._proposer_slashings.setdefault(
+            int(slashing.signed_header_1.message.proposer_index), slashing
+        )
+
+    def insert_attester_slashing(self, slashing) -> None:
+        # keyed by content root: duplicate gossip must not pack the same
+        # slashing twice (the second copy would invalidate the block —
+        # its validators are already slashed by the first)
+        self._attester_slashings.setdefault(
+            T.AttesterSlashing.hash_tree_root(slashing), slashing
+        )
+
+    def insert_bls_to_execution_change(self, signed_change) -> None:
+        self._bls_changes.setdefault(
+            int(signed_change.message.validator_index), signed_change
+        )
+
+    # ------------------------------------------------------------ packing
+
+    def get_attestations(self, state) -> list:
+        """Max-cover selection of attestations valid for inclusion in a
+        block built on `state` (op_pool get_attestations)."""
+        current_epoch = st.get_current_epoch(self.spec, state)
+        previous_epoch = st.get_previous_epoch(self.spec, state)
+        # participation already in the state earns no reward: exclude
+        # (attestation_storage reward-aware covering sets, simplified to
+        # "uncovered attesting indices that haven't fully participated")
+        items = []
+        for slot, entries in self._attestations.values():
+            epoch = st.compute_epoch_at_slot(self.spec, slot)
+            if epoch not in (current_epoch, previous_epoch):
+                continue
+            if slot + self.spec.min_attestation_inclusion_delay > state.slot:
+                continue
+            if slot + self.spec.preset.slots_per_epoch < state.slot:
+                continue  # outside inclusion window
+            part = (
+                state.current_epoch_participation
+                if epoch == current_epoch
+                else state.previous_epoch_participation
+            )
+            justified = (
+                state.current_justified_checkpoint
+                if epoch == current_epoch
+                else state.previous_justified_checkpoint
+            )
+            for att, indices in entries:
+                # a fork attestation with a different source would fail
+                # the block's own process_attestation — filter here
+                if (
+                    att.data.source.epoch != justified.epoch
+                    or bytes(att.data.source.root) != bytes(justified.root)
+                ):
+                    continue
+                fresh = {
+                    i for i in indices if i < len(part) and part[i] != 0b111
+                }
+                if fresh:
+                    items.append(CoverItem(att, fresh))
+        return maximum_cover(items, self.spec.preset.max_attestations)
+
+    def get_slashings_and_exits(self, state) -> tuple:
+        """(proposer_slashings, attester_slashings, exits, bls_changes)
+        still valid against `state`."""
+        epoch = st.get_current_epoch(self.spec, state)
+        proposer = [
+            s
+            for s in self._proposer_slashings.values()
+            if self._proposer_slashing_valid(state, s, epoch)
+        ][: self.spec.preset.max_proposer_slashings]
+        attester = [
+            s
+            for s in self._attester_slashings.values()
+            if self._attester_slashing_valid(state, s, epoch)
+        ][: self.spec.preset.max_attester_slashings]
+        exits = [
+            e
+            for e in self._exits.values()
+            if self._exit_valid(state, e, epoch)
+        ][: self.spec.preset.max_voluntary_exits]
+        changes = [
+            c
+            for c in self._bls_changes.values()
+            if self._bls_change_valid(state, c)
+        ][: self.spec.preset.max_bls_to_execution_changes]
+        return proposer, attester, exits, changes
+
+    def get_sync_aggregate(self, agg_pool, state, block_root: bytes):
+        """Combine the naive pool's per-subcommittee contributions for
+        the previous slot into the block's SyncAggregate."""
+        size = self.spec.preset.sync_committee_size
+        subnets = self.spec.preset.sync_committee_subnet_count
+        subnet_size = size // subnets
+        slot = max(0, state.slot - 1)
+        bits = [False] * size
+        sig_point = None
+        found = False
+        from ..crypto.bls import curve as C
+
+        for sub in range(subnets):
+            contrib = agg_pool.get_contribution(slot, block_root, sub)
+            if contrib is None:
+                continue
+            found = True
+            for i, b in enumerate(contrib.aggregation_bits):
+                if b:
+                    bits[sub * subnet_size + i] = True
+            p = C.g2_decompress(bytes(contrib.signature))
+            sig_point = p if sig_point is None else C.g2_add(sig_point, p)
+        if not found:
+            return T.SyncAggregate.make(
+                sync_committee_bits=[False] * size,
+                sync_committee_signature=b"\xc0" + b"\x00" * 95,
+            )
+        return T.SyncAggregate.make(
+            sync_committee_bits=bits,
+            sync_committee_signature=C.g2_compress(sig_point)
+            if sig_point is not None
+            else b"\xc0" + b"\x00" * 95,
+        )
+
+    # ------------------------------------------------------------ validity
+
+    def _proposer_slashing_valid(self, state, s, epoch) -> bool:
+        i = int(s.signed_header_1.message.proposer_index)
+        return i < len(state.validators) and st.is_slashable_validator(
+            state.validators[i], epoch
+        )
+
+    def _attester_slashing_valid(self, state, s, epoch) -> bool:
+        a, b = s.attestation_1, s.attestation_2
+        if not st.is_slashable_attestation_data(a.data, b.data):
+            return False
+        both = set(a.attesting_indices) & set(b.attesting_indices)
+        return any(
+            i < len(state.validators)
+            and st.is_slashable_validator(state.validators[i], epoch)
+            for i in both
+        )
+
+    def _exit_valid(self, state, e, epoch) -> bool:
+        i = int(e.message.validator_index)
+        if i >= len(state.validators):
+            return False
+        v = state.validators[i]
+        return (
+            v.exit_epoch == st.FAR_FUTURE_EPOCH
+            and st.is_active_validator(v, epoch)
+            and epoch >= e.message.epoch
+        )
+
+    def _bls_change_valid(self, state, c) -> bool:
+        i = int(c.message.validator_index)
+        if i >= len(state.validators):
+            return False
+        wc = bytes(state.validators[i].withdrawal_credentials)
+        return wc[:1] == b"\x00"  # still BLS-type credentials
+
+    # ------------------------------------------------------------ pruning
+
+    def prune(self, state) -> None:
+        """Drop everything no longer includable (op pool prune on
+        finalization/head change)."""
+        current_epoch = st.get_current_epoch(self.spec, state)
+        self._attestations = {
+            r: entry
+            for r, entry in self._attestations.items()
+            if st.compute_epoch_at_slot(self.spec, entry[0]) + 1 >= current_epoch
+        }
+        epoch = current_epoch
+        self._exits = {
+            i: e for i, e in self._exits.items() if self._exit_valid(state, e, epoch)
+        }
+        self._proposer_slashings = {
+            i: s
+            for i, s in self._proposer_slashings.items()
+            if self._proposer_slashing_valid(state, s, epoch)
+        }
+        self._attester_slashings = {
+            r: s
+            for r, s in self._attester_slashings.items()
+            if self._attester_slashing_valid(state, s, epoch)
+        }
+        self._bls_changes = {
+            i: c
+            for i, c in self._bls_changes.items()
+            if self._bls_change_valid(state, c)
+        }

@@ -1,0 +1,29 @@
+"""Validator client components (validator_client/* analogs).
+
+  signing_method     — local-key / remote-signer seam (signing_method crate)
+  validator_store    — signing orchestration gated by slashing protection
+                       (validator_store/src/lib.rs:575,671)
+  duties             — attester/proposer duty computation + precomputed
+                       selection proofs (validator_services/duties_service.rs)
+  client             — the per-slot service loop: propose, attest at 1/3,
+                       aggregate at 2/3 (attestation_service / block_service)
+  slashing_protection— EIP-3076 SQLite watermarks (slashing_protection crate)
+"""
+
+from .slashing_protection import SlashingProtectionDB, SlashingProtectionError
+from .signing_method import LocalKeystoreSigner, SigningMethod
+from .validator_store import ValidatorStore
+from .duties import AttesterDuty, DutiesService, ProposerDuty
+from .client import ValidatorClient
+
+__all__ = [
+    "SlashingProtectionDB",
+    "SlashingProtectionError",
+    "SigningMethod",
+    "LocalKeystoreSigner",
+    "ValidatorStore",
+    "DutiesService",
+    "AttesterDuty",
+    "ProposerDuty",
+    "ValidatorClient",
+]

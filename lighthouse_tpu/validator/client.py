@@ -1,0 +1,223 @@
+"""The validator-client loop (validator_services attestation_service /
+block_service analog): per slot —
+
+  slot start : propose if we hold the proposer duty (block_service)
+  slot + 1/3 : produce/sign/publish attestations (attestation_service)
+  slot + 2/3 : aggregate-and-proof for aggregator duties
+
+The beacon node boundary is a small interface (`BeaconNodeApi`) the
+in-process node implements by direct chain calls; a typed HTTP client
+implements the same methods across processes (common/eth2 role). Every
+signature goes through the ValidatorStore, i.e. the slashing DB.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..consensus import state_transition as st
+from ..consensus import types as T
+from ..consensus.spec import ChainSpec
+from .duties import DutiesService
+from .slashing_protection import SlashingProtectionError
+from .validator_store import ValidatorStore
+
+
+class BeaconNodeApi:
+    """What the VC needs from a BN (the eth2 typed-client surface the
+    services use)."""
+
+    def head_state(self):
+        raise NotImplementedError
+
+    def produce_block(self, slot: int, randao_reveal: bytes):
+        raise NotImplementedError
+
+    def publish_block(self, signed_block) -> None:
+        raise NotImplementedError
+
+    def attestation_data(self, slot: int, committee_index: int):
+        raise NotImplementedError
+
+    def publish_attestation(self, attestation) -> None:
+        raise NotImplementedError
+
+    def aggregate_for(self, data) -> Optional[object]:
+        raise NotImplementedError
+
+    def publish_aggregate(self, signed_aggregate) -> None:
+        raise NotImplementedError
+
+    def is_aggregator(self, committee_len: int, proof: bytes) -> bool:
+        raise NotImplementedError
+
+
+class InProcessBeaconNode(BeaconNodeApi):
+    """Direct chain wiring (the testing/simulator posture)."""
+
+    def __init__(self, chain):
+        self.chain = chain
+
+    def head_state(self):
+        return self.chain.head_state()
+
+    def produce_block(self, slot, randao_reveal):
+        return self.chain.produce_block(slot, randao_reveal=randao_reveal)
+
+    def publish_block(self, signed_block):
+        self.chain.process_block(signed_block)
+
+    def attestation_data(self, slot, committee_index):
+        """produce_attestation_data role: head vote + justified source +
+        epoch-boundary target."""
+        chain = self.chain
+        state = chain.head_state()
+        adv = state
+        if adv.slot < slot:
+            adv = state.copy()
+            st.process_slots(chain.spec, adv, slot)
+        epoch = st.compute_epoch_at_slot(chain.spec, slot)
+        boundary_slot = st.compute_start_slot_at_epoch(chain.spec, epoch)
+        if chain.head.slot > boundary_slot:
+            # spec get_block_root: the LATEST block at-or-before the
+            # boundary (state.block_roots carries the last root through
+            # skipped slots — a plain slot lookup would miss them)
+            target_root = st.get_block_root_at_slot(
+                chain.spec, adv, boundary_slot
+            )
+        else:
+            target_root = chain.head.root
+        return T.AttestationData.make(
+            slot=slot,
+            index=committee_index,
+            beacon_block_root=chain.head.root,
+            source=T.Checkpoint.make(
+                epoch=adv.current_justified_checkpoint.epoch,
+                root=bytes(adv.current_justified_checkpoint.root),
+            ),
+            target=T.Checkpoint.make(epoch=epoch, root=target_root),
+        )
+
+    def publish_attestation(self, attestation):
+        v = self.chain.verify_attestation_for_gossip(attestation)
+        self.chain.batch_verify_attestations([v])
+
+    def aggregate_for(self, data):
+        return self.chain.agg_pool.get_aggregate(data)
+
+    def publish_aggregate(self, signed_aggregate):
+        self.chain.verify_aggregate_for_gossip(signed_aggregate)
+
+    def is_aggregator(self, committee_len, proof):
+        return self.chain._is_aggregator(committee_len, proof)
+
+
+class ValidatorClient:
+    def __init__(
+        self,
+        spec: ChainSpec,
+        store: ValidatorStore,
+        bn: BeaconNodeApi,
+    ):
+        self.spec = spec
+        self.store = store
+        self.bn = bn
+        self.duties = DutiesService(
+            spec, store, lambda: bn.head_state()
+        )
+        self._polled_epochs: set[int] = set()
+        self.produced_blocks = 0
+        self.published_attestations = 0
+        self.published_aggregates = 0
+        self.slashing_vetoes = 0
+
+    # ------------------------------------------------------------ duties
+
+    def _ensure_duties(self, epoch: int) -> None:
+        """Poll this epoch (and the next, for lookahead) once each
+        (duties_service poll loop)."""
+        for e in (epoch, epoch + 1):
+            if e not in self._polled_epochs:
+                self.duties.poll_epoch(e, self.bn.is_aggregator)
+                self._polled_epochs.add(e)
+
+    # ------------------------------------------------------------ slot loop
+
+    def on_slot_start(self, slot: int) -> None:
+        """Block proposal (block_service)."""
+        epoch = st.compute_epoch_at_slot(self.spec, slot)
+        self._ensure_duties(epoch)
+        duty = self.duties.proposer_duty_at(slot)
+        if duty is None:
+            return
+        fork = self.bn.head_state().fork
+        reveal = self.store.sign_randao(duty.pubkey, epoch, fork)
+        block = self.bn.produce_block(slot, reveal)
+        try:
+            signed = self.store.sign_block(duty.pubkey, block, fork)
+        except SlashingProtectionError:
+            self.slashing_vetoes += 1
+            return
+        self.bn.publish_block(signed)
+        self.produced_blocks += 1
+
+    def on_slot_third(self, slot: int) -> None:
+        """Attestation production at slot+1/3 (attestation_service)."""
+        epoch = st.compute_epoch_at_slot(self.spec, slot)
+        self._ensure_duties(epoch)
+        fork = self.bn.head_state().fork
+        by_committee: dict[int, object] = {}
+        for duty in self.duties.attester_duties_at(slot):
+            data = by_committee.get(duty.committee_index)
+            if data is None:
+                data = self.bn.attestation_data(slot, duty.committee_index)
+                by_committee[duty.committee_index] = data
+            try:
+                sig = self.store.sign_attestation(duty.pubkey, data, fork)
+            except SlashingProtectionError:
+                self.slashing_vetoes += 1
+                continue
+            bits = [
+                i == duty.committee_position
+                for i in range(duty.committee_length)
+            ]
+            att = T.Attestation.make(
+                aggregation_bits=bits, data=data, signature=sig
+            )
+            try:
+                self.bn.publish_attestation(att)
+            except Exception:
+                # one rejected attestation (e.g. already covered by an
+                # observed aggregate) must not abort the slot's other
+                # duties
+                continue
+            self.published_attestations += 1
+
+    def on_slot_two_thirds(self, slot: int) -> None:
+        """Aggregate-and-proof publication for aggregator duties."""
+        fork = self.bn.head_state().fork
+        for duty in self.duties.attester_duties_at(slot):
+            if not duty.is_aggregator:
+                continue
+            data = self.bn.attestation_data(slot, duty.committee_index)
+            aggregate = self.bn.aggregate_for(data)
+            if aggregate is None:
+                continue
+            msg = T.AggregateAndProof.make(
+                aggregator_index=duty.validator_index,
+                aggregate=aggregate,
+                selection_proof=duty.selection_proof,
+            )
+            sig = self.store.sign_aggregate_and_proof(duty.pubkey, msg, fork)
+            signed = T.SignedAggregateAndProof.make(message=msg, signature=sig)
+            try:
+                self.bn.publish_aggregate(signed)
+                self.published_aggregates += 1
+            except Exception:
+                pass  # e.g. another aggregator already observed
+
+    def run_slot(self, slot: int) -> None:
+        """Drive all three phases for tests/simulators."""
+        self.on_slot_start(slot)
+        self.on_slot_third(slot)
+        self.on_slot_two_thirds(slot)

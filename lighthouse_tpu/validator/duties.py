@@ -1,0 +1,116 @@
+"""Duties service: who attests/proposes when, computed per epoch with
+selection proofs precomputed at poll time
+(validator_services/src/duties_service.rs:105-170,209).
+
+The beacon-node boundary is a `duty_state_provider() -> state` callable
+(direct chain access in-process; the typed HTTP client fills the same
+seam across processes), so the service logic is transport-agnostic.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from ..consensus import state_transition as st
+from ..consensus.spec import ChainSpec
+
+
+@dataclass
+class AttesterDuty:
+    pubkey: bytes
+    validator_index: int
+    slot: int
+    committee_index: int
+    committee_position: int
+    committee_length: int
+    selection_proof: Optional[bytes] = None  # set if duty-holder aggregates
+    is_aggregator: bool = False
+
+
+@dataclass
+class ProposerDuty:
+    pubkey: bytes
+    validator_index: int
+    slot: int
+
+
+class DutiesService:
+    def __init__(self, spec: ChainSpec, store, duty_state_provider):
+        self.spec = spec
+        self.store = store  # ValidatorStore
+        self._state_of = duty_state_provider
+        # epoch -> {slot -> [AttesterDuty]} / {slot -> ProposerDuty}
+        self._attesters: dict[int, dict] = {}
+        self._proposers: dict[int, dict] = {}
+
+    def poll_epoch(self, epoch: int, is_aggregator) -> None:
+        """Compute every managed validator's duties for `epoch`;
+        precompute selection proofs and the aggregator decision
+        (duties_service.rs:128-158). `is_aggregator(committee_len,
+        proof_bytes) -> bool` is the chain's modulo rule."""
+        state = self._state_of()
+        state = state.copy()
+        target_slot = st.compute_start_slot_at_epoch(self.spec, epoch)
+        if state.slot < target_slot:
+            st.process_slots(self.spec, state, target_slot)
+        managed_set = set(self.store.pubkeys())
+        managed = {
+            bytes(v.pubkey): i
+            for i, v in enumerate(state.validators)
+            if bytes(v.pubkey) in managed_set
+        }
+        att: dict[int, list] = {}
+        prop: dict[int, object] = {}
+        per_slot = st.get_committee_count_per_slot(self.spec, state, epoch)
+        for slot in range(
+            target_slot, target_slot + self.spec.preset.slots_per_epoch
+        ):
+            for cidx in range(per_slot):
+                committee = st.get_beacon_committee(self.spec, state, slot, cidx)
+                for pos, vidx in enumerate(committee):
+                    pk = bytes(state.validators[vidx].pubkey)
+                    if pk not in managed:
+                        continue
+                    duty = AttesterDuty(
+                        pubkey=pk,
+                        validator_index=vidx,
+                        slot=slot,
+                        committee_index=cidx,
+                        committee_position=pos,
+                        committee_length=len(committee),
+                    )
+                    duty.selection_proof = self.store.selection_proof(
+                        pk, slot, state.fork
+                    )
+                    duty.is_aggregator = is_aggregator(
+                        len(committee), duty.selection_proof
+                    )
+                    att.setdefault(slot, []).append(duty)
+        # proposers: advance a copy through the epoch's slots
+        walk = state
+        for slot in range(
+            target_slot, target_slot + self.spec.preset.slots_per_epoch
+        ):
+            if walk.slot < slot:
+                st.process_slots(self.spec, walk, slot)
+            vidx = st.get_beacon_proposer_index(self.spec, walk)
+            pk = bytes(walk.validators[vidx].pubkey)
+            if pk in managed:
+                prop[slot] = ProposerDuty(
+                    pubkey=pk, validator_index=vidx, slot=slot
+                )
+        self._attesters[epoch] = att
+        self._proposers[epoch] = prop
+        # retain a 2-epoch window
+        for cache in (self._attesters, self._proposers):
+            for e in [e for e in cache if e + 1 < epoch]:
+                del cache[e]
+
+    def attester_duties_at(self, slot: int) -> list:
+        epoch = st.compute_epoch_at_slot(self.spec, slot)
+        return self._attesters.get(epoch, {}).get(slot, [])
+
+    def proposer_duty_at(self, slot: int) -> Optional[ProposerDuty]:
+        epoch = st.compute_epoch_at_slot(self.spec, slot)
+        return self._proposers.get(epoch, {}).get(slot)

@@ -1,0 +1,51 @@
+"""Signing methods (signing_method/src/lib.rs:79-90 analog).
+
+`SigningMethod` is the seam between "what to sign" (a 32-byte signing
+root, domain already mixed in) and "how": a local BLS key, or a remote
+signer speaking the Web3Signer API. The store never touches raw secret
+keys directly — doppelganger and slashing-protection gates live above
+this seam, transport below it.
+"""
+
+from __future__ import annotations
+
+from ..crypto.bls.keys import SecretKey, Signature
+
+
+class SigningMethod:
+    def sign(self, signing_root: bytes) -> Signature:
+        raise NotImplementedError
+
+    def public_key_bytes(self) -> bytes:
+        raise NotImplementedError
+
+
+class LocalKeystoreSigner(SigningMethod):
+    """SigningMethod::LocalKeystore: in-process BLS sign."""
+
+    def __init__(self, secret_key: SecretKey):
+        self._sk = secret_key
+        self._pk = secret_key.public_key().to_bytes()
+
+    def sign(self, signing_root: bytes) -> Signature:
+        return self._sk.sign(signing_root)
+
+    def public_key_bytes(self) -> bytes:
+        return self._pk
+
+
+class Web3SignerMethod(SigningMethod):
+    """SigningMethod::Web3Signer: remote HTTP signer. The transport is a
+    callable (url, signing_root) -> signature bytes so the HTTP client
+    (and its tests) slot in without this module importing one."""
+
+    def __init__(self, public_key: bytes, url: str, post):
+        self._pk = bytes(public_key)
+        self.url = url
+        self._post = post
+
+    def sign(self, signing_root: bytes) -> Signature:
+        return Signature.from_bytes(self._post(self.url, signing_root))
+
+    def public_key_bytes(self) -> bytes:
+        return self._pk

@@ -1,0 +1,166 @@
+"""ValidatorStore: every signature flows through here, gated by the
+slashing-protection DB and the doppelganger state
+(validator_store/src/lib.rs:575 sign_block, :671 sign_attestation).
+
+The store holds SigningMethods keyed by pubkey; services ask it to sign
+typed objects (block, attestation, randao, selection proof, sync
+message) — never raw roots — so the watermarks are enforced at the only
+place a signature can be born.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..consensus import state_transition as st
+from ..consensus import types as T
+from ..consensus.domains import compute_signing_root, get_domain
+from ..consensus.signature_sets import _EpochSSZ, _Bytes32SSZ
+from ..consensus.spec import ChainSpec
+from .signing_method import SigningMethod
+from .slashing_protection import SlashingProtectionDB, SlashingProtectionError
+
+
+class DoppelgangerProtected(Exception):
+    """Signing refused: the validator has not cleared doppelganger
+    detection yet (doppelganger_service/src/lib.rs:1-16 role)."""
+
+
+class ValidatorStore:
+    def __init__(
+        self,
+        spec: ChainSpec,
+        genesis_validators_root: bytes,
+        slashing_db: SlashingProtectionDB = None,
+    ):
+        self.spec = spec
+        self.genesis_validators_root = bytes(genesis_validators_root)
+        self.slashing_db = slashing_db or SlashingProtectionDB()
+        self._signers: dict[bytes, SigningMethod] = {}
+        # pubkeys still under doppelganger observation (sign refused)
+        self._doppelganger_hold: set[bytes] = set()
+
+    # ------------------------------------------------------------ registry
+
+    def add_validator(self, method: SigningMethod, doppelganger_hold: bool = False):
+        pk = method.public_key_bytes()
+        self._signers[pk] = method
+        self.slashing_db.register_validator(pk)
+        if doppelganger_hold:
+            self._doppelganger_hold.add(pk)
+
+    def clear_doppelganger(self, pubkey: bytes) -> None:
+        self._doppelganger_hold.discard(bytes(pubkey))
+
+    def pubkeys(self) -> list:
+        return list(self._signers)
+
+    def _signer(self, pubkey: bytes) -> SigningMethod:
+        m = self._signers.get(bytes(pubkey))
+        if m is None:
+            raise KeyError("unknown validator")
+        if bytes(pubkey) in self._doppelganger_hold:
+            raise DoppelgangerProtected(bytes(pubkey).hex())
+        return m
+
+    # ------------------------------------------------------------ signing
+
+    def sign_block(self, pubkey: bytes, block, fork) -> T.SignedBeaconBlock:
+        """Slashing-gated block proposal signature (sign_block)."""
+        epoch = st.compute_epoch_at_slot(self.spec, block.slot)
+        domain = get_domain(
+            self.spec,
+            self.spec.domain_beacon_proposer,
+            epoch,
+            fork,
+            self.genesis_validators_root,
+        )
+        root = compute_signing_root(block, domain)
+        m = self._signer(pubkey)
+        self.slashing_db.check_and_insert_block_proposal(
+            bytes(pubkey), int(block.slot), root
+        )
+        return T.SignedBeaconBlock.make(
+            message=block, signature=m.sign(root).to_bytes()
+        )
+
+    def sign_attestation(self, pubkey: bytes, data, fork) -> bytes:
+        """Slashing-gated attestation signature (sign_attestation);
+        returns the signature bytes for the service to wrap in bits."""
+        domain = get_domain(
+            self.spec,
+            self.spec.domain_beacon_attester,
+            data.target.epoch,
+            fork,
+            self.genesis_validators_root,
+        )
+        root = compute_signing_root(data, domain)
+        m = self._signer(pubkey)
+        self.slashing_db.check_and_insert_attestation(
+            bytes(pubkey),
+            int(data.source.epoch),
+            int(data.target.epoch),
+            root,
+        )
+        return m.sign(root).to_bytes()
+
+    def sign_randao(self, pubkey: bytes, epoch: int, fork) -> bytes:
+        domain = get_domain(
+            self.spec,
+            self.spec.domain_randao,
+            epoch,
+            fork,
+            self.genesis_validators_root,
+        )
+        return (
+            self._signer(pubkey)
+            .sign(compute_signing_root(_EpochSSZ(epoch), domain))
+            .to_bytes()
+        )
+
+    def selection_proof(self, pubkey: bytes, slot: int, fork) -> bytes:
+        """Aggregation selection proof (precomputed by the duties
+        service, duties_service.rs:128-158)."""
+        epoch = st.compute_epoch_at_slot(self.spec, slot)
+        domain = get_domain(
+            self.spec,
+            self.spec.domain_selection_proof,
+            epoch,
+            fork,
+            self.genesis_validators_root,
+        )
+        return (
+            self._signer(pubkey)
+            .sign(compute_signing_root(_EpochSSZ(slot), domain))
+            .to_bytes()
+        )
+
+    def sign_aggregate_and_proof(self, pubkey: bytes, msg, fork) -> bytes:
+        epoch = st.compute_epoch_at_slot(self.spec, msg.aggregate.data.slot)
+        domain = get_domain(
+            self.spec,
+            self.spec.domain_aggregate_and_proof,
+            epoch,
+            fork,
+            self.genesis_validators_root,
+        )
+        return self._signer(pubkey).sign(compute_signing_root(msg, domain)).to_bytes()
+
+    def sign_sync_committee_message(
+        self, pubkey: bytes, slot: int, beacon_block_root: bytes, fork
+    ) -> bytes:
+        epoch = st.compute_epoch_at_slot(self.spec, slot)
+        domain = get_domain(
+            self.spec,
+            self.spec.domain_sync_committee,
+            epoch,
+            fork,
+            self.genesis_validators_root,
+        )
+        return (
+            self._signer(pubkey)
+            .sign(
+                compute_signing_root(_Bytes32SSZ(beacon_block_root), domain)
+            )
+            .to_bytes()
+        )

