@@ -1,0 +1,177 @@
+"""CLI entry point (`lighthouse` binary mux, lighthouse/src/main.rs:88).
+
+Subcommands:
+  bn       — run a beacon node (interop genesis or resume from datadir)
+  account  — wallet/keystore management (account_manager analog):
+             wallet-create, validator-derive, keystore-inspect
+  db       — store inspection (database_manager analog): summary
+
+(A standalone `vc` process arrives with the cross-process HTTP client;
+in-process validators run through lighthouse_tpu.validator today.)
+
+Run: python -m lighthouse_tpu.cli <subcommand> [flags]
+"""
+
+from __future__ import annotations
+
+import argparse
+import getpass
+import json
+import os
+import sys
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="lighthouse-tpu")
+    p.add_argument(
+        "--preset",
+        choices=["mainnet", "minimal"],
+        default="mainnet",
+        help="compile-time-style preset (eth_spec.rs presets)",
+    )
+    sub = p.add_subparsers(dest="command", required=True)
+
+    bn = sub.add_parser("bn", help="beacon node")
+    bn.add_argument("--datadir", default="./datadir")
+    bn.add_argument("--http-port", type=int, default=5052)
+    bn.add_argument("--interop-validators", type=int, default=0,
+                    help="fresh interop genesis with N deterministic keys")
+    bn.add_argument("--resume", action="store_true",
+                    help="resume the chain persisted in --datadir")
+    bn.add_argument("--bls-backend", choices=["cpu", "tpu", "fake"],
+                    default=None)
+
+    acct = sub.add_parser("account", help="wallet/keystore management")
+    acct_sub = acct.add_subparsers(dest="account_cmd", required=True)
+    wc = acct_sub.add_parser("wallet-create")
+    wc.add_argument("--name", default="wallet")
+    wc.add_argument("--out", required=True)
+    vd = acct_sub.add_parser("validator-derive")
+    vd.add_argument("--wallet", required=True)
+    vd.add_argument("--out-dir", required=True)
+    vd.add_argument("--count", type=int, default=1)
+    ki = acct_sub.add_parser("keystore-inspect")
+    ki.add_argument("keystore")
+
+    db = sub.add_parser("db", help="store inspection")
+    db.add_argument("--datadir", default="./datadir")
+
+    return p
+
+
+def _spec(args):
+    from .consensus.spec import mainnet_spec, minimal_spec
+
+    return mainnet_spec() if args.preset == "mainnet" else minimal_spec()
+
+
+def cmd_bn(args) -> int:
+    from .consensus import state_transition as st
+    from .crypto.bls.keys import SecretKey
+    from .node.client import ClientBuilder
+    from .node.store import HotColdDB, LogStore
+
+    spec = _spec(args)
+    os.makedirs(args.datadir, exist_ok=True)
+    store = HotColdDB(spec, LogStore(args.datadir))
+    builder = (
+        ClientBuilder(spec)
+        .store(store)
+        .http_api(args.http_port)
+        .bls_backend(args.bls_backend)
+    )
+    if args.resume:
+        builder.resume_from_store()
+    elif args.interop_validators > 0:
+        pubkeys = [
+            SecretKey.from_seed(i.to_bytes(4, "big")).public_key().to_bytes()
+            for i in range(args.interop_validators)
+        ]
+        builder.genesis_state(st.interop_genesis_state(spec, pubkeys))
+    else:
+        print("need --interop-validators N or --resume", file=sys.stderr)
+        return 2
+    client = builder.build()
+    print(
+        f"beacon node up: head slot {client.chain.head.slot}, "
+        f"http :{client.api_server.port if client.api_server else '-'}"
+    )
+    try:
+        client.run()
+    except KeyboardInterrupt:
+        client.shutdown()
+    return 0
+
+
+def cmd_account(args) -> int:
+    from .crypto.keystore import Wallet, Keystore
+
+    if args.account_cmd == "wallet-create":
+        password = getpass.getpass("wallet password: ")
+        seed = os.urandom(32)
+        wallet = Wallet.create(seed, password, name=args.name)
+        with open(args.out, "w") as f:
+            f.write(wallet.to_json())
+        print(f"wrote wallet {wallet.name} ({args.out})")
+        print("seed (back this up!):", seed.hex())
+        return 0
+    if args.account_cmd == "validator-derive":
+        with open(args.wallet) as f:
+            wallet = Wallet.from_json(f.read())
+        wpass = getpass.getpass("wallet password: ")
+        kpass = getpass.getpass("keystore password: ")
+        os.makedirs(args.out_dir, exist_ok=True)
+        for _ in range(args.count):
+            ks = wallet.next_validator(wpass, kpass)
+            out = os.path.join(args.out_dir, f"keystore-{ks.pubkey.hex()[:12]}.json")
+            with open(out, "w") as f:
+                f.write(ks.to_json())
+            print("wrote", out, "path", ks.path)
+        with open(args.wallet, "w") as f:
+            f.write(wallet.to_json())  # persist nextaccount
+        return 0
+    if args.account_cmd == "keystore-inspect":
+        with open(args.keystore) as f:
+            ks = Keystore.from_json(f.read())
+        print(json.dumps({"pubkey": "0x" + ks.pubkey.hex(), "path": ks.path,
+                          "uuid": ks.uuid}, indent=2))
+        return 0
+    return 2
+
+
+def cmd_db(args) -> int:
+    from .node.store import Column, HotColdDB, LogStore
+
+    spec = _spec(args)
+    db = HotColdDB(spec, LogStore(args.datadir))
+    db.load_split()
+    blocks = sum(1 for _ in db.kv.keys(Column.BLOCK))
+    states = sum(1 for _ in db.kv.keys(Column.STATE))
+    cold = sum(1 for _ in db.kv.keys(Column.COLD_STATE))
+    print(
+        json.dumps(
+            {
+                "split_slot": db.split_slot,
+                "hot_blocks": blocks,
+                "hot_states": states,
+                "restore_points": cold,
+            },
+            indent=2,
+        )
+    )
+    return 0
+
+
+def main(argv=None) -> int:
+    args = _build_parser().parse_args(argv)
+    if args.command == "bn":
+        return cmd_bn(args)
+    if args.command == "account":
+        return cmd_account(args)
+    if args.command == "db":
+        return cmd_db(args)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,25 @@
+"""Key management: EIP-2333 HD derivation, EIP-2335 keystores,
+EIP-2386 wallets (crypto/eth2_key_derivation, eth2_keystore,
+eth2_wallet analogs).
+"""
+
+from .key_derivation import (
+    derive_master_sk,
+    derive_child_sk,
+    derive_path,
+    validator_signing_path,
+    validator_withdrawal_path,
+)
+from .keystore import Keystore, KeystoreError
+from .wallet import Wallet
+
+__all__ = [
+    "derive_master_sk",
+    "derive_child_sk",
+    "derive_path",
+    "validator_signing_path",
+    "validator_withdrawal_path",
+    "Keystore",
+    "KeystoreError",
+    "Wallet",
+]

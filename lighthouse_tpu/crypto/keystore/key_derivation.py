@@ -1,0 +1,93 @@
+"""EIP-2333 BLS hierarchical key derivation + EIP-2334 paths
+(crypto/eth2_key_derivation/src/derived_key.rs analog).
+
+The tree: a master secret from a seed, children derived via Lamport
+hashes of the parent key — deterministic, no stored chain state.
+Anchored by the EIP-2333 published test case in tests/test_keystore.py.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+
+from ..bls.params import R
+
+_SALT0 = b"BLS-SIG-KEYGEN-SALT-"
+_L = 48  # ceil((3 * ceil(log2(r))) / 16)
+
+
+def _hkdf_extract(salt: bytes, ikm: bytes) -> bytes:
+    return hmac.new(salt, ikm, hashlib.sha256).digest()
+
+
+def _hkdf_expand(prk: bytes, info: bytes, length: int) -> bytes:
+    out, t, i = b"", b"", 1
+    while len(out) < length:
+        t = hmac.new(prk, t + info + bytes([i]), hashlib.sha256).digest()
+        out += t
+        i += 1
+    return out[:length]
+
+
+def hkdf_mod_r(ikm: bytes, key_info: bytes = b"") -> int:
+    """EIP-2333 hkdf_mod_r: loop re-salting until nonzero mod r."""
+    salt = _SALT0
+    while True:
+        salt = hashlib.sha256(salt).digest()
+        prk = _hkdf_extract(salt, ikm + b"\x00")
+        okm = _hkdf_expand(prk, key_info + _L.to_bytes(2, "big"), _L)
+        sk = int.from_bytes(okm, "big") % R
+        if sk != 0:
+            return sk
+
+
+def _ikm_to_lamport_sk(ikm: bytes, salt: bytes) -> list:
+    prk = _hkdf_extract(salt, ikm)
+    okm = _hkdf_expand(prk, b"", 255 * 32)
+    return [okm[i * 32 : (i + 1) * 32] for i in range(255)]
+
+
+def _parent_sk_to_lamport_pk(parent_sk: int, index: int) -> bytes:
+    salt = index.to_bytes(4, "big")
+    ikm = parent_sk.to_bytes(32, "big")
+    lamport_0 = _ikm_to_lamport_sk(ikm, salt)
+    not_ikm = bytes(b ^ 0xFF for b in ikm)
+    lamport_1 = _ikm_to_lamport_sk(not_ikm, salt)
+    lamport_pk = b"".join(
+        hashlib.sha256(chunk).digest() for chunk in lamport_0 + lamport_1
+    )
+    return hashlib.sha256(lamport_pk).digest()
+
+
+def derive_master_sk(seed: bytes) -> int:
+    if len(seed) < 32:
+        raise ValueError("seed must be at least 32 bytes")
+    return hkdf_mod_r(seed)
+
+
+def derive_child_sk(parent_sk: int, index: int) -> int:
+    return hkdf_mod_r(_parent_sk_to_lamport_pk(parent_sk, index))
+
+
+def derive_path(seed: bytes, path: str) -> int:
+    """EIP-2334 path derivation: 'm/12381/3600/i/0/0' etc."""
+    parts = path.split("/")
+    if parts[0] != "m":
+        raise ValueError("path must start at the master node 'm'")
+    sk = derive_master_sk(seed)
+    for p in parts[1:]:
+        if not p.isdigit():
+            raise ValueError(f"invalid path component {p!r} (no hardening marks in EIP-2334)")
+        sk = derive_child_sk(sk, int(p))
+    return sk
+
+
+def validator_signing_path(index: int) -> str:
+    """EIP-2334 g = m/12381/3600/<index>/0/0 (signing key)."""
+    return f"m/12381/3600/{index}/0/0"
+
+
+def validator_withdrawal_path(index: int) -> str:
+    """EIP-2334 m/12381/3600/<index>/0 (withdrawal key)."""
+    return f"m/12381/3600/{index}/0"

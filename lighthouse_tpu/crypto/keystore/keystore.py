@@ -1,0 +1,179 @@
+"""EIP-2335 BLS keystores (crypto/eth2_keystore/src/keystore.rs analog).
+
+JSON envelope holding an AES-128-CTR-encrypted secret key, a scrypt or
+pbkdf2 password KDF, and a sha256 checksum binding cipher message to
+decryption key. Passwords are NFKD-normalized with C0/C1 control
+characters stripped, per the EIP.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import unicodedata
+import uuid as uuid_mod
+
+from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+
+from ..bls.keys import SecretKey
+
+
+class KeystoreError(Exception):
+    pass
+
+
+def normalize_password(password: str) -> bytes:
+    norm = unicodedata.normalize("NFKD", password)
+    stripped = "".join(
+        c
+        for c in norm
+        if not (0x00 <= ord(c) <= 0x1F or 0x7F <= ord(c) <= 0x9F)
+    )
+    return stripped.encode("utf-8")
+
+
+def _kdf(password: bytes, params: dict) -> bytes:
+    fn = params["function"]
+    p = params["params"]
+    salt = bytes.fromhex(p["salt"])
+    if fn == "scrypt":
+        return hashlib.scrypt(
+            password,
+            salt=salt,
+            n=p["n"],
+            r=p["r"],
+            p=p["p"],
+            dklen=p["dklen"],
+            maxmem=128 * p["n"] * p["r"] * 2,
+        )
+    if fn == "pbkdf2":
+        if p.get("prf", "hmac-sha256") != "hmac-sha256":
+            raise KeystoreError("unsupported prf")
+        return hashlib.pbkdf2_hmac("sha256", password, salt, p["c"], p["dklen"])
+    raise KeystoreError(f"unsupported kdf {fn}")
+
+
+def _aes128ctr(key16: bytes, iv: bytes, data: bytes) -> bytes:
+    cipher = Cipher(algorithms.AES(key16), modes.CTR(iv))
+    enc = cipher.encryptor()
+    return enc.update(data) + enc.finalize()
+
+
+class Keystore:
+    """One encrypted validator key, JSON round-trippable."""
+
+    def __init__(self, obj: dict):
+        self.obj = obj
+
+    # ------------------------------------------------------------ create
+
+    @classmethod
+    def encrypt(
+        cls,
+        secret_key: SecretKey,
+        password: str,
+        path: str = "",
+        kdf: str = "scrypt",
+        description: str = "",
+        scrypt_n: int = 262144,
+    ) -> "Keystore":
+        secret = secret_key.scalar.to_bytes(32, "big")
+        pw = normalize_password(password)
+        salt = os.urandom(32)
+        iv = os.urandom(16)
+        if kdf == "scrypt":
+            kdf_module = {
+                "function": "scrypt",
+                "params": {
+                    "dklen": 32,
+                    "n": scrypt_n,
+                    "r": 8,
+                    "p": 1,
+                    "salt": salt.hex(),
+                },
+                "message": "",
+            }
+        elif kdf == "pbkdf2":
+            kdf_module = {
+                "function": "pbkdf2",
+                "params": {
+                    "dklen": 32,
+                    "c": 262144,
+                    "prf": "hmac-sha256",
+                    "salt": salt.hex(),
+                },
+                "message": "",
+            }
+        else:
+            raise KeystoreError(f"unsupported kdf {kdf}")
+        dk = _kdf(pw, kdf_module)
+        cipher_text = _aes128ctr(dk[:16], iv, secret)
+        checksum = hashlib.sha256(dk[16:32] + cipher_text).hexdigest()
+        obj = {
+            "crypto": {
+                "kdf": kdf_module,
+                "checksum": {
+                    "function": "sha256",
+                    "params": {},
+                    "message": checksum,
+                },
+                "cipher": {
+                    "function": "aes-128-ctr",
+                    "params": {"iv": iv.hex()},
+                    "message": cipher_text.hex(),
+                },
+            },
+            "description": description,
+            "pubkey": secret_key.public_key().to_bytes().hex(),
+            "path": path,
+            "uuid": str(uuid_mod.uuid4()),
+            "version": 4,
+        }
+        return cls(obj)
+
+    # ------------------------------------------------------------ open
+
+    def decrypt(self, password: str) -> SecretKey:
+        crypto = self.obj["crypto"]
+        if crypto["cipher"]["function"] != "aes-128-ctr":
+            raise KeystoreError("unsupported cipher")
+        if crypto["checksum"]["function"] != "sha256":
+            raise KeystoreError("unsupported checksum")
+        pw = normalize_password(password)
+        dk = _kdf(pw, crypto["kdf"])
+        cipher_text = bytes.fromhex(crypto["cipher"]["message"])
+        checksum = hashlib.sha256(dk[16:32] + cipher_text).hexdigest()
+        if checksum != crypto["checksum"]["message"]:
+            raise KeystoreError("invalid password (checksum mismatch)")
+        iv = bytes.fromhex(crypto["cipher"]["params"]["iv"])
+        secret = _aes128ctr(dk[:16], iv, cipher_text)
+        sk = SecretKey(int.from_bytes(secret, "big"))
+        if self.obj.get("pubkey"):
+            if sk.public_key().to_bytes().hex() != self.obj["pubkey"]:
+                raise KeystoreError("decrypted key does not match pubkey")
+        return sk
+
+    # ------------------------------------------------------------ io
+
+    @property
+    def pubkey(self) -> bytes:
+        return bytes.fromhex(self.obj["pubkey"])
+
+    @property
+    def uuid(self) -> str:
+        return self.obj["uuid"]
+
+    @property
+    def path(self) -> str:
+        return self.obj.get("path", "")
+
+    def to_json(self) -> str:
+        return json.dumps(self.obj)
+
+    @classmethod
+    def from_json(cls, raw: str) -> "Keystore":
+        obj = json.loads(raw)
+        if obj.get("version") != 4:
+            raise KeystoreError("only EIP-2335 version 4 supported")
+        return cls(obj)

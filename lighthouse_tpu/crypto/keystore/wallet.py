@@ -1,0 +1,103 @@
+"""EIP-2386 hierarchical-deterministic wallets
+(crypto/eth2_wallet analog).
+
+A wallet = an encrypted seed (reusing the EIP-2335 crypto envelope) plus
+a `nextaccount` counter; each account derives a validator signing key at
+the EIP-2334 path m/12381/3600/<i>/0/0 and wraps it in its own
+password-protected keystore.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import uuid as uuid_mod
+
+from ..bls.keys import SecretKey
+from . import key_derivation as kd
+from .keystore import Keystore, KeystoreError, _aes128ctr, _kdf, normalize_password
+import hashlib
+
+
+class Wallet:
+    def __init__(self, obj: dict):
+        self.obj = obj
+
+    @classmethod
+    def create(
+        cls, seed: bytes, password: str, name: str = "wallet", scrypt_n: int = 262144
+    ) -> "Wallet":
+        if len(seed) < 32:
+            raise KeystoreError("seed must be at least 32 bytes")
+        pw = normalize_password(password)
+        salt = os.urandom(32)
+        iv = os.urandom(16)
+        kdf_module = {
+            "function": "scrypt",
+            "params": {"dklen": 32, "n": scrypt_n, "r": 8, "p": 1, "salt": salt.hex()},
+            "message": "",
+        }
+        dk = _kdf(pw, kdf_module)
+        cipher_text = _aes128ctr(dk[:16], iv, seed)
+        checksum = hashlib.sha256(dk[16:32] + cipher_text).hexdigest()
+        obj = {
+            "crypto": {
+                "kdf": kdf_module,
+                "checksum": {"function": "sha256", "params": {}, "message": checksum},
+                "cipher": {
+                    "function": "aes-128-ctr",
+                    "params": {"iv": iv.hex()},
+                    "message": cipher_text.hex(),
+                },
+            },
+            "name": name,
+            "nextaccount": 0,
+            "type": "hierarchical deterministic",
+            "uuid": str(uuid_mod.uuid4()),
+            "version": 1,
+        }
+        return cls(obj)
+
+    def decrypt_seed(self, password: str) -> bytes:
+        crypto = self.obj["crypto"]
+        pw = normalize_password(password)
+        dk = _kdf(pw, crypto["kdf"])
+        cipher_text = bytes.fromhex(crypto["cipher"]["message"])
+        checksum = hashlib.sha256(dk[16:32] + cipher_text).hexdigest()
+        if checksum != crypto["checksum"]["message"]:
+            raise KeystoreError("invalid wallet password")
+        iv = bytes.fromhex(crypto["cipher"]["params"]["iv"])
+        return _aes128ctr(dk[:16], iv, cipher_text)
+
+    def next_validator(
+        self,
+        wallet_password: str,
+        keystore_password: str,
+        scrypt_n: int = 262144,
+    ) -> Keystore:
+        """Derive the next account's signing keystore and advance the
+        counter (eth2_wallet next_account)."""
+        seed = self.decrypt_seed(wallet_password)
+        index = self.obj["nextaccount"]
+        path = kd.validator_signing_path(index)
+        sk = SecretKey(kd.derive_path(seed, path))
+        store = Keystore.encrypt(
+            sk, keystore_password, path=path, scrypt_n=scrypt_n
+        )
+        self.obj["nextaccount"] = index + 1
+        return store
+
+    @property
+    def name(self) -> str:
+        return self.obj["name"]
+
+    @property
+    def nextaccount(self) -> int:
+        return self.obj["nextaccount"]
+
+    def to_json(self) -> str:
+        return json.dumps(self.obj)
+
+    @classmethod
+    def from_json(cls, raw: str) -> "Wallet":
+        return cls(json.loads(raw))

@@ -85,11 +85,16 @@ class BeaconChain:
         store: HotColdDB = None,
         bls_backend: Optional[str] = None,
         kzg=None,
+        slasher=None,
     ):
         self.spec = spec
         self.store = store or HotColdDB(spec)
         self.bls_backend = bls_backend
         self._lock = threading.RLock()
+        # optional slasher service (slasher/service role: observes
+        # verified gossip attestations + imported block headers,
+        # import_block_update_slasher beacon_chain.rs:4306)
+        self.slasher = slasher
         # Deneb data availability: sidecars buffer here until the block's
         # commitment list is satisfied. kzg=None runs blob-free (blocks
         # with commitments are then rejected rather than unverified).
@@ -253,6 +258,7 @@ class BeaconChain:
         self._observed_aggregators = set()
         self.agg_pool = NaiveAggregationPool()
         self.op_pool = OperationPool(spec)
+        self.slasher = None
         self.m_blocks = metrics.counter("beacon_chain_blocks_imported_total")
         self.m_atts = metrics.counter(
             "beacon_chain_attestations_verified_total"
@@ -573,8 +579,61 @@ class BeaconChain:
             )
         except ForkChoiceError as e:
             raise BlockError(str(e)) from None
+        if self.slasher is not None:
+            self.slasher.queue_block_header(
+                T.SignedBeaconBlockHeader.make(
+                    message=T.BeaconBlockHeader.make(
+                        slot=block.slot,
+                        proposer_index=block.proposer_index,
+                        parent_root=bytes(block.parent_root),
+                        state_root=bytes(block.state_root),
+                        body_root=block.body.hash_tree_root(),
+                    ),
+                    signature=bytes(signed_block.signature),
+                )
+            )
+            for att in block.body.attestations:
+                try:
+                    adv = state
+                    committee = st.get_beacon_committee(
+                        self.spec, adv, att.data.slot, att.data.index
+                    )
+                    indices = [
+                        c
+                        for c, b in zip(committee, att.aggregation_bits)
+                        if b
+                    ]
+                    self.slasher.queue_attestation(
+                        T.IndexedAttestation.make(
+                            # spec ordering: a materialized slashing must
+                            # pass the sorted-indices validity check
+                            attesting_indices=sorted(indices),
+                            data=att.data,
+                            signature=bytes(att.signature),
+                        )
+                    )
+                except Exception:
+                    pass  # slasher feed is best-effort observability
         self.m_blocks.inc()
         self.recompute_head()
+
+    def poll_slasher(self) -> int:
+        """Run queued slasher detection; verified slashings enter the op
+        pool + fork choice (slasher/service -> broadcast path). Returns
+        the number of new attester slashings."""
+        if self.slasher is None:
+            return 0
+        att_slashings, prop_slashings = self.slasher.process_queued()
+        with self._lock:
+            for s in att_slashings:
+                self.op_pool.insert_attester_slashing(s)
+                both = set(s.attestation_1.attesting_indices) & set(
+                    s.attestation_2.attesting_indices
+                )
+                self.fork_choice.on_attester_slashing(both)
+            for s in prop_slashings:
+                self.op_pool.insert_proposer_slashing(s)
+        return len(att_slashings)
 
     def recompute_head(self) -> bytes:
         """canonical_head.rs:474 recompute_head_at_current_slot."""
@@ -693,6 +752,14 @@ class BeaconChain:
                     self.op_pool.insert_attestation(
                         v.attestation, v.indexed_indices
                     )
+                if self.slasher is not None:
+                    self.slasher.queue_attestation(
+                        T.IndexedAttestation.make(
+                            attesting_indices=sorted(v.indexed_indices),
+                            data=v.attestation.data,
+                            signature=bytes(v.attestation.signature),
+                        )
+                    )
         self.m_atts.inc(len(good))
         return good
 
@@ -779,6 +846,16 @@ class BeaconChain:
                 self._observed_attesters.add((index, epoch))
             self.apply_attestation_to_fork_choice(v)
             self.op_pool.insert_attestation(aggregate, indices)
+            if self.slasher is not None:
+                # most validators' votes arrive only inside aggregates —
+                # detection coverage must not depend on the arrival path
+                self.slasher.queue_attestation(
+                    T.IndexedAttestation.make(
+                        attesting_indices=sorted(indices),
+                        data=data,
+                        signature=bytes(aggregate.signature),
+                    )
+                )
             self.m_atts.inc()
             return v
 

@@ -1,0 +1,244 @@
+"""Client assembly (beacon_node/client/src/builder.rs:74 analog) + the
+per-slot timer (beacon_node/timer).
+
+`ClientBuilder` wires genesis-or-resume chain, scheduler, network stack,
+sync, and the REST/metrics server into a `Client`; `Client.tick()` is
+one scheduler/network pump and `SlotTimer` drives slot transitions
+(on_slot -> queued fork-choice attestations -> finality migration +
+persistence at epoch boundaries)."""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from ..common.slot_clock import SlotClock
+from ..consensus import state_transition as st
+from ..consensus.spec import ChainSpec
+from .beacon_chain import BeaconChain
+from .beacon_processor import BeaconProcessor
+from .http_api import ApiServer, BeaconApi
+from .store import HotColdDB
+
+
+class SlotTimer:
+    """Wall-clock slot driver (timer/src/lib.rs role). `poll()` fires
+    missed slot transitions; call it from any loop (or let `Client.run`
+    do it)."""
+
+    def __init__(self, chain: BeaconChain, clock: SlotClock):
+        self.chain = chain
+        self.clock = clock
+        self._last_slot = chain.current_slot
+
+    def poll(self) -> int:
+        """Advance to the clock's slot; returns slots fired."""
+        now = self.clock.current_slot()
+        fired = 0
+        while self._last_slot < now:
+            self._last_slot += 1
+            self.on_slot(self._last_slot)
+            fired += 1
+        return fired
+
+    def on_slot(self, slot: int) -> None:
+        chain = self.chain
+        chain.on_slot(slot)
+        # release queued fork-choice votes, recompute the head
+        chain.recompute_head()
+        # run queued slashing detection each slot
+        chain.poll_slasher()
+        # epoch boundary: migrate finalized history + snapshot
+        if slot % chain.spec.preset.slots_per_epoch == 0:
+            chain.migrate_finalized()
+            if chain.slasher is not None:
+                chain.slasher.prune(
+                    slot // chain.spec.preset.slots_per_epoch
+                )
+
+
+class Client:
+    def __init__(
+        self,
+        chain: BeaconChain,
+        processor: BeaconProcessor,
+        timer: SlotTimer,
+        service=None,
+        nbp=None,
+        sync=None,
+        api_server: Optional[ApiServer] = None,
+    ):
+        self.chain = chain
+        self.processor = processor
+        self.timer = timer
+        self.service = service
+        self.nbp = nbp
+        self.sync = sync
+        self.api_server = api_server
+        self._stop = threading.Event()
+
+    def tick(self) -> int:
+        """One pump: timer, network events -> work, scheduler steps,
+        sync progress. Returns units of work done."""
+        n = self.timer.poll()
+        if self.service is not None and self.nbp is not None:
+            for ev in self.service.poll():
+                self.nbp.handle_gossip(ev.peer_id, ev.topic, ev.data)
+                n += 1
+        while self.processor.step():
+            n += 1
+        if self.sync is not None:
+            self.sync.tick()
+        return n
+
+    def run(self, poll_interval: float = 0.05) -> None:
+        """Blocking loop for the CLI (`lighthouse bn` run role)."""
+        if self.api_server is not None:
+            self.api_server.start()
+        try:
+            while not self._stop.is_set():
+                if self.tick() == 0:
+                    time.sleep(poll_interval)
+        finally:
+            if self.api_server is not None:
+                self.api_server.stop()
+            self.chain.persist()
+
+    def shutdown(self) -> None:
+        self._stop.set()
+
+
+class ClientBuilder:
+    """builder.rs:74: accumulate parts, then `build()`."""
+
+    def __init__(self, spec: ChainSpec):
+        self.spec = spec
+        self._store: Optional[HotColdDB] = None
+        self._genesis_state = None
+        self._resume = False
+        self._bls_backend: Optional[str] = None
+        self._kzg = None
+        self._hub = None
+        self._peer_id = "node"
+        self._api_port: Optional[int] = None
+        self._clock: Optional[SlotClock] = None
+        self._slasher = False
+
+    def store(self, store: HotColdDB) -> "ClientBuilder":
+        self._store = store
+        return self
+
+    def genesis_state(self, state) -> "ClientBuilder":
+        self._genesis_state = state
+        return self
+
+    def resume_from_store(self) -> "ClientBuilder":
+        """ClientGenesis::Resume: rebuild the chain from a persisted
+        store (client/src/config.rs:22-41)."""
+        self._resume = True
+        return self
+
+    def bls_backend(self, name: str) -> "ClientBuilder":
+        self._bls_backend = name
+        return self
+
+    def slasher(self, enabled: bool = True) -> "ClientBuilder":
+        """Attach a slasher service (slasher/service role: the chain
+        feeds it verified gossip + imported blocks, the timer polls and
+        prunes it)."""
+        self._slasher = enabled
+        return self
+
+    def kzg(self, kzg) -> "ClientBuilder":
+        self._kzg = kzg
+        return self
+
+    def network(self, hub, peer_id: str) -> "ClientBuilder":
+        self._hub = hub
+        self._peer_id = peer_id
+        return self
+
+    def http_api(self, port: int = 0) -> "ClientBuilder":
+        self._api_port = port
+        return self
+
+    def slot_clock(self, clock: SlotClock) -> "ClientBuilder":
+        self._clock = clock
+        return self
+
+    def build(self) -> Client:
+        store = self._store or HotColdDB(self.spec)
+        slasher = None
+        if self._slasher:
+            from ..slasher import Slasher, SlasherConfig
+
+            slasher = Slasher(
+                SlasherConfig(slots_per_epoch=self.spec.preset.slots_per_epoch)
+            )
+        if self._resume:
+            chain = BeaconChain.resume(
+                self.spec, store, bls_backend=self._bls_backend, kzg=self._kzg
+            )
+            chain.slasher = slasher
+        else:
+            if self._genesis_state is None:
+                raise ValueError("need genesis_state(...) or resume_from_store()")
+            chain = BeaconChain(
+                self.spec,
+                self._genesis_state,
+                store=store,
+                bls_backend=self._bls_backend,
+                kzg=self._kzg,
+                slasher=slasher,
+            )
+        processor = BeaconProcessor()
+        service = nbp = sync = None
+        if self._hub is not None:
+            from ..network import (
+                NetworkBeaconProcessor,
+                NetworkService,
+                SyncManager,
+            )
+            from ..network.gossip import (
+                TOPIC_AGGREGATE,
+                TOPIC_ATTESTATION_SUBNET,
+                TOPIC_BLOCK,
+                topic_for,
+            )
+            from ..consensus.domains import compute_fork_digest
+
+            digest = compute_fork_digest(
+                self.spec.genesis_fork_version, chain.genesis_validators_root
+            )
+            service = NetworkService(self._hub, self._peer_id)
+            service.subscribe(topic_for(TOPIC_BLOCK, digest))
+            service.subscribe(topic_for(TOPIC_AGGREGATE, digest))
+            for subnet in range(2):  # default subnet subscriptions
+                service.subscribe(
+                    topic_for(TOPIC_ATTESTATION_SUBNET, digest, subnet)
+                )
+            nbp = NetworkBeaconProcessor(
+                chain, processor, service, fork_digest=digest
+            )
+            sync = SyncManager(chain, processor, service, nbp)
+        head_state = chain.head_state()
+        clock = self._clock or SlotClock(
+            genesis_time=head_state.genesis_time if head_state is not None else 0,
+            seconds_per_slot=self.spec.seconds_per_slot,
+        )
+        timer = SlotTimer(chain, clock)
+        api_server = None
+        if self._api_port is not None:
+            api_server = ApiServer(
+                BeaconApi(chain, sync), port=self._api_port
+            )
+        return Client(
+            chain,
+            processor,
+            timer,
+            service=service,
+            nbp=nbp,
+            sync=sync,
+            api_server=api_server,
+        )

@@ -1,0 +1,316 @@
+"""Beacon REST API + metrics scrape endpoint
+(beacon_node/http_api/src/lib.rs:101 + http_metrics analogs).
+
+The Eth beacon-API subset that the VC, sync tooling, and operators
+actually hit, served by a stdlib ThreadingHTTPServer (no framework —
+handlers are plain callables on the chain, so a C++ server can take the
+same routing table). JSON bodies follow the beacon-API envelope
+{"data": ...}; SSZ available via Accept: application/octet-stream on
+block/state gets.
+
+Routes:
+  GET  /eth/v1/node/health | version | syncing
+  GET  /eth/v1/beacon/genesis
+  GET  /eth/v1/beacon/headers/{head|root}
+  GET  /eth/v1/beacon/blocks/{head|root|slot}        (json summary | ssz)
+  GET  /eth/v1/beacon/states/{head}/finality_checkpoints
+  GET  /eth/v1/beacon/states/{head}/validators/{index}
+  GET  /eth/v1/validator/duties/proposer/{epoch}
+  POST /eth/v1/beacon/pool/attestations
+  POST /eth/v1/beacon/blocks
+  GET  /metrics                                       (prometheus text)
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from ..common import metrics
+from ..consensus import state_transition as st
+from ..consensus import types as T
+
+VERSION = "lighthouse-tpu/0.2.0"
+
+
+class ApiError(Exception):
+    def __init__(self, code: int, message: str):
+        super().__init__(message)
+        self.code = code
+
+
+class BeaconApi:
+    """Route logic, framework-free (unit-testable without sockets)."""
+
+    def __init__(self, chain, sync=None):
+        self.chain = chain
+        self.sync = sync
+
+    # ------------------------------------------------------------ gets
+
+    def node_health(self):
+        return 200, {}
+
+    def node_version(self):
+        return 200, {"data": {"version": VERSION}}
+
+    def node_syncing(self):
+        head = self.chain.head.slot
+        target = self.sync.target_slot() if self.sync else head
+        return 200, {
+            "data": {
+                "head_slot": str(head),
+                "sync_distance": str(max(0, target - head)),
+                "is_syncing": target > head,
+            }
+        }
+
+    def genesis(self):
+        return 200, {
+            "data": {
+                "genesis_time": str(self.chain.head_state().genesis_time),
+                "genesis_validators_root": "0x"
+                + self.chain.genesis_validators_root.hex(),
+            }
+        }
+
+    def _resolve_block_root(self, block_id: str) -> bytes:
+        if block_id == "head":
+            return self.chain.head.root
+        if block_id == "genesis":
+            return self.chain.genesis_root
+        if block_id.startswith("0x"):
+            return bytes.fromhex(block_id[2:])
+        if block_id.isdigit():
+            root = self.chain.block_root_at_slot(int(block_id))
+            if root is None:
+                raise ApiError(404, f"no canonical block at slot {block_id}")
+            return root
+        raise ApiError(400, f"invalid block id {block_id!r}")
+
+    def header(self, block_id: str):
+        root = self._resolve_block_root(block_id)
+        block = self.chain.store.get_block(root)
+        if block is None:
+            raise ApiError(404, "block not found")
+        msg = block.message
+        return 200, {
+            "data": {
+                "root": "0x" + root.hex(),
+                "header": {
+                    "message": {
+                        "slot": str(msg.slot),
+                        "proposer_index": str(msg.proposer_index),
+                        "parent_root": "0x" + bytes(msg.parent_root).hex(),
+                        "state_root": "0x" + bytes(msg.state_root).hex(),
+                        "body_root": "0x" + msg.body.hash_tree_root().hex(),
+                    }
+                },
+            }
+        }
+
+    def block_ssz(self, block_id: str) -> bytes:
+        root = self._resolve_block_root(block_id)
+        block = self.chain.store.get_block(root)
+        if block is None:
+            raise ApiError(404, "block not found")
+        return T.SignedBeaconBlock.serialize(block)
+
+    def finality_checkpoints(self, state_id: str):
+        if state_id != "head":
+            raise ApiError(400, "only state id 'head' is served")
+        state = self.chain.head_state()
+        fc = self.chain.fork_choice
+
+        def cp(epoch, root):
+            return {"epoch": str(epoch), "root": "0x" + bytes(root).hex()}
+
+        return 200, {
+            "data": {
+                "previous_justified": cp(
+                    state.previous_justified_checkpoint.epoch,
+                    state.previous_justified_checkpoint.root,
+                ),
+                "current_justified": cp(*fc.justified_checkpoint),
+                "finalized": cp(*fc.finalized_checkpoint),
+            }
+        }
+
+    def validator(self, state_id: str, index: str):
+        if state_id != "head":
+            raise ApiError(400, "only state id 'head' is served")
+        state = self.chain.head_state()
+        i = int(index)
+        if i >= len(state.validators):
+            raise ApiError(404, "unknown validator")
+        v = state.validators[i]
+        return 200, {
+            "data": {
+                "index": str(i),
+                "balance": str(state.balances[i]),
+                "validator": {
+                    "pubkey": "0x" + bytes(v.pubkey).hex(),
+                    "effective_balance": str(v.effective_balance),
+                    "slashed": bool(v.slashed),
+                    "activation_epoch": str(v.activation_epoch),
+                    "exit_epoch": str(v.exit_epoch),
+                },
+            }
+        }
+
+    def proposer_duties(self, epoch: str):
+        e = int(epoch)
+        # beacon-API rule: only current/next epoch — also caps the
+        # process_slots replay a request can demand of a handler thread
+        cur = st.compute_epoch_at_slot(self.chain.spec, self.chain.current_slot)
+        if e > cur + 1:
+            raise ApiError(400, f"epoch {e} beyond next epoch {cur + 1}")
+        state = self.chain.head_state().copy()
+        start = st.compute_start_slot_at_epoch(self.chain.spec, e)
+        if state.slot < start:
+            st.process_slots(self.chain.spec, state, start)
+        duties = []
+        for slot in range(start, start + self.chain.spec.preset.slots_per_epoch):
+            if state.slot < slot:
+                st.process_slots(self.chain.spec, state, slot)
+            vidx = st.get_beacon_proposer_index(self.chain.spec, state)
+            duties.append(
+                {
+                    "pubkey": "0x"
+                    + bytes(state.validators[vidx].pubkey).hex(),
+                    "validator_index": str(vidx),
+                    "slot": str(slot),
+                }
+            )
+        return 200, {"data": duties}
+
+    # ------------------------------------------------------------ posts
+
+    def publish_attestation(self, body: bytes):
+        att = T.Attestation.deserialize(body)
+        v = self.chain.verify_attestation_for_gossip(att)
+        self.chain.batch_verify_attestations([v])
+        return 200, {}
+
+    def publish_block(self, body: bytes):
+        signed = T.SignedBeaconBlock.deserialize(body)
+        self.chain.process_block(signed)
+        return 200, {}
+
+
+# ---------------------------------------------------------------- server
+
+_ROUTES = [
+    ("GET", re.compile(r"^/eth/v1/node/health$"), "node_health"),
+    ("GET", re.compile(r"^/eth/v1/node/version$"), "node_version"),
+    ("GET", re.compile(r"^/eth/v1/node/syncing$"), "node_syncing"),
+    ("GET", re.compile(r"^/eth/v1/beacon/genesis$"), "genesis"),
+    ("GET", re.compile(r"^/eth/v1/beacon/headers/([^/]+)$"), "header"),
+    ("GET", re.compile(r"^/eth/v1/beacon/blocks/([^/]+)$"), "block"),
+    (
+        "GET",
+        re.compile(r"^/eth/v1/beacon/states/([^/]+)/finality_checkpoints$"),
+        "finality_checkpoints",
+    ),
+    (
+        "GET",
+        re.compile(r"^/eth/v1/beacon/states/([^/]+)/validators/([^/]+)$"),
+        "validator",
+    ),
+    (
+        "GET",
+        re.compile(r"^/eth/v1/validator/duties/proposer/([^/]+)$"),
+        "proposer_duties",
+    ),
+    ("POST", re.compile(r"^/eth/v1/beacon/pool/attestations$"), "publish_attestation"),
+    ("POST", re.compile(r"^/eth/v1/beacon/blocks$"), "publish_block"),
+]
+
+
+def make_handler(api: BeaconApi):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *args):  # quiet
+            pass
+
+        def _send_json(self, code: int, obj) -> None:
+            raw = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(raw)))
+            self.end_headers()
+            self.wfile.write(raw)
+
+        def _dispatch(self, method: str, body: Optional[bytes]) -> None:
+            if method == "GET" and self.path == "/metrics":
+                raw = metrics.gather().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(raw)))
+                self.end_headers()
+                self.wfile.write(raw)
+                return
+            for m, pat, name in _ROUTES:
+                if m != method:
+                    continue
+                match = pat.match(self.path.split("?")[0])
+                if not match:
+                    continue
+                try:
+                    if name == "block":
+                        if "application/octet-stream" in self.headers.get(
+                            "Accept", ""
+                        ):
+                            raw = api.block_ssz(*match.groups())
+                            self.send_response(200)
+                            self.send_header(
+                                "Content-Type", "application/octet-stream"
+                            )
+                            self.send_header("Content-Length", str(len(raw)))
+                            self.end_headers()
+                            self.wfile.write(raw)
+                            return
+                        code, obj = api.header(*match.groups())
+                    elif method == "POST":
+                        code, obj = getattr(api, name)(body)
+                    else:
+                        code, obj = getattr(api, name)(*match.groups())
+                    self._send_json(code, obj)
+                except ApiError as e:
+                    self._send_json(
+                        e.code, {"code": e.code, "message": str(e)}
+                    )
+                except Exception as e:
+                    self._send_json(400, {"code": 400, "message": str(e)})
+                return
+            self._send_json(404, {"code": 404, "message": "unknown route"})
+
+        def do_GET(self):
+            self._dispatch("GET", None)
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", "0"))
+            self._dispatch("POST", self.rfile.read(n))
+
+    return Handler
+
+
+class ApiServer:
+    """http_api::serve + http_metrics in one listener."""
+
+    def __init__(self, api: BeaconApi, host: str = "127.0.0.1", port: int = 0):
+        self.httpd = ThreadingHTTPServer((host, port), make_handler(api))
+        self.port = self.httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()

@@ -1,0 +1,6 @@
+"""Slasher (slasher/ crate analog): double-vote, surround-vote, and
+double-proposal detection over batched ingest."""
+
+from .slasher import Slasher, SlasherConfig
+
+__all__ = ["Slasher", "SlasherConfig"]

@@ -1,0 +1,229 @@
+"""Slashing detection (slasher/src/array.rs, attestation_queue.rs,
+block_queue.rs analogs).
+
+Surround detection uses the reference's min/max-target arrays, held as
+numpy vectors per validator so both the membership UPDATE and the
+surround CHECK are O(window) vectorized ops instead of per-epoch loops
+(array.rs chunked min/max targets, built for exactly this access
+pattern — and the same layout a device kernel would batch over
+validators):
+
+  min_target[e] = min target among v's attestations with source > e
+      new (s, t) SURROUNDS an old vote   iff min_target[s] < t
+  max_target[e] = max target among v's attestations with source < e
+      new (s, t) IS SURROUNDED by an old iff max_target[s] > t
+
+Ingest is queue-then-batch like the reference: `queue_attestation` /
+`queue_block_header` buffer, `process_queued` runs detection for the
+whole batch (slasher/service ties this to block import,
+beacon_chain.rs:4306).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from ..consensus import types as T
+
+_NO_MIN = np.iinfo(np.int64).max  # sentinel: no attestation recorded
+_NO_MAX = -1
+
+
+@dataclass
+class SlasherConfig:
+    history_length: int = 4096  # epochs of surround history (config.rs)
+    slots_per_epoch: int = 32  # preset-dependent (minimal uses 8)
+    max_db_attestations: int = 1 << 20
+
+
+@dataclass
+class _ValidatorHistory:
+    min_targets: np.ndarray
+    max_targets: np.ndarray
+    # the absolute epoch arrays[0] represents: the window SLIDES as the
+    # chain advances (no wraparound blind spot past history_length)
+    offset: int = 0
+    # target_epoch -> (data_root, attestation) for double votes +
+    # materializing slashings
+    by_target: dict = field(default_factory=dict)
+    # (source, target) list for locating the surround counterparty
+    votes: list = field(default_factory=list)
+
+
+class Slasher:
+    def __init__(self, config: SlasherConfig = None):
+        self.config = config or SlasherConfig()
+        self._validators: dict[int, _ValidatorHistory] = {}
+        # (proposer, slot) -> (header_root, signed_header)
+        self._proposals: dict[tuple, tuple] = {}
+        self._att_queue: list = []
+        self._block_queue: list = []
+        # detected slashings, deduped by content root
+        self.attester_slashings: dict[bytes, object] = {}
+        self.proposer_slashings: dict[bytes, object] = {}
+
+    # ------------------------------------------------------------ ingest
+
+    def queue_attestation(self, indexed_att) -> None:
+        """Batch ingest buffer (attestation_queue.rs)."""
+        self._att_queue.append(indexed_att)
+
+    def queue_block_header(self, signed_header) -> None:
+        self._block_queue.append(signed_header)
+
+    def process_queued(self) -> tuple:
+        """Drain the queues; returns (new_attester_slashings,
+        new_proposer_slashings) found in this batch."""
+        new_att, new_prop = [], []
+        atts, self._att_queue = self._att_queue, []
+        blocks, self._block_queue = self._block_queue, []
+        for ia in atts:
+            new_att.extend(self._process_attestation(ia))
+        for sh in blocks:
+            s = self._process_block_header(sh)
+            if s is not None:
+                new_prop.append(s)
+        return new_att, new_prop
+
+    # ------------------------------------------------------------ blocks
+
+    def _process_block_header(self, signed_header):
+        h = signed_header.message
+        key = (int(h.proposer_index), int(h.slot))
+        root = h.hash_tree_root()
+        prev = self._proposals.get(key)
+        if prev is None:
+            self._proposals[key] = (root, signed_header)
+            return None
+        prev_root, prev_signed = prev
+        if prev_root == root:
+            return None
+        slashing = T.ProposerSlashing.make(
+            signed_header_1=prev_signed, signed_header_2=signed_header
+        )
+        sroot = T.ProposerSlashing.hash_tree_root(slashing)
+        if sroot in self.proposer_slashings:
+            return None
+        self.proposer_slashings[sroot] = slashing
+        return slashing
+
+    # ------------------------------------------------------------ votes
+
+    def _history(self, v: int) -> _ValidatorHistory:
+        hist = self._validators.get(v)
+        if hist is None:
+            w = self.config.history_length
+            hist = self._validators[v] = _ValidatorHistory(
+                min_targets=np.full(w, _NO_MIN, dtype=np.int64),
+                max_targets=np.full(w, _NO_MAX, dtype=np.int64),
+            )
+        return hist
+
+    def _slide_window(self, hist: _ValidatorHistory, epoch: int) -> None:
+        """Keep `epoch` addressable: slide the window forward, dropping
+        the oldest entries (sliding-base equivalent of the reference's
+        chunk pruning — no absolute-epoch blind spot past the window)."""
+        w = self.config.history_length
+        if epoch < hist.offset + w:
+            return
+        shift = epoch - (hist.offset + w) + 1
+        if shift >= w:
+            hist.min_targets.fill(_NO_MIN)
+            hist.max_targets.fill(_NO_MAX)
+        else:
+            hist.min_targets[:-shift] = hist.min_targets[shift:]
+            hist.min_targets[-shift:] = _NO_MIN
+            hist.max_targets[:-shift] = hist.max_targets[shift:]
+            hist.max_targets[-shift:] = _NO_MAX
+        hist.offset += shift
+
+    def _process_attestation(self, indexed_att) -> list:
+        data = indexed_att.data
+        source = int(data.source.epoch)
+        target = int(data.target.epoch)
+        root = T.AttestationData.hash_tree_root(data)
+        w = self.config.history_length
+        found = []
+        for v in indexed_att.attesting_indices:
+            v = int(v)
+            hist = self._history(v)
+            self._slide_window(hist, max(source, target))
+            # 1. double vote: same target, different data
+            prev = hist.by_target.get(target)
+            if prev is not None and prev[0] != root:
+                found.append(self._emit_double(v, prev[1], indexed_att))
+            # 2. surround checks via the arrays (both directions);
+            # sources older than the window have no surround history
+            idx = source - hist.offset
+            if 0 <= idx < w:
+                if hist.min_targets[idx] < target:
+                    other = self._find_vote(hist, lambda s, t: s > source and t < target)
+                    if other is not None:
+                        found.append(
+                            self._emit_surround(v, indexed_att, other)
+                        )
+                if hist.max_targets[idx] > target:
+                    other = self._find_vote(hist, lambda s, t: s < source and t > target)
+                    if other is not None:
+                        found.append(
+                            self._emit_surround(v, other, indexed_att)
+                        )
+            # 3. record the vote (vectorized slice updates in window
+            # coordinates: min over epochs < source, max over > source)
+            if prev is None:
+                hist.by_target[target] = (root, indexed_att)
+                hist.votes.append((source, target))
+                lo_end = max(0, min(idx, w))
+                if lo_end > 0:
+                    lo = hist.min_targets[:lo_end]
+                    np.minimum(lo, target, out=lo)
+                hi_start = max(0, idx + 1)
+                if hi_start < w:
+                    hi = hist.max_targets[hi_start:]
+                    np.maximum(hi, target, out=hi)
+        return [s for s in found if s is not None]
+
+    def _find_vote(self, hist: _ValidatorHistory, pred):
+        for s, t in hist.votes:
+            if pred(s, t):
+                entry = hist.by_target.get(t)
+                if entry is not None:
+                    return entry[1]
+        return None
+
+    def _emit_double(self, v: int, att_1, att_2):
+        return self._emit(att_1, att_2)
+
+    def _emit_surround(self, v: int, surrounder, surrounded):
+        """attestation_1 surrounds attestation_2 (spec is_slashable
+        ordering: is_slashable_attestation_data(data_1, data_2))."""
+        return self._emit(surrounder, surrounded)
+
+    def _emit(self, att_1, att_2):
+        slashing = T.AttesterSlashing.make(
+            attestation_1=att_1, attestation_2=att_2
+        )
+        root = T.AttesterSlashing.hash_tree_root(slashing)
+        if root in self.attester_slashings:
+            return None
+        self.attester_slashings[root] = slashing
+        return slashing
+
+    # ------------------------------------------------------------ pruning
+
+    def prune(self, current_epoch: int) -> None:
+        """Drop history beyond the window (migrate.rs role)."""
+        cutoff = max(0, current_epoch - self.config.history_length)
+        for hist in self._validators.values():
+            hist.votes = [(s, t) for s, t in hist.votes if t >= cutoff]
+            hist.by_target = {
+                t: e for t, e in hist.by_target.items() if t >= cutoff
+            }
+        self._proposals = {
+            k: v
+            for k, v in self._proposals.items()
+            if k[1] >= cutoff * self.config.slots_per_epoch
+        }

@@ -1,0 +1,175 @@
+"""Node assembly (VERDICT r1 missing #6): ClientBuilder, slot timer,
+REST API + metrics serving, CLI db inspection.
+
+Reference parity: client/src/builder.rs:74, http_api/src/lib.rs:101,
+http_metrics, timer/src/lib.rs.
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+from lighthouse_tpu.common.slot_clock import ManualSlotClock
+from lighthouse_tpu.consensus import state_transition as st
+from lighthouse_tpu.consensus import types as T
+from lighthouse_tpu.consensus.spec import mainnet_spec
+from lighthouse_tpu.crypto.bls.keys import SecretKey
+from lighthouse_tpu.node.client import ClientBuilder
+from lighthouse_tpu.node.http_api import ApiServer, BeaconApi
+from lighthouse_tpu.node.store import HotColdDB, LogStore
+
+N = 16
+SPEC = mainnet_spec()
+
+
+def _pubkeys():
+    return [
+        SecretKey.from_seed(i.to_bytes(4, "big")).public_key().to_bytes()
+        for i in range(N)
+    ]
+
+
+def _client(tmp_path, clock=None):
+    store = HotColdDB(SPEC, LogStore(str(tmp_path)))
+    b = (
+        ClientBuilder(SPEC)
+        .store(store)
+        .genesis_state(st.interop_genesis_state(SPEC, _pubkeys()))
+        .bls_backend("fake")
+    )
+    if clock is not None:
+        b.slot_clock(clock)
+    return b.build()
+
+
+def _extend(client, slot):
+    chain = client.chain
+    chain.on_slot(slot)
+    sig = b"\xc0" + b"\x00" * 95
+    block = chain.produce_block(slot, randao_reveal=sig)
+    signed = T.SignedBeaconBlock.make(message=block, signature=sig)
+    chain.process_block(signed)
+    return signed
+
+
+def test_builder_assembles_and_timer_fires(tmp_path):
+    clock = ManualSlotClock(seconds_per_slot=12)
+    client = _client(tmp_path, clock=clock)
+    assert client.chain.head.slot == 0
+    clock.set_slot(3)
+    fired = client.timer.poll()
+    assert fired == 3
+    assert client.chain.current_slot == 3
+
+
+def test_builder_resume_roundtrip(tmp_path):
+    client = _client(tmp_path)
+    _extend(client, 1)
+    _extend(client, 2)
+    client.chain.persist()
+    head = client.chain.head.root
+
+    resumed = (
+        ClientBuilder(SPEC)
+        .store(HotColdDB(SPEC, LogStore(str(tmp_path))))
+        .resume_from_store()
+        .bls_backend("fake")
+        .build()
+    )
+    assert resumed.chain.head.root == head
+
+
+@pytest.fixture()
+def api(tmp_path):
+    client = _client(tmp_path)
+    _extend(client, 1)
+    server = ApiServer(BeaconApi(client.chain, client.sync))
+    server.start()
+    yield client, f"http://127.0.0.1:{server.port}"
+    server.stop()
+
+
+def _get(base, path, accept=None):
+    req = urllib.request.Request(base + path)
+    if accept:
+        req.add_header("Accept", accept)
+    with urllib.request.urlopen(req, timeout=5) as r:
+        ct = r.headers.get("Content-Type", "")
+        raw = r.read()
+    return raw, ct
+
+
+def test_rest_api_endpoints(api):
+    client, base = api
+    raw, _ = _get(base, "/eth/v1/node/version")
+    assert "lighthouse-tpu" in json.loads(raw)["data"]["version"]
+
+    raw, _ = _get(base, "/eth/v1/beacon/headers/head")
+    hdr = json.loads(raw)["data"]
+    assert hdr["root"] == "0x" + client.chain.head.root.hex()
+    assert hdr["header"]["message"]["slot"] == "1"
+
+    raw, _ = _get(base, "/eth/v1/beacon/states/head/finality_checkpoints")
+    assert json.loads(raw)["data"]["finalized"]["epoch"] == "0"
+
+    raw, _ = _get(base, "/eth/v1/beacon/states/head/validators/0")
+    v = json.loads(raw)["data"]
+    assert v["validator"]["pubkey"] == "0x" + _pubkeys()[0].hex()
+
+    raw, _ = _get(base, "/eth/v1/validator/duties/proposer/0")
+    duties = json.loads(raw)["data"]
+    assert len(duties) == SPEC.preset.slots_per_epoch
+
+    # SSZ block download round-trips
+    raw, ct = _get(
+        base, "/eth/v1/beacon/blocks/head", accept="application/octet-stream"
+    )
+    assert ct == "application/octet-stream"
+    block = T.SignedBeaconBlock.deserialize(raw)
+    assert block.message.hash_tree_root() == client.chain.head.root
+
+
+def test_rest_api_publish_block(api):
+    client, base = api
+    chain = client.chain
+    chain.on_slot(2)
+    sig = b"\xc0" + b"\x00" * 95
+    block = chain.produce_block(2, randao_reveal=sig)
+    signed = T.SignedBeaconBlock.make(message=block, signature=sig)
+    req = urllib.request.Request(
+        base + "/eth/v1/beacon/blocks",
+        data=T.SignedBeaconBlock.serialize(signed),
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=5) as r:
+        assert r.status == 200
+    assert chain.head.slot == 2
+
+
+def test_metrics_scrape(api):
+    _, base = api
+    raw, ct = _get(base, "/metrics")
+    assert "text/plain" in ct
+    assert b"beacon_chain_blocks_imported_total" in raw
+
+
+def test_api_errors(api):
+    _, base = api
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get(base, "/eth/v1/beacon/headers/0xdeadbeef".ljust(40, "0"))
+    assert e.value.code in (400, 404)
+    with pytest.raises(urllib.error.HTTPError) as e2:
+        _get(base, "/nope")
+    assert e2.value.code == 404
+
+
+def test_cli_db_summary(tmp_path, capsys):
+    client = _client(tmp_path)
+    _extend(client, 1)
+    client.chain.persist()
+    from lighthouse_tpu.cli import main
+
+    assert main(["db", "--datadir", str(tmp_path)]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["hot_blocks"] >= 1

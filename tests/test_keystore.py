@@ -1,0 +1,105 @@
+"""Key management: EIP-2333 derivation (anchored by the published test
+case), EIP-2335 keystore round-trips, EIP-2386 wallet account flow.
+
+Reference parity: crypto/eth2_key_derivation/src/derived_key.rs,
+crypto/eth2_keystore/src/keystore.rs, crypto/eth2_wallet.
+"""
+
+import pytest
+
+from lighthouse_tpu.crypto.bls.keys import SecretKey
+from lighthouse_tpu.crypto.keystore import (
+    Keystore,
+    KeystoreError,
+    Wallet,
+    derive_child_sk,
+    derive_master_sk,
+    derive_path,
+    validator_signing_path,
+)
+
+# EIP-2333 published test case 0.
+EIP2333_SEED = bytes.fromhex(
+    "c55257c360c07c72029aebc1b53c05ed0362ada38ead3e3e9efa3708e53495531f"
+    "09a6987599d18264c1e1c92f2cf141630c7a3c4ab7c81b2f001698e7463b04"
+)
+EIP2333_MASTER_SK = (
+    6083874454709270928345386274498605044986640685124978867557563392430687146096
+)
+EIP2333_CHILD_INDEX = 0
+EIP2333_CHILD_SK = (
+    20397789859736650942317412262472558107875392172444076792671091975210932703118
+)
+
+# small scrypt cost for tests (the format is identical, only n differs)
+FAST_N = 2**12
+
+
+def test_eip2333_known_answer():
+    master = derive_master_sk(EIP2333_SEED)
+    assert master == EIP2333_MASTER_SK
+    child = derive_child_sk(master, EIP2333_CHILD_INDEX)
+    assert child == EIP2333_CHILD_SK
+
+
+def test_derive_path_walks_tree():
+    sk = derive_path(EIP2333_SEED, "m/0")
+    assert sk == derive_child_sk(derive_master_sk(EIP2333_SEED), 0)
+    deep = derive_path(EIP2333_SEED, validator_signing_path(3))
+    assert 0 < deep
+    # deterministic
+    assert deep == derive_path(EIP2333_SEED, "m/12381/3600/3/0/0")
+
+
+def test_keystore_roundtrip_scrypt_and_pbkdf2():
+    sk = SecretKey.from_seed(b"keystore-test")
+    for kdf in ("scrypt", "pbkdf2"):
+        ks = Keystore.encrypt(
+            sk, "correct horse battery staple", kdf=kdf, scrypt_n=FAST_N
+        )
+        again = Keystore.from_json(ks.to_json())
+        out = again.decrypt("correct horse battery staple")
+        assert out.scalar == sk.scalar
+        assert again.pubkey == sk.public_key().to_bytes()
+
+
+def test_keystore_wrong_password_rejected():
+    sk = SecretKey.from_seed(b"keystore-test2")
+    ks = Keystore.encrypt(sk, "right", scrypt_n=FAST_N)
+    with pytest.raises(KeystoreError, match="checksum"):
+        ks.decrypt("wrong")
+
+
+def test_keystore_password_normalization():
+    """NFKD + control-char stripping per EIP-2335: the same logical
+    password in composed/decomposed unicode must both decrypt."""
+    sk = SecretKey.from_seed(b"keystore-test3")
+    composed = "café"  # café, composed é
+    decomposed = "café"  # café, e + combining acute
+    ks = Keystore.encrypt(sk, composed, scrypt_n=FAST_N)
+    assert ks.decrypt(decomposed).scalar == sk.scalar
+    # control characters are stripped
+    assert ks.decrypt("café\x7f").scalar == sk.scalar
+
+
+def test_wallet_derives_sequential_accounts():
+    wallet = Wallet.create(EIP2333_SEED, "wallet-pass", scrypt_n=FAST_N)
+    ks0 = wallet.next_validator("wallet-pass", "key-pass-0", scrypt_n=FAST_N)
+    ks1 = wallet.next_validator("wallet-pass", "key-pass-1", scrypt_n=FAST_N)
+    assert wallet.nextaccount == 2
+    assert ks0.path == "m/12381/3600/0/0/0"
+    assert ks1.path == "m/12381/3600/1/0/0"
+    # keys match direct path derivation (wallet adds nothing but storage)
+    sk0 = ks0.decrypt("key-pass-0")
+    assert sk0.scalar == derive_path(EIP2333_SEED, ks0.path)
+    # wallet persists + resumes the counter
+    again = Wallet.from_json(wallet.to_json())
+    assert again.nextaccount == 2
+    ks2 = again.next_validator("wallet-pass", "key-pass-2", scrypt_n=FAST_N)
+    assert ks2.path == "m/12381/3600/2/0/0"
+
+
+def test_wallet_wrong_password():
+    wallet = Wallet.create(EIP2333_SEED, "right", scrypt_n=FAST_N)
+    with pytest.raises(KeystoreError):
+        wallet.next_validator("wrong", "x", scrypt_n=FAST_N)

@@ -1,0 +1,189 @@
+"""Slasher detection (VERDICT r1 missing #7): double votes, surround
+votes in both directions via the min/max-target arrays, double
+proposals, batched ingest, dedup, pruning.
+
+Reference parity: slasher/src/array.rs (chunked min/max targets),
+attestation_queue.rs / block_queue.rs (batch ingest).
+"""
+
+from lighthouse_tpu.consensus import state_transition as st
+from lighthouse_tpu.consensus import types as T
+from lighthouse_tpu.slasher import Slasher, SlasherConfig
+
+
+def _att(indices, source, target, tag=0):
+    return T.IndexedAttestation.make(
+        attesting_indices=list(indices),
+        data=T.AttestationData.make(
+            slot=target * 32,
+            index=0,
+            beacon_block_root=bytes([tag]) * 32,
+            source=T.Checkpoint.make(epoch=source, root=b"\x00" * 32),
+            target=T.Checkpoint.make(epoch=target, root=bytes([tag]) * 32),
+        ),
+        signature=b"\xc0" + b"\x00" * 95,
+    )
+
+
+def _header(proposer, slot, tag=0):
+    return T.SignedBeaconBlockHeader.make(
+        message=T.BeaconBlockHeader.make(
+            slot=slot,
+            proposer_index=proposer,
+            parent_root=bytes([tag]) * 32,
+            state_root=b"\x00" * 32,
+            body_root=b"\x00" * 32,
+        ),
+        signature=b"\xc0" + b"\x00" * 95,
+    )
+
+
+def test_no_false_positive_on_consistent_votes():
+    s = Slasher()
+    s.queue_attestation(_att([1], 0, 1))
+    s.queue_attestation(_att([1], 1, 2))
+    s.queue_attestation(_att([1], 2, 3))
+    atts, props = s.process_queued()
+    assert atts == [] and props == []
+    # exact duplicate: also fine
+    s.queue_attestation(_att([1], 2, 3))
+    assert s.process_queued() == ([], [])
+
+
+def test_double_vote_detected():
+    s = Slasher()
+    s.queue_attestation(_att([7], 0, 2, tag=1))
+    s.queue_attestation(_att([7], 0, 2, tag=2))  # same target, diff data
+    atts, _ = s.process_queued()
+    assert len(atts) == 1
+    sl = atts[0]
+    assert st.is_slashable_attestation_data(
+        sl.attestation_1.data, sl.attestation_2.data
+    )
+
+
+def test_surround_new_surrounds_old():
+    s = Slasher()
+    s.queue_attestation(_att([3], 2, 3))  # old: inner vote
+    s.queue_attestation(_att([3], 1, 4))  # new surrounds it
+    atts, _ = s.process_queued()
+    assert len(atts) == 1
+    sl = atts[0]
+    # attestation_1 must surround attestation_2 (spec ordering)
+    assert st.is_slashable_attestation_data(
+        sl.attestation_1.data, sl.attestation_2.data
+    )
+    assert sl.attestation_1.data.source.epoch == 1
+
+
+def test_surround_old_surrounds_new():
+    s = Slasher()
+    s.queue_attestation(_att([3], 1, 4))  # old: outer vote
+    s.queue_attestation(_att([3], 2, 3))  # new is surrounded
+    atts, _ = s.process_queued()
+    assert len(atts) == 1
+    sl = atts[0]
+    assert st.is_slashable_attestation_data(
+        sl.attestation_1.data, sl.attestation_2.data
+    )
+    assert sl.attestation_1.data.source.epoch == 1
+
+
+def test_batch_ingest_multiple_validators():
+    s = Slasher()
+    # 50 validators vote normally; validator 42 also equivocates
+    for e in range(5):
+        s.queue_attestation(_att(range(50), e, e + 1))
+    s.queue_attestation(_att([42], 2, 3, tag=9))  # double vote at target 3
+    atts, _ = s.process_queued()
+    assert len(atts) == 1
+    both = set(atts[0].attestation_1.attesting_indices) & set(
+        atts[0].attestation_2.attesting_indices
+    )
+    assert both == {42}
+
+
+def test_double_proposal_detected_and_deduped():
+    s = Slasher()
+    s.queue_block_header(_header(5, 100, tag=1))
+    s.queue_block_header(_header(5, 100, tag=2))
+    s.queue_block_header(_header(5, 101, tag=1))  # different slot: fine
+    atts, props = s.process_queued()
+    assert len(props) == 1
+    # same pair again: deduped
+    s.queue_block_header(_header(5, 100, tag=2))
+    assert s.process_queued() == ([], [])
+
+
+def test_detected_slashing_passes_chain_validity():
+    """The emitted AttesterSlashing round-trips through the op-pool
+    validity check the chain applies before packing."""
+    from lighthouse_tpu.consensus.spec import mainnet_spec
+    from lighthouse_tpu.crypto.bls.keys import SecretKey
+    from lighthouse_tpu.node.operation_pool import OperationPool
+
+    spec = mainnet_spec()
+    pubkeys = [
+        SecretKey.from_seed(i.to_bytes(4, "big")).public_key().to_bytes()
+        for i in range(16)
+    ]
+    state = st.interop_genesis_state(spec, pubkeys)
+    s = Slasher()
+    s.queue_attestation(_att([3], 2, 3))
+    s.queue_attestation(_att([3], 1, 4))
+    atts, _ = s.process_queued()
+    pool = OperationPool(spec)
+    epoch = st.get_current_epoch(spec, state)
+    assert pool._attester_slashing_valid(state, atts[0], epoch)
+
+
+def test_chain_integration_slashing_reaches_block():
+    """slasher/service wiring: a detected surround vote lands in the op
+    pool via poll_slasher, is packed into the next produced block, and
+    the block imports (slashing the validator on chain)."""
+    from lighthouse_tpu.consensus.spec import mainnet_spec
+    from lighthouse_tpu.crypto.bls.keys import SecretKey
+    from lighthouse_tpu.node.beacon_chain import BeaconChain
+
+    spec = mainnet_spec()
+    pubkeys = [
+        SecretKey.from_seed(i.to_bytes(4, "big")).public_key().to_bytes()
+        for i in range(16)
+    ]
+    genesis = st.interop_genesis_state(spec, pubkeys)
+    chain = BeaconChain(
+        spec, genesis, bls_backend="fake", slasher=Slasher()
+    )
+    chain.slasher.queue_attestation(_att([3], 2, 3))
+    chain.slasher.queue_attestation(_att([3], 1, 4))
+    assert chain.poll_slasher() == 1
+    chain.on_slot(1)
+    sig = b"\xc0" + b"\x00" * 95
+    block = chain.produce_block(1, randao_reveal=sig)
+    assert len(block.body.attester_slashings) == 1
+    signed = T.SignedBeaconBlock.make(message=block, signature=sig)
+    chain.process_block(signed)
+    assert chain.head_state().validators[3].slashed
+
+
+def test_surround_detected_beyond_history_window():
+    """The window SLIDES: epochs past history_length must still be
+    covered (a fixed absolute-indexed array would go blind forever)."""
+    s = Slasher(SlasherConfig(history_length=16))
+    base = 1000  # far beyond the window size
+    s.queue_attestation(_att([5], base + 2, base + 3))
+    s.queue_attestation(_att([5], base + 1, base + 4))  # surrounds it
+    atts, _ = s.process_queued()
+    assert len(atts) == 1
+    assert st.is_slashable_attestation_data(
+        atts[0].attestation_1.data, atts[0].attestation_2.data
+    )
+
+
+def test_prune_drops_old_history():
+    s = Slasher(SlasherConfig(history_length=8))
+    s.queue_attestation(_att([1], 0, 2))
+    s.process_queued()
+    s.prune(current_epoch=100)
+    assert s._validators[1].by_target == {}
+    assert s._validators[1].votes == []
