@@ -1,88 +1,373 @@
 #!/usr/bin/env python
-"""North-star benchmark: BLS signature-set verification throughput.
-
-BASELINE config 1: `verify_signature_sets` on a batch of random
-single-pubkey SignatureSets (the gossip-attestation shape,
-attestation_verification/batch.rs:133-214). Reports sets verified per
-second on the available accelerator vs the in-repo CPU control backend
-(pure-Python optimized pairing; blst is unavailable in this image — see
-BASELINE.md for how the blst control is defined).
+"""North-star benchmark: the five BASELINE.md configs, honest baseline.
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "sets/s", "vs_baseline": N}
+  {"metric", "value" (config-1 sets/s on the device), "unit",
+   "vs_baseline" (vs the blst single-HOST anchor, see below),
+   "detail" (all configs, latency percentiles, anchors)}
 
-Env knobs: BENCH_SETS (default 256), BENCH_REPS (default 3),
-BENCH_CPU_SETS (default 4).
+Baseline anchoring (VERDICT r1 #2): blst is not installable in this
+image, so the denominator is an explicit, documented anchor — NOT the
+in-repo pure-Python control (which is reported separately as
+cpu_control_sets_per_s for sanity only). Anchor values live in
+BASELINE.md §"blst anchor" and here:
+
+  BLST_SETS_PER_S_PER_CORE = 1200   (order of published blst
+      verify_multiple_aggregate_signatures figures on a modern server
+      core, hash-to-curve included)
+  BLST_HOST_CORES = 16
+  => single-host anchor 19,200 sets/s; the north star is >= 10x this.
+
+Configs (BASELINE.md):
+  1 verify_signature_sets on BENCH_SETS random single-pubkey sets
+  2 gossip attestation load through the beacon_processor batch former ->
+    device batches -> fork choice votes; p50/p99 per-batch latency
+  3 full-block signature batch (proposer + randao + 128 aggregates with
+    128 aggregated pubkeys each + sync aggregate), one batch latency
+  4 sync-committee contribution: one 512-pubkey aggregate set
+  5 KZG 6 blobs x 32 blocks batch verify (BENCH_KZG=1; off by default
+    until the device MSM path lands — the host MSM control is minutes)
+
+Workload construction uses incremental keys (sk_{i+1} = sk_i + 1 =>
+sig_{i+1} = sig_i + H(m), pk_{i+1} = pk_i + G) so building 10^4 valid
+sets costs point ADDS, not scalar muls — setup stays O(seconds) and is
+excluded from timings, exactly like the reference's criterion setup.
+
+Env knobs: BENCH_SETS (256), BENCH_REPS (5), BENCH_ATTS (4096),
+BENCH_BATCH (1024), BENCH_CPU_SETS (4), BENCH_KZG (0),
+BENCH_CONFIGS ("1,2,3,4,5" subset filter — each new batch bucket is a
+fresh XLA compile, so CI smoke runs restrict to cached buckets),
+BENCH_BLOCK_AGGS (128), BENCH_AGG_KEYS (128).
 """
 
 import json
 import os
+import statistics
 import sys
 import time
 
 import numpy as np
 
+BLST_SETS_PER_S_PER_CORE = 1200
+BLST_HOST_CORES = 16
+BLST_HOST_ANCHOR = BLST_SETS_PER_S_PER_CORE * BLST_HOST_CORES
+
+
+def _pcts(xs):
+    import math
+
+    xs = sorted(xs)
+    n = len(xs)
+    # nearest-rank p99: never below the true 99th percentile (for small
+    # n this is the max — the honest reading for a latency headline)
+    p99_idx = min(n - 1, max(0, math.ceil(n * 0.99) - 1))
+    return {
+        "p50_s": round(statistics.median(xs), 4),
+        "p99_s": round(xs[p99_idx], 4),
+        "min_s": round(xs[0], 4),
+    }
+
+
+def _incremental_sets(n, messages):
+    """n valid single-pubkey sets over `messages` via incremental keys
+    (implied secret key of the i-th set for a message is i+1)."""
+    from lighthouse_tpu.crypto.bls import curve as C, hash_to_curve as H2C
+    from lighthouse_tpu.crypto.bls.keys import PublicKey, Signature, SignatureSet
+
+    hms = [H2C.hash_to_g2(m) for m in messages]
+    sets = []
+    per_msg_state = {}
+    for i in range(n):
+        m = i % len(messages)
+        pk, sig = per_msg_state.get(m, (None, None))
+        pk = C.g1_add(pk, C.G1_GEN)
+        sig = C.g2_add(sig, hms[m])
+        per_msg_state[m] = (pk, sig)
+        sets.append(
+            SignatureSet.single_pubkey(
+                Signature(point=sig), PublicKey(point=pk), messages[m]
+            )
+        )
+    return sets
+
 
 def main():
     n_sets = int(os.environ.get("BENCH_SETS", "256"))
-    reps = int(os.environ.get("BENCH_REPS", "3"))
+    reps = int(os.environ.get("BENCH_REPS", "5"))
+    n_atts = int(os.environ.get("BENCH_ATTS", "4096"))
+    batch_cap = int(os.environ.get("BENCH_BATCH", "1024"))
     cpu_sets = int(os.environ.get("BENCH_CPU_SETS", "4"))
+    run_kzg = os.environ.get("BENCH_KZG", "0") == "1"
+    configs = set(os.environ.get("BENCH_CONFIGS", "1,2,3,4,5").split(","))
+    n_aggs = int(os.environ.get("BENCH_BLOCK_AGGS", "128"))
+    keys_per_agg = int(os.environ.get("BENCH_AGG_KEYS", "128"))
 
+    # honor an explicit cpu request: the TPU-tunnel plugin may override
+    # JAX_PLATFORMS at interpreter startup (same guard as __graft_entry__)
+    want = os.environ.get("JAX_PLATFORMS", "")
+    if "cpu" in want and "axon" not in want and "tpu" not in want:
+        import jax
+
+        jax.config.update("jax_platforms", want)
     import lighthouse_tpu
 
     lighthouse_tpu.enable_compilation_cache()
-    from lighthouse_tpu.crypto import bls
-    from lighthouse_tpu.crypto.bls.keys import SecretKey, SignatureSet
-    from lighthouse_tpu.crypto.bls.backends import tpu as TB, cpu as CB
-
-    # -- build the workload (distinct messages, single pubkey per set) --
-    keys = [SecretKey.from_seed(i.to_bytes(4, "big")) for i in range(64)]
-    pubs = [k.public_key() for k in keys]
-    sets = []
-    for i in range(n_sets):
-        k = i % len(keys)
-        msg = b"bench-attestation-%d" % i
-        sets.append(SignatureSet.single_pubkey(keys[k].sign(msg), pubs[k], msg))
-    scalars = bls.gen_batch_scalars(n_sets)
-
-    # -- device timing (prepared inputs; kernel includes h2c, subgroup
-    # checks, ladders, pairings — everything but SHA-256 and packing) --
-    args = TB.prepare_batch(sets, scalars)
-    assert args is not None
     import jax
 
-    out = jax.block_until_ready(TB._verify_kernel(*args))  # compile+warm
-    assert bool(np.asarray(out)), "bench batch must verify"
-    times = []
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        jax.block_until_ready(TB._verify_kernel(*args))
-        times.append(time.perf_counter() - t0)
-    dev_rate = n_sets / min(times)
+    from lighthouse_tpu.crypto import bls
+    from lighthouse_tpu.crypto.bls.backends import cpu as CB, tpu as TB
 
-    # -- CPU control --
+    detail = {"device": str(jax.devices()[0]), "blst_anchor": {
+        "sets_per_s_per_core": BLST_SETS_PER_S_PER_CORE,
+        "host_cores": BLST_HOST_CORES,
+        "host_sets_per_s": BLST_HOST_ANCHOR,
+        "provenance": "published blst batch-verify figures; see BASELINE.md",
+    }}
+
+    # ---------------- config 1: raw verify_signature_sets throughput
+    msgs1 = [b"bench-config1-%d" % i for i in range(8)]
+    sets1 = _incremental_sets(max(n_sets, cpu_sets), msgs1)
+    scalars1 = bls.gen_batch_scalars(len(sets1))
+    rate1 = 0.0
+    if "1" in configs:
+        args1 = TB.prepare_batch(sets1[:n_sets], scalars1[:n_sets])
+        out = jax.block_until_ready(TB._verify_kernel(*args1))
+        assert bool(np.asarray(out)), "config1 batch must verify"
+        times1 = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            jax.block_until_ready(TB._verify_kernel(*args1))
+            times1.append(time.perf_counter() - t0)
+        rate1 = n_sets / min(times1)
+        detail["config1_raw_batch"] = {
+            "batch": n_sets,
+            "sets_per_s": round(rate1, 2),
+            **_pcts(times1),
+        }
+    else:
+        detail["config1_raw_batch"] = {"skipped": "BENCH_CONFIGS"}
+
+    # ---------------- config 2: gossip load through the batch former
+    if "2" in configs:
+        _config2(detail, n_atts, batch_cap)
+    else:
+        detail["config2_gossip_pipeline"] = {"skipped": "BENCH_CONFIGS"}
+
+    # ---------------- config 3: full-block batch (aggregate-heavy)
+    if "3" in configs:
+        _config3(detail, reps, n_aggs, keys_per_agg)
+    else:
+        detail["config3_full_block"] = {"skipped": "BENCH_CONFIGS"}
+
+    # ---------------- config 4: 512-key sync contribution
+    if "4" in configs:
+        _config4(detail, reps)
+    else:
+        detail["config4_sync_contribution"] = {"skipped": "BENCH_CONFIGS"}
+
+    # ---------------- config 5: KZG blob batch (gated)
+    if run_kzg and "5" in configs:
+        _config5(detail)
+    else:
+        detail["config5_kzg_blob_batch"] = {
+            "skipped": "BENCH_KZG=1 to run (device MSM + device pairing; "
+            "the dev trusted-setup construction itself is host-side and slow)"
+        }
+
+    # ------------- in-repo CPU control (sanity only, NOT the baseline)
     t0 = time.perf_counter()
-    ok = CB.verify_signature_sets(sets[:cpu_sets], scalars[:cpu_sets])
+    ok = CB.verify_signature_sets(sets1[:cpu_sets], scalars1[:cpu_sets])
     cpu_dt = time.perf_counter() - t0
     assert ok
-    cpu_rate = cpu_sets / cpu_dt
+    detail["cpu_control_sets_per_s"] = round(cpu_sets / cpu_dt, 2)
 
     print(
         json.dumps(
             {
                 "metric": "bls_verify_signature_sets_throughput",
-                "value": round(dev_rate, 2),
+                "value": round(rate1, 2),
                 "unit": "sets/s",
-                "vs_baseline": round(dev_rate / cpu_rate, 2),
-                "detail": {
-                    "batch": n_sets,
-                    "device": str(jax.devices()[0]),
-                    "best_batch_seconds": round(min(times), 4),
-                    "cpu_control_sets_per_s": round(cpu_rate, 2),
-                },
+                "vs_baseline": round(rate1 / BLST_HOST_ANCHOR, 4),
+                "detail": detail,
             }
         )
     )
+
+
+def _config2(detail, n_atts, batch_cap):
+    import jax
+
+    from lighthouse_tpu.crypto import bls
+    from lighthouse_tpu.crypto.bls.backends import tpu as TB
+    from lighthouse_tpu.consensus.fork_choice import ForkChoice
+    from lighthouse_tpu.consensus.spec import mainnet_spec
+    from lighthouse_tpu.node.beacon_processor import (
+        BeaconProcessor,
+        BeaconProcessorConfig,
+        Work,
+        WorkType,
+    )
+
+    spec = mainnet_spec()
+    fc = ForkChoice(spec, genesis_root=b"\x00" * 32)
+    fc.on_block(1, 1, b"\x01" * 32, b"\x00" * 32, (0, b"\x00" * 32),
+                (0, b"\x00" * 32), [32 * 10**9] * 64)
+    msgs2 = [b"bench-att-%d" % c for c in range(64)]  # 64 committees/slot
+    sets2 = _incremental_sets(n_atts, msgs2)
+    proc = BeaconProcessor(
+        BeaconProcessorConfig(
+            max_gossip_attestation_batch_size=batch_cap,
+            default_capacity=max(16384, n_atts + 1),
+        )
+    )
+    batch_times = []
+    verified = [0]  # only VERIFIED attestations count toward throughput
+
+    def _verify(payloads) -> bool:
+        scalars = bls.gen_batch_scalars(len(payloads))
+        args = TB.prepare_batch(payloads, scalars)
+        return bool(
+            np.asarray(jax.block_until_ready(TB._verify_kernel(*args)))
+        )
+
+    def process_batch(payloads):
+        t0 = time.perf_counter()
+        ok = _verify(payloads)
+        if ok:
+            verified[0] += len(payloads)
+            for i, _s in enumerate(payloads):
+                fc.on_attestation(2, i % 500_000, b"\x01" * 32, 0, 1,
+                                  is_from_block=True)
+        batch_times.append(time.perf_counter() - t0)
+        return ok
+
+    def process_individual(payload):
+        # singleton tail / poisoned-batch fallback: still real crypto
+        if _verify([payload]):
+            verified[0] += 1
+
+    for s in sets2:
+        proc.submit(
+            Work(
+                kind=WorkType.GOSSIP_ATTESTATION,
+                process_individual=process_individual,
+                process_batch=process_batch,
+                payload=s,
+            )
+        )
+    t0 = time.perf_counter()
+    while proc.step():
+        pass
+    wall2 = time.perf_counter() - t0
+    assert verified[0] == n_atts, "every attestation must verify"
+    detail["config2_gossip_pipeline"] = {
+        "attestations": n_atts,
+        "verified": verified[0],
+        "batch_cap": batch_cap,
+        "batches": len(batch_times),
+        "atts_per_s": round(verified[0] / wall2, 2),
+        "per_batch": _pcts(batch_times) if batch_times else {},
+        "note": "scheduler batch formation + device verify + fork-choice votes; "
+        "packing included in per-batch times",
+    }
+
+
+def _config3(detail, reps, n_aggs, keys_per_agg):
+    import jax
+
+    from lighthouse_tpu.crypto import bls
+    from lighthouse_tpu.crypto.bls import curve as C, hash_to_curve as H2C
+    from lighthouse_tpu.crypto.bls.backends import tpu as TB
+    from lighthouse_tpu.crypto.bls.keys import PublicKey, Signature, SignatureSet
+
+    agg_sets = []
+    for a in range(n_aggs):
+        m = b"bench-block-agg-%d" % a
+        hm = H2C.hash_to_g2(m)
+        # aggregate of incremental keys 1..k: apk = (k(k+1)/2) G... use
+        # running sums: pk_sum after k steps = sum_{i=1..k} iG
+        k = keys_per_agg
+        tri = k * (k + 1) // 2
+        apk = C.g1_mul(C.G1_GEN, tri)
+        asig = C.g2_mul(hm, tri)
+        agg_sets.append(
+            SignatureSet.single_pubkey(
+                Signature(point=asig), PublicKey(point=apk), m
+            )
+        )
+    extra = _incremental_sets(3, [b"proposer", b"randao", b"sync-agg"])
+    block_sets = extra + agg_sets
+    scalars3 = bls.gen_batch_scalars(len(block_sets))
+    args3 = TB.prepare_batch(block_sets, scalars3)
+    jax.block_until_ready(TB._verify_kernel(*args3))  # warm
+    times3 = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out3 = jax.block_until_ready(TB._verify_kernel(*args3))
+        times3.append(time.perf_counter() - t0)
+    assert bool(np.asarray(out3))
+    detail["config3_full_block"] = {
+        "sets": len(block_sets),
+        "aggregates": n_aggs,
+        "keys_per_aggregate": keys_per_agg,
+        "note": "precomputed-aggregate shortcut: per-set kernel work "
+        "(subgroup checks, h2c, pairings) identical to real aggregates",
+        "blocks_per_s": round(1.0 / min(times3), 2),
+        **_pcts(times3),
+    }
+
+
+def _config4(detail, reps):
+    import jax
+
+    from lighthouse_tpu.crypto import bls
+    from lighthouse_tpu.crypto.bls import curve as C, hash_to_curve as H2C
+    from lighthouse_tpu.crypto.bls.backends import tpu as TB
+    from lighthouse_tpu.crypto.bls.keys import PublicKey, Signature, SignatureSet
+
+    m4 = b"bench-sync-contribution"
+    hm4 = H2C.hash_to_g2(m4)
+    tri = 512 * 513 // 2
+    set4 = SignatureSet.single_pubkey(
+        Signature(point=C.g2_mul(hm4, tri)),
+        PublicKey(point=C.g1_mul(C.G1_GEN, tri)),
+        m4,
+    )
+    args4 = TB.prepare_batch([set4], bls.gen_batch_scalars(1))
+    jax.block_until_ready(TB._verify_kernel(*args4))
+    times4 = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out4 = jax.block_until_ready(TB._verify_kernel(*args4))
+        times4.append(time.perf_counter() - t0)
+    assert bool(np.asarray(out4))
+    detail["config4_sync_contribution"] = {
+        "aggregated_keys": 512,
+        "note": "pubkey aggregation is 512 G1 adds on host, excluded",
+        **_pcts(times4),
+    }
+
+
+def _config5(detail):
+    from lighthouse_tpu.crypto.kzg import TrustedSetup
+    from lighthouse_tpu.crypto.kzg.device import device_kzg
+
+    kzg = device_kzg(TrustedSetup.dev(4096))
+    blob = bytes(range(256)) * (4096 * 32 // 256)
+    commitment = kzg.blob_to_kzg_commitment(blob)
+    proof, _ = kzg.compute_blob_kzg_proof(blob, commitment)
+    blobs = [blob] * (6 * 32)
+    t0 = time.perf_counter()
+    ok5 = kzg.verify_blob_kzg_proof_batch(
+        blobs, [commitment] * len(blobs), [proof] * len(blobs)
+    )
+    dt5 = time.perf_counter() - t0
+    assert ok5
+    detail["config5_kzg_blob_batch"] = {
+        "blobs": len(blobs),
+        "seconds": round(dt5, 3),
+        "blobs_per_s": round(len(blobs) / dt5, 2),
+    }
 
 
 if __name__ == "__main__":

@@ -181,10 +181,15 @@ def _msm_host(points: list, scalars: list):
 class Kzg:
     """The reference's `Kzg` service object (crypto/kzg/src/lib.rs:50)."""
 
-    def __init__(self, setup: TrustedSetup = None, msm=None):
+    def __init__(self, setup: TrustedSetup = None, msm=None, pairing=None):
         self.setup = setup or TrustedSetup.dev()
         self.n = len(self.setup.g1_lagrange)
         self._msm = msm or _msm_host  # device seam: batched G1 MSM
+        # device seam: pairing-product check ([(G1, G2)] -> bool);
+        # host control = validated pure-Python pairing
+        self._pairing = pairing or (
+            lambda pairs: PF.pairings_product_is_one_fast(pairs)
+        )
 
     # -- commitments
 
@@ -319,12 +324,12 @@ class Kzg:
             lhs_scalars.append(z * r % R)
             proof_points.append(pr)
             proof_scalars.append(r)
-        lhs = _msm_host(lhs_points, lhs_scalars)
-        pagg = _msm_host(proof_points, proof_scalars)
+        lhs = self._msm(lhs_points, lhs_scalars)
+        pagg = self._msm(proof_points, proof_scalars)
         if pagg is None:
             return lhs is None
         pairs = []
         if lhs is not None:
             pairs.append((lhs, G2_GEN))
         pairs.append((C.g1_neg(pagg), self.setup.g2_tau))
-        return PF.pairings_product_is_one_fast(pairs)
+        return self._pairing(pairs)

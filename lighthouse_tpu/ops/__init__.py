@@ -4,9 +4,9 @@ This package plays the role blst's assembly plays for the reference
 (crypto/bls/src/impls/blst.rs): the actual field/curve/pairing arithmetic,
 designed TPU-first:
 
-  - multiprecision Fp as signed-int32 limb vectors (11 bits x 35 limbs)
-    so schoolbook products and Montgomery REDC accumulate safely on the
-    VPU without 64-bit carries (limbs.py);
+  - multiprecision Fp as lazy signed-limb vectors with constant-matrix
+    folding, so schoolbook products accumulate safely on the VPU
+    without 64-bit carries (fp.py);
   - batch dimension first: every op is elementwise over [..., LIMBS] so
     whole gossip batches verify as one fused XLA program;
   - loops over exponent/scalar bits as lax.scan with static bit arrays

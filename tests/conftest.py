@@ -30,3 +30,30 @@ jax.config.update("jax_platforms", "cpu")
 import lighthouse_tpu
 
 lighthouse_tpu.enable_compilation_cache()
+
+# ---------------------------------------------------------------- tiers
+# The crypto-kernel tests dominate suite runtime (pure-Python EC math +
+# first-run XLA compiles). Mark them so consensus/node iteration can run
+# the fast tier: pytest -m "not crypto_heavy"   (VERDICT r1 weak #10).
+import pytest
+
+_CRYPTO_HEAVY = {
+    "test_fp.py",
+    "test_tower.py",
+    "test_jacobian.py",
+    "test_pairing_ops.py",
+    "test_pairing_fast.py",
+    "test_htc.py",
+    "test_bls_ref.py",
+    "test_bls_api.py",
+    "test_tpu_backend.py",
+    "test_h2c_vectors.py",
+    "test_parallel.py",
+    "test_kzg.py",
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        if item.fspath.basename in _CRYPTO_HEAVY:
+            item.add_marker(pytest.mark.crypto_heavy)

@@ -95,9 +95,31 @@ def test_blob_proof_and_batch(ctx):
 
 
 def test_msm_device_matches_host(ctx):
-    """The device MSM path must agree with the host control."""
+    """The windowed device MSM must agree with the host control,
+    including zero scalars and infinity padding edge cases."""
     from lighthouse_tpu.ops.msm import msm_g1
 
     pts = ctx.setup.g1_lagrange[:8]
     scalars = [secrets.randbelow(R) for _ in range(8)]
     assert msm_g1(pts, scalars) == K._msm_host(pts, scalars)
+    # zero scalars and a None point mixed in
+    scalars2 = [0, 1, secrets.randbelow(R), 0, 2, 3, R - 1, 0]
+    pts2 = list(pts)
+    pts2[3] = None
+    assert msm_g1(pts2, scalars2) == K._msm_host(pts2, scalars2)
+    # non-power-of-two length exercises bucket padding
+    assert msm_g1(pts[:5], scalars[:5]) == K._msm_host(pts[:5], scalars[:5])
+
+
+def test_device_kzg_batch_verify_matches_host(ctx):
+    """Full device path (windowed MSM + device pairing product) agrees
+    with the host oracle on accept AND reject."""
+    from lighthouse_tpu.crypto.kzg.device import device_kzg
+
+    dev = device_kzg(ctx.setup)
+    blobs = [rand_blob(10 + i) for i in range(2)]
+    cms = [ctx.blob_to_kzg_commitment(b) for b in blobs]
+    proofs = [ctx.compute_blob_kzg_proof(b, c)[0] for b, c in zip(blobs, cms)]
+    assert dev.verify_blob_kzg_proof_batch(blobs, cms, proofs)
+    bad = [proofs[1], proofs[0]]
+    assert not dev.verify_blob_kzg_proof_batch(blobs, cms, bad)
