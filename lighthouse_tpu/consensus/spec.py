@@ -124,6 +124,15 @@ MINIMAL_PRESET = Preset(
 FORK_ORDER = ["phase0", "altair", "bellatrix", "capella", "deneb", "electra"]
 
 
+@dataclass(frozen=True)
+class ForkInfo:
+    """Duck-compatible with the SSZ Fork container for get_domain."""
+
+    previous_version: bytes
+    current_version: bytes
+    epoch: int
+
+
 @dataclass
 class ChainSpec:
     """Runtime constants (chain_spec.rs analog)."""
@@ -200,6 +209,19 @@ class ChainSpec:
 
     def fork_version_at_epoch(self, epoch: int) -> bytes:
         return self.fork_versions[self.fork_name_at_epoch(epoch)]
+
+    def fork_at_epoch(self, epoch: int) -> "ForkInfo":
+        """The Fork (previous/current version + activation epoch) in
+        effect at `epoch` — for signing domains of HISTORICAL objects
+        where no state of that era is at hand (backfill verification)."""
+        name = self.fork_name_at_epoch(epoch)
+        idx = FORK_ORDER.index(name)
+        prev_name = FORK_ORDER[max(0, idx - 1)]
+        return ForkInfo(
+            previous_version=self.fork_versions[prev_name],
+            current_version=self.fork_versions[name],
+            epoch=self.fork_epochs.get(name, 0),
+        )
 
     def to_dict(self) -> dict:
         d = asdict(self)

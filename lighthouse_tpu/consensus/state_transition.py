@@ -1429,12 +1429,9 @@ def mock_execution_payload(spec: ChainSpec, state):
 # ---------------------------------------------------------------- genesis
 
 
-def interop_genesis_state(
-    spec: ChainSpec, pubkeys: list, genesis_time: int = 0
-):
-    """Deterministic test-net genesis from a pubkey list (the
-    eth2_interop_keypairs + interop genesis path the reference's
-    BeaconChainHarness uses, test_utils.rs)."""
+def empty_genesis_shell(spec: ChainSpec, genesis_time: int = 0):
+    """A structurally-initialized genesis state with NO validators:
+    shared base for the interop path and the deposit-contract path."""
     state = T.BeaconState.default()
     state.genesis_time = genesis_time
     state.fork = T.Fork.make(
@@ -1450,6 +1447,38 @@ def interop_genesis_state(
     state.state_roots = [b"\x00" * 32] * spec.preset.slots_per_historical_root
     state.slashings = [0] * spec.preset.epochs_per_slashings_vector
     state.justification_bits = [False] * 4
+    return state
+
+
+def finalize_genesis_state(spec: ChainSpec, state, el_anchor: bytes = b""):
+    """Post-registry genesis finishing: validators root, sync
+    committees, and the synthetic post-merge EL anchor (a genesis EL
+    block hash so payload parent-hash ancestry is enforced from the
+    FIRST block — otherwise is_merge_transition_complete is False and
+    slot-1 payload ancestry would go unchecked)."""
+    state.genesis_validators_root = _state_field_type(
+        "validators"
+    ).hash_tree_root(state.validators)
+    if state.validators:
+        state.current_sync_committee = get_next_sync_committee(spec, state)
+        state.next_sync_committee = get_next_sync_committee(spec, state)
+    state.latest_execution_payload_header = T.ExecutionPayloadHeader.make(
+        block_hash=_hash(
+            (el_anchor or b"interop-genesis-el-block")
+            + bytes(state.genesis_validators_root)
+        ),
+        timestamp=state.genesis_time,
+    )
+    return state
+
+
+def interop_genesis_state(
+    spec: ChainSpec, pubkeys: list, genesis_time: int = 0
+):
+    """Deterministic test-net genesis from a pubkey list (the
+    eth2_interop_keypairs + interop genesis path the reference's
+    BeaconChainHarness uses, test_utils.rs)."""
+    state = empty_genesis_shell(spec, genesis_time)
 
     validators, balances = [], []
     for pk in pubkeys:
@@ -1464,21 +1493,4 @@ def interop_genesis_state(
     state.previous_epoch_participation = [0] * len(validators)
     state.current_epoch_participation = [0] * len(validators)
     state.inactivity_scores = [0] * len(validators)
-
-    state.genesis_validators_root = _state_field_type(
-        "validators"
-    ).hash_tree_root(state.validators)
-    committee = get_next_sync_committee(spec, state)
-    state.current_sync_committee = committee
-    state.next_sync_committee = get_next_sync_committee(spec, state)
-    # post-merge from birth: a synthetic genesis EL block anchors the
-    # payload parent-hash chain starting at the FIRST block (otherwise
-    # is_merge_transition_complete is False and slot-1 payload ancestry
-    # would go unchecked)
-    state.latest_execution_payload_header = T.ExecutionPayloadHeader.make(
-        block_hash=_hash(
-            b"interop-genesis-el-block" + bytes(state.genesis_validators_root)
-        ),
-        timestamp=genesis_time,
-    )
-    return state
+    return finalize_genesis_state(spec, state)

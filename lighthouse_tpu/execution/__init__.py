@@ -1,0 +1,27 @@
+"""L5: execution-layer I/O (beacon_node/execution_layer + eth1 analogs).
+
+  engine_api      — JSON-RPC engine API client with JWT auth
+                    (execution_layer/src/engine_api/http.rs + auth.rs)
+  execution_layer — the ExecutionLayer service: notify_new_payload /
+                    notify_forkchoice_updated / get_payload
+                    (execution_layer/src/lib.rs:1360,1466)
+  mock_el         — in-process mock execution engine for tests and
+                    interop (execution_layer/src/test_utils role)
+  eth1            — deposit-contract follower: deposit cache, incremental
+                    merkle tree, eth1 voting data (eth1/src/service.rs)
+"""
+
+from .engine_api import EngineApi, JwtAuth, PayloadStatus
+from .execution_layer import ExecutionLayer
+from .mock_el import MockExecutionEngine
+from .eth1 import DepositCache, Eth1Service
+
+__all__ = [
+    "EngineApi",
+    "JwtAuth",
+    "PayloadStatus",
+    "ExecutionLayer",
+    "MockExecutionEngine",
+    "DepositCache",
+    "Eth1Service",
+]

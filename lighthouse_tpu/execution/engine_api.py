@@ -1,0 +1,145 @@
+"""Engine API client: JSON-RPC over HTTP with JWT auth
+(execution_layer/src/engine_api/http.rs, auth.rs).
+
+The beacon node talks to its execution client across a process boundary:
+engine_newPayloadV3 / engine_forkchoiceUpdatedV3 / engine_getPayloadV3 /
+engine_exchangeCapabilities, authenticated with an HS256 JWT minted per
+request from a shared hex secret (EIP-3675 / engine API auth spec).
+
+Transport seam: `post(url, headers, body_bytes) -> bytes` — the default
+uses urllib; tests and the in-process mock inject a callable, and a C++
+client implements the same one-function boundary.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import json
+import time
+from dataclasses import dataclass
+from enum import Enum
+from typing import Optional
+
+
+class EngineError(Exception):
+    pass
+
+
+class PayloadStatus(Enum):
+    VALID = "VALID"
+    INVALID = "INVALID"
+    SYNCING = "SYNCING"
+    ACCEPTED = "ACCEPTED"
+    INVALID_BLOCK_HASH = "INVALID_BLOCK_HASH"
+
+
+def _b64url(data: bytes) -> bytes:
+    return base64.urlsafe_b64encode(data).rstrip(b"=")
+
+
+class JwtAuth:
+    """HS256 JWT minting from the shared secret (auth.rs). Claims: iat
+    only, as the engine API auth spec requires."""
+
+    def __init__(self, secret_hex: str):
+        secret_hex = secret_hex.strip().removeprefix("0x")
+        self.secret = bytes.fromhex(secret_hex)
+        if len(self.secret) < 32:
+            raise EngineError("jwt secret must be at least 32 bytes")
+
+    def token(self, now: Optional[int] = None) -> str:
+        header = _b64url(json.dumps({"typ": "JWT", "alg": "HS256"}).encode())
+        claims = _b64url(
+            json.dumps({"iat": int(now if now is not None else time.time())}).encode()
+        )
+        signing_input = header + b"." + claims
+        sig = hmac.new(self.secret, signing_input, hashlib.sha256).digest()
+        return (signing_input + b"." + _b64url(sig)).decode()
+
+
+def _default_post(url: str, headers: dict, body: bytes) -> bytes:
+    import urllib.request
+
+    req = urllib.request.Request(url, data=body, headers=headers, method="POST")
+    with urllib.request.urlopen(req, timeout=8) as r:
+        return r.read()
+
+
+@dataclass
+class PayloadStatusV1:
+    status: PayloadStatus
+    latest_valid_hash: Optional[bytes] = None
+    validation_error: Optional[str] = None
+
+
+class EngineApi:
+    def __init__(self, url: str, jwt: JwtAuth = None, post=None):
+        self.url = url
+        self.jwt = jwt
+        self._post = post or _default_post
+        self._next_id = 0
+
+    def _call(self, method: str, params: list):
+        self._next_id += 1
+        body = json.dumps(
+            {
+                "jsonrpc": "2.0",
+                "id": self._next_id,
+                "method": method,
+                "params": params,
+            }
+        ).encode()
+        headers = {"Content-Type": "application/json"}
+        if self.jwt is not None:
+            headers["Authorization"] = f"Bearer {self.jwt.token()}"
+        raw = self._post(self.url, headers, body)
+        obj = json.loads(raw)
+        if obj.get("error"):
+            raise EngineError(str(obj["error"]))
+        return obj.get("result")
+
+    # ------------------------------------------------------------ methods
+
+    def exchange_capabilities(self, ours: list) -> list:
+        return self._call("engine_exchangeCapabilities", [ours])
+
+    def new_payload(self, payload_json: dict, versioned_hashes: list,
+                    parent_beacon_block_root: bytes) -> PayloadStatusV1:
+        res = self._call(
+            "engine_newPayloadV3",
+            [
+                payload_json,
+                ["0x" + h.hex() for h in versioned_hashes],
+                "0x" + parent_beacon_block_root.hex(),
+            ],
+        )
+        lvh = res.get("latestValidHash")
+        return PayloadStatusV1(
+            status=PayloadStatus(res["status"]),
+            latest_valid_hash=bytes.fromhex(lvh[2:]) if lvh else None,
+            validation_error=res.get("validationError"),
+        )
+
+    def forkchoice_updated(
+        self, head: bytes, safe: bytes, finalized: bytes, attrs: dict = None
+    ):
+        res = self._call(
+            "engine_forkchoiceUpdatedV3",
+            [
+                {
+                    "headBlockHash": "0x" + head.hex(),
+                    "safeBlockHash": "0x" + safe.hex(),
+                    "finalizedBlockHash": "0x" + finalized.hex(),
+                },
+                attrs,
+            ],
+        )
+        status = PayloadStatusV1(
+            status=PayloadStatus(res["payloadStatus"]["status"]),
+        )
+        return status, res.get("payloadId")
+
+    def get_payload(self, payload_id: str) -> dict:
+        return self._call("engine_getPayloadV3", [payload_id])

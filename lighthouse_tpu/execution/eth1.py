@@ -1,0 +1,224 @@
+"""Eth1 deposit follower (beacon_node/eth1/src/service.rs +
+beacon_node/genesis analogs).
+
+A provider seam (`get_latest_block()` / `get_deposit_logs(range)`)
+stands in for the EL JSON-RPC; the service maintains the deposit cache
+— an incremental depth-32 merkle tree mirroring the deposit contract —
+serves inclusion-proved deposits for block production
+(process_operations' expected-deposit check), computes eth1_data votes,
+and can assemble a deposit-contract genesis state
+(genesis crate: initialize_beacon_state_from_eth1).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+from typing import Optional
+
+from ..consensus import state_transition as st
+from ..consensus import types as T
+from ..consensus.spec import ChainSpec
+
+DEPOSIT_CONTRACT_TREE_DEPTH = 32
+
+
+def _hash(data: bytes) -> bytes:
+    return hashlib.sha256(data).digest()
+
+
+class DepositTree:
+    """Incremental merkle tree, contract-equivalent: zero-hash padding,
+    leaf count mixed in for the final root (is_valid_merkle_branch
+    verifies against this root with depth 33)."""
+
+    def __init__(self):
+        self.leaves: list[bytes] = []
+        self._zeros = [b"\x00" * 32]
+        for _ in range(DEPOSIT_CONTRACT_TREE_DEPTH):
+            self._zeros.append(_hash(self._zeros[-1] + self._zeros[-1]))
+
+    def push(self, leaf: bytes) -> None:
+        self.leaves.append(leaf)
+
+    def _level(self, depth: int, index: int, count: int) -> bytes:
+        """Root of the subtree at (depth below top, index) considering
+        only the first `count` leaves."""
+        if depth == 0:
+            return (
+                self.leaves[index]
+                if index < count
+                else self._zeros[0]
+            )
+        span = 1 << depth
+        if index * span >= count:
+            return self._zeros[depth]
+        return _hash(
+            self._level(depth - 1, index * 2, count)
+            + self._level(depth - 1, index * 2 + 1, count)
+        )
+
+    def root(self, count: Optional[int] = None) -> bytes:
+        count = len(self.leaves) if count is None else count
+        inner = self._level(DEPOSIT_CONTRACT_TREE_DEPTH, 0, count)
+        return _hash(inner + count.to_bytes(32, "little"))
+
+    def proof(self, index: int, count: Optional[int] = None) -> list:
+        """33-element branch (32 tree levels + the length mix-in) for
+        leaf `index` against root(count)."""
+        count = len(self.leaves) if count is None else count
+        branch = []
+        idx = index
+        for depth in range(DEPOSIT_CONTRACT_TREE_DEPTH):
+            branch.append(self._level(depth, idx ^ 1, count))
+            idx //= 2
+        branch.append(count.to_bytes(32, "little"))
+        return branch
+
+
+@dataclass
+class DepositLog:
+    index: int
+    pubkey: bytes
+    withdrawal_credentials: bytes
+    amount: int
+    signature: bytes
+    block_number: int
+
+
+class DepositCache:
+    def __init__(self):
+        self.tree = DepositTree()
+        self.logs: list[DepositLog] = []
+
+    def insert(self, log: DepositLog) -> None:
+        if log.index != len(self.logs):
+            raise ValueError(
+                f"deposit {log.index} out of order (have {len(self.logs)})"
+            )
+        data = T.DepositData.make(
+            pubkey=log.pubkey,
+            withdrawal_credentials=log.withdrawal_credentials,
+            amount=log.amount,
+            signature=log.signature,
+        )
+        self.tree.push(data.hash_tree_root())
+        self.logs.append(log)
+
+    def __len__(self) -> int:
+        return len(self.logs)
+
+    def get_deposits(self, start: int, n: int, deposit_count: int) -> list:
+        """Inclusion-proved Deposit objects [start, start+n) against the
+        tree at `deposit_count` (block packing: state.eth1_deposit_index
+        .. eth1_data.deposit_count)."""
+        out = []
+        for i in range(start, min(start + n, deposit_count, len(self.logs))):
+            log = self.logs[i]
+            out.append(
+                T.Deposit.make(
+                    proof=self.tree.proof(i, deposit_count),
+                    data=T.DepositData.make(
+                        pubkey=log.pubkey,
+                        withdrawal_credentials=log.withdrawal_credentials,
+                        amount=log.amount,
+                        signature=log.signature,
+                    ),
+                )
+            )
+        return out
+
+
+class Eth1Service:
+    """Follower loop + eth1_data voting (service.rs + eth1 voting)."""
+
+    FOLLOW_DISTANCE = 8  # blocks behind the EL head we trust
+
+    def __init__(self, provider, spec: ChainSpec):
+        self.provider = provider  # .get_latest_block() / .get_deposit_logs(a, b)
+        self.spec = spec
+        self.cache = DepositCache()
+        self._synced_to = -1
+
+    def update(self) -> int:
+        """Poll new deposit logs up to the follow distance; returns how
+        many were ingested."""
+        head = self.provider.get_latest_block()
+        target = head - self.FOLLOW_DISTANCE
+        if target <= self._synced_to:
+            return 0
+        n = 0
+        for log in self.provider.get_deposit_logs(self._synced_to + 1, target):
+            self.cache.insert(log)
+            n += 1
+        self._synced_to = target
+        return n
+
+    def eth1_data_vote(self, state) -> object:
+        """The Eth1Data this node votes for: the followed tree's state
+        (the reference picks the majority candidate in the voting
+        window; with one honest provider the followed snapshot IS the
+        candidate)."""
+        count = len(self.cache)
+        if count <= state.eth1_data.deposit_count:
+            return state.eth1_data  # never regress the deposit count
+        return T.Eth1Data.make(
+            deposit_root=self.cache.tree.root(count),
+            deposit_count=count,
+            block_hash=b"\x11" * 32,
+        )
+
+    def deposits_for_block(self, state, vote=None) -> list:
+        """The deposits a produced block MUST include
+        (min(MAX_DEPOSITS, eth1_data.deposit_count - eth1_deposit_index)).
+        Uses the EFFECTIVE eth1_data: if this block's own vote reaches
+        the period majority, process_eth1_data flips eth1_data BEFORE
+        the deposit-count check, so packing must anticipate it."""
+        effective = state.eth1_data
+        if vote is not None:
+            period_slots = (
+                self.spec.preset.epochs_per_eth1_voting_period
+                * self.spec.preset.slots_per_epoch
+            )
+            votes = [v for v in state.eth1_data_votes if v == vote] + [vote]
+            if len(votes) * 2 > period_slots:
+                effective = vote
+        want = min(
+            self.spec.preset.max_deposits,
+            effective.deposit_count - state.eth1_deposit_index,
+        )
+        return self.cache.get_deposits(
+            state.eth1_deposit_index, want, effective.deposit_count
+        )
+
+
+def genesis_from_deposits(
+    spec: ChainSpec, cache: DepositCache, genesis_time: int, block_hash: bytes
+):
+    """Deposit-contract genesis (genesis crate
+    initialize_beacon_state_from_eth1): every deposit is applied through
+    process_deposit — merkle proof verified against the contract tree
+    root, invalid BLS proofs-of-possession skipped per spec — then
+    qualifying validators activate at epoch 0."""
+    state = st.empty_genesis_shell(spec, genesis_time)
+    state.eth1_data = T.Eth1Data.make(
+        deposit_root=cache.tree.root(),
+        deposit_count=len(cache),
+        block_hash=block_hash,
+    )
+    for d in cache.get_deposits(0, len(cache), len(cache)):
+        st.process_deposit(spec, state, d)
+    # genesis activations (spec: full-balance validators start active)
+    for v in state.validators:
+        if v.effective_balance == spec.max_effective_balance:
+            v.activation_eligibility_epoch = 0
+            v.activation_epoch = 0
+    return st.finalize_genesis_state(spec, state, el_anchor=block_hash)
+
+
+def is_valid_genesis_state(spec: ChainSpec, state, genesis_time: int) -> bool:
+    """Genesis trigger condition (spec is_valid_genesis_state)."""
+    if state.genesis_time < spec.min_genesis_time:
+        return False
+    active = len(st.get_active_validator_indices(state, 0))
+    return active >= spec.min_genesis_active_validator_count

@@ -1,0 +1,128 @@
+"""ExecutionLayer service (execution_layer/src/lib.rs analog).
+
+The chain's seam to the EL: `notify_new_payload` (lib.rs:1360) validates
+an execution payload and maps the engine verdict onto fork-choice
+execution status; `notify_forkchoice_updated` (lib.rs:1466) pushes head/
+finalized; `get_payload` drives block production through the
+fcu-with-attributes -> getPayload flow. Versioned hashes for blob
+commitments are computed here (kzg_commitment -> sha256 with the 0x01
+version byte)."""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Optional
+
+from ..consensus.proto_array import ExecutionStatus
+from .engine_api import EngineApi, PayloadStatus
+
+VERSIONED_HASH_VERSION_KZG = b"\x01"
+
+
+def kzg_commitment_to_versioned_hash(commitment: bytes) -> bytes:
+    return VERSIONED_HASH_VERSION_KZG + hashlib.sha256(commitment).digest()[1:]
+
+
+def payload_to_json(payload) -> dict:
+    """SSZ ExecutionPayload -> engine-API JSON encoding."""
+
+    def h(b):
+        return "0x" + bytes(b).hex()
+
+    def q(v):
+        return hex(int(v))
+
+    return {
+        "parentHash": h(payload.parent_hash),
+        "feeRecipient": h(payload.fee_recipient),
+        "stateRoot": h(payload.state_root),
+        "receiptsRoot": h(payload.receipts_root),
+        "logsBloom": h(payload.logs_bloom),
+        "prevRandao": h(payload.prev_randao),
+        "blockNumber": q(payload.block_number),
+        "gasLimit": q(payload.gas_limit),
+        "gasUsed": q(payload.gas_used),
+        "timestamp": q(payload.timestamp),
+        "extraData": h(payload.extra_data),
+        "baseFeePerGas": q(payload.base_fee_per_gas),
+        "blockHash": h(payload.block_hash),
+        "transactions": [h(t) for t in payload.transactions],
+        "withdrawals": [
+            {
+                "index": q(w.index),
+                "validatorIndex": q(w.validator_index),
+                "address": h(w.address),
+                "amount": q(w.amount),
+            }
+            for w in payload.withdrawals
+        ],
+        "blobGasUsed": q(payload.blob_gas_used),
+        "excessBlobGas": q(payload.excess_blob_gas),
+    }
+
+
+class ExecutionLayer:
+    def __init__(self, engine: EngineApi):
+        self.engine = engine
+
+    def notify_new_payload(
+        self, payload, blob_commitments, parent_beacon_block_root: bytes
+    ) -> ExecutionStatus:
+        """Engine verdict -> fork-choice execution status
+        (block_verification's ExecutionPendingBlock stage). INVALID
+        raises so the block is rejected outright; SYNCING/ACCEPTED map
+        to OPTIMISTIC (optimistic sync, resolved by later fcu)."""
+        hashes = [
+            kzg_commitment_to_versioned_hash(bytes(c))
+            for c in blob_commitments
+        ]
+        res = self.engine.new_payload(
+            payload_to_json(payload), hashes, parent_beacon_block_root
+        )
+        if res.status == PayloadStatus.VALID:
+            return ExecutionStatus.VALID
+        if res.status in (PayloadStatus.SYNCING, PayloadStatus.ACCEPTED):
+            return ExecutionStatus.OPTIMISTIC
+        raise InvalidPayload(res.validation_error or res.status.value)
+
+    def notify_forkchoice_updated(
+        self,
+        head_hash: bytes,
+        finalized_hash: bytes,
+        attrs: Optional[dict] = None,
+    ):
+        status, payload_id = self.engine.forkchoice_updated(
+            head_hash, finalized_hash, finalized_hash, attrs
+        )
+        return status, payload_id
+
+    def get_payload_for_block(
+        self,
+        head_hash: bytes,
+        finalized_hash: bytes,
+        timestamp: int,
+        prev_randao: bytes,
+        fee_recipient: bytes = b"\x00" * 20,
+    ) -> dict:
+        """fcu-with-attributes -> getPayload (block production)."""
+        attrs = {
+            "timestamp": hex(timestamp),
+            "prevRandao": "0x" + prev_randao.hex(),
+            "suggestedFeeRecipient": "0x" + fee_recipient.hex(),
+            "withdrawals": [],
+            "parentBeaconBlockRoot": "0x" + b"\x00".hex() * 32,
+        }
+        status, payload_id = self.engine.forkchoice_updated(
+            head_hash, finalized_hash, finalized_hash, attrs
+        )
+        if payload_id is None:
+            raise EngineUnavailable(f"no payload id ({status.status.value})")
+        return self.engine.get_payload(payload_id)
+
+
+class InvalidPayload(Exception):
+    """The EL judged the payload invalid: the block must be rejected."""
+
+
+class EngineUnavailable(Exception):
+    pass

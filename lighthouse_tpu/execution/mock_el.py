@@ -1,0 +1,150 @@
+"""Mock execution engine (execution_layer/src/test_utils role — the
+reference uses its mock EL server across the whole workspace's tests).
+
+Implements the engine methods as an in-process JSON-RPC endpoint whose
+`post` callable plugs straight into EngineApi, so the full client stack
+(JWT minting + JSON-RPC framing) is exercised with no sockets. Keeps a
+fake EL chain of block hashes; configurable to answer SYNCING or
+INVALID for fault-injection tests.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import json
+from typing import Optional
+
+
+class MockExecutionEngine:
+    def __init__(self, jwt_secret_hex: Optional[str] = None):
+        self.jwt_secret = (
+            bytes.fromhex(jwt_secret_hex.removeprefix("0x"))
+            if jwt_secret_hex
+            else None
+        )
+        self.known_hashes: set[bytes] = {b"\x00" * 32}
+        self.head: bytes = b"\x00" * 32
+        self.finalized: bytes = b"\x00" * 32
+        # fault injection
+        self.static_response: Optional[str] = None  # e.g. "SYNCING"
+        self.invalid_hashes: set[bytes] = set()
+        self.new_payload_calls = 0
+        self.fcu_calls = 0
+        self._payload_counter = 0
+        self._pending_payloads: dict[str, dict] = {}
+
+    # ------------------------------------------------------------ transport
+
+    def post(self, url: str, headers: dict, body: bytes) -> bytes:
+        """EngineApi-compatible transport: auth check + dispatch."""
+        if self.jwt_secret is not None:
+            auth = headers.get("Authorization", "")
+            if not auth.startswith("Bearer ") or not self._jwt_ok(auth[7:]):
+                return json.dumps(
+                    {"jsonrpc": "2.0", "id": 0, "error": {"code": -32000, "message": "unauthorized"}}
+                ).encode()
+        req = json.loads(body)
+        method = req["method"]
+        handler = {
+            "engine_exchangeCapabilities": self._capabilities,
+            "engine_newPayloadV3": self._new_payload,
+            "engine_forkchoiceUpdatedV3": self._fcu,
+            "engine_getPayloadV3": self._get_payload,
+        }.get(method)
+        if handler is None:
+            resp = {"error": {"code": -32601, "message": f"unknown {method}"}}
+        else:
+            resp = {"result": handler(req["params"])}
+        return json.dumps({"jsonrpc": "2.0", "id": req["id"], **resp}).encode()
+
+    def _jwt_ok(self, token: str) -> bool:
+        try:
+            import base64
+
+            head, claims, sig = token.split(".")
+            signing_input = (head + "." + claims).encode()
+            want = hmac.new(
+                self.jwt_secret, signing_input, hashlib.sha256
+            ).digest()
+            got = base64.urlsafe_b64decode(sig + "=" * (-len(sig) % 4))
+            return hmac.compare_digest(want, got)
+        except Exception:
+            return False
+
+    # ------------------------------------------------------------ methods
+
+    def _capabilities(self, params):
+        return [
+            "engine_newPayloadV3",
+            "engine_forkchoiceUpdatedV3",
+            "engine_getPayloadV3",
+        ]
+
+    def _new_payload(self, params):
+        self.new_payload_calls += 1
+        payload = params[0]
+        block_hash = bytes.fromhex(payload["blockHash"][2:])
+        parent_hash = bytes.fromhex(payload["parentHash"][2:])
+        if self.static_response:
+            return {"status": self.static_response, "latestValidHash": None}
+        if block_hash in self.invalid_hashes:
+            return {
+                "status": "INVALID",
+                "latestValidHash": "0x" + self.head.hex(),
+                "validationError": "injected invalid",
+            }
+        if parent_hash not in self.known_hashes:
+            return {"status": "SYNCING", "latestValidHash": None}
+        self.known_hashes.add(block_hash)
+        return {"status": "VALID", "latestValidHash": "0x" + block_hash.hex()}
+
+    def _fcu(self, params):
+        self.fcu_calls += 1
+        state = params[0]
+        head = bytes.fromhex(state["headBlockHash"][2:])
+        if self.static_response:
+            return {"payloadStatus": {"status": self.static_response}}
+        if head not in self.known_hashes:
+            return {"payloadStatus": {"status": "SYNCING"}}
+        self.head = head
+        self.finalized = bytes.fromhex(state["finalizedBlockHash"][2:])
+        result = {"payloadStatus": {"status": "VALID"}}
+        if params[1]:  # payload attributes -> start building
+            self._payload_counter += 1
+            pid = "0x%016x" % self._payload_counter
+            self._pending_payloads[pid] = {
+                "parent": head,
+                "attrs": params[1],
+            }
+            result["payloadId"] = pid
+        return result
+
+    def _get_payload(self, params):
+        pid = params[0]
+        pending = self._pending_payloads.pop(pid, None)
+        if pending is None:
+            raise ValueError("unknown payload id")
+        parent = pending["parent"]
+        block_hash = hashlib.sha256(b"mock-el-built" + parent).digest()
+        self.known_hashes.add(block_hash)
+        return {
+            "executionPayload": {
+                "parentHash": "0x" + parent.hex(),
+                "blockHash": "0x" + block_hash.hex(),
+                "prevRandao": pending["attrs"].get("prevRandao", "0x" + "00" * 32),
+                "timestamp": pending["attrs"].get("timestamp", "0x0"),
+                "feeRecipient": "0x" + "00" * 20,
+                "blockNumber": "0x1",
+                "gasLimit": "0x1c9c380",
+                "gasUsed": "0x0",
+                "extraData": "0x",
+                "baseFeePerGas": "0x7",
+                "transactions": [],
+                "withdrawals": [],
+                "blobGasUsed": "0x0",
+                "excessBlobGas": "0x0",
+            },
+            "blockValue": "0x0",
+            "blobsBundle": {"commitments": [], "proofs": [], "blobs": []},
+        }

@@ -59,6 +59,9 @@ class SyncManager:
         self._parent_requests: dict[bytes, int] = {}  # root -> depth
         # orphans parked until their ancestor chain lands
         self._awaiting_parent: dict[bytes, list] = {}
+        # backfill bookkeeping (checkpoint-synced nodes)
+        self._backfill_inflight = False
+        self._backfill_empty_streak = 0
         nbp.on_unknown_parent = self.on_unknown_parent
 
     # ------------------------------------------------------------ status
@@ -93,13 +96,16 @@ class SyncManager:
 
     def tick(self) -> None:
         """Drive sync: issue the next batch request if behind and no
-        request is in flight."""
+        request is in flight. When caught up forward, backfill history
+        genesis-ward (backfill_sync/mod.rs: runs after checkpoint sync,
+        at lower priority than staying at the head)."""
         if self._pending is not None:
             return
         target = self.target_slot()
         local = self.chain.head.slot
         if target <= local:
             self.state = SyncState.IDLE
+            self._tick_backfill()
             return
         peer = self._best_peer_for(local + 1)
         if peer is None:
@@ -118,6 +124,78 @@ class SyncManager:
             Protocol.BLOCKS_BY_RANGE,
             BlocksByRangeRequest.serialize(req),
             self._on_batch,
+        )
+
+    def _tick_backfill(self) -> None:
+        oldest = getattr(self.chain, "oldest_block_slot", 0)
+        if oldest <= 0 or self._backfill_inflight:
+            return
+        peer = self._best_peer_for(oldest)
+        if peer is None:
+            return
+        # consecutive empty responses WIDEN the window (a run of skipped
+        # slots longer than one batch must not livelock re-requesting
+        # the same empty range) until it reaches genesis
+        width = BATCH_SLOTS * (1 + self._backfill_empty_streak)
+        start = max(0, oldest - width)
+        count = oldest - start
+        # in flight until the response is fully PROCESSED — clearing at
+        # receipt would let a tick issue a duplicate request whose batch
+        # no longer links after the first one lands
+        self._backfill_inflight = True
+        req = BlocksByRangeRequest.make(start_slot=start, count=count, step=1)
+        self.service.request(
+            peer,
+            Protocol.BLOCKS_BY_RANGE,
+            BlocksByRangeRequest.serialize(req),
+            lambda p, c, ch: self._on_backfill_batch(p, c, ch, start),
+        )
+
+    def _on_backfill_batch(self, peer_id: str, code, chunks, start: int) -> None:
+        if code != ResponseCode.SUCCESS:
+            self._backfill_inflight = False
+            self.service.report_peer(peer_id, PeerAction.MID_TOLERANCE)
+            return
+        blocks = []
+        for raw in chunks:
+            try:
+                blocks.append(T.SignedBeaconBlock.deserialize(raw))
+            except Exception:
+                self._backfill_inflight = False
+                self.service.report_peer(peer_id, PeerAction.LOW_TOLERANCE)
+                return
+
+        def process(_payload) -> None:
+            try:
+                try:
+                    stored = self.chain.backfill_blocks(blocks)
+                except BlockError:
+                    self.service.report_peer(peer_id, PeerAction.LOW_TOLERANCE)
+                    return
+                if stored:
+                    self._backfill_empty_streak = 0
+                    self.service.report_peer(peer_id, PeerAction.VALUABLE)
+                    return
+                # empty response: only the window that REACHES genesis
+                # may conclude backfill — anything else is either a
+                # skipped-slot run (widen) or a withholding peer
+                # (mild penalty + implicit peer rotation via scoring)
+                if start == 0:
+                    self.chain.oldest_block_slot = 0
+                else:
+                    self._backfill_empty_streak += 1
+                    self.service.report_peer(
+                        peer_id, PeerAction.HIGH_TOLERANCE
+                    )
+            finally:
+                self._backfill_inflight = False
+
+        # backfill takes the LOWEST priority lane (lib.rs:1037 ordering)
+        self.processor.submit(
+            Work(
+                kind=WorkType.CHAIN_SEGMENT_BACKFILL,
+                process_individual=process,
+            )
         )
 
     def _best_peer_for(self, slot: int) -> Optional[str]:

@@ -86,6 +86,7 @@ class BeaconChain:
         bls_backend: Optional[str] = None,
         kzg=None,
         slasher=None,
+        execution_layer=None,
     ):
         self.spec = spec
         self.store = store or HotColdDB(spec)
@@ -95,6 +96,13 @@ class BeaconChain:
         # verified gossip attestations + imported block headers,
         # import_block_update_slasher beacon_chain.rs:4306)
         self.slasher = slasher
+        # optional execution layer (L5): payload verification + fcu
+        # (execution_layer/src/lib.rs:1360,1466); None = mock payloads
+        self.execution_layer = execution_layer
+        # optional eth1 deposit follower (eth1/src/service.rs role):
+        # feeds deposit inclusion + eth1_data votes at block production
+        self.eth1 = None
+        self._in_fcu_recompute = False
         # Deneb data availability: sidecars buffer here until the block's
         # commitment list is satisfied. kzg=None runs blob-free (blocks
         # with commitments are then rejected rather than unverified).
@@ -144,6 +152,8 @@ class BeaconChain:
         }
         self.head = ChainHead(root=genesis_root, slot=0, state_root=sroot)
         self.current_slot = 0
+        self.oldest_block_slot = 0  # full history from genesis
+        self._backfill_expected_parent = None
 
         # gossip duplicate filters (observed_attesters role)
         self._observed_attesters: set = set()
@@ -196,8 +206,138 @@ class BeaconChain:
                     self.head.root,
                     self._block_info,
                     pubkey_count=n,
+                    oldest_block_slot=self.oldest_block_slot,
                 ),
             )
+
+    @classmethod
+    def from_checkpoint(
+        cls,
+        spec: ChainSpec,
+        anchor_state,
+        signed_anchor_block,
+        store: HotColdDB = None,
+        bls_backend: Optional[str] = None,
+        kzg=None,
+    ) -> "BeaconChain":
+        """Weak-subjectivity (checkpoint) sync start: trust a recent
+        (state, block) pair instead of replaying from genesis
+        (ClientGenesis::WeakSubjSszBytes, client/src/config.rs:22-41).
+        History BELOW the anchor arrives later via backfill sync; the
+        chain serves and extends forward immediately."""
+        anchor_block = signed_anchor_block.message
+        anchor_root = anchor_block.hash_tree_root()
+        if bytes(anchor_block.state_root) != anchor_state.hash_tree_root():
+            raise ValueError("anchor state does not match anchor block")
+
+        self = cls.__new__(cls)
+        self.spec = spec
+        self.store = store or HotColdDB(spec)
+        self.bls_backend = bls_backend
+        self._lock = threading.RLock()
+        self.slasher = None
+        self.execution_layer = None
+        self.eth1 = None
+        self._in_fcu_recompute = False
+        self.kzg = kzg
+        self.da_checker = (
+            DataAvailabilityChecker(spec, kzg) if kzg is not None else None
+        )
+        self.genesis_root = anchor_root  # fork-choice anchor
+        self.genesis_validators_root = bytes(
+            anchor_state.genesis_validators_root
+        )
+        anchor_epoch = st.compute_epoch_at_slot(spec, anchor_block.slot)
+        self.fork_choice = ForkChoice(
+            spec,
+            genesis_root=anchor_root,
+            genesis_slot=anchor_block.slot,
+            justified_epoch=anchor_epoch,
+            finalized_epoch=anchor_epoch,
+            justified_balances_provider=self._justified_balances,
+        )
+        self.pubkey_cache = ValidatorPubkeyCache()
+        self.pubkey_cache.import_new_pubkeys(
+            bytes(v.pubkey) for v in anchor_state.validators
+        )
+        self._persisted_pubkeys = 0
+        sroot = anchor_state.hash_tree_root()
+        self.store.put_block(anchor_root, signed_anchor_block)
+        self.store.put_state(sroot, anchor_state)
+        self._state_roots = {anchor_root: sroot}
+        self._states = {anchor_root: anchor_state}
+        self._block_info = {anchor_root: (anchor_block.slot, None, sroot)}
+        self.head = ChainHead(
+            root=anchor_root, slot=anchor_block.slot, state_root=sroot
+        )
+        self.current_slot = anchor_block.slot
+        # history below the anchor is missing until backfill completes
+        self.store.split_slot = int(anchor_block.slot)
+        self.oldest_block_slot = int(anchor_block.slot)
+        self._backfill_expected_parent = bytes(anchor_block.parent_root)
+        self._observed_attesters = set()
+        self._observed_aggregators = set()
+        self.agg_pool = NaiveAggregationPool()
+        self.op_pool = OperationPool(spec)
+        self.m_blocks = metrics.counter("beacon_chain_blocks_imported_total")
+        self.m_atts = metrics.counter(
+            "beacon_chain_attestations_verified_total"
+        )
+        self.m_batch_fallback = metrics.counter(
+            "beacon_chain_attestation_batch_fallbacks_total"
+        )
+        return self
+
+    def backfill_blocks(self, signed_blocks) -> int:
+        """Archive a backward batch of historical blocks below the
+        anchor (backfill_sync/mod.rs role): blocks must link upward to
+        the current oldest known block; proposer signatures verify as
+        ONE batch against the anchor's validator set; bodies are stored
+        WITHOUT state transition (history only). Returns blocks stored."""
+        from ..consensus.signature_sets import block_proposal_signature_set
+
+        if not signed_blocks:
+            return 0
+        with self._lock:
+            if self._backfill_expected_parent is None:
+                raise BlockError("chain has full history; nothing to backfill")
+            blocks = [sb.message for sb in signed_blocks]
+            # the batch's newest block must BE the parent the oldest
+            # stored block expects; walk the links downward
+            expect_root = self._backfill_expected_parent
+            for b in reversed(blocks):
+                if b.hash_tree_root() != expect_root:
+                    raise BlockError("backfill batch does not link to chain")
+                expect_root = bytes(b.parent_root)
+            if self.bls_backend != "fake":
+                # historical domains come from the spec's fork SCHEDULE,
+                # not the anchor state's fork — blocks older than one
+                # fork boundary would otherwise get the wrong domain
+                sets = [
+                    block_proposal_signature_set(
+                        self.spec,
+                        self._get_pubkey,
+                        sb,
+                        self.spec.fork_at_epoch(
+                            st.compute_epoch_at_slot(
+                                self.spec, sb.message.slot
+                            )
+                        ),
+                        self.genesis_validators_root,
+                    )
+                    for sb in signed_blocks
+                ]
+                if not bls.verify_signature_sets(
+                    sets, backend=self.bls_backend
+                ):
+                    raise BlockError("backfill signature batch invalid")
+            for sb in signed_blocks:
+                root = sb.message.hash_tree_root()
+                self.store.put_block(root, sb)
+                self.store.put_cold_block_root(sb.message.slot, root)
+            self.oldest_block_slot = int(blocks[0].slot)
+            self._backfill_expected_parent = bytes(blocks[0].parent_root)
+            return len(signed_blocks)
 
     @classmethod
     def resume(
@@ -259,6 +399,22 @@ class BeaconChain:
         self.agg_pool = NaiveAggregationPool()
         self.op_pool = OperationPool(spec)
         self.slasher = None
+        self.execution_layer = None
+        self.eth1 = None
+        self._in_fcu_recompute = False
+        self.oldest_block_slot = meta["oldest_block_slot"]
+        # a resumed checkpoint node re-derives the backfill link from
+        # the oldest archived block (or the anchor)
+        self._backfill_expected_parent = None
+        if self.oldest_block_slot > 0:
+            oldest_root = store.get_cold_block_root(self.oldest_block_slot)
+            if oldest_root is None and meta["block_info"]:
+                oldest_root = min(
+                    meta["block_info"], key=lambda r: meta["block_info"][r][0]
+                )
+            blk = store.get_block(oldest_root) if oldest_root else None
+            if blk is not None:
+                self._backfill_expected_parent = bytes(blk.message.parent_root)
         self.m_blocks = metrics.counter("beacon_chain_blocks_imported_total")
         self.m_atts = metrics.counter(
             "beacon_chain_attestations_verified_total"
@@ -370,8 +526,37 @@ class BeaconChain:
             if bytes(block.state_root) != state.hash_tree_root():
                 raise BlockError("state root mismatch")
 
-            self._import_block(signed_block, block_root, state)
+            self._import_block(
+                signed_block,
+                block_root,
+                state,
+                execution_status=self._notify_new_payload(block),
+            )
             return block_root
+
+    def _notify_new_payload(self, block):
+        """EL payload verification (ExecutionPendingBlock stage,
+        block_verification.rs:700 -> lib.rs:1360). INVALID rejects the
+        block; SYNCING imports optimistically (optimistic sync)."""
+        from ..consensus.proto_array import ExecutionStatus
+
+        if self.execution_layer is None:
+            return ExecutionStatus.IRRELEVANT
+        from ..execution.execution_layer import InvalidPayload
+
+        try:
+            return self.execution_layer.notify_new_payload(
+                block.body.execution_payload,
+                [bytes(c) for c in block.body.blob_kzg_commitments],
+                # EIP-4788: the PARENT beacon block root (part of the
+                # EL block header), never this block's own root
+                bytes(block.parent_root),
+            )
+        except InvalidPayload as e:
+            raise BlockError(f"execution payload invalid: {e}") from None
+        except Exception:
+            # EL unreachable: import optimistically, resolve via later fcu
+            return ExecutionStatus.OPTIMISTIC
 
     def receive_blob_sidecars(self, sidecars) -> list:
         """Gossip/RPC sidecar arrival: verify the proposer signature on
@@ -512,7 +697,15 @@ class BeaconChain:
                     self.da_checker.expect(root, len(commitments))
                     if not self.da_checker.is_available(root):
                         break  # stop at the first unavailable block
-                self._import_block(sb, root, post)
+                # EL verification applies on the segment path too: a
+                # range-synced EL-invalid payload must not become
+                # canonical as IRRELEVANT
+                self._import_block(
+                    sb,
+                    root,
+                    post,
+                    execution_status=self._notify_new_payload(sb.message),
+                )
                 imported.append(root)
             return imported
 
@@ -526,7 +719,13 @@ class BeaconChain:
             entry = canonical.get(slot)
             return entry[0] if entry else None
 
-    def _import_block(self, signed_block, block_root: bytes, state) -> None:
+    def _import_block(
+        self, signed_block, block_root: bytes, state, execution_status=None
+    ) -> None:
+        from ..consensus.proto_array import ExecutionStatus
+
+        if execution_status is None:
+            execution_status = ExecutionStatus.IRRELEVANT
         block = signed_block.message
         state_root = bytes(block.state_root)
         self.store.put_block(block_root, signed_block)
@@ -576,6 +775,7 @@ class BeaconChain:
                     bytes(state.finalized_checkpoint.root),
                 ),
                 balances=balances,
+                execution_status=execution_status,
             )
         except ForkChoiceError as e:
             raise BlockError(str(e)) from None
@@ -646,7 +846,52 @@ class BeaconChain:
             slot=node.slot,
             state_root=self._state_roots.get(head_root, b""),
         )
+        self._notify_forkchoice_updated(head_root)
         return head_root
+
+    def _notify_forkchoice_updated(self, head_root: bytes) -> None:
+        """Push head/finalized EL block hashes after each head change
+        (lib.rs:1466). A VALID verdict also resolves optimistic
+        ancestors (on_execution_status propagation)."""
+        if self.execution_layer is None:
+            return
+        head_state = self.state_for_block(head_root)
+        if head_state is None:
+            return
+        head_hash = bytes(head_state.latest_execution_payload_header.block_hash)
+        fin_root = self.fork_choice.finalized_checkpoint[1]
+        fin_state = self.state_for_block(fin_root)
+        fin_hash = (
+            bytes(fin_state.latest_execution_payload_header.block_hash)
+            if fin_state is not None
+            else b"\x00" * 32
+        )
+        from ..consensus.proto_array import ExecutionStatus
+        from ..execution.engine_api import PayloadStatus
+
+        try:
+            status, _ = self.execution_layer.notify_forkchoice_updated(
+                head_hash, fin_hash
+            )
+        except Exception:
+            return  # EL unreachable: stay optimistic
+        if status.status == PayloadStatus.VALID:
+            self.fork_choice.on_execution_status(
+                head_root, ExecutionStatus.VALID
+            )
+        elif status.status == PayloadStatus.INVALID:
+            self.fork_choice.on_execution_status(
+                head_root, ExecutionStatus.INVALID
+            )
+            # the head just became non-viable: move OFF it immediately
+            # (the reference recomputes on an invalid fcu verdict) —
+            # guard prevents fcu->recompute->fcu recursion
+            if not self._in_fcu_recompute:
+                self._in_fcu_recompute = True
+                try:
+                    self.recompute_head()
+                finally:
+                    self._in_fcu_recompute = False
 
     # ------------------------------------------------------------ attestations
 
@@ -968,6 +1213,10 @@ class BeaconChain:
             body = T.BeaconBlockBody.default()
             body.randao_reveal = randao_reveal
             body.eth1_data = state.eth1_data
+            if self.eth1 is not None:
+                vote = self.eth1.eth1_data_vote(state)
+                body.eth1_data = vote
+                body.deposits = self.eth1.deposits_for_block(state, vote)
             prop_sl, att_sl, exits, bls_changes = (
                 self.op_pool.get_slashings_and_exits(state)
             )

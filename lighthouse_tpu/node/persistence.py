@@ -42,7 +42,7 @@ from ..crypto.bls.keys import PublicKey
 SNAPSHOT_KEY = b"persisted_chain_snapshot"
 PUBKEY_CHUNK_PREFIX = b"pubkey_chunk_"  # + <start index, 8 bytes LE>
 
-_VERSION = 2
+_VERSION = 3
 
 _EXEC_CODE = {s: i for i, s in enumerate(ExecutionStatus)}
 _EXEC_FROM = list(ExecutionStatus)
@@ -262,11 +262,12 @@ def serialize_snapshot(
     head_root: bytes,
     block_info: dict,
     pubkey_count: int,
+    oldest_block_slot: int = 0,
 ) -> bytes:
     """The single atomic resume record. The referenced pubkey chunks must
     already be durable (written first)."""
     out = BytesIO()
-    _wq(out, _VERSION, current_slot, pubkey_count)
+    _wq(out, _VERSION, current_slot, pubkey_count, oldest_block_slot)
     _wb(out, genesis_root)
     _wb(out, genesis_validators_root)
     _wb(out, head_root)
@@ -282,7 +283,7 @@ def serialize_snapshot(
 
 def restore_snapshot(raw: bytes):
     inp = BytesIO(raw)
-    version, current_slot, pubkey_count = _rq(inp, 3)
+    version, current_slot, pubkey_count, oldest_block_slot = _rq(inp, 4)
     if version != _VERSION:
         raise ValueError(f"unknown persisted chain version {version}")
     genesis_root = _rb(inp)
@@ -300,6 +301,7 @@ def restore_snapshot(raw: bytes):
     return {
         "current_slot": current_slot,
         "pubkey_count": pubkey_count,
+        "oldest_block_slot": oldest_block_slot,
         "genesis_root": genesis_root,
         "genesis_validators_root": genesis_validators_root,
         "head_root": head_root,

@@ -1,0 +1,163 @@
+"""Checkpoint (weak-subjectivity) sync + backfill (VERDICT r1 missing
+#10): a node starts from a trusted recent (state, block) pair, follows
+the head immediately, and backfills history genesis-ward in the
+background over the network.
+
+Reference parity: ClientGenesis::WeakSubjSszBytes
+(client/src/config.rs:22-41, builder.rs:268-471),
+network/src/sync/backfill_sync/mod.rs.
+"""
+
+import pytest
+
+from lighthouse_tpu.consensus import state_transition as st
+from lighthouse_tpu.consensus import types as T
+from lighthouse_tpu.consensus.spec import mainnet_spec
+from lighthouse_tpu.crypto.bls.keys import SecretKey
+from lighthouse_tpu.network import (
+    InProcessHub,
+    NetworkBeaconProcessor,
+    NetworkService,
+    SyncManager,
+)
+from lighthouse_tpu.network.gossip import TOPIC_BLOCK, topic_for
+from lighthouse_tpu.node.beacon_chain import BeaconChain, BlockError
+from lighthouse_tpu.node.beacon_processor import BeaconProcessor
+
+N = 16
+SPEC = mainnet_spec()
+DIGEST = b"\x0c\x0c\x0c\x0c"
+SIG = b"\xc0" + b"\x00" * 95
+
+
+def _build_source(slots=12):
+    pubkeys = [
+        SecretKey.from_seed(i.to_bytes(4, "big")).public_key().to_bytes()
+        for i in range(N)
+    ]
+    chain = BeaconChain(
+        SPEC, st.interop_genesis_state(SPEC, pubkeys), bls_backend="fake"
+    )
+    for slot in range(1, slots + 1):
+        chain.on_slot(slot)
+        block = chain.produce_block(slot, randao_reveal=SIG)
+        chain.process_block(
+            T.SignedBeaconBlock.make(message=block, signature=SIG)
+        )
+    return chain
+
+
+def test_from_checkpoint_follows_head_then_backfills():
+    source = _build_source(12)
+    anchor_root = source.block_root_at_slot(8)
+    anchor_block = source.store.get_block(anchor_root)
+    anchor_state = source.state_for_block(anchor_root)
+
+    node = BeaconChain.from_checkpoint(
+        SPEC, anchor_state.copy(), anchor_block, bls_backend="fake"
+    )
+    assert node.head.root == anchor_root
+    assert node.oldest_block_slot == 8
+
+    # forward: import the blocks above the anchor directly
+    for slot in range(9, 13):
+        node.on_slot(slot)
+        root = source.block_root_at_slot(slot)
+        if root is None:
+            continue
+        node.process_block(source.store.get_block(root))
+    assert node.head.root == source.head.root
+
+    # backward: archive history below the anchor in two linked batches
+    def blocks_between(lo, hi):
+        out = []
+        for s in range(lo, hi):
+            r = source.block_root_at_slot(s)
+            if r is not None:
+                out.append(source.store.get_block(r))
+        return out
+
+    assert node.backfill_blocks(blocks_between(4, 8)) == 4
+    assert node.oldest_block_slot == 4
+    assert node.backfill_blocks(blocks_between(1, 4)) == 3
+    assert node.oldest_block_slot == 1
+    # archived history is now servable by slot
+    for s in range(1, 8):
+        assert node.store.get_cold_block_root(s) == source.block_root_at_slot(s)
+
+
+def test_backfill_rejects_unlinked_batch():
+    source = _build_source(8)
+    anchor_root = source.block_root_at_slot(6)
+    node = BeaconChain.from_checkpoint(
+        SPEC,
+        source.state_for_block(anchor_root).copy(),
+        source.store.get_block(anchor_root),
+        bls_backend="fake",
+    )
+    # a batch that skips a block cannot link
+    bad = [
+        source.store.get_block(source.block_root_at_slot(s))
+        for s in (2, 3, 4)  # missing slot 5: gap to the anchor
+    ]
+    with pytest.raises(BlockError, match="link"):
+        node.backfill_blocks(bad)
+
+
+def test_checkpoint_sync_over_network():
+    """End to end over the in-process stack: a fresh checkpoint node
+    catches up forward via range sync AND backfills below its anchor."""
+    hub = InProcessHub()
+    source = _build_source(12)
+
+    class Node:
+        def __init__(self, name, chain):
+            self.chain = chain
+            self.processor = BeaconProcessor()
+            self.service = NetworkService(hub, name)
+            self.service.subscribe(topic_for(TOPIC_BLOCK, DIGEST))
+            self.nbp = NetworkBeaconProcessor(
+                chain, self.processor, self.service, fork_digest=DIGEST
+            )
+            self.sync = SyncManager(
+                chain, self.processor, self.service, self.nbp
+            )
+
+        def pump(self):
+            n = 0
+            for ev in self.service.poll():
+                self.nbp.handle_gossip(ev.peer_id, ev.topic, ev.data)
+                n += 1
+            while self.processor.step():
+                n += 1
+            return n
+
+    a = Node("a", source)
+    anchor_root = source.block_root_at_slot(8)
+    b = Node(
+        "b",
+        BeaconChain.from_checkpoint(
+            SPEC,
+            source.state_for_block(anchor_root).copy(),
+            source.store.get_block(anchor_root),
+            bls_backend="fake",
+        ),
+    )
+    a.service.connect_peer(b.service)
+    b.chain.on_slot(12)
+    b.sync.add_peer("a")
+    for _ in range(12):
+        b.sync.tick()
+        while a.pump() + b.pump():
+            pass
+        if (
+            b.chain.head.root == source.head.root
+            and b.chain.oldest_block_slot == 0
+        ):
+            break
+    assert b.chain.head.root == source.head.root  # forward sync done
+    assert b.chain.oldest_block_slot == 0  # backfill reached genesis
+    for s in range(1, 8):
+        assert b.chain.store.get_cold_block_root(s) == (
+            source.block_root_at_slot(s)
+        )

@@ -1,0 +1,263 @@
+"""Execution layer + eth1 (VERDICT r1 missing #2 — layer L5):
+engine-API client with JWT auth against the mock EL, payload-status to
+fork-choice mapping, optimistic import + fcu resolution, the deposit
+tree/cache/follower, deposit packing into produced blocks, and
+deposit-contract genesis.
+
+Reference parity: execution_layer/src/lib.rs:1360,1466 +
+engine_api/{http,auth}.rs + test_utils mock server; eth1/src/service.rs;
+genesis crate.
+"""
+
+import pytest
+
+from lighthouse_tpu.consensus import state_transition as st
+from lighthouse_tpu.consensus import types as T
+from lighthouse_tpu.consensus.domains import compute_signing_root, compute_domain
+from lighthouse_tpu.consensus.proto_array import ExecutionStatus
+from lighthouse_tpu.consensus.spec import mainnet_spec
+from lighthouse_tpu.crypto.bls.keys import SecretKey
+from lighthouse_tpu.execution import (
+    DepositCache,
+    EngineApi,
+    Eth1Service,
+    ExecutionLayer,
+    JwtAuth,
+    MockExecutionEngine,
+    PayloadStatus,
+)
+from lighthouse_tpu.execution.eth1 import DepositLog, genesis_from_deposits
+from lighthouse_tpu.execution.execution_layer import InvalidPayload
+from lighthouse_tpu.node.beacon_chain import BeaconChain, BlockError
+
+SPEC = mainnet_spec()
+SECRET = "aa" * 32
+N = 16
+
+
+def _engine(mock=None):
+    mock = mock or MockExecutionEngine(jwt_secret_hex=SECRET)
+    api = EngineApi("http://mock", JwtAuth(SECRET), post=mock.post)
+    return mock, ExecutionLayer(api)
+
+
+# ------------------------------------------------------------ engine api
+
+
+def test_jwt_auth_roundtrip_and_rejection():
+    mock, el = _engine()
+    caps = el.engine.exchange_capabilities(["engine_newPayloadV3"])
+    assert "engine_newPayloadV3" in caps
+    bad_api = EngineApi("http://mock", JwtAuth("bb" * 32), post=mock.post)
+    with pytest.raises(Exception, match="unauthorized"):
+        bad_api.exchange_capabilities([])
+
+
+def test_payload_status_mapping():
+    mock, el = _engine()
+    payload = T.ExecutionPayload.default()
+    payload.parent_hash = b"\x00" * 32  # known to the mock
+    payload.block_hash = b"\x01" * 32
+    status = el.notify_new_payload(payload, [], b"\x22" * 32)
+    assert status == ExecutionStatus.VALID
+
+    orphan = T.ExecutionPayload.default()
+    orphan.parent_hash = b"\x77" * 32  # unknown parent -> SYNCING
+    orphan.block_hash = b"\x78" * 32
+    assert el.notify_new_payload(orphan, [], b"\x22" * 32) == (
+        ExecutionStatus.OPTIMISTIC
+    )
+
+    bad = T.ExecutionPayload.default()
+    bad.parent_hash = b"\x00" * 32
+    bad.block_hash = b"\x99" * 32
+    mock.invalid_hashes.add(b"\x99" * 32)
+    with pytest.raises(InvalidPayload):
+        el.notify_new_payload(bad, [], b"\x22" * 32)
+
+
+# ------------------------------------------------------------ chain + EL
+
+
+def _chain_with_el(mock=None):
+    pubkeys = [
+        SecretKey.from_seed(i.to_bytes(4, "big")).public_key().to_bytes()
+        for i in range(N)
+    ]
+    genesis = st.interop_genesis_state(SPEC, pubkeys)
+    mock, el = _engine(mock)
+    chain = BeaconChain(
+        SPEC, genesis, bls_backend="fake", execution_layer=el
+    )
+    # the EL knows the genesis anchor block
+    mock.known_hashes.add(
+        bytes(genesis.latest_execution_payload_header.block_hash)
+    )
+    return mock, chain
+
+
+def _extend(chain, slot):
+    chain.on_slot(slot)
+    sig = b"\xc0" + b"\x00" * 95
+    block = chain.produce_block(slot, randao_reveal=sig)
+    signed = T.SignedBeaconBlock.make(message=block, signature=sig)
+    return signed, chain.process_block(signed)
+
+
+def test_chain_notifies_el_and_marks_valid():
+    mock, chain = _chain_with_el()
+    _, root = _extend(chain, 1)
+    assert mock.new_payload_calls == 1
+    assert mock.fcu_calls >= 1  # recompute_head pushed the new head
+    node = chain.fork_choice.proto.nodes[
+        chain.fork_choice.proto.index_by_root[root]
+    ]
+    assert node.execution_status == ExecutionStatus.VALID
+    # the EL's head followed ours
+    head_state = chain.head_state()
+    assert mock.head == bytes(
+        head_state.latest_execution_payload_header.block_hash
+    )
+
+
+def test_invalid_payload_rejects_block():
+    mock, chain = _chain_with_el()
+    chain.on_slot(1)
+    sig = b"\xc0" + b"\x00" * 95
+    block = chain.produce_block(1, randao_reveal=sig)
+    mock.invalid_hashes.add(
+        bytes(block.body.execution_payload.block_hash)
+    )
+    with pytest.raises(BlockError, match="payload invalid"):
+        chain.process_block(
+            T.SignedBeaconBlock.make(message=block, signature=sig)
+        )
+    assert not chain.fork_choice.contains_block(block.hash_tree_root())
+
+
+def test_syncing_el_imports_optimistically():
+    mock, chain = _chain_with_el()
+    mock.static_response = "SYNCING"
+    _, root = _extend(chain, 1)
+    node = chain.fork_choice.proto.nodes[
+        chain.fork_choice.proto.index_by_root[root]
+    ]
+    assert node.execution_status == ExecutionStatus.OPTIMISTIC
+    # EL catches up (it now knows the payload) and the next head
+    # recompute resolves the optimistic status
+    mock.static_response = None
+    head_state = chain.head_state()
+    mock.known_hashes.add(
+        bytes(head_state.latest_execution_payload_header.block_hash)
+    )
+    chain.recompute_head()
+    assert node.execution_status == ExecutionStatus.VALID
+
+
+# ------------------------------------------------------------ deposits
+
+
+def _deposit_log(index, amount=32 * 10**9):
+    sk = SecretKey.from_seed(b"dep" + index.to_bytes(4, "big"))
+    pk = sk.public_key().to_bytes()
+    wc = b"\x00" + bytes(31)
+    msg = T.DepositMessage.make(
+        pubkey=pk, withdrawal_credentials=wc, amount=amount
+    )
+    domain = compute_domain(
+        SPEC.domain_deposit, SPEC.genesis_fork_version, b"\x00" * 32
+    )
+    sig = sk.sign(compute_signing_root(msg, domain)).to_bytes()
+    return DepositLog(
+        index=index,
+        pubkey=pk,
+        withdrawal_credentials=wc,
+        amount=amount,
+        signature=sig,
+        block_number=100 + index,
+    )
+
+
+def test_deposit_tree_proofs_verify():
+    cache = DepositCache()
+    for i in range(5):
+        cache.insert(_deposit_log(i))
+    for count in (3, 5):
+        root = cache.tree.root(count)
+        for i in range(count):
+            d = cache.get_deposits(i, 1, count)[0]
+            assert st.is_valid_merkle_branch(
+                d.data.hash_tree_root(), d.proof, 33, i, root
+            ), (i, count)
+
+
+class _Provider:
+    def __init__(self, logs):
+        self.logs = logs
+        self.head = 0
+
+    def get_latest_block(self):
+        return self.head
+
+    def get_deposit_logs(self, lo, hi):
+        return [
+            l
+            for l in self.logs
+            if lo <= l.index <= hi  # index used as block offset for the test
+        ]
+
+
+def test_eth1_follower_honors_follow_distance():
+    logs = [_deposit_log(i) for i in range(4)]
+    provider = _Provider(logs)
+    svc = Eth1Service(provider, SPEC)
+    provider.head = 2  # target = 2 - 8 < 0: nothing followed yet
+    assert svc.update() == 0
+    provider.head = 11  # target = 3: logs 0..3
+    assert svc.update() == 4
+    assert len(svc.cache) == 4
+
+
+def test_deposits_flow_into_produced_block():
+    """eth1 -> produce_block -> import: a new validator joins the
+    registry through a packed, inclusion-proved deposit."""
+    mock, chain = _chain_with_el()
+    svc = Eth1Service(_Provider([_deposit_log(0)]), SPEC)
+    svc.provider.head = 100
+    svc.update()
+    chain.eth1 = svc
+    # vote until the period majority flips eth1_data (fresh chain: the
+    # vote wins once more than half the period's slots carry it)
+    period_slots = (
+        SPEC.preset.epochs_per_eth1_voting_period * SPEC.preset.slots_per_epoch
+    )
+    needed = period_slots // 2 + 1
+    for slot in range(1, needed + 2):
+        signed, _ = _extend(chain, slot)
+        if chain.head_state().eth1_deposit_index > 0:
+            break
+    state = chain.head_state()
+    assert state.eth1_data.deposit_count == 1
+    assert state.eth1_deposit_index == 1
+    assert len(state.validators) == N + 1
+    assert bytes(state.validators[N].pubkey) == svc.cache.logs[0].pubkey
+
+
+def test_genesis_from_deposits():
+    cache = DepositCache()
+    for i in range(4):
+        cache.insert(_deposit_log(i))
+    state = genesis_from_deposits(
+        SPEC, cache, genesis_time=12345, block_hash=b"\x42" * 32
+    )
+    assert len(state.validators) == 4
+    assert all(v.activation_epoch == 0 for v in state.validators)
+    assert state.eth1_data.deposit_count == 4
+    # a bad-signature deposit is skipped, not fatal (spec behavior)
+    bad = _deposit_log(4)
+    bad.signature = b"\xc0" + b"\x00" * 95
+    cache.insert(bad)
+    state2 = genesis_from_deposits(
+        SPEC, cache, genesis_time=12345, block_hash=b"\x42" * 32
+    )
+    assert len(state2.validators) == 4  # still 4: invalid PoP skipped
