@@ -73,7 +73,16 @@ def cmd_bn(args) -> int:
 
     spec = _spec(args)
     os.makedirs(args.datadir, exist_ok=True)
-    store = HotColdDB(spec, LogStore(args.datadir))
+    # production path: the C++ engine (same on-disk format); the Python
+    # engine is the fallback when no toolchain is present
+    from .node import native_store
+
+    kv = (
+        native_store.NativeLogStore(args.datadir)
+        if native_store.native_available()
+        else LogStore(args.datadir)
+    )
+    store = HotColdDB(spec, kv)
     builder = (
         ClientBuilder(spec)
         .store(store)

@@ -1,0 +1,100 @@
+"""Hierarchical state diffs for the freezer
+(beacon_node/store/src/hdiff.rs analog).
+
+Full state snapshots are large (registry-dominated) and adjacent epoch
+states differ in a small fraction of their SSZ bytes. Cold states are
+therefore stored as a DIFF HIERARCHY: slots at the top layer keep full
+(compressed) snapshots; every other restore point stores a compressed
+byte-span diff against its parent at the next-coarser layer, so
+reconstructing any restore point resolves at most `len(exponents)`
+records (hdiff.rs exponent hierarchy).
+
+Layout rule (mirrors the reference): for exponents [e0 < e1 < ... < ek]
+(slots measured in restore-point units), a point at multiple of 2^ek is
+a snapshot; otherwise its parent is the slot rounded down to the next
+coarser layer's alignment.
+
+The diff codec is span-based (offset/length/replacement runs + length
+change) over the SSZ serialization, zlib-compressed — byte-exact on
+apply, content-agnostic, and replaceable by a C++ codec behind the same
+two functions.
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+from typing import Optional
+
+DEFAULT_EXPONENTS = (0, 2, 4, 6)  # in restore-point units
+
+
+def compute_diff(base: bytes, target: bytes) -> bytes:
+    """Span diff: runs of differing bytes against `base`, plus the
+    target length (handles growth/shrink). Vectorized: the change mask
+    and run boundaries come from numpy, not a per-byte Python loop —
+    states are megabytes at production validator counts."""
+    import numpy as np
+
+    out = bytearray(struct.pack("<Q", len(target)))
+    n = min(len(base), len(target))
+    if n:
+        a = np.frombuffer(base, dtype=np.uint8, count=n)
+        b = np.frombuffer(target, dtype=np.uint8, count=n)
+        idx = np.nonzero(a != b)[0]
+        if idx.size:
+            # merge differing bytes separated by <= 8 equal bytes into
+            # one run (span-header amortization)
+            breaks = np.nonzero(np.diff(idx) > 8)[0]
+            starts = np.concatenate(([0], breaks + 1))
+            ends = np.concatenate((breaks, [idx.size - 1]))
+            for s, e in zip(starts, ends):
+                i, j = int(idx[s]), int(idx[e]) + 1
+                out += struct.pack("<QI", i, j - i) + target[i:j]
+    if len(target) > len(base):
+        out += struct.pack("<QI", len(base), len(target) - len(base))
+        out += target[len(base):]
+    return zlib.compress(bytes(out), level=3)
+
+
+def apply_diff(base: bytes, diff: bytes) -> bytes:
+    raw = zlib.decompress(diff)
+    (target_len,) = struct.unpack_from("<Q", raw, 0)
+    out = bytearray(base[:target_len].ljust(target_len, b"\x00"))
+    pos = 8
+    while pos < len(raw):
+        off, length = struct.unpack_from("<QI", raw, pos)
+        pos += 12
+        out[off : off + length] = raw[pos : pos + length]
+        pos += length
+    return bytes(out)
+
+
+class Hierarchy:
+    def __init__(self, exponents=DEFAULT_EXPONENTS):
+        self.exponents = tuple(sorted(exponents))
+
+    def parent(self, unit: int) -> Optional[int]:
+        """The restore-point unit this unit diffs against; None for a
+        full snapshot (top-layer alignment or unit 0)."""
+        if unit == 0 or unit % (1 << self.exponents[-1]) == 0:
+            return None
+        # the COARSEST layer this unit aligns to determines its parent:
+        # the enclosing point at the next-coarser layer's alignment
+        # (coarsest-first scan guarantees parent != unit)
+        for e in reversed(self.exponents):
+            if unit % (1 << e) == 0:
+                coarser = 1 << self._next_coarser(e)
+                return (unit // coarser) * coarser
+        # not aligned to any layer: diff against the finest alignment
+        finest = 1 << self.exponents[0]
+        return (unit // finest) * finest
+
+    def _next_coarser(self, e: int) -> int:
+        for c in self.exponents:
+            if c > e:
+                return c
+        return self.exponents[-1]
+
+    def chain_depth(self) -> int:
+        return len(self.exponents) + 1

@@ -1,0 +1,178 @@
+"""ctypes binding for the native C++ KV engine (native/kvstore.cpp).
+
+`NativeLogStore` implements the same `KVStore` interface and the same
+on-disk format as the Python `LogStore` — stores open interchangeably;
+the Python engine stays as the correctness oracle and test double, the
+C++ engine is the production path (LevelDB role, SURVEY.md §2.7 #3).
+
+The shared library builds on demand with g++ (cached next to the
+source, keyed by source mtime); `native_available()` gates callers so
+environments without a toolchain fall back to LogStore.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+import subprocess
+import threading
+from typing import Iterator, Optional
+
+from .store import KVStore
+
+_SRC = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+    "kvstore.cpp",
+)
+_SO = os.path.join(os.path.dirname(_SRC), "build", "libkvstore.so")
+
+_lib = None
+_build_err: Optional[str] = None
+_build_lock = threading.Lock()
+
+
+def _load():
+    global _lib, _build_err
+    with _build_lock:
+        if _lib is not None or _build_err is not None:
+            return _lib
+        try:
+            os.makedirs(os.path.dirname(_SO), exist_ok=True)
+            if (
+                not os.path.exists(_SO)
+                or os.path.getmtime(_SO) < os.path.getmtime(_SRC)
+            ):
+                subprocess.run(
+                    [
+                        "g++",
+                        "-O2",
+                        "-shared",
+                        "-fPIC",
+                        "-std=c++17",
+                        _SRC,
+                        "-o",
+                        _SO,
+                    ],
+                    check=True,
+                    capture_output=True,
+                )
+            lib = ctypes.CDLL(_SO)
+            lib.kv_open.restype = ctypes.c_void_p
+            lib.kv_open.argtypes = [ctypes.c_char_p]
+            lib.kv_close.argtypes = [ctypes.c_void_p]
+            lib.kv_put.restype = ctypes.c_int
+            lib.kv_put.argtypes = [
+                ctypes.c_void_p,
+                ctypes.c_char_p,
+                ctypes.c_uint32,
+                ctypes.c_char_p,
+                ctypes.c_uint32,
+                ctypes.c_char_p,
+                ctypes.c_uint32,
+            ]
+            lib.kv_get.restype = ctypes.c_int64
+            lib.kv_get.argtypes = [
+                ctypes.c_void_p,
+                ctypes.c_char_p,
+                ctypes.c_uint32,
+                ctypes.c_char_p,
+                ctypes.c_uint32,
+                ctypes.POINTER(ctypes.POINTER(ctypes.c_char)),
+            ]
+            lib.kv_delete.restype = ctypes.c_int
+            lib.kv_delete.argtypes = lib.kv_put.argtypes[:5]
+            lib.kv_keys.restype = ctypes.c_int64
+            lib.kv_keys.argtypes = lib.kv_get.argtypes[:3] + [
+                ctypes.POINTER(ctypes.POINTER(ctypes.c_char))
+            ]
+            lib.kv_compact.restype = ctypes.c_int
+            lib.kv_compact.argtypes = lib.kv_get.argtypes[:3]
+            lib.kv_free.argtypes = [ctypes.POINTER(ctypes.c_char)]
+            _lib = lib
+        except Exception as e:  # toolchain missing / compile failure
+            _build_err = str(e)
+        return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+class NativeLogStore(KVStore):
+    def __init__(self, path: str):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError(f"native kvstore unavailable: {_build_err}")
+        self._lib = lib
+        os.makedirs(path, exist_ok=True)
+        self._h = lib.kv_open(path.encode())
+        if not self._h:
+            raise RuntimeError("kv_open failed")
+
+    def _handle(self):
+        # a NULL Store* would segfault inside the engine — fail loudly
+        # instead (the Python oracle transparently reopens; callers that
+        # need that behavior must construct a new NativeLogStore)
+        if not self._h:
+            raise RuntimeError("NativeLogStore used after close()")
+        return self._h
+
+    def get(self, column, key):
+        out = ctypes.POINTER(ctypes.c_char)()
+        n = self._lib.kv_get(
+            self._handle(), bytes(column), len(column), bytes(key), len(key),
+            ctypes.byref(out),
+        )
+        if n == -1:
+            return None
+        if n < 0:
+            raise IOError("kv_get failed")
+        try:
+            return ctypes.string_at(out, n)
+        finally:
+            self._lib.kv_free(out)
+
+    def put(self, column, key, value):
+        rc = self._lib.kv_put(
+            self._handle(), bytes(column), len(column), bytes(key), len(key),
+            bytes(value), len(value),
+        )
+        if rc != 0:
+            raise IOError("kv_put failed")
+
+    def delete(self, column, key):
+        rc = self._lib.kv_delete(
+            self._handle(), bytes(column), len(column), bytes(key), len(key)
+        )
+        if rc != 0:
+            raise IOError("kv_delete failed")
+
+    def keys(self, column) -> Iterator[bytes]:
+        out = ctypes.POINTER(ctypes.c_char)()
+        n = self._lib.kv_keys(
+            self._handle(), bytes(column), len(column), ctypes.byref(out)
+        )
+        if n < 0:
+            raise IOError("kv_keys failed")
+        try:
+            raw = ctypes.string_at(out, n)
+        finally:
+            self._lib.kv_free(out)
+        (count,) = struct.unpack_from("<I", raw, 0)
+        pos, keys = 4, []
+        for _ in range(count):
+            (klen,) = struct.unpack_from("<I", raw, pos)
+            keys.append(raw[pos + 4 : pos + 4 + klen])
+            pos += 4 + klen
+        return iter(keys)
+
+    def compact(self, column: bytes) -> None:
+        if self._lib.kv_compact(self._handle(), bytes(column), len(column)) != 0:
+            raise IOError("kv_compact failed")
+
+    def close(self):
+        if self._h:
+            self._lib.kv_close(self._h)
+            self._h = None

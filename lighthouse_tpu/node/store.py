@@ -223,6 +223,41 @@ class HotColdDB:
         self.sprp = slots_per_restore_point or (
             2 * spec.preset.slots_per_epoch
         )
+        from . import hdiff
+
+        self._hierarchy = hdiff.Hierarchy()
+        # small parent-bytes cache: boundaries in one migrate window
+        # share parents; don't re-resolve the same snapshot W times
+        self._cold_bytes_cache: dict[int, bytes] = {}
+        self._migrate_schema()
+
+    SCHEMA_VERSION = 2
+
+    def _migrate_schema(self) -> None:
+        """Versioned schema upgrades (beacon_chain/src/schema_change.rs
+        role). v1 -> v2: cold-state records gain the b'F'/b'D' tag;
+        untagged v1 records are verified by deserialization and
+        rewritten as tagged full snapshots."""
+        import zlib
+
+        raw = self.kv.get(Column.METADATA, b"schema_version")
+        version = struct.unpack("<Q", raw)[0] if raw else 1
+        if version >= self.SCHEMA_VERSION:
+            return
+        for key in list(self.kv.keys(Column.COLD_STATE)):
+            rec = self.kv.get(Column.COLD_STATE, key)
+            if rec is None:
+                continue
+            try:  # v1 records are raw SSZ; verify before rewriting
+                T.BeaconState.deserialize(rec)
+            except Exception:
+                continue  # already tagged (or corrupt: surfaced on read)
+            self.kv.put(Column.COLD_STATE, key, b"F" + zlib.compress(rec, 3))
+        self.kv.put(
+            Column.METADATA,
+            b"schema_version",
+            struct.pack("<Q", self.SCHEMA_VERSION),
+        )
 
     # -- blocks
 
@@ -279,10 +314,65 @@ class HotColdDB:
         return self.kv.get(Column.BLOCK_ROOT_BY_SLOT, struct.pack("<Q", slot))
 
     def put_restore_point(self, slot: int, state) -> None:
-        self.kv.put(Column.COLD_STATE, struct.pack("<Q", slot), state.serialize())
+        """Cold-state write through the diff hierarchy (hdiff.rs role):
+        top-layer points store full compressed snapshots; every other
+        point stores a span diff against its parent layer. Records are
+        tagged b'F' (full, zlib) / b'D' (diff + parent slot)."""
+        import zlib
+
+        from . import hdiff
+
+        key = struct.pack("<Q", slot)
+        raw = state.serialize()
+        unit = slot // self.sprp
+        parent_unit = self._hierarchy.parent(unit)
+        if parent_unit is not None:
+            parent_raw = self._cold_state_bytes(parent_unit * self.sprp)
+            if parent_raw is not None:
+                self.kv.put(
+                    Column.COLD_STATE,
+                    key,
+                    b"D"
+                    + struct.pack("<Q", parent_unit * self.sprp)
+                    + hdiff.compute_diff(parent_raw, raw),
+                )
+                return
+        self.kv.put(Column.COLD_STATE, key, b"F" + zlib.compress(raw, 3))
+        self._cold_bytes_cache[slot] = raw
+        while len(self._cold_bytes_cache) > 4:
+            self._cold_bytes_cache.pop(next(iter(self._cold_bytes_cache)))
+
+    def _cold_state_bytes(self, slot: int, _depth: int = 0):
+        """Resolve a restore point's SSZ bytes through the diff chain
+        (bounded by the hierarchy depth)."""
+        import zlib
+
+        from . import hdiff
+
+        cached = self._cold_bytes_cache.get(slot)
+        if cached is not None:
+            return cached
+        raw = self.kv.get(Column.COLD_STATE, struct.pack("<Q", slot))
+        if raw is None:
+            return None
+        if raw[:1] == b"F":
+            out = zlib.decompress(raw[1:])
+            self._cold_bytes_cache[slot] = out
+            while len(self._cold_bytes_cache) > 4:
+                self._cold_bytes_cache.pop(next(iter(self._cold_bytes_cache)))
+            return out
+        if raw[:1] == b"D":
+            if _depth > self._hierarchy.chain_depth():
+                raise IOError("hdiff chain too deep (corrupt hierarchy)")
+            (parent_slot,) = struct.unpack_from("<Q", raw, 1)
+            base = self._cold_state_bytes(parent_slot, _depth + 1)
+            if base is None:
+                return None
+            return hdiff.apply_diff(base, raw[9:])
+        raise IOError(f"unknown cold-state record tag {raw[:1]!r}")
 
     def get_restore_point(self, slot: int):
-        raw = self.kv.get(Column.COLD_STATE, struct.pack("<Q", slot))
+        raw = self._cold_state_bytes(slot)
         return None if raw is None else T.BeaconState.deserialize(raw)
 
     def get_cold_state(self, slot: int):

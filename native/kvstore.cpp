@@ -1,0 +1,271 @@
+// Native log-structured KV engine — the LevelDB-role storage backend
+// (beacon_node/store/src/leveldb_store.rs analog; SURVEY.md §2.7 item 3:
+// "an embedded KV or C++ engine — not a crypto kernel, keep on host").
+//
+// On-disk format is IDENTICAL to the Python LogStore
+// (lighthouse_tpu/node/store.py): one append-only segment per column,
+// records [klen u32][vlen u32 | 0xFFFFFFFF tombstone][key][value],
+// torn tails truncated on open. A store written by either engine opens
+// in the other — the Python engine is the correctness oracle, this one
+// is the production path (no GIL, no per-record Python overhead).
+//
+// C ABI for ctypes (no pybind11 in this image):
+//   kv_open/kv_close, kv_put/kv_get/kv_delete, kv_keys, kv_compact,
+//   kv_free for buffers the engine allocates.
+
+#include <cstdint>
+#include <cstring>
+#include <cstdio>
+#include <map>
+#include <mutex>
+#include <string>
+#include <sys/stat.h>
+#include <unistd.h>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kTomb = 0xFFFFFFFFu;
+
+struct Column {
+  FILE* f = nullptr;
+  // key -> (value offset, value length)
+  std::unordered_map<std::string, std::pair<uint64_t, uint32_t>> index;
+};
+
+struct Store {
+  std::string path;
+  std::mutex mu;
+  std::map<std::string, Column> columns;
+};
+
+std::string segment_path(const Store& s, const std::string& col) {
+  return s.path + "/" + col + ".log";
+}
+
+bool load_column(Store& s, const std::string& col, Column& c) {
+  std::string seg = segment_path(s, col);
+  FILE* rf = fopen(seg.c_str(), "rb");
+  uint64_t valid_end = 0;
+  if (rf != nullptr) {
+    fseek(rf, 0, SEEK_END);
+    uint64_t size = static_cast<uint64_t>(ftell(rf));
+    fseek(rf, 0, SEEK_SET);
+    std::vector<uint8_t> data(size);
+    if (size && fread(data.data(), 1, size, rf) != size) {
+      fclose(rf);
+      return false;
+    }
+    fclose(rf);
+    uint64_t pos = 0;
+    while (pos + 8 <= size) {
+      uint32_t klen, vlen;
+      memcpy(&klen, data.data() + pos, 4);
+      memcpy(&vlen, data.data() + pos + 4, 4);
+      uint64_t body = 8ull + klen + (vlen == kTomb ? 0 : vlen);
+      if (pos + body > size) break;  // torn tail
+      std::string key(reinterpret_cast<char*>(data.data() + pos + 8), klen);
+      if (vlen == kTomb) {
+        c.index.erase(key);
+      } else {
+        c.index[key] = {pos + 8 + klen, vlen};
+      }
+      pos += body;
+      valid_end = pos;
+    }
+    if (valid_end != size) {
+      // crash-recovery: drop the torn tail exactly like the oracle
+      FILE* tf = fopen(seg.c_str(), "r+b");
+      if (tf != nullptr) {
+        if (ftruncate(fileno(tf), static_cast<off_t>(valid_end)) != 0) {
+          fclose(tf);
+          return false;
+        }
+        fclose(tf);
+      }
+    }
+  }
+  c.f = fopen(seg.c_str(), "a+b");
+  return c.f != nullptr;
+}
+
+Column* open_column(Store& s, const char* col_data, uint32_t col_len) {
+  std::string col(col_data, col_len);
+  auto it = s.columns.find(col);
+  if (it != s.columns.end()) return &it->second;
+  Column c;
+  if (!load_column(s, col, c)) return nullptr;
+  auto [ins, ok] = s.columns.emplace(col, std::move(c));
+  return &ins->second;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* kv_open(const char* path) {
+  auto* s = new Store();
+  s->path = path;
+  mkdir(path, 0755);  // best-effort; existing dir is fine
+  return s;
+}
+
+void kv_close(void* handle) {
+  auto* s = static_cast<Store*>(handle);
+  {
+    std::lock_guard<std::mutex> lock(s->mu);
+    for (auto& [_, c] : s->columns) {
+      if (c.f != nullptr) fclose(c.f);
+    }
+  }
+  delete s;
+}
+
+int kv_put(void* handle, const char* col, uint32_t col_len, const char* key,
+           uint32_t key_len, const char* val, uint32_t val_len) {
+  auto* s = static_cast<Store*>(handle);
+  std::lock_guard<std::mutex> lock(s->mu);
+  Column* c = open_column(*s, col, col_len);
+  if (c == nullptr) return -1;
+  fseek(c->f, 0, SEEK_END);
+  uint64_t pos = static_cast<uint64_t>(ftell(c->f));
+  // an acknowledged write must BE on disk: any short write or failed
+  // flush reports an error and leaves the index untouched (torn-tail
+  // recovery drops the partial record on reopen), matching the Python
+  // oracle's OSError behavior
+  bool ok = fwrite(&key_len, 4, 1, c->f) == 1 &&
+            fwrite(&val_len, 4, 1, c->f) == 1 &&
+            fwrite(key, 1, key_len, c->f) == key_len &&
+            fwrite(val, 1, val_len, c->f) == val_len &&
+            fflush(c->f) == 0;
+  if (!ok) return -1;
+  c->index[std::string(key, key_len)] = {pos + 8 + key_len, val_len};
+  return 0;
+}
+
+// Returns value length, -1 if absent, -2 on error; *out receives a
+// malloc'd buffer the caller frees with kv_free.
+int64_t kv_get(void* handle, const char* col, uint32_t col_len,
+               const char* key, uint32_t key_len, char** out) {
+  auto* s = static_cast<Store*>(handle);
+  std::lock_guard<std::mutex> lock(s->mu);
+  Column* c = open_column(*s, col, col_len);
+  if (c == nullptr) return -2;
+  auto it = c->index.find(std::string(key, key_len));
+  if (it == c->index.end()) return -1;
+  auto [off, vlen] = it->second;
+  fflush(c->f);
+  fseek(c->f, static_cast<long>(off), SEEK_SET);
+  char* buf = static_cast<char*>(malloc(vlen ? vlen : 1));
+  if (vlen && fread(buf, 1, vlen, c->f) != vlen) {
+    free(buf);
+    return -2;
+  }
+  *out = buf;
+  return static_cast<int64_t>(vlen);
+}
+
+int kv_delete(void* handle, const char* col, uint32_t col_len,
+              const char* key, uint32_t key_len) {
+  auto* s = static_cast<Store*>(handle);
+  std::lock_guard<std::mutex> lock(s->mu);
+  Column* c = open_column(*s, col, col_len);
+  if (c == nullptr) return -1;
+  std::string k(key, key_len);
+  if (c->index.find(k) == c->index.end()) return 0;
+  uint32_t tomb = kTomb;
+  fseek(c->f, 0, SEEK_END);
+  bool ok = fwrite(&key_len, 4, 1, c->f) == 1 &&
+            fwrite(&tomb, 4, 1, c->f) == 1 &&
+            fwrite(key, 1, key_len, c->f) == key_len && fflush(c->f) == 0;
+  if (!ok) return -1;
+  c->index.erase(k);
+  return 0;
+}
+
+// Serializes all keys as [n u32][klen u32][key]... into a malloc'd
+// buffer; returns byte length or -1. Caller frees with kv_free.
+int64_t kv_keys(void* handle, const char* col, uint32_t col_len, char** out) {
+  auto* s = static_cast<Store*>(handle);
+  std::lock_guard<std::mutex> lock(s->mu);
+  Column* c = open_column(*s, col, col_len);
+  if (c == nullptr) return -1;
+  uint64_t total = 4;
+  for (auto& [k, _] : c->index) total += 4 + k.size();
+  char* buf = static_cast<char*>(malloc(total));
+  uint32_t n = static_cast<uint32_t>(c->index.size());
+  memcpy(buf, &n, 4);
+  uint64_t pos = 4;
+  for (auto& [k, _] : c->index) {
+    uint32_t klen = static_cast<uint32_t>(k.size());
+    memcpy(buf + pos, &klen, 4);
+    memcpy(buf + pos + 4, k.data(), klen);
+    pos += 4 + klen;
+  }
+  *out = buf;
+  return static_cast<int64_t>(total);
+}
+
+int kv_compact(void* handle, const char* col, uint32_t col_len) {
+  auto* s = static_cast<Store*>(handle);
+  std::lock_guard<std::mutex> lock(s->mu);
+  Column* c = open_column(*s, col, col_len);
+  if (c == nullptr) return -1;
+  // snapshot live records
+  std::vector<std::pair<std::string, std::string>> live;
+  fflush(c->f);
+  for (auto& [k, ent] : c->index) {
+    std::string v(ent.second, '\0');
+    fseek(c->f, static_cast<long>(ent.first), SEEK_SET);
+    if (ent.second && fread(v.data(), 1, ent.second, c->f) != ent.second) {
+      return -1;
+    }
+    live.emplace_back(k, std::move(v));
+  }
+  std::string colname(col, col_len);
+  std::string seg = segment_path(*s, colname);
+  std::string tmp = seg + ".tmp";
+  FILE* tf = fopen(tmp.c_str(), "wb");
+  if (tf == nullptr) return -1;
+  std::unordered_map<std::string, std::pair<uint64_t, uint32_t>> index;
+  uint64_t pos = 0;
+  bool ok = true;
+  for (auto& [k, v] : live) {
+    uint32_t klen = static_cast<uint32_t>(k.size());
+    uint32_t vlen = static_cast<uint32_t>(v.size());
+    ok = ok && fwrite(&klen, 4, 1, tf) == 1 && fwrite(&vlen, 4, 1, tf) == 1 &&
+         fwrite(k.data(), 1, klen, tf) == klen &&
+         fwrite(v.data(), 1, vlen, tf) == vlen;
+    if (!ok) break;
+    index[k] = {pos + 8 + klen, vlen};
+    pos += 8ull + klen + vlen;
+  }
+  // the rename only happens after every byte of the replacement segment
+  // is verifiably on disk; any failure leaves the ORIGINAL intact and
+  // the column fully usable (os.replace-after-success, like the oracle)
+  ok = (fflush(tf) == 0) && ok;
+  ok = (fclose(tf) == 0) && ok;
+  if (!ok) {
+    remove(tmp.c_str());
+    return -1;
+  }
+  if (rename(tmp.c_str(), seg.c_str()) != 0) {
+    remove(tmp.c_str());
+    return -1;
+  }
+  fclose(c->f);
+  c->f = fopen(seg.c_str(), "a+b");
+  if (c->f == nullptr) {
+    // segment replaced but unreopenable: drop the column so the next
+    // op re-opens from disk instead of dereferencing a dead stream
+    s->columns.erase(colname);
+    return -1;
+  }
+  c->index = std::move(index);
+  return 0;
+}
+
+void kv_free(char* buf) { free(buf); }
+
+}  // extern "C"

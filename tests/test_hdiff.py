@@ -1,0 +1,112 @@
+"""Hierarchical state diffs (hdiff.rs analog, VERDICT r1 missing #11):
+span-diff codec round-trips, hierarchy parent layout, and cold-state
+storage resolving through diff chains with real states.
+"""
+
+import struct
+
+import pytest
+
+from lighthouse_tpu.consensus import state_transition as st
+from lighthouse_tpu.consensus.spec import mainnet_spec
+from lighthouse_tpu.crypto.bls.keys import SecretKey
+from lighthouse_tpu.node import hdiff
+from lighthouse_tpu.node.store import Column, HotColdDB, MemoryStore
+
+SPEC = mainnet_spec()
+
+
+def test_diff_codec_roundtrip():
+    base = bytes(range(256)) * 40
+    # mutate some spans, grow the tail
+    target = bytearray(base)
+    target[100:110] = b"X" * 10
+    target[5000:5003] = b"YZW"
+    target += b"tail-growth" * 5
+    diff = hdiff.compute_diff(base, bytes(target))
+    assert hdiff.apply_diff(base, diff) == bytes(target)
+    assert len(diff) < len(target) // 4  # sparse change compresses well
+    # shrink case
+    short = bytes(target[:3000])
+    diff2 = hdiff.compute_diff(bytes(target), short)
+    assert hdiff.apply_diff(bytes(target), diff2) == short
+    # identical inputs: near-empty diff
+    diff3 = hdiff.compute_diff(base, base)
+    assert hdiff.apply_diff(base, diff3) == base
+
+
+def test_hierarchy_parent_layout():
+    h = hdiff.Hierarchy(exponents=(0, 2, 4, 6))
+    assert h.parent(0) is None
+    assert h.parent(64) is None  # top layer: snapshot
+    assert h.parent(16) == 0  # layer 2^4 -> parent at 2^6 alignment
+    assert h.parent(80) == 64
+    assert h.parent(4) == 0  # layer 2^2 -> parent at 2^4 alignment
+    assert h.parent(20) == 16
+    assert h.parent(3) == 0  # finest layer -> enclosing 2^2 alignment
+    assert h.parent(19) == 16
+    # every chain terminates at a snapshot within the hierarchy depth
+    for unit in range(1, 257):
+        steps = 0
+        u = unit
+        while h.parent(u) is not None:
+            u = h.parent(u)
+            steps += 1
+            assert steps <= h.chain_depth()
+
+
+def test_cold_states_store_as_diffs_and_resolve():
+    pubkeys = [
+        SecretKey.from_seed(i.to_bytes(4, "big")).public_key().to_bytes()
+        for i in range(16)
+    ]
+    state = st.interop_genesis_state(SPEC, pubkeys)
+    db = HotColdDB(SPEC, MemoryStore(), slots_per_restore_point=8)
+
+    snapshots = {}
+    walk = state
+    for unit in range(0, 4):
+        slot = unit * 8
+        if walk.slot < slot:
+            walk = walk.copy()
+            st.process_slots(SPEC, walk, slot)
+        db.put_restore_point(slot, walk)
+        snapshots[slot] = walk.hash_tree_root()
+
+    # units 1..3 parent onto unit 0 per the hierarchy: stored as diffs
+    raw8 = db.kv.get(Column.COLD_STATE, struct.pack("<Q", 8))
+    raw0 = db.kv.get(Column.COLD_STATE, struct.pack("<Q", 0))
+    assert raw0[:1] == b"F"
+    assert raw8[:1] == b"D"
+    full_size = len(raw0)
+    assert len(raw8) < full_size // 2  # epoch-adjacent states diff small
+
+    for slot, root in snapshots.items():
+        got = db.get_restore_point(slot)
+        assert got.hash_tree_root() == root
+
+
+def test_v1_store_schema_migrates_on_open():
+    """A store written before the tagged format (v1: raw SSZ cold
+    records) upgrades in place on open (schema_change.rs role)."""
+    pubkeys = [
+        SecretKey.from_seed(i.to_bytes(4, "big")).public_key().to_bytes()
+        for i in range(8)
+    ]
+    state = st.interop_genesis_state(SPEC, pubkeys)
+    kv = MemoryStore()
+    # simulate a v1 store: raw record, no schema version key
+    kv.put(Column.COLD_STATE, struct.pack("<Q", 0), state.serialize())
+    db = HotColdDB(SPEC, kv, slots_per_restore_point=8)
+    got = db.get_restore_point(0)
+    assert got.hash_tree_root() == state.hash_tree_root()
+    assert kv.get(Column.METADATA, b"schema_version") == struct.pack("<Q", 2)
+    # and the record is now tagged
+    assert kv.get(Column.COLD_STATE, struct.pack("<Q", 0))[:1] == b"F"
+
+
+def test_corrupt_cold_record_raises():
+    db = HotColdDB(SPEC, MemoryStore(), slots_per_restore_point=8)
+    db.kv.put(Column.COLD_STATE, struct.pack("<Q", 0), b"Xjunk")
+    with pytest.raises(IOError, match="unknown cold-state"):
+        db.get_restore_point(0)
