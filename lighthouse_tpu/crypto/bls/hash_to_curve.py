@@ -125,9 +125,17 @@ def iso_map(pt):
     return (xx, yy)
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=512)
 def hash_to_g2(msg: bytes, dst: bytes = params.DST):
-    """Full hash_to_curve: msg → point in G2 (r-torsion of E2)."""
-    u0, u1 = hash_to_field_fp2(msg, 2, dst)
+    """Full hash_to_curve: msg → point in G2 (r-torsion of E2).
+
+    Memoized: many signers hash the SAME message (a slot's sync
+    committee all sign the head root; a committee's attesters share
+    attestation data) — the map runs once per distinct message."""
+    u0, u1 = hash_to_field_fp2(bytes(msg), 2, bytes(dst))
     q0 = iso_map(map_to_curve_sswu(u0))
     q1 = iso_map(map_to_curve_sswu(u1))
     return C.g2_clear_cofactor(C.g2_add(q0, q1))

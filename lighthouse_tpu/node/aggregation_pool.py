@@ -128,6 +128,21 @@ class NaiveAggregationPool:
             signature=_merge_signatures(entry.signature, msg.signature),
         )
 
+    def insert_contribution(self, contribution) -> None:
+        """Adopt a received aggregate contribution when it covers more
+        signers than the locally-built one (best-contribution keeping,
+        the op-pool role for sync aggregates)."""
+        key = (
+            int(contribution.slot),
+            bytes(contribution.beacon_block_root),
+            int(contribution.subcommittee_index),
+        )
+        entry = self._sync.get(key)
+        if entry is None or sum(contribution.aggregation_bits) > sum(
+            entry.aggregation_bits
+        ):
+            self._sync[key] = contribution
+
     def get_contribution(
         self, slot: int, block_root: bytes, subcommittee: int
     ) -> Optional[object]:

@@ -158,6 +158,8 @@ class BeaconChain:
         # gossip duplicate filters (observed_attesters role)
         self._observed_attesters: set = set()
         self._observed_aggregators: set = set()
+        self._observed_sync_signers: set = set()
+        self._observed_sync_aggregators: set = set()
         # pools: local aggregation + block packing
         self.agg_pool = NaiveAggregationPool()
         self.op_pool = OperationPool(spec)
@@ -277,6 +279,8 @@ class BeaconChain:
         self._backfill_expected_parent = bytes(anchor_block.parent_root)
         self._observed_attesters = set()
         self._observed_aggregators = set()
+        self._observed_sync_signers = set()
+        self._observed_sync_aggregators = set()
         self.agg_pool = NaiveAggregationPool()
         self.op_pool = OperationPool(spec)
         self.m_blocks = metrics.counter("beacon_chain_blocks_imported_total")
@@ -396,6 +400,8 @@ class BeaconChain:
         self._persisted_pubkeys = len(self.pubkey_cache)
         self._observed_attesters = set()
         self._observed_aggregators = set()
+        self._observed_sync_signers = set()
+        self._observed_sync_aggregators = set()
         self.agg_pool = NaiveAggregationPool()
         self.op_pool = OperationPool(spec)
         self.slasher = None
@@ -1104,6 +1110,159 @@ class BeaconChain:
             self.m_atts.inc()
             return v
 
+    # -------------------------------------------------- sync committee gossip
+
+    def sync_committee_positions(self, validator_index: int) -> dict:
+        """subcommittee -> [positions] of `validator_index` in the
+        CURRENT sync committee (duty discovery + message fan-out)."""
+        state = self.head_state()
+        pubkey = bytes(state.validators[validator_index].pubkey)
+        size = self.spec.preset.sync_committee_size
+        subnet_size = size // self.spec.preset.sync_committee_subnet_count
+        out: dict[int, list] = {}
+        for i, pk in enumerate(state.current_sync_committee.pubkeys):
+            if bytes(pk) == pubkey:
+                out.setdefault(i // subnet_size, []).append(i % subnet_size)
+        return out
+
+    def verify_sync_message_for_gossip(self, msg) -> None:
+        """SyncCommitteeMessage gossip verification
+        (sync_committee_verification.rs): slot currency, committee
+        membership, first-seen filter, signature — then merge into the
+        per-subcommittee local contributions."""
+        from ..consensus.signature_sets import sync_committee_message_set
+
+        with self._lock:
+            if not (
+                self.current_slot - 1 <= int(msg.slot) <= self.current_slot
+            ):
+                raise AttestationError("sync message not for current slot")
+            key = (int(msg.validator_index), int(msg.slot))
+            if key in self._observed_sync_signers:
+                raise AttestationError("sync signer already seen")
+            positions = self.sync_committee_positions(int(msg.validator_index))
+            if not positions:
+                raise AttestationError("not in the current sync committee")
+            state = self.head_state()
+            s = sync_committee_message_set(
+                self.spec,
+                self._get_pubkey,
+                int(msg.validator_index),
+                int(msg.slot),
+                bytes(msg.beacon_block_root),
+                bytes(msg.signature),
+                state.fork,
+                self.genesis_validators_root,
+            )
+            if not bls.verify_signature_sets([s], backend=self.bls_backend):
+                raise AttestationError("sync message signature invalid")
+            self._observed_sync_signers.add(key)
+            size = self.spec.preset.sync_committee_size
+            subnet_size = size // self.spec.preset.sync_committee_subnet_count
+            for subcommittee, poss in positions.items():
+                for pos in poss:
+                    self.agg_pool.insert_sync_message(
+                        msg, subcommittee, pos, subnet_size
+                    )
+
+    def verify_sync_contribution_for_gossip(self, signed_contribution) -> None:
+        """SignedContributionAndProof gossip verification — THREE sets
+        in ONE batch (selection proof, wrapper, contribution), like the
+        reference's sync_committee_verification.rs:670 batching."""
+        from ..consensus.signature_sets import (
+            signed_sync_aggregate_selection_proof_signature_set,
+            signed_sync_aggregate_signature_set,
+            sync_committee_contribution_signature_set,
+        )
+
+        msg = signed_contribution.message
+        contribution = msg.contribution
+        with self._lock:
+            if not (
+                self.current_slot - 1
+                <= int(contribution.slot)
+                <= self.current_slot
+            ):
+                raise AttestationError("contribution not for current slot")
+            key = (
+                int(msg.aggregator_index),
+                int(contribution.slot),
+                int(contribution.subcommittee_index),
+            )
+            if key in self._observed_sync_aggregators:
+                raise AttestationError("sync aggregator already seen")
+            # the aggregator must itself sit in the subcommittee it
+            # aggregates for (spec contribution-and-proof rule)
+            agg_positions = self.sync_committee_positions(
+                int(msg.aggregator_index)
+            )
+            if int(contribution.subcommittee_index) not in agg_positions:
+                raise AttestationError("aggregator not in subcommittee")
+            if not self._is_sync_aggregator(bytes(msg.selection_proof)):
+                raise AttestationError("invalid sync aggregator selection")
+            state = self.head_state()
+            size = self.spec.preset.sync_committee_size
+            subnets = self.spec.preset.sync_committee_subnet_count
+            subnet_size = size // subnets
+            sub = int(contribution.subcommittee_index)
+            if sub >= subnets:
+                raise AttestationError("subcommittee index out of range")
+            bits = list(contribution.aggregation_bits)
+            if not any(bits):
+                raise AttestationError("empty contribution")
+            member_pubkeys = [
+                self.pubkey_cache.get(
+                    self.pubkey_cache.get_index(
+                        bytes(
+                            state.current_sync_committee.pubkeys[
+                                sub * subnet_size + i
+                            ]
+                        )
+                    )
+                )
+                for i, b in enumerate(bits)
+                if b
+            ]
+            fork = state.fork
+            sets = [
+                signed_sync_aggregate_selection_proof_signature_set(
+                    self.spec,
+                    self._get_pubkey,
+                    signed_contribution,
+                    fork,
+                    self.genesis_validators_root,
+                ),
+                signed_sync_aggregate_signature_set(
+                    self.spec,
+                    self._get_pubkey,
+                    signed_contribution,
+                    fork,
+                    self.genesis_validators_root,
+                ),
+                sync_committee_contribution_signature_set(
+                    self.spec,
+                    member_pubkeys,
+                    contribution,
+                    fork,
+                    self.genesis_validators_root,
+                ),
+            ]
+            if not bls.verify_signature_sets(sets, backend=self.bls_backend):
+                raise AttestationError("sync contribution batch invalid")
+            self._observed_sync_aggregators.add(key)
+            self.agg_pool.insert_contribution(contribution)
+
+    def _is_sync_aggregator(self, selection_proof: bytes) -> bool:
+        """spec is_sync_committee_aggregator: modulo over the
+        subcommittee size / TARGET_AGGREGATORS_PER_SYNC_SUBCOMMITTEE."""
+        import hashlib
+
+        size = self.spec.preset.sync_committee_size
+        subnets = self.spec.preset.sync_committee_subnet_count
+        modulo = max(1, (size // subnets) // 16)
+        h = hashlib.sha256(selection_proof).digest()
+        return int.from_bytes(h[:8], "little") % modulo == 0
+
     def _is_aggregator(self, committee_len: int, selection_proof: bytes) -> bool:
         """spec is_aggregator: hash(selection_proof)[:8] mod
         (committee_len // TARGET_AGGREGATORS) == 0."""
@@ -1283,6 +1442,20 @@ class BeaconChain:
                 (i, e)
                 for (i, e) in self._observed_attesters
                 if e + 1 >= cur_epoch
+            }
+            # slot-keyed sync dedup sets age out on the same tick
+            slot_cutoff = max(
+                0, (cur_epoch - 1) * self.spec.preset.slots_per_epoch
+            )
+            self._observed_sync_signers = {
+                (i, s)
+                for (i, s) in self._observed_sync_signers
+                if s >= slot_cutoff
+            }
+            self._observed_sync_aggregators = {
+                k
+                for k in self._observed_sync_aggregators
+                if k[1] >= slot_cutoff
             }
             # pool pruning rides the same finality tick
             head_state = self.head_state()

@@ -51,6 +51,24 @@ class BeaconNodeApi:
     def is_aggregator(self, committee_len: int, proof: bytes) -> bool:
         raise NotImplementedError
 
+    def sync_committee_positions(self, validator_index: int) -> dict:
+        raise NotImplementedError
+
+    def publish_sync_message(self, msg) -> None:
+        raise NotImplementedError
+
+    def is_sync_aggregator(self, proof: bytes) -> bool:
+        raise NotImplementedError
+
+    def sync_contribution_for(self, slot, block_root, subcommittee):
+        raise NotImplementedError
+
+    def publish_sync_contribution(self, signed_contribution) -> None:
+        raise NotImplementedError
+
+    def head_root(self) -> bytes:
+        raise NotImplementedError
+
 
 class InProcessBeaconNode(BeaconNodeApi):
     """Direct chain wiring (the testing/simulator posture)."""
@@ -111,6 +129,26 @@ class InProcessBeaconNode(BeaconNodeApi):
     def is_aggregator(self, committee_len, proof):
         return self.chain._is_aggregator(committee_len, proof)
 
+    def sync_committee_positions(self, validator_index):
+        return self.chain.sync_committee_positions(validator_index)
+
+    def publish_sync_message(self, msg):
+        self.chain.verify_sync_message_for_gossip(msg)
+
+    def is_sync_aggregator(self, proof):
+        return self.chain._is_sync_aggregator(proof)
+
+    def sync_contribution_for(self, slot, block_root, subcommittee):
+        return self.chain.agg_pool.get_contribution(
+            slot, block_root, subcommittee
+        )
+
+    def publish_sync_contribution(self, signed_contribution):
+        self.chain.verify_sync_contribution_for_gossip(signed_contribution)
+
+    def head_root(self):
+        return self.chain.head.root
+
 
 class ValidatorClient:
     def __init__(
@@ -129,6 +167,8 @@ class ValidatorClient:
         self.produced_blocks = 0
         self.published_attestations = 0
         self.published_aggregates = 0
+        self.published_sync_messages = 0
+        self.published_sync_contributions = 0
         self.slashing_vetoes = 0
 
     # ------------------------------------------------------------ duties
@@ -193,6 +233,79 @@ class ValidatorClient:
                 continue
             self.published_attestations += 1
 
+    def _managed_validators(self, state) -> dict:
+        """pubkey -> validator index for keys this VC holds (hoisted
+        set: the registry scan must be O(V+K), not O(V*K))."""
+        managed_set = set(self.store.pubkeys())
+        return {
+            bytes(v.pubkey): i
+            for i, v in enumerate(state.validators)
+            if bytes(v.pubkey) in managed_set
+        }
+
+    def on_slot_third_sync(self, slot: int) -> None:
+        """Sync-committee message production (sync_committee_service):
+        every managed validator in the current committee signs the head
+        root at slot+1/3, alongside attestations."""
+        state = self.bn.head_state()
+        fork = state.fork
+        head_root = self.bn.head_root()
+        for pubkey, vidx in self._managed_validators(state).items():
+            if not self.bn.sync_committee_positions(vidx):
+                continue
+            try:
+                sig = self.store.sign_sync_committee_message(
+                    pubkey, slot, head_root, fork
+                )
+            except Exception:
+                continue
+            msg = T.SyncCommitteeMessage.make(
+                slot=slot,
+                beacon_block_root=head_root,
+                validator_index=vidx,
+                signature=sig,
+            )
+            try:
+                self.bn.publish_sync_message(msg)
+                self.published_sync_messages += 1
+            except Exception:
+                continue
+
+    def on_slot_two_thirds_sync(self, slot: int) -> None:
+        """Sync contribution-and-proof for sync aggregator duties."""
+        state = self.bn.head_state()
+        fork = state.fork
+        head_root = self.bn.head_root()
+        for pubkey, vidx in self._managed_validators(state).items():
+            for subcommittee in self.bn.sync_committee_positions(vidx):
+                # cheap check first: no contribution -> no signing work
+                contribution = self.bn.sync_contribution_for(
+                    slot, head_root, subcommittee
+                )
+                if contribution is None:
+                    continue
+                proof = self.store.sync_selection_proof(
+                    pubkey, slot, subcommittee, fork
+                )
+                if not self.bn.is_sync_aggregator(proof):
+                    continue
+                msg = T.ContributionAndProof.make(
+                    aggregator_index=vidx,
+                    contribution=contribution,
+                    selection_proof=proof,
+                )
+                sig = self.store.sign_contribution_and_proof(
+                    pubkey, msg, fork
+                )
+                signed = T.SignedContributionAndProof.make(
+                    message=msg, signature=sig
+                )
+                try:
+                    self.bn.publish_sync_contribution(signed)
+                    self.published_sync_contributions += 1
+                except Exception:
+                    pass
+
     def on_slot_two_thirds(self, slot: int) -> None:
         """Aggregate-and-proof publication for aggregator duties."""
         fork = self.bn.head_state().fork
@@ -217,7 +330,9 @@ class ValidatorClient:
                 pass  # e.g. another aggregator already observed
 
     def run_slot(self, slot: int) -> None:
-        """Drive all three phases for tests/simulators."""
+        """Drive all phases for tests/simulators."""
         self.on_slot_start(slot)
         self.on_slot_third(slot)
+        self.on_slot_third_sync(slot)
         self.on_slot_two_thirds(slot)
+        self.on_slot_two_thirds_sync(slot)

@@ -146,6 +146,43 @@ class ValidatorStore:
         )
         return self._signer(pubkey).sign(compute_signing_root(msg, domain)).to_bytes()
 
+    def sync_selection_proof(
+        self, pubkey: bytes, slot: int, subcommittee_index: int, fork
+    ) -> bytes:
+        """Sync-aggregator selection proof over
+        SyncAggregatorSelectionData."""
+        epoch = st.compute_epoch_at_slot(self.spec, slot)
+        domain = get_domain(
+            self.spec,
+            self.spec.domain_sync_committee_selection_proof,
+            epoch,
+            fork,
+            self.genesis_validators_root,
+        )
+        data = T.SyncAggregatorSelectionData.make(
+            slot=slot, subcommittee_index=subcommittee_index
+        )
+        return (
+            self._signer(pubkey)
+            .sign(compute_signing_root(data, domain))
+            .to_bytes()
+        )
+
+    def sign_contribution_and_proof(self, pubkey: bytes, msg, fork) -> bytes:
+        epoch = st.compute_epoch_at_slot(self.spec, msg.contribution.slot)
+        domain = get_domain(
+            self.spec,
+            self.spec.domain_contribution_and_proof,
+            epoch,
+            fork,
+            self.genesis_validators_root,
+        )
+        return (
+            self._signer(pubkey)
+            .sign(compute_signing_root(msg, domain))
+            .to_bytes()
+        )
+
     def sign_sync_committee_message(
         self, pubkey: bytes, slot: int, beacon_block_root: bytes, fork
     ) -> bytes:

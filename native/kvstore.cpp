@@ -139,7 +139,17 @@ int kv_put(void* handle, const char* col, uint32_t col_len, const char* key,
             fwrite(key, 1, key_len, c->f) == key_len &&
             fwrite(val, 1, val_len, c->f) == val_len &&
             fflush(c->f) == 0;
-  if (!ok) return -1;
+  if (!ok) {
+    // a partial record MID-log would make reopen truncate everything
+    // after it — cut back to the pre-write offset so later acknowledged
+    // writes stay parseable
+    if (ftruncate(fileno(c->f), static_cast<off_t>(pos)) != 0) {
+      // can't restore invariants: drop the column, reopen from disk
+      fclose(c->f);
+      s->columns.erase(std::string(col, col_len));
+    }
+    return -1;
+  }
   c->index[std::string(key, key_len)] = {pos + 8 + key_len, val_len};
   return 0;
 }
@@ -176,10 +186,17 @@ int kv_delete(void* handle, const char* col, uint32_t col_len,
   if (c->index.find(k) == c->index.end()) return 0;
   uint32_t tomb = kTomb;
   fseek(c->f, 0, SEEK_END);
+  uint64_t pos = static_cast<uint64_t>(ftell(c->f));
   bool ok = fwrite(&key_len, 4, 1, c->f) == 1 &&
             fwrite(&tomb, 4, 1, c->f) == 1 &&
             fwrite(key, 1, key_len, c->f) == key_len && fflush(c->f) == 0;
-  if (!ok) return -1;
+  if (!ok) {
+    if (ftruncate(fileno(c->f), static_cast<off_t>(pos)) != 0) {
+      fclose(c->f);
+      s->columns.erase(std::string(col, col_len));
+    }
+    return -1;
+  }
   c->index.erase(k);
   return 0;
 }
