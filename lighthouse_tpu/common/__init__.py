@@ -1,1 +1,4 @@
-"""Cross-cutting commons (SURVEY.md §2.6 LX): slot clock, metrics."""
+"""Cross-cutting commons (SURVEY.md §2.6 LX): slot clock, metrics,
+task executor + shutdown plumbing, logging layer, LRU caches, typed
+REST client, built-in network configs, system health, monitoring
+pusher, lockfiles, sensitive URLs, validator directory layout."""

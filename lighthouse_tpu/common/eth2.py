@@ -1,0 +1,168 @@
+"""Typed HTTP client for the beacon REST API (common/eth2 analog).
+
+The reference's `eth2` crate is the one typed client every out-of-
+process consumer shares — the VC, `watch`, the simulator, validator_
+manager. This is the same role against `node/http_api.py`'s routes:
+each method is one endpoint, JSON decoded into plain values, SSZ
+endpoints returned as bytes, errors surfaced as ``ApiClientError`` with
+the status code (eth2/src/lib.rs `Error::StatusCode`).
+
+Network I/O is stdlib urllib — no framework — and every method takes a
+per-call timeout so the VC fallback layer can health-rank nodes.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+from typing import Optional
+
+from .sensitive_url import SensitiveUrl
+
+
+class ApiClientError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(f"HTTP {status}: {message}")
+        self.status = status
+
+
+class BeaconNodeHttpClient:
+    """Typed client over one BN's REST listener."""
+
+    def __init__(self, base_url: str, timeout: float = 5.0):
+        self.url = SensitiveUrl(base_url)
+        self._base = base_url.rstrip("/")
+        self.timeout = timeout
+
+    def __repr__(self):
+        return f"BeaconNodeHttpClient({self.url})"
+
+    # ------------------------------------------------------------ plumbing
+
+    def _request(
+        self,
+        method: str,
+        path: str,
+        body: Optional[bytes] = None,
+        content_type: str = "application/octet-stream",
+        timeout: Optional[float] = None,
+        accept: Optional[str] = None,
+    ) -> tuple[int, bytes]:
+        req = urllib.request.Request(
+            self._base + path, data=body, method=method
+        )
+        if body is not None:
+            req.add_header("Content-Type", content_type)
+        if accept is not None:
+            req.add_header("Accept", accept)
+        try:
+            with urllib.request.urlopen(
+                req, timeout=timeout or self.timeout
+            ) as resp:
+                return resp.status, resp.read()
+        except urllib.error.HTTPError as e:
+            raise ApiClientError(e.code, e.read().decode(errors="replace"))
+        except (urllib.error.URLError, OSError) as e:
+            raise ApiClientError(0, f"connection failed: {e}")
+
+    def _get_json(self, path: str, timeout: Optional[float] = None) -> dict:
+        _, raw = self._request("GET", path, timeout=timeout)
+        return json.loads(raw)
+
+    # ------------------------------------------------------------ node
+
+    def node_health(self) -> bool:
+        try:
+            status, _ = self._request("GET", "/eth/v1/node/health")
+            return status == 200
+        except ApiClientError:
+            return False
+
+    def node_version(self) -> str:
+        return self._get_json("/eth/v1/node/version")["data"]["version"]
+
+    def node_syncing(self) -> dict:
+        d = self._get_json("/eth/v1/node/syncing")["data"]
+        return {
+            "head_slot": int(d["head_slot"]),
+            "sync_distance": int(d["sync_distance"]),
+            "is_syncing": bool(d["is_syncing"]),
+        }
+
+    # ------------------------------------------------------------ beacon
+
+    def genesis(self) -> dict:
+        d = self._get_json("/eth/v1/beacon/genesis")["data"]
+        return {
+            "genesis_time": int(d["genesis_time"]),
+            "genesis_validators_root": bytes.fromhex(
+                d["genesis_validators_root"][2:]
+            ),
+        }
+
+    def header(self, block_id: str = "head") -> dict:
+        d = self._get_json(f"/eth/v1/beacon/headers/{block_id}")["data"]
+        msg = d["header"]["message"]
+        return {
+            "root": bytes.fromhex(d["root"][2:]),
+            "slot": int(msg["slot"]),
+            "proposer_index": int(msg["proposer_index"]),
+            "parent_root": bytes.fromhex(msg["parent_root"][2:]),
+            "state_root": bytes.fromhex(msg["state_root"][2:]),
+        }
+
+    def block_ssz(self, block_id: str = "head") -> bytes:
+        _, raw = self._request(
+            "GET",
+            f"/eth/v1/beacon/blocks/{block_id}",
+            accept="application/octet-stream",
+        )
+        return raw
+
+    def finality_checkpoints(self, state_id: str = "head") -> dict:
+        d = self._get_json(
+            f"/eth/v1/beacon/states/{state_id}/finality_checkpoints"
+        )["data"]
+
+        def cp(x):
+            return (int(x["epoch"]), bytes.fromhex(x["root"][2:]))
+
+        return {
+            "previous_justified": cp(d["previous_justified"]),
+            "current_justified": cp(d["current_justified"]),
+            "finalized": cp(d["finalized"]),
+        }
+
+    def validator(self, index: int, state_id: str = "head") -> dict:
+        d = self._get_json(
+            f"/eth/v1/beacon/states/{state_id}/validators/{index}"
+        )["data"]
+        return {
+            "index": int(d["index"]),
+            "balance": int(d["balance"]),
+            "pubkey": bytes.fromhex(d["validator"]["pubkey"][2:]),
+            "effective_balance": int(d["validator"]["effective_balance"]),
+            "slashed": bool(d["validator"]["slashed"]),
+        }
+
+    def proposer_duties(self, epoch: int) -> list:
+        data = self._get_json(f"/eth/v1/validator/duties/proposer/{epoch}")[
+            "data"
+        ]
+        return [
+            {
+                "pubkey": bytes.fromhex(d["pubkey"][2:]),
+                "validator_index": int(d["validator_index"]),
+                "slot": int(d["slot"]),
+            }
+            for d in data
+        ]
+
+    # ------------------------------------------------------------ publish
+
+    def publish_attestation_ssz(self, ssz: bytes) -> None:
+        self._request("POST", "/eth/v1/beacon/pool/attestations", body=ssz)
+
+    def publish_block_ssz(self, ssz: bytes) -> None:
+        self._request("POST", "/eth/v1/beacon/blocks", body=ssz)

@@ -1,0 +1,77 @@
+"""PID lockfiles (common/lockfile analog).
+
+Guards datadirs and keystores against concurrent processes — double-
+running a VC on one slashing DB is how validators get slashed. Mirrors
+Lockfile::new semantics (common/lockfile/src/lib.rs): acquiring writes
+our PID; a lockfile from a dead process is stale and reclaimable.
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+
+
+class LockfileError(Exception):
+    pass
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+    return True
+
+
+class Lockfile:
+    def __init__(self, path):
+        self.path = Path(path)
+        self._acquired = False
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        # O_EXCL-first: only on EEXIST do we examine staleness, and the
+        # unlink-then-retry loop means two racers can never both win —
+        # exactly one O_EXCL create succeeds per unlink.
+        for _ in range(3):
+            try:
+                fd = os.open(self.path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            except FileExistsError:
+                try:
+                    other = int(self.path.read_text().strip() or "0")
+                except (ValueError, OSError):
+                    other = 0
+                if other and _pid_alive(other):
+                    raise LockfileError(
+                        f"{self.path} is held by live pid {other}"
+                    )
+                try:  # stale — reclaim and retry the exclusive create
+                    self.path.unlink()
+                except FileNotFoundError:
+                    pass
+                continue
+            try:
+                os.write(fd, str(os.getpid()).encode())
+            finally:
+                os.close(fd)
+            self._acquired = True
+            return
+        raise LockfileError(f"could not acquire {self.path} (create races)")
+
+    def release(self) -> None:
+        if self._acquired and self.path.exists():
+            self.path.unlink()
+        self._acquired = False
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+
+    def __del__(self):
+        try:
+            self.release()
+        except OSError:
+            pass

@@ -1,0 +1,149 @@
+"""Structured logging layer (common/logging analog, SURVEY.md §5.5).
+
+The reference builds on tracing/slog: component-scoped loggers, a
+human-readable terminal format, an optional JSON file drain, and an
+SSE_LOGGING_COMPONENTS ring buffer the HTTP API can stream. The analog
+here wraps stdlib logging with:
+
+  * ``get_logger(component)``  — component-scoped logger ("beacon_chain",
+    "network", ...) under one "lighthouse_tpu" root
+  * key=value structured fields: ``log.info("imported block", slot=5)``
+  * ``init(level, json_path)`` — process-wide once-only setup
+  * ``SSEDrain``               — bounded ring buffer of recent records,
+    drained by the HTTP API's event stream (logging/src/sse_logging_components.rs)
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import logging
+import threading
+import time
+from typing import Optional
+
+_ROOT = "lighthouse_tpu"
+_initialized = False
+_lock = threading.Lock()
+
+
+class _KvAdapter(logging.LoggerAdapter):
+    """key=value structured fields appended slog-style."""
+
+    def process(self, msg, kwargs):
+        extra_fields = {
+            k: v for k, v in kwargs.items()
+            if k not in ("exc_info", "stack_info", "stacklevel", "extra")
+        }
+        for k in extra_fields:
+            kwargs.pop(k)
+        if extra_fields:
+            rendered = ", ".join(f"{k}: {_fmt(v)}" for k, v in extra_fields.items())
+            msg = f"{msg}  {rendered}"
+        kwargs.setdefault("extra", {})["fields"] = extra_fields
+        return msg, kwargs
+
+
+def _fmt(v) -> str:
+    if isinstance(v, (bytes, bytearray)):
+        return "0x" + bytes(v).hex()
+    return str(v)
+
+
+def get_logger(component: str) -> _KvAdapter:
+    return _KvAdapter(logging.getLogger(f"{_ROOT}.{component}"), {})
+
+
+class JsonHandler(logging.Handler):
+    """JSON-lines file drain (logging's `--logfile-format JSON` role)."""
+
+    def __init__(self, path: str):
+        super().__init__()
+        self._f = open(path, "a", buffering=1)
+
+    def emit(self, record):
+        entry = {
+            "ts": time.time(),
+            "level": record.levelname,
+            "component": record.name.removeprefix(_ROOT + "."),
+            "msg": record.getMessage(),
+        }
+        self._f.write(json.dumps(entry) + "\n")
+
+    def close(self):
+        self._f.close()
+        super().close()
+
+
+class SSEDrain(logging.Handler):
+    """Bounded ring buffer of recent records for the API event stream."""
+
+    def __init__(self, capacity: int = 512):
+        super().__init__()
+        self._buf = collections.deque(maxlen=capacity)
+        self._cv = threading.Condition()
+        self._seq = 0
+
+    def emit(self, record):
+        entry = {
+            "seq": None,
+            "ts": time.time(),
+            "level": record.levelname,
+            "component": record.name.removeprefix(_ROOT + "."),
+            "msg": record.getMessage(),
+        }
+        with self._cv:
+            self._seq += 1
+            entry["seq"] = self._seq
+            self._buf.append(entry)
+            self._cv.notify_all()
+
+    def drain_since(self, seq: int) -> list:
+        with self._cv:
+            return [e for e in self._buf if e["seq"] > seq]
+
+    def wait_for(self, seq: int, timeout: float) -> list:
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while True:
+                fresh = [e for e in self._buf if e["seq"] > seq]
+                if fresh:
+                    return fresh
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return []
+                self._cv.wait(remaining)
+
+
+def init(
+    level: str = "INFO",
+    json_path: Optional[str] = None,
+    sse: Optional[SSEDrain] = None,
+) -> None:
+    """Process-wide setup; safe to call more than once (first wins for
+    the terminal handler, later calls can still attach drains)."""
+    global _initialized
+    root = logging.getLogger(_ROOT)
+    root.setLevel(getattr(logging, level.upper(), logging.INFO))
+    with _lock:
+        if not _initialized:
+            h = logging.StreamHandler()
+            h.setFormatter(
+                logging.Formatter(
+                    "%(asctime)s %(levelname)-5s %(name)s  %(message)s",
+                    datefmt="%H:%M:%S",
+                )
+            )
+            root.addHandler(h)
+            root.propagate = False
+            _initialized = True
+        # Drain attachment is idempotent: re-initializing with the same
+        # json path or SSE drain must not double-write every record.
+        if json_path is not None and json_path not in {
+            getattr(h, "_json_path", None) for h in root.handlers
+        }:
+            jh = JsonHandler(json_path)
+            jh._json_path = json_path
+            root.addHandler(jh)
+        if sse is not None and sse not in root.handlers:
+            root.addHandler(sse)

@@ -1,0 +1,90 @@
+"""LRU caches (common/lru_cache analog).
+
+Two shapes the reference uses throughout the network stack
+(common/lru_cache/src/{space,time}.rs):
+
+  * ``LRUCache(capacity)``   — space-bounded insert/contains set
+  * ``LRUTimeCache(ttl)``    — time-bounded dedup set (gossip seen-sets,
+                               peer-action dedup); entries expire after
+                               ``ttl`` seconds
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+from typing import Hashable, Iterator, Optional
+
+
+class LRUCache:
+    """Space-bounded LRU membership set with optional values."""
+
+    def __init__(self, capacity: int):
+        if capacity <= 0:
+            raise ValueError("capacity must be positive")
+        self.capacity = capacity
+        self._map: "collections.OrderedDict[Hashable, object]" = (
+            collections.OrderedDict()
+        )
+
+    def insert(self, key: Hashable, value: object = True) -> None:
+        if key in self._map:
+            self._map.move_to_end(key)
+        self._map[key] = value
+        if len(self._map) > self.capacity:
+            self._map.popitem(last=False)
+
+    def get(self, key: Hashable) -> Optional[object]:
+        v = self._map.get(key)
+        if v is not None:
+            self._map.move_to_end(key)
+        return v
+
+    def __contains__(self, key: Hashable) -> bool:
+        return key in self._map
+
+    def __len__(self) -> int:
+        return len(self._map)
+
+    def __iter__(self) -> Iterator[Hashable]:
+        return iter(self._map)
+
+
+class LRUTimeCache:
+    """Time-bounded seen-set: ``insert`` returns True when novel.
+
+    Mirrors LRUTimeCache::raw_insert semantics — re-inserting refreshes
+    the expiry; expired entries are pruned lazily on access.
+    """
+
+    def __init__(self, ttl_seconds: float, clock=time.monotonic):
+        self.ttl = ttl_seconds
+        self._clock = clock
+        self._expiry: "collections.OrderedDict[Hashable, float]" = (
+            collections.OrderedDict()
+        )
+
+    def _prune(self, now: float) -> None:
+        while self._expiry:
+            key, exp = next(iter(self._expiry.items()))
+            if exp > now:
+                break
+            self._expiry.popitem(last=False)
+
+    def insert(self, key: Hashable) -> bool:
+        now = self._clock()
+        self._prune(now)
+        novel = key not in self._expiry
+        if not novel:
+            del self._expiry[key]
+        self._expiry[key] = now + self.ttl
+        return novel
+
+    def __contains__(self, key: Hashable) -> bool:
+        now = self._clock()
+        self._prune(now)
+        return key in self._expiry
+
+    def __len__(self) -> int:
+        self._prune(self._clock())
+        return len(self._expiry)

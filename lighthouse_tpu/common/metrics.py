@@ -47,6 +47,14 @@ class Gauge:
         with _LOCK:
             self.value = v
 
+    def inc(self, amount: float = 1.0):
+        with _LOCK:
+            self.value += amount
+
+    def dec(self, amount: float = 1.0):
+        with _LOCK:
+            self.value -= amount
+
     def render(self) -> str:
         return (
             f"# HELP {self.name} {self.help}\n# TYPE {self.name} gauge\n"

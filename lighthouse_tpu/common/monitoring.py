@@ -1,0 +1,83 @@
+"""Remote metrics pusher (common/monitoring_api analog).
+
+The reference POSTs a JSON snapshot of process/beacon/validator metrics
+to a remote monitoring endpoint every 60s (monitoring_api/src/lib.rs).
+Same shape here: a MonitoringService thread that gathers system health
+plus a caller-provided process snapshot and POSTs it; failures are
+logged and retried on the next tick, never fatal.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.request
+from typing import Callable, Optional
+
+from . import logging as common_logging
+from . import system_health
+from .sensitive_url import SensitiveUrl
+
+log = common_logging.get_logger("monitoring")
+
+VERSION = 1
+DEFAULT_UPDATE_PERIOD = 60.0
+
+
+class MonitoringService:
+    def __init__(
+        self,
+        endpoint: str,
+        process_fn: Callable[[], dict],
+        process_name: str = "beaconnode",
+        period: float = DEFAULT_UPDATE_PERIOD,
+        datadir: str = ".",
+    ):
+        self.endpoint_url = SensitiveUrl(endpoint)
+        self._endpoint = endpoint
+        self.process_fn = process_fn
+        self.process_name = process_name
+        self.period = period
+        self.datadir = datadir
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def snapshot(self) -> list:
+        """The payload: [system metrics, process metrics] (lib.rs
+        MonitoringMetrics pair)."""
+        sys_metrics = system_health.observe(self.datadir)
+        sys_metrics.update({"version": VERSION, "process": "system"})
+        proc = dict(self.process_fn())
+        proc.update({"version": VERSION, "process": self.process_name})
+        return [sys_metrics, proc]
+
+    def send(self) -> bool:
+        body = json.dumps(self.snapshot()).encode()
+        req = urllib.request.Request(
+            self._endpoint,
+            data=body,
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                return 200 <= resp.status < 300
+        except OSError as e:
+            log.warning(
+                "monitoring push failed", endpoint=str(self.endpoint_url),
+                error=str(e),
+            )
+            return False
+
+    def start(self) -> None:
+        def loop():
+            while not self._stop.wait(self.period):
+                self.send()
+
+        self._thread = threading.Thread(
+            target=loop, name="monitoring", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()

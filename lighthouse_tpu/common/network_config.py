@@ -1,0 +1,173 @@
+"""Built-in network configurations (common/eth2_network_config analog).
+
+The reference embeds five networks' YAML configs + genesis state blobs
+(common/eth2_network_config/built_in_network_configs/{mainnet,gnosis,
+sepolia,holesky,chiado}). Here each network is a ChainSpec constructor:
+fork schedule, deposit contract, timing — the constants a node needs to
+join that network. Genesis *states* are not embedded (they come from
+checkpoint sync or the deposit follower, as in the reference's
+`genesis_state_url` flow).
+
+Values are the public network parameters. Where a network's electra
+epoch was not yet scheduled at survey time it is FAR_FUTURE_EPOCH.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from ..consensus.spec import (
+    FAR_FUTURE_EPOCH,
+    ChainSpec,
+    MAINNET_PRESET,
+    MINIMAL_PRESET,
+)
+
+HARDCODED_NETS = ["mainnet", "minimal", "sepolia", "holesky", "gnosis", "chiado"]
+
+
+def _versions(prefix: bytes, count: int = 6) -> dict:
+    names = ["phase0", "altair", "bellatrix", "capella", "deneb", "electra"]
+    return {
+        name: bytes([i]) + prefix for i, name in enumerate(names[:count])
+    }
+
+
+# Gnosis is the reference's third compile-time EthSpec (eth_spec.rs
+# gnosis preset): 16-slot epochs, 5s slots, its own reward/churn curve.
+GNOSIS_PRESET = dataclasses.replace(
+    MAINNET_PRESET,
+    name="gnosis",
+    slots_per_epoch=16,
+    epochs_per_sync_committee_period=512,
+)
+
+
+def _mainnet() -> ChainSpec:
+    # ChainSpec's defaults ARE mainnet (single source of truth —
+    # consensus/spec.py); only the fixed genesis root is network data.
+    spec = ChainSpec()
+    spec.config_name = "mainnet"
+    spec.genesis_validators_root = bytes.fromhex(
+        "4b363db94e286120d76eb905340fdd4e54bfe9f06bf33ff6cf5ad27f511bfe95"
+    )
+    return spec
+
+
+def _sepolia() -> ChainSpec:
+    spec = ChainSpec()
+    spec.config_name = "sepolia"
+    spec.genesis_fork_version = bytes.fromhex("90000069")
+    spec.fork_versions = {
+        "phase0": bytes.fromhex("90000069"),
+        "altair": bytes.fromhex("90000070"),
+        "bellatrix": bytes.fromhex("90000071"),
+        "capella": bytes.fromhex("90000072"),
+        "deneb": bytes.fromhex("90000073"),
+        "electra": bytes.fromhex("90000074"),
+    }
+    spec.fork_epochs = {
+        "phase0": 0,
+        "altair": 50,
+        "bellatrix": 100,
+        "capella": 56832,
+        "deneb": 132608,
+        "electra": 222464,
+    }
+    spec.min_genesis_time = 1655647200
+    spec.min_genesis_active_validator_count = 1300
+    spec.deposit_chain_id = 11155111
+    spec.deposit_contract_address = "0x7f02C3E3c98b133055B8B348B2Ac625669Ed295D"
+    return spec
+
+
+def _holesky() -> ChainSpec:
+    spec = ChainSpec()
+    spec.config_name = "holesky"
+    spec.genesis_fork_version = bytes.fromhex("01017000")
+    spec.fork_versions = {
+        "phase0": bytes.fromhex("01017000"),
+        "altair": bytes.fromhex("02017000"),
+        "bellatrix": bytes.fromhex("03017000"),
+        "capella": bytes.fromhex("04017000"),
+        "deneb": bytes.fromhex("05017000"),
+        "electra": bytes.fromhex("06017000"),
+    }
+    spec.fork_epochs = {
+        "phase0": 0,
+        "altair": 0,
+        "bellatrix": 0,
+        "capella": 256,
+        "deneb": 29696,
+        "electra": 115968,
+    }
+    spec.min_genesis_time = 1695902100
+    spec.deposit_chain_id = 17000
+    spec.deposit_contract_address = "0x4242424242424242424242424242424242424242"
+    return spec
+
+
+def _gnosis() -> ChainSpec:
+    spec = ChainSpec(preset=GNOSIS_PRESET, config_name="gnosis")
+    spec.seconds_per_slot = 5
+    spec.genesis_fork_version = bytes.fromhex("00000064")
+    spec.fork_versions = _versions(bytes.fromhex("000064"))
+    spec.fork_epochs = {
+        "phase0": 0,
+        "altair": 512,
+        "bellatrix": 385536,
+        "capella": 648704,
+        "deneb": 889856,
+        "electra": FAR_FUTURE_EPOCH,
+    }
+    spec.min_genesis_time = 1638968400
+    spec.base_reward_factor = 25
+    spec.churn_limit_quotient = 4096
+    spec.deposit_chain_id = 100
+    spec.deposit_contract_address = "0x0B98057eA310F4d31F2a452B414647007d1645d9"
+    return spec
+
+
+def _chiado() -> ChainSpec:
+    spec = ChainSpec(preset=GNOSIS_PRESET, config_name="chiado")
+    spec.seconds_per_slot = 5
+    spec.genesis_fork_version = bytes.fromhex("0000006f")
+    spec.fork_versions = _versions(bytes.fromhex("00006f"))
+    spec.fork_epochs = {
+        "phase0": 0,
+        "altair": 90,
+        "bellatrix": 180,
+        "capella": 244224,
+        "deneb": 516608,
+        "electra": FAR_FUTURE_EPOCH,
+    }
+    spec.min_genesis_time = 1665396000
+    spec.base_reward_factor = 25
+    spec.churn_limit_quotient = 4096
+    spec.deposit_chain_id = 10200
+    spec.deposit_contract_address = "0xb97036A26259B7147018913bD58a774cf91acf25"
+    return spec
+
+
+def _minimal() -> ChainSpec:
+    return ChainSpec(preset=MINIMAL_PRESET, config_name="minimal")
+
+
+_BUILDERS = {
+    "mainnet": _mainnet,
+    "minimal": _minimal,
+    "sepolia": _sepolia,
+    "holesky": _holesky,
+    "gnosis": _gnosis,
+    "chiado": _chiado,
+}
+
+
+def spec_for_network(name: str) -> ChainSpec:
+    """Eth2NetworkConfig::constant(name) → ChainSpec."""
+    try:
+        return _BUILDERS[name]()
+    except KeyError:
+        raise ValueError(
+            f"unknown network {name!r}; built-ins: {HARDCODED_NETS}"
+        ) from None

@@ -1,0 +1,42 @@
+"""Credential-redacting URL wrapper (common/sensitive_url analog).
+
+Engine-API and web3signer endpoints carry secrets in userinfo or paths;
+the reference's SensitiveUrl Display-redacts so logs/metrics can never
+leak them (common/sensitive_url/src/lib.rs).
+"""
+
+from __future__ import annotations
+
+from urllib.parse import urlparse, urlunparse
+
+
+class SensitiveError(ValueError):
+    pass
+
+
+class SensitiveUrl:
+    def __init__(self, url: str):
+        parsed = urlparse(url)
+        if parsed.scheme not in ("http", "https"):
+            raise SensitiveError(f"unsupported scheme in {self.__class__.__name__}")
+        if not parsed.hostname:
+            raise SensitiveError("URL has no host")
+        self.full = url
+        # Redacted form: scheme://host:port/ with userinfo, path, query
+        # and fragment stripped (lib.rs `to_string` behavior).
+        netloc = parsed.hostname
+        if parsed.port:
+            netloc += f":{parsed.port}"
+        self.redacted = urlunparse((parsed.scheme, netloc, "/", "", "", ""))
+
+    def __str__(self) -> str:
+        return self.redacted
+
+    def __repr__(self) -> str:
+        return f"SensitiveUrl({self.redacted})"
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, SensitiveUrl) and other.full == self.full
+
+    def __hash__(self) -> int:
+        return hash(self.full)

@@ -1,0 +1,62 @@
+"""Host health observations (common/system_health analog).
+
+The reference samples sysinfo for the `/lighthouse/ui/health` endpoint
+and the monitoring pusher. Here: /proc + os.statvfs, no dependencies.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+
+def _meminfo() -> dict:
+    out = {}
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                k, _, rest = line.partition(":")
+                out[k.strip()] = int(rest.strip().split()[0]) * 1024
+    except OSError:
+        pass
+    return out
+
+
+def _cpu_times() -> tuple:
+    try:
+        with open("/proc/stat") as f:
+            first = f.readline().split()
+        vals = [int(x) for x in first[1:]]
+        idle = vals[3] + (vals[4] if len(vals) > 4 else 0)
+        return sum(vals), idle
+    except OSError:
+        return 0, 0
+
+
+def observe(datadir: str = ".") -> dict:
+    """One SystemHealth observation (system_health::observe_system_health)."""
+    mem = _meminfo()
+    total, idle = _cpu_times()
+    try:
+        load1, load5, load15 = os.getloadavg()
+    except OSError:
+        load1 = load5 = load15 = 0.0
+    try:
+        st = os.statvfs(datadir)
+        disk_total = st.f_blocks * st.f_frsize
+        disk_free = st.f_bavail * st.f_frsize
+    except OSError:
+        disk_total = disk_free = 0
+    return {
+        "observed_at": time.time(),
+        "sys_virt_mem_total": mem.get("MemTotal", 0),
+        "sys_virt_mem_available": mem.get("MemAvailable", 0),
+        "sys_loadavg_1": load1,
+        "sys_loadavg_5": load5,
+        "sys_loadavg_15": load15,
+        "cpu_time_total": total,
+        "cpu_time_idle": idle,
+        "disk_node_bytes_total": disk_total,
+        "disk_node_bytes_free": disk_free,
+        "host_cpu_count": os.cpu_count() or 0,
+    }

@@ -1,0 +1,133 @@
+"""Task executor + shutdown plumbing (common/task_executor analog).
+
+The reference wraps a tokio handle with spawn/spawn_blocking, a named
+task metric per spawn, and an exit/shutdown broadcast every long-lived
+service listens on (common/task_executor/src/lib.rs; SURVEY.md §2.6,
+§5.5).  The TPU build's services are Python threads (the device work is
+batched inside JAX, not spread across an async runtime), so the analog
+is a thread-spawning executor with the same three capabilities:
+
+  * ``spawn(fn, name)``         — long-lived service task (daemon thread)
+  * ``spawn_blocking(fn, name)``— bounded worker-pool task returning a
+                                   Future (blst-rayon role; here feeds
+                                   host-side prep off the hot path)
+  * ``shutdown_signal()``       — every task can watch one Event; a
+                                   failed critical task can request
+                                   process shutdown with a reason, the
+                                   ``environment`` CLI layer observes it
+
+Metrics: ``async_tasks_count`` gauge over live service + pool tasks and
+an ``executor_spawns_total`` counter — the reference's TASKS_HISTOGRAM
+observability posture.
+"""
+
+from __future__ import annotations
+
+import threading
+import traceback
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Callable, Optional
+
+from . import metrics
+
+_TASKS_GAUGE = metrics.gauge(
+    "async_tasks_count", "Number of live executor tasks"
+)
+_SPAWNS = metrics.counter(
+    "executor_spawns_total", "Tasks ever spawned on the executor"
+)
+
+
+class ShutdownReason:
+    """Why the process is going down (task_executor ShutdownReason)."""
+
+    def __init__(self, message: str, failure: bool):
+        self.message = message
+        self.failure = failure
+
+    def __repr__(self):
+        kind = "Failure" if self.failure else "Success"
+        return f"ShutdownReason::{kind}({self.message!r})"
+
+
+class TaskExecutor:
+    """Spawns named service threads + blocking pool work, and carries
+    the process-wide shutdown broadcast (oneshot_broadcast role)."""
+
+    def __init__(self, blocking_workers: int = 4, name: str = "node"):
+        self.name = name
+        self._threads: list[threading.Thread] = []
+        self._pool = ThreadPoolExecutor(
+            max_workers=blocking_workers,
+            thread_name_prefix=f"{name}-blocking",
+        )
+        self._shutdown = threading.Event()
+        self._shutdown_reason: Optional[ShutdownReason] = None
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------ spawn
+
+    def spawn(self, fn: Callable[[], None], name: str) -> threading.Thread:
+        """Long-lived service task. Uncaught exceptions trigger a
+        failure shutdown (the reference logs + optionally exits; our
+        services are critical by construction)."""
+        _SPAWNS.inc()
+
+        def runner():
+            _TASKS_GAUGE.inc()
+            try:
+                fn()
+            except Exception as exc:  # noqa: BLE001 — boundary
+                traceback.print_exc()
+                self.request_shutdown(
+                    ShutdownReason(f"task {name!r} failed: {exc}", True)
+                )
+            finally:
+                _TASKS_GAUGE.dec()
+
+        t = threading.Thread(target=runner, name=f"{self.name}-{name}", daemon=True)
+        with self._lock:
+            self._threads.append(t)
+        t.start()
+        return t
+
+    def spawn_blocking(self, fn: Callable, name: str, *args, **kwargs) -> Future:
+        """CPU-bound work on the bounded pool; returns a Future."""
+        _SPAWNS.inc()
+
+        def tracked():
+            _TASKS_GAUGE.inc()
+            try:
+                return fn(*args, **kwargs)
+            finally:
+                _TASKS_GAUGE.dec()
+
+        return self._pool.submit(tracked)
+
+    # --------------------------------------------------------- shutdown
+
+    def shutdown_signal(self) -> threading.Event:
+        return self._shutdown
+
+    def request_shutdown(self, reason: ShutdownReason) -> None:
+        with self._lock:
+            if self._shutdown_reason is None:
+                self._shutdown_reason = reason
+        self._shutdown.set()
+
+    @property
+    def shutdown_reason(self) -> Optional[ShutdownReason]:
+        return self._shutdown_reason
+
+    def wait_shutdown(self, timeout: Optional[float] = None) -> Optional[ShutdownReason]:
+        self._shutdown.wait(timeout)
+        return self._shutdown_reason
+
+    def close(self, timeout: float = 5.0) -> None:
+        """Drain: signal shutdown, join services, stop the pool."""
+        self.request_shutdown(ShutdownReason("executor closed", False))
+        with self._lock:
+            threads = list(self._threads)
+        for t in threads:
+            t.join(timeout=timeout)
+        self._pool.shutdown(wait=False, cancel_futures=True)

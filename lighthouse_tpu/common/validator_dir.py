@@ -1,0 +1,96 @@
+"""On-disk validator directory layout (common/validator_dir +
+common/account_utils analog).
+
+The reference's layout the VC's keystore discovery walks
+(validator_dir/src/{builder,validator_dir}.rs, account_utils):
+
+    <validators>/0x<pubkey>/voting-keystore.json
+    <secrets>/0x<pubkey>              (password file, 0600)
+
+`ValidatorDirBuilder` writes a freshly-encrypted EIP-2335 keystore +
+password pair; `list_validator_dirs`/`load_keystore` is the discovery
+path `initialized_validators` consumes.
+"""
+
+from __future__ import annotations
+
+import os
+import secrets as _secrets
+import string
+from pathlib import Path
+from typing import Iterator, Optional
+
+from ..crypto.keystore.keystore import Keystore
+
+VOTING_KEYSTORE_FILE = "voting-keystore.json"
+LOCKFILE_NAME = "voting-keystore.json.lock"
+DEFAULT_PASSWORD_LEN = 48
+
+
+def random_password(length: int = DEFAULT_PASSWORD_LEN) -> str:
+    alphabet = string.ascii_letters + string.digits
+    return "".join(_secrets.choice(alphabet) for _ in range(length))
+
+
+class ValidatorDirError(Exception):
+    pass
+
+
+def create_validator_dir(
+    validators_dir,
+    secrets_dir,
+    secret_key,
+    password: Optional[str] = None,
+    path: str = "",
+    scrypt_n: int = 262144,
+) -> Path:
+    """ValidatorDirBuilder::build — write keystore + secret, 0600/0700.
+
+    ``secret_key`` is a crypto.bls SecretKey (or an int scalar).
+    """
+    from ..crypto.bls.keys import SecretKey
+
+    if isinstance(secret_key, int):
+        secret_key = SecretKey(secret_key)
+    validators_dir = Path(validators_dir)
+    secrets_dir = Path(secrets_dir)
+    password = password or random_password()
+    ks = Keystore.encrypt(secret_key, password, path=path, scrypt_n=scrypt_n)
+    name = "0x" + ks.pubkey.hex()
+    vdir = validators_dir / name
+    if vdir.exists():
+        raise ValidatorDirError(f"validator dir exists: {vdir}")
+    vdir.mkdir(parents=True)
+    os.chmod(vdir, 0o700)
+    (vdir / VOTING_KEYSTORE_FILE).write_text(ks.to_json())
+    secrets_dir.mkdir(parents=True, exist_ok=True)
+    secret_path = secrets_dir / name
+    fd = os.open(secret_path, os.O_CREAT | os.O_WRONLY | os.O_TRUNC, 0o600)
+    try:
+        os.write(fd, password.encode())
+    finally:
+        os.close(fd)
+    return vdir
+
+
+def list_validator_dirs(validators_dir) -> Iterator[Path]:
+    """Directories that look like validators (have a voting keystore)."""
+    validators_dir = Path(validators_dir)
+    if not validators_dir.exists():
+        return
+    for entry in sorted(validators_dir.iterdir()):
+        if entry.is_dir() and (entry / VOTING_KEYSTORE_FILE).exists():
+            yield entry
+
+
+def load_keystore(validator_dir) -> Keystore:
+    raw = (Path(validator_dir) / VOTING_KEYSTORE_FILE).read_text()
+    return Keystore.from_json(raw)
+
+
+def read_password(secrets_dir, pubkey: bytes) -> str:
+    """account_utils::read_password — the per-pubkey secret file."""
+    p = Path(secrets_dir) / ("0x" + pubkey.hex())
+    if not p.exists():
+        raise ValidatorDirError(f"no password file {p}")
+    return p.read_text().strip()

@@ -140,7 +140,7 @@ class ChainSpec:
     preset: Preset = MAINNET_PRESET
     config_name: str = "mainnet"
     seconds_per_slot: int = 12
-    min_genesis_time: int = 0
+    min_genesis_time: int = 1606824000
     genesis_delay: int = 604800
     min_genesis_active_validator_count: int = 16384
     min_deposit_amount: int = 10**9
@@ -163,6 +163,12 @@ class ChainSpec:
     churn_limit_quotient: int = 65536
     proposer_score_boost: int = 40
     target_aggregators_per_committee: int = 16
+    # deposit contract (chain_spec.rs deposit_chain_id/_network_id/_contract)
+    deposit_chain_id: int = 1
+    deposit_contract_address: str = "0x00000000219ab540356cBB839Cbe05303d7705Fa"
+    # known genesis_validators_root for networks whose genesis is fixed
+    # (None until genesis is computed/synced)
+    genesis_validators_root: bytes = None
     # domain types (4-byte little-endian constants, spec values)
     domain_beacon_proposer: bytes = bytes.fromhex("00000000")
     domain_beacon_attester: bytes = bytes.fromhex("01000000")
@@ -195,7 +201,7 @@ class ChainSpec:
             "bellatrix": 144896,
             "capella": 194048,
             "deneb": 269568,
-            "electra": FAR_FUTURE_EPOCH,
+            "electra": 364032,
         }
     )
 
