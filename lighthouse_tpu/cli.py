@@ -2,12 +2,11 @@
 
 Subcommands:
   bn       — run a beacon node (interop genesis or resume from datadir)
+  vc       — run a validator-client process: keystore discovery,
+             keymanager API, multi-BN fallback health loop
   account  — wallet/keystore management (account_manager analog):
              wallet-create, validator-derive, keystore-inspect
   db       — store inspection (database_manager analog): summary
-
-(A standalone `vc` process arrives with the cross-process HTTP client;
-in-process validators run through lighthouse_tpu.validator today.)
 
 Run: python -m lighthouse_tpu.cli <subcommand> [flags]
 """
@@ -29,6 +28,12 @@ def _build_parser() -> argparse.ArgumentParser:
         default="mainnet",
         help="compile-time-style preset (eth_spec.rs presets)",
     )
+    p.add_argument(
+        "--network",
+        default=None,
+        help="built-in network config (mainnet/minimal/sepolia/holesky/"
+        "gnosis/chiado); overrides --preset",
+    )
     sub = p.add_subparsers(dest="command", required=True)
 
     bn = sub.add_parser("bn", help="beacon node")
@@ -40,6 +45,15 @@ def _build_parser() -> argparse.ArgumentParser:
                     help="resume the chain persisted in --datadir")
     bn.add_argument("--bls-backend", choices=["cpu", "tpu", "fake"],
                     default=None)
+
+    vc = sub.add_parser("vc", help="validator client")
+    vc.add_argument("--datadir", default="./vc-datadir")
+    vc.add_argument("--beacon-nodes", default="http://127.0.0.1:5052",
+                    help="comma-separated BN REST endpoints, primary first")
+    vc.add_argument("--http-port", type=int, default=5062,
+                    help="keymanager API port")
+    vc.add_argument("--graffiti-file", default=None)
+    vc.add_argument("--enable-doppelganger-protection", action="store_true")
 
     acct = sub.add_parser("account", help="wallet/keystore management")
     acct_sub = acct.add_subparsers(dest="account_cmd", required=True)
@@ -62,6 +76,10 @@ def _build_parser() -> argparse.ArgumentParser:
 def _spec(args):
     from .consensus.spec import mainnet_spec, minimal_spec
 
+    if getattr(args, "network", None):
+        from .common.network_config import spec_for_network
+
+        return spec_for_network(args.network)
     return mainnet_spec() if args.preset == "mainnet" else minimal_spec()
 
 
@@ -109,6 +127,153 @@ def cmd_bn(args) -> int:
         client.run()
     except KeyboardInterrupt:
         client.shutdown()
+    return 0
+
+
+def cmd_vc(args) -> int:
+    """The standalone VC process: discover + decrypt keystores, start
+    the keymanager API, health-rank the configured BNs, and (once the
+    fleet exposes duty endpoints cross-process) drive the services.
+    validator_client/src/lib.rs wiring analog."""
+    import time
+
+    from .common import logging as clog
+    from .common.eth2 import BeaconNodeHttpClient
+    from .common.lockfile import Lockfile
+    from .validator.beacon_node_fallback import BeaconNodeFallback
+    from .validator.http_api import KeymanagerApi, ValidatorApiServer
+    from .validator.initialized_validators import InitializedValidators
+    from .validator.slashing_protection import SlashingProtectionDB
+    from .validator.validator_store import ValidatorStore
+
+    clog.init("INFO")
+    log = clog.get_logger("vc")
+    spec = _spec(args)
+    os.makedirs(args.datadir, exist_ok=True)
+    lock = Lockfile(os.path.join(args.datadir, "vc.lock"))
+
+    class _HttpBN:
+        """Adapter: the fallback probes syncing_status on eth2 clients."""
+
+        def __init__(self, url):
+            self.client = BeaconNodeHttpClient(url)
+
+        def syncing_status(self):
+            return self.client.node_syncing()
+
+    urls = [u.strip() for u in args.beacon_nodes.split(",") if u.strip()]
+    fallback = BeaconNodeFallback.from_apis([_HttpBN(u) for u in urls])
+
+    genesis = {"genesis_time": None, "genesis_validators_root": b"\x00" * 32}
+
+    def _fetch_genesis():
+        try:
+            genesis.update(fallback.first_success(lambda bn: bn.client.genesis()))
+            return True
+        except Exception:
+            return False
+
+    if not _fetch_genesis():
+        log.warning("no beacon node reachable yet; starting anyway")
+
+    slashing_db = SlashingProtectionDB(
+        os.path.join(args.datadir, "slashing_protection.sqlite")
+    )
+    store = ValidatorStore(
+        spec, genesis["genesis_validators_root"], slashing_db=slashing_db
+    )
+    iv = InitializedValidators(
+        os.path.join(args.datadir, "validators"),
+        os.path.join(args.datadir, "secrets"),
+    )
+    iv.discover_local_keystores()
+
+    from .validator.doppelganger_service import (
+        DoppelgangerDetected,
+        DoppelgangerService,
+    )
+
+    def _liveness(epoch, indices):
+        return fallback.first_success(
+            lambda bn: bn.client.validator_liveness(epoch, indices)
+        )
+
+    def _index_of(pubkey):
+        try:
+            return fallback.first_success(
+                lambda bn: bn.client.validator_by_pubkey(pubkey)
+            )["index"]
+        except Exception:
+            return None  # not deposited yet → can't have a doppelganger
+
+    doppelganger = DoppelgangerService(store, _liveness, _index_of)
+    for method in iv.initialize().values():
+        store.add_validator(
+            method, doppelganger_hold=args.enable_doppelganger_protection
+        )
+        if args.enable_doppelganger_protection:
+            doppelganger.register(method.public_key_bytes())
+    log.info("validators initialized", count=len(store.pubkeys()))
+
+    graffiti, default_graffiti = {}, None
+    if args.graffiti_file:
+        from .validator.graffiti_file import GraffitiFile
+
+        gf = GraffitiFile(args.graffiti_file)
+        graffiti = {pk: g.decode(errors="replace").rstrip("\x00")
+                    for pk, g in gf.graffitis.items()}
+        if gf.default is not None:
+            default_graffiti = gf.default.decode(errors="replace").rstrip("\x00")
+
+    api = KeymanagerApi(
+        store,
+        iv,
+        genesis_validators_root=genesis["genesis_validators_root"],
+        graffiti_overrides=graffiti,
+        default_graffiti=default_graffiti,
+        doppelganger_protection=args.enable_doppelganger_protection,
+        doppelganger_service=doppelganger,
+    )
+    server = ValidatorApiServer(api, args.datadir, port=args.http_port)
+    server.start()
+    log.info("keymanager API up", port=server.port)
+    last_epoch_checked = -1
+    try:
+        while True:
+            fallback.update_all_candidates()
+            # a VC started before its BN must pick up the real genesis
+            # root once one appears — domains/interchange depend on it
+            if genesis["genesis_time"] is None and _fetch_genesis():
+                gvr = genesis["genesis_validators_root"]
+                store.genesis_validators_root = gvr
+                api.gvr = gvr
+                log.info("genesis fetched", root=gvr)
+            if genesis["genesis_time"] is not None:
+                now_epoch = max(
+                    0,
+                    int(time.time() - genesis["genesis_time"])
+                    // spec.seconds_per_slot
+                    // spec.preset.slots_per_epoch,
+                )
+                if now_epoch > last_epoch_checked:
+                    prior = now_epoch - 1
+                    if prior >= 0:
+                        try:
+                            doppelganger.on_epoch(prior)
+                        except DoppelgangerDetected as e:
+                            log.error("doppelganger detected; shutting down",
+                                      indices=sorted(e.indices))
+                            raise SystemExit(1)
+                    last_epoch_checked = now_epoch
+            log.info(
+                "beacon node health",
+                available=fallback.num_available(),
+                total=len(fallback.candidates),
+            )
+            time.sleep(spec.seconds_per_slot)
+    except KeyboardInterrupt:
+        server.stop()
+        lock.release()
     return 0
 
 
@@ -175,6 +340,8 @@ def main(argv=None) -> int:
     args = _build_parser().parse_args(argv)
     if args.command == "bn":
         return cmd_bn(args)
+    if args.command == "vc":
+        return cmd_vc(args)
     if args.command == "account":
         return cmd_account(args)
     if args.command == "db":

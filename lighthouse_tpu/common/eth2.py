@@ -159,6 +159,24 @@ class BeaconNodeHttpClient:
             for d in data
         ]
 
+    def validator_by_pubkey(self, pubkey: bytes, state_id: str = "head") -> dict:
+        return self.validator("0x" + bytes(pubkey).hex(), state_id)
+
+    def validator_liveness(self, epoch: int, indices: list) -> set:
+        """POST /eth/v1/validator/liveness — live indices in `epoch`."""
+        body = json.dumps(
+            {"epoch": str(epoch), "indices": [str(i) for i in indices]}
+        ).encode()
+        _, raw = self._request(
+            "POST",
+            "/eth/v1/validator/liveness",
+            body=body,
+            content_type="application/json",
+        )
+        return {
+            int(d["index"]) for d in json.loads(raw)["data"] if d["is_live"]
+        }
+
     # ------------------------------------------------------------ publish
 
     def publish_attestation_ssz(self, ssz: bytes) -> None:

@@ -452,6 +452,16 @@ class BeaconChain:
     def head_state(self):
         return self.state_for_block(self.head.root)
 
+    def validator_liveness(self, epoch: int, indices) -> set:
+        """Which of `indices` were observed attesting in `epoch` — the
+        /eth/v1/validator/liveness role the doppelganger service polls
+        (answered from the observed-attesters gossip filter)."""
+        return {
+            int(i)
+            for i in indices
+            if (int(i), epoch) in self._observed_attesters
+        }
+
     def _justified_balances(self, justified_root: bytes, justified_epoch: int):
         """Vote weights for fork choice: the JUSTIFIED checkpoint
         state's active, unslashed effective balances (fork_choice.rs

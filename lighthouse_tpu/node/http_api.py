@@ -143,7 +143,20 @@ class BeaconApi:
         if state_id != "head":
             raise ApiError(400, "only state id 'head' is served")
         state = self.chain.head_state()
-        i = int(index)
+        if index.startswith("0x"):  # pubkey form (beacon-API validator_id)
+            want = bytes.fromhex(index[2:])
+            i = next(
+                (
+                    j
+                    for j, v in enumerate(state.validators)
+                    if bytes(v.pubkey) == want
+                ),
+                None,
+            )
+            if i is None:
+                raise ApiError(404, "unknown validator")
+        else:
+            i = int(index)
         if i >= len(state.validators):
             raise ApiError(404, "unknown validator")
         v = state.validators[i]
@@ -189,6 +202,20 @@ class BeaconApi:
 
     # ------------------------------------------------------------ posts
 
+    def liveness(self, body: bytes):
+        """POST /eth/v1/validator/liveness/{epoch} analog (flattened:
+        epoch in the body) — the doppelganger service's poll, answered
+        from the chain's observed-attester sets."""
+        req = json.loads(body)
+        epoch = int(req["epoch"])
+        indices = [int(i) for i in req.get("indices", [])]
+        live = self.chain.validator_liveness(epoch, indices)
+        return 200, {
+            "data": [
+                {"index": str(i), "is_live": i in live} for i in indices
+            ]
+        }
+
     def publish_attestation(self, body: bytes):
         att = T.Attestation.deserialize(body)
         v = self.chain.verify_attestation_for_gossip(att)
@@ -225,6 +252,7 @@ _ROUTES = [
         re.compile(r"^/eth/v1/validator/duties/proposer/([^/]+)$"),
         "proposer_duties",
     ),
+    ("POST", re.compile(r"^/eth/v1/validator/liveness$"), "liveness"),
     ("POST", re.compile(r"^/eth/v1/beacon/pool/attestations$"), "publish_attestation"),
     ("POST", re.compile(r"^/eth/v1/beacon/blocks$"), "publish_block"),
 ]

@@ -1,0 +1,124 @@
+"""Multi-BN redundancy with health ranking
+(common/beacon_node_fallback analog, SURVEY.md §2.4).
+
+The reference wraps N BeaconNodeHttpClients in `CandidateBeaconNode`s,
+periodically health-checks them (online → synced → optimistic), sorts by
+health, and every VC request walks candidates in rank order until one
+succeeds (`first_success`). Same shape over our `BeaconNodeApi` seam —
+in-process nodes and HTTP-client-backed nodes rank identically.
+
+Health ordering (beacon_node_fallback/src/lib.rs CandidateError +
+health tiers): Synced < Syncing < Offline; ties break by user order.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional, Sequence
+
+from ..common import logging as clog
+from ..common import metrics
+
+log = clog.get_logger("fallback")
+
+_FALLBACKS = metrics.counter(
+    "vc_beacon_node_fallbacks_total",
+    "Requests that fell back past the primary beacon node",
+)
+
+# health tiers, best (lowest) first
+SYNCED = 0
+SYNCING = 1
+OFFLINE = 2
+
+# re-probe an unhealthy candidate at most this often
+HEALTH_CHECK_PERIOD = 12.0
+
+
+class AllNodesFailed(Exception):
+    def __init__(self, errors: list):
+        super().__init__("; ".join(f"{n}: {e}" for n, e in errors))
+        self.errors = errors
+
+
+class CandidateBeaconNode:
+    def __init__(self, api, name: str = "bn", sync_tolerance: int = 8):
+        self.api = api
+        self.name = name
+        self.sync_tolerance = sync_tolerance
+        self.health = SYNCED  # optimistic until first probe says otherwise
+        self.last_probe = 0.0
+
+    def probe(self) -> int:
+        """One health observation. The BeaconNodeApi seam exposes
+        `syncing_status() -> {is_syncing, sync_distance}` (HTTP:
+        /eth/v1/node/syncing); in-process nodes are synced by
+        construction if they answer at all."""
+        try:
+            status = getattr(self.api, "syncing_status", None)
+            if status is None:
+                self.api.head_root()  # answers → alive and local
+                self.health = SYNCED
+            else:
+                s = status()
+                syncing = s.get("is_syncing", False) and (
+                    s.get("sync_distance", 0) > self.sync_tolerance
+                )
+                self.health = SYNCING if syncing else SYNCED
+        except Exception as e:  # noqa: BLE001 — any failure = offline
+            log.warning("beacon node offline", name=self.name, error=str(e))
+            self.health = OFFLINE
+        self.last_probe = time.monotonic()
+        return self.health
+
+
+class BeaconNodeFallback:
+    """The ranked candidate list every VC request goes through."""
+
+    def __init__(self, candidates: Sequence[CandidateBeaconNode]):
+        if not candidates:
+            raise ValueError("need at least one beacon node")
+        self.candidates = list(candidates)
+        self._lock = threading.Lock()
+
+    @classmethod
+    def from_apis(cls, apis: Sequence, sync_tolerance: int = 8):
+        return cls(
+            [
+                CandidateBeaconNode(a, name=f"bn{i}", sync_tolerance=sync_tolerance)
+                for i, a in enumerate(apis)
+            ]
+        )
+
+    def update_all_candidates(self) -> None:
+        """The periodic health-check task's body."""
+        for c in self.candidates:
+            c.probe()
+
+    def _ranked(self) -> list:
+        with self._lock:
+            # stable sort: health tier, then user-given order
+            return sorted(self.candidates, key=lambda c: c.health)
+
+    def first_success(self, fn: Callable, *args, **kwargs):
+        """Try `fn(api)` on each candidate in rank order; re-probe
+        stale unhealthy candidates on the way. First success wins."""
+        errors = []
+        now = time.monotonic()
+        for rank, cand in enumerate(self._ranked()):
+            if cand.health != SYNCED and now - cand.last_probe > HEALTH_CHECK_PERIOD:
+                cand.probe()
+            try:
+                result = fn(cand.api, *args, **kwargs)
+                if rank > 0:
+                    _FALLBACKS.inc()
+                return result
+            except Exception as e:  # noqa: BLE001 — candidate boundary
+                errors.append((cand.name, e))
+                cand.health = OFFLINE
+                cand.last_probe = time.monotonic()
+        raise AllNodesFailed(errors)
+
+    def num_available(self) -> int:
+        return sum(1 for c in self.candidates if c.health != OFFLINE)

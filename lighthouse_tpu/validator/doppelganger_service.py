@@ -1,0 +1,96 @@
+"""Doppelganger detection (doppelganger_service analog, SURVEY.md §2.4,
+§5.3).
+
+Starting a VC whose keys are live elsewhere gets a validator slashed.
+The reference holds every newly-added validator out of signing for ~2
+full epochs while polling the BN's liveness endpoint; any sighting is
+fatal (doppelganger_service/src/lib.rs:1-16: "assume that the worst
+case will happen"). States per validator:
+
+  epoch_checks < DEFAULT_REMAINING  → held (store keeps its hold)
+  sighting observed                 → PERMANENT hold + shutdown request
+  checks exhausted, no sightings    → hold cleared, signing enabled
+
+The BN boundary is `liveness(epoch, indices) -> set(live indices)` —
+the beacon API's /eth/v1/validator/liveness role, answered from the
+chain's observed-attester sets.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from ..common import logging as clog
+
+log = clog.get_logger("doppelganger")
+
+# epochs of clean liveness observations required before signing
+DEFAULT_REMAINING_DETECTION_EPOCHS = 2
+
+
+class DoppelgangerDetected(Exception):
+    def __init__(self, indices):
+        super().__init__(f"doppelganger(s) detected for indices {sorted(indices)}")
+        self.indices = set(indices)
+
+
+class DoppelgangerService:
+    def __init__(
+        self,
+        store,
+        liveness: Callable[[int, list], set],
+        index_of: Callable[[bytes], Optional[int]],
+        remaining_epochs: int = DEFAULT_REMAINING_DETECTION_EPOCHS,
+    ):
+        """store: ValidatorStore (holds + clears); liveness: BN seam;
+        index_of: pubkey → validator index (None until deposited)."""
+        self.store = store
+        self.liveness = liveness
+        self.index_of = index_of
+        self._remaining: dict[bytes, int] = {}
+        self.default_remaining = remaining_epochs
+        self.detected: set = set()
+
+    def register(self, pubkey: bytes) -> None:
+        """Put a validator under observation (the store must have been
+        given doppelganger_hold=True for it)."""
+        self._remaining[bytes(pubkey)] = self.default_remaining
+
+    def under_observation(self, pubkey: bytes) -> bool:
+        return self._remaining.get(bytes(pubkey), 0) > 0
+
+    def on_epoch(self, prior_epoch: int) -> list:
+        """Run one detection round against the COMPLETED epoch. Returns
+        pubkeys newly cleared for signing. Raises DoppelgangerDetected
+        on any sighting (caller shuts the VC down — reference behavior)."""
+        if not self._remaining:
+            return []
+        watched = {}
+        for pk in list(self._remaining):
+            idx = self.index_of(pk)
+            if idx is not None:
+                watched[idx] = pk
+        live = self.liveness(prior_epoch, sorted(watched)) if watched else set()
+        if live:
+            hits = {watched[i] for i in live if i in watched}
+            if hits:
+                self.detected |= {bytes(h) for h in hits}
+                log.error(
+                    "DOPPELGANGER DETECTED — refusing to ever sign",
+                    count=len(hits),
+                )
+                raise DoppelgangerDetected(
+                    {self.index_of(pk) for pk in hits}
+                )
+        cleared = []
+        for pk in list(self._remaining):
+            # a validator with no index yet cannot have attested; its
+            # observation window still counts down (it also can't sign)
+            self._remaining[pk] -= 1
+            if self._remaining[pk] <= 0:
+                del self._remaining[pk]
+                self.store.clear_doppelganger(pk)
+                cleared.append(pk)
+        if cleared:
+            log.info("doppelganger holds cleared", count=len(cleared))
+        return cleared

@@ -1,0 +1,61 @@
+"""Per-validator graffiti (common/graffiti_file analog).
+
+File format (graffiti_file/src/lib.rs):
+
+    default: lighthouse-tpu
+    0x<pubkey>: my validator one
+    0x<pubkey>: my validator two
+
+`graffiti_for` resolves pubkey → 32-byte graffiti with the default as
+fallback; the file is re-read on `load` so operators can edit live.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Optional
+
+GRAFFITI_BYTES = 32
+
+
+class GraffitiFileError(Exception):
+    pass
+
+
+def pad_graffiti(text: str) -> bytes:
+    raw = text.encode()[:GRAFFITI_BYTES]
+    return raw + b"\x00" * (GRAFFITI_BYTES - len(raw))
+
+
+class GraffitiFile:
+    def __init__(self, path):
+        self.path = Path(path)
+        self.default: Optional[bytes] = None
+        self.graffitis: dict[bytes, bytes] = {}
+        self.load()
+
+    def load(self) -> None:
+        self.graffitis = {}
+        self.default = None
+        if not self.path.exists():
+            return
+        for lineno, line in enumerate(self.path.read_text().splitlines(), 1):
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            key, sep, value = line.partition(":")
+            if not sep:
+                raise GraffitiFileError(f"line {lineno}: no ':' separator")
+            key = key.strip()
+            value = value.strip()
+            if key == "default":
+                self.default = pad_graffiti(value)
+            else:
+                if not key.startswith("0x") or len(key) != 98:
+                    raise GraffitiFileError(
+                        f"line {lineno}: bad pubkey {key!r}"
+                    )
+                self.graffitis[bytes.fromhex(key[2:])] = pad_graffiti(value)
+
+    def graffiti_for(self, pubkey: bytes) -> Optional[bytes]:
+        return self.graffitis.get(bytes(pubkey), self.default)

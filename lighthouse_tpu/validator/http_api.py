@@ -1,0 +1,342 @@
+"""VC management API — the keymanager spec + metrics
+(validator_client/http_api + http_metrics analog, SURVEY.md §2.4).
+
+Endpoints (the keymanager standard the reference implements):
+
+  GET    /eth/v1/keystores                      list local keys
+  POST   /eth/v1/keystores                      import keystores
+  DELETE /eth/v1/keystores                      delete + export slashing data
+  GET/POST/DELETE /eth/v1/validator/{pubkey}/feerecipient
+  GET/POST/DELETE /eth/v1/validator/{pubkey}/graffiti
+  GET    /lighthouse/version
+  GET    /metrics                               prometheus text
+
+Auth: `Authorization: Bearer <token>`; the token is written to
+`api-token.txt` in the VC dir on startup (http_api/src/api_secret.rs
+posture). Route logic is framework-free like node/http_api.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import secrets as _secrets
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+from typing import Optional
+
+from ..common import metrics
+from ..crypto.keystore.keystore import Keystore, KeystoreError
+from .signing_method import LocalKeystoreSigner
+
+API_TOKEN_FILE = "api-token.txt"
+
+
+class ApiError(Exception):
+    def __init__(self, code: int, message: str):
+        super().__init__(message)
+        self.code = code
+
+
+class KeymanagerApi:
+    """Route logic over the VC's moving parts."""
+
+    def __init__(
+        self,
+        store,
+        initialized,
+        genesis_validators_root: bytes = b"\x00" * 32,
+        graffiti_overrides: Optional[dict] = None,
+        default_graffiti: Optional[str] = None,
+        doppelganger_protection: bool = False,
+        doppelganger_service=None,
+    ):
+        self.store = store
+        self.initialized = initialized
+        self.gvr = bytes(genesis_validators_root)
+        # runtime (API-set) per-validator fee recipients + graffiti —
+        # the reference persists these in the validator definitions
+        self.fee_recipients: dict[bytes, str] = {}
+        self.graffiti: dict[bytes, str] = graffiti_overrides or {}
+        self.default_graffiti = default_graffiti
+        # hot-imported keys must get the same doppelganger observation
+        # window as startup-discovered ones
+        self.doppelganger_protection = doppelganger_protection
+        self.doppelganger_service = doppelganger_service
+
+    # ------------------------------------------------------- keystores
+
+    def list_keystores(self):
+        data = []
+        for d in self.initialized.definitions:
+            if d.get("type", "local_keystore") != "local_keystore":
+                continue
+            data.append(
+                {
+                    "validating_pubkey": d["voting_public_key"],
+                    "derivation_path": d.get("derivation_path", ""),
+                    "readonly": not d.get("enabled", False),
+                }
+            )
+        return 200, {"data": data}
+
+    def import_keystores(self, body: bytes):
+        req = json.loads(body)
+        keystores = req.get("keystores", [])
+        passwords = req.get("passwords", [])
+        if len(keystores) != len(passwords):
+            raise ApiError(400, "keystores/passwords length mismatch")
+        if "slashing_protection" in req and req["slashing_protection"]:
+            obj = req["slashing_protection"]
+            if isinstance(obj, str):
+                obj = json.loads(obj)
+            self.store.slashing_db.import_interchange(obj)
+        statuses = []
+        known = {
+            d["voting_public_key"].lower()
+            for d in self.initialized.definitions
+        }
+        for raw, password in zip(keystores, passwords):
+            try:
+                ks = Keystore.from_json(raw if isinstance(raw, str) else json.dumps(raw))
+                pk_hex = "0x" + ks.pubkey.hex()
+                if pk_hex.lower() in known:
+                    statuses.append({"status": "duplicate"})
+                    continue
+                sk = ks.decrypt(password)  # proves the password now
+                self.initialized.definitions.append(
+                    {
+                        "enabled": True,
+                        "voting_public_key": pk_hex,
+                        "type": "local_keystore",
+                        "voting_keystore_password": password,
+                        "derivation_path": ks.path,
+                        # imported inline: keystore JSON stored in the
+                        # definition (no dir layout for API imports)
+                        "voting_keystore_json": ks.to_json(),
+                    }
+                )
+                known.add(pk_hex.lower())
+                self.store.add_validator(
+                    LocalKeystoreSigner(sk),
+                    doppelganger_hold=self.doppelganger_protection,
+                )
+                if self.doppelganger_protection and self.doppelganger_service:
+                    self.doppelganger_service.register(ks.pubkey)
+                statuses.append({"status": "imported"})
+            except (KeystoreError, ValueError) as e:
+                statuses.append({"status": "error", "message": str(e)})
+        self.initialized.save_definitions()
+        return 200, {"data": statuses}
+
+    def delete_keystores(self, body: bytes):
+        req = json.loads(body)
+        statuses = []
+        for pk_hex in req.get("pubkeys", []):
+            pk = bytes.fromhex(pk_hex[2:])
+            # the key must stop signing BEFORE the response carries the
+            # slashing export out (keymanager spec)
+            removed_signer = self.store.remove_validator(pk)
+            if self.initialized.delete_definition(pk) or removed_signer:
+                statuses.append({"status": "deleted"})
+            else:
+                statuses.append({"status": "not_found"})
+        export = self.store.slashing_db.export_interchange(self.gvr)
+        return 200, {
+            "data": statuses,
+            "slashing_protection": json.dumps(export),
+        }
+
+    # --------------------------------------------------- fee recipient
+
+    def get_fee_recipient(self, pk_hex: str):
+        pk = bytes.fromhex(pk_hex[2:])
+        addr = self.fee_recipients.get(pk)
+        if addr is None:
+            raise ApiError(404, "no fee recipient set")
+        return 200, {"data": {"pubkey": pk_hex, "ethaddress": addr}}
+
+    def set_fee_recipient(self, pk_hex: str, body: bytes):
+        req = json.loads(body)
+        addr = req.get("ethaddress", "")
+        if not re.fullmatch(r"0x[0-9a-fA-F]{40}", addr):
+            raise ApiError(400, "bad ethaddress")
+        self.fee_recipients[bytes.fromhex(pk_hex[2:])] = addr
+        return 202, {}
+
+    def delete_fee_recipient(self, pk_hex: str):
+        self.fee_recipients.pop(bytes.fromhex(pk_hex[2:]), None)
+        return 204, {}
+
+    # -------------------------------------------------------- graffiti
+
+    def get_graffiti(self, pk_hex: str):
+        pk = bytes.fromhex(pk_hex[2:])
+        g = self.graffiti.get(pk, self.default_graffiti)
+        if g is None:
+            raise ApiError(404, "no graffiti set")
+        return 200, {"data": {"pubkey": pk_hex, "graffiti": g}}
+
+    def set_graffiti(self, pk_hex: str, body: bytes):
+        req = json.loads(body)
+        self.graffiti[bytes.fromhex(pk_hex[2:])] = str(req.get("graffiti", ""))[:32]
+        return 202, {}
+
+    def delete_graffiti(self, pk_hex: str):
+        self.graffiti.pop(bytes.fromhex(pk_hex[2:]), None)
+        return 204, {}
+
+    def version(self):
+        from ..node.http_api import VERSION
+
+        return 200, {"data": {"version": VERSION}}
+
+
+_ROUTES = [
+    ("GET", re.compile(r"^/eth/v1/keystores$"), "list_keystores", False),
+    ("POST", re.compile(r"^/eth/v1/keystores$"), "import_keystores", True),
+    ("DELETE", re.compile(r"^/eth/v1/keystores$"), "delete_keystores", True),
+    (
+        "GET",
+        re.compile(r"^/eth/v1/validator/(0x[0-9a-fA-F]{96})/feerecipient$"),
+        "get_fee_recipient",
+        False,
+    ),
+    (
+        "POST",
+        re.compile(r"^/eth/v1/validator/(0x[0-9a-fA-F]{96})/feerecipient$"),
+        "set_fee_recipient",
+        True,
+    ),
+    (
+        "DELETE",
+        re.compile(r"^/eth/v1/validator/(0x[0-9a-fA-F]{96})/feerecipient$"),
+        "delete_fee_recipient",
+        False,
+    ),
+    (
+        "GET",
+        re.compile(r"^/eth/v1/validator/(0x[0-9a-fA-F]{96})/graffiti$"),
+        "get_graffiti",
+        False,
+    ),
+    (
+        "POST",
+        re.compile(r"^/eth/v1/validator/(0x[0-9a-fA-F]{96})/graffiti$"),
+        "set_graffiti",
+        True,
+    ),
+    (
+        "DELETE",
+        re.compile(r"^/eth/v1/validator/(0x[0-9a-fA-F]{96})/graffiti$"),
+        "delete_graffiti",
+        False,
+    ),
+    ("GET", re.compile(r"^/lighthouse/version$"), "version", False),
+]
+
+
+def make_handler(api: KeymanagerApi, token: str):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *args):  # quiet
+            pass
+
+        def _send_json(self, code: int, obj) -> None:
+            raw = b"" if code == 204 else json.dumps(obj).encode()
+            self.send_response(code)
+            if raw:
+                self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(raw)))
+            self.end_headers()
+            if raw:
+                self.wfile.write(raw)
+
+        def _authorized(self) -> bool:
+            got = self.headers.get("Authorization", "")
+            return got == f"Bearer {token}"
+
+        def _dispatch(self, method: str, body: Optional[bytes]) -> None:
+            path = self.path.split("?")[0]
+            if method == "GET" and path == "/metrics":
+                raw = metrics.gather().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(raw)))
+                self.end_headers()
+                self.wfile.write(raw)
+                return
+            if not self._authorized():
+                self._send_json(401, {"code": 401, "message": "invalid token"})
+                return
+            for m, pat, name, wants_body in _ROUTES:
+                if m != method:
+                    continue
+                match = pat.match(path)
+                if not match:
+                    continue
+                try:
+                    args = list(match.groups())
+                    if wants_body:
+                        args.append(body)
+                    code, obj = getattr(api, name)(*args)
+                    self._send_json(code, obj)
+                except ApiError as e:
+                    self._send_json(e.code, {"code": e.code, "message": str(e)})
+                except Exception as e:  # noqa: BLE001 — route boundary
+                    self._send_json(400, {"code": 400, "message": str(e)})
+                return
+            self._send_json(404, {"code": 404, "message": "unknown route"})
+
+        def do_GET(self):
+            self._dispatch("GET", None)
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", "0"))
+            self._dispatch("POST", self.rfile.read(n))
+
+        def do_DELETE(self):
+            n = int(self.headers.get("Content-Length", "0"))
+            self._dispatch("DELETE", self.rfile.read(n) if n else None)
+
+    return Handler
+
+
+class ValidatorApiServer:
+    """http_api::serve for the VC, with bearer-token auth."""
+
+    def __init__(
+        self,
+        api: KeymanagerApi,
+        datadir,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        token: Optional[str] = None,
+    ):
+        self.token = token or _secrets.token_hex(32)
+        Path(datadir).mkdir(parents=True, exist_ok=True)
+        token_path = Path(datadir) / API_TOKEN_FILE
+        # owner-only: the token grants keystore import/delete
+        # (api_secret.rs writes 0600)
+        import os as _os
+
+        fd = _os.open(
+            token_path, _os.O_CREAT | _os.O_WRONLY | _os.O_TRUNC, 0o600
+        )
+        try:
+            _os.write(fd, self.token.encode())
+        finally:
+            _os.close(fd)
+        self.httpd = ThreadingHTTPServer((host, port), make_handler(api, self.token))
+        self.port = self.httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, name="vc-http", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()

@@ -1,0 +1,173 @@
+"""Keystore discovery / decryption / lifecycle
+(common/initialized_validators analog, SURVEY.md §2.4).
+
+The reference walks the validators dir, keeps a `validator_definitions.yml`
+of definitions (enabled flag, voting keystore path, password source, or
+web3signer URL), decrypts enabled keystores, and exposes the live set to
+the ValidatorStore. Here the definitions file is JSON, and the output of
+``initialize`` is SigningMethods pushed into a ValidatorStore.
+
+Definition shapes (initialized_validators/src/lib.rs SigningDefinition):
+  {"enabled": true, "voting_public_key": "0x..",
+   "type": "local_keystore", "voting_keystore_path": "...",
+   "voting_keystore_password_path": "..."}          # or inline password
+  {"enabled": true, "voting_public_key": "0x..",
+   "type": "web3signer", "url": "http://..."}
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Callable, Optional
+
+from ..common import logging as clog
+from ..common import validator_dir as vdir
+from ..crypto.keystore.keystore import Keystore, KeystoreError
+from .signing_method import LocalKeystoreSigner, SigningMethod, Web3SignerMethod
+
+log = clog.get_logger("validator")
+
+DEFINITIONS_FILE = "validator_definitions.json"
+
+
+class InitializedValidators:
+    """The live, decrypted validator set + its on-disk definitions."""
+
+    def __init__(
+        self,
+        validators_dir,
+        secrets_dir=None,
+        web3signer_post: Optional[Callable] = None,
+    ):
+        self.validators_dir = Path(validators_dir)
+        self.secrets_dir = Path(secrets_dir) if secrets_dir else None
+        self._web3signer_post = web3signer_post or _unconfigured_post
+        self.definitions: list[dict] = []
+        self._methods: dict[bytes, SigningMethod] = {}
+        self._load_definitions()
+
+    # ------------------------------------------------------ definitions
+
+    @property
+    def _definitions_path(self) -> Path:
+        return self.validators_dir / DEFINITIONS_FILE
+
+    def _load_definitions(self) -> None:
+        if self._definitions_path.exists():
+            self.definitions = json.loads(self._definitions_path.read_text())
+        else:
+            self.definitions = []
+
+    def save_definitions(self) -> None:
+        self.validators_dir.mkdir(parents=True, exist_ok=True)
+        self._definitions_path.write_text(json.dumps(self.definitions, indent=1))
+
+    def discover_local_keystores(self) -> int:
+        """`discover_local_keystores`: scan the dir for validator
+        subdirs not yet in the definitions; new ones are appended
+        enabled, with the password expected in secrets_dir."""
+        known = {d["voting_public_key"].lower() for d in self.definitions}
+        added = 0
+        for entry in vdir.list_validator_dirs(self.validators_dir):
+            ks_path = entry / vdir.VOTING_KEYSTORE_FILE
+            try:
+                ks = Keystore.from_json(ks_path.read_text())
+            except (KeystoreError, ValueError) as e:
+                log.warning("skipping malformed keystore", path=str(ks_path), error=str(e))
+                continue
+            pk_hex = "0x" + ks.pubkey.hex()
+            if pk_hex.lower() in known:
+                continue
+            d = {
+                "enabled": True,
+                "voting_public_key": pk_hex,
+                "type": "local_keystore",
+                "voting_keystore_path": str(ks_path),
+            }
+            if self.secrets_dir is not None:
+                d["voting_keystore_password_path"] = str(self.secrets_dir / pk_hex)
+            self.definitions.append(d)
+            added += 1
+        if added:
+            self.save_definitions()
+        return added
+
+    # ------------------------------------------------------ lifecycle
+
+    def initialize(self) -> dict:
+        """Decrypt every enabled definition → {pubkey: SigningMethod}.
+        A failed decrypt disables nothing but is logged and skipped
+        (the reference surfaces it in the API as an error state)."""
+        self._methods = {}
+        for d in self.definitions:
+            if not d.get("enabled", False):
+                continue
+            pk = bytes.fromhex(d["voting_public_key"][2:])
+            try:
+                self._methods[pk] = self._method_for(d)
+            except (KeystoreError, OSError, ValueError) as e:
+                log.warning(
+                    "could not initialize validator",
+                    pubkey=d["voting_public_key"], error=str(e),
+                )
+        return dict(self._methods)
+
+    def _method_for(self, d: dict) -> SigningMethod:
+        kind = d.get("type", "local_keystore")
+        if kind == "web3signer":
+            return Web3SignerMethod(
+                bytes.fromhex(d["voting_public_key"][2:]),
+                d["url"],
+                self._web3signer_post,
+            )
+        if "voting_keystore_json" in d:  # API-imported inline keystore
+            ks = Keystore.from_json(d["voting_keystore_json"])
+        else:
+            ks = Keystore.from_json(Path(d["voting_keystore_path"]).read_text())
+        if "voting_keystore_password" in d:
+            password = d["voting_keystore_password"]
+        elif "voting_keystore_password_path" in d:
+            password = Path(d["voting_keystore_password_path"]).read_text().strip()
+        else:
+            raise KeystoreError("no password source in definition")
+        return LocalKeystoreSigner(ks.decrypt(password))
+
+    def methods(self) -> dict:
+        return dict(self._methods)
+
+    def is_enabled(self, pubkey: bytes) -> Optional[bool]:
+        pk_hex = ("0x" + bytes(pubkey).hex()).lower()
+        for d in self.definitions:
+            if d["voting_public_key"].lower() == pk_hex:
+                return bool(d.get("enabled", False))
+        return None
+
+    def set_enabled(self, pubkey: bytes, enabled: bool) -> bool:
+        """Keymanager enable/disable; returns True if the key is known."""
+        pk_hex = ("0x" + bytes(pubkey).hex()).lower()
+        for d in self.definitions:
+            if d["voting_public_key"].lower() == pk_hex:
+                d["enabled"] = enabled
+                self.save_definitions()
+                return True
+        return False
+
+    def delete_definition(self, pubkey: bytes) -> bool:
+        pk_hex = ("0x" + bytes(pubkey).hex()).lower()
+        before = len(self.definitions)
+        self.definitions = [
+            d for d in self.definitions
+            if d["voting_public_key"].lower() != pk_hex
+        ]
+        if len(self.definitions) != before:
+            self._methods.pop(bytes(pubkey), None)
+            self.save_definitions()
+            return True
+        return False
+
+
+def _unconfigured_post(url, signing_root):
+    raise RuntimeError(
+        "web3signer definition present but no transport configured"
+    )

@@ -17,8 +17,28 @@ from ..consensus import types as T
 from ..consensus.domains import compute_signing_root, get_domain
 from ..consensus.signature_sets import _EpochSSZ, _Bytes32SSZ
 from ..consensus.spec import ChainSpec
+from ..common import metrics
 from .signing_method import SigningMethod
 from .slashing_protection import SlashingProtectionDB, SlashingProtectionError
+
+# validator_metrics crate role: per-process signing counters
+SIGNED_BLOCKS = metrics.counter(
+    "vc_signed_beacon_blocks_total", "Blocks signed by this VC"
+)
+SIGNED_ATTESTATIONS = metrics.counter(
+    "vc_signed_attestations_total", "Attestations signed by this VC"
+)
+SIGNED_AGGREGATES = metrics.counter(
+    "vc_signed_aggregates_total", "Aggregate-and-proofs signed by this VC"
+)
+SIGNED_SYNC_MESSAGES = metrics.counter(
+    "vc_signed_sync_committee_messages_total",
+    "Sync-committee messages signed by this VC",
+)
+SLASHING_VETOES = metrics.counter(
+    "vc_slashing_protection_vetoes_total",
+    "Signatures refused by the slashing-protection DB",
+)
 
 
 class DoppelgangerProtected(Exception):
@@ -49,6 +69,13 @@ class ValidatorStore:
         if doppelganger_hold:
             self._doppelganger_hold.add(pk)
 
+    def remove_validator(self, pubkey: bytes) -> bool:
+        """Forget a signer immediately (keymanager DELETE: the key must
+        stop signing before the response returns)."""
+        pk = bytes(pubkey)
+        self._doppelganger_hold.discard(pk)
+        return self._signers.pop(pk, None) is not None
+
     def clear_doppelganger(self, pubkey: bytes) -> None:
         self._doppelganger_hold.discard(bytes(pubkey))
 
@@ -77,12 +104,18 @@ class ValidatorStore:
         )
         root = compute_signing_root(block, domain)
         m = self._signer(pubkey)
-        self.slashing_db.check_and_insert_block_proposal(
-            bytes(pubkey), int(block.slot), root
-        )
-        return T.SignedBeaconBlock.make(
+        try:
+            self.slashing_db.check_and_insert_block_proposal(
+                bytes(pubkey), int(block.slot), root
+            )
+        except SlashingProtectionError:
+            SLASHING_VETOES.inc()
+            raise
+        signed = T.SignedBeaconBlock.make(
             message=block, signature=m.sign(root).to_bytes()
         )
+        SIGNED_BLOCKS.inc()
+        return signed
 
     def sign_attestation(self, pubkey: bytes, data, fork) -> bytes:
         """Slashing-gated attestation signature (sign_attestation);
@@ -96,13 +129,19 @@ class ValidatorStore:
         )
         root = compute_signing_root(data, domain)
         m = self._signer(pubkey)
-        self.slashing_db.check_and_insert_attestation(
-            bytes(pubkey),
-            int(data.source.epoch),
-            int(data.target.epoch),
-            root,
-        )
-        return m.sign(root).to_bytes()
+        try:
+            self.slashing_db.check_and_insert_attestation(
+                bytes(pubkey),
+                int(data.source.epoch),
+                int(data.target.epoch),
+                root,
+            )
+        except SlashingProtectionError:
+            SLASHING_VETOES.inc()
+            raise
+        sig = m.sign(root).to_bytes()
+        SIGNED_ATTESTATIONS.inc()
+        return sig
 
     def sign_randao(self, pubkey: bytes, epoch: int, fork) -> bytes:
         domain = get_domain(
@@ -144,7 +183,9 @@ class ValidatorStore:
             fork,
             self.genesis_validators_root,
         )
-        return self._signer(pubkey).sign(compute_signing_root(msg, domain)).to_bytes()
+        sig = self._signer(pubkey).sign(compute_signing_root(msg, domain)).to_bytes()
+        SIGNED_AGGREGATES.inc()
+        return sig
 
     def sync_selection_proof(
         self, pubkey: bytes, slot: int, subcommittee_index: int, fork
@@ -194,10 +235,12 @@ class ValidatorStore:
             fork,
             self.genesis_validators_root,
         )
-        return (
+        sig = (
             self._signer(pubkey)
             .sign(
                 compute_signing_root(_Bytes32SSZ(beacon_block_root), domain)
             )
             .to_bytes()
         )
+        SIGNED_SYNC_MESSAGES.inc()
+        return sig

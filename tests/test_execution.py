@@ -218,6 +218,7 @@ def test_eth1_follower_honors_follow_distance():
     assert len(svc.cache) == 4
 
 
+@pytest.mark.crypto_heavy
 def test_deposits_flow_into_produced_block():
     """eth1 -> produce_block -> import: a new validator joins the
     registry through a packed, inclusion-proved deposit."""

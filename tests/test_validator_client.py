@@ -40,6 +40,7 @@ def _setup(bls_backend="fake"):
     return keys, chain, store, vc
 
 
+@pytest.mark.crypto_heavy
 def test_vc_drives_chain_multiple_epochs():
     """Every slot proposed by the VC's duty holder; attestations signed,
     gossiped, aggregated and packed; justification advances."""
@@ -88,6 +89,7 @@ def test_vc_drives_chain_multiple_epochs():
     assert some_block_has_atts
 
 
+@pytest.mark.crypto_heavy
 def test_vc_real_signatures_verify_on_cpu_backend():
     """Short run with REAL crypto end to end: the chain verifies every
     VC signature (block batch + gossip attestation batch) on the cpu

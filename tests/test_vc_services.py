@@ -1,0 +1,357 @@
+"""VC completion surface (SURVEY §2.4 rows): initialized_validators,
+beacon_node_fallback, keymanager API, graffiti_file, doppelganger
+service, validator metrics."""
+
+import json
+import urllib.request
+
+import pytest
+
+from lighthouse_tpu.common import validator_dir as vdir
+from lighthouse_tpu.consensus.spec import mainnet_spec
+from lighthouse_tpu.crypto.bls.keys import SecretKey
+from lighthouse_tpu.crypto.keystore.keystore import Keystore
+from lighthouse_tpu.validator.beacon_node_fallback import (
+    AllNodesFailed,
+    BeaconNodeFallback,
+    OFFLINE,
+    SYNCED,
+)
+from lighthouse_tpu.validator.doppelganger_service import (
+    DoppelgangerDetected,
+    DoppelgangerService,
+)
+from lighthouse_tpu.validator.graffiti_file import GraffitiFile, pad_graffiti
+from lighthouse_tpu.validator.http_api import KeymanagerApi, ValidatorApiServer
+from lighthouse_tpu.validator.initialized_validators import InitializedValidators
+from lighthouse_tpu.validator.validator_store import ValidatorStore
+
+SPEC = mainnet_spec()
+GVR = b"\x11" * 32
+FAST_N = 4096
+
+
+def _sk(i):
+    return SecretKey.from_seed(i.to_bytes(4, "big"))
+
+
+# ------------------------------------------------------- initialized
+
+
+def test_initialized_validators_discovery_and_lifecycle(tmp_path):
+    v, s = tmp_path / "validators", tmp_path / "secrets"
+    for i in range(3):
+        vdir.create_validator_dir(v, s, _sk(i), scrypt_n=FAST_N)
+    iv = InitializedValidators(v, s)
+    assert iv.discover_local_keystores() == 3
+    assert iv.discover_local_keystores() == 0  # idempotent
+    methods = iv.initialize()
+    assert len(methods) == 3
+    pk0 = _sk(0).public_key().to_bytes()
+    assert methods[pk0].sign(b"\x01" * 32) is not None
+    # disable one; re-init drops it
+    assert iv.set_enabled(pk0, False)
+    assert len(iv.initialize()) == 2
+    # definitions persist across construction
+    iv2 = InitializedValidators(v, s)
+    assert iv2.is_enabled(pk0) is False
+    assert len(iv2.initialize()) == 2
+    # delete removes the definition
+    assert iv2.delete_definition(pk0)
+    assert iv2.is_enabled(pk0) is None
+
+
+# ---------------------------------------------------------- fallback
+
+
+class _FakeBN:
+    def __init__(self, name, fail=False, syncing=False):
+        self.name, self.fail, self.syncing = name, fail, syncing
+        self.calls = 0
+
+    def syncing_status(self):
+        if self.fail:
+            raise ConnectionError("down")
+        return {"is_syncing": self.syncing, "sync_distance": 100 if self.syncing else 0}
+
+    def head_root(self):
+        if self.fail:
+            raise ConnectionError("down")
+        return b"\x22" * 32
+
+    def work(self):
+        self.calls += 1
+        if self.fail:
+            raise ConnectionError("down")
+        return self.name
+
+
+def test_fallback_prefers_healthy_and_falls_back():
+    a, b = _FakeBN("a", fail=True), _FakeBN("b")
+    fb = BeaconNodeFallback.from_apis([a, b])
+    fb.update_all_candidates()
+    assert fb.candidates[0].health == OFFLINE
+    assert fb.candidates[1].health == SYNCED
+    # ranked order puts b first; a isn't even tried
+    assert fb.first_success(lambda api: api.work()) == "b"
+    assert a.calls == 0
+    assert fb.num_available() == 1
+
+
+def test_fallback_tries_in_order_and_raises_when_all_fail():
+    a, b = _FakeBN("a", fail=True), _FakeBN("b", fail=True)
+    fb = BeaconNodeFallback.from_apis([a, b])
+    with pytest.raises(AllNodesFailed):
+        fb.first_success(lambda api: api.work())
+    assert a.calls == 1 and b.calls == 1
+
+
+def test_fallback_deprioritizes_syncing_node():
+    a, b = _FakeBN("a", syncing=True), _FakeBN("b")
+    fb = BeaconNodeFallback.from_apis([a, b])
+    fb.update_all_candidates()
+    assert fb.first_success(lambda api: api.work()) == "b"
+
+
+# ---------------------------------------------------------- graffiti
+
+
+def test_graffiti_file_resolution(tmp_path):
+    pk = _sk(1).public_key().to_bytes()
+    other = _sk(2).public_key().to_bytes()
+    f = tmp_path / "graffiti.txt"
+    f.write_text(
+        "# comment\n"
+        "default: base graffiti\n"
+        f"0x{pk.hex()}: custom one\n"
+    )
+    g = GraffitiFile(f)
+    assert g.graffiti_for(pk) == pad_graffiti("custom one")
+    assert g.graffiti_for(other) == pad_graffiti("base graffiti")
+    assert len(g.graffiti_for(pk)) == 32
+
+
+# ------------------------------------------------------ doppelganger
+
+
+def test_doppelganger_clears_after_clean_epochs():
+    store = ValidatorStore(SPEC, GVR)
+    from lighthouse_tpu.validator.signing_method import LocalKeystoreSigner
+
+    sk = _sk(3)
+    pk = sk.public_key().to_bytes()
+    store.add_validator(LocalKeystoreSigner(sk), doppelganger_hold=True)
+    svc = DoppelgangerService(
+        store, liveness=lambda e, idx: set(), index_of=lambda p: 7
+    )
+    svc.register(pk)
+    from lighthouse_tpu.validator.validator_store import DoppelgangerProtected
+
+    with pytest.raises(DoppelgangerProtected):
+        store.sign_randao(pk, 0, SPEC.fork_at_epoch(0))
+    assert svc.on_epoch(0) == []  # one clean epoch: still held
+    cleared = svc.on_epoch(1)  # second clean epoch: released
+    assert cleared == [pk]
+    assert store.sign_randao(pk, 0, SPEC.fork_at_epoch(0))
+
+
+def test_doppelganger_detection_is_fatal():
+    store = ValidatorStore(SPEC, GVR)
+    from lighthouse_tpu.validator.signing_method import LocalKeystoreSigner
+
+    sk = _sk(4)
+    pk = sk.public_key().to_bytes()
+    store.add_validator(LocalKeystoreSigner(sk), doppelganger_hold=True)
+    svc = DoppelgangerService(
+        store, liveness=lambda e, idx: {9}, index_of=lambda p: 9
+    )
+    svc.register(pk)
+    with pytest.raises(DoppelgangerDetected):
+        svc.on_epoch(0)
+    assert pk in svc.detected
+
+
+def test_chain_validator_liveness_surface(tmp_path):
+    """BeaconChain.validator_liveness answers from observed attesters."""
+    from lighthouse_tpu.consensus import state_transition as st
+    from lighthouse_tpu.node.client import ClientBuilder
+    from lighthouse_tpu.node.store import HotColdDB, LogStore
+
+    pubkeys = [_sk(i).public_key().to_bytes() for i in range(16)]
+    node = (
+        ClientBuilder(SPEC)
+        .store(HotColdDB(SPEC, LogStore(str(tmp_path))))
+        .genesis_state(st.interop_genesis_state(SPEC, pubkeys))
+        .bls_backend("fake")
+        .build()
+    )
+    chain = node.chain
+    chain._observed_attesters.add((5, 0))
+    assert chain.validator_liveness(0, [4, 5, 6]) == {5}
+    assert chain.validator_liveness(1, [5]) == set()
+
+    # the HTTP surface the cross-process doppelganger service polls
+    from lighthouse_tpu.common.eth2 import BeaconNodeHttpClient
+    from lighthouse_tpu.node.http_api import ApiServer, BeaconApi
+
+    server = ApiServer(BeaconApi(chain), host="127.0.0.1", port=0)
+    server.start()
+    try:
+        client = BeaconNodeHttpClient(f"http://127.0.0.1:{server.port}")
+        assert client.validator_liveness(0, [4, 5, 6]) == {5}
+        v = client.validator_by_pubkey(pubkeys[3])
+        assert v["index"] == 3
+    finally:
+        server.stop()
+
+
+# ------------------------------------------------------- keymanager
+
+
+def _km(tmp_path):
+    store = ValidatorStore(SPEC, GVR)
+    iv = InitializedValidators(tmp_path / "validators", tmp_path / "secrets")
+    api = KeymanagerApi(store, iv, genesis_validators_root=GVR)
+    server = ValidatorApiServer(api, tmp_path, port=0)
+    server.start()
+    return store, iv, api, server
+
+
+def _call(server, method, path, body=None, token=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{server.port}{path}",
+        data=json.dumps(body).encode() if body is not None else None,
+        method=method,
+    )
+    req.add_header("Authorization", f"Bearer {token or server.token}")
+    if body is not None:
+        req.add_header("Content-Type", "application/json")
+    try:
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            raw = resp.read()
+            return resp.status, json.loads(raw) if raw else {}
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+def test_keymanager_auth_and_keystore_lifecycle(tmp_path):
+    store, iv, api, server = _km(tmp_path)
+    try:
+        # bad token rejected
+        code, _ = _call(server, "GET", "/eth/v1/keystores", token="wrong")
+        assert code == 401
+        # token file written
+        assert (tmp_path / "api-token.txt").read_text() == server.token
+
+        code, out = _call(server, "GET", "/eth/v1/keystores")
+        assert code == 200 and out["data"] == []
+
+        sk = _sk(10)
+        ks = Keystore.encrypt(sk, "km-pass", scrypt_n=FAST_N)
+        code, out = _call(
+            server,
+            "POST",
+            "/eth/v1/keystores",
+            body={"keystores": [ks.to_json()], "passwords": ["km-pass"]},
+        )
+        assert code == 200
+        assert out["data"][0]["status"] == "imported"
+        pk = sk.public_key().to_bytes()
+        assert pk in store.pubkeys()
+
+        # duplicate import
+        code, out = _call(
+            server,
+            "POST",
+            "/eth/v1/keystores",
+            body={"keystores": [ks.to_json()], "passwords": ["km-pass"]},
+        )
+        assert out["data"][0]["status"] == "duplicate"
+
+        code, out = _call(server, "GET", "/eth/v1/keystores")
+        assert len(out["data"]) == 1
+
+        # delete exports slashing data AND stops the key signing
+        code, out = _call(
+            server,
+            "DELETE",
+            "/eth/v1/keystores",
+            body={"pubkeys": ["0x" + pk.hex()]},
+        )
+        assert out["data"][0]["status"] == "deleted"
+        interchange = json.loads(out["slashing_protection"])
+        assert interchange["metadata"]["interchange_format_version"]
+        assert pk not in store.pubkeys()
+        # token file is owner-only (it grants import/delete)
+        import os as _os
+
+        mode = _os.stat(tmp_path / "api-token.txt").st_mode & 0o777
+        assert mode == 0o600
+    finally:
+        server.stop()
+
+
+def test_keymanager_import_honors_doppelganger_protection(tmp_path):
+    store = ValidatorStore(SPEC, GVR)
+    iv = InitializedValidators(tmp_path / "validators", tmp_path / "secrets")
+    api = KeymanagerApi(
+        store, iv, genesis_validators_root=GVR, doppelganger_protection=True
+    )
+    server = ValidatorApiServer(api, tmp_path, port=0)
+    server.start()
+    try:
+        sk = _sk(12)
+        ks = Keystore.encrypt(sk, "dp-pass", scrypt_n=FAST_N)
+        _, out = _call(
+            server,
+            "POST",
+            "/eth/v1/keystores",
+            body={"keystores": [ks.to_json()], "passwords": ["dp-pass"]},
+        )
+        assert out["data"][0]["status"] == "imported"
+        from lighthouse_tpu.validator.validator_store import (
+            DoppelgangerProtected,
+        )
+
+        with pytest.raises(DoppelgangerProtected):
+            store.sign_randao(
+                sk.public_key().to_bytes(), 0, SPEC.fork_at_epoch(0)
+            )
+    finally:
+        server.stop()
+
+
+def test_keymanager_fee_recipient_and_graffiti(tmp_path):
+    store, iv, api, server = _km(tmp_path)
+    try:
+        pk_hex = "0x" + _sk(11).public_key().to_bytes().hex()
+        code, out = _call(server, "GET", f"/eth/v1/validator/{pk_hex}/feerecipient")
+        assert code == 404
+        code, _ = _call(
+            server,
+            "POST",
+            f"/eth/v1/validator/{pk_hex}/feerecipient",
+            body={"ethaddress": "0x" + "ab" * 20},
+        )
+        assert code == 202
+        code, out = _call(server, "GET", f"/eth/v1/validator/{pk_hex}/feerecipient")
+        assert out["data"]["ethaddress"] == "0x" + "ab" * 20
+        code, _ = _call(
+            server,
+            "POST",
+            f"/eth/v1/validator/{pk_hex}/graffiti",
+            body={"graffiti": "hello graffiti"},
+        )
+        assert code == 202
+        code, out = _call(server, "GET", f"/eth/v1/validator/{pk_hex}/graffiti")
+        assert out["data"]["graffiti"] == "hello graffiti"
+        # bad fee recipient rejected
+        code, _ = _call(
+            server,
+            "POST",
+            f"/eth/v1/validator/{pk_hex}/feerecipient",
+            body={"ethaddress": "nope"},
+        )
+        assert code == 400
+    finally:
+        server.stop()
