@@ -198,13 +198,20 @@ def cmd_vc(args) -> int:
             lambda bn: bn.client.validator_liveness(epoch, indices)
         )
 
+    from .common.eth2 import ApiClientError
+
     def _index_of(pubkey):
-        try:
-            return fallback.first_success(
-                lambda bn: bn.client.validator_by_pubkey(pubkey)
-            )["index"]
-        except Exception:
-            return None  # not deposited yet → can't have a doppelganger
+        def lookup(bn):
+            try:
+                return bn.client.validator_by_pubkey(pubkey)["index"]
+            except ApiClientError as e:
+                if e.status == 404:
+                    # a live node's definitive answer: not deposited yet
+                    # → can't have a doppelganger (don't try other BNs)
+                    return None
+                raise
+
+        return fallback.first_success(lookup)
 
     doppelganger = DoppelgangerService(store, _liveness, _index_of)
     for method in iv.initialize().values():
@@ -257,6 +264,7 @@ def cmd_vc(args) -> int:
                 )
                 if now_epoch > last_epoch_checked:
                     prior = now_epoch - 1
+                    round_ok = True
                     if prior >= 0:
                         try:
                             doppelganger.on_epoch(prior)
@@ -264,7 +272,17 @@ def cmd_vc(args) -> int:
                             log.error("doppelganger detected; shutting down",
                                       indices=sorted(e.indices))
                             raise SystemExit(1)
-                    last_epoch_checked = now_epoch
+                        except Exception as e:  # noqa: BLE001 — BN outage
+                            # a transient all-BN outage must not kill the
+                            # VC; the round is retried next tick (the
+                            # epoch stays unacknowledged)
+                            round_ok = False
+                            log.warning(
+                                "doppelganger round failed; will retry",
+                                error=str(e),
+                            )
+                    if round_ok:
+                        last_epoch_checked = now_epoch
             log.info(
                 "beacon node health",
                 available=fallback.num_available(),

@@ -1,0 +1,351 @@
+"""Light-client protocol: types, state-proof construction, and the
+client-side update verifier (consensus/types light-client containers +
+Altair sync-protocol analog; reference consensus/types/src/light_client_
+{header,bootstrap,update,finality_update,optimistic_update}.rs).
+
+A light client tracks the chain from block HEADERS plus sync-committee
+signatures, using two merkle proofs into the state:
+
+  * next_sync_committee  — state field, depth-5 branch
+  * finalized_checkpoint.root — state field sub-tree, depth-6 branch
+
+Generalized indices derive from THIS framework's canonical BeaconState
+container (28 fields → 32 leaves): current_sync_committee gindex 54,
+next 55, finalized root 105 — numerically equal to mainnet Altair's
+because the field count rounds to the same tree width.
+
+Proof construction uses only the public SSZ surface (per-field
+hash_tree_root + merkle_branch), no tree internals.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .merkle_proof import merkle_branch, verify_merkle_branch
+from .spec import ChainSpec
+from .ssz import Bytes32, Bytes96, Container, Vector, uint64
+from .types import (
+    BeaconBlockHeader,
+    BeaconState,
+    SyncAggregate,
+    SyncCommittee,
+)
+
+# ---------------------------------------------------------------- indices
+
+_STATE_FIELDS = [f for f, _ in BeaconState.fields]
+_TREE_WIDTH = 1 << (len(_STATE_FIELDS) - 1).bit_length()  # 32
+STATE_PROOF_DEPTH = _TREE_WIDTH.bit_length() - 1  # 5
+
+CURRENT_SYNC_COMMITTEE_INDEX = _TREE_WIDTH + _STATE_FIELDS.index(
+    "current_sync_committee"
+)  # 54
+NEXT_SYNC_COMMITTEE_INDEX = _TREE_WIDTH + _STATE_FIELDS.index(
+    "next_sync_committee"
+)  # 55
+# finalized_checkpoint is a 2-field container; .root is leaf 1 of it
+FINALIZED_ROOT_INDEX = (
+    _TREE_WIDTH + _STATE_FIELDS.index("finalized_checkpoint")
+) * 2 + 1  # 105
+FINALITY_PROOF_DEPTH = STATE_PROOF_DEPTH + 1  # 6
+
+# ---------------------------------------------------------------- types
+
+LightClientHeader = Container(
+    "LightClientHeader", [("beacon", BeaconBlockHeader)]
+)
+
+LightClientBootstrap = Container(
+    "LightClientBootstrap",
+    [
+        ("header", LightClientHeader),
+        ("current_sync_committee", SyncCommittee),
+        (
+            "current_sync_committee_branch",
+            Vector(Bytes32, STATE_PROOF_DEPTH),
+        ),
+    ],
+)
+
+LightClientUpdate = Container(
+    "LightClientUpdate",
+    [
+        ("attested_header", LightClientHeader),
+        ("next_sync_committee", SyncCommittee),
+        ("next_sync_committee_branch", Vector(Bytes32, STATE_PROOF_DEPTH)),
+        ("finalized_header", LightClientHeader),
+        ("finality_branch", Vector(Bytes32, FINALITY_PROOF_DEPTH)),
+        ("sync_aggregate", SyncAggregate),
+        ("signature_slot", uint64),
+    ],
+)
+
+LightClientFinalityUpdate = Container(
+    "LightClientFinalityUpdate",
+    [
+        ("attested_header", LightClientHeader),
+        ("finalized_header", LightClientHeader),
+        ("finality_branch", Vector(Bytes32, FINALITY_PROOF_DEPTH)),
+        ("sync_aggregate", SyncAggregate),
+        ("signature_slot", uint64),
+    ],
+)
+
+LightClientOptimisticUpdate = Container(
+    "LightClientOptimisticUpdate",
+    [
+        ("attested_header", LightClientHeader),
+        ("sync_aggregate", SyncAggregate),
+        ("signature_slot", uint64),
+    ],
+)
+
+LightClientUpdatesByRangeRequest = Container(
+    "LightClientUpdatesByRangeRequest",
+    [("start_period", uint64), ("count", uint64)],
+)
+
+# ---------------------------------------------------------------- proofs
+
+
+def _state_field_roots(state) -> list:
+    return [
+        ftype.hash_tree_root(getattr(state, fname))
+        for fname, ftype in BeaconState.fields
+    ]
+
+
+def state_field_branch(state, field_name: str, roots: list = None) -> list:
+    """Depth-5 branch proving one state field against the state root.
+    Pass precomputed `roots` (_state_field_roots) when deriving several
+    branches from one state — hashing the 28 fields dominates."""
+    if roots is None:
+        roots = _state_field_roots(state)
+    return merkle_branch(roots, _TREE_WIDTH, _STATE_FIELDS.index(field_name))
+
+
+def finality_branch(state, roots: list = None) -> list:
+    """Depth-6 branch for finalized_checkpoint.root: one step inside
+    the Checkpoint container, then the depth-5 field branch."""
+    from .ssz import uint64 as _u64
+
+    cp = state.finalized_checkpoint
+    epoch_root = _u64.hash_tree_root(cp.epoch)
+    return [epoch_root] + state_field_branch(
+        state, "finalized_checkpoint", roots
+    )
+
+
+def header_for_block(block_message) -> "LightClientHeader":
+    return LightClientHeader.make(
+        beacon=BeaconBlockHeader.make(
+            slot=block_message.slot,
+            proposer_index=block_message.proposer_index,
+            parent_root=bytes(block_message.parent_root),
+            state_root=bytes(block_message.state_root),
+            body_root=block_message.body.hash_tree_root(),
+        )
+    )
+
+
+# ---------------------------------------------------------------- periods
+
+
+def sync_committee_period(spec: ChainSpec, slot: int) -> int:
+    p = spec.preset
+    return slot // p.slots_per_epoch // p.epochs_per_sync_committee_period
+
+
+# ------------------------------------------------------------- verification
+
+
+class LightClientError(Exception):
+    pass
+
+
+@dataclass
+class LightClientStore:
+    """The client's persistent view (sync-protocol LightClientStore)."""
+
+    finalized_header: object
+    current_sync_committee: object
+    next_sync_committee: Optional[object] = None
+    best_valid_update: Optional[object] = None
+    optimistic_header: Optional[object] = None
+    previous_max_active_participants: int = 0
+    current_max_active_participants: int = 0
+
+
+def validate_bootstrap(trusted_block_root: bytes, bootstrap) -> LightClientStore:
+    """Check the bootstrap against an out-of-band trusted root and open
+    a store from it."""
+    header_root = BeaconBlockHeader.hash_tree_root(bootstrap.header.beacon)
+    if header_root != bytes(trusted_block_root):
+        raise LightClientError("bootstrap header != trusted root")
+    ok = verify_merkle_branch(
+        SyncCommittee.hash_tree_root(bootstrap.current_sync_committee),
+        [bytes(b) for b in bootstrap.current_sync_committee_branch],
+        STATE_PROOF_DEPTH,
+        CURRENT_SYNC_COMMITTEE_INDEX % _TREE_WIDTH,
+        bytes(bootstrap.header.beacon.state_root),
+    )
+    if not ok:
+        raise LightClientError("bad current-sync-committee branch")
+    return LightClientStore(
+        finalized_header=bootstrap.header,
+        current_sync_committee=bootstrap.current_sync_committee,
+        optimistic_header=bootstrap.header,
+    )
+
+
+def _verify_sync_aggregate(
+    spec: ChainSpec,
+    genesis_validators_root: bytes,
+    committee,
+    sync_aggregate,
+    attested_root: bytes,
+    signature_slot: int,
+    backend: Optional[str] = None,
+) -> int:
+    """Verify the committee signature over the attested block root;
+    returns the participant count. The message/domain construction
+    mirrors the VC's sync-message signing exactly."""
+    from ..crypto import bls
+    from ..crypto.bls.keys import PublicKey, Signature, SignatureSet
+    from .domains import compute_signing_root, get_domain
+    from .signature_sets import _Bytes32SSZ
+    from . import state_transition as st
+
+    bits = list(sync_aggregate.sync_committee_bits)
+    participants = [
+        PublicKey.from_bytes(bytes(committee.pubkeys[i]))
+        for i, b in enumerate(bits)
+        if b
+    ]
+    n = len(participants)
+    if n == 0:
+        return 0
+    prev_slot = max(1, int(signature_slot)) - 1
+    epoch = st.compute_epoch_at_slot(spec, prev_slot)
+    domain = get_domain(
+        spec,
+        spec.domain_sync_committee,
+        epoch,
+        spec.fork_at_epoch(epoch),
+        genesis_validators_root,
+    )
+    root = compute_signing_root(_Bytes32SSZ(attested_root), domain)
+    sset = SignatureSet.multiple_pubkeys(
+        Signature.from_bytes(bytes(sync_aggregate.sync_committee_signature)),
+        participants,
+        root,
+    )
+    if not bls.verify_signature_sets([sset], backend=backend):
+        raise LightClientError("sync aggregate signature invalid")
+    return n
+
+
+def process_light_client_update(
+    store: LightClientStore,
+    update,
+    current_slot: int,
+    spec: ChainSpec,
+    genesis_validators_root: bytes,
+    bls_backend: Optional[str] = None,
+) -> None:
+    """The sync-protocol's process_light_client_update, collapsed to the
+    force-update-free happy path: verify branches + signature, advance
+    finalized/optimistic headers, rotate committees across periods."""
+    attested = update.attested_header.beacon
+    finalized = update.finalized_header.beacon
+    sig_slot = int(update.signature_slot)
+    if not (int(attested.slot) < sig_slot <= current_slot):
+        raise LightClientError("update slots out of order")
+
+    store_period = sync_committee_period(
+        spec, int(store.finalized_header.beacon.slot)
+    )
+    update_period = sync_committee_period(spec, int(attested.slot))
+    if update_period not in (store_period, store_period + 1):
+        raise LightClientError("update period not adjacent to store")
+
+    # finality proof: finalized header root sits in the attested state
+    if int(finalized.slot) > 0:
+        ok = verify_merkle_branch(
+            BeaconBlockHeader.hash_tree_root(finalized),
+            [bytes(b) for b in update.finality_branch],
+            FINALITY_PROOF_DEPTH,
+            FINALIZED_ROOT_INDEX % (1 << FINALITY_PROOF_DEPTH),
+            bytes(attested.state_root),
+        )
+        if not ok:
+            raise LightClientError("bad finality branch")
+
+    # next-committee proof against the attested state
+    has_next = any(
+        bytes(pk) != b"\x00" * 48 for pk in update.next_sync_committee.pubkeys[:1]
+    )
+    if has_next:
+        ok = verify_merkle_branch(
+            SyncCommittee.hash_tree_root(update.next_sync_committee),
+            [bytes(b) for b in update.next_sync_committee_branch],
+            STATE_PROOF_DEPTH,
+            NEXT_SYNC_COMMITTEE_INDEX % _TREE_WIDTH,
+            bytes(attested.state_root),
+        )
+        if not ok:
+            raise LightClientError("bad next-sync-committee branch")
+
+    # signature by the committee of the signature slot's period (the
+    # spec's compute_sync_committee_period_at_slot(signature_slot) —
+    # the -1 applies only to the DOMAIN epoch; a boundary-slot block is
+    # verified against the post-rotation committee, matching
+    # process_sync_aggregate's use of the state's current committee)
+    sig_period = sync_committee_period(spec, sig_slot)
+    if sig_period == store_period:
+        committee = store.current_sync_committee
+    elif sig_period == store_period + 1 and store.next_sync_committee is not None:
+        committee = store.next_sync_committee
+    else:
+        raise LightClientError("no committee known for signature period")
+    n = _verify_sync_aggregate(
+        spec,
+        genesis_validators_root,
+        committee,
+        update.sync_aggregate,
+        BeaconBlockHeader.hash_tree_root(attested),
+        sig_slot,
+        backend=bls_backend,
+    )
+    if 3 * n < 2 * spec.preset.sync_committee_size:
+        raise LightClientError("insufficient sync participation")
+
+    # apply
+    store.current_max_active_participants = max(
+        store.current_max_active_participants, n
+    )
+    if store.optimistic_header is None or int(attested.slot) > int(
+        store.optimistic_header.beacon.slot
+    ):
+        store.optimistic_header = update.attested_header
+    if int(finalized.slot) > int(store.finalized_header.beacon.slot):
+        finalized_period = sync_committee_period(spec, int(finalized.slot))
+        if has_next and store.next_sync_committee is None:
+            store.next_sync_committee = update.next_sync_committee
+        elif finalized_period == store_period + 1:
+            # period rollover: next becomes current
+            if store.next_sync_committee is None:
+                raise LightClientError("rollover without next committee")
+            store.current_sync_committee = store.next_sync_committee
+            store.next_sync_committee = (
+                update.next_sync_committee if has_next else None
+            )
+            store.previous_max_active_participants = (
+                store.current_max_active_participants
+            )
+            store.current_max_active_participants = 0
+        store.finalized_header = update.finalized_header
+    elif has_next and store.next_sync_committee is None:
+        store.next_sync_committee = update.next_sync_committee

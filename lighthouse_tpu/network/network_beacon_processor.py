@@ -186,6 +186,20 @@ class NetworkBeaconProcessor:
         self.service.rpc.register(
             Protocol.BLOBS_BY_ROOT, self._serve_blobs_by_root
         )
+        self.service.rpc.register(
+            Protocol.LIGHT_CLIENT_BOOTSTRAP, self._serve_lc_bootstrap
+        )
+        self.service.rpc.register(
+            Protocol.LIGHT_CLIENT_OPTIMISTIC_UPDATE,
+            self._serve_lc_optimistic,
+        )
+        self.service.rpc.register(
+            Protocol.LIGHT_CLIENT_FINALITY_UPDATE, self._serve_lc_finality
+        )
+        self.service.rpc.register(
+            Protocol.LIGHT_CLIENT_UPDATES_BY_RANGE,
+            self._serve_lc_updates_by_range,
+        )
 
     def local_status(self):
         fin_epoch, fin_root = self.chain.fork_choice.finalized_checkpoint
@@ -229,3 +243,56 @@ class NetworkBeaconProcessor:
             for sc in self.chain.store.get_blobs(root):
                 chunks.append(T.BlobSidecar.serialize(sc))
         return ResponseCode.SUCCESS, chunks
+
+    # ------------------------------------------------- light-client rpc
+
+    def _serve_lc_bootstrap(self, peer_id: str, body: bytes):
+        from ..consensus import light_client as lc
+
+        cache = self.chain.light_client_cache
+        if cache is None:
+            return ResponseCode.RESOURCE_UNAVAILABLE, []
+        bootstrap = cache.get_bootstrap(body[:32])
+        if bootstrap is None:
+            return ResponseCode.RESOURCE_UNAVAILABLE, []
+        return ResponseCode.SUCCESS, [
+            lc.LightClientBootstrap.serialize(bootstrap)
+        ]
+
+    def _serve_lc_optimistic(self, peer_id: str, body: bytes):
+        from ..consensus import light_client as lc
+
+        cache = self.chain.light_client_cache
+        if cache is None or cache.latest_optimistic_update is None:
+            return ResponseCode.RESOURCE_UNAVAILABLE, []
+        return ResponseCode.SUCCESS, [
+            lc.LightClientOptimisticUpdate.serialize(
+                cache.latest_optimistic_update
+            )
+        ]
+
+    def _serve_lc_finality(self, peer_id: str, body: bytes):
+        from ..consensus import light_client as lc
+
+        cache = self.chain.light_client_cache
+        if cache is None or cache.latest_finality_update is None:
+            return ResponseCode.RESOURCE_UNAVAILABLE, []
+        return ResponseCode.SUCCESS, [
+            lc.LightClientFinalityUpdate.serialize(
+                cache.latest_finality_update
+            )
+        ]
+
+    def _serve_lc_updates_by_range(self, peer_id: str, body: bytes):
+        from ..consensus import light_client as lc
+
+        cache = self.chain.light_client_cache
+        if cache is None:
+            return ResponseCode.RESOURCE_UNAVAILABLE, []
+        req = lc.LightClientUpdatesByRangeRequest.deserialize(body)
+        updates = cache.get_updates(
+            int(req.start_period), min(int(req.count), 128)
+        )
+        return ResponseCode.SUCCESS, [
+            lc.LightClientUpdate.serialize(u) for u in updates
+        ]

@@ -36,6 +36,11 @@ class Protocol(IntEnum):
     BLOCKS_BY_ROOT = 5
     BLOBS_BY_RANGE = 6
     BLOBS_BY_ROOT = 7
+    # light-client server protocols (rpc/protocol.rs LightClient*)
+    LIGHT_CLIENT_BOOTSTRAP = 8
+    LIGHT_CLIENT_OPTIMISTIC_UPDATE = 9
+    LIGHT_CLIENT_FINALITY_UPDATE = 10
+    LIGHT_CLIENT_UPDATES_BY_RANGE = 11
 
 
 class ResponseCode(IntEnum):
@@ -88,6 +93,10 @@ class RateLimiter:
         Protocol.BLOCKS_BY_ROOT: (256, 128.0),
         Protocol.BLOBS_BY_RANGE: (512, 128.0),
         Protocol.BLOBS_BY_ROOT: (256, 128.0),
+        Protocol.LIGHT_CLIENT_BOOTSTRAP: (4, 1.0),
+        Protocol.LIGHT_CLIENT_OPTIMISTIC_UPDATE: (8, 2.0),
+        Protocol.LIGHT_CLIENT_FINALITY_UPDATE: (8, 2.0),
+        Protocol.LIGHT_CLIENT_UPDATES_BY_RANGE: (16, 4.0),
     }
 
     def __init__(self, clock: Callable[[], float] = time.monotonic):

@@ -102,6 +102,9 @@ class BeaconChain:
         # optional eth1 deposit follower (eth1/src/service.rs role):
         # feeds deposit inclusion + eth1_data votes at block production
         self.eth1 = None
+        # optional light-client server cache (light_client_server_cache
+        # role) — attach with enable_light_client_server()
+        self.light_client_cache = None
         self._in_fcu_recompute = False
         # Deneb data availability: sidecars buffer here until the block's
         # commitment list is satisfied. kzg=None runs blob-free (blocks
@@ -240,6 +243,7 @@ class BeaconChain:
         self.slasher = None
         self.execution_layer = None
         self.eth1 = None
+        self.light_client_cache = None
         self._in_fcu_recompute = False
         self.kzg = kzg
         self.da_checker = (
@@ -831,6 +835,11 @@ class BeaconChain:
                 except Exception:
                     pass  # slasher feed is best-effort observability
         self.m_blocks.inc()
+        if self.light_client_cache is not None:
+            try:
+                self.light_client_cache.on_imported_block(signed_block)
+            except Exception:
+                pass  # serving light clients must never fail an import
         self.recompute_head()
 
     def poll_slasher(self) -> int:

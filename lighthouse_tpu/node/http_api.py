@@ -144,15 +144,9 @@ class BeaconApi:
             raise ApiError(400, "only state id 'head' is served")
         state = self.chain.head_state()
         if index.startswith("0x"):  # pubkey form (beacon-API validator_id)
-            want = bytes.fromhex(index[2:])
-            i = next(
-                (
-                    j
-                    for j, v in enumerate(state.validators)
-                    if bytes(v.pubkey) == want
-                ),
-                None,
-            )
+            # O(1) via the chain's decompressed-pubkey cache, not a scan
+            # over the registry (validator_pubkey_cache.rs role)
+            i = self.chain.pubkey_cache.get_index(bytes.fromhex(index[2:]))
             if i is None:
                 raise ApiError(404, "unknown validator")
         else:

@@ -1,0 +1,127 @@
+"""Server-side light-client caches (beacon_chain light_client_server_
+cache analog; reference beacon_node/beacon_chain/src/light_client_
+server_cache.rs).
+
+On every imported block carrying sync participation the cache derives:
+
+  * the latest LightClientOptimisticUpdate (attested header = parent)
+  * the latest LightClientFinalityUpdate (+ finality branch from the
+    attested state)
+  * the best LightClientUpdate of the attested period — "best" =
+    most sync participants, finalized beats unfinalized
+
+and serves LightClientBootstrap for finalized roots. All proofs are
+built from states the chain already holds — no extra tree machinery.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..consensus import light_client as lc
+from ..consensus import types as T
+
+
+class LightClientServerCache:
+    def __init__(self, chain):
+        self.chain = chain
+        self.latest_finality_update = None
+        self.latest_optimistic_update = None
+        # period -> best LightClientUpdate
+        self.best_updates: dict[int, object] = {}
+
+    # ------------------------------------------------------------ ingest
+
+    def on_imported_block(self, signed_block) -> None:
+        block = signed_block.message
+        agg = block.body.sync_aggregate
+        participants = sum(1 for b in agg.sync_committee_bits if b)
+        if participants == 0:
+            return
+        chain = self.chain
+        parent_root = bytes(block.parent_root)
+        attested_block = chain.store.get_block(parent_root)
+        attested_state = chain.state_for_block(parent_root)
+        if attested_block is None or attested_state is None:
+            return
+        attested_header = lc.header_for_block(attested_block.message)
+
+        # finalized header from the attested state's checkpoint
+        fin_root = bytes(attested_state.finalized_checkpoint.root)
+        fin_block = chain.store.get_block(fin_root) if any(fin_root) else None
+        if fin_block is not None:
+            finalized_header = lc.header_for_block(fin_block.message)
+        else:
+            finalized_header = lc.LightClientHeader.default()
+        # hash the 28 state fields ONCE; both branches derive from it
+        roots = lc._state_field_roots(attested_state)
+        fin_branch = lc.finality_branch(attested_state, roots)
+
+        update = lc.LightClientUpdate.make(
+            attested_header=attested_header,
+            next_sync_committee=attested_state.next_sync_committee,
+            next_sync_committee_branch=lc.state_field_branch(
+                attested_state, "next_sync_committee", roots
+            ),
+            finalized_header=finalized_header,
+            finality_branch=fin_branch,
+            sync_aggregate=agg,
+            signature_slot=block.slot,
+        )
+
+        self.latest_optimistic_update = lc.LightClientOptimisticUpdate.make(
+            attested_header=attested_header,
+            sync_aggregate=agg,
+            signature_slot=block.slot,
+        )
+        if fin_block is not None:
+            self.latest_finality_update = lc.LightClientFinalityUpdate.make(
+                attested_header=attested_header,
+                finalized_header=finalized_header,
+                finality_branch=fin_branch,
+                sync_aggregate=agg,
+                signature_slot=block.slot,
+            )
+
+        period = lc.sync_committee_period(
+            chain.spec, int(attested_header.beacon.slot)
+        )
+        best = self.best_updates.get(period)
+        if best is None or self._better(update, best):
+            self.best_updates[period] = update
+
+    @staticmethod
+    def _participants(update) -> int:
+        return sum(1 for b in update.sync_aggregate.sync_committee_bits if b)
+
+    def _better(self, a, b) -> bool:
+        """is_better_update, collapsed: finalized > participation."""
+        a_fin = int(a.finalized_header.beacon.slot) > 0
+        b_fin = int(b.finalized_header.beacon.slot) > 0
+        if a_fin != b_fin:
+            return a_fin
+        return self._participants(a) > self._participants(b)
+
+    # ------------------------------------------------------------ serve
+
+    def get_bootstrap(self, block_root: bytes) -> Optional[object]:
+        """LightClientBootstrap for a (finalized) block root."""
+        chain = self.chain
+        block = chain.store.get_block(bytes(block_root))
+        state = chain.state_for_block(bytes(block_root))
+        if block is None or state is None:
+            return None
+        return lc.LightClientBootstrap.make(
+            header=lc.header_for_block(block.message),
+            current_sync_committee=state.current_sync_committee,
+            current_sync_committee_branch=lc.state_field_branch(
+                state, "current_sync_committee"
+            ),
+        )
+
+    def get_updates(self, start_period: int, count: int) -> list:
+        return [
+            self.best_updates[p]
+            for p in range(start_period, start_period + count)
+            if p in self.best_updates
+        ]

@@ -116,8 +116,14 @@ class BeaconNodeFallback:
                 return result
             except Exception as e:  # noqa: BLE001 — candidate boundary
                 errors.append((cand.name, e))
-                cand.health = OFFLINE
-                cand.last_probe = time.monotonic()
+                # Only a TRANSPORT failure demotes the node. An HTTP
+                # error response (status > 0, e.g. 404 for an unknown
+                # validator) came from a live node answering correctly —
+                # conflating it with health would mark every healthy
+                # node offline on an application-level miss.
+                if getattr(e, "status", 0) == 0:
+                    cand.health = OFFLINE
+                    cand.last_probe = time.monotonic()
         raise AllNodesFailed(errors)
 
     def num_available(self) -> int:

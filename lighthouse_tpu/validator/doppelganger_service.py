@@ -56,6 +56,11 @@ class DoppelgangerService:
         given doppelganger_hold=True for it)."""
         self._remaining[bytes(pubkey)] = self.default_remaining
 
+    def unregister(self, pubkey: bytes) -> None:
+        """Stop observing a key (keymanager DELETE) — a key migrated to
+        another machine must not trip detection here afterwards."""
+        self._remaining.pop(bytes(pubkey), None)
+
     def under_observation(self, pubkey: bytes) -> bool:
         return self._remaining.get(bytes(pubkey), 0) > 0
 

@@ -138,6 +138,8 @@ class KeymanagerApi:
             # the key must stop signing BEFORE the response carries the
             # slashing export out (keymanager spec)
             removed_signer = self.store.remove_validator(pk)
+            if self.doppelganger_service is not None:
+                self.doppelganger_service.unregister(pk)
             if self.initialized.delete_definition(pk) or removed_signer:
                 statuses.append({"status": "deleted"})
             else:

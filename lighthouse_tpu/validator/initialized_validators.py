@@ -60,8 +60,19 @@ class InitializedValidators:
             self.definitions = []
 
     def save_definitions(self) -> None:
+        import os
+
         self.validators_dir.mkdir(parents=True, exist_ok=True)
-        self._definitions_path.write_text(json.dumps(self.definitions, indent=1))
+        # 0600: API-imported definitions carry inline keystore passwords
+        fd = os.open(
+            self._definitions_path,
+            os.O_CREAT | os.O_WRONLY | os.O_TRUNC,
+            0o600,
+        )
+        try:
+            os.write(fd, json.dumps(self.definitions, indent=1).encode())
+        finally:
+            os.close(fd)
 
     def discover_local_keystores(self) -> int:
         """`discover_local_keystores`: scan the dir for validator

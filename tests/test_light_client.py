@@ -1,0 +1,327 @@
+"""Light-client protocol: state proofs, bootstrap validation, server
+cache production, RPC serving, and the full BLS-verified update flow
+(reference light_client types + light_client_server_cache.rs +
+the Altair sync protocol)."""
+
+import pytest
+
+from lighthouse_tpu.consensus import light_client as lc
+from lighthouse_tpu.consensus import state_transition as st
+from lighthouse_tpu.consensus import types as T
+from lighthouse_tpu.consensus.merkle_proof import verify_merkle_branch
+from lighthouse_tpu.consensus.spec import mainnet_spec, minimal_spec
+from lighthouse_tpu.crypto.bls.keys import SecretKey
+from lighthouse_tpu.node.light_client_server import LightClientServerCache
+from lighthouse_tpu.node.store import HotColdDB, LogStore
+
+SPEC = mainnet_spec()
+N = 16
+
+
+def _pubkeys(n=N):
+    return [
+        SecretKey.from_seed(i.to_bytes(4, "big")).public_key().to_bytes()
+        for i in range(n)
+    ]
+
+
+def _chain(tmp_path, spec=SPEC):
+    from lighthouse_tpu.node.client import ClientBuilder
+
+    node = (
+        ClientBuilder(spec)
+        .store(HotColdDB(spec, LogStore(str(tmp_path))))
+        .genesis_state(st.interop_genesis_state(spec, _pubkeys()))
+        .bls_backend("fake")
+        .build()
+    )
+    return node.chain
+
+
+def _extend(chain, slot, sync_bits=None):
+    chain.on_slot(slot)
+    sig = b"\xc0" + b"\x00" * 95
+    block = chain.produce_block(slot, randao_reveal=sig)
+    if sync_bits is not None:
+        # rebuild the block with the injected sync aggregate (and the
+        # matching post-state root) — fake backend skips signatures
+        body = block.body
+        body.sync_aggregate = T.SyncAggregate.make(
+            sync_committee_bits=sync_bits,
+            sync_committee_signature=sig,
+        )
+        state = chain.head_state().copy()
+        if state.slot < slot:
+            st.process_slots(chain.spec, state, slot)
+        block = T.BeaconBlock.make(
+            slot=block.slot,
+            proposer_index=block.proposer_index,
+            parent_root=bytes(block.parent_root),
+            state_root=b"\x00" * 32,
+            body=body,
+        )
+        st.process_block(chain.spec, state, block, verify_signatures=False)
+        block.state_root = state.hash_tree_root()
+    signed = T.SignedBeaconBlock.make(message=block, signature=sig)
+    chain.process_block(signed)
+    return signed
+
+
+# ---------------------------------------------------------------- indices
+
+
+def test_generalized_indices_match_altair_constants():
+    assert lc.CURRENT_SYNC_COMMITTEE_INDEX == 54
+    assert lc.NEXT_SYNC_COMMITTEE_INDEX == 55
+    assert lc.FINALIZED_ROOT_INDEX == 105
+    assert lc.STATE_PROOF_DEPTH == 5
+    assert lc.FINALITY_PROOF_DEPTH == 6
+
+
+def test_state_field_proofs_verify_against_state_root():
+    state = st.interop_genesis_state(SPEC, _pubkeys())
+    root = state.hash_tree_root()
+    branch = lc.state_field_branch(state, "current_sync_committee")
+    assert verify_merkle_branch(
+        T.SyncCommittee.hash_tree_root(state.current_sync_committee),
+        branch,
+        lc.STATE_PROOF_DEPTH,
+        lc.CURRENT_SYNC_COMMITTEE_INDEX % 32,
+        root,
+    )
+    fbranch = lc.finality_branch(state)
+    assert verify_merkle_branch(
+        bytes(state.finalized_checkpoint.root),
+        fbranch,
+        lc.FINALITY_PROOF_DEPTH,
+        lc.FINALIZED_ROOT_INDEX % 64,
+        root,
+    )
+    # a corrupted branch must fail
+    bad = list(fbranch)
+    bad[2] = b"\x00" * 32
+    assert not verify_merkle_branch(
+        bytes(state.finalized_checkpoint.root),
+        bad,
+        lc.FINALITY_PROOF_DEPTH,
+        lc.FINALIZED_ROOT_INDEX % 64,
+        root,
+    )
+
+
+# --------------------------------------------------------------- bootstrap
+
+
+def test_bootstrap_roundtrip_and_validation(tmp_path):
+    chain = _chain(tmp_path)
+    chain.light_client_cache = LightClientServerCache(chain)
+    signed = _extend(chain, 1)
+    root = signed.message.hash_tree_root()
+    bootstrap = chain.light_client_cache.get_bootstrap(root)
+    assert bootstrap is not None
+    # SSZ round-trip (the RPC wire format)
+    raw = lc.LightClientBootstrap.serialize(bootstrap)
+    bootstrap2 = lc.LightClientBootstrap.deserialize(raw)
+    store = lc.validate_bootstrap(root, bootstrap2)
+    assert int(store.finalized_header.beacon.slot) == 1
+    with pytest.raises(lc.LightClientError):
+        lc.validate_bootstrap(b"\x99" * 32, bootstrap2)
+
+
+# ------------------------------------------------------------ server cache
+
+
+def test_server_cache_produces_updates(tmp_path):
+    chain = _chain(tmp_path)
+    chain.light_client_cache = LightClientServerCache(chain)
+    size = SPEC.preset.sync_committee_size
+    _extend(chain, 1)
+    _extend(chain, 2, sync_bits=[True] * size)
+    cache = chain.light_client_cache
+    opt = cache.latest_optimistic_update
+    assert opt is not None
+    assert int(opt.attested_header.beacon.slot) == 1
+    assert int(opt.signature_slot) == 2
+    # the update's committee branch verifies against the attested state
+    period = lc.sync_committee_period(SPEC, 1)
+    upd = cache.best_updates[period]
+    assert verify_merkle_branch(
+        T.SyncCommittee.hash_tree_root(upd.next_sync_committee),
+        [bytes(b) for b in upd.next_sync_committee_branch],
+        lc.STATE_PROOF_DEPTH,
+        lc.NEXT_SYNC_COMMITTEE_INDEX % 32,
+        bytes(upd.attested_header.beacon.state_root),
+    )
+    # a fuller participation replaces a thinner one, not vice versa
+    half = [i < size // 2 for i in range(size)]
+    _extend(chain, 3, sync_bits=half)
+    assert cache._participants(cache.best_updates[period]) == size
+
+
+# ----------------------------------------------------------------- rpc
+
+
+def test_light_client_rpc_serving(tmp_path):
+    from lighthouse_tpu.network.rpc import Protocol, ResponseCode
+
+    chain = _chain(tmp_path)
+    chain.light_client_cache = LightClientServerCache(chain)
+    size = SPEC.preset.sync_committee_size
+    signed1 = _extend(chain, 1)
+    _extend(chain, 2, sync_bits=[True] * size)
+
+    # drive the serving handlers directly (the wire path is exercised
+    # by test_network's two-node harness for the block protocols)
+    from lighthouse_tpu.network import network_beacon_processor as nbp
+
+    class _Svc:
+        class rpc:
+            handlers = {}
+
+            @classmethod
+            def register(cls, proto, fn):
+                cls.handlers[proto] = fn
+
+    proc = object.__new__(nbp.NetworkBeaconProcessor)
+    proc.chain = chain
+    proc.service = _Svc
+    proc._register_rpc.__func__
+    nbp.NetworkBeaconProcessor._register_rpc(proc)
+    handlers = _Svc.rpc.handlers
+
+    code, chunks = handlers[Protocol.LIGHT_CLIENT_BOOTSTRAP](
+        "peer", signed1.message.hash_tree_root()
+    )
+    assert code == ResponseCode.SUCCESS
+    bootstrap = lc.LightClientBootstrap.deserialize(chunks[0])
+    assert int(bootstrap.header.beacon.slot) == 1
+
+    code, chunks = handlers[Protocol.LIGHT_CLIENT_OPTIMISTIC_UPDATE]("peer", b"")
+    assert code == ResponseCode.SUCCESS
+    opt = lc.LightClientOptimisticUpdate.deserialize(chunks[0])
+    assert int(opt.signature_slot) == 2
+
+    req = lc.LightClientUpdatesByRangeRequest.make(start_period=0, count=4)
+    code, chunks = handlers[Protocol.LIGHT_CLIENT_UPDATES_BY_RANGE](
+        "peer", lc.LightClientUpdatesByRangeRequest.serialize(req)
+    )
+    assert code == ResponseCode.SUCCESS and len(chunks) == 1
+
+
+# ------------------------------------------------- verified update flow
+
+
+@pytest.mark.crypto_heavy
+def test_process_update_with_real_signatures():
+    """A hand-built update with a real 2/3+ sync aggregate (cpu BLS)
+    advances the light client's store; insufficient participation and
+    wrong-root signatures are rejected."""
+    from lighthouse_tpu.consensus.domains import compute_signing_root, get_domain
+    from lighthouse_tpu.consensus.signature_sets import _Bytes32SSZ
+    from lighthouse_tpu.crypto.bls.keys import aggregate_signatures
+
+    spec = minimal_spec()
+    size = spec.preset.sync_committee_size
+    sks = [SecretKey.from_seed((1000 + i).to_bytes(4, "big")) for i in range(size)]
+    committee = T.SyncCommittee.make(
+        pubkeys=[sk.public_key().to_bytes() for sk in sks],
+        aggregate_pubkey=sks[0].public_key().to_bytes(),
+    )
+    gvr = b"\x07" * 32
+
+    # the attested state: put the SAME committee as next (period 0)
+    state = st.interop_genesis_state(spec, _pubkeys(8))
+    state.next_sync_committee = committee
+    state.current_sync_committee = committee
+    state.slot = 1
+    attested_header = lc.LightClientHeader.make(
+        beacon=T.BeaconBlockHeader.make(
+            slot=1, proposer_index=0, parent_root=b"\x01" * 32,
+            state_root=state.hash_tree_root(), body_root=b"\x02" * 32,
+        )
+    )
+    attested_root = T.BeaconBlockHeader.hash_tree_root(attested_header.beacon)
+
+    # 2/3+ of the committee signs the attested root (sync-message form)
+    sig_slot = 2
+    epoch = st.compute_epoch_at_slot(spec, sig_slot - 1)
+    domain = get_domain(
+        spec, spec.domain_sync_committee, epoch, spec.fork_at_epoch(epoch), gvr
+    )
+    root = compute_signing_root(_Bytes32SSZ(attested_root), domain)
+    k = (2 * size) // 3 + 1
+    agg = aggregate_signatures([sk.sign(root) for sk in sks[:k]])
+    bits = [i < k for i in range(size)]
+    update = lc.LightClientUpdate.make(
+        attested_header=attested_header,
+        next_sync_committee=state.next_sync_committee,
+        next_sync_committee_branch=lc.state_field_branch(
+            state, "next_sync_committee"
+        ),
+        finalized_header=lc.LightClientHeader.default(),
+        finality_branch=[b"\x00" * 32] * lc.FINALITY_PROOF_DEPTH,
+        sync_aggregate=T.SyncAggregate.make(
+            sync_committee_bits=bits,
+            sync_committee_signature=agg.to_bytes(),
+        ),
+        signature_slot=sig_slot,
+    )
+
+    store = lc.LightClientStore(
+        finalized_header=lc.LightClientHeader.make(
+            beacon=T.BeaconBlockHeader.make(
+                slot=0, proposer_index=0, parent_root=b"\x00" * 32,
+                state_root=b"\x00" * 32, body_root=b"\x00" * 32,
+            )
+        ),
+        current_sync_committee=committee,
+    )
+    lc.process_light_client_update(
+        store, update, current_slot=3, spec=spec,
+        genesis_validators_root=gvr, bls_backend="cpu",
+    )
+    assert int(store.optimistic_header.beacon.slot) == 1
+    assert store.next_sync_committee is not None
+    assert store.current_max_active_participants == k
+
+    # too few participants -> rejected
+    thin_bits = [i < size // 3 for i in range(size)]
+    thin_agg = aggregate_signatures([sk.sign(root) for sk in sks[: size // 3]])
+    thin = lc.LightClientUpdate.make(
+        attested_header=update.attested_header,
+        next_sync_committee=update.next_sync_committee,
+        next_sync_committee_branch=update.next_sync_committee_branch,
+        finalized_header=update.finalized_header,
+        finality_branch=update.finality_branch,
+        sync_aggregate=T.SyncAggregate.make(
+            sync_committee_bits=thin_bits,
+            sync_committee_signature=thin_agg.to_bytes(),
+        ),
+        signature_slot=sig_slot,
+    )
+    with pytest.raises(lc.LightClientError):
+        lc.process_light_client_update(
+            store, thin, current_slot=3, spec=spec,
+            genesis_validators_root=gvr, bls_backend="cpu",
+        )
+
+    # signature over the WRONG root -> rejected
+    bad_root = compute_signing_root(_Bytes32SSZ(b"\xAA" * 32), domain)
+    bad_agg = aggregate_signatures([sk.sign(bad_root) for sk in sks[:k]])
+    bad = lc.LightClientUpdate.make(
+        attested_header=update.attested_header,
+        next_sync_committee=update.next_sync_committee,
+        next_sync_committee_branch=update.next_sync_committee_branch,
+        finalized_header=update.finalized_header,
+        finality_branch=update.finality_branch,
+        sync_aggregate=T.SyncAggregate.make(
+            sync_committee_bits=bits,
+            sync_committee_signature=bad_agg.to_bytes(),
+        ),
+        signature_slot=sig_slot,
+    )
+    with pytest.raises(lc.LightClientError):
+        lc.process_light_client_update(
+            store, bad, current_slot=3, spec=spec,
+            genesis_validators_root=gvr, bls_backend="cpu",
+        )
