@@ -1,0 +1,228 @@
+"""PeerDAS data columns: the DataColumnSidecar type, column
+construction from blobs (kzg_utils blob->column role), custody
+assignment, and gossip verification
+(reference consensus/types data_column_sidecar.rs,
+beacon_chain/src/data_column_verification.rs, kzg_utils.rs,
+network custody assignment in sync/network_context/custody.rs).
+
+The blob matrix view: row b = blob b's CELLS_PER_EXT_BLOB cells;
+COLUMN j = cell j of every blob. A node custodies a deterministic
+pseudo-random set of columns derived from its node id and serves/
+verifies only those; sampling queries SAMPLES_PER_SLOT random columns
+per slot to probabilistically confirm availability.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Optional, Sequence
+
+from ..crypto.kzg.peerdas import CELLS_PER_EXT_BLOB
+from .merkle_proof import merkle_branch, verify_merkle_branch, _next_pow2
+from . import types as T
+from .ssz import ByteList, Bytes32, Bytes48, Container, List, Vector, uint64
+
+NUMBER_OF_COLUMNS = CELLS_PER_EXT_BLOB  # 128
+DATA_COLUMN_SIDECAR_SUBNET_COUNT = 128
+CUSTODY_REQUIREMENT = 4
+SAMPLES_PER_SLOT = 8
+MAX_CELL_BYTES = 64 * 32  # FIELD_ELEMENTS_PER_CELL * 32
+
+# commitments-LIST inclusion proof: the body has 12 fields -> 16
+# leaves, depth 4 (data_column_sidecar.rs
+# KZG_COMMITMENTS_INCLUSION_PROOF_DEPTH)
+_BODY_FIELDS = [name for name, _ in T.BeaconBlockBody.fields]
+_COMMITMENTS_FIELD = _BODY_FIELDS.index("blob_kzg_commitments")
+_BODY_WIDTH = _next_pow2(len(_BODY_FIELDS))
+KZG_COMMITMENTS_INCLUSION_PROOF_DEPTH = _BODY_WIDTH.bit_length() - 1
+
+# Cell as ByteList so shrunk test geometries (smaller cells) round-trip
+# through the same container; mainnet cells are exactly MAX_CELL_BYTES.
+Cell = ByteList(MAX_CELL_BYTES)
+
+DataColumnSidecar = Container(
+    "DataColumnSidecar",
+    [
+        ("index", uint64),
+        # limits = max_blob_commitments_per_block (spec preset)
+        ("column", List(Cell, 4096)),
+        ("kzg_commitments", List(Bytes48, 4096)),
+        ("kzg_proofs", List(Bytes48, 4096)),
+        ("signed_block_header", T.SignedBeaconBlockHeader),
+        (
+            "kzg_commitments_inclusion_proof",
+            Vector(Bytes32, KZG_COMMITMENTS_INCLUSION_PROOF_DEPTH),
+        ),
+    ],
+)
+
+DataColumnIdentifier = Container(
+    "DataColumnIdentifier", [("block_root", Bytes32), ("index", uint64)]
+)
+
+DataColumnsByRangeRequest = Container(
+    "DataColumnsByRangeRequest",
+    [
+        ("start_slot", uint64),
+        ("count", uint64),
+        ("columns", List(uint64, NUMBER_OF_COLUMNS)),
+    ],
+)
+
+
+class DataColumnError(Exception):
+    pass
+
+
+# ------------------------------------------------------- construction
+
+
+def compute_commitments_inclusion_proof(body) -> list:
+    """Branch proving the blob_kzg_commitments LIST against body root."""
+    roots = [
+        ftype.hash_tree_root(getattr(body, fname))
+        for fname, ftype in T.BeaconBlockBody.fields
+    ]
+    return merkle_branch(roots, _BODY_WIDTH, _COMMITMENTS_FIELD)
+
+
+def verify_commitments_inclusion_proof(sidecar) -> bool:
+    commitments_type = dict(T.BeaconBlockBody.fields)["blob_kzg_commitments"]
+    leaf = commitments_type.hash_tree_root(list(sidecar.kzg_commitments))
+    return verify_merkle_branch(
+        leaf,
+        [bytes(b) for b in sidecar.kzg_commitments_inclusion_proof],
+        KZG_COMMITMENTS_INCLUSION_PROOF_DEPTH,
+        _COMMITMENTS_FIELD,
+        bytes(sidecar.signed_block_header.message.body_root),
+    )
+
+
+def build_sidecars(
+    signed_block,
+    cell_matrix: Sequence[Sequence[bytes]],
+    proof_matrix: Sequence[Sequence[bytes]],
+    n_columns: int = NUMBER_OF_COLUMNS,
+) -> list:
+    """kzg_utils blob->column sidecar construction: `cell_matrix[b][j]`
+    is blob b's cell j as bytes; column j gathers that cell from every
+    blob, with the full commitment list + inclusion proof repeated per
+    sidecar (data_column_sidecar.rs build path)."""
+    block = signed_block.message
+    commitments = [bytes(c) for c in block.body.blob_kzg_commitments]
+    if len(cell_matrix) != len(commitments):
+        raise DataColumnError("one cell row per commitment required")
+    header = T.SignedBeaconBlockHeader.make(
+        message=T.BeaconBlockHeader.make(
+            slot=block.slot,
+            proposer_index=block.proposer_index,
+            parent_root=bytes(block.parent_root),
+            state_root=bytes(block.state_root),
+            body_root=block.body.hash_tree_root(),
+        ),
+        signature=bytes(signed_block.signature),
+    )
+    inclusion = compute_commitments_inclusion_proof(block.body)
+    out = []
+    for j in range(n_columns):
+        out.append(
+            DataColumnSidecar.make(
+                index=j,
+                column=[bytes(row[j]) for row in cell_matrix],
+                kzg_commitments=commitments,
+                kzg_proofs=[bytes(row[j]) for row in proof_matrix],
+                signed_block_header=header,
+                kzg_commitments_inclusion_proof=inclusion,
+            )
+        )
+    return out
+
+
+# ------------------------------------------------------------ custody
+
+
+def pseudo_random_selection(seed: bytes, k: int, space: int) -> list:
+    """k distinct hash-derived values in [0, space) — the shared
+    derivation for custody subnets AND per-block sample columns."""
+    out, i = [], 0
+    while len(out) < k:
+        h = hashlib.sha256(bytes(seed) + i.to_bytes(8, "little")).digest()
+        v = int.from_bytes(h[:8], "little") % space
+        if v not in out:
+            out.append(v)
+        i += 1
+    return out
+
+
+def get_custody_columns(node_id: bytes, custody_subnet_count: int = CUSTODY_REQUIREMENT) -> list:
+    """Deterministic pseudo-random custody assignment from the node id
+    (the spec's get_custody_columns shape: hash-derived subnet ids,
+    columns striped across subnets)."""
+    if custody_subnet_count > DATA_COLUMN_SIDECAR_SUBNET_COUNT:
+        raise DataColumnError("custody count exceeds subnet count")
+    subnets = pseudo_random_selection(
+        node_id, custody_subnet_count, DATA_COLUMN_SIDECAR_SUBNET_COUNT
+    )
+    per = NUMBER_OF_COLUMNS // DATA_COLUMN_SIDECAR_SUBNET_COUNT
+    cols = []
+    for sid in subnets:
+        cols.extend(
+            DATA_COLUMN_SIDECAR_SUBNET_COUNT * k + sid for k in range(per)
+        )
+    return sorted(cols)
+
+
+def compute_subnet_for_column(index: int) -> int:
+    return index % DATA_COLUMN_SIDECAR_SUBNET_COUNT
+
+
+# ------------------------------------------------------- verification
+
+
+class DataColumnVerifier:
+    """Gossip-path verification (data_column_verification.rs):
+    structural checks + inclusion proof + ONE batched cell-proof check
+    per sidecar; header-signature verification rides the chain's
+    block-header path, supplied as a callable."""
+
+    def __init__(self, cell_context, verify_header_signature=None):
+        self.ctx = cell_context
+        self._verify_header = verify_header_signature or (lambda h: True)
+
+    def verify_sidecar(self, sidecar) -> None:
+        idx = int(sidecar.index)
+        if idx >= NUMBER_OF_COLUMNS:
+            raise DataColumnError("column index out of range")
+        n = len(sidecar.column)
+        if not (
+            n == len(sidecar.kzg_commitments) == len(sidecar.kzg_proofs)
+        ):
+            raise DataColumnError("column/commitment/proof length mismatch")
+        if n == 0:
+            raise DataColumnError("empty column")
+        if not verify_commitments_inclusion_proof(sidecar):
+            raise DataColumnError("bad commitments inclusion proof")
+        if not self._verify_header(sidecar.signed_block_header):
+            raise DataColumnError("bad header signature")
+        from ..crypto.bls import curve as C
+
+        # everything below parses REMOTE bytes — any malformation must
+        # surface as DataColumnError so callers' failover paths fire
+        try:
+            commitments = [
+                C.g1_decompress(bytes(cm)) for cm in sidecar.kzg_commitments
+            ]
+            proofs = [C.g1_decompress(bytes(p)) for p in sidecar.kzg_proofs]
+            cells = [
+                self.ctx.cell_from_bytes(bytes(cell))
+                for cell in sidecar.column
+            ]
+            ok = self.ctx.verify_cell_proof_batch(
+                commitments, [idx] * n, cells, proofs
+            )
+        except DataColumnError:
+            raise
+        except Exception as e:  # noqa: BLE001 — remote-bytes boundary
+            raise DataColumnError(f"malformed sidecar: {e}") from None
+        if not ok:
+            raise DataColumnError("cell proof batch failed")

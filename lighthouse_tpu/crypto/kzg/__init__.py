@@ -111,6 +111,10 @@ class TrustedSetup:
     g1_lagrange: list          # [L_i(tau)]G1, bit-reversed domain order
     g2_tau: tuple              # [tau]G2
     roots: list                # domain, bit-reversed order
+    # monomial powers — required only by the PeerDAS cell ops
+    # (coefficient-form quotient proofs); None for Lagrange-only setups
+    g1_monomial: list = None   # [[tau^i]G1]
+    g2_monomial: list = None   # [[tau^i]G2] (up to cell size + 1)
 
     @classmethod
     def dev(cls, n: int = FIELD_ELEMENTS_PER_BLOB) -> "TrustedSetup":
@@ -141,8 +145,21 @@ class TrustedSetup:
                     % R
                 )
             g1s.append(C.g1_mul(G1_GEN, li))
+        # monomial powers for the PeerDAS cell ops (dev setup knows tau)
+        g1m, acc = [], 1
+        for _ in range(n):
+            g1m.append(C.g1_mul(G1_GEN, acc))
+            acc = acc * tau % R
+        g2m, acc = [], 1
+        for _ in range(min(n, 65) + 1):
+            g2m.append(C.g2_mul(G2_GEN, acc))
+            acc = acc * tau % R
         return cls(
-            g1_lagrange=g1s, g2_tau=C.g2_mul(G2_GEN, tau), roots=roots
+            g1_lagrange=g1s,
+            g2_tau=C.g2_mul(G2_GEN, tau),
+            roots=roots,
+            g1_monomial=g1m,
+            g2_monomial=g2m,
         )
 
     @classmethod
@@ -153,15 +170,27 @@ class TrustedSetup:
             C.g1_decompress(bytes.fromhex(h[2:] if h.startswith("0x") else h))
             for h in obj["g1_lagrange"]
         ]
+        def _pt2(h):
+            return C.g2_decompress(
+                bytes.fromhex(h[2:] if h.startswith("0x") else h)
+            )
+
         g2s = obj["g2_monomial"]
-        h1 = g2s[1]
-        g2_tau = C.g2_decompress(
-            bytes.fromhex(h1[2:] if h1.startswith("0x") else h1)
-        )
+        g2_tau = _pt2(g2s[1])
+        g1m = None
+        if "g1_monomial" in obj:
+            g1m = [
+                C.g1_decompress(
+                    bytes.fromhex(h[2:] if h.startswith("0x") else h)
+                )
+                for h in obj["g1_monomial"]
+            ]
         return cls(
             g1_lagrange=g1s,
             g2_tau=g2_tau,
             roots=compute_roots_of_unity(len(g1s)),
+            g1_monomial=g1m,
+            g2_monomial=[_pt2(h) for h in g2s],
         )
 
 

@@ -1,0 +1,376 @@
+"""PeerDAS cell operations (EIP-7594; reference crypto/kzg's
+rust_eth_kzg DASContext: compute_cells_and_proofs lib.rs:221,
+verify_cell_proof_batch lib.rs:240, recover_cells_and_compute_kzg_proofs
+lib.rs:267).
+
+A blob's polynomial (degree < n, evaluation form) is Reed-Solomon
+extended to 2n points and split into CELLS_PER_EXT_BLOB multiplicative
+cosets of FIELD_ELEMENTS_PER_CELL points each: with w the 2n-th root of
+unity, cell i is the coset  w^{rbo(i)} · H,  H = <w^{cells}>.  Each cell
+carries a KZG multi-opening proof [q_i(tau)]G1 for
+
+    q_i(X) = (p(X) - I_i(X)) / Z_i(X),   Z_i(X) = X^c - h_i^c
+
+(c = cell size, h_i the coset shift, I_i the coset interpolant), which
+one pairing pair batch-verifies via a random linear combination:
+
+    e(sum r_i (C_i - [I_i(tau)]G1 + h_i^c P_i), G2)
+      * e(-sum r_i P_i, [tau^c]G2) == 1
+
+Recovery from any >=50% of cells runs the standard vanishing-polynomial
+erasure decoder (zero-poly over missing cosets, coset-FFT division).
+
+Fr FFTs run on the host; the G1 MSMs ride the same device seam as the
+blob commitments (ops/msm.py). Cell layout inside a cell is the c-kzg
+bit-reversed enumeration of the natural coset order.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Optional, Sequence
+
+from ..bls import curve as C
+from . import (
+    G1_GEN,
+    G2_GEN,
+    KzgError,
+    R,
+    TrustedSetup,
+    _bit_reverse,
+    _msm_host,
+    fr_batch_inverse,
+    fr_to_bytes,
+    bytes_to_fr,
+    blob_to_field_elements,
+)
+from ..bls import pairing_fast as PF
+
+# mainnet constants (EIP-7594)
+CELLS_PER_EXT_BLOB = 128
+FIELD_ELEMENTS_PER_CELL = 64
+BYTES_PER_CELL = FIELD_ELEMENTS_PER_CELL * 32
+
+RANDOM_CHALLENGE_KZG_CELL_BATCH_DOMAIN = b"RCKZGCBATCH__V1_"
+
+_PRIMITIVE_ROOT = 7
+
+
+def _root_of_unity(order: int) -> int:
+    """Primitive `order`-th root in Fr (2-adicity 32)."""
+    assert order & (order - 1) == 0
+    return pow(_PRIMITIVE_ROOT, (R - 1) // order, R)
+
+
+def fft(vals: Sequence[int], inverse: bool = False) -> list:
+    """Iterative radix-2 NTT over Fr, natural order in/out."""
+    n = len(vals)
+    assert n & (n - 1) == 0
+    a = [v % R for v in vals]
+    # bit-reversal permutation (_bit_reverse takes the domain SIZE)
+    for i in range(n):
+        j = _bit_reverse(i, n)
+        if i < j:
+            a[i], a[j] = a[j], a[i]
+    root = _root_of_unity(n)
+    if inverse:
+        root = pow(root, R - 2, R)
+    length = 2
+    while length <= n:
+        w_len = pow(root, n // length, R)
+        for start in range(0, n, length):
+            w = 1
+            half = length // 2
+            for k in range(start, start + half):
+                u = a[k]
+                v = a[k + half] * w % R
+                a[k] = (u + v) % R
+                a[k + half] = (u - v) % R
+                w = w * w_len % R
+        length *= 2
+    if inverse:
+        n_inv = pow(n, R - 2, R)
+        a = [x * n_inv % R for x in a]
+    return a
+
+
+class CellContext:
+    """DASContext analog: cell compute/verify/recover over one setup.
+
+    `n` is the blob size (power of two), `cells` the cell count; mainnet
+    is (4096, 128); tests shrink both. Requires a monomial setup."""
+
+    def __init__(
+        self,
+        setup: Optional[TrustedSetup] = None,
+        n: int = None,
+        cells: int = CELLS_PER_EXT_BLOB,
+        msm=None,
+        pairing=None,
+    ):
+        self.setup = setup or TrustedSetup.dev()
+        self.n = n or len(self.setup.g1_lagrange)
+        if self.setup.g1_monomial is None:
+            raise KzgError("cell ops need a monomial trusted setup")
+        if len(self.setup.g1_monomial) < self.n:
+            raise KzgError("monomial setup shorter than blob size")
+        self.ext_n = 2 * self.n
+        self.cells = cells
+        self.cell_size = self.ext_n // cells
+        if self.cell_size < 1:
+            raise KzgError("cell size underflow")
+        if len(self.setup.g2_monomial or []) <= self.cell_size:
+            raise KzgError("g2 monomial setup shorter than cell size + 1")
+        self._msm = msm or _msm_host
+        self._pairing = pairing or (
+            lambda pairs: PF.pairings_product_is_one_fast(pairs)
+        )
+        self._w_ext = _root_of_unity(self.ext_n)
+
+    # ------------------------------------------------------------ layout
+
+    def coset_shift(self, cell_index: int) -> int:
+        return pow(self._w_ext, _bit_reverse(cell_index, self.cells), R)
+
+    def _coset_points(self, cell_index: int) -> list:
+        h = self.coset_shift(cell_index)
+        g = pow(self._w_ext, self.cells, R)  # order = cell_size
+        pts, acc = [], h
+        for _ in range(self.cell_size):
+            pts.append(acc)
+            acc = acc * g % R
+        return pts
+
+    # ------------------------------------------------------- compute
+
+    def blob_to_coeffs(self, blob: bytes) -> list:
+        """Evaluation form (bit-reversed domain, the 4844 layout) ->
+        coefficient form."""
+        fields = blob_to_field_elements(blob, self.n)
+        nat = [0] * self.n
+        for i, v in enumerate(fields):
+            nat[_bit_reverse(i, self.n)] = v
+        return fft(nat, inverse=True)
+
+    def compute_cells_and_proofs(self, blob: bytes) -> tuple:
+        """-> ([cells]: list of list[int], [proof points])."""
+        coeffs = self.blob_to_coeffs(blob)
+        ext_evals = fft(coeffs + [0] * (self.ext_n - self.n))
+        cells_out = []
+        for i in range(self.cells):
+            shift_pow = _bit_reverse(i, self.cells)
+            vals = []
+            for j in range(self.cell_size):
+                m = _bit_reverse(j, self.cell_size)
+                idx = (shift_pow + self.cells * m) % self.ext_n
+                vals.append(ext_evals[idx])
+            cells_out.append(vals)
+        proofs = [
+            self._cell_proof(coeffs, i) for i in range(self.cells)
+        ]
+        return cells_out, proofs
+
+    def _quotient_and_interpolant(self, coeffs: list, zc: int) -> tuple:
+        """Divide p by Z(X) = X^c - zc: p = q Z + r, deg r < c.
+        O(n) because X^c ≡ zc (mod Z)."""
+        c = self.cell_size
+        r = list(coeffs) + [0] * ((-len(coeffs)) % c)
+        q = [0] * max(len(r) - c, 0)
+        for i in range(len(r) - 1, c - 1, -1):
+            q[i - c] = (q[i - c] + r[i]) % R
+            r[i - c] = (r[i - c] + zc * r[i]) % R
+            r[i] = 0
+        return q, r[:c]
+
+    def _cell_proof(self, coeffs: list, cell_index: int):
+        h = self.coset_shift(cell_index)
+        zc = pow(h, self.cell_size, R)
+        q, _ = self._quotient_and_interpolant(coeffs, zc)
+        if not any(q):
+            return None  # identity proof (constant polynomial)
+        return self._msm(self.setup.g1_monomial[: len(q)], q)
+
+    # -------------------------------------------------------- verify
+
+    def _interpolant_commitment(self, cell_index: int, cell_vals: list):
+        """[I(tau)]G1 for the coset interpolant of one cell: un-bit-
+        reverse to natural coset order, subgroup-IFFT, unscale by h."""
+        c = self.cell_size
+        nat = [0] * c
+        for j, v in enumerate(cell_vals):
+            nat[_bit_reverse(j, self.cell_size)] = v
+        # I(h x) has subgroup-IFFT coeffs a_k; I coeffs = a_k h^{-k}.
+        # The order-c subgroup's canonical root IS _root_of_unity(c)
+        # (= w_ext^cells), so the plain size-c IFFT is the subgroup IFFT.
+        sub = fft(nat, inverse=True)
+        h_inv = pow(self.coset_shift(cell_index), R - 2, R)
+        coeff, acc = [], 1
+        for a in sub:
+            coeff.append(a * acc % R)
+            acc = acc * h_inv % R
+        return coeff
+
+    def verify_cell_proof_batch(
+        self,
+        commitments: Sequence,
+        cell_indices: Sequence[int],
+        cells: Sequence[Sequence[int]],
+        proofs: Sequence,
+    ) -> bool:
+        """verify_cell_kzg_proof_batch: ONE pairing pair for any number
+        of (commitment, cell, proof) rows via RLC."""
+        if not (
+            len(commitments) == len(cell_indices) == len(cells) == len(proofs)
+        ):
+            raise KzgError("length mismatch")
+        if not cells:
+            return True
+        for idx, vals in zip(cell_indices, cells):
+            if not 0 <= idx < self.cells:
+                raise KzgError("cell index out of range")
+            if len(vals) != self.cell_size:
+                raise KzgError("bad cell size")
+        rs = self._batch_challenges(commitments, cell_indices, cells, proofs)
+        c = self.cell_size
+        lhs_pts, lhs_scalars = [], []
+        p_pts, p_scalars = [], []
+        for (cm, idx, vals, pr), r in zip(
+            zip(commitments, cell_indices, cells, proofs), rs
+        ):
+            h_c = pow(self.coset_shift(idx), c, R)
+            lhs_pts.append(cm)
+            lhs_scalars.append(r)
+            icoeff = self._interpolant_commitment(idx, list(vals))
+            for k, a in enumerate(icoeff):
+                lhs_pts.append(self.setup.g1_monomial[k])
+                lhs_scalars.append((-(a * r)) % R)
+            if pr is not None:
+                lhs_pts.append(pr)
+                lhs_scalars.append(h_c * r % R)
+                p_pts.append(pr)
+                p_scalars.append(r)
+        lhs = self._msm(lhs_pts, lhs_scalars)
+        pagg = self._msm(p_pts, p_scalars)
+        pairs = []
+        if lhs is not None:
+            pairs.append((lhs, G2_GEN))
+        if pagg is not None:
+            pairs.append((C.g1_neg(pagg), self.setup.g2_monomial[c]))
+        if not pairs:
+            return True
+        return self._pairing(pairs)
+
+    def _batch_challenges(self, commitments, indices, cells, proofs) -> list:
+        data = RANDOM_CHALLENGE_KZG_CELL_BATCH_DOMAIN
+        data += self.n.to_bytes(8, "little") + len(cells).to_bytes(8, "little")
+        for cm, idx, vals, pr in zip(commitments, indices, cells, proofs):
+            data += C.g1_compress(cm) + int(idx).to_bytes(8, "little")
+            for v in vals:
+                data += fr_to_bytes(v)
+            data += C.g1_compress(pr) if pr is not None else b"\xc0" + b"\x00" * 47
+        r = int.from_bytes(hashlib.sha256(data).digest(), "big") % R
+        out, acc = [], 1
+        for _ in cells:
+            out.append(acc)
+            acc = acc * r % R
+        return out
+
+    # ------------------------------------------------------- recover
+
+    def recover_cells_and_proofs(
+        self, cell_indices: Sequence[int], cells: Sequence[Sequence[int]]
+    ) -> tuple:
+        """Erasure-recover the full cell set (plus fresh proofs) from
+        any >= 50% of cells (recover_cells_and_compute_kzg_proofs)."""
+        have = dict(zip((int(i) for i in cell_indices), cells))
+        if len(have) * 2 < self.cells:
+            raise KzgError("need at least half the cells to recover")
+        if len(have) == self.cells:
+            coeffs = self._cells_to_coeffs(have)
+        else:
+            coeffs = self._recover_coeffs(have)
+        # re-derive all cells directly from the coefficients
+        ext_evals = fft(coeffs + [0] * (self.ext_n - self.n))
+        out_cells = []
+        for i in range(self.cells):
+            shift_pow = _bit_reverse(i, self.cells)
+            vals = []
+            for j in range(self.cell_size):
+                m = _bit_reverse(j, self.cell_size)
+                vals.append(ext_evals[(shift_pow + self.cells * m) % self.ext_n])
+            out_cells.append(vals)
+        proofs = [self._cell_proof(coeffs, i) for i in range(self.cells)]
+        return out_cells, proofs
+
+    def _cells_to_coeffs(self, have: dict) -> list:
+        ext = [0] * self.ext_n
+        for i, vals in have.items():
+            shift_pow = _bit_reverse(i, self.cells)
+            for j, v in enumerate(vals):
+                m = _bit_reverse(j, self.cell_size)
+                ext[(shift_pow + self.cells * m) % self.ext_n] = v
+        coeffs = fft(ext, inverse=True)
+        if any(x != 0 for x in coeffs[self.n :]):
+            raise KzgError("cells are not a degree-n extension")
+        return coeffs[: self.n]
+
+    def _recover_coeffs(self, have: dict) -> list:
+        """Vanishing-polynomial erasure decoding (c-kzg recover):
+        Z vanishes on missing cosets; (pZ) is recoverable from the
+        received points; divide on a shifted domain."""
+        missing = [i for i in range(self.cells) if i not in have]
+        # Z(X) = prod (X^c - h_i^c): build by convolving sparse factors
+        z = [1]
+        c = self.cell_size
+        for i in missing:
+            hc = pow(self.coset_shift(i), c, R)
+            nz = [0] * (len(z) + c)
+            for d, coef in enumerate(z):
+                nz[d] = (nz[d] - hc * coef) % R  # -h^c * X^d
+                nz[d + c] = (nz[d + c] + coef) % R  # X^{d+c}
+            z = nz
+        z += [0] * (self.ext_n - len(z))
+        z_evals = fft(z)
+
+        ext = [0] * self.ext_n
+        for i, vals in have.items():
+            shift_pow = _bit_reverse(i, self.cells)
+            for j, v in enumerate(vals):
+                m = _bit_reverse(j, self.cell_size)
+                ext[(shift_pow + self.cells * m) % self.ext_n] = v
+        pz_evals = [e * zv % R for e, zv in zip(ext, z_evals)]
+        pz_coeffs = fft(pz_evals, inverse=True)
+
+        # divide on the coset s·domain where Z has no roots
+        s = _PRIMITIVE_ROOT
+        s_pows, acc = [], 1
+        for _ in range(self.ext_n):
+            s_pows.append(acc)
+            acc = acc * s % R
+        pz_shift = fft([a * sp % R for a, sp in zip(pz_coeffs, s_pows)])
+        z_shift = fft([a * sp % R for a, sp in zip(z, s_pows)])
+        inv_z = fr_batch_inverse(z_shift)
+        p_shift = [a * b % R for a, b in zip(pz_shift, inv_z)]
+        p_scaled = fft(p_shift, inverse=True)
+        s_inv = pow(s, R - 2, R)
+        coeffs, acc = [], 1
+        for a in p_scaled:
+            coeffs.append(a * acc % R)
+            acc = acc * s_inv % R
+        if any(x != 0 for x in coeffs[self.n :]):
+            raise KzgError("recovered polynomial exceeds blob degree")
+        return coeffs[: self.n]
+
+    # ------------------------------------------------------ bytes I/O
+
+    def cell_to_bytes(self, vals: Sequence[int]) -> bytes:
+        return b"".join(fr_to_bytes(v) for v in vals)
+
+    def cell_from_bytes(self, raw: bytes) -> list:
+        if len(raw) != self.cell_size * 32:
+            raise KzgError("bad cell byte length")
+        return [
+            bytes_to_fr(raw[i : i + 32]) for i in range(0, len(raw), 32)
+        ]
+
+

@@ -37,6 +37,7 @@ TOPIC_SYNC_CONTRIBUTION = "sync_committee_contribution_and_proof"
 TOPIC_SYNC_COMMITTEE_SUBNET = "sync_committee_{subnet}"
 TOPIC_BLS_TO_EXECUTION_CHANGE = "bls_to_execution_change"
 TOPIC_BLOB_SIDECAR = "blob_sidecar_{subnet}"
+TOPIC_DATA_COLUMN_SIDECAR = "data_column_sidecar_{subnet}"
 TOPIC_LC_FINALITY_UPDATE = "light_client_finality_update"
 TOPIC_LC_OPTIMISTIC_UPDATE = "light_client_optimistic_update"
 

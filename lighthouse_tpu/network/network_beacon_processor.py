@@ -200,6 +200,12 @@ class NetworkBeaconProcessor:
             Protocol.LIGHT_CLIENT_UPDATES_BY_RANGE,
             self._serve_lc_updates_by_range,
         )
+        self.service.rpc.register(
+            Protocol.DATA_COLUMNS_BY_ROOT, self._serve_columns_by_root
+        )
+        self.service.rpc.register(
+            Protocol.DATA_COLUMNS_BY_RANGE, self._serve_columns_by_range
+        )
 
     def local_status(self):
         fin_epoch, fin_root = self.chain.fork_choice.finalized_checkpoint
@@ -242,6 +248,43 @@ class NetworkBeaconProcessor:
         for root in roots[:128]:
             for sc in self.chain.store.get_blobs(root):
                 chunks.append(T.BlobSidecar.serialize(sc))
+        return ResponseCode.SUCCESS, chunks
+
+    # ------------------------------------------------- peerdas rpc
+
+    def _serve_columns_by_root(self, peer_id: str, body: bytes):
+        """Body: concatenated DataColumnIdentifier (40 bytes each);
+        serves only custodied columns (rpc_methods.rs columns path)."""
+        from ..consensus import data_column as dc
+
+        # group identifiers by root: ONE store read + deserialize per
+        # distinct block even when all 128 columns of it are asked for
+        by_root: dict = {}
+        for i in range(0, min(len(body), 40 * 128), 40):
+            ident = dc.DataColumnIdentifier.deserialize(body[i : i + 40])
+            by_root.setdefault(bytes(ident.block_root), set()).add(
+                int(ident.index)
+            )
+        chunks = []
+        for root, want in by_root.items():
+            for sc in self.chain.store.get_columns(root):
+                if int(sc.index) in want:
+                    chunks.append(dc.DataColumnSidecar.serialize(sc))
+        return ResponseCode.SUCCESS, chunks
+
+    def _serve_columns_by_range(self, peer_id: str, body: bytes):
+        from ..consensus import data_column as dc
+
+        req = dc.DataColumnsByRangeRequest.deserialize(body)
+        want = {int(c) for c in req.columns}
+        chunks = []
+        for slot in range(req.start_slot, req.start_slot + min(int(req.count), 1024)):
+            root = self.chain.block_root_at_slot(slot)
+            if root is None:
+                continue
+            for sc in self.chain.store.get_columns(root):
+                if int(sc.index) in want:
+                    chunks.append(dc.DataColumnSidecar.serialize(sc))
         return ResponseCode.SUCCESS, chunks
 
     # ------------------------------------------------- light-client rpc

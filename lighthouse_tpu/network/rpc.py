@@ -41,6 +41,9 @@ class Protocol(IntEnum):
     LIGHT_CLIENT_OPTIMISTIC_UPDATE = 9
     LIGHT_CLIENT_FINALITY_UPDATE = 10
     LIGHT_CLIENT_UPDATES_BY_RANGE = 11
+    # PeerDAS column protocols (rpc/protocol.rs DataColumnsBy{Root,Range})
+    DATA_COLUMNS_BY_ROOT = 12
+    DATA_COLUMNS_BY_RANGE = 13
 
 
 class ResponseCode(IntEnum):
@@ -97,6 +100,8 @@ class RateLimiter:
         Protocol.LIGHT_CLIENT_OPTIMISTIC_UPDATE: (8, 2.0),
         Protocol.LIGHT_CLIENT_FINALITY_UPDATE: (8, 2.0),
         Protocol.LIGHT_CLIENT_UPDATES_BY_RANGE: (16, 4.0),
+        Protocol.DATA_COLUMNS_BY_ROOT: (256, 128.0),
+        Protocol.DATA_COLUMNS_BY_RANGE: (512, 128.0),
     }
 
     def __init__(self, clock: Callable[[], float] = time.monotonic):

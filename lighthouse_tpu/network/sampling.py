@@ -1,0 +1,135 @@
+"""PeerDAS peer sampling (network/src/sync/peer_sampling.rs analog).
+
+After a block is imported with blob commitments, the sampler picks
+SAMPLES_PER_SLOT random column indices and requests each from a peer
+that should custody it (DataColumnsByRoot). A block whose samples all
+verify is `Sampled` — probabilistic availability confirmation without
+downloading 2x-extended blobs. A failed/timed-out column retries on
+another peer; exhausting peers marks the sample (and block) failed.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from ..common import logging as clog
+from ..consensus import data_column as dc
+
+log = clog.get_logger("sampling")
+
+
+@dataclass
+class _Sample:
+    column: int
+    status: str = "pending"  # pending | verified | failed
+    tried_peers: list = field(default_factory=list)
+
+
+@dataclass
+class SamplingRequest:
+    block_root: bytes
+    samples: dict  # column -> _Sample
+
+    @property
+    def done(self) -> bool:
+        return all(s.status == "verified" for s in self.samples.values())
+
+    @property
+    def failed(self) -> bool:
+        return any(s.status == "failed" for s in self.samples.values())
+
+
+class PeerSampler:
+    def __init__(
+        self,
+        request_column: Callable,
+        verifier=None,
+        samples_per_slot: int = dc.SAMPLES_PER_SLOT,
+        custody_of: Optional[Callable] = None,
+    ):
+        """request_column(peer_id, block_root, column_index,
+        callback(sidecar_or_none)) issues the RPC; custody_of(peer_id)
+        -> set of columns the peer custodies (from its metadata)."""
+        self.request_column = request_column
+        self.verifier = verifier
+        self.samples_per_slot = samples_per_slot
+        self.custody_of = custody_of or (lambda peer: set(range(dc.NUMBER_OF_COLUMNS)))
+        self.active: dict[bytes, SamplingRequest] = {}
+
+    # ---------------------------------------------------------- start
+
+    def columns_for(self, block_root: bytes) -> list:
+        """Deterministic per-block pseudo-random column choice (the
+        reference randomizes; determinism here keeps tests exact while
+        remaining unpredictable to a block producer pre-image)."""
+        return dc.pseudo_random_selection(
+            block_root, self.samples_per_slot, dc.NUMBER_OF_COLUMNS
+        )
+
+    def start(self, block_root: bytes, peers: list) -> SamplingRequest:
+        req = SamplingRequest(
+            block_root=block_root,
+            samples={c: _Sample(column=c) for c in self.columns_for(block_root)},
+        )
+        self.active[block_root] = req
+        for sample in req.samples.values():
+            self._dispatch(req, sample, peers)
+        self._maybe_finish(req)
+        return req
+
+    def _dispatch(self, req: SamplingRequest, sample: _Sample, peers: list) -> None:
+        candidates = [
+            p
+            for p in peers
+            if p not in sample.tried_peers
+            and sample.column in self.custody_of(p)
+        ]
+        if not candidates:
+            sample.status = "failed"
+            log.warning(
+                "sampling exhausted peers",
+                column=sample.column,
+                root=req.block_root,
+            )
+            return
+        peer = candidates[0]
+        sample.tried_peers.append(peer)
+
+        def on_response(sidecar):
+            self._on_column(req, sample, peers, sidecar)
+
+        self.request_column(peer, req.block_root, sample.column, on_response)
+
+    def _on_column(self, req, sample, peers, sidecar) -> None:
+        if sidecar is None:
+            self._dispatch(req, sample, peers)  # retry elsewhere
+            self._maybe_finish(req)
+            return
+        try:
+            if int(sidecar.index) != sample.column:
+                raise dc.DataColumnError("wrong column index")
+            # the sidecar must be FOR the sampled block — a valid
+            # column of some other block must not satisfy the sample
+            from ..consensus import types as T
+
+            header_root = T.BeaconBlockHeader.hash_tree_root(
+                sidecar.signed_block_header.message
+            )
+            if header_root != bytes(req.block_root):
+                raise dc.DataColumnError("sidecar for a different block")
+            if self.verifier is not None:
+                self.verifier.verify_sidecar(sidecar)
+        except dc.DataColumnError as e:
+            log.warning("sampled column invalid", error=str(e))
+            self._dispatch(req, sample, peers)
+            self._maybe_finish(req)
+            return
+        sample.status = "verified"
+        self._maybe_finish(req)
+
+    def _maybe_finish(self, req: SamplingRequest) -> None:
+        if req.done:
+            log.info("block sampled available", root=req.block_root)
+        if req.done or req.failed:
+            self.active.pop(req.block_root, None)

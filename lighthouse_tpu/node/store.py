@@ -38,6 +38,7 @@ class Column:
     COLD_STATE = b"cst"
     BLOCK_ROOT_BY_SLOT = b"brs"  # cold chain index
     BLOBS = b"blb"  # BlobSidecar lists by block root (Deneb DA)
+    COLUMNS = b"col"  # DataColumnSidecar lists by block root (PeerDAS)
     METADATA = b"met"
 
 
@@ -290,6 +291,29 @@ class HotColdDB:
     def get_blobs(self, block_root: bytes) -> list:
         raw = self.kv.get(Column.BLOBS, block_root)
         return [] if raw is None else self._blob_list_type().deserialize(raw)
+
+    _COLUMN_LIST = None
+
+    @classmethod
+    def _column_list_type(cls):
+        if cls._COLUMN_LIST is None:
+            from ..consensus.data_column import DataColumnSidecar
+            from ..consensus.ssz import List
+
+            cls._COLUMN_LIST = List(DataColumnSidecar, 128)
+        return cls._COLUMN_LIST
+
+    def put_columns(self, block_root: bytes, sidecars) -> None:
+        """Custodied DataColumnSidecars for a block (PeerDAS)."""
+        self.kv.put(
+            Column.COLUMNS,
+            block_root,
+            self._column_list_type().serialize(list(sidecars)),
+        )
+
+    def get_columns(self, block_root: bytes) -> list:
+        raw = self.kv.get(Column.COLUMNS, block_root)
+        return [] if raw is None else self._column_list_type().deserialize(raw)
 
     # -- hot states
 

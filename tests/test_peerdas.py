@@ -1,0 +1,246 @@
+"""PeerDAS: Fr FFT, cell compute/verify/recover, DataColumnSidecar
+construction + verification, custody assignment, peer sampling, RPC
+shapes (reference rust_eth_kzg DASContext + data_column_verification.rs
++ peer_sampling.rs)."""
+
+import random
+
+import pytest
+
+from lighthouse_tpu.consensus import data_column as dc
+from lighthouse_tpu.consensus import types as T
+from lighthouse_tpu.crypto.kzg import Kzg, TrustedSetup
+from lighthouse_tpu.crypto.kzg import peerdas as pd
+from lighthouse_tpu.network.sampling import PeerSampler
+
+pytestmark = pytest.mark.crypto_heavy  # EC math throughout
+
+# small geometry: blob n=32, ext 64, 16 cells of 4 field elements
+N, CELLS = 32, 16
+_SETUP = TrustedSetup.dev(N)
+_CTX = pd.CellContext(_SETUP, n=N, cells=CELLS)
+_KZG = Kzg(_SETUP)
+
+
+def _blob(seed=7):
+    rnd = random.Random(seed)
+    return b"".join(
+        rnd.getrandbits(250).to_bytes(32, "big") for _ in range(N)
+    )
+
+
+# ---------------------------------------------------------------- fft
+
+
+def test_fft_roundtrip_and_evaluation():
+    rnd = random.Random(1)
+    coeffs = [rnd.randrange(pd.R) for _ in range(16)]
+    evals = pd.fft(coeffs)
+    assert pd.fft(evals, inverse=True) == coeffs
+    w = pd._root_of_unity(16)
+    # evals[k] == p(w^k)
+    for k in (0, 3, 11):
+        x = pow(w, k, pd.R)
+        want = 0
+        for c in reversed(coeffs):
+            want = (want * x + c) % pd.R
+        assert evals[k] == want
+
+
+# ---------------------------------------------------------------- cells
+
+
+def test_cells_are_coset_evaluations():
+    blob = _blob()
+    coeffs = _CTX.blob_to_coeffs(blob)
+    cells, _ = _CTX.compute_cells_and_proofs(blob)
+
+    def p_at(x):
+        acc = 0
+        for c in reversed(coeffs):
+            acc = (acc * x + c) % pd.R
+        return acc
+
+    for i in (0, 5, CELLS - 1):
+        pts = _CTX._coset_points(i)
+        nat = [p_at(x) for x in pts]
+        got = [
+            cells[i][j]
+            for j in range(_CTX.cell_size)
+        ]
+        from lighthouse_tpu.crypto.kzg import _bit_reverse
+
+        expect = [nat[_bit_reverse(j, _CTX.cell_size)] for j in range(_CTX.cell_size)]
+        assert got == expect
+
+    # the first n cells (inner domain, bit-reversed) reproduce the blob
+    fields = [
+        int.from_bytes(blob[k * 32 : (k + 1) * 32], "big")
+        for k in range(N)
+    ]
+    flat = [v for cell in cells[: CELLS // 2] for v in cell]
+    assert flat == fields
+
+
+def test_cell_proofs_verify_and_reject_tampering():
+    blob = _blob()
+    cm = _KZG.blob_to_kzg_commitment(blob)
+    cells, proofs = _CTX.compute_cells_and_proofs(blob)
+    assert _CTX.verify_cell_proof_batch(
+        [cm] * CELLS, list(range(CELLS)), cells, proofs
+    )
+    # subset with shuffled indices
+    idxs = [5, 2, 11]
+    assert _CTX.verify_cell_proof_batch(
+        [cm] * 3, idxs, [cells[i] for i in idxs], [proofs[i] for i in idxs]
+    )
+    bad = [list(c) for c in cells]
+    bad[3][1] = (bad[3][1] + 1) % pd.R
+    assert not _CTX.verify_cell_proof_batch(
+        [cm] * CELLS, list(range(CELLS)), bad, proofs
+    )
+    # proof swapped between cells fails
+    swapped = list(proofs)
+    swapped[0], swapped[1] = swapped[1], swapped[0]
+    assert not _CTX.verify_cell_proof_batch(
+        [cm] * CELLS, list(range(CELLS)), cells, swapped
+    )
+
+
+def test_recovery_from_half_cells():
+    blob = _blob(9)
+    cells, proofs = _CTX.compute_cells_and_proofs(blob)
+    rnd = random.Random(3)
+    keep = sorted(rnd.sample(range(CELLS), CELLS // 2))
+    rec_cells, rec_proofs = _CTX.recover_cells_and_proofs(
+        keep, [cells[i] for i in keep]
+    )
+    assert rec_cells == cells
+    from lighthouse_tpu.crypto.bls import curve as C
+
+    assert [
+        None if p is None else C.g1_compress(p) for p in rec_proofs
+    ] == [None if p is None else C.g1_compress(p) for p in proofs]
+    with pytest.raises(Exception):
+        _CTX.recover_cells_and_proofs(
+            keep[: CELLS // 2 - 1], [cells[i] for i in keep[: CELLS // 2 - 1]]
+        )
+
+
+# ------------------------------------------------------------- sidecars
+
+
+def _signed_block_with_commitments(commitments):
+    body = T.BeaconBlockBody.default()
+    body.blob_kzg_commitments = list(commitments)
+    block = T.BeaconBlock.make(
+        slot=5,
+        proposer_index=2,
+        parent_root=b"\x01" * 32,
+        state_root=b"\x02" * 32,
+        body=body,
+    )
+    return T.SignedBeaconBlock.make(
+        message=block, signature=b"\xc0" + b"\x00" * 95
+    )
+
+
+def test_sidecar_build_and_verify():
+    from lighthouse_tpu.crypto.bls import curve as C
+
+    blobs = [_blob(11), _blob(12)]
+    commitments = [_KZG.blob_to_kzg_commitment(b) for b in blobs]
+    cm_bytes = [C.g1_compress(c) for c in commitments]
+    matrices = [_CTX.compute_cells_and_proofs(b) for b in blobs]
+    cell_matrix = [
+        [_CTX.cell_to_bytes(cell) for cell in cells] for cells, _ in matrices
+    ]
+    proof_matrix = [
+        [C.g1_compress(p) for p in proofs] for _, proofs in matrices
+    ]
+    signed = _signed_block_with_commitments(cm_bytes)
+    sidecars = dc.build_sidecars(
+        signed, cell_matrix, proof_matrix, n_columns=CELLS
+    )
+    assert len(sidecars) == CELLS
+    # SSZ wire round-trip
+    raw = dc.DataColumnSidecar.serialize(sidecars[3])
+    rt = dc.DataColumnSidecar.deserialize(raw)
+    assert int(rt.index) == 3 and len(rt.column) == 2
+
+    verifier = dc.DataColumnVerifier(_CTX)
+    for sc in (rt, sidecars[0], sidecars[CELLS - 1]):
+        verifier.verify_sidecar(sc)
+
+    # tampered cell data fails the batch proof
+    bad = dc.DataColumnSidecar.deserialize(raw)
+    cell0 = bytearray(bytes(bad.column[0]))
+    cell0[5] ^= 1
+    bad.column = [bytes(cell0), bytes(bad.column[1])]
+    with pytest.raises(dc.DataColumnError):
+        verifier.verify_sidecar(bad)
+
+    # tampered commitment list fails the inclusion proof
+    bad2 = dc.DataColumnSidecar.deserialize(raw)
+    bad2.kzg_commitments = [cm_bytes[1], cm_bytes[0]]
+    with pytest.raises(dc.DataColumnError):
+        verifier.verify_sidecar(bad2)
+
+
+# -------------------------------------------------------------- custody
+
+
+def test_custody_assignment_deterministic_and_bounded():
+    node = b"\xaa" * 32
+    cols = dc.get_custody_columns(node)
+    assert cols == dc.get_custody_columns(node)
+    assert len(cols) == dc.CUSTODY_REQUIREMENT
+    assert all(0 <= c < dc.NUMBER_OF_COLUMNS for c in cols)
+    other = dc.get_custody_columns(b"\xbb" * 32)
+    assert cols != other  # overwhelmingly likely
+    everything = dc.get_custody_columns(node, dc.DATA_COLUMN_SIDECAR_SUBNET_COUNT)
+    assert everything == list(range(dc.NUMBER_OF_COLUMNS))
+
+
+# ------------------------------------------------------------- sampling
+
+
+def test_peer_sampler_verifies_and_fails_over():
+    from lighthouse_tpu.crypto.bls import curve as C
+
+    blob = _blob(20)
+    cm = _KZG.blob_to_kzg_commitment(blob)
+    cells, proofs = _CTX.compute_cells_and_proofs(blob)
+    signed = _signed_block_with_commitments([C.g1_compress(cm)])
+    sidecars = dc.build_sidecars(
+        signed,
+        [[_CTX.cell_to_bytes(c) for c in cells]],
+        [[C.g1_compress(p) for p in proofs]],
+        n_columns=CELLS,
+    )
+    root = signed.message.hash_tree_root()
+
+    served = {"good": sidecars, "bad": [None] * CELLS}
+    calls = []
+
+    def request_column(peer, block_root, column, cb):
+        calls.append((peer, column))
+        sc = served[peer][column % CELLS]
+        cb(sc)
+
+    sampler = PeerSampler(
+        request_column,
+        verifier=dc.DataColumnVerifier(_CTX),
+        samples_per_slot=3,
+    )
+    # patch the column space down to the test geometry
+    sampler.columns_for = lambda r: [1, 4, 9]
+    req = sampler.start(root, peers=["bad", "good"])
+    assert req.done and not req.failed
+    # 'bad' returned None for each column first -> one failover per sample
+    assert sum(1 for p, _ in calls if p == "bad") == 3
+    assert sum(1 for p, _ in calls if p == "good") == 3
+
+    # no peer serves -> failed
+    req2 = sampler.start(b"\x44" * 32, peers=["bad"])
+    assert req2.failed
