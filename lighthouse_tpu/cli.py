@@ -6,7 +6,13 @@ Subcommands:
              keymanager API, multi-BN fallback health loop
   account  — wallet/keystore management (account_manager analog):
              wallet-create, validator-derive, keystore-inspect
-  db       — store inspection (database_manager analog): summary
+  db       — database_manager analog: inspect/compact/prune-blobs/version
+  lcli     — dev tools: transition-blocks, skip-slots, parse-ssz,
+             interop-genesis
+  vm       — validator_manager analog: bulk create/import/list against
+             the VC keymanager API
+  watch    — chain analytics daemon (sqlite) following a BN's REST API
+  boot-node— standalone discovery responder
 
 Run: python -m lighthouse_tpu.cli <subcommand> [flags]
 """
@@ -67,8 +73,54 @@ def _build_parser() -> argparse.ArgumentParser:
     ki = acct_sub.add_parser("keystore-inspect")
     ki.add_argument("keystore")
 
-    db = sub.add_parser("db", help="store inspection")
+    db = sub.add_parser("db", help="store inspect/compact/prune")
     db.add_argument("--datadir", default="./datadir")
+    db.add_argument("db_cmd", nargs="?", default="inspect",
+                    choices=["inspect", "compact", "prune-blobs", "version"])
+    db.add_argument("--before-slot", type=int, default=0,
+                    help="prune-blobs: drop sidecars for slots below this")
+
+    lcli = sub.add_parser("lcli", help="dev tools (lcli analog)")
+    lcli_sub = lcli.add_subparsers(dest="lcli_cmd", required=True)
+    tb = lcli_sub.add_parser("transition-blocks")
+    tb.add_argument("--pre", required=True)
+    tb.add_argument("--block", required=True)
+    tb.add_argument("--out", required=True)
+    tb.add_argument("--no-signature-verification", action="store_true")
+    sk = lcli_sub.add_parser("skip-slots")
+    sk.add_argument("--pre", required=True)
+    sk.add_argument("--slots", type=int, required=True)
+    sk.add_argument("--out", required=True)
+    ps = lcli_sub.add_parser("parse-ssz")
+    ps.add_argument("type_name")
+    ps.add_argument("file")
+    ig = lcli_sub.add_parser("interop-genesis")
+    ig.add_argument("--count", type=int, required=True)
+    ig.add_argument("--genesis-time", type=int, default=0)
+    ig.add_argument("--out", required=True)
+
+    vm = sub.add_parser("vm", help="validator manager (bulk create/import/move)")
+    vm_sub = vm.add_subparsers(dest="vm_cmd", required=True)
+    vc_create = vm_sub.add_parser("create")
+    vc_create.add_argument("--seed-hex", required=True)
+    vc_create.add_argument("--count", type=int, required=True)
+    vc_create.add_argument("--out-dir", required=True)
+    vc_create.add_argument("--first-index", type=int, default=0)
+    for name in ("import", "list"):
+        cmd = vm_sub.add_parser(name)
+        cmd.add_argument("--vc-url", required=True)
+        cmd.add_argument("--vc-token", required=True)
+        if name == "import":
+            cmd.add_argument("--keystores", nargs="+", required=True)
+            cmd.add_argument("--password", required=True)
+
+    watch = sub.add_parser("watch", help="chain analytics daemon")
+    watch.add_argument("--beacon-node", default="http://127.0.0.1:5052")
+    watch.add_argument("--db", default="./watch.sqlite")
+    watch.add_argument("--once", action="store_true")
+
+    boot = sub.add_parser("boot-node", help="standalone discovery node")
+    boot.add_argument("--peer-id", default="boot")
 
     return p
 
@@ -110,10 +162,7 @@ def cmd_bn(args) -> int:
     if args.resume:
         builder.resume_from_store()
     elif args.interop_validators > 0:
-        pubkeys = [
-            SecretKey.from_seed(i.to_bytes(4, "big")).public_key().to_bytes()
-            for i in range(args.interop_validators)
-        ]
+        pubkeys = st.interop_pubkeys(args.interop_validators)
         builder.genesis_state(st.interop_genesis_state(spec, pubkeys))
     else:
         print("need --interop-validators N or --resume", file=sys.stderr)
@@ -332,14 +381,50 @@ def cmd_account(args) -> int:
 
 
 def cmd_db(args) -> int:
+    """database_manager analog: inspect / compact / prune-blobs /
+    version (db version + schema migrations run on open)."""
+    import struct as _struct
+
     from .node.store import Column, HotColdDB, LogStore
 
     spec = _spec(args)
-    db = HotColdDB(spec, LogStore(args.datadir))
+    kv = LogStore(args.datadir)
+    db = HotColdDB(spec, kv)
     db.load_split()
+    cmd = getattr(args, "db_cmd", "inspect")
+    if cmd == "compact":
+        for col in (Column.BLOCK, Column.STATE, Column.COLD_STATE,
+                    Column.BLOBS, Column.COLUMNS, Column.METADATA):
+            kv.compact(col)
+        print("compacted all columns")
+        return 0
+    if cmd == "version":
+        raw = kv.get(Column.METADATA, b"schema_version")
+        print(json.dumps({
+            "schema_version": _struct.unpack("<Q", raw)[0] if raw else 0,
+            "latest": HotColdDB.SCHEMA_VERSION,
+        }))
+        return 0
+    if cmd == "prune-blobs":
+        # resolve roots via the slot->root cold index — no per-block
+        # deserialization; hot (above-split) blobs are never below the
+        # prune point in practice since split >= finality
+        pruned = 0
+        blob_roots = set(kv.keys(Column.BLOBS))
+        for key in list(kv.keys(Column.BLOCK_ROOT_BY_SLOT)):
+            slot = _struct.unpack("<Q", key)[0]
+            if slot >= args.before_slot:
+                continue
+            root = kv.get(Column.BLOCK_ROOT_BY_SLOT, key)
+            if root in blob_roots:
+                kv.delete(Column.BLOBS, root)
+                pruned += 1
+        print(json.dumps({"pruned_blob_lists": pruned}))
+        return 0
     blocks = sum(1 for _ in db.kv.keys(Column.BLOCK))
     states = sum(1 for _ in db.kv.keys(Column.STATE))
     cold = sum(1 for _ in db.kv.keys(Column.COLD_STATE))
+    blobs = sum(1 for _ in db.kv.keys(Column.BLOBS))
     print(
         json.dumps(
             {
@@ -347,11 +432,128 @@ def cmd_db(args) -> int:
                 "hot_blocks": blocks,
                 "hot_states": states,
                 "restore_points": cold,
+                "blob_lists": blobs,
             },
             indent=2,
         )
     )
     return 0
+
+
+def cmd_lcli(args) -> int:
+    from .tools import lcli as L
+
+    spec = _spec(args)
+    if args.lcli_cmd == "transition-blocks":
+        with open(args.pre, "rb") as f:
+            pre = f.read()
+        with open(args.block, "rb") as f:
+            block = f.read()
+        out = L.transition_blocks(
+            spec,
+            pre,
+            block,
+            no_signature_verification=args.no_signature_verification,
+        )
+        with open(args.out, "wb") as f:
+            f.write(out)
+        print(f"wrote post state ({len(out)} bytes) to {args.out}")
+        return 0
+    if args.lcli_cmd == "skip-slots":
+        with open(args.pre, "rb") as f:
+            pre = f.read()
+        out = L.skip_slots(spec, pre, args.slots)
+        with open(args.out, "wb") as f:
+            f.write(out)
+        print(f"wrote post state to {args.out}")
+        return 0
+    if args.lcli_cmd == "parse-ssz":
+        with open(args.file, "rb") as f:
+            raw = f.read()
+        print(L.pretty_ssz(args.type_name, raw))
+        return 0
+    if args.lcli_cmd == "interop-genesis":
+        out = L.interop_genesis(spec, args.count, args.genesis_time)
+        with open(args.out, "wb") as f:
+            f.write(out)
+        print(f"wrote {args.count}-validator genesis to {args.out}")
+        return 0
+    return 2
+
+
+def cmd_vm(args) -> int:
+    from .tools import validator_manager as VM
+
+    if args.vm_cmd == "create":
+        password = getpass.getpass("keystore password: ")
+        pairs = VM.create_validators(
+            bytes.fromhex(args.seed_hex),
+            args.count,
+            password,
+            first_index=args.first_index,
+        )
+        os.makedirs(args.out_dir, exist_ok=True)
+        for ks_json, pk in pairs:
+            path = os.path.join(args.out_dir, f"keystore-{pk[2:14]}.json")
+            with open(path, "w") as f:
+                f.write(ks_json)
+            print("wrote", path)
+        return 0
+    client = VM.ValidatorClientHttpClient(args.vc_url, args.vc_token)
+    if args.vm_cmd == "list":
+        print(json.dumps(client.list_keystores(), indent=2))
+        return 0
+    if args.vm_cmd == "import":
+        keystores = []
+        for path in args.keystores:
+            with open(path) as f:
+                keystores.append(f.read())
+        statuses = client.import_keystores(
+            keystores, [args.password] * len(keystores)
+        )
+        print(json.dumps(statuses, indent=2))
+        return 0
+    return 2
+
+
+def cmd_watch(args) -> int:
+    import time
+
+    from .common.eth2 import BeaconNodeHttpClient
+    from .tools.watch import WatchDB, WatchService
+
+    spec = _spec(args)
+    svc = WatchService(BeaconNodeHttpClient(args.beacon_node), WatchDB(args.db))
+    try:
+        while True:
+            n = svc.update()
+            print(json.dumps({
+                "recorded": n,
+                "highest_slot": svc.db.highest_slot(),
+                "packing": svc.db.block_packing(),
+            }))
+            if args.once:
+                return 0
+            time.sleep(spec.seconds_per_slot)
+    except KeyboardInterrupt:
+        return 0
+
+
+def cmd_boot_node(args) -> int:
+    import time
+
+    from .network.discovery import BootNode
+    from .network.transport import InProcessHub
+
+    hub = InProcessHub()
+    node = BootNode(hub, peer_id=args.peer_id)
+    print(f"boot node {args.peer_id!r} serving discovery")
+    try:
+        while True:
+            node.poll()
+            time.sleep(0.05)
+    except KeyboardInterrupt:
+        return 0
 
 
 def main(argv=None) -> int:
@@ -364,6 +566,14 @@ def main(argv=None) -> int:
         return cmd_account(args)
     if args.command == "db":
         return cmd_db(args)
+    if args.command == "lcli":
+        return cmd_lcli(args)
+    if args.command == "vm":
+        return cmd_vm(args)
+    if args.command == "watch":
+        return cmd_watch(args)
+    if args.command == "boot-node":
+        return cmd_boot_node(args)
     return 2
 
 

@@ -1472,6 +1472,18 @@ def finalize_genesis_state(spec: ChainSpec, state, el_anchor: bytes = b""):
     return state
 
 
+def interop_pubkeys(count: int) -> list:
+    """The canonical interop key derivation (eth2_interop_keypairs
+    role): seed = index as 4 big-endian bytes. The ONE definition every
+    caller (CLI, lcli, tests) shares."""
+    from ..crypto.bls.keys import SecretKey
+
+    return [
+        SecretKey.from_seed(i.to_bytes(4, "big")).public_key().to_bytes()
+        for i in range(count)
+    ]
+
+
 def interop_genesis_state(
     spec: ChainSpec, pubkeys: list, genesis_time: int = 0
 ):

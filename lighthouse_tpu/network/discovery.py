@@ -1,0 +1,148 @@
+"""Peer discovery + the standalone boot node
+(reference lighthouse_network/src/discovery (discv5) + the `boot_node`
+binary — a chain-less process that only answers discovery queries).
+
+Records are ENR-analogs: signed-sequence metadata {peer_id, seq,
+attnets, custody_subnet_count}. A `BootNode` attaches to the transport
+WITHOUT a chain and serves DISCOVERY requests: a querying node sends a
+predicate (subnet / custody column) and receives matching records —
+the subnet-predicate discv5 queries the subnet services rely on
+(discovery/mod.rs:1338 subnet_predicate).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import asdict, dataclass
+
+from ..consensus import data_column as dc
+from .rpc import Protocol, ResponseCode, RpcHandler
+from .transport import InProcessHub
+
+MAX_DISCOVERY_RESPONSE = 16
+
+
+@dataclass
+class PeerRecord:
+    """ENR analog. `attnets` is a bitfield int over 64 subnets."""
+
+    peer_id: str
+    seq: int = 0
+    attnets: int = 0
+    custody_subnet_count: int = dc.CUSTODY_REQUIREMENT
+
+    def to_bytes(self) -> bytes:
+        return json.dumps(asdict(self)).encode()
+
+    @classmethod
+    def from_bytes(cls, raw: bytes) -> "PeerRecord":
+        return cls(**json.loads(raw))
+
+    def custody_columns(self) -> list:
+        return dc.get_custody_columns(
+            self.peer_id.encode(), self.custody_subnet_count
+        )
+
+
+def subnet_predicate(subnet: int):
+    """discv5 subnet predicate: does the record advertise the subnet?"""
+
+    def pred(record: PeerRecord) -> bool:
+        return bool(record.attnets >> (subnet % 64) & 1)
+
+    return pred
+
+
+def custody_predicate(column: int):
+    def pred(record: PeerRecord) -> bool:
+        return column in record.custody_columns()
+
+    return pred
+
+
+class Discovery:
+    """The registry + query engine both full nodes and the boot node
+    embed. Full nodes seed it from the boot node and from gossip."""
+
+    def __init__(self, local: PeerRecord):
+        self.local = local
+        self.records: dict[str, PeerRecord] = {}
+
+    def update_local(self, **changes) -> PeerRecord:
+        for k, v in changes.items():
+            setattr(self.local, k, v)
+        self.local.seq += 1
+        return self.local
+
+    def insert(self, record: PeerRecord) -> bool:
+        """Newer-sequence records replace; stale ones are ignored."""
+        cur = self.records.get(record.peer_id)
+        if cur is not None and cur.seq >= record.seq:
+            return False
+        self.records[record.peer_id] = record
+        return True
+
+    def query(self, predicate=None, limit: int = MAX_DISCOVERY_RESPONSE) -> list:
+        out = []
+        for rec in self.records.values():
+            if predicate is None or predicate(rec):
+                out.append(rec)
+            if len(out) >= limit:
+                break
+        return out
+
+
+# wire form: request = json {"kind": "all"|"subnet"|"custody", "value": n}
+# + the requester's own record (so discovery is symmetric, like ENR
+# exchange in discv5 handshakes); response chunks = records
+
+
+def encode_query(kind: str, value: int, self_record: PeerRecord) -> bytes:
+    return json.dumps(
+        {"kind": kind, "value": value, "from": asdict(self_record)}
+    ).encode()
+
+
+class BootNode:
+    """Standalone discovery responder (boot_node binary role): attaches
+    an endpoint + RPC handler to the transport, no chain behind it."""
+
+    def __init__(self, hub: InProcessHub, peer_id: str = "boot"):
+        self.endpoint = hub.join(peer_id)
+        self.discovery = Discovery(PeerRecord(peer_id=peer_id))
+        self.rpc = RpcHandler(self.endpoint)
+        self.rpc.register(Protocol.DISCOVERY, self._serve)
+
+    def _serve(self, sender: str, body: bytes):
+        try:
+            req = json.loads(body)
+            kind, value = req.get("kind", "all"), int(req.get("value", 0))
+            if "from" in req:
+                self.discovery.insert(PeerRecord(**req["from"]))
+        except (ValueError, TypeError, KeyError):
+            return ResponseCode.INVALID_REQUEST, []
+        if kind == "subnet":
+            base = subnet_predicate(value)
+        elif kind == "custody":
+            base = custody_predicate(value)
+        else:
+            base = None
+        # the sender exclusion must run INSIDE the predicate — filtering
+        # after query() would let the sender's own record consume one of
+        # the limited response slots
+        def pred(rec):
+            return rec.peer_id != sender and (base is None or base(rec))
+
+        records = self.discovery.query(pred)
+        return ResponseCode.SUCCESS, [r.to_bytes() for r in records]
+
+    def poll(self) -> None:
+        """Drain transport frames into the RPC handler."""
+        from .transport import CHANNEL_RPC
+
+        for frame in self.endpoint.drain():
+            if frame.channel == CHANNEL_RPC:
+                try:
+                    self.rpc.handle_frame(frame.sender, frame.payload)
+                except Exception:  # noqa: BLE001 — remote bytes
+                    pass

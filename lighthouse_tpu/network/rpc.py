@@ -44,6 +44,8 @@ class Protocol(IntEnum):
     # PeerDAS column protocols (rpc/protocol.rs DataColumnsBy{Root,Range})
     DATA_COLUMNS_BY_ROOT = 12
     DATA_COLUMNS_BY_RANGE = 13
+    # ENR-record discovery (discv5 FINDNODE role; boot_node serves it)
+    DISCOVERY = 14
 
 
 class ResponseCode(IntEnum):
@@ -102,6 +104,7 @@ class RateLimiter:
         Protocol.LIGHT_CLIENT_UPDATES_BY_RANGE: (16, 4.0),
         Protocol.DATA_COLUMNS_BY_ROOT: (256, 128.0),
         Protocol.DATA_COLUMNS_BY_RANGE: (512, 128.0),
+        Protocol.DISCOVERY: (16, 4.0),
     }
 
     def __init__(self, clock: Callable[[], float] = time.monotonic):

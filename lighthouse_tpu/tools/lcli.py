@@ -1,0 +1,100 @@
+"""Dev swiss-army knife (lcli analog; reference lcli/src/main.rs:
+transition-blocks, skip-slots, parse_ssz, interop-genesis).
+
+Each operation is a plain function over SSZ bytes so tests drive them
+directly; the CLI wires files/stdout around them.
+"""
+
+from __future__ import annotations
+
+import json
+
+from ..consensus import state_transition as st
+from ..consensus import types as T
+from ..consensus import light_client as lc
+from ..consensus import data_column as dc
+from ..consensus.spec import ChainSpec
+
+# the parse-ssz type registry (lcli parse_ssz's type_name match)
+SSZ_TYPES = {
+    "SignedBeaconBlock": T.SignedBeaconBlock,
+    "BeaconBlock": T.BeaconBlock,
+    "BeaconState": T.BeaconState,
+    "Attestation": T.Attestation,
+    "IndexedAttestation": T.IndexedAttestation,
+    "SignedAggregateAndProof": T.SignedAggregateAndProof,
+    "BeaconBlockHeader": T.BeaconBlockHeader,
+    "SignedBeaconBlockHeader": T.SignedBeaconBlockHeader,
+    "BlobSidecar": T.BlobSidecar,
+    "DataColumnSidecar": dc.DataColumnSidecar,
+    "SyncCommittee": T.SyncCommittee,
+    "LightClientBootstrap": lc.LightClientBootstrap,
+    "LightClientUpdate": lc.LightClientUpdate,
+    "LightClientFinalityUpdate": lc.LightClientFinalityUpdate,
+    "LightClientOptimisticUpdate": lc.LightClientOptimisticUpdate,
+}
+
+
+def transition_blocks(
+    spec: ChainSpec, pre_ssz: bytes, block_ssz: bytes, no_signature_verification: bool = False
+) -> bytes:
+    """lcli transition-blocks: run one block through the transition.
+    Signatures verify by DEFAULT (the reference's posture) — an
+    invalid-signature block must not 'transition successfully' unless
+    the caller explicitly opts out."""
+    state = T.BeaconState.deserialize(pre_ssz)
+    signed = T.SignedBeaconBlock.deserialize(block_ssz)
+    block = signed.message
+    if state.slot < block.slot:
+        st.process_slots(spec, state, int(block.slot))
+    st.process_block(
+        spec, state, block, verify_signatures=not no_signature_verification
+    )
+    return state.serialize()
+
+
+def skip_slots(spec: ChainSpec, pre_ssz: bytes, slots: int) -> bytes:
+    """lcli skip-slots: advance a state through empty slots."""
+    state = T.BeaconState.deserialize(pre_ssz)
+    st.process_slots(spec, state, int(state.slot) + slots)
+    return state.serialize()
+
+
+def parse_ssz(type_name: str, raw: bytes) -> dict:
+    """lcli parse_ssz: decode and render as JSON-able python."""
+    ctype = SSZ_TYPES.get(type_name)
+    if ctype is None:
+        raise ValueError(
+            f"unknown type {type_name!r}; known: {sorted(SSZ_TYPES)}"
+        )
+    return _to_jsonable(ctype.deserialize(raw))
+
+
+def _to_jsonable(value):
+    from ..consensus.ssz import SSZValue
+
+    if isinstance(value, SSZValue):
+        ctype = object.__getattribute__(value, "_type")
+        return {
+            fname: _to_jsonable(getattr(value, fname))
+            for fname, _ in ctype.fields
+        }
+    if isinstance(value, (bytes, bytearray)):
+        return "0x" + bytes(value).hex()
+    if isinstance(value, (list, tuple)):
+        return [_to_jsonable(v) for v in value]
+    if isinstance(value, bool):
+        return value
+    if isinstance(value, int):
+        return str(value)  # beacon-API style stringed uints
+    return value
+
+
+def interop_genesis(spec: ChainSpec, count: int, genesis_time: int = 0) -> bytes:
+    """lcli interop-genesis: deterministic-key genesis state SSZ."""
+    pubkeys = st.interop_pubkeys(count)
+    return st.interop_genesis_state(spec, pubkeys, genesis_time).serialize()
+
+
+def pretty_ssz(type_name: str, raw: bytes) -> str:
+    return json.dumps(parse_ssz(type_name, raw), indent=2)

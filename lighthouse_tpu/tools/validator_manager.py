@@ -1,0 +1,120 @@
+"""Bulk validator lifecycle against the VC keymanager API
+(validator_manager analog; reference validator_manager/src/{create,
+import,move}.rs).
+
+`create` derives N EIP-2333 keys from a wallet seed into keystore
+JSONs; `import_keystores` pushes them to a running VC's keymanager API;
+`move_validators` performs the safe migration dance: DELETE on the
+source VC (which stops signing and returns the slashing-protection
+interchange) then import on the destination WITH that interchange, so
+the low/high watermarks travel with the key.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+from typing import Optional
+
+from ..crypto.keystore.key_derivation import (
+    derive_path,
+    validator_signing_path,
+)
+from ..crypto.keystore.keystore import Keystore
+from ..crypto.bls.keys import SecretKey
+
+
+class VcApiError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(f"HTTP {status}: {message}")
+        self.status = status
+
+
+class ValidatorClientHttpClient:
+    """Typed client for the VC keymanager API (the `eth2` crate's
+    ValidatorClientHttpClient role)."""
+
+    def __init__(self, base_url: str, token: str, timeout: float = 10.0):
+        self._base = base_url.rstrip("/")
+        self._token = token
+        self.timeout = timeout
+
+    def _request(self, method: str, path: str, body: Optional[dict] = None):
+        req = urllib.request.Request(
+            self._base + path,
+            data=json.dumps(body).encode() if body is not None else None,
+            method=method,
+        )
+        req.add_header("Authorization", f"Bearer {self._token}")
+        if body is not None:
+            req.add_header("Content-Type", "application/json")
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                raw = resp.read()
+                return json.loads(raw) if raw else {}
+        except urllib.error.HTTPError as e:
+            raise VcApiError(e.code, e.read().decode(errors="replace"))
+        except (urllib.error.URLError, OSError) as e:
+            raise VcApiError(0, f"connection failed: {e}")
+
+    def list_keystores(self) -> list:
+        return self._request("GET", "/eth/v1/keystores")["data"]
+
+    def import_keystores(
+        self,
+        keystores: list,
+        passwords: list,
+        slashing_protection: Optional[str] = None,
+    ) -> list:
+        body = {"keystores": keystores, "passwords": passwords}
+        if slashing_protection is not None:
+            body["slashing_protection"] = slashing_protection
+        return self._request("POST", "/eth/v1/keystores", body)["data"]
+
+    def delete_keystores(self, pubkeys: list) -> dict:
+        return self._request(
+            "DELETE", "/eth/v1/keystores", {"pubkeys": pubkeys}
+        )
+
+
+# ---------------------------------------------------------------- create
+
+
+def create_validators(
+    seed: bytes,
+    count: int,
+    password: str,
+    first_index: int = 0,
+    scrypt_n: int = 262144,
+) -> list:
+    """validator_manager create: N (keystore_json, pubkey_hex) pairs
+    derived at m/12381/3600/i/0/0."""
+    out = []
+    for i in range(first_index, first_index + count):
+        path = validator_signing_path(i)
+        sk = SecretKey(derive_path(seed, path))
+        ks = Keystore.encrypt(sk, password, path=path, scrypt_n=scrypt_n)
+        out.append((ks.to_json(), "0x" + ks.pubkey.hex()))
+    return out
+
+
+# ---------------------------------------------------------------- move
+
+
+def move_validators(
+    src: ValidatorClientHttpClient,
+    dst: ValidatorClientHttpClient,
+    pubkeys: list,
+    keystores: list,
+    passwords: list,
+) -> list:
+    """The migration dance: stop-and-export on src, import-with-
+    watermarks on dst. `keystores` are the JSONs for the moved keys
+    (the API's delete does not return key material)."""
+    deleted = src.delete_keystores(pubkeys)
+    interchange = deleted.get("slashing_protection")
+    statuses = dst.import_keystores(
+        keystores, passwords, slashing_protection=interchange
+    )
+    return statuses

@@ -1,0 +1,155 @@
+"""Chain analytics daemon (watch analog; reference watch/src/lib.rs —
+Postgres there, sqlite here, same job: poll a BN's REST API, record
+canonical blocks, and answer packing/participation/proposer queries).
+"""
+
+from __future__ import annotations
+
+import sqlite3
+import threading
+from typing import Optional
+
+from ..common import logging as clog
+from ..common.eth2 import ApiClientError, BeaconNodeHttpClient
+from ..consensus import types as T
+
+log = clog.get_logger("watch")
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS canonical_blocks (
+    slot INTEGER PRIMARY KEY,
+    root TEXT NOT NULL,
+    proposer INTEGER NOT NULL,
+    attestation_count INTEGER NOT NULL,
+    deposit_count INTEGER NOT NULL,
+    exit_count INTEGER NOT NULL,
+    sync_participation INTEGER,
+    graffiti TEXT
+);
+CREATE INDEX IF NOT EXISTS blocks_by_proposer
+    ON canonical_blocks (proposer);
+"""
+
+
+class WatchDB:
+    def __init__(self, path: str = ":memory:"):
+        self._db = sqlite3.connect(path, check_same_thread=False)
+        self._lock = threading.Lock()
+        with self._lock:
+            self._db.executescript(_SCHEMA)
+
+    # -------------------------------------------------------- writes
+
+    def record_block(self, signed_block, root: bytes) -> None:
+        msg = signed_block.message
+        body = msg.body
+        sync_bits = body.sync_aggregate.sync_committee_bits
+        graffiti = bytes(body.graffiti).rstrip(b"\x00")
+        with self._lock:
+            self._db.execute(
+                "INSERT OR REPLACE INTO canonical_blocks VALUES "
+                "(?,?,?,?,?,?,?,?)",
+                (
+                    int(msg.slot),
+                    "0x" + root.hex(),
+                    int(msg.proposer_index),
+                    len(body.attestations),
+                    len(body.deposits),
+                    len(body.voluntary_exits),
+                    sum(1 for b in sync_bits if b),
+                    graffiti.decode(errors="replace"),
+                ),
+            )
+            self._db.commit()
+
+    # -------------------------------------------------------- queries
+
+    def highest_slot(self) -> Optional[int]:
+        row = self._db.execute(
+            "SELECT MAX(slot) FROM canonical_blocks"
+        ).fetchone()
+        return row[0]
+
+    def lowest_slot(self) -> Optional[int]:
+        row = self._db.execute(
+            "SELECT MIN(slot) FROM canonical_blocks"
+        ).fetchone()
+        return row[0]
+
+    def block_packing(self) -> dict:
+        """watch block_packing role: attestation fill statistics."""
+        rows = self._db.execute(
+            "SELECT COUNT(*), AVG(attestation_count), MIN(attestation_count),"
+            " MAX(attestation_count) FROM canonical_blocks"
+        ).fetchone()
+        return {
+            "blocks": rows[0],
+            "avg_attestations": rows[1],
+            "min_attestations": rows[2],
+            "max_attestations": rows[3],
+        }
+
+    def proposer_counts(self) -> dict:
+        return dict(
+            self._db.execute(
+                "SELECT proposer, COUNT(*) FROM canonical_blocks"
+                " GROUP BY proposer"
+            ).fetchall()
+        )
+
+    def sync_participation(self) -> Optional[float]:
+        row = self._db.execute(
+            "SELECT AVG(sync_participation) FROM canonical_blocks"
+            " WHERE sync_participation IS NOT NULL"
+        ).fetchone()
+        return row[0]
+
+
+class WatchService:
+    """The updater task: follow the head backwards until known ground."""
+
+    def __init__(self, client: BeaconNodeHttpClient, db: WatchDB):
+        self.client = client
+        self.db = db
+
+    def update(self, max_blocks: int = 64) -> int:
+        """One poll round; returns blocks newly recorded. Walks head →
+        known ground, then resumes the historical backfill below the
+        lowest recorded slot, so a fresh DB on an old chain converges to
+        full coverage over successive rounds instead of abandoning the
+        gap at max_blocks."""
+        try:
+            head = self.client.header("head")
+        except ApiClientError as e:
+            log.warning("watch poll failed", error=str(e))
+            return 0
+        known = self.db.highest_slot()
+        recorded = self._walk(head["slot"], floor=known, budget=max_blocks)
+        low = self.db.lowest_slot()
+        if recorded < max_blocks and low is not None and low > 0:
+            recorded += self._walk(
+                low - 1, floor=None, budget=max_blocks - recorded
+            )
+        return recorded
+
+    def _walk(self, slot: int, floor, budget: int) -> int:
+        recorded = 0
+        while slot >= 0 and recorded < budget:
+            if floor is not None and slot <= floor:
+                break
+            try:
+                raw = self.client.block_ssz(str(slot))
+            except ApiClientError as e:
+                if e.status == 404:
+                    slot -= 1  # genuinely skipped slot
+                    continue
+                # transport/BN failure: abort the round — decrementing
+                # past it would permanently drop a real block
+                log.warning("watch fetch failed", slot=slot, error=str(e))
+                break
+            signed = T.SignedBeaconBlock.deserialize(raw)
+            root = signed.message.hash_tree_root()
+            self.db.record_block(signed, root)
+            recorded += 1
+            slot = int(signed.message.slot) - 1
+        return recorded
