@@ -27,6 +27,13 @@ class SlotClock:
     def seconds_into_slot(self) -> float:
         return (self.now() - self.genesis_time) % self.seconds_per_slot
 
+    def slot_progress(self) -> float:
+        """Fraction [0, 1) of the current slot elapsed (state-advance
+        and VC sub-slot scheduling read this)."""
+        if self.now() < self.genesis_time:
+            return 0.0
+        return self.seconds_into_slot() / self.seconds_per_slot
+
 
 class ManualSlotClock(SlotClock):
     """Deterministic clock for tests: time advances only on demand."""

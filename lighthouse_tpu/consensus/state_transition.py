@@ -186,10 +186,20 @@ def compute_proposer_index(
 
 
 def get_beacon_proposer_index(spec: ChainSpec, state) -> int:
+    return get_beacon_proposer_index_at_slot(spec, state, int(state.slot))
+
+
+def get_beacon_proposer_index_at_slot(spec: ChainSpec, state, slot: int) -> int:
+    """Proposer for any `slot` of the state's CURRENT epoch, without
+    advancing the state: the seed depends only on the epoch mix and the
+    slot number, and the active set + effective balances are fixed
+    within an epoch (beacon_proposer_cache.rs computes whole epochs
+    this way)."""
     epoch = get_current_epoch(spec, state)
+    assert compute_epoch_at_slot(spec, slot) == epoch, "slot outside epoch"
     seed = _hash(
         get_seed(spec, state, epoch, spec.domain_beacon_proposer)
-        + state.slot.to_bytes(8, "little")
+        + int(slot).to_bytes(8, "little")
     )
     return compute_proposer_index(
         spec, state, get_active_validator_indices(state, epoch), seed

@@ -58,6 +58,9 @@ class NetworkService:
     def subscribe(self, topic: str) -> None:
         self.gossip.subscribe(topic)
 
+    def unsubscribe(self, topic: str) -> None:
+        self.gossip.unsubscribe(topic)
+
     def resubscribe_meshes(self, others: list) -> None:
         """Re-graft after subscription changes (subnet rotation)."""
         for other in others:

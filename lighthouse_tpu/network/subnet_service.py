@@ -1,0 +1,149 @@
+"""Attestation + sync-committee subnet scheduling
+(beacon_node/network/src/subnet_service analog, subnet_service/mod.rs:1-3).
+
+Two subscription sources, exactly like the reference:
+
+  * long-lived subnets — deterministically derived from the node id and
+    rotated per ~epoch period (discv5 advertises them; here they also
+    pin gossip meshes)
+  * short-lived duty subnets — one epoch of lookahead from the duties
+    the VC registers (beacon-API subscribe-to-subnet role); aggregators
+    must be IN the mesh before their slot arrives
+
+The service turns both into topic subscribe/unsubscribe actions against
+the gossip layer each slot tick.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+
+from ..consensus import state_transition as st
+from .gossip import (
+    TOPIC_ATTESTATION_SUBNET,
+    TOPIC_SYNC_COMMITTEE_SUBNET,
+    topic_for,
+)
+
+ATTESTATION_SUBNET_COUNT = 64
+SUBNETS_PER_NODE = 2
+EPOCHS_PER_SUBSCRIPTION_ROTATION = 256
+
+
+def compute_subnet_for_attestation(
+    spec, committees_per_slot: int, slot: int, committee_index: int
+) -> int:
+    """Spec compute_subnet_for_attestation."""
+    slots_since_epoch_start = slot % spec.preset.slots_per_epoch
+    committees_since_epoch_start = committees_per_slot * slots_since_epoch_start
+    return (
+        committees_since_epoch_start + committee_index
+    ) % ATTESTATION_SUBNET_COUNT
+
+
+def long_lived_subnets(node_id: bytes, epoch: int) -> list:
+    """Deterministic node-id-derived subnets, rotating every
+    EPOCHS_PER_SUBSCRIPTION_ROTATION epochs (the spec's
+    compute_subscribed_subnets shape)."""
+    period = epoch // EPOCHS_PER_SUBSCRIPTION_ROTATION
+    out, i = [], 0
+    while len(out) < SUBNETS_PER_NODE:
+        h = hashlib.sha256(
+            bytes(node_id) + period.to_bytes(8, "little") + i.to_bytes(8, "little")
+        ).digest()
+        s = int.from_bytes(h[:8], "little") % ATTESTATION_SUBNET_COUNT
+        if s not in out:
+            out.append(s)
+        i += 1
+    return sorted(out)
+
+
+@dataclass
+class SubnetSubscription:
+    """One duty-driven subscription (beacon-API POST
+    /eth/v1/validator/beacon_committee_subscriptions row)."""
+
+    validator_index: int
+    subnet: int
+    slot: int
+    is_aggregator: bool
+
+
+class SubnetService:
+    def __init__(self, spec, service, node_id: bytes, fork_digest: bytes):
+        self.spec = spec
+        self.service = service  # NetworkService (subscribe/unsubscribe)
+        self.node_id = bytes(node_id)
+        self.fork_digest = bytes(fork_digest)
+        self._duty_subs: list[SubnetSubscription] = []
+        self._current_topics: set = set()
+
+    # ------------------------------------------------------- registration
+
+    def subscribe_duty(
+        self,
+        validator_index: int,
+        slot: int,
+        committee_index: int,
+        committees_per_slot: int,
+        is_aggregator: bool,
+    ) -> SubnetSubscription:
+        sub = SubnetSubscription(
+            validator_index=validator_index,
+            subnet=compute_subnet_for_attestation(
+                self.spec, committees_per_slot, slot, committee_index
+            ),
+            slot=slot,
+            is_aggregator=is_aggregator,
+        )
+        self._duty_subs.append(sub)
+        return sub
+
+    def subscribe_sync_subnets(self, subnets) -> None:
+        for s in subnets:
+            topic = topic_for(
+                TOPIC_SYNC_COMMITTEE_SUBNET, self.fork_digest, int(s)
+            )
+            if topic not in self._current_topics:
+                self.service.subscribe(topic)
+                self._current_topics.add(topic)
+
+    # ------------------------------------------------------------- tick
+
+    def wanted_subnets(self, current_slot: int) -> set:
+        """Long-lived + duty subnets covering [current_slot, +1 epoch)."""
+        epoch = st.compute_epoch_at_slot(self.spec, current_slot)
+        wanted = set(long_lived_subnets(self.node_id, epoch))
+        horizon = current_slot + self.spec.preset.slots_per_epoch
+        for sub in self._duty_subs:
+            if current_slot <= sub.slot < horizon:
+                wanted.add(sub.subnet)
+        return wanted
+
+    def on_slot(self, current_slot: int) -> tuple:
+        """Reconcile gossip meshes with the wanted set; returns
+        (subscribed topics, unsubscribed topics). Expired duties are
+        dropped."""
+        self._duty_subs = [
+            s for s in self._duty_subs if s.slot >= current_slot
+        ]
+        wanted_topics = {
+            topic_for(TOPIC_ATTESTATION_SUBNET, self.fork_digest, s)
+            for s in self.wanted_subnets(current_slot)
+        }
+        # keep sync-committee topics (separately managed) out of the diff
+        att_current = {
+            t for t in self._current_topics if "beacon_attestation" in t
+        }
+        to_add = wanted_topics - att_current
+        to_remove = att_current - wanted_topics
+        for t in to_add:
+            self.service.subscribe(t)
+            self._current_topics.add(t)
+        for t in to_remove:
+            unsub = getattr(self.service, "unsubscribe", None)
+            if unsub is not None:
+                unsub(t)
+            self._current_topics.discard(t)
+        return to_add, to_remove

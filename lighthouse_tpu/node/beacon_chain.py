@@ -33,6 +33,13 @@ from ..consensus.signature_sets import (
 from ..consensus.spec import ChainSpec
 from ..crypto import bls
 from .aggregation_pool import NaiveAggregationPool
+from .caches import (
+    BeaconProposerCache,
+    EarlyAttesterCache,
+    EventBus,
+    ShufflingCache,
+    shuffling_decision_root,
+)
 from .blob_verification import DataAvailabilityChecker
 from .operation_pool import OperationPool
 from .store import HotColdDB
@@ -166,6 +173,7 @@ class BeaconChain:
         # pools: local aggregation + block packing
         self.agg_pool = NaiveAggregationPool()
         self.op_pool = OperationPool(spec)
+        self._init_caches()
 
         self.m_blocks = metrics.counter("beacon_chain_blocks_imported_total")
         self.m_atts = metrics.counter(
@@ -174,6 +182,36 @@ class BeaconChain:
         self.m_batch_fallback = metrics.counter(
             "beacon_chain_attestation_batch_fallbacks_total"
         )
+
+    def _init_caches(self) -> None:
+        """Epoch-scoped caches (shuffling_cache.rs / beacon_proposer_
+        cache.rs / early_attester_cache.rs), the SSE event bus, the
+        optional validator monitor, and the slot-tail pre-advanced
+        state — ONE definition shared by all three constructors."""
+        self.shuffling_cache = ShufflingCache()
+        self.proposer_cache = BeaconProposerCache()
+        self.early_attester_cache = EarlyAttesterCache()
+        self.event_bus = EventBus()
+        self.validator_monitor = None
+        # (head_root, slot, state) pre-advanced at the slot tail
+        self._advanced_state = None
+        self._last_finalized_emitted = -1
+
+    def cache_advanced_state(self, head_root: bytes, slot: int, state) -> None:
+        with self._lock:
+            self._advanced_state = (bytes(head_root), int(slot), state)
+
+    def take_advanced_state(self, slot: int):
+        """A COPY of the pre-advanced head state for `slot`, or None.
+        Callers mutate the result; the cached original stays intact for
+        other consumers in the same slot."""
+        with self._lock:
+            if self._advanced_state is None:
+                return None
+            root, s, state = self._advanced_state
+            if root == self.head.root and s == int(slot):
+                return state.copy()
+            return None
 
     # ------------------------------------------------------------ persistence
 
@@ -287,6 +325,7 @@ class BeaconChain:
         self._observed_sync_aggregators = set()
         self.agg_pool = NaiveAggregationPool()
         self.op_pool = OperationPool(spec)
+        self._init_caches()
         self.m_blocks = metrics.counter("beacon_chain_blocks_imported_total")
         self.m_atts = metrics.counter(
             "beacon_chain_attestations_verified_total"
@@ -408,6 +447,7 @@ class BeaconChain:
         self._observed_sync_aggregators = set()
         self.agg_pool = NaiveAggregationPool()
         self.op_pool = OperationPool(spec)
+        self._init_caches()
         self.slasher = None
         self.execution_layer = None
         self.eth1 = None
@@ -455,6 +495,19 @@ class BeaconChain:
 
     def head_state(self):
         return self.state_for_block(self.head.root)
+
+    def beacon_committee_cached(self, state, slot: int, index: int) -> list:
+        """Committee lookup through the shuffling cache: the whole
+        epoch's shuffle computes ONCE per (epoch, decision root); every
+        later gossip attestation in that epoch is a dict hit
+        (shuffling_cache.rs role)."""
+        epoch = st.compute_epoch_at_slot(self.spec, slot)
+        decision = shuffling_decision_root(
+            self.spec, state, epoch, self.head.root
+        )
+        return self.shuffling_cache.get_committee(
+            self.spec, state, slot, index, decision
+        )
 
     def validator_liveness(self, epoch: int, indices) -> set:
         """Which of `indices` were observed attesting in `epoch` — the
@@ -835,6 +888,37 @@ class BeaconChain:
                 except Exception:
                     pass  # slasher feed is best-effort observability
         self.m_blocks.inc()
+        if self.validator_monitor is not None:
+            self.validator_monitor.observe_block(
+                int(block.proposer_index), int(block.slot)
+            )
+        self.event_bus.emit(
+            "block",
+            {"slot": str(int(block.slot)), "block": "0x" + block_root.hex()},
+        )
+        # the just-imported block can be attested to instantly, without
+        # the head lock (early_attester_cache.rs)
+        if block.slot >= self.current_slot:
+            block_epoch = st.compute_epoch_at_slot(self.spec, block.slot)
+            boundary = st.compute_start_slot_at_epoch(self.spec, block_epoch)
+            if block.slot == boundary:
+                target_root = block_root
+            else:
+                try:
+                    target_root = st.get_block_root_at_slot(
+                        self.spec, state, boundary
+                    )
+                except Exception:  # noqa: BLE001 — pre-history boundary
+                    target_root = block_root
+            self.early_attester_cache.add(
+                block.slot,
+                block_root,
+                T.Checkpoint.make(
+                    epoch=state.current_justified_checkpoint.epoch,
+                    root=bytes(state.current_justified_checkpoint.root),
+                ),
+                T.Checkpoint.make(epoch=block_epoch, root=target_root),
+            )
         if self.light_client_cache is not None:
             try:
                 self.light_client_cache.on_imported_block(signed_block)
@@ -860,8 +944,27 @@ class BeaconChain:
                 self.op_pool.insert_proposer_slashing(s)
         return len(att_slashings)
 
+    def _is_ancestor(
+        self, anc_root: bytes, anc_slot: int, desc_root: bytes
+    ) -> bool:
+        """Is `anc_root` on `desc_root`'s chain? Walks hot parents;
+        anything at/below the finalized horizon counts as ancestral
+        (finality implies it)."""
+        root = desc_root
+        while root in self._block_info:
+            if root == anc_root:
+                return True
+            slot, parent, _ = self._block_info[root]
+            if slot <= anc_slot and root != anc_root:
+                return False
+            if parent is None:
+                break
+            root = parent
+        return root == anc_root
+
     def recompute_head(self) -> bytes:
         """canonical_head.rs:474 recompute_head_at_current_slot."""
+        old_head = self.head
         head_root = self.fork_choice.get_head(self.current_slot)
         node = self.fork_choice.proto.nodes[
             self.fork_choice.proto.index_by_root[head_root]
@@ -871,6 +974,23 @@ class BeaconChain:
             slot=node.slot,
             state_root=self._state_roots.get(head_root, b""),
         )
+        if head_root != old_head.root:
+            self.event_bus.emit(
+                "head",
+                {"slot": str(node.slot), "block": "0x" + head_root.hex()},
+            )
+            # reorg = the old head is NOT an ancestor of the new head
+            if old_head.root and not self._is_ancestor(
+                old_head.root, old_head.slot, head_root
+            ):
+                self.event_bus.emit(
+                    "chain_reorg",
+                    {
+                        "slot": str(node.slot),
+                        "old_head_block": "0x" + old_head.root.hex(),
+                        "new_head_block": "0x" + head_root.hex(),
+                    },
+                )
         self._notify_forkchoice_updated(head_root)
         return head_root
 
@@ -943,8 +1063,8 @@ class BeaconChain:
         state = self.state_for_block(target_root)
         if state is None:
             raise AttestationError("no state for target")
-        committee = st.get_beacon_committee(
-            self.spec, state, data.slot, data.index
+        committee = self.beacon_committee_cached(
+            state, data.slot, data.index
         )
         bits = attestation.aggregation_bits
         if len(bits) != len(committee):
@@ -1003,6 +1123,10 @@ class BeaconChain:
                 )
                 for index in v.indexed_indices:
                     self._observed_attesters.add((index, epoch))
+                    if self.validator_monitor is not None:
+                        self.validator_monitor.observe_attestation(
+                            index, epoch
+                        )
                 self.apply_attestation_to_fork_choice(v)
                 # feed local aggregation + packing (naive pool merges
                 # signatures and tracks the covered indices; the op pool
@@ -1066,8 +1190,8 @@ class BeaconChain:
             if adv.slot < data.slot:
                 adv = state.copy()
                 st.process_slots(self.spec, adv, data.slot)
-            committee = st.get_beacon_committee(
-                self.spec, adv, data.slot, data.index
+            committee = self.beacon_committee_cached(
+                adv, data.slot, data.index
             )
             if int(msg.aggregator_index) not in committee:
                 raise AttestationError("aggregator not in committee")
@@ -1114,6 +1238,8 @@ class BeaconChain:
             )
             for index in indices:
                 self._observed_attesters.add((index, epoch))
+                if self.validator_monitor is not None:
+                    self.validator_monitor.observe_attestation(index, epoch)
             self.apply_attestation_to_fork_choice(v)
             self.op_pool.insert_attestation(aggregate, indices)
             if self.slasher is not None:
@@ -1384,9 +1510,11 @@ class BeaconChain:
             if head_state is None:
                 raise BlockError("no head state")
             parent_root = self.head.root
-            state = head_state.copy()
-            if state.slot < slot:
-                st.process_slots(self.spec, state, slot)
+            state = self.take_advanced_state(slot)
+            if state is None:
+                state = head_state.copy()
+                if state.slot < slot:
+                    st.process_slots(self.spec, state, slot)
             proposer = st.get_beacon_proposer_index(self.spec, state)
             body = T.BeaconBlockBody.default()
             body.randao_reveal = randao_reveal
@@ -1441,6 +1569,12 @@ class BeaconChain:
             fin_epoch, fin_root = self.fork_choice.finalized_checkpoint
             if fin_root not in self._block_info:
                 return 0
+            if fin_epoch > self._last_finalized_emitted:
+                self._last_finalized_emitted = fin_epoch
+                self.event_bus.emit(
+                    "finalized_checkpoint",
+                    {"epoch": str(fin_epoch), "block": "0x" + fin_root.hex()},
+                )
             fin_slot = st.compute_start_slot_at_epoch(self.spec, fin_epoch)
             canonical = self.canonical_roots_through(fin_root)
             moved = self.store.migrate(fin_slot, canonical)

@@ -1,0 +1,187 @@
+"""Chain-internal caches (beacon_chain's shuffling_cache.rs,
+beacon_proposer_cache.rs, attester_cache.rs / early_attester_cache.rs
+analogs) plus the chain event bus the SSE endpoint drains
+(beacon_chain/src/events.rs role).
+
+Keys follow the reference's decision-root discipline: a shuffling for
+epoch E is fully determined by (E, decision_block_root) where the
+decision root is the last block before epoch E-1 starts — caching by
+head root would miss across forks sharing the shuffling.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Callable, Optional
+
+from ..consensus import state_transition as st
+
+
+class _LRU:
+    def __init__(self, capacity: int):
+        self.capacity = capacity
+        self._map = collections.OrderedDict()
+        self._lock = threading.Lock()
+
+    def get(self, key):
+        with self._lock:
+            v = self._map.get(key)
+            if v is not None:
+                self._map.move_to_end(key)
+            return v
+
+    def put(self, key, value):
+        with self._lock:
+            self._map[key] = value
+            self._map.move_to_end(key)
+            if len(self._map) > self.capacity:
+                self._map.popitem(last=False)
+
+    def __len__(self):
+        return len(self._map)
+
+
+def shuffling_decision_root(spec, state, epoch: int, head_root: bytes) -> bytes:
+    """The block root that pins epoch `epoch`'s shuffling: the last
+    block before epoch-1 starts (shuffling_id.rs). At the boundary of
+    history the head root itself is the anchor."""
+    boundary = st.compute_start_slot_at_epoch(spec, max(epoch - 1, 0))
+    if boundary == 0 or state.slot < boundary:
+        return bytes(head_root)
+    try:
+        return st.get_block_root_at_slot(spec, state, boundary - 1)
+    except Exception:  # noqa: BLE001 — out of block_roots range
+        return bytes(head_root)
+
+
+class ShufflingCache:
+    """(epoch, decision_root) -> [[committee] per (slot, index)] — the
+    full epoch's committees computed once (shuffling_cache.rs)."""
+
+    def __init__(self, capacity: int = 16):
+        self._cache = _LRU(capacity)
+        self.hits = 0
+        self.misses = 0
+
+    def get_committee(
+        self, spec, state, slot: int, index: int, decision_root: bytes
+    ) -> list:
+        epoch = st.compute_epoch_at_slot(spec, slot)
+        key = (epoch, bytes(decision_root))
+        epoch_map = self._cache.get(key)
+        if epoch_map is None:
+            self.misses += 1
+            epoch_map = self._compute_epoch(spec, state, epoch)
+            self._cache.put(key, epoch_map)
+        else:
+            self.hits += 1
+        return epoch_map[(slot, index)]
+
+    @staticmethod
+    def _compute_epoch(spec, state, epoch: int) -> dict:
+        out = {}
+        start = st.compute_start_slot_at_epoch(spec, epoch)
+        per_slot = st.get_committee_count_per_slot(spec, state, epoch)
+        for slot in range(start, start + spec.preset.slots_per_epoch):
+            for index in range(per_slot):
+                out[(slot, index)] = st.get_beacon_committee(
+                    spec, state, slot, index
+                )
+        return out
+
+
+class BeaconProposerCache:
+    """(epoch, decision_root) -> [proposer index per slot]
+    (beacon_proposer_cache.rs)."""
+
+    def __init__(self, capacity: int = 16):
+        self._cache = _LRU(capacity)
+
+    def get_epoch_proposers(self, spec, state, epoch: int, decision_root: bytes):
+        key = (epoch, bytes(decision_root))
+        cached = self._cache.get(key)
+        if cached is not None:
+            return cached
+        start = st.compute_start_slot_at_epoch(spec, epoch)
+        work = state
+        if st.get_current_epoch(spec, state) != epoch:
+            # a COPY is advanced to the epoch — the caller's state (the
+            # chain's live head state!) must never be mutated here
+            work = state.copy()
+            st.process_slots(spec, work, start)
+        proposers = [
+            st.get_beacon_proposer_index_at_slot(spec, work, slot)
+            for slot in range(start, start + spec.preset.slots_per_epoch)
+        ]
+        self._cache.put(key, proposers)
+        return proposers
+
+
+class EarlyAttesterCache:
+    """Serve attestation data for the current slot's block the moment
+    it is imported, without touching the head lock
+    (early_attester_cache.rs)."""
+
+    def __init__(self):
+        self._entry = None
+        self._lock = threading.Lock()
+
+    def add(self, slot: int, block_root: bytes, source, target) -> None:
+        with self._lock:
+            self._entry = {
+                "slot": int(slot),
+                "beacon_block_root": bytes(block_root),
+                "source": source,
+                "target": target,
+            }
+
+    def try_attest(self, slot: int) -> Optional[dict]:
+        with self._lock:
+            e = self._entry
+            if e is not None and e["slot"] == int(slot):
+                return dict(e)
+            return None
+
+
+class EventBus:
+    """Bounded per-topic event queues for the SSE endpoint
+    (events.rs ServerSentEventHandler role). Topics: head, block,
+    finalized_checkpoint, attestation, chain_reorg."""
+
+    TOPICS = ("head", "block", "finalized_checkpoint", "attestation", "chain_reorg")
+
+    def __init__(self, capacity: int = 256):
+        self._buf = collections.deque(maxlen=capacity)
+        self._cv = threading.Condition()
+        self._seq = 0
+
+    def emit(self, topic: str, data: dict) -> None:
+        with self._cv:
+            self._seq += 1
+            self._buf.append({"seq": self._seq, "event": topic, "data": data})
+            self._cv.notify_all()
+
+    def current_seq(self) -> int:
+        with self._cv:
+            return self._seq
+
+    def poll_since(self, seq: int, topics=None, timeout: float = 0.0) -> list:
+        """Events newer than `seq`, blocking up to `timeout` for one."""
+        import time as _time
+
+        deadline = _time.monotonic() + timeout
+        with self._cv:
+            while True:
+                fresh = [
+                    e
+                    for e in self._buf
+                    if e["seq"] > seq
+                    and (topics is None or e["event"] in topics)
+                ]
+                if fresh:
+                    return fresh
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0:
+                    return []
+                self._cv.wait(remaining)

@@ -28,9 +28,14 @@ class SlotTimer:
     do it)."""
 
     def __init__(self, chain: BeaconChain, clock: SlotClock):
+        from .state_advance_timer import StateAdvanceTimer
+
         self.chain = chain
         self.clock = clock
         self._last_slot = chain.current_slot
+        # slot-tail pre-advance (state_advance_timer.rs role)
+        self.state_advance = StateAdvanceTimer(chain)
+        self._advanced_for_slot = -1
 
     def poll(self) -> int:
         """Advance to the clock's slot; returns slots fired."""
@@ -40,6 +45,15 @@ class SlotTimer:
             self._last_slot += 1
             self.on_slot(self._last_slot)
             fired += 1
+        # slot tail (last quarter): pre-advance the head state for the
+        # NEXT slot so its critical path starts warm
+        if (
+            fired == 0
+            and self._advanced_for_slot < now
+            and self.clock.slot_progress() >= 0.75
+        ):
+            self.state_advance.on_slot_tail(now)
+            self._advanced_for_slot = now
         return fired
 
     def on_slot(self, slot: int) -> None:

@@ -1,0 +1,65 @@
+"""Fork revert: excise an invalid chain segment and re-run fork choice
+(beacon_chain/src/fork_revert.rs analog).
+
+When the EL declares an optimistically-imported payload INVALID, every
+block from the invalid one to the tip built on it must stop being
+head-eligible. Proto-array's optimistic invalidation already handles
+the weights; this removes the blocks' hot bookkeeping so nothing can
+serve or build on them, then recomputes the head.
+"""
+
+from __future__ import annotations
+
+from ..common import logging as clog
+
+log = clog.get_logger("fork_revert")
+
+
+def revert_to_fork_boundary(chain, invalid_root: bytes) -> list:
+    """Drop `invalid_root` and all its hot descendants. Returns the
+    removed block roots (the reference logs + metrics them). The
+    finalized chain is never touched — an invalid finalized block is a
+    catastrophic condition the caller must handle (it raises)."""
+    invalid_root = bytes(invalid_root)
+    with chain._lock:
+        _, fin_root = chain.fork_choice.finalized_checkpoint
+        if invalid_root == fin_root or invalid_root == chain.genesis_root:
+            raise RuntimeError(
+                "finalized/genesis block declared invalid — cannot revert"
+            )
+        if invalid_root not in chain._block_info:
+            return []
+        # collect the invalid subtree by walking every hot block's
+        # parents (hot set is small: unfinalized only)
+        doomed = {invalid_root}
+        changed = True
+        while changed:
+            changed = False
+            for root, (slot, parent, _sroot) in chain._block_info.items():
+                if root not in doomed and parent in doomed:
+                    doomed.add(root)
+                    changed = True
+        # proto-array: mark the subtree invalid so get_head never
+        # selects it (optimistic-sync invalidation path)
+        from ..consensus.proto_array import ExecutionStatus
+
+        try:
+            chain.fork_choice.proto.on_execution_status(
+                invalid_root, ExecutionStatus.INVALID
+            )
+        except Exception:  # noqa: BLE001 — proto may not track it
+            pass
+        for root in doomed:
+            info = chain._block_info.pop(root, None)
+            chain._states.pop(root, None)
+            sroot = chain._state_roots.pop(root, None)
+            if sroot is not None:
+                try:
+                    chain.store.delete_state(sroot)
+                except Exception:  # noqa: BLE001 — already migrated
+                    pass
+        log.warning(
+            "reverted invalid fork", blocks=len(doomed), root=invalid_root
+        )
+        chain.recompute_head()
+        return sorted(doomed)

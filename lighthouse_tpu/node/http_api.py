@@ -175,23 +175,27 @@ class BeaconApi:
         cur = st.compute_epoch_at_slot(self.chain.spec, self.chain.current_slot)
         if e > cur + 1:
             raise ApiError(400, f"epoch {e} beyond next epoch {cur + 1}")
-        state = self.chain.head_state().copy()
+        from .caches import shuffling_decision_root
+
+        state = self.chain.head_state()
         start = st.compute_start_slot_at_epoch(self.chain.spec, e)
-        if state.slot < start:
-            st.process_slots(self.chain.spec, state, start)
-        duties = []
-        for slot in range(start, start + self.chain.spec.preset.slots_per_epoch):
-            if state.slot < slot:
-                st.process_slots(self.chain.spec, state, slot)
-            vidx = st.get_beacon_proposer_index(self.chain.spec, state)
-            duties.append(
-                {
-                    "pubkey": "0x"
-                    + bytes(state.validators[vidx].pubkey).hex(),
-                    "validator_index": str(vidx),
-                    "slot": str(slot),
-                }
-            )
+        # proposer shuffling for epoch e is pinned by the last block
+        # before e starts — the helper's (e+1) convention yields that
+        decision = shuffling_decision_root(
+            self.chain.spec, state, e + 1, self.chain.head.root
+        )
+        proposers = self.chain.proposer_cache.get_epoch_proposers(
+            self.chain.spec, state, e, decision
+        )
+        duties = [
+            {
+                "pubkey": "0x"
+                + bytes(state.validators[vidx].pubkey).hex(),
+                "validator_index": str(vidx),
+                "slot": str(start + i),
+            }
+            for i, vidx in enumerate(proposers)
+        ]
         return 200, {"data": duties}
 
     # ------------------------------------------------------------ posts
@@ -257,6 +261,47 @@ def make_handler(api: BeaconApi):
         def log_message(self, *args):  # quiet
             pass
 
+        def _stream_events(self) -> None:
+            """GET /eth/v1/events?topics=head,block — the beacon-API
+            SSE stream fed by the chain's event bus (events.rs role).
+            Streams until the client disconnects."""
+            from urllib.parse import parse_qs, urlparse
+
+            bus = getattr(api.chain, "event_bus", None)
+            if bus is None:
+                self._send_json(501, {"code": 501, "message": "no event bus"})
+                return
+            q = parse_qs(urlparse(self.path).query)
+            topics = None
+            if "topics" in q:
+                topics = set(",".join(q["topics"]).split(","))
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-cache")
+            self.send_header("Connection", "close")
+            self.end_headers()
+            # beacon-API semantics: events FROM subscription time — do
+            # not replay the bus's history buffer to new clients
+            seq = bus.current_seq()
+            try:
+                while True:
+                    events = bus.poll_since(seq, topics=topics, timeout=1.0)
+                    for e in events:
+                        seq = max(seq, e["seq"])
+                        frame = (
+                            f"event: {e['event']}\n"
+                            f"data: {json.dumps(e['data'])}\n\n"
+                        )
+                        self.wfile.write(frame.encode())
+                    if not events:
+                        # keepalive comment: surfaces a dead client even
+                        # on a topic that never fires (thread/socket
+                        # leak otherwise)
+                        self.wfile.write(b":\n\n")
+                    self.wfile.flush()
+            except (BrokenPipeError, ConnectionResetError, OSError):
+                return  # client went away — normal SSE termination
+
         def _send_json(self, code: int, obj) -> None:
             raw = json.dumps(obj).encode()
             self.send_response(code)
@@ -273,6 +318,9 @@ def make_handler(api: BeaconApi):
                 self.send_header("Content-Length", str(len(raw)))
                 self.end_headers()
                 self.wfile.write(raw)
+                return
+            if method == "GET" and self.path.split("?")[0] == "/eth/v1/events":
+                self._stream_events()
                 return
             for m, pat, name in _ROUTES:
                 if m != method:

@@ -1,0 +1,62 @@
+"""Slot-tail state pre-advance (state_advance_timer.rs:1-15 analog).
+
+Near the end of each slot the head state is advanced through the
+upcoming empty slot so next-slot work — attestation data at slot start,
+block production, committee lookups after an epoch boundary — reads a
+ready state instead of paying process_slots on the critical path. The
+reference runs this 3/4 through the slot; here the client timer calls
+`on_slot_tail` and the chain consults `advanced_state`.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from ..common import logging as clog
+from ..consensus import state_transition as st
+
+log = clog.get_logger("state_advance")
+
+
+class StateAdvanceTimer:
+    def __init__(self, chain):
+        self.chain = chain
+        self._lock = threading.Lock()
+        # (head_root, slot) -> advanced state
+        self._advanced: Optional[tuple] = None
+
+    def on_slot_tail(self, current_slot: int) -> bool:
+        """Pre-compute the head state at current_slot + 1. Returns True
+        if an advance was computed (False: already done / no state)."""
+        chain = self.chain
+        head_root = chain.head.root
+        target = int(current_slot) + 1
+        with self._lock:
+            if self._advanced is not None:
+                root, slot, _ = self._advanced
+                if root == head_root and slot >= target:
+                    return False
+        state = chain.head_state()
+        if state is None or state.slot >= target:
+            return False
+        work = state.copy()
+        st.process_slots(chain.spec, work, target)
+        with self._lock:
+            self._advanced = (head_root, target, work)
+        # hand the result to the chain — produce_block/attestation-data
+        # paths consume it via take_advanced_state
+        chain.cache_advanced_state(head_root, target, work)
+        log.info("state pre-advanced", slot=target)
+        return True
+
+    def advanced_state(self, head_root: bytes, slot: int):
+        """The pre-advanced state for (head, slot), or None — the chain
+        falls back to advancing on demand."""
+        with self._lock:
+            if self._advanced is None:
+                return None
+            root, s, state = self._advanced
+            if root == bytes(head_root) and s == int(slot):
+                return state
+            return None

@@ -1,0 +1,110 @@
+"""Per-validator observability (validator_monitor.rs:2173 analog).
+
+Operators register indices (or auto-register all); the monitor observes
+gossip/block events the chain already produces and keeps per-validator
+hit/miss records, logging a summary at each epoch transition and
+exporting aggregate metrics. Observation is intentionally passive — a
+monitor must never sit on the import path's critical section.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+
+from ..common import logging as clog
+from ..common import metrics
+
+log = clog.get_logger("validator_monitor")
+
+_MONITORED = metrics.gauge(
+    "validator_monitor_validators", "Validators under monitoring"
+)
+_ATT_HITS = metrics.counter(
+    "validator_monitor_attestation_hits_total",
+    "Monitored validators' attestations seen (gossip or blocks)",
+)
+_BLOCKS = metrics.counter(
+    "validator_monitor_blocks_total", "Monitored validators' blocks seen"
+)
+
+
+@dataclass
+class _Record:
+    index: int
+    attestations: int = 0
+    blocks: int = 0
+    last_attestation_epoch: int = -1
+    epochs_attested: set = field(default_factory=set)
+
+
+class ValidatorMonitor:
+    def __init__(self, auto_register: bool = False):
+        self.auto_register = auto_register
+        self._records: dict[int, _Record] = {}
+        self._lock = threading.Lock()
+        self._last_summary_epoch = -1
+
+    def register(self, index: int) -> None:
+        with self._lock:
+            if index not in self._records:
+                self._records[index] = _Record(index=index)
+                _MONITORED.set(len(self._records))
+
+    def registered(self) -> list:
+        return sorted(self._records)
+
+    # ---------------------------------------------------- observations
+
+    def observe_attestation(self, index: int, epoch: int) -> None:
+        with self._lock:
+            rec = self._records.get(index)
+            if rec is None:
+                if not self.auto_register:
+                    return
+                rec = self._records[index] = _Record(index=index)
+                _MONITORED.set(len(self._records))
+            if epoch not in rec.epochs_attested:
+                rec.epochs_attested.add(epoch)
+                rec.attestations += 1
+                rec.last_attestation_epoch = max(
+                    rec.last_attestation_epoch, epoch
+                )
+                _ATT_HITS.inc()
+
+    def observe_block(self, proposer_index: int, slot: int) -> None:
+        with self._lock:
+            rec = self._records.get(proposer_index)
+            if rec is None:
+                if not self.auto_register:
+                    return
+                rec = self._records[proposer_index] = _Record(
+                    index=proposer_index
+                )
+                _MONITORED.set(len(self._records))
+            rec.blocks += 1
+            _BLOCKS.inc()
+
+    # -------------------------------------------------------- summary
+
+    def on_epoch(self, completed_epoch: int) -> dict:
+        """Epoch-transition summary (the reference logs one line per
+        monitored validator): {index: attested_bool} for the epoch."""
+        with self._lock:
+            if completed_epoch <= self._last_summary_epoch:
+                return {}
+            self._last_summary_epoch = completed_epoch
+            out = {}
+            for rec in self._records.values():
+                attested = completed_epoch in rec.epochs_attested
+                out[rec.index] = attested
+                if not attested:
+                    log.warning(
+                        "monitored validator missed attestation",
+                        validator=rec.index,
+                        epoch=completed_epoch,
+                    )
+            return out
+
+    def record(self, index: int):
+        return self._records.get(index)

@@ -1,0 +1,260 @@
+"""Chain caches + services (SURVEY §2.3 internals): shuffling/proposer/
+early-attester caches, event bus + SSE endpoint, state-advance timer,
+validator monitor, fork revert, subnet service."""
+
+import urllib.request
+
+import pytest
+
+from lighthouse_tpu.common.slot_clock import ManualSlotClock
+from lighthouse_tpu.consensus import state_transition as st
+from lighthouse_tpu.consensus import types as T
+from lighthouse_tpu.consensus.spec import mainnet_spec
+from lighthouse_tpu.node.caches import (
+    EventBus,
+    ShufflingCache,
+    shuffling_decision_root,
+)
+from lighthouse_tpu.node.fork_revert import revert_to_fork_boundary
+from lighthouse_tpu.node.state_advance_timer import StateAdvanceTimer
+from lighthouse_tpu.node.store import HotColdDB, LogStore
+from lighthouse_tpu.node.validator_monitor import ValidatorMonitor
+
+SPEC = mainnet_spec()
+N = 16
+
+
+def _node(tmp_path, clock=None):
+    from lighthouse_tpu.node.client import ClientBuilder
+
+    b = (
+        ClientBuilder(SPEC)
+        .store(HotColdDB(SPEC, LogStore(str(tmp_path))))
+        .genesis_state(
+            st.interop_genesis_state(SPEC, st.interop_pubkeys(N))
+        )
+        .bls_backend("fake")
+    )
+    if clock is not None:
+        b.slot_clock(clock)
+    return b.build()
+
+
+def _extend(chain, slot):
+    chain.on_slot(slot)
+    sig = b"\xc0" + b"\x00" * 95
+    block = chain.produce_block(slot, randao_reveal=sig)
+    signed = T.SignedBeaconBlock.make(message=block, signature=sig)
+    chain.process_block(signed)
+    return signed
+
+
+# ---------------------------------------------------------------- caches
+
+
+def test_shuffling_cache_hits_and_matches_direct(tmp_path):
+    node = _node(tmp_path)
+    chain = node.chain
+    _extend(chain, 1)
+    state = chain.head_state()
+    direct = st.get_beacon_committee(SPEC, state, 1, 0)
+    via_cache = chain.beacon_committee_cached(state, 1, 0)
+    assert via_cache == direct
+    assert chain.shuffling_cache.misses == 1
+    chain.beacon_committee_cached(state, 1, 0)
+    chain.beacon_committee_cached(state, 2, 0)  # same epoch -> same entry
+    assert chain.shuffling_cache.hits == 2
+    assert chain.shuffling_cache.misses == 1
+
+
+def test_proposer_cache_epoch(tmp_path):
+    node = _node(tmp_path)
+    chain = node.chain
+    state = chain.head_state()
+    decision = shuffling_decision_root(SPEC, state, 1, chain.head.root)
+    proposers = chain.proposer_cache.get_epoch_proposers(
+        SPEC, state, 0, decision
+    )
+    assert len(proposers) == SPEC.preset.slots_per_epoch
+    assert all(0 <= p < N for p in proposers)
+    # cached: same list object on second call
+    again = chain.proposer_cache.get_epoch_proposers(SPEC, state, 0, decision)
+    assert again is proposers
+
+
+def test_early_attester_cache_serves_imported_block(tmp_path):
+    node = _node(tmp_path)
+    chain = node.chain
+    signed = _extend(chain, 1)
+    entry = chain.early_attester_cache.try_attest(1)
+    assert entry is not None
+    assert entry["beacon_block_root"] == signed.message.hash_tree_root()
+    # the target checkpoint is materialized at add() time
+    assert entry["target"] is not None and entry["target"].epoch == 0
+    assert entry["source"] is not None
+    assert chain.early_attester_cache.try_attest(2) is None
+
+
+# ------------------------------------------------------------- event bus
+
+
+def test_event_bus_emits_block_head_and_sse_stream(tmp_path):
+    from lighthouse_tpu.node.http_api import ApiServer, BeaconApi
+
+    node = _node(tmp_path)
+    chain = node.chain
+    _extend(chain, 1)
+    events = chain.event_bus.poll_since(0)
+    kinds = [e["event"] for e in events]
+    assert "block" in kinds and "head" in kinds
+
+    server = ApiServer(BeaconApi(chain), host="127.0.0.1", port=0)
+    server.start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/eth/v1/events?topics=block,head"
+        )
+        resp = urllib.request.urlopen(req, timeout=5)
+        assert resp.headers["Content-Type"] == "text/event-stream"
+        # subscription starts at the live edge: history is NOT replayed;
+        # a new import streams through (keepalive comments may precede)
+        _extend(chain, 2)
+        for _ in range(5):
+            chunk = resp.fp.readline().decode()
+            if chunk.startswith("event: "):
+                break
+        assert chunk.startswith("event: ")
+        resp.close()
+    finally:
+        server.stop()
+
+
+def test_event_bus_topic_filter():
+    bus = EventBus()
+    bus.emit("block", {"slot": "1"})
+    bus.emit("head", {"slot": "1"})
+    only_head = bus.poll_since(0, topics={"head"})
+    assert [e["event"] for e in only_head] == ["head"]
+
+
+# ----------------------------------------------------------- state advance
+
+
+def test_state_advance_timer_precomputes_next_slot(tmp_path):
+    clock = ManualSlotClock(seconds_per_slot=12)
+    node = _node(tmp_path, clock=clock)
+    chain = node.chain
+    _extend(chain, 1)
+    adv = StateAdvanceTimer(chain)
+    assert adv.on_slot_tail(1) is True
+    state = adv.advanced_state(chain.head.root, 2)
+    assert state is not None and state.slot == 2
+    # idempotent for the same (head, slot)
+    assert adv.on_slot_tail(1) is False
+    # timer integration: last-quarter tick triggers the advance
+    clock.set_slot(2)
+    node.timer.poll()
+    clock.advance(9.5)  # 9.5/12 > 0.75
+    node.timer.poll()
+    assert node.timer.state_advance.advanced_state(chain.head.root, 3) is not None
+
+
+# -------------------------------------------------------- validator monitor
+
+
+def test_validator_monitor_observation_and_epoch_summary(tmp_path):
+    node = _node(tmp_path)
+    chain = node.chain
+    mon = ValidatorMonitor()
+    chain.validator_monitor = mon
+    mon.register(3)
+    mon.register(4)
+    _extend(chain, 1)
+    # register the NEXT block's proposer before it is imported, so the
+    # import-path hook observes it
+    sig = b"\xc0" + b"\x00" * 95
+    chain.on_slot(2)
+    block = chain.produce_block(2, randao_reveal=sig)
+    proposer = int(block.proposer_index)
+    mon.register(proposer)
+    chain.process_block(T.SignedBeaconBlock.make(message=block, signature=sig))
+    chain.validator_monitor.observe_attestation(3, 0)
+    summary = mon.on_epoch(0)
+    assert summary[3] is True
+    assert summary[4] is False  # never attested
+    assert mon.on_epoch(0) == {}  # idempotent per epoch
+    rec = mon.record(proposer)
+    assert rec is not None and rec.blocks >= 1
+
+
+# ------------------------------------------------------------- fork revert
+
+
+def test_fork_revert_excises_invalid_subtree(tmp_path):
+    node = _node(tmp_path)
+    chain = node.chain
+    _extend(chain, 1)
+    head_before = chain.head.root
+    b2 = _extend(chain, 2)
+    b3 = _extend(chain, 3)
+    root2 = b2.message.hash_tree_root()
+    root3 = b3.message.hash_tree_root()
+    assert chain.head.root == root3
+    removed = revert_to_fork_boundary(chain, root2)
+    assert set(removed) == {root2, root3}
+    assert chain.head.root == head_before
+    assert root2 not in chain._block_info
+    # reverting finalized/genesis is refused
+    with pytest.raises(RuntimeError):
+        revert_to_fork_boundary(chain, chain.genesis_root)
+
+
+# ------------------------------------------------------------ subnet service
+
+
+def test_subnet_service_schedules_and_rotates():
+    from lighthouse_tpu.network.subnet_service import (
+        ATTESTATION_SUBNET_COUNT,
+        SubnetService,
+        compute_subnet_for_attestation,
+        long_lived_subnets,
+    )
+
+    class _FakeService:
+        def __init__(self):
+            self.subscribed = set()
+
+        def subscribe(self, t):
+            self.subscribed.add(t)
+
+        def unsubscribe(self, t):
+            self.subscribed.discard(t)
+
+    svc = _FakeService()
+    sub = SubnetService(SPEC, svc, node_id=b"\x01" * 32, fork_digest=b"\x00" * 4)
+
+    # long-lived subnets: deterministic, 2 of them
+    ll = long_lived_subnets(b"\x01" * 32, epoch=0)
+    assert len(ll) == 2 and ll == long_lived_subnets(b"\x01" * 32, 0)
+
+    added, removed = sub.on_slot(0)
+    assert len(added) == 2 and not removed
+
+    # a duty adds its subnet ahead of time
+    duty = sub.subscribe_duty(
+        validator_index=7,
+        slot=5,
+        committee_index=3,
+        committees_per_slot=4,
+        is_aggregator=True,
+    )
+    expect = compute_subnet_for_attestation(SPEC, 4, 5, 3)
+    assert duty.subnet == expect
+    added, _ = sub.on_slot(1)
+    assert any(f"beacon_attestation_{expect}" in t for t in svc.subscribed)
+
+    # after the duty slot passes, the subnet drops (unless long-lived)
+    _, removed = sub.on_slot(6)
+    if expect not in ll:
+        assert any(f"beacon_attestation_{expect}" in t for t in removed)
+    assert all(s < ATTESTATION_SUBNET_COUNT for s in sub.wanted_subnets(6))
