@@ -1,0 +1,575 @@
+"""Electra state-transition extensions (EIP-7251 MaxEB, EIP-7002
+execution-layer withdrawals, EIP-6110 EL deposits, EIP-7549 committee
+bits) — the reference's per_block_processing/per_epoch_processing
+electra variants (consensus/state_processing single_pass.rs electra
+arms, process_operations.rs:703 request handling).
+
+State surface lives in `state.electra` (ElectraStateExtras); every
+function here is gated by `spec.electra_enabled(epoch)` at the call
+sites in state_transition.py.
+"""
+
+from __future__ import annotations
+
+from .spec import FAR_FUTURE_EPOCH, ChainSpec
+from . import types as T
+
+COMPOUNDING_WITHDRAWAL_PREFIX = b"\x02"
+UNSET_DEPOSIT_REQUESTS_START_INDEX = 2**64 - 1
+ETH1_WITHDRAWAL_PREFIX = b"\x01"
+FULL_EXIT_REQUEST_AMOUNT = 0
+
+
+# ---------------------------------------------------------------- creds
+
+
+def has_compounding_withdrawal_credential(v) -> bool:
+    return bytes(v.withdrawal_credentials)[:1] == COMPOUNDING_WITHDRAWAL_PREFIX
+
+
+def has_execution_withdrawal_credential(v) -> bool:
+    prefix = bytes(v.withdrawal_credentials)[:1]
+    return prefix in (ETH1_WITHDRAWAL_PREFIX, COMPOUNDING_WITHDRAWAL_PREFIX)
+
+
+def get_max_effective_balance(spec: ChainSpec, v) -> int:
+    """Per-validator cap: 2048 ETH for compounding creds, 32 otherwise."""
+    if has_compounding_withdrawal_credential(v):
+        return spec.max_effective_balance_electra
+    return spec.min_activation_balance
+
+
+# ---------------------------------------------------------------- churn
+
+
+def get_balance_churn_limit(spec: ChainSpec, state) -> int:
+    from . import state_transition as st
+
+    limit = max(
+        spec.min_per_epoch_churn_limit_electra,
+        st.get_total_active_balance(spec, state) // spec.churn_limit_quotient,
+    )
+    return limit - limit % spec.effective_balance_increment
+
+
+def get_activation_exit_churn_limit(spec: ChainSpec, state) -> int:
+    return min(
+        spec.max_per_epoch_activation_exit_churn_limit,
+        get_balance_churn_limit(spec, state),
+    )
+
+
+def get_consolidation_churn_limit(spec: ChainSpec, state) -> int:
+    return get_balance_churn_limit(spec, state) - get_activation_exit_churn_limit(
+        spec, state
+    )
+
+
+def compute_exit_epoch_and_update_churn(
+    spec: ChainSpec, state, exit_balance: int
+) -> int:
+    """Balance-denominated exit queue (EIP-7251 replaces the per-
+    validator churn with gwei churn)."""
+    from . import state_transition as st
+
+    ex = state.electra
+    earliest = max(
+        ex.earliest_exit_epoch,
+        st.get_current_epoch(spec, state) + 1 + spec.max_seed_lookahead,
+    )
+    per_epoch_churn = get_activation_exit_churn_limit(spec, state)
+    if ex.earliest_exit_epoch < earliest:
+        balance_to_consume = per_epoch_churn
+    else:
+        balance_to_consume = ex.exit_balance_to_consume
+    if exit_balance > balance_to_consume:
+        additional = exit_balance - balance_to_consume
+        epochs = (additional + per_epoch_churn - 1) // per_epoch_churn
+        earliest += epochs
+        balance_to_consume += epochs * per_epoch_churn
+    ex.exit_balance_to_consume = balance_to_consume - exit_balance
+    ex.earliest_exit_epoch = earliest
+    return earliest
+
+
+def compute_consolidation_epoch_and_update_churn(
+    spec: ChainSpec, state, consolidation_balance: int
+) -> int:
+    from . import state_transition as st
+
+    ex = state.electra
+    earliest = max(
+        ex.earliest_consolidation_epoch,
+        st.get_current_epoch(spec, state) + 1 + spec.max_seed_lookahead,
+    )
+    # floor of one increment: on a network whose balance churn sits at
+    # the electra minimum the spec formula yields 0 (all churn goes to
+    # activations/exits) and consolidations would divide by zero; one
+    # increment per epoch keeps them merely slow
+    per_epoch = max(
+        get_consolidation_churn_limit(spec, state),
+        spec.effective_balance_increment,
+    )
+    if ex.earliest_consolidation_epoch < earliest:
+        balance_to_consume = per_epoch
+    else:
+        balance_to_consume = ex.consolidation_balance_to_consume
+    if consolidation_balance > balance_to_consume:
+        additional = consolidation_balance - balance_to_consume
+        epochs = (additional + per_epoch - 1) // per_epoch
+        earliest += epochs
+        balance_to_consume += epochs * per_epoch
+    ex.consolidation_balance_to_consume = (
+        balance_to_consume - consolidation_balance
+    )
+    ex.earliest_consolidation_epoch = earliest
+    return earliest
+
+
+def initiate_validator_exit(spec: ChainSpec, state, index: int) -> None:
+    """Electra initiate_validator_exit: balance-churned queue."""
+    v = state.validators[index]
+    if v.exit_epoch != FAR_FUTURE_EPOCH:
+        return
+    exit_epoch = compute_exit_epoch_and_update_churn(
+        spec, state, v.effective_balance
+    )
+    v.exit_epoch = exit_epoch
+    v.withdrawable_epoch = (
+        exit_epoch + spec.min_validator_withdrawability_delay
+    )
+
+
+def get_pending_balance_to_withdraw(state, index: int) -> int:
+    return sum(
+        int(w.amount)
+        for w in state.electra.pending_partial_withdrawals
+        if int(w.validator_index) == index
+    )
+
+
+def switch_to_compounding_validator(spec: ChainSpec, state, index: int) -> None:
+    v = state.validators[index]
+    v.withdrawal_credentials = (
+        COMPOUNDING_WITHDRAWAL_PREFIX + bytes(v.withdrawal_credentials)[1:]
+    )
+    queue_excess_active_balance(spec, state, index)
+
+
+def queue_excess_active_balance(spec: ChainSpec, state, index: int) -> None:
+    from . import state_transition as st
+
+    balance = state.balances[index]
+    if balance > spec.min_activation_balance:
+        excess = balance - spec.min_activation_balance
+        state.balances[index] = spec.min_activation_balance
+        v = state.validators[index]
+        state.electra.pending_deposits.append(
+            T.PendingDeposit.make(
+                pubkey=bytes(v.pubkey),
+                withdrawal_credentials=bytes(v.withdrawal_credentials),
+                amount=excess,
+                signature=b"\x00" * 96,  # G2 infinity marker (skip sig)
+                slot=int(state.slot),
+            )
+        )
+
+
+# --------------------------------------------------------- block requests
+
+
+def process_deposit_request(spec: ChainSpec, state, request) -> None:
+    """EIP-6110: EL deposit receipts enter the pending queue."""
+    ex = state.electra
+    if ex.deposit_requests_start_index in (
+        0,
+        UNSET_DEPOSIT_REQUESTS_START_INDEX,
+    ):
+        ex.deposit_requests_start_index = int(request.index)
+    ex.pending_deposits.append(
+        T.PendingDeposit.make(
+            pubkey=bytes(request.pubkey),
+            withdrawal_credentials=bytes(request.withdrawal_credentials),
+            amount=int(request.amount),
+            signature=bytes(request.signature),
+            slot=int(state.slot),
+        )
+    )
+
+
+def process_withdrawal_request(spec: ChainSpec, state, request, ctx) -> None:
+    """EIP-7002: EL-triggered exits / partial withdrawals. Invalid
+    requests are no-ops (the EL cannot be rolled back)."""
+    from . import state_transition as st
+
+    amount = int(request.amount)
+    index = ctx.pubkey_index(bytes(request.validator_pubkey))
+    if index is None:
+        return
+    v = state.validators[index]
+    if not has_execution_withdrawal_credential(v):
+        return
+    # request must come from the credentialed address
+    if bytes(v.withdrawal_credentials)[12:] != bytes(request.source_address):
+        return
+    cur = st.get_current_epoch(spec, state)
+    if not st.is_active_validator(v, cur) or v.exit_epoch != FAR_FUTURE_EPOCH:
+        return
+    if cur < v.activation_epoch + spec.shard_committee_period:
+        return
+    pending = get_pending_balance_to_withdraw(state, index)
+    if amount == FULL_EXIT_REQUEST_AMOUNT:
+        if pending == 0:
+            initiate_validator_exit(spec, state, index)
+        return
+    # partial: compounding validators with excess over 32 ETH only
+    has_sufficient = (
+        v.effective_balance >= spec.min_activation_balance
+        and state.balances[index] > spec.min_activation_balance + pending
+    )
+    if not (has_compounding_withdrawal_credential(v) and has_sufficient):
+        return
+    to_withdraw = min(
+        state.balances[index] - spec.min_activation_balance - pending,
+        amount,
+    )
+    withdrawable = compute_exit_epoch_and_update_churn(spec, state, to_withdraw)
+    state.electra.pending_partial_withdrawals.append(
+        T.PendingPartialWithdrawal.make(
+            validator_index=index,
+            amount=to_withdraw,
+            withdrawable_epoch=withdrawable
+            + spec.min_validator_withdrawability_delay,
+        )
+    )
+
+
+def process_consolidation_request(spec: ChainSpec, state, request, ctx) -> None:
+    from . import state_transition as st
+
+    src_pk = bytes(request.source_pubkey)
+    tgt_pk = bytes(request.target_pubkey)
+    source_index = ctx.pubkey_index(src_pk)
+    if source_index is None:
+        return
+    # self-consolidation = switch to compounding credentials
+    if src_pk == tgt_pk:
+        v = state.validators[source_index]
+        cur = st.get_current_epoch(spec, state)
+        if (
+            bytes(v.withdrawal_credentials)[:1] == ETH1_WITHDRAWAL_PREFIX
+            and bytes(v.withdrawal_credentials)[12:]
+            == bytes(request.source_address)
+            # spec is_valid_switch_to_compounding_request: active, no
+            # exit initiated — an exiting validator flipping to 0x02
+            # would strand its excess balance
+            and st.is_active_validator(v, cur)
+            and v.exit_epoch == FAR_FUTURE_EPOCH
+        ):
+            switch_to_compounding_validator(spec, state, source_index)
+        return
+    target_index = ctx.pubkey_index(tgt_pk)
+    if target_index is None:
+        return
+    source = state.validators[source_index]
+    target = state.validators[target_index]
+    cur = st.get_current_epoch(spec, state)
+    if not (
+        st.is_active_validator(source, cur)
+        and st.is_active_validator(target, cur)
+    ):
+        return
+    if (
+        source.exit_epoch != FAR_FUTURE_EPOCH
+        or target.exit_epoch != FAR_FUTURE_EPOCH
+    ):
+        return
+    if bytes(source.withdrawal_credentials)[12:] != bytes(
+        request.source_address
+    ):
+        return
+    if not has_execution_withdrawal_credential(source):
+        return
+    if not has_compounding_withdrawal_credential(target):
+        return
+    if cur < source.activation_epoch + spec.shard_committee_period:
+        return
+    if get_pending_balance_to_withdraw(state, source_index) > 0:
+        return
+    exit_epoch = compute_consolidation_epoch_and_update_churn(
+        spec, state, source.effective_balance
+    )
+    source.exit_epoch = exit_epoch
+    source.withdrawable_epoch = (
+        exit_epoch + spec.min_validator_withdrawability_delay
+    )
+    state.electra.pending_consolidations.append(
+        T.PendingConsolidation.make(
+            source_index=source_index, target_index=target_index
+        )
+    )
+
+
+def process_execution_requests(spec: ChainSpec, state, requests, ctx) -> None:
+    """The per-block entry: deposits, then withdrawals, then
+    consolidations (process_operations electra tail)."""
+    for r in requests.deposits:
+        process_deposit_request(spec, state, r)
+    for r in requests.withdrawals:
+        process_withdrawal_request(spec, state, r, ctx)
+    for r in requests.consolidations:
+        process_consolidation_request(spec, state, r, ctx)
+
+
+# ------------------------------------------------------------ epoch passes
+
+
+def process_pending_deposits(spec: ChainSpec, state, ctx=None) -> None:
+    """Apply queued deposits under the gwei activation churn
+    (single_pass.rs electra pending-deposit arm)."""
+    from . import state_transition as st
+
+    ex = state.electra
+    available = (
+        get_activation_exit_churn_limit(spec, state)
+        + ex.deposit_balance_to_consume
+    )
+    finalized_slot = st.compute_start_slot_at_epoch(
+        spec, int(state.finalized_checkpoint.epoch)
+    )
+    processed_amount = 0
+    next_index = 0
+    churn_limited = False
+    remaining = list(ex.pending_deposits)
+    for dep in remaining:
+        # only deposits the chain has finalized past are applyable
+        if int(dep.slot) > finalized_slot and finalized_slot > 0:
+            break
+        if next_index >= spec.max_pending_deposits_per_epoch:
+            break
+        amount = int(dep.amount)
+        if processed_amount + amount > available:
+            churn_limited = True
+            break
+        next_index += 1
+        processed_amount += amount
+        _apply_pending_deposit(spec, state, dep, ctx)
+    ex.pending_deposits = remaining[next_index:] if next_index else remaining
+    # unused churn banks ONLY when churn was the stopper — a deposit
+    # waiting on finalization must not accumulate multi-epoch credit
+    # that later applies a burst above the per-epoch limit
+    if churn_limited:
+        ex.deposit_balance_to_consume = available - processed_amount
+    else:
+        ex.deposit_balance_to_consume = 0
+
+
+def _apply_pending_deposit(spec: ChainSpec, state, dep, ctx=None) -> None:
+    from . import state_transition as st
+
+    ctx = ctx or st.BlockContext(spec, state)
+    index = ctx.pubkey_index(bytes(dep.pubkey))
+    if index is not None:
+        st.increase_balance(state, index, int(dep.amount))
+        return
+    # zero signature marks an internally-queued balance (excess from
+    # compounding switch) — never a NEW validator
+    if bytes(dep.signature) == b"\x00" * 96:
+        return
+    st.apply_deposit(
+        spec,
+        state,
+        bytes(dep.pubkey),
+        bytes(dep.withdrawal_credentials),
+        int(dep.amount),
+        bytes(dep.signature),
+        ctx=ctx,
+    )
+
+
+def process_pending_consolidations(spec: ChainSpec, state) -> None:
+    from . import state_transition as st
+
+    ex = state.electra
+    cur = st.get_current_epoch(spec, state)
+    done = 0
+    for pc in ex.pending_consolidations:
+        source = state.validators[int(pc.source_index)]
+        if source.slashed:
+            done += 1
+            continue
+        if source.withdrawable_epoch > cur:
+            break
+        # move the source's remaining MIN_ACTIVATION-capped balance
+        balance = min(
+            state.balances[int(pc.source_index)],
+            spec.min_activation_balance,
+        )
+        st.decrease_balance(state, int(pc.source_index), balance)
+        st.increase_balance(state, int(pc.target_index), balance)
+        done += 1
+    if done:
+        ex.pending_consolidations = list(ex.pending_consolidations)[done:]
+
+
+def process_effective_balance_updates(spec: ChainSpec, state) -> None:
+    """Electra variant: per-validator cap (compounding -> 2048 ETH)."""
+    hysteresis_increment = spec.effective_balance_increment // 4
+    downward = hysteresis_increment
+    upward = hysteresis_increment * 2
+    for i, v in enumerate(state.validators):
+        balance = state.balances[i]
+        cap = get_max_effective_balance(spec, v)
+        if (
+            balance + downward < v.effective_balance
+            or v.effective_balance + upward < balance
+        ):
+            v.effective_balance = min(
+                balance - balance % spec.effective_balance_increment, cap
+            )
+
+
+def process_registry_updates(spec: ChainSpec, state) -> None:
+    """Electra variant: eligibility at MIN_ACTIVATION_BALANCE; the
+    activation queue is churn-free (the gwei churn already ran at the
+    pending-deposit stage)."""
+    from . import state_transition as st
+
+    cur = st.get_current_epoch(spec, state)
+    for i, v in enumerate(state.validators):
+        if (
+            v.activation_eligibility_epoch == FAR_FUTURE_EPOCH
+            and v.effective_balance >= spec.min_activation_balance
+        ):
+            v.activation_eligibility_epoch = cur + 1
+        if (
+            st.is_active_validator(v, cur)
+            and v.effective_balance <= spec.ejection_balance
+        ):
+            initiate_validator_exit(spec, state, i)
+        if (
+            v.activation_epoch == FAR_FUTURE_EPOCH
+            and v.activation_eligibility_epoch
+            <= state.finalized_checkpoint.epoch
+        ):
+            v.activation_epoch = cur + 1 + spec.max_seed_lookahead
+
+
+# ------------------------------------------------------------ withdrawals
+
+
+def get_expected_withdrawals(spec: ChainSpec, state) -> tuple:
+    """Electra variant: pending partials drain first (bounded per
+    sweep), then the regular sweep with per-validator caps. Returns
+    (withdrawals, partials_consumed)."""
+    from . import state_transition as st
+
+    epoch = st.get_current_epoch(spec, state)
+    withdrawal_index = state.next_withdrawal_index
+    withdrawals = []
+    consumed = 0
+    for w in state.electra.pending_partial_withdrawals:
+        if (
+            int(w.withdrawable_epoch) > epoch
+            or len(withdrawals)
+            == spec.max_pending_partials_per_withdrawals_sweep
+        ):
+            break
+        idx = int(w.validator_index)
+        v = state.validators[idx]
+        ok = (
+            v.exit_epoch == FAR_FUTURE_EPOCH
+            and v.effective_balance >= spec.min_activation_balance
+            and state.balances[idx] > spec.min_activation_balance
+        )
+        if ok:
+            amount = min(
+                state.balances[idx] - spec.min_activation_balance,
+                int(w.amount),
+            )
+            withdrawals.append(
+                T.Withdrawal.make(
+                    index=withdrawal_index,
+                    validator_index=idx,
+                    address=bytes(v.withdrawal_credentials)[12:],
+                    amount=amount,
+                )
+            )
+            withdrawal_index += 1
+        consumed += 1
+    # regular sweep on top
+    bound = min(
+        len(state.validators), spec.preset.max_validators_per_withdrawals_sweep
+    )
+    vi = state.next_withdrawal_validator_index
+    for _ in range(bound):
+        if len(withdrawals) >= spec.preset.max_withdrawals_per_payload:
+            break
+        v = state.validators[vi]
+        balance = state.balances[vi]
+        # account for partials already in this set
+        already = sum(
+            int(w.amount) for w in withdrawals if int(w.validator_index) == vi
+        )
+        balance -= min(balance, already)
+        cap = get_max_effective_balance(spec, v)
+        fully = (
+            has_execution_withdrawal_credential(v)  # 0x01 OR 0x02
+            and v.withdrawable_epoch <= epoch
+            and balance > 0
+        )
+        if fully:
+            withdrawals.append(
+                T.Withdrawal.make(
+                    index=withdrawal_index,
+                    validator_index=vi,
+                    address=bytes(v.withdrawal_credentials)[12:],
+                    amount=balance,
+                )
+            )
+            withdrawal_index += 1
+        elif (
+            has_execution_withdrawal_credential(v)
+            and v.effective_balance == cap
+            and balance > cap
+        ):
+            withdrawals.append(
+                T.Withdrawal.make(
+                    index=withdrawal_index,
+                    validator_index=vi,
+                    address=bytes(v.withdrawal_credentials)[12:],
+                    amount=balance - cap,
+                )
+            )
+            withdrawal_index += 1
+        vi = (vi + 1) % len(state.validators)
+    return withdrawals, consumed
+
+
+# ------------------------------------------------------------- fork upgrade
+
+
+def upgrade_state(spec: ChainSpec, state) -> None:
+    """upgrade_to_electra: seed the electra sub-state at the fork
+    boundary (or electra genesis) — the balance churn must inherit the
+    pre-fork exit queue, not jump it."""
+    from . import state_transition as st
+
+    ex = state.electra
+    ex.deposit_requests_start_index = UNSET_DEPOSIT_REQUESTS_START_INDEX
+    exit_epochs = [
+        int(v.exit_epoch)
+        for v in state.validators
+        if v.exit_epoch != FAR_FUTURE_EPOCH
+    ]
+    ex.earliest_exit_epoch = max(
+        exit_epochs + [st.get_current_epoch(spec, state)]
+    ) + 1
+    ex.earliest_consolidation_epoch = (
+        st.get_current_epoch(spec, state) + 1 + spec.max_seed_lookahead
+    )
+    ex.exit_balance_to_consume = get_activation_exit_churn_limit(spec, state)
+    ex.consolidation_balance_to_consume = max(
+        get_consolidation_churn_limit(spec, state),
+        spec.effective_balance_increment,
+    )

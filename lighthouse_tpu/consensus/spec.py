@@ -163,6 +163,15 @@ class ChainSpec:
     churn_limit_quotient: int = 65536
     proposer_score_boost: int = 40
     target_aggregators_per_committee: int = 16
+    # electra (EIP-7251 MaxEB / EIP-7002 / EIP-6110 / EIP-7549)
+    min_activation_balance: int = 32 * 10**9
+    max_effective_balance_electra: int = 2048 * 10**9
+    min_per_epoch_churn_limit_electra: int = 128 * 10**9  # gwei
+    max_per_epoch_activation_exit_churn_limit: int = 256 * 10**9
+    min_slashing_penalty_quotient_electra: int = 4096
+    whistleblower_reward_quotient_electra: int = 4096
+    max_pending_partials_per_withdrawals_sweep: int = 8
+    max_pending_deposits_per_epoch: int = 16
     # deposit contract (chain_spec.rs deposit_chain_id/_network_id/_contract)
     deposit_chain_id: int = 1
     deposit_contract_address: str = "0x00000000219ab540356cBB839Cbe05303d7705Fa"
@@ -212,6 +221,16 @@ class ChainSpec:
             if e <= epoch:
                 current = name
         return current
+
+    def fork_at_least(self, epoch: int, name: str) -> bool:
+        """Is fork `name` (or a later one) active at `epoch`? The
+        fork_name.rs ordering comparison every fork gate uses."""
+        return FORK_ORDER.index(self.fork_name_at_epoch(epoch)) >= (
+            FORK_ORDER.index(name)
+        )
+
+    def electra_enabled(self, epoch: int) -> bool:
+        return self.fork_at_least(epoch, "electra")
 
     def fork_version_at_epoch(self, epoch: int) -> bytes:
         return self.fork_versions[self.fork_name_at_epoch(epoch)]

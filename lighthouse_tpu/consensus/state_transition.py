@@ -249,6 +249,11 @@ def initiate_validator_exit(spec: ChainSpec, state, index: int) -> None:
     v = state.validators[index]
     if v.exit_epoch != FAR_FUTURE_EPOCH:
         return
+    if spec.electra_enabled(get_current_epoch(spec, state)):
+        from . import electra
+
+        electra.initiate_validator_exit(spec, state, index)
+        return
     exit_epochs = [
         w.exit_epoch
         for w in state.validators
@@ -280,15 +285,22 @@ def slash_validator(
     state.slashings[epoch % spec.preset.epochs_per_slashings_vector] += (
         v.effective_balance
     )
-    decrease_balance(
-        state, index, v.effective_balance // MIN_SLASHING_PENALTY_QUOTIENT
+    electra_active = spec.electra_enabled(epoch)
+    slash_quotient = (
+        spec.min_slashing_penalty_quotient_electra
+        if electra_active
+        else MIN_SLASHING_PENALTY_QUOTIENT
     )
+    decrease_balance(state, index, v.effective_balance // slash_quotient)
     proposer_index = get_beacon_proposer_index(spec, state)
     if whistleblower_index is None:
         whistleblower_index = proposer_index
-    whistleblower_reward = (
-        v.effective_balance // spec.whistleblower_reward_quotient
+    wb_quotient = (
+        spec.whistleblower_reward_quotient_electra
+        if electra_active
+        else spec.whistleblower_reward_quotient
     )
+    whistleblower_reward = v.effective_balance // wb_quotient
     proposer_reward = (
         whistleblower_reward * PROPOSER_WEIGHT // WEIGHT_DENOMINATOR
     )
@@ -310,6 +322,15 @@ def process_slots(spec: ChainSpec, state, slot: int) -> None:
         _process_slot(spec, state)
         if (state.slot + 1) % spec.preset.slots_per_epoch == 0:
             process_epoch(spec, state)
+            # fork boundary: entering electra runs upgrade_to_electra
+            # (seeds churn from the pre-fork exit queue)
+            next_epoch = compute_epoch_at_slot(spec, state.slot + 1)
+            if spec.electra_enabled(next_epoch) and not spec.electra_enabled(
+                next_epoch - 1
+            ):
+                from . import electra
+
+                electra.upgrade_state(spec, state)
         state.slot += 1
 
 
@@ -504,7 +525,15 @@ def process_withdrawals(spec: ChainSpec, state, payload) -> None:
     """capella process_withdrawals: the payload's withdrawals must equal
     the state-derived expectation; balances decrease; sweep cursors
     advance."""
-    expected = get_expected_withdrawals(spec, state)
+    partials_consumed = 0
+    if spec.electra_enabled(get_current_epoch(spec, state)):
+        from . import electra
+
+        expected, partials_consumed = electra.get_expected_withdrawals(
+            spec, state
+        )
+    else:
+        expected = get_expected_withdrawals(spec, state)
     got = list(payload.withdrawals)
     if len(got) != len(expected):
         raise BlockProcessingError("withdrawal count mismatch")
@@ -518,6 +547,10 @@ def process_withdrawals(spec: ChainSpec, state, payload) -> None:
             raise BlockProcessingError("withdrawal mismatch")
     for w in expected:
         decrease_balance(state, w.validator_index, w.amount)
+    if partials_consumed:
+        state.electra.pending_partial_withdrawals = list(
+            state.electra.pending_partial_withdrawals
+        )[partials_consumed:]
     if expected:
         state.next_withdrawal_index = expected[-1].index + 1
     n = len(state.validators)
@@ -633,6 +666,23 @@ def process_operations(
         spec.preset.max_deposits,
         state.eth1_data.deposit_count - state.eth1_deposit_index,
     )
+    if spec.electra_enabled(get_current_epoch(spec, state)):
+        from .electra import UNSET_DEPOSIT_REQUESTS_START_INDEX
+
+        start = state.electra.deposit_requests_start_index
+        if start not in (0, UNSET_DEPOSIT_REQUESTS_START_INDEX):
+            # EIP-6110 transition: the legacy eth1 path shuts off at
+            # deposit_requests_start_index — past it the SAME deposit
+            # would arrive again as a DepositRequest (double credit)
+            limit = min(int(state.eth1_data.deposit_count), int(start))
+            expected_deposits = (
+                min(
+                    spec.preset.max_deposits,
+                    limit - state.eth1_deposit_index,
+                )
+                if state.eth1_deposit_index < limit
+                else 0
+            )
     if len(body.deposits) != expected_deposits:
         raise BlockProcessingError("wrong deposit count")
     for op in body.proposer_slashings:
@@ -647,6 +697,12 @@ def process_operations(
         process_voluntary_exit(spec, state, op, verify_signatures)
     for op in body.bls_to_execution_changes:
         process_bls_to_execution_change(spec, state, op, verify_signatures)
+    if spec.electra_enabled(get_current_epoch(spec, state)):
+        from . import electra
+
+        electra.process_execution_requests(
+            spec, state, body.execution_requests, ctx
+        )
 
 
 def is_slashable_validator(v, epoch: int) -> bool:
@@ -792,9 +848,34 @@ def get_base_reward(spec: ChainSpec, state, index: int) -> int:
     return increments * get_base_reward_per_increment(spec, state)
 
 
+def resolve_committee_index(spec: ChainSpec, state, attestation) -> int:
+    """EIP-7549: post-electra the committee moves to committee_bits
+    (data.index must be 0); exactly one bit set in this framework's
+    single-committee canonical shape."""
+    data = attestation.data
+    if spec.electra_enabled(compute_epoch_at_slot(spec, int(data.slot))):
+        set_bits = [
+            i for i, b in enumerate(attestation.committee_bits) if b
+        ]
+        # STRICT post-electra (the spec asserts): index lives in the
+        # bits, data.index must be zero — a bits-free attestation is
+        # invalid, not a legacy fallback (consensus-split risk)
+        if int(data.index) != 0:
+            raise BlockProcessingError(
+                "electra attestation must have data.index == 0"
+            )
+        if len(set_bits) != 1:
+            raise BlockProcessingError(
+                "electra attestation needs exactly one committee bit"
+            )
+        return set_bits[0]
+    return int(data.index)
+
+
 def get_attesting_indices(spec: ChainSpec, state, attestation) -> set:
     committee = get_beacon_committee(
-        spec, state, attestation.data.slot, attestation.data.index
+        spec, state, attestation.data.slot,
+        resolve_committee_index(spec, state, attestation),
     )
     bits = attestation.aggregation_bits
     if len(bits) != len(committee):
@@ -817,7 +898,10 @@ def process_attestation(
         data.slot + spec.min_attestation_inclusion_delay <= state.slot
     ):
         raise BlockProcessingError("attestation too fresh")
-    if data.index >= get_committee_count_per_slot(spec, state, data.target.epoch):
+    committee_index = resolve_committee_index(spec, state, attestation)
+    if committee_index >= get_committee_count_per_slot(
+        spec, state, data.target.epoch
+    ):
         raise BlockProcessingError("committee index out of range")
 
     inclusion_delay = state.slot - data.slot
@@ -986,6 +1070,16 @@ def process_voluntary_exit(
         )
         if not bls.verify_signature_sets([s]):
             raise BlockProcessingError("invalid exit signature")
+    if spec.electra_enabled(get_current_epoch(spec, state)):
+        from . import electra
+
+        # EIP-7251: no voluntary exit while partial withdrawals pend
+        if electra.get_pending_balance_to_withdraw(
+            state, int(exit_msg.validator_index)
+        ) > 0:
+            raise BlockProcessingError(
+                "voluntary exit with pending partial withdrawals"
+            )
     initiate_validator_exit(spec, state, exit_msg.validator_index)
 
 
@@ -1139,10 +1233,21 @@ def process_epoch(spec: ChainSpec, state) -> None:
         flag_balances_prev,
         total_active,
     )
-    process_registry_updates(spec, state)
+    electra_active = spec.electra_enabled(cur)
+    if electra_active:
+        from . import electra as _electra
+
+        _electra.process_registry_updates(spec, state)
+    else:
+        process_registry_updates(spec, state)
     process_slashings_epoch(spec, state, total_active)
     process_eth1_data_reset(spec, state)
-    process_effective_balance_updates(spec, state)
+    if electra_active:
+        _electra.process_pending_deposits(spec, state)
+        _electra.process_pending_consolidations(spec, state)
+        _electra.process_effective_balance_updates(spec, state)
+    else:
+        process_effective_balance_updates(spec, state)
     process_slashings_reset(spec, state)
     process_randao_mixes_reset(spec, state)
     process_historical_roots_update(spec, state)
@@ -1432,8 +1537,19 @@ def mock_execution_payload(spec: ChainSpec, state):
         block_hash=_hash(
             b"mock-el-block" + parent + state.slot.to_bytes(8, "little")
         ),
-        withdrawals=get_expected_withdrawals(spec, state),
+        withdrawals=_expected_withdrawals_for_fork(spec, state),
     )
+
+
+def _expected_withdrawals_for_fork(spec: ChainSpec, state) -> list:
+    """The fork-correct expectation (a produced payload must match what
+    process_withdrawals will demand, incl. electra pending partials)."""
+    if spec.electra_enabled(get_current_epoch(spec, state)):
+        from . import electra
+
+        withdrawals, _ = electra.get_expected_withdrawals(spec, state)
+        return withdrawals
+    return get_expected_withdrawals(spec, state)
 
 
 # ---------------------------------------------------------------- genesis

@@ -92,6 +92,11 @@ Attestation = Container(
         ("aggregation_bits", Bitlist(_P.max_validators_per_committee)),
         ("data", AttestationData),
         ("signature", Bytes96),
+        # Electra (EIP-7549): data.index moves to committee_bits; pre-
+        # electra this stays all-zero. One committee per attestation in
+        # this framework's canonical shape (aggregation_bits stays
+        # committee-scoped).
+        ("committee_bits", Bitvector(_P.max_committees_per_slot)),
     ],
 )
 
@@ -278,6 +283,96 @@ def execution_payload_to_header(payload) -> "ExecutionPayloadHeader":
     fields["excess_blob_gas"] = payload.excess_blob_gas
     return ExecutionPayloadHeader.make(**fields)
 
+# ------------------------------------------------------- electra (EIP-7251/6110/7002)
+
+DepositRequest = Container(
+    "DepositRequest",
+    [
+        ("pubkey", Bytes48),
+        ("withdrawal_credentials", Bytes32),
+        ("amount", uint64),
+        ("signature", Bytes96),
+        ("index", uint64),
+    ],
+)
+
+WithdrawalRequest = Container(
+    "WithdrawalRequest",
+    [
+        ("source_address", Bytes20),
+        ("validator_pubkey", Bytes48),
+        ("amount", uint64),
+    ],
+)
+
+ConsolidationRequest = Container(
+    "ConsolidationRequest",
+    [
+        ("source_address", Bytes20),
+        ("source_pubkey", Bytes48),
+        ("target_pubkey", Bytes48),
+    ],
+)
+
+# EL-sourced requests carried in the body (electra
+# beacon_block_body.rs execution_requests; limits are the spec's
+# MAX_DEPOSIT/WITHDRAWAL/CONSOLIDATION_REQUESTS_PER_PAYLOAD)
+ExecutionRequests = Container(
+    "ExecutionRequests",
+    [
+        ("deposits", List(DepositRequest, 8192)),
+        ("withdrawals", List(WithdrawalRequest, 16)),
+        ("consolidations", List(ConsolidationRequest, 2)),
+    ],
+)
+
+PendingDeposit = Container(
+    "PendingDeposit",
+    [
+        ("pubkey", Bytes48),
+        ("withdrawal_credentials", Bytes32),
+        ("amount", uint64),
+        ("signature", Bytes96),
+        ("slot", uint64),
+    ],
+)
+
+PendingPartialWithdrawal = Container(
+    "PendingPartialWithdrawal",
+    [
+        ("validator_index", uint64),
+        ("amount", uint64),
+        ("withdrawable_epoch", uint64),
+    ],
+)
+
+PendingConsolidation = Container(
+    "PendingConsolidation",
+    [("source_index", uint64), ("target_index", uint64)],
+)
+
+# The electra-only state surface lives in ONE sub-container field so
+# the canonical BeaconState keeps its 32-leaf tree (light-client
+# gindices 54/55/105 stay valid). DEVIATION from spec-exact SSZ (the
+# spec appends 9 top-level fields); documented in SURVEY parity notes.
+ElectraStateExtras = Container(
+    "ElectraStateExtras",
+    [
+        ("deposit_requests_start_index", uint64),
+        ("deposit_balance_to_consume", uint64),
+        ("exit_balance_to_consume", uint64),
+        ("earliest_exit_epoch", uint64),
+        ("consolidation_balance_to_consume", uint64),
+        ("earliest_consolidation_epoch", uint64),
+        ("pending_deposits", List(PendingDeposit, 2**27)),
+        (
+            "pending_partial_withdrawals",
+            List(PendingPartialWithdrawal, 2**27),
+        ),
+        ("pending_consolidations", List(PendingConsolidation, 2**18)),
+    ],
+)
+
 BeaconBlockBody = Container(
     "BeaconBlockBody",
     [
@@ -299,6 +394,8 @@ BeaconBlockBody = Container(
             "blob_kzg_commitments",
             List(Bytes48, _P.max_blob_commitments_per_block),
         ),
+        # Electra+: EL-sourced deposit/withdrawal/consolidation requests
+        ("execution_requests", ExecutionRequests),
     ],
 )
 
@@ -405,6 +502,8 @@ HistoricalSummary = Container(
     [("block_summary_root", Bytes32), ("state_summary_root", Bytes32)],
 )
 
+
+
 # ---------------------------------------------------------------- state
 
 BeaconState = Container(
@@ -440,5 +539,8 @@ BeaconState = Container(
         ("next_withdrawal_index", uint64),
         ("next_withdrawal_validator_index", uint64),
         ("historical_summaries", List(HistoricalSummary, _P.historical_roots_limit)),
+        # Electra+ (ONE sub-container field keeps the 32-leaf state
+        # tree; see ElectraStateExtras)
+        ("electra", ElectraStateExtras),
     ],
 )

@@ -39,13 +39,24 @@ class NaiveAggregationPool:
 
     # ------------------------------------------------------------ attestations
 
+    @staticmethod
+    def _att_key(data, committee_bits) -> tuple:
+        """(data_root, committee_bits): post-electra data.index is 0
+        for every committee, so the data root alone would merge
+        DIFFERENT committees' signatures into one garbage aggregate —
+        the committee bits disambiguate (EIP-7549)."""
+        bits = bytes(int(bool(b)) for b in (committee_bits or ()))
+        if not any(bits):
+            bits = b""  # pre-electra / None / all-zero are ONE key form
+        return (T.AttestationData.hash_tree_root(data), bits)
+
     def insert_attestation(self, attestation, indices=()) -> None:
         """Merge a (possibly single-bit) attestation into the local
         aggregate for its data. `indices` are the attesting validator
         indices the caller resolved from the bits (tracked so the op
         pool can know exactly whom the aggregate covers)."""
         data = attestation.data
-        root = T.AttestationData.hash_tree_root(data)
+        root = self._att_key(data, attestation.committee_bits)
         bits = list(attestation.aggregation_bits)
         entry = self._atts.get(root)
         if entry is None:
@@ -55,6 +66,7 @@ class NaiveAggregationPool:
                     aggregation_bits=bits,
                     data=data,
                     signature=bytes(attestation.signature),
+                    committee_bits=list(attestation.committee_bits),
                 ),
                 frozenset(indices),
             )
@@ -78,18 +90,17 @@ class NaiveAggregationPool:
                 signature=_merge_signatures(
                     agg.signature, attestation.signature
                 ),
+                committee_bits=list(agg.committee_bits),
             ),
             agg_idx | frozenset(indices),
         )
 
-    def get_aggregate(self, data) -> Optional[object]:
-        root = T.AttestationData.hash_tree_root(data)
-        entry = self._atts.get(root)
+    def get_aggregate(self, data, committee_bits=None) -> Optional[object]:
+        entry = self._atts.get(self._att_key(data, committee_bits))
         return entry[1] if entry else None
 
-    def get_indices(self, data) -> frozenset:
-        root = T.AttestationData.hash_tree_root(data)
-        entry = self._atts.get(root)
+    def get_indices(self, data, committee_bits=None) -> frozenset:
+        entry = self._atts.get(self._att_key(data, committee_bits))
         return entry[2] if entry else frozenset()
 
     def aggregates_for_slot(self, slot: int) -> list:

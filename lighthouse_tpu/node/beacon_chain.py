@@ -109,9 +109,6 @@ class BeaconChain:
         # optional eth1 deposit follower (eth1/src/service.rs role):
         # feeds deposit inclusion + eth1_data votes at block production
         self.eth1 = None
-        # optional light-client server cache (light_client_server_cache
-        # role) — attach with enable_light_client_server()
-        self.light_client_cache = None
         self._in_fcu_recompute = False
         # Deneb data availability: sidecars buffer here until the block's
         # commitment list is satisfied. kzg=None runs blob-free (blocks
@@ -193,6 +190,9 @@ class BeaconChain:
         self.early_attester_cache = EarlyAttesterCache()
         self.event_bus = EventBus()
         self.validator_monitor = None
+        # optional light-client server cache (light_client_server_cache
+        # role) — construct a LightClientServerCache and assign
+        self.light_client_cache = None
         # (head_root, slot, state) pre-advanced at the slot tail
         self._advanced_state = None
         self._last_finalized_emitted = -1
@@ -281,7 +281,6 @@ class BeaconChain:
         self.slasher = None
         self.execution_layer = None
         self.eth1 = None
-        self.light_client_cache = None
         self._in_fcu_recompute = False
         self.kzg = kzg
         self.da_checker = (
@@ -869,7 +868,10 @@ class BeaconChain:
                 try:
                     adv = state
                     committee = st.get_beacon_committee(
-                        self.spec, adv, att.data.slot, att.data.index
+                        self.spec,
+                        adv,
+                        att.data.slot,
+                        st.resolve_committee_index(self.spec, adv, att),
                     )
                     indices = [
                         c
@@ -1064,7 +1066,8 @@ class BeaconChain:
         if state is None:
             raise AttestationError("no state for target")
         committee = self.beacon_committee_cached(
-            state, data.slot, data.index
+            state, data.slot,
+            st.resolve_committee_index(self.spec, state, attestation),
         )
         bits = attestation.aggregation_bits
         if len(bits) != len(committee):
@@ -1191,7 +1194,9 @@ class BeaconChain:
                 adv = state.copy()
                 st.process_slots(self.spec, adv, data.slot)
             committee = self.beacon_committee_cached(
-                adv, data.slot, data.index
+                adv,
+                data.slot,
+                st.resolve_committee_index(self.spec, adv, aggregate),
             )
             if int(msg.aggregator_index) not in committee:
                 raise AttestationError("aggregator not in committee")

@@ -74,7 +74,11 @@ class OperationPool:
         """Store an aggregate for packing (op_pool insert_attestation).
         Aggregates whose signers are a subset of an existing one are
         dropped; supersets replace their subsets."""
-        root = T.AttestationData.hash_tree_root(attestation.data)
+        cb = bytes(int(bool(b)) for b in attestation.committee_bits)
+        root = (
+            T.AttestationData.hash_tree_root(attestation.data),
+            cb if any(cb) else b"",
+        )
         indices = frozenset(attesting_indices)
         slot = int(attestation.data.slot)
         _, entries = self._attestations.get(root, (slot, []))

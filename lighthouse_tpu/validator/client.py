@@ -42,7 +42,7 @@ class BeaconNodeApi:
     def publish_attestation(self, attestation) -> None:
         raise NotImplementedError
 
-    def aggregate_for(self, data) -> Optional[object]:
+    def aggregate_for(self, data, committee_bits=None) -> Optional[object]:
         raise NotImplementedError
 
     def publish_aggregate(self, signed_aggregate) -> None:
@@ -120,8 +120,8 @@ class InProcessBeaconNode(BeaconNodeApi):
         v = self.chain.verify_attestation_for_gossip(attestation)
         self.chain.batch_verify_attestations([v])
 
-    def aggregate_for(self, data):
-        return self.chain.agg_pool.get_aggregate(data)
+    def aggregate_for(self, data, committee_bits=None):
+        return self.chain.agg_pool.get_aggregate(data, committee_bits)
 
     def publish_aggregate(self, signed_aggregate):
         self.chain.verify_aggregate_for_gossip(signed_aggregate)
@@ -208,10 +208,12 @@ class ValidatorClient:
         fork = self.bn.head_state().fork
         by_committee: dict[int, object] = {}
         for duty in self.duties.attester_duties_at(slot):
-            data = by_committee.get(duty.committee_index)
-            if data is None:
-                data = self.bn.attestation_data(slot, duty.committee_index)
-                by_committee[duty.committee_index] = data
+            cached = by_committee.get(duty.committee_index)
+            if cached is None:
+                raw = self.bn.attestation_data(slot, duty.committee_index)
+                cached = self._fork_shape(slot, raw, duty.committee_index)
+                by_committee[duty.committee_index] = cached
+            data, committee_bits = cached
             try:
                 sig = self.store.sign_attestation(duty.pubkey, data, fork)
             except SlashingProtectionError:
@@ -222,7 +224,10 @@ class ValidatorClient:
                 for i in range(duty.committee_length)
             ]
             att = T.Attestation.make(
-                aggregation_bits=bits, data=data, signature=sig
+                aggregation_bits=bits,
+                data=data,
+                signature=sig,
+                committee_bits=committee_bits,
             )
             try:
                 self.bn.publish_attestation(att)
@@ -232,6 +237,28 @@ class ValidatorClient:
                 # duties
                 continue
             self.published_attestations += 1
+
+    def _fork_shape(self, slot: int, data, committee_index: int) -> tuple:
+        """EIP-7549 shaping: post-electra the committee index moves
+        from data.index into committee_bits (data.index = 0); the
+        signed root therefore changes — shaping must happen BEFORE
+        signing and slashing-DB recording."""
+        if not self.spec.electra_enabled(
+            st.compute_epoch_at_slot(self.spec, slot)
+        ):
+            return data, None
+        shaped = T.AttestationData.make(
+            slot=data.slot,
+            index=0,
+            beacon_block_root=bytes(data.beacon_block_root),
+            source=data.source,
+            target=data.target,
+        )
+        bits = [
+            i == committee_index
+            for i in range(self.spec.preset.max_committees_per_slot)
+        ]
+        return shaped, bits
 
     def _managed_validators(self, state) -> dict:
         """pubkey -> validator index for keys this VC holds (hoisted
@@ -312,8 +339,11 @@ class ValidatorClient:
         for duty in self.duties.attester_duties_at(slot):
             if not duty.is_aggregator:
                 continue
-            data = self.bn.attestation_data(slot, duty.committee_index)
-            aggregate = self.bn.aggregate_for(data)
+            raw = self.bn.attestation_data(slot, duty.committee_index)
+            data, committee_bits = self._fork_shape(
+                slot, raw, duty.committee_index
+            )
+            aggregate = self.bn.aggregate_for(data, committee_bits)
             if aggregate is None:
                 continue
             msg = T.AggregateAndProof.make(

@@ -1,0 +1,364 @@
+"""Electra fork surface: MaxEB/compounding (EIP-7251), EL withdrawal
+requests (EIP-7002), EL deposits (EIP-6110), committee bits (EIP-7549),
+churn + pending queues (reference per_block_processing /
+single_pass.rs electra arms)."""
+
+import dataclasses
+
+import pytest
+
+from lighthouse_tpu.consensus import electra as E
+from lighthouse_tpu.consensus import state_transition as st
+from lighthouse_tpu.consensus import types as T
+from lighthouse_tpu.consensus.spec import (
+    FAR_FUTURE_EPOCH,
+    ChainSpec,
+    MAINNET_PRESET,
+    mainnet_spec,
+)
+from lighthouse_tpu.crypto.bls.keys import SecretKey
+
+N = 16
+
+
+def electra_spec() -> ChainSpec:
+    spec = mainnet_spec()
+    spec.fork_epochs = dict(spec.fork_epochs)
+    spec.fork_epochs["electra"] = 0  # electra from genesis
+    return spec
+
+
+SPEC = electra_spec()
+PRE_SPEC = mainnet_spec()  # electra at 364032 — not active at epoch 0
+
+
+def _state(spec=SPEC):
+    return st.interop_genesis_state(spec, st.interop_pubkeys(N))
+
+
+def _make_compounding(state, i):
+    v = state.validators[i]
+    v.withdrawal_credentials = b"\x02" + bytes(v.withdrawal_credentials)[1:]
+
+
+def _make_eth1_creds(state, i, address=b"\xaa" * 20):
+    v = state.validators[i]
+    v.withdrawal_credentials = b"\x01" + b"\x00" * 11 + address
+
+
+# ---------------------------------------------------------------- gating
+
+
+def test_fork_gating_helpers():
+    assert SPEC.electra_enabled(0)
+    assert not PRE_SPEC.electra_enabled(0)
+    assert PRE_SPEC.electra_enabled(364032)
+    assert PRE_SPEC.fork_at_least(194048, "capella")
+    assert not PRE_SPEC.fork_at_least(0, "electra")
+    assert PRE_SPEC.fork_name_at_epoch(364032) == "electra"
+
+
+# ------------------------------------------------------------- credentials
+
+
+def test_max_effective_balance_per_validator():
+    state = _state()
+    _make_compounding(state, 0)
+    _make_eth1_creds(state, 1)
+    assert (
+        E.get_max_effective_balance(SPEC, state.validators[0])
+        == SPEC.max_effective_balance_electra
+    )
+    assert (
+        E.get_max_effective_balance(SPEC, state.validators[1])
+        == SPEC.min_activation_balance
+    )
+
+
+def test_compounding_effective_balance_grows_past_32eth():
+    state = _state()
+    _make_compounding(state, 0)
+    state.balances[0] = 100 * 10**9  # 100 ETH
+    E.process_effective_balance_updates(SPEC, state)
+    assert state.validators[0].effective_balance == 100 * 10**9
+    # non-compounding stays capped at 32
+    state.balances[1] = 100 * 10**9
+    E.process_effective_balance_updates(SPEC, state)
+    assert state.validators[1].effective_balance == 32 * 10**9
+
+
+# ------------------------------------------------------------ exit churn
+
+
+def test_balance_denominated_exit_churn():
+    state = _state()
+    # tiny active balance -> churn floor applies
+    churn = E.get_activation_exit_churn_limit(SPEC, state)
+    assert churn == SPEC.min_per_epoch_churn_limit_electra
+    e1 = E.compute_exit_epoch_and_update_churn(SPEC, state, 32 * 10**9)
+    # consuming far beyond one epoch's churn pushes the epoch out
+    big = churn * 3
+    e2 = E.compute_exit_epoch_and_update_churn(SPEC, state, big)
+    assert e2 >= e1
+    assert state.electra.earliest_exit_epoch == e2
+
+
+def test_electra_initiate_exit_used_by_voluntary_exit_path():
+    state = _state()
+    st.initiate_validator_exit(SPEC, state, 0)
+    v = state.validators[0]
+    assert v.exit_epoch != FAR_FUTURE_EPOCH
+    assert state.electra.earliest_exit_epoch >= v.exit_epoch
+
+
+# ------------------------------------------------------- deposit requests
+
+
+def test_deposit_request_flows_through_pending_queue():
+    state = _state()
+    sk = SecretKey.from_seed(b"electra-dep")
+    pk = sk.public_key().to_bytes()
+    req = T.DepositRequest.make(
+        pubkey=pk,
+        withdrawal_credentials=b"\x01" + b"\x00" * 11 + b"\xbb" * 20,
+        amount=32 * 10**9,
+        signature=b"\x00" * 96,  # unsigned: existing-validator top-up path
+        index=7,
+    )
+    E.process_deposit_request(SPEC, state, req)
+    assert len(state.electra.pending_deposits) == 1
+    assert state.electra.deposit_requests_start_index == 7
+
+    # top-up for an EXISTING validator applies without a signature
+    existing_pk = bytes(state.validators[3].pubkey)
+    req2 = T.DepositRequest.make(
+        pubkey=existing_pk,
+        withdrawal_credentials=bytes(state.validators[3].withdrawal_credentials),
+        amount=1 * 10**9,
+        signature=b"\x00" * 96,
+        index=8,
+    )
+    E.process_deposit_request(SPEC, state, req2)
+    state.finalized_checkpoint = T.Checkpoint.make(epoch=1, root=b"\x00" * 32)
+    state.slot = SPEC.preset.slots_per_epoch  # past the deposits' slots
+    before = state.balances[3]
+    E.process_pending_deposits(SPEC, state)
+    assert state.balances[3] == before + 1 * 10**9
+    assert len(state.electra.pending_deposits) == 0
+
+
+def test_pending_deposits_respect_churn():
+    state = _state()
+    state.finalized_checkpoint = T.Checkpoint.make(epoch=1, root=b"\x00" * 32)
+    state.slot = SPEC.preset.slots_per_epoch
+    churn = E.get_activation_exit_churn_limit(SPEC, state)
+    # queue two top-ups: one consumes nearly all churn, second must wait
+    pk0 = bytes(state.validators[0].pubkey)
+    for amount in (churn, 10**9):
+        state.electra.pending_deposits.append(
+            T.PendingDeposit.make(
+                pubkey=pk0,
+                withdrawal_credentials=bytes(
+                    state.validators[0].withdrawal_credentials
+                ),
+                amount=amount,
+                signature=b"\x00" * 96,
+                slot=0,
+            )
+        )
+    E.process_pending_deposits(SPEC, state)
+    assert len(state.electra.pending_deposits) == 1  # second deferred
+    E.process_pending_deposits(SPEC, state)
+    assert len(state.electra.pending_deposits) == 0
+
+
+# ---------------------------------------------------- withdrawal requests
+
+
+def test_withdrawal_request_full_exit_and_partial():
+    state = _state()
+    addr = b"\xcc" * 20
+    _make_eth1_creds(state, 2, addr)
+    ctx = st.BlockContext(SPEC, state)
+    # full exit (amount 0)
+    req = T.WithdrawalRequest.make(
+        source_address=addr,
+        validator_pubkey=bytes(state.validators[2].pubkey),
+        amount=0,
+    )
+    state.slot = (
+        SPEC.shard_committee_period * SPEC.preset.slots_per_epoch
+    )  # past min activation period
+    E.process_withdrawal_request(SPEC, state, req, ctx)
+    assert state.validators[2].exit_epoch != FAR_FUTURE_EPOCH
+
+    # partial from a compounding validator with excess
+    _make_compounding(state, 3)
+    v3 = state.validators[3]
+    v3.withdrawal_credentials = b"\x02" + b"\x00" * 11 + addr
+    state.balances[3] = 40 * 10**9
+    v3.effective_balance = 32 * 10**9
+    req2 = T.WithdrawalRequest.make(
+        source_address=addr,
+        validator_pubkey=bytes(v3.pubkey),
+        amount=5 * 10**9,
+    )
+    E.process_withdrawal_request(SPEC, state, req2, ctx)
+    assert len(state.electra.pending_partial_withdrawals) == 1
+    ppw = state.electra.pending_partial_withdrawals[0]
+    assert int(ppw.validator_index) == 3 and int(ppw.amount) == 5 * 10**9
+
+    # wrong source address is a silent no-op
+    req3 = T.WithdrawalRequest.make(
+        source_address=b"\xdd" * 20,
+        validator_pubkey=bytes(state.validators[4].pubkey),
+        amount=0,
+    )
+    _make_eth1_creds(state, 4, b"\xcc" * 20)
+    E.process_withdrawal_request(SPEC, state, req3, ctx)
+    assert state.validators[4].exit_epoch == FAR_FUTURE_EPOCH
+
+
+def test_expected_withdrawals_include_pending_partials():
+    state = _state()
+    addr = b"\xee" * 20
+    _make_compounding(state, 5)
+    state.validators[5].withdrawal_credentials = b"\x02" + b"\x00" * 11 + addr
+    state.balances[5] = 40 * 10**9
+    state.validators[5].effective_balance = 32 * 10**9
+    state.electra.pending_partial_withdrawals.append(
+        T.PendingPartialWithdrawal.make(
+            validator_index=5, amount=5 * 10**9, withdrawable_epoch=0
+        )
+    )
+    withdrawals, consumed = E.get_expected_withdrawals(SPEC, state)
+    assert consumed == 1
+    assert any(
+        int(w.validator_index) == 5 and int(w.amount) == 5 * 10**9
+        for w in withdrawals
+    )
+
+
+# ----------------------------------------------------------- consolidation
+
+
+def test_consolidation_request_and_pending_processing():
+    state = _state()
+    addr = b"\x99" * 20
+    _make_eth1_creds(state, 6, addr)
+    _make_compounding(state, 7)
+    state.slot = (
+        SPEC.shard_committee_period * SPEC.preset.slots_per_epoch
+    )
+    ctx = st.BlockContext(SPEC, state)
+    req = T.ConsolidationRequest.make(
+        source_address=addr,
+        source_pubkey=bytes(state.validators[6].pubkey),
+        target_pubkey=bytes(state.validators[7].pubkey),
+    )
+    E.process_consolidation_request(SPEC, state, req, ctx)
+    assert len(state.electra.pending_consolidations) == 1
+    src_v = state.validators[6]
+    assert src_v.exit_epoch != FAR_FUTURE_EPOCH
+
+    # once the source is withdrawable, the balance moves to the target
+    state.slot = (
+        (src_v.withdrawable_epoch + 1) * SPEC.preset.slots_per_epoch
+    )
+    before_target = state.balances[7]
+    before_source = state.balances[6]
+    E.process_pending_consolidations(SPEC, state)
+    assert len(state.electra.pending_consolidations) == 0
+    moved = min(before_source, SPEC.min_activation_balance)
+    assert state.balances[7] == before_target + moved
+    assert state.balances[6] == before_source - moved
+
+
+def test_self_consolidation_switches_to_compounding():
+    state = _state()
+    addr = b"\x88" * 20
+    _make_eth1_creds(state, 8, addr)
+    state.balances[8] = 40 * 10**9
+    ctx = st.BlockContext(SPEC, state)
+    pk = bytes(state.validators[8].pubkey)
+    req = T.ConsolidationRequest.make(
+        source_address=addr, source_pubkey=pk, target_pubkey=pk
+    )
+    E.process_consolidation_request(SPEC, state, req, ctx)
+    assert E.has_compounding_withdrawal_credential(state.validators[8])
+    # excess over 32 ETH was queued as a pending deposit
+    assert state.balances[8] == 32 * 10**9
+    assert int(state.electra.pending_deposits[0].amount) == 8 * 10**9
+
+
+# ------------------------------------------------------------ attestations
+
+
+def test_electra_committee_bits_resolution():
+    state = _state()
+    state.slot = 8
+    data = T.AttestationData.make(
+        slot=4, index=0,
+        beacon_block_root=b"\x01" * 32,
+        source=T.Checkpoint.make(epoch=0, root=b"\x00" * 32),
+        target=T.Checkpoint.make(epoch=0, root=b"\x02" * 32),
+    )
+    bits = [False] * SPEC.preset.max_committees_per_slot
+    bits[0] = True
+    att = T.Attestation.make(
+        aggregation_bits=[True],
+        data=data,
+        signature=b"\x00" * 96,
+        committee_bits=bits,
+    )
+    assert st.resolve_committee_index(SPEC, state, att) == 0
+    # data.index != 0 with committee bits set is invalid post-electra
+    data2 = T.AttestationData.make(
+        slot=4, index=1,
+        beacon_block_root=b"\x01" * 32,
+        source=T.Checkpoint.make(epoch=0, root=b"\x00" * 32),
+        target=T.Checkpoint.make(epoch=0, root=b"\x02" * 32),
+    )
+    att2 = T.Attestation.make(
+        aggregation_bits=[True], data=data2,
+        signature=b"\x00" * 96, committee_bits=bits,
+    )
+    with pytest.raises(st.BlockProcessingError):
+        st.resolve_committee_index(SPEC, state, att2)
+    # electra attestation with NO committee bit set is invalid (strict:
+    # no silent fallback to data.index — consensus-split risk)
+    att3 = T.Attestation.make(
+        aggregation_bits=[True], data=data, signature=b"\x00" * 96
+    )
+    with pytest.raises(st.BlockProcessingError):
+        st.resolve_committee_index(SPEC, state, att3)
+    # pre-electra: data.index rules, committee_bits ignored
+    assert st.resolve_committee_index(PRE_SPEC, state, att2) == 1
+
+
+# ----------------------------------------------------------- end-to-end
+
+
+def test_electra_chain_imports_blocks_with_requests(tmp_path):
+    """A chain under an electra-from-genesis spec produces + imports
+    blocks whose bodies carry (empty) execution requests; the epoch
+    pass runs the electra arms."""
+    from lighthouse_tpu.node.client import ClientBuilder
+    from lighthouse_tpu.node.store import HotColdDB, LogStore
+
+    node = (
+        ClientBuilder(SPEC)
+        .store(HotColdDB(SPEC, LogStore(str(tmp_path))))
+        .genesis_state(_state())
+        .bls_backend("fake")
+        .build()
+    )
+    chain = node.chain
+    sig = b"\xc0" + b"\x00" * 95
+    for slot in range(1, SPEC.preset.slots_per_epoch + 2):
+        chain.on_slot(slot)
+        block = chain.produce_block(slot, randao_reveal=sig)
+        chain.process_block(
+            T.SignedBeaconBlock.make(message=block, signature=sig)
+        )
+    assert chain.head.slot == SPEC.preset.slots_per_epoch + 1
