@@ -37,15 +37,41 @@ class LocalKeystoreSigner(SigningMethod):
 class Web3SignerMethod(SigningMethod):
     """SigningMethod::Web3Signer: remote HTTP signer. The transport is a
     callable (url, signing_root) -> signature bytes so the HTTP client
-    (and its tests) slot in without this module importing one."""
+    (and its tests) slot in without this module importing one; pass
+    `web3signer_http_post` for the real wire."""
 
-    def __init__(self, public_key: bytes, url: str, post):
+    def __init__(self, public_key: bytes, url: str, post=None):
         self._pk = bytes(public_key)
         self.url = url
-        self._post = post
+        self._post = post or web3signer_http_post
 
     def sign(self, signing_root: bytes) -> Signature:
         return Signature.from_bytes(self._post(self.url, signing_root))
 
     def public_key_bytes(self) -> bytes:
         return self._pk
+
+
+def web3signer_http_post(url: str, signing_root: bytes) -> bytes:
+    """The web3signer REST wire: POST /api/v1/eth2/sign/{identifier}
+    with {"signing_root": "0x.."}; the response body is the 0x-hex
+    signature (possibly JSON-wrapped)."""
+    import json
+    import urllib.request
+
+    body = json.dumps({"signing_root": "0x" + bytes(signing_root).hex()})
+    req = urllib.request.Request(
+        url,
+        data=body.encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=12) as resp:
+        raw = resp.read().decode().strip()
+    if raw.startswith("{"):
+        raw = json.loads(raw).get("signature", "")
+    if raw.startswith('"'):
+        raw = raw.strip('"')
+    if raw.startswith("0x"):
+        raw = raw[2:]
+    return bytes.fromhex(raw)

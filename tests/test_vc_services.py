@@ -205,6 +205,51 @@ def test_chain_validator_liveness_surface(tmp_path):
         server.stop()
 
 
+# ------------------------------------------------------- web3signer
+
+
+def test_web3signer_http_transport_round_trip():
+    """The real wire: a mock web3signer answers the REST POST and the
+    SigningMethod returns a parseable signature."""
+    import json as _json
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+    import threading
+
+    from lighthouse_tpu.validator.signing_method import Web3SignerMethod
+
+    sk = _sk(20)
+    pk = sk.public_key().to_bytes()
+    seen = {}
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            n = int(self.headers["Content-Length"])
+            req = _json.loads(self.rfile.read(n))
+            seen["signing_root"] = req["signing_root"]
+            root = bytes.fromhex(req["signing_root"][2:])
+            sig = sk.sign(root).to_bytes()
+            body = _json.dumps({"signature": "0x" + sig.hex()}).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        url = f"http://127.0.0.1:{httpd.server_address[1]}/api/v1/eth2/sign/0x{pk.hex()}"
+        method = Web3SignerMethod(pk, url)
+        root = b"\x42" * 32
+        sig = method.sign(root)
+        assert seen["signing_root"] == "0x" + root.hex()
+        assert sig.to_bytes() == sk.sign(root).to_bytes()
+    finally:
+        httpd.shutdown()
+
+
 # ------------------------------------------------------- keymanager
 
 
