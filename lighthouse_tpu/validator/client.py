@@ -19,6 +19,7 @@ from ..consensus import state_transition as st
 from ..consensus import types as T
 from ..consensus.spec import ChainSpec
 from .duties import DutiesService
+from .signing_method import RemoteSignerError
 from .slashing_protection import SlashingProtectionError
 from .validator_store import ValidatorStore
 
@@ -219,6 +220,8 @@ class ValidatorClient:
             except SlashingProtectionError:
                 self.slashing_vetoes += 1
                 continue
+            except RemoteSignerError:
+                continue  # one signer outage must not abort the slot
             bits = [
                 i == duty.committee_position
                 for i in range(duty.committee_length)

@@ -42,7 +42,8 @@ class InitializedValidators:
     ):
         self.validators_dir = Path(validators_dir)
         self.secrets_dir = Path(secrets_dir) if secrets_dir else None
-        self._web3signer_post = web3signer_post or _unconfigured_post
+        # None -> the SigningMethod's real HTTP transport
+        self._web3signer_post = web3signer_post
         self.definitions: list[dict] = []
         self._methods: dict[bytes, SigningMethod] = {}
         self._load_definitions()
@@ -178,7 +179,3 @@ class InitializedValidators:
         return False
 
 
-def _unconfigured_post(url, signing_root):
-    raise RuntimeError(
-        "web3signer definition present but no transport configured"
-    )

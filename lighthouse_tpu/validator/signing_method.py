@@ -52,11 +52,20 @@ class Web3SignerMethod(SigningMethod):
         return self._pk
 
 
-def web3signer_http_post(url: str, signing_root: bytes) -> bytes:
+class RemoteSignerError(Exception):
+    """Typed transport/protocol failure from a remote signer — duty
+    loops catch THIS, never raw urllib exceptions."""
+
+
+def web3signer_http_post(
+    url: str, signing_root: bytes, timeout: float = 3.0
+) -> bytes:
     """The web3signer REST wire: POST /api/v1/eth2/sign/{identifier}
     with {"signing_root": "0x.."}; the response body is the 0x-hex
-    signature (possibly JSON-wrapped)."""
+    signature (possibly JSON-wrapped). The default timeout stays well
+    inside the slot/3 attestation window."""
     import json
+    import urllib.error
     import urllib.request
 
     body = json.dumps({"signing_root": "0x" + bytes(signing_root).hex()})
@@ -66,12 +75,30 @@ def web3signer_http_post(url: str, signing_root: bytes) -> bytes:
         headers={"Content-Type": "application/json"},
         method="POST",
     )
-    with urllib.request.urlopen(req, timeout=12) as resp:
-        raw = resp.read().decode().strip()
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            raw = resp.read().decode().strip()
+    except urllib.error.HTTPError as e:
+        raise RemoteSignerError(
+            f"signer HTTP {e.code}: {e.read().decode(errors='replace')[:200]}"
+        ) from None
+    except (urllib.error.URLError, OSError) as e:
+        raise RemoteSignerError(f"signer unreachable: {e}") from None
     if raw.startswith("{"):
-        raw = json.loads(raw).get("signature", "")
+        obj = json.loads(raw)
+        if "signature" not in obj:
+            raise RemoteSignerError(
+                f"signer response lacks 'signature': {raw[:200]}"
+            )
+        raw = obj["signature"]
     if raw.startswith('"'):
         raw = raw.strip('"')
     if raw.startswith("0x"):
         raw = raw[2:]
-    return bytes.fromhex(raw)
+    try:
+        out = bytes.fromhex(raw)
+    except ValueError:
+        raise RemoteSignerError(f"non-hex signer response: {raw[:64]}") from None
+    if len(out) != 96:
+        raise RemoteSignerError(f"signer returned {len(out)} bytes, want 96")
+    return out
