@@ -27,7 +27,11 @@ import sys
 
 
 def _build_parser() -> argparse.ArgumentParser:
-    p = argparse.ArgumentParser(prog="lighthouse-tpu")
+    # @file support = the clap_utils --config-file role: one flag per
+    # line in the file, e.g. `python -m lighthouse_tpu.cli @node.cfg bn`
+    p = argparse.ArgumentParser(
+        prog="lighthouse-tpu", fromfile_prefix_chars="@"
+    )
     p.add_argument(
         "--preset",
         choices=["mainnet", "minimal"],

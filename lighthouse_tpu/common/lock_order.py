@@ -1,0 +1,73 @@
+"""Lock-order sanitizer (SURVEY.md §5.2 — the reference runs lockbud
+over its Rust locks in CI to catch deadlock cycles; a dynamic-language
+runtime gets a DYNAMIC checker instead).
+
+Wrap locks in `OrderedLock(name, rank)`: every acquisition asserts that
+the thread holds no lock of equal-or-higher rank, so any potential
+lock-order inversion (the classic AB/BA deadlock) raises immediately in
+tests rather than deadlocking rarely in production. Zero overhead when
+disabled (the default outside tests).
+"""
+
+from __future__ import annotations
+
+import threading
+
+_tls = threading.local()
+
+ENABLED = False  # tests/conftest flips this on
+
+
+class LockOrderViolation(RuntimeError):
+    pass
+
+
+class OrderedLock:
+    """An RLock with a deadlock-avoidance rank. Lower ranks must be
+    taken first; re-entrant acquisition of the same lock is fine."""
+
+    def __init__(self, name: str, rank: int):
+        self.name = name
+        self.rank = rank
+        self._lock = threading.RLock()
+
+    def _held(self) -> list:
+        held = getattr(_tls, "held", None)
+        if held is None:
+            held = _tls.held = []
+        return held
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        if ENABLED:
+            held = self._held()
+            for other in held:
+                if other is self:
+                    break  # re-entrant
+                if other.rank >= self.rank:
+                    raise LockOrderViolation(
+                        f"acquiring {self.name!r} (rank {self.rank}) while "
+                        f"holding {other.name!r} (rank {other.rank}) — "
+                        "lock-order inversion"
+                    )
+        ok = self._lock.acquire(blocking, timeout)
+        if ok and ENABLED:
+            self._held().append(self)
+        return ok
+
+    def release(self):
+        if ENABLED:
+            held = self._held()
+            if self in held:
+                # remove the LAST occurrence (re-entrancy)
+                for i in range(len(held) - 1, -1, -1):
+                    if held[i] is self:
+                        del held[i]
+                        break
+        self._lock.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()

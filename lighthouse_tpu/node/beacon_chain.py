@@ -196,6 +196,15 @@ class BeaconChain:
         # (head_root, slot, state) pre-advanced at the slot tail
         self._advanced_state = None
         self._last_finalized_emitted = -1
+        # hot-path timers (SURVEY §5.1: the reference's start_timer/
+        # stop_timer pairs around import + attestation batches)
+        self.t_block_import = metrics.histogram(
+            "beacon_chain_block_import_seconds", "process_block wall time"
+        )
+        self.t_att_batch = metrics.histogram(
+            "beacon_chain_attestation_batch_seconds",
+            "batch_verify_attestations wall time",
+        )
 
     def cache_advanced_state(self, head_root: bytes, slot: int, state) -> None:
         with self._lock:
@@ -547,6 +556,10 @@ class BeaconChain:
     def process_block(self, signed_block, verify_signatures: bool = True):
         """Full import pipeline (beacon_chain.rs:3289 process_block →
         :3717 import_block)."""
+        with self.t_block_import.time():
+            return self._process_block_timed(signed_block, verify_signatures)
+
+    def _process_block_timed(self, signed_block, verify_signatures=True):
         with self._lock:
             block = signed_block.message
             block_root = block.hash_tree_root()
@@ -1105,6 +1118,10 @@ class BeaconChain:
         (attestation_verification/batch.rs:133-214). Returns the subset
         that verified; falls back to per-item verification if the batch
         fails (poisoning defense)."""
+        with self.t_att_batch.time():
+            return self._batch_verify_attestations_timed(verified)
+
+    def _batch_verify_attestations_timed(self, verified):
         if not verified:
             return []
         sets = [v.signature_set for v in verified]

@@ -475,3 +475,33 @@ class HotColdDB:
         raw = self.kv.get(Column.METADATA, b"split_slot")
         if raw is not None:
             self.split_slot = struct.unpack("<Q", raw)[0]
+
+    # ------------------------------------------------- forwards iterators
+
+    def forwards_block_roots_iterator(self, start_slot: int, chain=None):
+        """Ascending (slot, block_root) from `start_slot` (the store's
+        forwards_iter_block_roots role): cold slots come from the
+        archived slot->root index; hot slots (>= split) from the
+        chain's canonical walk when a chain is supplied."""
+        slot = int(start_slot)
+        while slot < self.split_slot:
+            root = self.get_cold_block_root(slot)
+            if root is not None:
+                yield slot, root
+            slot += 1
+        if chain is None:
+            return
+        canonical = chain.canonical_roots_through(chain.head.root)
+        for s in sorted(canonical):
+            if s >= slot:
+                yield s, canonical[s][0]
+
+    def forwards_state_roots_iterator(self, start_slot: int, chain=None):
+        """Ascending (slot, state_root); cold states resolve via the
+        restore-point/diff machinery so only the roots stream here."""
+        if chain is None:
+            return
+        canonical = chain.canonical_roots_through(chain.head.root)
+        for s in sorted(canonical):
+            if s >= int(start_slot):
+                yield s, canonical[s][1]
