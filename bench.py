@@ -92,7 +92,7 @@ def _incremental_sets(n, messages):
 
 
 def main():
-    n_sets = int(os.environ.get("BENCH_SETS", "256"))
+    n_sets = int(os.environ.get("BENCH_SETS", "1024"))
     reps = int(os.environ.get("BENCH_REPS", "5"))
     n_atts = int(os.environ.get("BENCH_ATTS", "4096"))
     batch_cap = int(os.environ.get("BENCH_BATCH", "1024"))
@@ -139,9 +139,24 @@ def main():
             jax.block_until_ready(TB._verify_kernel(*args1))
             times1.append(time.perf_counter() - t0)
         rate1 = n_sets / min(times1)
+        # one-set batch isolates the fixed launch/transfer overhead of
+        # the tunneled chip; the marginal per-set cost is the honest
+        # kernel-throughput figure
+        args_one = TB.prepare_batch(sets1[:1], scalars1[:1])
+        jax.block_until_ready(TB._verify_kernel(*args_one))
+        t_one = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            jax.block_until_ready(TB._verify_kernel(*args_one))
+            t_one.append(time.perf_counter() - t0)
+        overhead = min(t_one)
+        marginal = max(min(times1) - overhead, 1e-9) / max(n_sets - 1, 1)
         detail["config1_raw_batch"] = {
             "batch": n_sets,
             "sets_per_s": round(rate1, 2),
+            "launch_overhead_s": round(overhead, 4),
+            "marginal_ms_per_set": round(marginal * 1e3, 4),
+            "marginal_sets_per_s": round(1.0 / marginal, 2),
             **_pcts(times1),
         }
     else:
@@ -246,6 +261,10 @@ def _config2(detail, n_atts, batch_cap):
         if _verify([payload]):
             verified[0] += 1
 
+    # warm the batch bucket: the first-ever bucket compile is ~15 min
+    # on the tunneled chip and must never count as throughput
+    _verify(sets2[:batch_cap])
+    batch_times.clear()
     for s in sets2:
         proc.submit(
             Work(
