@@ -92,7 +92,7 @@ def _incremental_sets(n, messages):
 
 
 def main():
-    n_sets = int(os.environ.get("BENCH_SETS", "1024"))
+    n_sets = int(os.environ.get("BENCH_SETS", "4096"))
     reps = int(os.environ.get("BENCH_REPS", "5"))
     n_atts = int(os.environ.get("BENCH_ATTS", "4096"))
     batch_cap = int(os.environ.get("BENCH_BATCH", "1024"))
@@ -372,7 +372,11 @@ def _config5(detail):
     from lighthouse_tpu.crypto.kzg.device import device_kzg
 
     kzg = device_kzg(TrustedSetup.dev(4096))
-    blob = bytes(range(256)) * (4096 * 32 // 256)
+    # canonical field elements (first byte zeroed keeps every 32-byte
+    # chunk < r; bytes(range(256)) chunks are NOT canonical scalars)
+    blob = b"".join(
+        b"\x00" + (i % 251).to_bytes(1, "big") * 31 for i in range(4096)
+    )
     commitment = kzg.blob_to_kzg_commitment(blob)
     proof, _ = kzg.compute_blob_kzg_proof(blob, commitment)
     blobs = [blob] * (6 * 32)

@@ -117,7 +117,7 @@ class TrustedSetup:
     g2_monomial: list = None   # [[tau^i]G2] (up to cell size + 1)
 
     @classmethod
-    def dev(cls, n: int = FIELD_ELEMENTS_PER_BLOB) -> "TrustedSetup":
+    def dev(cls, n: int = FIELD_ELEMENTS_PER_BLOB, with_monomial=None) -> "TrustedSetup":
         """Deterministic INSECURE setup: tau is derived from a public
         seed, so proofs can be forged — dev/test/bench only."""
         tau = (
@@ -145,15 +145,22 @@ class TrustedSetup:
                     % R
                 )
             g1s.append(C.g1_mul(G1_GEN, li))
-        # monomial powers for the PeerDAS cell ops (dev setup knows tau)
-        g1m, acc = [], 1
-        for _ in range(n):
-            g1m.append(C.g1_mul(G1_GEN, acc))
-            acc = acc * tau % R
-        g2m, acc = [], 1
-        for _ in range(min(n, 65) + 1):
-            g2m.append(C.g2_mul(G2_GEN, acc))
-            acc = acc * tau % R
+        # monomial powers for the PeerDAS cell ops (dev setup knows
+        # tau). Host G1 muls are ~0.5s each in pure Python, so large
+        # setups skip them unless asked — blob commit/verify paths
+        # only need the Lagrange basis.
+        if with_monomial is None:
+            with_monomial = n <= 512
+        g1m = g2m = None
+        if with_monomial:
+            g1m, acc = [], 1
+            for _ in range(n):
+                g1m.append(C.g1_mul(G1_GEN, acc))
+                acc = acc * tau % R
+            g2m, acc = [], 1
+            for _ in range(min(n, 65) + 1):
+                g2m.append(C.g2_mul(G2_GEN, acc))
+                acc = acc * tau % R
         return cls(
             g1_lagrange=g1s,
             g2_tau=C.g2_mul(G2_GEN, tau),

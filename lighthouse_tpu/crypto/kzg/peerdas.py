@@ -108,7 +108,9 @@ class CellContext:
         msm=None,
         pairing=None,
     ):
-        self.setup = setup or TrustedSetup.dev()
+        # cell ops REQUIRE the monomial bases — ask dev() for them
+        # explicitly (its default skips them above n=512)
+        self.setup = setup or TrustedSetup.dev(with_monomial=True)
         self.n = n or len(self.setup.g1_lagrange)
         if self.setup.g1_monomial is None:
             raise KzgError("cell ops need a monomial trusted setup")

@@ -1,0 +1,195 @@
+"""Conformance-vector generation + replay (testing/ef_tests analog,
+reference handler.rs:61-97).
+
+Generation is DETERMINISTIC, so the committed regression pin is the
+tiny root manifest (tests/vector_roots.json), not megabytes of state
+blobs: the suite regenerates the vectors and any transition change
+that alters a post-state flips its root against the manifest.
+
+The reference freezes spec-team vectors and replays them; this
+framework freezes ITS OWN golden vectors (generated once, committed)
+so every later refactor of the transition replays byte-identical
+cases — the regression-oracle role. Layout, one directory per case:
+
+    <suite>/<case>/pre.ssz        BeaconState before
+    <suite>/<case>/blocks_0.ssz.. SignedBeaconBlocks to apply in order
+    <suite>/<case>/post.ssz       expected BeaconState after
+    <suite>/<case>/meta.json      {"spec": ..., "description": ...}
+
+Cases cover: empty-slot advance, single block, multi-block with a
+skipped slot, an epoch boundary, and (electra spec) a block carrying an
+EL deposit request.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+from ..consensus import state_transition as st
+from ..consensus import types as T
+from ..consensus.spec import ChainSpec, mainnet_spec
+
+
+def _electra_mainnet() -> ChainSpec:
+    spec = mainnet_spec()
+    spec.fork_epochs = dict(spec.fork_epochs)
+    spec.fork_epochs["electra"] = 0
+    return spec
+
+
+def _produce(spec, state, slot, mutate_body=None):
+    """A valid (unsigned-crypto) block on `state` at `slot`; advances
+    the state."""
+    if state.slot < slot:
+        st.process_slots(spec, state, slot)
+    proposer = st.get_beacon_proposer_index(spec, state)
+    body = T.BeaconBlockBody.default()
+    body.randao_reveal = b"\xc0" + b"\x00" * 95
+    body.eth1_data = state.eth1_data
+    body.execution_payload = st.mock_execution_payload(spec, state)
+    if mutate_body is not None:
+        mutate_body(body)
+    # _process_slot filled the cached header's state_root, so its root
+    # IS the canonical parent root now
+    block = T.BeaconBlock.make(
+        slot=slot,
+        proposer_index=proposer,
+        parent_root=state.latest_block_header.hash_tree_root(),
+        state_root=b"\x00" * 32,
+        body=body,
+    )
+    st.process_block(spec, state, block, verify_signatures=False)
+    block.state_root = state.hash_tree_root()
+    return T.SignedBeaconBlock.make(
+        message=block, signature=b"\xc0" + b"\x00" * 95
+    )
+
+
+def generate(out_dir, spec: ChainSpec = None, validators: int = 16) -> list:
+    """Write the suite; returns case names. Deterministic — a second
+    run reproduces identical bytes (interop keys, fixed graffiti)."""
+    spec = spec or mainnet_spec()
+    out = Path(out_dir)
+    cases = []
+
+    def emit(name, pre, blocks, post, description):
+        d = out / name
+        d.mkdir(parents=True, exist_ok=True)
+        (d / "pre.ssz").write_bytes(pre.serialize())
+        for i, b in enumerate(blocks):
+            (d / f"blocks_{i}.ssz").write_bytes(
+                T.SignedBeaconBlock.serialize(b)
+            )
+        (d / "post.ssz").write_bytes(post.serialize())
+        (d / "meta.json").write_text(
+            json.dumps(
+                {
+                    "spec": spec.config_name,
+                    "electra_epoch": spec.fork_epochs.get("electra"),
+                    "description": description,
+                    "blocks": len(blocks),
+                    "post_root": "0x" + post.hash_tree_root().hex(),
+                }
+            )
+        )
+        cases.append(name)
+
+    genesis = st.interop_genesis_state(spec, st.interop_pubkeys(validators))
+
+    # 1: pure slot advance across an epoch boundary
+    pre = genesis.copy()
+    post = pre.copy()
+    st.process_slots(spec, post, spec.preset.slots_per_epoch + 1)
+    emit("slots_epoch_boundary", pre, [], post,
+         "process_slots across one epoch boundary")
+
+    # 2: one block at slot 1
+    pre = genesis.copy()
+    work = pre.copy()
+    b1 = _produce(spec, work, 1)
+    emit("single_block", pre, [b1], work, "one empty-body block")
+
+    # 3: two blocks with a skipped slot between
+    pre = genesis.copy()
+    work = pre.copy()
+    blocks = [_produce(spec, work, 1), _produce(spec, work, 3)]
+    emit("skipped_slot", pre, blocks, work,
+         "blocks at slots 1 and 3 (slot 2 skipped)")
+
+    # 4 (electra): a block carrying an EL deposit request
+    espec = _electra_mainnet()
+    egen = st.interop_genesis_state(espec, st.interop_pubkeys(validators))
+    pre = egen.copy()
+    work = pre.copy()
+
+    def add_request(body):
+        body.execution_requests = T.ExecutionRequests.make(
+            deposits=[
+                T.DepositRequest.make(
+                    pubkey=bytes(work.validators[2].pubkey),
+                    withdrawal_credentials=bytes(
+                        work.validators[2].withdrawal_credentials
+                    ),
+                    amount=10**9,
+                    signature=b"\x00" * 96,
+                    index=0,
+                )
+            ],
+            withdrawals=[],
+            consolidations=[],
+        )
+
+    eb = _produce(espec, work, 1, mutate_body=add_request)
+    d = Path(out_dir) / "electra_deposit_request"
+    d.mkdir(parents=True, exist_ok=True)
+    (d / "pre.ssz").write_bytes(pre.serialize())
+    (d / "blocks_0.ssz").write_bytes(T.SignedBeaconBlock.serialize(eb))
+    (d / "post.ssz").write_bytes(work.serialize())
+    (d / "meta.json").write_text(
+        json.dumps(
+            {
+                "spec": espec.config_name,
+                "electra_epoch": 0,
+                "description": "EL deposit request enters the pending queue",
+                "blocks": 1,
+                "post_root": "0x" + work.hash_tree_root().hex(),
+            }
+        )
+    )
+    cases.append("electra_deposit_request")
+    return cases
+
+
+def replay_case(case_dir) -> None:
+    """Handler: load pre, apply blocks (or slot-advance to post.slot),
+    byte-compare against post (ef_tests cases::run)."""
+    d = Path(case_dir)
+    meta = json.loads((d / "meta.json").read_text())
+    spec = mainnet_spec()
+    if meta.get("electra_epoch") == 0:
+        spec = _electra_mainnet()
+    state = T.BeaconState.deserialize((d / "pre.ssz").read_bytes())
+    post_raw = (d / "post.ssz").read_bytes()
+    post = T.BeaconState.deserialize(post_raw)
+    i = 0
+    while (d / f"blocks_{i}.ssz").exists():
+        signed = T.SignedBeaconBlock.deserialize(
+            (d / f"blocks_{i}.ssz").read_bytes()
+        )
+        block = signed.message
+        if state.slot < block.slot:
+            st.process_slots(spec, state, int(block.slot))
+        st.process_block(spec, state, block, verify_signatures=False)
+        i += 1
+    if i == 0 and state.slot < post.slot:
+        st.process_slots(spec, state, int(post.slot))
+    got_root = state.hash_tree_root()
+    want_root = bytes.fromhex(meta["post_root"][2:])
+    if got_root != want_root:
+        raise AssertionError(
+            f"{d.name}: post-state root mismatch "
+            f"(got 0x{got_root.hex()[:16]}, want 0x{want_root.hex()[:16]})"
+        )
+    if state.serialize() != post_raw:
+        raise AssertionError(f"{d.name}: post-state bytes differ")

@@ -1,0 +1,53 @@
+"""Conformance harness (testing/ef_tests handler.rs role): regenerate
+the deterministic vector suite, replay every case through the
+transition, and pin the post-state roots against the committed
+manifest — any transition change that alters consensus output flips a
+root here."""
+
+import json
+from pathlib import Path
+
+import pytest
+
+from lighthouse_tpu.tools import vectors
+
+MANIFEST = json.loads(
+    (Path(__file__).parent / "vector_roots.json").read_text()
+)
+
+
+@pytest.fixture(scope="module")
+def suite(tmp_path_factory):
+    out = tmp_path_factory.mktemp("vectors")
+    cases = vectors.generate(out)
+    return out, cases
+
+
+def test_suite_covers_manifest(suite):
+    _, cases = suite
+    assert set(cases) == set(MANIFEST)
+
+
+@pytest.mark.parametrize("case", sorted(MANIFEST))
+def test_case_replays_and_matches_pinned_root(suite, case):
+    out, _ = suite
+    vectors.replay_case(out / case)
+    meta = json.loads((out / case / "meta.json").read_text())
+    assert meta["post_root"] == MANIFEST[case], (
+        f"{case}: transition output changed vs the pinned golden root — "
+        "if intentional, regenerate tests/vector_roots.json"
+    )
+
+
+def test_tampered_vector_fails(suite, tmp_path):
+    """The harness itself must detect a wrong post state."""
+    out, _ = suite
+    import shutil
+
+    broken = tmp_path / "broken"
+    shutil.copytree(out / "single_block", broken)
+    raw = bytearray(broken.joinpath("post.ssz").read_bytes())
+    raw[100] ^= 1
+    broken.joinpath("post.ssz").write_bytes(bytes(raw))
+    with pytest.raises(AssertionError):
+        vectors.replay_case(broken)
