@@ -380,6 +380,9 @@ def _config5(detail):
     commitment = kzg.blob_to_kzg_commitment(blob)
     proof, _ = kzg.compute_blob_kzg_proof(blob, commitment)
     blobs = [blob] * (6 * 32)
+    # warm the device MSM + pairing kernels: their first-ever compile
+    # is minutes on the tunneled chip and must not count as throughput
+    kzg.verify_blob_kzg_proof_batch(blobs[:2], [commitment] * 2, [proof] * 2)
     t0 = time.perf_counter()
     ok5 = kzg.verify_blob_kzg_proof_batch(
         blobs, [commitment] * len(blobs), [proof] * len(blobs)
