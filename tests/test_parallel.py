@@ -26,6 +26,19 @@ pytestmark = pytest.mark.skipif(
 )
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _no_cache_writes():
+    """Cache READS stay on (the dryrun seeds the big mesh program);
+    WRITES are disabled for this module — serializing a freshly
+    compiled sharded CPU executable has segfaulted jaxlib's cache
+    writer when another process writes the cache concurrently, and a
+    crashed suite is worse than a cold compile next run."""
+    old = jax.config.jax_persistent_cache_min_compile_time_secs
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1e9)
+    yield
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", old)
+
+
 def _sets(n, tamper=None):
     sets = []
     for i in range(n):
