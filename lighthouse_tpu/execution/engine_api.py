@@ -143,3 +143,8 @@ class EngineApi:
 
     def get_payload(self, payload_id: str) -> dict:
         return self._call("engine_getPayloadV3", [payload_id])
+
+    def get_blobs(self, versioned_hashes: list) -> list:
+        """engine_getBlobsV1: blobs+proofs from the EL's pool by
+        versioned hash; per-entry null on miss (fetch_blobs.rs source)."""
+        return self._call("engine_getBlobsV1", [versioned_hashes])

@@ -33,6 +33,9 @@ class MockExecutionEngine:
         self.fcu_calls = 0
         self._payload_counter = 0
         self._pending_payloads: dict[str, dict] = {}
+        # versioned-hash (bytes) -> {"blob": hex, "proof": hex}
+        # (engine_getBlobsV1 pool; tests seed it)
+        self.blob_pool: dict[bytes, dict] = {}
 
     # ------------------------------------------------------------ transport
 
@@ -51,6 +54,7 @@ class MockExecutionEngine:
             "engine_newPayloadV3": self._new_payload,
             "engine_forkchoiceUpdatedV3": self._fcu,
             "engine_getPayloadV3": self._get_payload,
+            "engine_getBlobsV1": self._get_blobs,
         }.get(method)
         if handler is None:
             resp = {"error": {"code": -32601, "message": f"unknown {method}"}}
@@ -79,6 +83,7 @@ class MockExecutionEngine:
             "engine_newPayloadV3",
             "engine_forkchoiceUpdatedV3",
             "engine_getPayloadV3",
+            "engine_getBlobsV1",
         ]
 
     def _new_payload(self, params):
@@ -148,3 +153,10 @@ class MockExecutionEngine:
             "blockValue": "0x0",
             "blobsBundle": {"commitments": [], "proofs": [], "blobs": []},
         }
+
+    def _get_blobs(self, params):
+        out = []
+        for h in params[0]:
+            key = bytes.fromhex(h.removeprefix("0x"))
+            out.append(self.blob_pool.get(key))
+        return out

@@ -71,7 +71,18 @@ class NetworkBeaconProcessor:
                 self.imported_blocks += 1
             except AvailabilityPending:
                 # honest Deneb ordering (block before trailing blobs):
-                # park, NO penalty; retried when the sidecars land
+                # first try completing DA from the EL's pool
+                # (fetch_blobs.rs — usually beats gossip), else park,
+                # NO penalty; retried when the sidecars land
+                from ..node.fetch_blobs import fetch_blobs_and_import
+
+                if fetch_blobs_and_import(self.chain, signed):
+                    try:
+                        self.chain.process_block(signed)
+                        self.imported_blocks += 1
+                        return
+                    except AvailabilityPending:
+                        pass  # EL had only part of the set
                 if len(self._awaiting_blobs) < self._AWAITING_CAP:
                     self._awaiting_blobs[
                         signed.message.hash_tree_root()

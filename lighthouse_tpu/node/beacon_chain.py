@@ -205,6 +205,10 @@ class BeaconChain:
             "beacon_chain_attestation_batch_seconds",
             "batch_verify_attestations wall time",
         )
+        # graffiti_calculator role: the default 32-byte tag for locally
+        # produced blocks; produce_block(graffiti=...) overrides per
+        # block (the VC threads per-validator graffiti through it)
+        self.graffiti = b"lighthouse-tpu".ljust(32, b"\x00")
 
     def cache_advanced_state(self, head_root: bytes, slot: int, state) -> None:
         with self._lock:
@@ -1522,7 +1526,9 @@ class BeaconChain:
 
     # ------------------------------------------------------------ production
 
-    def produce_block(self, slot: int, randao_reveal: bytes = b"\x00" * 96):
+    def produce_block(
+        self, slot: int, randao_reveal: bytes = b"\x00" * 96, graffiti=None
+    ):
         """Block production on the canonical head with FULL bodies
         packed from the pools (operation_pool get_attestations max-cover
         + slashings/exits/bls changes + the naive pool's sync aggregate;
@@ -1540,6 +1546,9 @@ class BeaconChain:
             proposer = st.get_beacon_proposer_index(self.spec, state)
             body = T.BeaconBlockBody.default()
             body.randao_reveal = randao_reveal
+            body.graffiti = (
+                bytes(graffiti) if graffiti is not None else self.graffiti
+            )
             body.eth1_data = state.eth1_data
             if self.eth1 is not None:
                 vote = self.eth1.eth1_data_vote(state)

@@ -32,14 +32,25 @@ class BlobError(Exception):
 
 
 def blobs_to_sidecars(
-    spec, signed_block, blobs: Sequence[bytes], proofs: Sequence[bytes], kzg: Kzg
+    spec,
+    signed_block,
+    blobs: Sequence[bytes],
+    proofs: Sequence[bytes],
+    kzg: Kzg,
+    indices: Sequence[int] = None,
 ) -> list:
-    """Build the gossip-able BlobSidecar set for a signed block whose
-    body commits to `blobs` (block production / EL fetch path)."""
+    """Build gossip-able BlobSidecars for a signed block. The default
+    covers ALL commitments in order (block production); `indices`
+    selects a sparse subset with positionally matching blobs/proofs
+    (the EL fetch path recovers only the missing ones)."""
     block = signed_block.message
     commitments = list(block.body.blob_kzg_commitments)
-    if not (len(blobs) == len(proofs) == len(commitments)):
-        raise BlobError("blobs/proofs/commitments length mismatch")
+    if indices is None:
+        indices = list(range(len(commitments)))
+        if not (len(blobs) == len(proofs) == len(commitments)):
+            raise BlobError("blobs/proofs/commitments length mismatch")
+    elif not (len(blobs) == len(proofs) == len(indices)):
+        raise BlobError("blobs/proofs/indices length mismatch")
     header = T.BeaconBlockHeader.make(
         slot=block.slot,
         proposer_index=block.proposer_index,
@@ -52,16 +63,16 @@ def blobs_to_sidecars(
     )
     return [
         T.BlobSidecar.make(
-            index=i,
-            blob=bytes(blobs[i]),
-            kzg_commitment=bytes(commitments[i]),
-            kzg_proof=bytes(proofs[i]),
+            index=idx,
+            blob=bytes(blob),
+            kzg_commitment=bytes(commitments[idx]),
+            kzg_proof=bytes(proof),
             signed_block_header=signed_header,
             kzg_commitment_inclusion_proof=mp.compute_blob_inclusion_proof(
-                block.body, i
+                block.body, idx
             ),
         )
-        for i in range(len(blobs))
+        for idx, blob, proof in zip(indices, blobs, proofs)
     ]
 
 
@@ -134,6 +145,13 @@ class DataAvailabilityChecker:
         for sc in sidecars:
             entry.sidecars[sc.index] = sc
         self._evict()
+
+    def missing_indices(self, block_root: bytes, commitment_count: int) -> list:
+        """Which of a block's blob indices have NOT arrived yet — the
+        EL fetch path's shopping list."""
+        entry = self._pending.get(bytes(block_root))
+        have = set() if entry is None else {int(i) for i in entry.sidecars}
+        return [i for i in range(commitment_count) if i not in have]
 
     def expect(self, block_root: bytes, commitment_count: int) -> None:
         """Record how many blobs the imported block commits to."""

@@ -1,0 +1,70 @@
+"""EL blob fetch (beacon_chain/src/fetch_blobs.rs analog).
+
+The EL's mempool already holds most blob transactions, so a node can
+often complete data availability for a new block WITHOUT waiting for
+blob gossip: ask the EL for the blobs by versioned hash
+(engine_getBlobsV1), build the sidecars locally, and feed them to the
+DA checker. Misses and malformed responses are normal and NON-FATAL —
+gossip remains the fallback path, so this function never raises.
+"""
+
+from __future__ import annotations
+
+from ..common import logging as clog
+from ..execution.execution_layer import kzg_commitment_to_versioned_hash
+from .blob_verification import blobs_to_sidecars
+
+log = clog.get_logger("fetch_blobs")
+
+
+def fetch_blobs_and_import(chain, signed_block) -> int:
+    """Try to complete DA for `signed_block` from the EL. Returns the
+    number of sidecars fetched+imported (0 on miss / no EL / bad EL
+    response)."""
+    block = signed_block.message
+    commitments = [bytes(c) for c in block.body.blob_kzg_commitments]
+    if not commitments or chain.execution_layer is None:
+        return 0
+    if chain.da_checker is None:
+        return 0
+    engine = getattr(chain.execution_layer, "engine", None)
+    get_blobs = getattr(engine, "get_blobs", None)
+    if get_blobs is None:
+        return 0
+    block_root = block.hash_tree_root()
+    missing = chain.da_checker.missing_indices(block_root, len(commitments))
+    if not missing:
+        return 0
+    hashes = [
+        "0x" + kzg_commitment_to_versioned_hash(commitments[i]).hex()
+        for i in missing
+    ]
+    # EVERYTHING from here touches remote bytes: a hostile or confused
+    # EL must degrade to "0 fetched", never crash the import path
+    try:
+        results = get_blobs(hashes)
+        indices, blobs, proofs = [], [], []
+        for idx, item in zip(missing, results):
+            if item is None:
+                continue  # not in the EL's pool — gossip will cover it
+            indices.append(idx)
+            blobs.append(bytes.fromhex(item["blob"].removeprefix("0x")))
+            proofs.append(bytes.fromhex(item["proof"].removeprefix("0x")))
+        if not indices:
+            return 0
+        sidecars = blobs_to_sidecars(
+            chain.spec,
+            signed_block,
+            blobs,
+            proofs,
+            chain.kzg,
+            indices=indices,
+        )
+        chain.receive_blob_sidecars(sidecars)
+    except Exception as e:  # noqa: BLE001 — EL boundary
+        log.warning("EL blob fetch failed; gossip remains", error=str(e))
+        return 0
+    log.info(
+        "blobs fetched from the EL", block=block_root, count=len(indices)
+    )
+    return len(indices)

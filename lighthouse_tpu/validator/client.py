@@ -31,7 +31,7 @@ class BeaconNodeApi:
     def head_state(self):
         raise NotImplementedError
 
-    def produce_block(self, slot: int, randao_reveal: bytes):
+    def produce_block(self, slot: int, randao_reveal: bytes, graffiti=None):
         raise NotImplementedError
 
     def publish_block(self, signed_block) -> None:
@@ -80,8 +80,10 @@ class InProcessBeaconNode(BeaconNodeApi):
     def head_state(self):
         return self.chain.head_state()
 
-    def produce_block(self, slot, randao_reveal):
-        return self.chain.produce_block(slot, randao_reveal=randao_reveal)
+    def produce_block(self, slot, randao_reveal, graffiti=None):
+        return self.chain.produce_block(
+            slot, randao_reveal=randao_reveal, graffiti=graffiti
+        )
 
     def publish_block(self, signed_block):
         self.chain.process_block(signed_block)
@@ -157,10 +159,14 @@ class ValidatorClient:
         spec: ChainSpec,
         store: ValidatorStore,
         bn: BeaconNodeApi,
+        graffiti_provider=None,
     ):
         self.spec = spec
         self.store = store
         self.bn = bn
+        # pubkey -> Optional[32 bytes] (GraffitiFile.graffiti_for /
+        # keymanager overrides); None falls back to the BN default
+        self.graffiti_provider = graffiti_provider
         self.duties = DutiesService(
             spec, store, lambda: bn.head_state()
         )
@@ -193,7 +199,12 @@ class ValidatorClient:
             return
         fork = self.bn.head_state().fork
         reveal = self.store.sign_randao(duty.pubkey, epoch, fork)
-        block = self.bn.produce_block(slot, reveal)
+        graffiti = (
+            self.graffiti_provider(duty.pubkey)
+            if self.graffiti_provider is not None
+            else None
+        )
+        block = self.bn.produce_block(slot, reveal, graffiti=graffiti)
         try:
             signed = self.store.sign_block(duty.pubkey, block, fork)
         except SlashingProtectionError:

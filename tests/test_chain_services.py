@@ -258,3 +258,38 @@ def test_subnet_service_schedules_and_rotates():
     if expect not in ll:
         assert any(f"beacon_attestation_{expect}" in t for t in removed)
     assert all(s < ATTESTATION_SUBNET_COUNT for s in sub.wanted_subnets(6))
+
+
+def test_graffiti_flows_from_provider_to_block(tmp_path):
+    """graffiti_calculator role: per-validator graffiti threads from
+    the VC provider through produce_block; default tags otherwise."""
+    from lighthouse_tpu.validator.client import (
+        InProcessBeaconNode,
+        ValidatorClient,
+    )
+    from lighthouse_tpu.validator.graffiti_file import pad_graffiti
+    from lighthouse_tpu.validator.signing_method import LocalKeystoreSigner
+    from lighthouse_tpu.validator.validator_store import ValidatorStore
+    from lighthouse_tpu.crypto.bls.keys import SecretKey
+
+    node = _node(tmp_path)
+    chain = node.chain
+    # default graffiti on plain production
+    block = chain.produce_block(0 + 1)
+    assert bytes(block.body.graffiti).rstrip(b"\x00") == b"lighthouse-tpu"
+
+    store = ValidatorStore(SPEC, chain.genesis_validators_root)
+    for i in range(N):
+        store.add_validator(
+            LocalKeystoreSigner(SecretKey.from_seed(i.to_bytes(4, "big")))
+        )
+    vc = ValidatorClient(
+        SPEC,
+        store,
+        InProcessBeaconNode(chain),
+        graffiti_provider=lambda pk: pad_graffiti("custom tag"),
+    )
+    chain.on_slot(1)
+    vc.on_slot_start(1)
+    head_block = chain.store.get_block(chain.head.root)
+    assert bytes(head_block.message.body.graffiti).rstrip(b"\x00") == b"custom tag"

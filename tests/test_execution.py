@@ -262,3 +262,66 @@ def test_genesis_from_deposits():
         SPEC, cache, genesis_time=12345, block_hash=b"\x42" * 32
     )
     assert len(state2.validators) == 4  # still 4: invalid PoP skipped
+
+
+# ---------------------------------------------------------- fetch blobs
+
+
+def test_fetch_blobs_from_el_completes_da():
+    """fetch_blobs.rs role: a block whose sidecars never arrive via
+    gossip becomes available by asking the EL (engine_getBlobsV1)."""
+    from lighthouse_tpu.node import fetch_blobs as FB
+
+    class _FakeKzg:
+        def verify_blob_kzg_proof_batch(self, blobs, commitments, proofs):
+            return True
+
+    pubkeys = [
+        SecretKey.from_seed(i.to_bytes(4, "big")).public_key().to_bytes()
+        for i in range(N)
+    ]
+    genesis = st.interop_genesis_state(SPEC, pubkeys)
+    mock, el = _engine(None)
+    chain = BeaconChain(
+        SPEC, genesis, bls_backend="fake", execution_layer=el,
+        kzg=_FakeKzg(),
+    )
+    mock.known_hashes.add(
+        bytes(genesis.latest_execution_payload_header.block_hash)
+    )
+    from lighthouse_tpu.crypto.bls import curve as C
+
+    g1 = C.g1_compress(C.G1_GEN)
+    blob = bytes(SPEC.preset.field_elements_per_blob * 32)
+
+    chain.on_slot(1)
+    sig = b"\xc0" + b"\x00" * 95
+    block = chain.produce_block(1, randao_reveal=sig)
+    body = block.body
+    body.blob_kzg_commitments = [g1]
+    state = chain.head_state().copy()
+    st.process_slots(SPEC, state, 1)
+    block = T.BeaconBlock.make(
+        slot=1, proposer_index=block.proposer_index,
+        parent_root=bytes(block.parent_root),
+        state_root=b"\x00" * 32, body=body,
+    )
+    st.process_block(SPEC, state, block, verify_signatures=False)
+    block.state_root = state.hash_tree_root()
+    signed = T.SignedBeaconBlock.make(message=block, signature=sig)
+
+    # no gossip sidecars: import parks on availability
+    import pytest as _pytest
+    from lighthouse_tpu.node.beacon_chain import AvailabilityPending
+
+    with _pytest.raises(AvailabilityPending):
+        chain.process_block(signed)
+
+    # the EL pool has the blob under its versioned hash
+    vh = FB.kzg_commitment_to_versioned_hash(g1)
+    mock.blob_pool[vh] = {"blob": "0x" + blob.hex(), "proof": "0x" + g1.hex()}
+    fetched = FB.fetch_blobs_and_import(chain, signed)
+    assert fetched == 1
+    # DA satisfied: the import now succeeds
+    chain.process_block(signed)
+    assert chain.head.root == signed.message.hash_tree_root()
