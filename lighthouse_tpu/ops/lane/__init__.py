@@ -1,0 +1,29 @@
+"""Lane-major (batch-minor) TPU field/curve/pairing stack.
+
+Round-3 rewrite of the ops/ kernel core around two measured facts
+(tools/ubench_fp.py, tools/ubench_pallas.py, TPU v5 lite):
+
+1. The round-2 kernels were HBM-bandwidth-bound, not compute-bound: a
+   full Fp mul is ~5,400 elementwise passes over [N, 36] tensors, and
+   XLA's fusion still round-trips HBM enough that int32 and f32 MACs
+   measure identically (~147 G elem/s — the bandwidth roofline).
+2. A Pallas kernel that fuses conv + carries + folds in VMEM runs the
+   same mul at ~2.6 ns/element-mul — 15-20x the marginal XLA rate.
+
+So this package keeps the proven limb arithmetic (B=11 signed lazy
+limbs, constant-matrix fold reduction — see ops/fp.py's module doc) but:
+
+- lays elements out batch-minor: [stack..., W, S] with the batch S on
+  the 128-wide lane axis and limbs on sublanes (36 -> 40 pad, ~10%
+  waste, vs 36/128 = 72% lane waste before);
+- runs mul/sqr as fused Pallas kernels (jnp fallback compiled by XLA
+  for CPU meshes / tests: same math, same layout, chosen by backend);
+- keeps round 2's proven carry-normalization schedule (norm3) — once
+  fused, carries are VPU-register work, not HBM passes.
+
+Replaces the reference's blst field/curve layer (crypto/bls/src/impls/
+blst.rs:37-119) as the TPU backend's compute core; ops/ (batch-major)
+remains for the CPU-control comparisons.
+"""
+
+from . import fp, tower, jacobian, pairing, htc  # noqa: F401

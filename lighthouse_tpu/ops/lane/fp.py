@@ -1,0 +1,390 @@
+"""Lane-major batched Fp arithmetic — Pallas-fused core.
+
+Same number theory as ops/fp.py (B=11-bit signed lazy limbs, W=36,
+396-bit capacity, constant-matrix fold reduction; bounds contract in
+that module's doc). What changed for round 3:
+
+Layout
+------
+[stack..., W, S]: the batch S rides the 128-wide lane axis, limbs ride
+sublanes (36 -> 40 padded). Round 2 put limbs on lanes (36/128 = 72%
+dead lanes) and let every one of the ~5,400 elementwise passes per mul
+round-trip HBM.
+
+Fusion
+------
+`mul`/`sqr` dispatch to a Pallas kernel that performs the whole
+conv -> carry -> fold -> carry chain on VMEM-resident tiles: 3 HBM
+passes per mul instead of ~5,400. Measured 2.6 ns/element-mul vs
+~42 ns for the XLA version (tools/ubench_pallas.py, TPU v5 lite).
+On CPU backends (tests, the sharded dryrun mesh) the same jnp body
+compiles through XLA — identical numerics, no Mosaic dependency.
+
+Reference seam: crypto/bls/src/impls/blst.rs field layer (via blst's
+assembly); SURVEY.md §2.7 item 1.
+"""
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .. import fp as _base
+from ...crypto.bls.params import P
+
+B = _base.B
+W = _base.W
+MASK = _base.MASK
+CONVW = _base.CONVW
+FOLD_AT = _base.FOLD_AT
+
+to_limbs = _base.to_limbs
+from_limbs = _base.from_limbs
+
+ZERO = _base.ZERO
+ONE = _base.ONE
+
+# ---------------------------------------------------------------- constants
+# Packed for kernel transport (Pallas kernels take constants as operands):
+#   FOLDS [W, 41] = [full | 2 | 1] fold matrices, transposed to limb-major
+#   TOPFM [3, CONVW] = topfold vectors for carry widths 73, 37, 36
+FOLDS_NP = np.concatenate(
+    [
+        np.asarray(_base.FOLD_FULL).T,
+        np.asarray(_base.FOLD_2).T,
+        np.asarray(_base.FOLD_1).T,
+    ],
+    axis=1,
+).astype(np.int32)
+TOPFM_NP = np.zeros((3, CONVW), np.int32)
+TOPFM_NP[0, :CONVW] = _base._topfold(CONVW)
+TOPFM_NP[1, :37] = _base._topfold(37)
+TOPFM_NP[2, :W] = _base._topfold(W)
+_TROW = {CONVW: 0, 37: 1, W: 2}
+
+_FOLDS = jnp.asarray(FOLDS_NP)
+_TOPFM = jnp.asarray(TOPFM_NP)
+
+
+def use_pallas() -> bool:
+    """Pallas on real TPU; plain XLA elsewhere (CPU tests, sharded mesh)."""
+    import os
+
+    v = os.environ.get("LH_TPU_PALLAS")
+    if v is not None:
+        return v not in ("0", "false", "")
+    return jax.default_backend() == "tpu"
+
+
+# ---------------------------------------------------------------- host codecs
+
+
+def pack(ints) -> np.ndarray:
+    """Iterable of python ints -> [W, n] int32 canonical limbs (lane-major)."""
+    return np.stack([to_limbs(i) for i in ints], axis=-1).astype(np.int32)
+
+
+def unpack(arr) -> list:
+    """[..., W, S] -> flat list of python ints (host, boundary only)."""
+    a = np.asarray(arr)
+    flat = a.reshape(-1, *a.shape[-2:])
+    out = []
+    for blk in flat:
+        for s in range(blk.shape[-1]):
+            out.append(from_limbs(blk[:, s]))
+    return out
+
+
+# ---------------------------------------------------------------- core bodies
+# Every body is plain jnp over [..., W|CONVW, S] and runs both inside the
+# Pallas kernels and as the XLA fallback.
+
+
+def _norm1(x, topf):
+    """One carry pass along the limb axis; top carry folded back mod p."""
+    w = x.shape[-2]
+    lo = jnp.bitwise_and(x, MASK)
+    hi = jnp.right_shift(x, B)
+    pad = [(0, 0)] * (x.ndim - 2) + [(1, 0), (0, 0)]
+    out = lo + jnp.pad(hi[..., :-1, :], pad)
+    tf = topf[_TROW[w], :w]
+    return out + hi[..., -1:, :] * tf[:, None]
+
+
+def _norm3(x, topf):
+    return _norm1(_norm1(_norm1(x, topf), topf), topf)
+
+
+def _pad_limbs(x, width):
+    return jnp.pad(x, [(0, 0)] * (x.ndim - 2) + [(0, width - x.shape[-2]), (0, 0)])
+
+
+def _fold(x, mt):
+    """Fold limbs [FOLD_AT:] down via constant matrix mt [W, n_hi]."""
+    nhi = x.shape[-2] - FOLD_AT
+    acc = _pad_limbs(x[..., :FOLD_AT, :], W)
+    for k in range(nhi):
+        acc = acc + mt[:, k][:, None] * x[..., FOLD_AT + k : FOLD_AT + k + 1, :]
+    return acc
+
+
+def _conv(a, b):
+    """Schoolbook limb product along the sublane axis -> [..., CONVW, S]."""
+    pads = [[(0, 0)] * (a.ndim - 2) + [(i, CONVW - W - i), (0, 0)] for i in range(W)]
+    acc = jnp.pad(a[..., 0:1, :] * b, pads[0])
+    for i in range(1, W):
+        acc = acc + jnp.pad(a[..., i : i + 1, :] * b, pads[i])
+    return acc
+
+
+def _mul_body(a, b, folds, topf, norm_a=True, norm_b=True):
+    if norm_a:
+        a = _norm3(a, topf)
+    if norm_b:
+        b = _norm3(b, topf)
+    wide = _norm3(_conv(a, b), topf)
+    x = _norm3(_pad_limbs(_fold(wide, folds[:, :38]), 37), topf)
+    x = _norm3(_fold(x, folds[:, 38:40]), topf)
+    x = _norm3(_fold(x, folds[:, 40:41]), topf)
+    return x
+
+
+def _reduce_light_body(x, folds, topf):
+    x = _norm3(x, topf)
+    x = _norm3(_fold(x, folds[:, 40:41]), topf)
+    x = _norm3(_fold(x, folds[:, 40:41]), topf)
+    return x
+
+
+# ---------------------------------------------------------------- pallas glue
+
+
+def _lane_tile(n_elems_per_lane: int) -> int:
+    """Lane-tile size keeping the working set well under VMEM (~16 MB).
+
+    n_elems_per_lane = number of Fp elements per batch lane inside the
+    kernel (stack size x intermediates multiplier)."""
+    # ~6 live CONVW-wide int32 copies per mul in flight, 4 bytes each
+    budget = 6 * 1024 * 1024
+    per_lane = n_elems_per_lane * CONVW * 4 * 6
+    ts = budget // max(per_lane, 1)
+    if ts < 128:
+        return 128
+    return min(2048, 1 << (int(ts).bit_length() - 1))
+
+
+def kernel_op(fn, name: str):
+    """Wrap an elementwise-[..., W|*, S] jnp body as a lane-tiled Pallas op.
+
+    fn(consts_folds, consts_topf, *arrays) -> array or tuple of arrays.
+    All arrays share the trailing lane axis S; leading dims are static.
+    Fallback path calls fn directly (XLA), used off-TPU.
+    """
+
+    def dispatch(*arrays, **kw):
+        if not use_pallas():
+            return fn(_FOLDS, _TOPFM, *arrays, **kw)
+        S = arrays[0].shape[-1]
+        outs = jax.eval_shape(
+            lambda *a: fn(_FOLDS, _TOPFM, *a, **kw), *arrays
+        )
+        tuple_out = isinstance(outs, (tuple, list))
+        out_shapes = outs if tuple_out else (outs,)
+        stack = sum(int(np.prod(a.shape[:-1])) for a in arrays) // W + 1
+        ts = min(_lane_tile(stack), S)
+        spad = -S % ts
+        if spad:  # pad the lane axis up to a tile multiple (VMEM budget)
+            arrays = tuple(
+                jnp.pad(a, [(0, 0)] * (a.ndim - 1) + [(0, spad)])
+                for a in arrays
+            )
+            S = S + spad
+
+        def kern(f_ref, t_ref, *refs):
+            ins = refs[: len(arrays)]
+            outs_ = refs[len(arrays) :]
+            res = fn(f_ref[:], t_ref[:], *[r[:] for r in ins], **kw)
+            if not tuple_out:
+                res = (res,)
+            for o_ref, r in zip(outs_, res):
+                o_ref[:] = r
+
+        grid = (S // ts,)
+        in_specs = [
+            pl.BlockSpec(FOLDS_NP.shape, lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec(TOPFM_NP.shape, lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ]
+        for a in arrays:
+            blk = (*a.shape[:-1], ts)
+            nl = a.ndim
+            in_specs.append(
+                pl.BlockSpec(
+                    blk,
+                    functools.partial(_imap, nl),
+                    memory_space=pltpu.VMEM,
+                )
+            )
+        out_specs = [
+            pl.BlockSpec(
+                (*o.shape[:-1], ts),
+                functools.partial(_imap, o.ndim),
+                memory_space=pltpu.VMEM,
+            )
+            for o in out_shapes
+        ]
+        res = pl.pallas_call(
+            kern,
+            out_shape=tuple(
+                jax.ShapeDtypeStruct((*o.shape[:-1], S), o.dtype)
+                for o in out_shapes
+            ),
+            grid=grid,
+            in_specs=in_specs,
+            out_specs=tuple(out_specs),
+        )(_FOLDS, _TOPFM, *arrays)
+        if spad:
+            res = tuple(r[..., : S - spad] for r in res)
+        return res if tuple_out else res[0]
+
+    dispatch.__name__ = name
+    return dispatch
+
+
+def _imap(ndim, i):
+    return (0,) * (ndim - 1) + (i,)
+
+
+# ---------------------------------------------------------------- public ops
+
+
+def _mul_fn(folds, topf, a, b, norm_a=True, norm_b=True):
+    return _mul_body(a, b, folds, topf, norm_a=norm_a, norm_b=norm_b)
+
+
+def _sqr_fn(folds, topf, a, norm=True):
+    a2 = _norm3(a, topf) if norm else a
+    return _mul_body(a2, a2, folds, topf, norm_a=False, norm_b=False)
+
+
+def _reduce_light_fn(folds, topf, x):
+    return _reduce_light_body(x, folds, topf)
+
+
+def _norm3_fn(folds, topf, x):
+    return _norm3(x, topf)
+
+
+mul = kernel_op(_mul_fn, "mul")
+sqr = kernel_op(_sqr_fn, "sqr")
+reduce_light = kernel_op(_reduce_light_fn, "reduce_light")
+norm3 = kernel_op(_norm3_fn, "norm3")
+
+
+def norm3_x(x):
+    """XLA-side norm3 (no kernel launch) for cheap glue normalization."""
+    return _norm3(x, _TOPFM)
+
+
+def normalize(x, width: int = W):
+    return _norm3(_pad_limbs(x, width), _TOPFM)
+
+
+# ---------------------------------------------------------------- canonical
+
+KP_37 = jnp.asarray(np.asarray(_base.KP_37))
+PK_LADDER = jnp.asarray(np.asarray(_base.PK_LADDER))
+_LADDER_ROUNDS = _base._LADDER_ROUNDS
+
+
+def _ripple_carry(v):
+    """Exact carry ripple along the limb axis via lax.scan (boundary op)."""
+
+    def step(carry, limb):
+        s = limb + carry
+        return jnp.right_shift(s, B), jnp.bitwise_and(s, MASK)
+
+    limbs_first = jnp.moveaxis(v, -2, 0)
+    carry, limbs = jax.lax.scan(
+        step, jnp.zeros(limbs_first.shape[1:], jnp.int32), limbs_first
+    )
+    return jnp.moveaxis(limbs, 0, -2), carry
+
+
+def canonical(x):
+    """Unique representative in [0, p); canonical limbs [..., W, S]."""
+    x = reduce_light(x)
+    x = norm3_x(_fold(x, _FOLDS[:, 40:41]))
+    x = norm3_x(_fold(x, _FOLDS[:, 40:41]))
+    x = _ripple_carry(_pad_limbs(x, 37) + KP_37[:, None])[0]
+    for k in reversed(range(_LADDER_ROUNDS)):
+        d, borrow = _ripple_carry(x - PK_LADDER[k][:, None])
+        x = jnp.where((borrow >= 0)[..., None, :], d, x)
+    return x[..., :W, :]
+
+
+def eq_zero(x):
+    """True where lazy x === 0 (mod p); shape [..., S]."""
+    return jnp.all(canonical(x) == 0, axis=-2)
+
+
+def eq(x, y):
+    return eq_zero(x - y)
+
+
+# ---------------------------------------------------------------- pow / inv
+
+
+def pow_const(a, exponent: int):
+    """a^e for static int e — LSB-first square-and-multiply under scan."""
+    nbits = max(exponent.bit_length(), 1)
+    bits = jnp.asarray([(exponent >> i) & 1 for i in range(nbits)], jnp.bool_)
+    one = jnp.broadcast_to(jnp.asarray(ONE)[:, None], a.shape).astype(jnp.int32)
+
+    def step(carry, bit):
+        acc, base = carry
+        acc = jnp.where(bit, mul(acc, base), acc)
+        base = sqr(base)
+        return (acc, base), None
+
+    (acc, _), _ = jax.lax.scan(step, (one, norm3_x(a)), bits)
+    return acc
+
+
+def inv(a):
+    """a^(p-2) — Fermat inversion (0 maps to 0)."""
+    return pow_const(a, P - 2)
+
+
+def batch_inv(a):
+    """Montgomery batch inversion over the LANE axis is wrong here (each
+    lane is an independent element and we want elementwise inverses), so
+    this is inversion amortized over a STACK axis instead: prefix
+    products along axis 0, one Fermat inversion, then back-substitution.
+    a: [K, ..., W, S] with K >= 1; zeros map to zero (checked per slot).
+
+    Cost: 3(K-1) muls + one pow chain, vs K pow chains for K slots.
+    """
+    K = a.shape[0]
+    if K == 1:
+        return inv(a)
+    is_z = eq_zero(a)                                   # [K, ..., S]
+    onearr = jnp.broadcast_to(jnp.asarray(ONE)[:, None], a.shape[1:]).astype(
+        jnp.int32
+    )
+    safe = jnp.where(is_z[..., None, :], onearr[None], a)
+    prefix = [safe[0]]
+    for k in range(1, K):
+        prefix.append(mul(prefix[-1], safe[k]))
+    total_inv = inv(prefix[-1])
+    outs = [None] * K
+    acc = total_inv
+    for k in range(K - 1, 0, -1):
+        outs[k] = mul(acc, prefix[k - 1])
+        acc = mul(acc, safe[k])
+    outs[0] = acc
+    out = jnp.stack(outs, 0)
+    zero = jnp.zeros_like(out)
+    return jnp.where(is_z[..., None, :], zero, out)

@@ -1,0 +1,342 @@
+"""Lane-major Fp2/Fp6/Fp12 tower — each tower op is ONE fused kernel.
+
+Tower (identical to ops/tower.py and the host oracle fields.py):
+    Fp2  = Fp[u]  / (u^2 + 1)
+    Fp6  = Fp2[v] / (v^3 - xi),  xi = 1 + u
+    Fp12 = Fp6[w] / (w^2 - v)
+
+Layouts (trailing dims; arbitrary leading stack dims broadcast):
+    Fp2  : [..., 2, W, S]
+    Fp6  : [..., 3, 2, W, S]
+    Fp12 : [..., 2, 3, 2, W, S]
+
+Round-2 stacked every Karatsuba level into one batched limb conv but let
+XLA schedule the combines through HBM; here the entire tree of an op
+(f12mul: 27 limb convs + all recombination adds + re-standardization)
+executes inside a single Pallas kernel on VMEM tiles. The sparse
+line-multiplication (mul_by_034, 13 f2 products vs 18 for a general
+f12mul) that blst uses in the Miller loop gets its own kernel —
+round 2 paid a full f12mul per line.
+
+Laziness contract is ops/tower.py's: kernel entry re-normalizes, f2/f6
+outputs standard; f12mul outputs <=3-unit and f12sqr <=4-unit lazy sums.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+
+from ...crypto.bls.params import P, XI
+from ...crypto.bls import fields as FF
+from .. import fp as _basefp
+from . import fp
+
+W = fp.W
+
+
+# ---------------------------------------------------------------- host codecs
+
+
+def f2_pack(t) -> np.ndarray:
+    """(a0, a1) ints -> [2, W, 1] limbs (lane dim of 1, broadcastable)."""
+    return np.stack([fp.to_limbs(t[0]), fp.to_limbs(t[1])])[..., None].astype(
+        np.int32
+    )
+
+
+def f2_pack_many(ts) -> np.ndarray:
+    """list of (a0, a1) -> [2, W, n]."""
+    return np.stack(
+        [fp.pack([t[0] for t in ts]), fp.pack([t[1] for t in ts])]
+    ).astype(np.int32)
+
+
+def f6_pack(t) -> np.ndarray:
+    return np.stack([f2_pack(c) for c in t])
+
+
+def f12_pack(t) -> np.ndarray:
+    return np.stack([f6_pack(c) for c in t])
+
+
+def f2_unpack(a):
+    a = np.asarray(a)
+    assert a.shape[-1] == 1 or a.ndim >= 3
+    return (
+        fp.from_limbs(a[..., 0, :, 0]),
+        fp.from_limbs(a[..., 1, :, 0]),
+    )
+
+
+def f12_unpack_one(a):
+    """[2, 3, 2, W, 1] -> nested tuple of ints."""
+    a = np.asarray(a)
+    return tuple(
+        tuple(
+            (fp.from_limbs(a[j, i, 0, :, 0]), fp.from_limbs(a[j, i, 1, :, 0]))
+            for i in range(3)
+        )
+        for j in range(2)
+    )
+
+
+F2_ONE = jnp.asarray(f2_pack(FF.F2_ONE))
+F12_ONE = jnp.asarray(f12_pack(FF.F12_ONE))
+
+
+def bcast(const, lanes: int):
+    """Broadcast a packed [..., W, 1] constant along the lane axis."""
+    return jnp.broadcast_to(const, (*const.shape[:-1], lanes)).astype(jnp.int32)
+
+
+# ------------------------------------------------------------ fused bodies
+# All bodies take (folds, topf) first and operate on [..., comp, W, S].
+
+
+def _c(a, k):
+    """Component k along axis -3 (the Fp2 axis for [..., 2, W, S])."""
+    return a[..., k, :, :]
+
+
+def _f2mul_body(folds, topf, a, b):
+    """Karatsuba 3-mul; standard output. a, b [..., 2, W, S] lazy <=3u."""
+    a0, a1 = _c(a, 0), _c(a, 1)
+    b0, b1 = _c(b, 0), _c(b, 1)
+    aa = jnp.stack([a0, a1, a0 + a1], -3)
+    bb = jnp.stack([b0, b1, b0 + b1], -3)
+    t = fp._mul_body(aa, bb, folds, topf)
+    c0 = _c(t, 0) - _c(t, 1)
+    c1 = _c(t, 2) - _c(t, 0) - _c(t, 1)
+    return fp._reduce_light_body(jnp.stack([c0, c1], -3), folds, topf)
+
+
+def _f2sqr_body(folds, topf, a):
+    a0, a1 = _c(a, 0), _c(a, 1)
+    aa = jnp.stack([a0 + a1, a0], -3)
+    bb = jnp.stack([a0 - a1, a1 + a1], -3)
+    return fp._mul_body(aa, bb, folds, topf)
+
+
+def f2mul_xi(a):
+    """(1+u)(a0 + a1 u) = (a0 - a1, a0 + a1). Lazy 2x; pure adds (XLA ok)."""
+    a0, a1 = _c(a, 0), _c(a, 1)
+    return jnp.stack([a0 - a1, a0 + a1], -3)
+
+
+def _f6mul_body(folds, topf, a, b):
+    """6 stacked f2muls + recombination; standard output."""
+    a0, a1, a2 = _c2(a, 0), _c2(a, 1), _c2(a, 2)
+    b0, b1, b2 = _c2(b, 0), _c2(b, 1), _c2(b, 2)
+    aa = jnp.stack([a0, a1, a2, a0 + a1, a0 + a2, a1 + a2], -4)
+    bb = jnp.stack([b0, b1, b2, b0 + b1, b0 + b2, b1 + b2], -4)
+    t = _f2mul_body(folds, topf, aa, bb)
+    t0, t1, t2 = _c2(t, 0), _c2(t, 1), _c2(t, 2)
+    u01, u02, u12 = _c2(t, 3), _c2(t, 4), _c2(t, 5)
+    c0 = t0 + f2mul_xi(u12 - t1 - t2)
+    c1 = u01 - t0 - t1 + f2mul_xi(t2)
+    c2 = u02 - t0 - t2 + t1
+    return fp._reduce_light_body(jnp.stack([c0, c1, c2], -4), folds, topf)
+
+
+def _c2(a, k):
+    return a[..., k, :, :, :]
+
+
+def _c3(a, k):
+    return a[..., k, :, :, :, :]
+
+
+def f6mul_by_v(a):
+    return jnp.stack([f2mul_xi(_c2(a, 2)), _c2(a, 0), _c2(a, 1)], -4)
+
+
+def _f12mul_body(folds, topf, a, b):
+    """3 stacked f6muls; <=3-unit lazy output."""
+    a0, a1 = _c3(a, 0), _c3(a, 1)
+    b0, b1 = _c3(b, 0), _c3(b, 1)
+    aa = jnp.stack([a0, a1, a0 + a1], -5)
+    bb = jnp.stack([b0, b1, b0 + b1], -5)
+    t = _f6mul_body(folds, topf, aa, bb)
+    t0, t1, t2 = t[..., 0, :, :, :, :], t[..., 1, :, :, :, :], t[..., 2, :, :, :, :]
+    c0 = t0 + f6mul_by_v(t1)
+    c1 = t2 - t0 - t1
+    return jnp.stack([c0, c1], -5)
+
+
+def _f12sqr_body(folds, topf, a):
+    a0, a1 = _c3(a, 0), _c3(a, 1)
+    aa = jnp.stack([a0 + a1, a0], -5)
+    bb = jnp.stack([a0 + f6mul_by_v(a1), a1], -5)
+    t = _f6mul_body(folds, topf, aa, bb)
+    m, n = t[..., 0, :, :, :, :], t[..., 1, :, :, :, :]
+    c0 = m - n - f6mul_by_v(n)
+    c1 = n + n
+    return jnp.stack([c0, c1], -5)
+
+
+def _f12mul_034_body(folds, topf, f, c0, c1, c4):
+    """f * (c0 + c1 v + c4 v w) — blst-style sparse line product.
+
+    13 f2 products (5 + 3 + 5) vs a general f12mul's 18. f lazy <=4u;
+    c0/c1/c4 [..., 2, W, S] standard. Output <=3-unit lazy.
+    """
+    g0, g1 = _c3(f, 0), _c3(f, 1)
+    # t0 = g0 * (c0, c1, 0): 5 products (m00, m11, karatsuba01, m20, m21)
+    x0, x1, x2 = _c2(g0, 0), _c2(g0, 1), _c2(g0, 2)
+    y0, y1, y2 = _c2(g1, 0), _c2(g1, 1), _c2(g1, 2)
+    d = c1 + c4                       # (L0+L1) middle coefficient
+    aa = jnp.stack(
+        [x0, x1, x0 + x1, x2, x2,          # t0 products
+         y0, y1, y2,                        # t1 = g1 * (0, c4, 0)
+         x0 + y0, x1 + y1, (x0 + y0) + (x1 + y1), x2 + y2, x2 + y2],
+        -4,
+    )
+    bb = jnp.stack(
+        [c0, c1, c0 + c1, c0, c1,
+         c4, c4, c4,
+         c0, d, c0 + d, c0, d],
+        -4,
+    )
+    t = _f2mul_body(folds, topf, aa, bb)
+    m00, m11, m01k, m20, m21 = (_c2(t, i) for i in range(5))
+    n0, n1, n2 = (_c2(t, i) for i in range(5, 8))
+    s00, s11, s01k, s20, s21 = (_c2(t, i) for i in range(8, 13))
+    t0 = jnp.stack(
+        [m00 + f2mul_xi(m21), m01k - m00 - m11, m11 + m20], -4
+    )
+    t1 = jnp.stack([f2mul_xi(n2), n0, n1], -4)            # g1 * (0, c4, 0)
+    ts = jnp.stack(
+        [s00 + f2mul_xi(s21), s01k - s00 - s11, s11 + s20], -4
+    )
+    r0 = t0 + f6mul_by_v(t1)
+    r1 = ts - t0 - t1
+    return jnp.stack([r0, r1], -5)
+
+
+# ------------------------------------------------------------ public kernels
+
+f2mul = fp.kernel_op(_f2mul_body, "f2mul")
+f2sqr = fp.kernel_op(_f2sqr_body, "f2sqr")
+f6mul = fp.kernel_op(_f6mul_body, "f6mul")
+f12mul = fp.kernel_op(_f12mul_body, "f12mul")
+f12sqr = fp.kernel_op(_f12sqr_body, "f12sqr")
+f12mul_034 = fp.kernel_op(_f12mul_034_body, "f12mul_034")
+
+
+_CONJ_SIGN = jnp.asarray(np.array([1, -1], dtype=np.int32)[:, None, None])
+
+
+def f2conj(a):
+    return a * _CONJ_SIGN
+
+
+def f2smul_fp(a, s):
+    """Fp2 x Fp scalar: s [..., W, S] broadcasts over the component axis."""
+    return fp.mul(a, s[..., None, :, :] if s.ndim == a.ndim - 1 else s)
+
+
+def f2inv(a):
+    """1/(a0 + a1 u) = (a0 - a1 u)/(a0^2 + a1^2). One Fermat inversion."""
+    a = fp.norm3_x(a)
+    a0, a1 = _c(a, 0), _c(a, 1)
+    sq = fp.mul(jnp.stack([a0, a1], -3), jnp.stack([a0, a1], -3))
+    norm = _c(sq, 0) + _c(sq, 1)
+    ninv = fp.inv(norm)
+    return fp.mul(jnp.stack([a0, -a1], -3), ninv[..., None, :, :])
+
+
+def f2_eq(a, b):
+    return jnp.all(fp.eq(a, b), axis=-2)
+
+
+def f2_eq_zero(a):
+    return jnp.all(fp.eq_zero(a), axis=-2)
+
+
+def f6sqr(a):
+    return f6mul(a, a)
+
+
+def f6neg(a):
+    return -a
+
+
+def f6inv(a):
+    a = fp.norm3_x(a)
+    a0, a1, a2 = _c2(a, 0), _c2(a, 1), _c2(a, 2)
+    sq = f2sqr(jnp.stack([a0, a2, a1], -4))
+    s0, s2, s1 = _c2(sq, 0), _c2(sq, 1), _c2(sq, 2)
+    pr = f2mul(jnp.stack([a1, a0, a0], -4), jnp.stack([a2, a1, a2], -4))
+    a1a2, a0a1, a0a2 = _c2(pr, 0), _c2(pr, 1), _c2(pr, 2)
+    c0 = s0 - f2mul_xi(a1a2)
+    c1 = f2mul_xi(s2) - a0a1
+    c2 = s1 - a0a2
+    tt = f2mul(jnp.stack([a0, a2, a1], -4), jnp.stack([c0, c1, c2], -4))
+    t = _c2(tt, 0) + f2mul_xi(_c2(tt, 1) + _c2(tt, 2))
+    ti = f2inv(t)
+    return f2mul(jnp.stack([c0, c1, c2], -4), ti[..., None, :, :, :])
+
+
+def f12conj(a):
+    return jnp.concatenate([a[..., :1, :, :, :, :], -a[..., 1:, :, :, :, :]], -5)
+
+
+def f12inv(a):
+    t = f6inv(
+        fp.reduce_light(f6sqr(_c3(a, 0)) - f6mul_by_v(f6sqr(_c3(a, 1))))
+    )
+    c0 = f6mul(_c3(a, 0), t)
+    c1 = f6neg(f6mul(_c3(a, 1), t))
+    return jnp.stack([c0, c1], -5)
+
+
+def f12_eq(a, b):
+    return jnp.all(fp.eq(a, b), axis=(-4, -3, -2))
+
+
+def f12_eq_one(a):
+    return f12_eq(a, bcast(F12_ONE, a.shape[-1]))
+
+
+# ---------------------------------------------------------------- Frobenius
+
+_G1 = [FF.f2pow(XI, k * ((P - 1) // 6)) for k in range(6)]
+_G2 = [FF.f2mul(g, FF.f2conj(g)) for g in _G1]
+_G3 = [FF.f2mul(_G1[k], _G2[k]) for k in range(6)]
+
+assert all(g[1] == 0 for g in _G2), "gamma2 must be real"
+
+
+def _coeff_const(gammas) -> jnp.ndarray:
+    arr = np.zeros((2, 3, 2, W, 1), dtype=np.int32)
+    for j in range(2):
+        for i in range(3):
+            arr[j, i] = f2_pack(gammas[2 * i + j])
+    return jnp.asarray(arr)
+
+
+_G1C = _coeff_const(_G1)
+_G3C = _coeff_const(_G3)
+_G2C = jnp.asarray(
+    np.stack(
+        [
+            np.stack([fp.to_limbs(_G2[2 * i + j][0]) for i in range(3)])
+            for j in range(2)
+        ]
+    )[:, :, None, :, None]
+)  # [2, 3, 1, W, 1] — broadcasts over the Fp2 component axis
+
+
+def _coeff_conj(a):
+    return a * _CONJ_SIGN
+
+
+def frob1(a):
+    return f2mul(_coeff_conj(a), bcast(_G1C, a.shape[-1]))
+
+
+def frob2(a):
+    return fp.mul(a, bcast(_G2C, a.shape[-1]))
+
+
+def frob3(a):
+    return f2mul(_coeff_conj(a), bcast(_G3C, a.shape[-1]))

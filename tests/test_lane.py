@@ -1,0 +1,198 @@
+"""Lane-major ops core (ops/lane/*) vs the host field oracle.
+
+Runs the jnp fallback path on the CPU mesh (conftest forces cpu);
+the Pallas path compiles the same bodies — kernel-vs-fallback equality
+on real TPU is asserted by bench.py's self-check, not here.
+"""
+import random
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from lighthouse_tpu.crypto.bls import fields as FF
+from lighthouse_tpu.crypto.bls.params import P
+from lighthouse_tpu.ops.lane import fp as L, tower as T
+
+random.seed(1234)
+
+
+def rint():
+    return random.randrange(P)
+
+
+def rf2():
+    return (rint(), rint())
+
+
+def rf12():
+    return tuple(tuple(rf2() for _ in range(3)) for _ in range(2))
+
+
+def fpk(xs):
+    return jnp.asarray(L.pack(xs))
+
+
+def f2k(xs):
+    return jnp.asarray(
+        np.stack([np.asarray(T.f2_pack(x))[..., 0] for x in xs], -1)
+    )
+
+
+def f12k(xs):
+    return jnp.asarray(np.concatenate([np.asarray(T.f12_pack(x)) for x in xs], -1))
+
+
+def f12_get(arr, i):
+    a = np.asarray(L.canonical(jnp.asarray(arr)))
+    return tuple(
+        tuple(
+            (L.from_limbs(a[j, k, 0, :, i]), L.from_limbs(a[j, k, 1, :, i]))
+            for k in range(3)
+        )
+        for j in range(2)
+    )
+
+
+N = 5
+A_INTS = [rint() for _ in range(N)]
+B_INTS = [rint() for _ in range(N)]
+
+
+class TestLaneFp:
+    def test_mul_sqr(self):
+        a, b = fpk(A_INTS), fpk(B_INTS)
+        out = np.asarray(L.mul(a, b))
+        assert [L.from_limbs(out[:, i]) for i in range(N)] == [
+            x * y % P for x, y in zip(A_INTS, B_INTS)
+        ]
+        out = np.asarray(L.sqr(a))
+        assert [L.from_limbs(out[:, i]) for i in range(N)] == [
+            x * x % P for x in A_INTS
+        ]
+
+    def test_stacked_mul(self):
+        a, b = fpk(A_INTS), fpk(B_INTS)
+        o = np.asarray(L.mul(jnp.stack([a, b]), jnp.stack([b, a])))
+        want = [x * y % P for x, y in zip(A_INTS, B_INTS)]
+        assert [L.from_limbs(o[0][:, i]) for i in range(N)] == want
+        assert [L.from_limbs(o[1][:, i]) for i in range(N)] == want
+
+    def test_lazy_inputs(self):
+        """mul accepts multi-unit lazy sums (the tower contract)."""
+        a, b = fpk(A_INTS), fpk(B_INTS)
+        lazy = a + a + a - b
+        out = np.asarray(L.mul(lazy, b))
+        assert [L.from_limbs(out[:, i]) for i in range(N)] == [
+            ((3 * x - y) * y) % P for x, y in zip(A_INTS, B_INTS)
+        ]
+
+    def test_canonical_eq(self):
+        a, b = fpk(A_INTS), fpk(B_INTS)
+        c = np.asarray(L.canonical(a - b + b))
+        assert [L.from_limbs(c[:, i]) for i in range(N)] == A_INTS
+        assert np.asarray(L.eq(a + b - b, a)).all()
+        assert not np.asarray(L.eq_zero(a)).any()
+
+    def test_inv(self):
+        a = fpk(A_INTS)
+        iv = np.asarray(L.inv(a))
+        assert [L.from_limbs(iv[:, i]) for i in range(N)] == [
+            pow(x, P - 2, P) for x in A_INTS
+        ]
+
+    def test_batch_inv(self):
+        a, b = fpk(A_INTS), fpk(B_INTS)
+        zero = jnp.zeros_like(a)
+        st = jnp.stack([a, zero, b])
+        bi = np.asarray(L.batch_inv(st))
+        assert [L.from_limbs(bi[0][:, i]) for i in range(N)] == [
+            pow(x, P - 2, P) for x in A_INTS
+        ]
+        assert (bi[1] == 0).all()
+        assert [L.from_limbs(bi[2][:, i]) for i in range(N)] == [
+            pow(x, P - 2, P) for x in B_INTS
+        ]
+
+
+A2 = [rf2() for _ in range(N)]
+B2 = [rf2() for _ in range(N)]
+A12 = [rf12() for _ in range(N)]
+B12 = [rf12() for _ in range(N)]
+
+
+class TestLaneTower:
+    def test_f2(self):
+        a, b = f2k(A2), f2k(B2)
+        out = np.asarray(T.f2mul(a, b))
+        for i in range(N):
+            got = (L.from_limbs(out[0, :, i]), L.from_limbs(out[1, :, i]))
+            assert got == FF.f2mul(A2[i], B2[i])
+        out = np.asarray(T.f2sqr(a))
+        for i in range(N):
+            got = (L.from_limbs(out[0, :, i]), L.from_limbs(out[1, :, i]))
+            assert got == FF.f2mul(A2[i], A2[i])
+
+    def test_f2inv(self):
+        a = f2k(A2)
+        out = np.asarray(L.canonical(T.f2inv(a)))
+        for i in range(N):
+            got = (L.from_limbs(out[0, :, i]), L.from_limbs(out[1, :, i]))
+            assert got == FF.f2inv(A2[i])
+
+    def test_f12mul_sqr(self):
+        a, b = f12k(A12), f12k(B12)
+        out = np.asarray(T.f12mul(a, b))
+        for i in range(N):
+            assert f12_get(out, i) == FF.f12mul(A12[i], B12[i])
+        out = np.asarray(T.f12sqr(a))
+        for i in range(N):
+            assert f12_get(out, i) == FF.f12mul(A12[i], A12[i])
+
+    def test_f12_sparse_034(self):
+        a = f12k(A12)
+        c0s = [rf2() for _ in range(N)]
+        c1s = [rf2() for _ in range(N)]
+        c4s = [rf2() for _ in range(N)]
+        out = np.asarray(T.f12mul_034(a, f2k(c0s), f2k(c1s), f2k(c4s)))
+        z2 = (0, 0)
+        for i in range(N):
+            line = ((c0s[i], c1s[i], z2), (z2, c4s[i], z2))
+            assert f12_get(out, i) == FF.f12mul(A12[i], line)
+
+    def test_f12_sparse_034_lazy_input(self):
+        """The Miller loop feeds f12sqr output (<=4-unit lazy) into 034."""
+        a = f12k(A12)
+        sq = T.f12sqr(a)
+        c0s = [rf2() for _ in range(N)]
+        c1s = [rf2() for _ in range(N)]
+        c4s = [rf2() for _ in range(N)]
+        out = np.asarray(T.f12mul_034(sq, f2k(c0s), f2k(c1s), f2k(c4s)))
+        z2 = (0, 0)
+        for i in range(N):
+            line = ((c0s[i], c1s[i], z2), (z2, c4s[i], z2))
+            want = FF.f12mul(FF.f12mul(A12[i], A12[i]), line)
+            assert f12_get(out, i) == want
+
+    def test_f12inv_conj(self):
+        a = f12k(A12)
+        out = np.asarray(T.f12inv(a))
+        for i in range(N):
+            assert f12_get(out, i) == FF.f12inv(A12[i])
+        out = np.asarray(T.f12conj(a))
+        for i in range(N):
+            got = f12_get(out, i)
+            want = FF.f12conj(A12[i])
+            assert got == want
+
+    def test_frobenius(self):
+        a = f12k(A12)
+        for frob, e in ((T.frob1, P), (T.frob2, P * P), (T.frob3, P**3)):
+            out = np.asarray(frob(a))
+            for i in range(N):
+                assert f12_get(out, i) == FF.f12pow(A12[i], e)
+
+    def test_f12_eq_one(self):
+        one = jnp.asarray(np.asarray(T.f12_pack(FF.F12_ONE)))
+        assert np.asarray(T.f12_eq_one(one)).all()
+        assert not np.asarray(T.f12_eq_one(f12k(A12))).any()

@@ -1,0 +1,221 @@
+"""Microbenchmark: where do the Fp-mul cycles go on the TPU?
+
+Compares candidate formulations of the batched Fp multiply (the inner op
+of everything in ops/) at realistic shapes, on the real chip:
+
+  A. current   — ops/fp.mul, layout [N, 36] int32 (lanes = limbs, 28% util)
+  B. transposed— same math, layout [36, N] int32 (lanes = batch, full util)
+  C. trans+f32 — transposed, conv in f32 (B=11 still exact? no — measure raw
+                 multiply cost only; correctness variant uses B=9)
+  D. raw VPU   — elementwise int32 vs f32 multiply throughput at equal bytes
+  E. fold-as-matmul — the reduction einsum in both layouts
+
+Run:  python tools/ubench_fp.py [N]
+"""
+import sys
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, "/root/repo")
+from lighthouse_tpu.ops import fp
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 4096 * 27
+R = 40  # muls chained per timed kernel, to swamp launch overhead
+
+W = fp.W
+CONVW = fp.CONVW
+FOLD_AT = fp.FOLD_AT
+
+
+def timeit(f, *args, reps=3):
+    jax.block_until_ready(f(*args))
+    best = 1e9
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(f(*args))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+rng = np.random.default_rng(0)
+a_cur = jnp.asarray(rng.integers(0, 2047, size=(N, W), dtype=np.int32))
+b_cur = jnp.asarray(rng.integers(0, 2047, size=(N, W), dtype=np.int32))
+a_t = jnp.asarray(np.ascontiguousarray(np.asarray(a_cur).T))
+b_t = jnp.asarray(np.ascontiguousarray(np.asarray(b_cur).T))
+
+
+# ---- A: current mul chained ------------------------------------------------
+@jax.jit
+def chain_current(a, b):
+    x = a
+    for _ in range(R):
+        x = fp.mul(x, b)
+    return x
+
+
+# ---- B: transposed layout --------------------------------------------------
+FOLD_FULL_T = jnp.asarray(np.asarray(fp.FOLD_FULL).T)  # [36, 38]
+FOLD_2_T = jnp.asarray(np.asarray(fp.FOLD_2).T)
+FOLD_1_T = jnp.asarray(np.asarray(fp.FOLD_1).T)
+TOPF_T = {w: fp._topfold(w)[:, None] for w in (36, 37, 73)}
+
+
+def norm1_t(x):
+    lo = jnp.bitwise_and(x, fp.MASK)
+    hi = jnp.right_shift(x, fp.B)
+    out = lo + jnp.pad(hi[:-1], [(1, 0), (0, 0)])
+    return out + hi[-1:] * TOPF_T[x.shape[0]]
+
+
+def norm3_t(x):
+    return norm1_t(norm1_t(norm1_t(x)))
+
+
+def pad_t(x, width):
+    return jnp.pad(x, [(0, width - x.shape[0]), (0, 0)])
+
+
+def conv_t(a, b):
+    out = jnp.zeros((CONVW, a.shape[1]), dtype=jnp.int32)
+    for i in range(W):
+        out = out.at[i : i + W].add(a[i] * b)
+    return out
+
+
+def fold_t(x, mt):
+    lo = pad_t(x[:FOLD_AT], W)
+    hi = x[FOLD_AT:]
+    folded = jnp.einsum(
+        "wk,kn->wn", mt[:, : hi.shape[0]], hi, preferred_element_type=jnp.int32
+    )
+    return lo + folded
+
+
+def mul_t(a, b):
+    a = norm3_t(a)
+    b = norm3_t(b)
+    wide = norm3_t(conv_t(a, b))
+    x = norm3_t(pad_t(fold_t(wide, FOLD_FULL_T), 37))
+    x = norm3_t(fold_t(x, FOLD_2_T))
+    x = norm3_t(fold_t(x, FOLD_1_T))
+    return x
+
+
+@jax.jit
+def chain_trans(a, b):
+    x = a
+    for _ in range(R):
+        x = mul_t(x, b)
+    return x
+
+
+# ---- C: raw conv cost, both layouts, int32 vs f32 --------------------------
+@jax.jit
+def conv_only_cur(a, b):
+    x = a
+    for _ in range(R):
+        x = fp.norm3(fp._conv(x, b)[..., :W])
+    return x
+
+
+@jax.jit
+def conv_only_t(a, b):
+    x = a
+    for _ in range(R):
+        x = norm3_t(conv_t(x, b)[:W])
+    return x
+
+
+def conv_t_f32(a, b):
+    out = jnp.zeros((CONVW, a.shape[1]), dtype=jnp.float32)
+    for i in range(W):
+        out = out + jnp.pad(a[i] * b, [(i, CONVW - W - i), (0, 0)])
+    return out
+
+
+@jax.jit
+def conv_only_t_f32(a, b):
+    x = a
+    for _ in range(R):
+        c = conv_t_f32(x, b)[:W]
+        # fake carry: mod/floor to keep values bounded (cost model only)
+        hi = jnp.floor(c / 2048.0)
+        x = c - hi * 2048.0 + jnp.pad(hi[:-1], [(1, 0), (0, 0)])
+    return x
+
+
+# ---- D: raw elementwise multiply throughput --------------------------------
+@jax.jit
+def raw_i32(a, b):
+    x = a
+    for _ in range(R * 36):
+        x = x * b + a
+    return x
+
+
+@jax.jit
+def raw_f32(a, b):
+    x = a
+    for _ in range(R * 36):
+        x = x * b + a
+    return x
+
+
+# ---- E: fold einsum as f32 matmul (MXU) vs int32 ---------------------------
+@jax.jit
+def fold_i32_t(x):
+    y = x
+    for _ in range(R):
+        y = fold_t(pad_t(y, CONVW), FOLD_FULL_T)
+    return y
+
+
+FOLD_FULL_T_F32 = FOLD_FULL_T.astype(jnp.float32)
+
+
+@jax.jit
+def fold_f32_t(x):
+    y = x
+    for _ in range(R):
+        lo = pad_t(y[:FOLD_AT], W)
+        hi = y[FOLD_AT:]
+        y = lo + jnp.dot(
+            FOLD_FULL_T_F32[:, : hi.shape[0]], hi,
+            preferred_element_type=jnp.float32,
+        )
+    return y
+
+
+def report(name, secs, nmul):
+    per = secs / nmul
+    print(f"{name:24s} {secs*1e3:9.2f} ms   {per*1e9:8.1f} ns/Fp-mul "
+          f"({N} elems: {per/N*1e12:8.2f} ps/elem-mul)")
+
+
+if __name__ == "__main__":
+    print(f"device={jax.devices()[0]}, N={N}, R={R}")
+    t = timeit(chain_current, a_cur, b_cur)
+    report("A current [N,36]", t, R)
+    t = timeit(chain_trans, a_t, b_t)
+    report("B transposed [36,N]", t, R)
+    t = timeit(conv_only_cur, a_cur, b_cur)
+    report("C conv+norm [N,36]", t, R)
+    t = timeit(conv_only_t, a_t, b_t)
+    report("C conv+norm [36,N]", t, R)
+    af = a_t.astype(jnp.float32)
+    bf = b_t.astype(jnp.float32)
+    t = timeit(conv_only_t_f32, af, bf)
+    report("C conv+carry f32 [36,N]", t, R)
+    t = timeit(raw_i32, a_cur, b_cur)
+    report("D raw i32 mac [N,36]", t, R * 36)
+    t = timeit(raw_i32, a_t, b_t)
+    report("D raw i32 mac [36,N]", t, R * 36)
+    t = timeit(raw_f32, af, bf)
+    report("D raw f32 fma [36,N]", t, R * 36)
+    t = timeit(fold_i32_t, a_t)
+    report("E fold i32 [36,N]", t, R)
+    t = timeit(fold_f32_t, af)
+    report("E fold f32 mxu [36,N]", t, R)

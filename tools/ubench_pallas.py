@@ -1,0 +1,169 @@
+"""Prototype: fused Fp-mul as a Pallas TPU kernel, correctness + speed.
+
+Layout under test: transposed [W, S] (limbs on sublanes, batch on lanes).
+The kernel fuses conv + carry-normalization + constant-matrix folds in
+VMEM — the XLA version round-trips HBM ~5400 times per mul; this does 3.
+
+Run: python tools/ubench_pallas.py [S] [R]
+"""
+import sys
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+sys.path.insert(0, "/root/repo")
+from lighthouse_tpu.ops import fp
+
+W = fp.W           # 36
+B = fp.B           # 11
+MASK = fp.MASK
+CONVW = fp.CONVW   # 73
+FOLD_AT = fp.FOLD_AT  # 35
+
+S = int(sys.argv[1]) if len(sys.argv) > 1 else 110592
+R = int(sys.argv[2]) if len(sys.argv) > 2 else 40
+TS = 512           # lane-tile per grid program
+
+# constants, transposed for [W, S] layout
+FOLD_FULL_T = np.asarray(fp.FOLD_FULL).T.astype(np.int32)  # [36, 38]
+FOLD_2_T = np.asarray(fp.FOLD_2).T.astype(np.int32)        # [36, 2]
+FOLD_1_T = np.asarray(fp.FOLD_1).T.astype(np.int32)        # [36, 1]
+TOPF = {w: fp._topfold(w).astype(np.int32) for w in (W, 37, CONVW)}
+
+
+# Packed constants, passed as kernel inputs (pallas forbids captures):
+#   folds [W, 41] = [FOLD_FULL_T | FOLD_2_T | FOLD_1_T]
+#   topf  [3, CONVW] = topfold vectors for widths 73, 37, 36 (zero-padded)
+FOLDS = np.concatenate([FOLD_FULL_T, FOLD_2_T, FOLD_1_T], axis=1)
+TOPFM = np.zeros((3, CONVW), np.int32)
+TOPFM[0, :] = TOPF[CONVW]
+TOPFM[1, :37] = TOPF[37]
+TOPFM[2, :W] = TOPF[W]
+_TROW = {CONVW: 0, 37: 1, W: 2}
+
+
+def _norm1(x, topf):
+    """One carry pass along axis 0 (sublanes); top carry folded mod p."""
+    w = x.shape[0]
+    lo = jnp.bitwise_and(x, MASK)
+    hi = jnp.right_shift(x, B)
+    out = lo + jnp.pad(hi[:-1], [(1, 0), (0, 0)])
+    tf = topf[_TROW[w], :w]
+    return out + hi[-1:] * tf[:, None]
+
+
+def _norm3(x, topf):
+    return _norm1(_norm1(_norm1(x, topf), topf), topf)
+
+
+def _fold(x, mt):
+    """x [CONVW-ish, TS] -> [W, TS] via constant matrix, unrolled MACs."""
+    nhi = x.shape[0] - FOLD_AT
+    lo = jnp.pad(x[:FOLD_AT], [(0, W - FOLD_AT), (0, 0)])
+    acc = lo
+    for k in range(nhi):
+        acc = acc + mt[:, k][:, None] * x[FOLD_AT + k][None, :]
+    return acc
+
+
+def _mul_body(a, b, folds, topf):
+    """Fused (a*b mod p): a, b [W, TS] normalized-limb int32."""
+    acc = jnp.zeros((CONVW, a.shape[1]), dtype=jnp.int32)
+    for i in range(W):
+        acc = acc + jnp.pad(a[i][None, :] * b, [(i, CONVW - W - i), (0, 0)])
+    wide = _norm3(acc, topf)
+    x = _norm3(jnp.pad(_fold(wide, folds[:, :38]), [(0, 1), (0, 0)]), topf)
+    x = _norm3(_fold(x, folds[:, 38:40]), topf)
+    x = _norm3(_fold(x, folds[:, 40:41]), topf)
+    return x
+
+
+def _kernel(folds_ref, topf_ref, a_ref, b_ref, o_ref):
+    folds = folds_ref[:]
+    topf = topf_ref[:]
+    a = _norm3(a_ref[:], topf)
+    b = _norm3(b_ref[:], topf)
+    o_ref[:] = _mul_body(a, b, folds, topf)
+
+
+def _kernel_chain(folds_ref, topf_ref, a_ref, b_ref, o_ref):
+    """R chained muls — models a fused hot loop living in VMEM."""
+    folds = folds_ref[:]
+    topf = topf_ref[:]
+    x = _norm3(a_ref[:], topf)
+    b = _norm3(b_ref[:], topf)
+    for _ in range(R):
+        x = _mul_body(x, b, folds, topf)
+    o_ref[:] = x
+
+
+def make(kernel):
+    fj = jnp.asarray(FOLDS)
+    tj = jnp.asarray(TOPFM)
+    call = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((W, S), jnp.int32),
+        grid=(S // TS,),
+        in_specs=[
+            pl.BlockSpec((W, 41), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((3, CONVW), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((W, TS), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((W, TS), lambda i: (0, i), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((W, TS), lambda i: (0, i), memory_space=pltpu.VMEM),
+    )
+    return jax.jit(lambda a, b: call(fj, tj, a, b))
+
+
+def timeit(f, *args, reps=3):
+    jax.block_until_ready(f(*args))
+    best = 1e9
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(f(*args))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+if __name__ == "__main__":
+    print(f"device={jax.devices()[0]}, S={S}, R={R}, TS={TS}")
+    import random
+    random.seed(2)
+    ints_a = [random.randrange(fp.P) for _ in range(8)]
+    ints_b = [random.randrange(fp.P) for _ in range(8)]
+    A = np.zeros((W, S), np.int32)
+    Bm = np.zeros((W, S), np.int32)
+    for i in range(8):
+        A[:, i] = fp.to_limbs(ints_a[i])
+        Bm[:, i] = fp.to_limbs(ints_b[i])
+    # fill the rest with tiled copies (values don't matter for timing)
+    A[:, 8:] = np.tile(A[:, :8], (1, (S - 8) // 8 + 1))[:, : S - 8]
+    Bm[:, 8:] = np.tile(Bm[:, :8], (1, (S - 8) // 8 + 1))[:, : S - 8]
+    Aj, Bj = jnp.asarray(A), jnp.asarray(Bm)
+
+    single = make(_kernel)
+    t0 = time.perf_counter()
+    out = np.asarray(single(Aj, Bj))
+    print(f"single-mul kernel compile+run: {time.perf_counter()-t0:.1f}s")
+    # correctness
+    ok = True
+    for i in range(8):
+        got = fp.from_limbs(out[:, i])
+        want = ints_a[i] * ints_b[i] % fp.P
+        ok &= got == want
+    print("correctness:", "PASS" if ok else "FAIL")
+
+    t = timeit(single, Aj, Bj)
+    print(f"pallas single mul:  {t*1e3:8.2f} ms  ({t/S*1e12:7.1f} ps/elem-mul)")
+
+    chain = make(_kernel_chain)
+    t0 = time.perf_counter()
+    jax.block_until_ready(chain(Aj, Bj))
+    print(f"chain kernel compile: {time.perf_counter()-t0:.1f}s")
+    t = timeit(chain, Aj, Bj)
+    print(f"pallas {R}-mul chain: {t*1e3:8.2f} ms  "
+          f"({t/R*1e3:6.2f} ms/mul, {t/R/S*1e12:7.1f} ps/elem-mul)")
