@@ -170,7 +170,10 @@ def queue_excess_active_balance(spec: ChainSpec, state, index: int) -> None:
                 withdrawal_credentials=bytes(v.withdrawal_credentials),
                 amount=excess,
                 signature=b"\x00" * 96,  # G2 infinity marker (skip sig)
-                slot=int(state.slot),
+                # GENESIS_SLOT, like the spec's queue_excess_active_balance:
+                # internally-queued balance is exempt from the finalization
+                # and eth1-bridge-ordering guards in process_pending_deposits
+                slot=0,
             )
         )
 
@@ -181,10 +184,7 @@ def queue_excess_active_balance(spec: ChainSpec, state, index: int) -> None:
 def process_deposit_request(spec: ChainSpec, state, request) -> None:
     """EIP-6110: EL deposit receipts enter the pending queue."""
     ex = state.electra
-    if ex.deposit_requests_start_index in (
-        0,
-        UNSET_DEPOSIT_REQUESTS_START_INDEX,
-    ):
+    if ex.deposit_requests_start_index == UNSET_DEPOSIT_REQUESTS_START_INDEX:
         ex.deposit_requests_start_index = int(request.index)
     ex.pending_deposits.append(
         T.PendingDeposit.make(
@@ -325,11 +325,22 @@ def process_execution_requests(spec: ChainSpec, state, requests, ctx) -> None:
 
 
 def process_pending_deposits(spec: ChainSpec, state, ctx=None) -> None:
-    """Apply queued deposits under the gwei activation churn
-    (single_pass.rs electra pending-deposit arm)."""
+    """Apply queued deposits under the gwei activation churn — spec-exact
+    electra branches (single_pass.rs electra pending-deposit arm):
+
+    - eth1-bridge ordering guard: post-genesis deposit requests wait
+      until every legacy eth1 deposit has been applied;
+    - only finalized deposits apply (slot <= finalized start slot);
+    - deposits to a WITHDRAWN validator credit immediately without
+      consuming churn (the balance can never activate);
+    - deposits to an EXITING validator are postponed past its
+      withdrawable epoch (re-queued at the tail);
+    - otherwise churn-limited, banking unused churn only when churn was
+      the stopper."""
     from . import state_transition as st
 
     ex = state.electra
+    next_epoch = st.get_current_epoch(spec, state) + 1
     available = (
         get_activation_exit_churn_limit(spec, state)
         + ex.deposit_balance_to_consume
@@ -337,24 +348,46 @@ def process_pending_deposits(spec: ChainSpec, state, ctx=None) -> None:
     finalized_slot = st.compute_start_slot_at_epoch(
         spec, int(state.finalized_checkpoint.epoch)
     )
+    ctx = ctx or st.BlockContext(spec, state)
     processed_amount = 0
     next_index = 0
     churn_limited = False
+    postponed = []
     remaining = list(ex.pending_deposits)
     for dep in remaining:
+        # deposit requests wait for the legacy eth1 bridge to drain
+        if (
+            int(dep.slot) > 0
+            and int(state.eth1_deposit_index) < ex.deposit_requests_start_index
+        ):
+            break
         # only deposits the chain has finalized past are applyable
-        if int(dep.slot) > finalized_slot and finalized_slot > 0:
+        if int(dep.slot) > finalized_slot:
             break
         if next_index >= spec.max_pending_deposits_per_epoch:
             break
-        amount = int(dep.amount)
-        if processed_amount + amount > available:
-            churn_limited = True
-            break
+
+        index = ctx.pubkey_index(bytes(dep.pubkey))
+        is_exited = False
+        is_withdrawn = False
+        if index is not None:
+            v = state.validators[index]
+            is_exited = v.exit_epoch < FAR_FUTURE_EPOCH
+            is_withdrawn = v.withdrawable_epoch < next_epoch
+
+        if is_withdrawn:
+            # balance can never activate: credit without consuming churn
+            _apply_pending_deposit(spec, state, dep, ctx)
+        elif is_exited:
+            postponed.append(dep)
+        else:
+            if processed_amount + int(dep.amount) > available:
+                churn_limited = True
+                break
+            processed_amount += int(dep.amount)
+            _apply_pending_deposit(spec, state, dep, ctx)
         next_index += 1
-        processed_amount += amount
-        _apply_pending_deposit(spec, state, dep, ctx)
-    ex.pending_deposits = remaining[next_index:] if next_index else remaining
+    ex.pending_deposits = remaining[next_index:] + postponed
     # unused churn banks ONLY when churn was the stopper — a deposit
     # waiting on finalization must not accumulate multi-epoch credit
     # that later applies a burst above the per-epoch limit

@@ -333,6 +333,15 @@ def process_light_client_update(
     if int(finalized.slot) > int(store.finalized_header.beacon.slot):
         finalized_period = sync_committee_period(spec, int(finalized.slot))
         if has_next and store.next_sync_committee is None:
+            # spec apply_light_client_update: learning the next committee
+            # without a rotation is only sound for the CURRENT period —
+            # accepting a later-period committee here would leave
+            # current_sync_committee one period stale and fail every
+            # subsequent signature check
+            if finalized_period != store_period:
+                raise LightClientError(
+                    "next-committee update from a later period"
+                )
             store.next_sync_committee = update.next_sync_committee
         elif finalized_period == store_period + 1:
             # period rollover: next becomes current
@@ -348,4 +357,9 @@ def process_light_client_update(
             store.current_max_active_participants = 0
         store.finalized_header = update.finalized_header
     elif has_next and store.next_sync_committee is None:
-        store.next_sync_committee = update.next_sync_committee
+        # non-finality update: learn the next committee only when the
+        # attested state is in OUR period (the committee the proof is
+        # checked against); a later-period update is simply not
+        # learnable here — skip, don't treat the peer as faulty
+        if sync_committee_period(spec, int(attested.slot)) == store_period:
+            store.next_sync_committee = update.next_sync_committee

@@ -1573,6 +1573,14 @@ def empty_genesis_shell(spec: ChainSpec, genesis_time: int = 0):
     state.state_roots = [b"\x00" * 32] * spec.preset.slots_per_historical_root
     state.slashings = [0] * spec.preset.epochs_per_slashings_vector
     state.justification_bits = [False] * 4
+    # "no deposit requests seen yet" is the max-uint sentinel, NOT 0:
+    # a legitimate first DepositRequest can carry index 0, and the
+    # legacy-eth1 shutoff in process_operations keys off this field
+    from .electra import UNSET_DEPOSIT_REQUESTS_START_INDEX
+
+    state.electra.deposit_requests_start_index = (
+        UNSET_DEPOSIT_REQUESTS_START_INDEX
+    )
     return state
 
 

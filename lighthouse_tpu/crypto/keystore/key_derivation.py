@@ -91,3 +91,15 @@ def validator_signing_path(index: int) -> str:
 def validator_withdrawal_path(index: int) -> str:
     """EIP-2334 m/12381/3600/<index>/0 (withdrawal key)."""
     return f"m/12381/3600/{index}/0"
+
+
+def mnemonic_to_seed(mnemonic: str, passphrase: str = "") -> bytes:
+    """BIP-39 seed: PBKDF2-HMAC-SHA512(mnemonic, 'mnemonic'+passphrase,
+    2048 rounds, 64 bytes). The mnemonic is taken as given (NFKD), no
+    wordlist validation — callers own checksum policy. This is the
+    staking-deposit-cli / eth2_wallet entry into EIP-2333 derivation."""
+    import unicodedata
+
+    m = unicodedata.normalize("NFKD", mnemonic).encode()
+    salt = unicodedata.normalize("NFKD", "mnemonic" + passphrase).encode()
+    return hashlib.pbkdf2_hmac("sha512", m, salt, 2048, dklen=64)

@@ -35,6 +35,7 @@ BYTES_PER_BLOB = FIELD_ELEMENTS_PER_BLOB * BYTES_PER_FIELD_ELEMENT
 
 # Fr: the BLS12-381 scalar field. 2-adicity 32, generator 7.
 _PRIMITIVE_ROOT = 7
+_MAINNET_SETUP = None
 
 FIAT_SHAMIR_PROTOCOL_DOMAIN = b"FSBLOBVERIFY_V1_"
 RANDOM_CHALLENGE_DOMAIN = b"RCKZGBATCH___V1_"
@@ -115,6 +116,44 @@ class TrustedSetup:
     # (coefficient-form quotient proofs); None for Lagrange-only setups
     g1_monomial: list = None   # [[tau^i]G1]
     g2_monomial: list = None   # [[tau^i]G2] (up to cell size + 1)
+
+    @classmethod
+    def mainnet(cls) -> "TrustedSetup":
+        """The REAL KZG ceremony output (4096 Lagrange G1 points + G2
+        monomials), from the same trusted_setup.json the reference
+        embeds (crypto/kzg/trusted_setup.json, loaded at
+        crypto/kzg/src/trusted_setup.rs). Public ceremony data; points
+        are decompressed without subgroup checks (ceremony-validated).
+        Cached after first load (4096 G1 decompressions)."""
+        global _MAINNET_SETUP
+        if _MAINNET_SETUP is None:
+            import json as _json
+            from pathlib import Path as _Path
+
+            raw = _json.loads(
+                (_Path(__file__).parent / "trusted_setup_mainnet.json")
+                .read_text()
+            )
+            g1l = [
+                C.g1_decompress(bytes.fromhex(h[2:]), subgroup_check=False)
+                for h in raw["g1_lagrange"]
+            ]
+            g2m = [
+                C.g2_decompress(bytes.fromhex(h[2:]), subgroup_check=False)
+                for h in raw["g2_monomial"]
+            ]
+            g1m = [
+                C.g1_decompress(bytes.fromhex(h[2:]), subgroup_check=False)
+                for h in raw["g1_monomial"]
+            ]
+            _MAINNET_SETUP = cls(
+                g1_lagrange=g1l,
+                g2_tau=g2m[1],
+                roots=compute_roots_of_unity(len(g1l)),
+                g1_monomial=g1m,
+                g2_monomial=g2m,
+            )
+        return _MAINNET_SETUP
 
     @classmethod
     def dev(cls, n: int = FIELD_ELEMENTS_PER_BLOB, with_monomial=None) -> "TrustedSetup":
@@ -325,19 +364,31 @@ class Kzg:
     # -- internals
 
     def _blob_challenge(self, blob: bytes, commitment) -> int:
+        # KZG_ENDIANNESS is 'big' throughout the spec's Fiat-Shamir —
+        # including the 16-byte polynomial degree. (Caught by the
+        # external c-kzg fixture, tests/test_external_vectors.py.)
         h = hashlib.sha256(
             FIAT_SHAMIR_PROTOCOL_DOMAIN
-            + self.n.to_bytes(16, "little")
+            + self.n.to_bytes(16, "big")
             + blob
             + C.g1_compress(commitment)
         ).digest()
         return int.from_bytes(h, "big") % R
 
     def _batch_r_powers(self, items) -> list:
-        data = RANDOM_CHALLENGE_DOMAIN + len(items).to_bytes(8, "little")
-        for cm, z, y, pr in items:
-            data += C.g1_compress(cm) + fr_to_bytes(z) + fr_to_bytes(y)
-            data += C.g1_compress(pr)
+        # spec compute_r_powers transcript: domain | degree (16B big) |
+        # count (8B big) | commitments | zs | ys | proofs. The value is
+        # verifier-local (any RLC is sound), but keep the transcript
+        # spec-exact like _blob_challenge.
+        data = (
+            RANDOM_CHALLENGE_DOMAIN
+            + self.n.to_bytes(16, "big")
+            + len(items).to_bytes(8, "big")
+        )
+        data += b"".join(C.g1_compress(cm) for cm, _, _, _ in items)
+        data += b"".join(fr_to_bytes(z) for _, z, _, _ in items)
+        data += b"".join(fr_to_bytes(y) for _, _, y, _ in items)
+        data += b"".join(C.g1_compress(pr) for _, _, _, pr in items)
         r = int.from_bytes(hashlib.sha256(data).digest(), "big") % R
         out = [1]
         for _ in range(len(items) - 1):

@@ -10,6 +10,8 @@ another peer; exhausting peers marks the sample (and block) failed.
 
 from __future__ import annotations
 
+import hashlib
+import os
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
@@ -47,24 +49,33 @@ class PeerSampler:
         verifier=None,
         samples_per_slot: int = dc.SAMPLES_PER_SLOT,
         custody_of: Optional[Callable] = None,
+        node_seed: Optional[bytes] = None,
     ):
         """request_column(peer_id, block_root, column_index,
         callback(sidecar_or_none)) issues the RPC; custody_of(peer_id)
-        -> set of columns the peer custodies (from its metadata)."""
+        -> set of columns the peer custodies (from its metadata);
+        node_seed: per-node entropy mixed into column selection
+        (defaults to fresh randomness; inject a fixed value in tests)."""
         self.request_column = request_column
         self.verifier = verifier
         self.samples_per_slot = samples_per_slot
         self.custody_of = custody_of or (lambda peer: set(range(dc.NUMBER_OF_COLUMNS)))
+        self.node_seed = os.urandom(32) if node_seed is None else node_seed
         self.active: dict[bytes, SamplingRequest] = {}
 
     # ---------------------------------------------------------- start
 
     def columns_for(self, block_root: bytes) -> list:
-        """Deterministic per-block pseudo-random column choice (the
-        reference randomizes; determinism here keeps tests exact while
-        remaining unpredictable to a block producer pre-image)."""
+        """Per-node pseudo-random column choice: the selection seed mixes
+        per-node entropy with the block root, so a producer cannot
+        predict which columns any node will sample (withholding all but
+        a known set would otherwise pass sampling network-wide; the
+        reference samples randomly per node). Tests inject node_seed
+        for determinism."""
         return dc.pseudo_random_selection(
-            block_root, self.samples_per_slot, dc.NUMBER_OF_COLUMNS
+            hashlib.sha256(self.node_seed + bytes(block_root)).digest(),
+            self.samples_per_slot,
+            dc.NUMBER_OF_COLUMNS,
         )
 
     def start(self, block_root: bytes, peers: list) -> SamplingRequest:

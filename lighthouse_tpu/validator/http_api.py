@@ -18,6 +18,7 @@ posture). Route logic is framework-free like node/http_api.
 
 from __future__ import annotations
 
+import hmac
 import json
 import re
 import secrets as _secrets
@@ -256,7 +257,13 @@ def make_handler(api: KeymanagerApi, token: str):
 
         def _authorized(self) -> bool:
             got = self.headers.get("Authorization", "")
-            return got == f"Bearer {token}"
+            # constant-time compare: the bearer token gates keystore
+            # import/delete; plain == leaks a timing side channel.
+            # bytes, not str: compare_digest(str) raises on non-ASCII
+            return hmac.compare_digest(
+                got.encode("utf-8", "surrogateescape"),
+                f"Bearer {token}".encode(),
+            )
 
         def _dispatch(self, method: str, body: Optional[bytes]) -> None:
             path = self.path.split("?")[0]

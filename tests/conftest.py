@@ -50,6 +50,8 @@ _CRYPTO_HEAVY = {
     "test_h2c_vectors.py",
     "test_parallel.py",
     "test_kzg.py",
+    "test_lane.py",
+    "test_lane_curve.py",
 }
 
 
