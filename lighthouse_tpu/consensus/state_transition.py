@@ -409,8 +409,18 @@ def process_block(
     """per_block_processing.rs:100 order: header, (withdrawals, payload)
     for the execution forks, randao, eth1, operations, sync aggregate."""
     process_block_header(spec, state, block)
-    process_withdrawals(spec, state, block.body.execution_payload)
-    process_execution_payload(spec, state, block.body)
+    blinded = hasattr(block.body, "execution_payload_header")
+    if blinded:
+        # builder flow: the body carries only the payload HEADER
+        # (process_withdrawals/process_execution_payload blinded arms,
+        # per_block_processing.rs on BlindedPayload)
+        process_withdrawals_header(
+            spec, state, block.body.execution_payload_header
+        )
+        process_execution_payload_header(spec, state, block.body)
+    else:
+        process_withdrawals(spec, state, block.body.execution_payload)
+        process_execution_payload(spec, state, block.body)
     process_randao(spec, state, block, verify_signatures)
     process_eth1_data(spec, state, block.body)
     process_operations(spec, state, block.body, verify_signatures)
@@ -521,6 +531,49 @@ def get_expected_withdrawals(spec: ChainSpec, state) -> list:
     return withdrawals
 
 
+def process_withdrawals_header(spec: ChainSpec, state, header) -> None:
+    """Blinded variant: the header's withdrawals_root must equal the
+    root of the state-derived expected withdrawals; the sweep advances
+    identically."""
+    partials_consumed = 0
+    if spec.electra_enabled(get_current_epoch(spec, state)):
+        from . import electra
+
+        expected, partials_consumed = electra.get_expected_withdrawals(
+            spec, state
+        )
+    else:
+        expected = get_expected_withdrawals(spec, state)
+    want = T.List(
+        T.Withdrawal, spec.preset.max_withdrawals_per_payload
+    ).hash_tree_root(expected)
+    if bytes(header.withdrawals_root) != want:
+        raise BlockProcessingError("withdrawals_root mismatch")
+    _apply_withdrawals(spec, state, expected, partials_consumed)
+
+
+def process_execution_payload_header(spec: ChainSpec, state, body) -> None:
+    """Blinded variant of process_execution_payload: same consensus
+    checks against the header, which then rotates into the state."""
+    header = body.execution_payload_header
+    if is_merge_transition_complete(state):
+        if bytes(header.parent_hash) != bytes(
+            state.latest_execution_payload_header.block_hash
+        ):
+            raise BlockProcessingError("payload parent hash mismatch")
+    if bytes(header.prev_randao) != get_randao_mix(
+        spec, state, get_current_epoch(spec, state)
+    ):
+        raise BlockProcessingError("payload prev_randao mismatch")
+    if header.timestamp != compute_timestamp_at_slot(spec, state, state.slot):
+        raise BlockProcessingError("payload timestamp mismatch")
+    if len(body.blob_kzg_commitments) > spec.preset.max_blobs_per_block:
+        raise BlockProcessingError("too many blob commitments")
+    state.latest_execution_payload_header = T.ExecutionPayloadHeader.make(
+        **{n: getattr(header, n) for n, _ in T.ExecutionPayloadHeader.fields}
+    )
+
+
 def process_withdrawals(spec: ChainSpec, state, payload) -> None:
     """capella process_withdrawals: the payload's withdrawals must equal
     the state-derived expectation; balances decrease; sweep cursors
@@ -545,6 +598,11 @@ def process_withdrawals(spec: ChainSpec, state, payload) -> None:
             or w.amount != e.amount
         ):
             raise BlockProcessingError("withdrawal mismatch")
+    _apply_withdrawals(spec, state, expected, partials_consumed)
+
+
+def _apply_withdrawals(spec, state, expected, partials_consumed) -> None:
+    """Shared effect application for the full and blinded arms."""
     for w in expected:
         decrease_balance(state, w.validator_index, w.amount)
     if partials_consumed:
@@ -670,7 +728,7 @@ def process_operations(
         from .electra import UNSET_DEPOSIT_REQUESTS_START_INDEX
 
         start = state.electra.deposit_requests_start_index
-        if start not in (0, UNSET_DEPOSIT_REQUESTS_START_INDEX):
+        if start != UNSET_DEPOSIT_REQUESTS_START_INDEX:
             # EIP-6110 transition: the legacy eth1 path shuts off at
             # deposit_requests_start_index — past it the SAME deposit
             # would arrive again as a DepositRequest (double credit)

@@ -399,6 +399,24 @@ BeaconBlockBody = Container(
     ],
 )
 
+# Blinded variant (builder/MEV flow): the payload is replaced by its
+# header. Field-root equality (htr(List) == the stored list root)
+# makes htr(BlindedBeaconBlockBody) == htr(BeaconBlockBody) for the
+# same content, so a signature over a blinded block commits to the
+# revealed full block (consensus/types/src/beacon_block_body.rs
+# BlindedBeaconBlockBody via superstruct).
+BlindedBeaconBlockBody = Container(
+    "BlindedBeaconBlockBody",
+    [
+        (
+            ("execution_payload_header", ExecutionPayloadHeader)
+            if n == "execution_payload"
+            else (n, t)
+        )
+        for n, t in BeaconBlockBody.fields
+    ],
+)
+
 BeaconBlock = Container(
     "BeaconBlock",
     [
@@ -413,6 +431,78 @@ BeaconBlock = Container(
 SignedBeaconBlock = Container(
     "SignedBeaconBlock",
     [("message", BeaconBlock), ("signature", Bytes96)],
+)
+
+BlindedBeaconBlock = Container(
+    "BlindedBeaconBlock",
+    [
+        ("slot", uint64),
+        ("proposer_index", uint64),
+        ("parent_root", Bytes32),
+        ("state_root", Bytes32),
+        ("body", BlindedBeaconBlockBody),
+    ],
+)
+
+SignedBlindedBeaconBlock = Container(
+    "SignedBlindedBeaconBlock",
+    [("message", BlindedBeaconBlock), ("signature", Bytes96)],
+)
+
+
+def block_to_blinded(block) -> "BlindedBeaconBlock":
+    """Full block -> blinded (payload replaced by its header)."""
+    body = block.body
+    fields = {}
+    for n, _ in BlindedBeaconBlockBody.fields:
+        if n == "execution_payload_header":
+            fields[n] = execution_payload_to_header(body.execution_payload)
+        else:
+            fields[n] = getattr(body, n)
+    return BlindedBeaconBlock.make(
+        slot=block.slot,
+        proposer_index=block.proposer_index,
+        parent_root=bytes(block.parent_root),
+        state_root=bytes(block.state_root),
+        body=BlindedBeaconBlockBody.make(**fields),
+    )
+
+
+def blinded_to_full(signed_blinded, payload) -> "SignedBeaconBlock":
+    """Signed blinded block + revealed payload -> signed full block.
+    Raises if the payload does not match the committed header root."""
+    msg = signed_blinded.message
+    header = msg.body.execution_payload_header
+    if ExecutionPayloadHeader.hash_tree_root(
+        execution_payload_to_header(payload)
+    ) != ExecutionPayloadHeader.hash_tree_root(header):
+        raise ValueError("revealed payload does not match blinded header")
+    fields = {}
+    for n, _ in BeaconBlockBody.fields:
+        if n == "execution_payload":
+            fields[n] = payload
+        else:
+            fields[n] = getattr(msg.body, n)
+    block = BeaconBlock.make(
+        slot=msg.slot,
+        proposer_index=msg.proposer_index,
+        parent_root=bytes(msg.parent_root),
+        state_root=bytes(msg.state_root),
+        body=BeaconBlockBody.make(**fields),
+    )
+    return SignedBeaconBlock.make(
+        message=block, signature=bytes(signed_blinded.signature)
+    )
+
+# builder registration (builder-specs ValidatorRegistrationV1 message)
+ValidatorRegistrationData = Container(
+    "ValidatorRegistrationData",
+    [
+        ("fee_recipient", Bytes20),
+        ("gas_limit", uint64),
+        ("timestamp", uint64),
+        ("pubkey", Bytes48),
+    ],
 )
 
 # ---------------------------------------------------------------- sync duty

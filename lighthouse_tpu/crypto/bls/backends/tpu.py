@@ -6,12 +6,23 @@ verification,
 
     prod_i e([r_i] apk_i, H(m_i)) * e(-g1, sum_i [r_i] sig_i) == 1,
 
-but with every expensive step — hash-to-curve maps, G2 subgroup checks
+with every expensive step — hash-to-curve maps, G2 subgroup checks
 of the signatures, the 64-bit scalar ladders, the point-sum tree, n+1
 Miller loops, one final exponentiation — fused into ONE jitted XLA
-program over the whole batch. Batch sizes are padded to power-of-two
-buckets so recompilation is rare; padding slots use r = 0 and are masked
-out of the pairing product.
+program over the whole batch.
+
+Round 3 rebuilt the compute core on ops/lane (lane-major layout +
+Pallas-fused kernels; see ops/lane/__init__.py for the measured
+rationale) and cut the operation count:
+
+- subgroup-check ladder [|u|]S shares the doubling chain with the
+  random-combination ladder [r]S, and its adds are static-unrolled
+  (scalar_mul_with_static);
+- the Miller loop is unrolled over the static ate bits with sparse
+  line products (ops/lane/pairing.py);
+- batch sizes are padded to power-of-two buckets >= 128 lanes so the
+  128-wide TPU lane axis is full and recompilation is rare; padding
+  slots use r = 0 and are masked out of the pairing product.
 
 Division of labor:
   host   — input policy checks (empty sets, infinity points), per-set
@@ -26,14 +37,13 @@ import jax
 import jax.numpy as jnp
 
 from .. import params
-from lighthouse_tpu.ops import fp, tower, jacobian as J, pairing as OP, htc
+from lighthouse_tpu.ops.lane import fp, tower, jacobian as J, pairing as OP, htc
 
 W = fp.W
 
 _G1_GEN_NEG_X = fp.to_limbs(params.G1X)
 _G1_GEN_NEG_Y = fp.to_limbs((-params.G1Y) % params.P)
-_G2_GEN_X = tower.f2_pack(params.G2X)
-_G2_GEN_Y = tower.f2_pack(params.G2Y)
+_M_ABS = -params.X
 
 
 def _to_affine_g1(p):
@@ -52,33 +62,31 @@ def _to_affine_g2(p):
 
 def local_phase(apk_x, apk_y, sig_x, sig_y, t0, t1, rbits, pad):
     """The per-shard portion of batch verification: everything except
-    the global signature aggregate. Returns
+    the global signature aggregate. All arrays lane-major (batch on the
+    trailing axis): apk_* [W, S]; sig_*, t0, t1 [2, W, S]; rbits
+    [64, S]; pad [S] bool. Returns
       (f_local, r_sig, sub_ok_all):
-      f_local [2,3,2,W]  — product of this shard's n Miller values
-      r_sig              — this shard's SUM of [r_i]sig_i (Jacobian G2)
-      sub_ok_all []      — AND of this shard's subgroup checks.
+      f_local [2,3,2,W,1] — product of this shard's Miller values
+      r_sig             — this shard's SUM of [r_i]sig_i (Jacobian G2)
+      sub_ok_all []     — AND of this shard's subgroup checks.
     Used unsharded by `_verify_kernel` and per-device by
-    lighthouse_tpu.parallel.verify under shard_map (SURVEY.md §5.7: the
-    batch axis is this project's sequence-parallel analog)."""
-    n = apk_x.shape[0]
-    one1 = tower.bcast(jnp.asarray(fp.ONE), (n,))
-    one2 = tower.bcast(jnp.asarray(np.stack([fp.ONE, fp.ZERO])), (n,))
+    lighthouse_tpu.parallel.verify under shard_map (SURVEY.md §5.7)."""
+    S = apk_x.shape[-1]
+    one1 = tower.bcast(jnp.asarray(fp.ONE)[:, None], S)
+    one2 = tower.bcast(jnp.asarray(np.stack([fp.ONE, fp.ZERO]))[..., None], S)
 
     # hash-to-curve for all messages
-    hm = htc.hash_draws_to_g2(t0, t1)                    # [n] Jacobian G2
+    hm = htc.hash_draws_to_g2(t0, t1)                    # [2, W, S] Jacobian
 
-    # Two scalar multiplications of the SAME base (subgroup check's
-    # [|u|]S and the random-combination [r]S) share one doubling chain:
-    # a single scan with two conditional-add accumulators — half the
-    # ladder cost and one compiled body instead of two.
+    # [r]S (dynamic 64-bit scalars) and the subgroup check's [|u|]S
+    # share one doubling chain; the static adds cost 5 fused kernels.
     sig_jac = (sig_x, sig_y, one2)
-    mbits = htc._m_bits(n)
-    m_sig, r_sig = J.scalar_mul2(J.FP2, sig_jac, mbits, rbits)
+    r_sig, m_sig = J.scalar_mul_with_static(J.FP2, sig_jac, rbits, _M_ABS)
 
     # signature subgroup checks: psi(S) == [u]S = -[|u|]S
     sub_ok = J.jac_eq(J.FP2, J.psi(sig_jac), J.neg(J.FP2, m_sig)) | pad
 
-    s_local = J.sum_tree(J.FP2, r_sig, n)                # shard's sum
+    s_local = J.lane_sum(J.FP2, r_sig, S)                # shard's sum
     r_apk = J.scalar_mul(J.FP1, (apk_x, apk_y, one1), rbits)
 
     # to affine for the Miller loop
@@ -87,20 +95,20 @@ def local_phase(apk_x, apk_y, sig_x, sig_y, t0, t1, rbits, pad):
     q_inf = J.FP2.is_zero_struct(hm[2]) | pad
 
     fs = OP.miller_loop(px, py, qx, qy, p_inf=pad, q_inf=q_inf)
-    f_local = OP.f12_product_tree(fs, n)
+    f_local = OP.lane_product(fs, S)
     return f_local, s_local, jnp.all(sub_ok)
 
 
 def finish_phase(f_prod, s_agg, sub_ok_all):
     """Global finish: the (-g1, S) pair, final exponentiation, verdict."""
-    sx, sy = _to_affine_g2(tuple(c[None] for c in s_agg))
-    s_inf = J.FP2.is_zero_struct(s_agg[2])[None]
-    xP = tower.bcast(jnp.asarray(_G1_GEN_NEG_X), (1,))
-    yP = tower.bcast(jnp.asarray(_G1_GEN_NEG_Y), (1,))
-    f_last = OP.miller_loop(xP, yP, sx, sy, q_inf=s_inf)[0]
+    sx, sy = _to_affine_g2(s_agg)
+    s_inf = J.FP2.is_zero_struct(s_agg[2])
+    xP = jnp.asarray(_G1_GEN_NEG_X)[:, None]
+    yP = jnp.asarray(_G1_GEN_NEG_Y)[:, None]
+    f_last = OP.miller_loop(xP, yP, sx, sy, q_inf=s_inf)
     prod = tower.f12mul(f_prod, f_last)
     ok = tower.f12_eq_one(OP.final_exp(prod))
-    return ok & sub_ok_all
+    return jnp.all(ok) & sub_ok_all
 
 
 @jax.jit
@@ -113,7 +121,8 @@ def _verify_kernel(apk_x, apk_y, sig_x, sig_y, t0, t1, rbits, pad):
 
 
 def _bucket(n: int) -> int:
-    return 1 << max(3, (n - 1).bit_length())
+    """Power-of-two lane buckets, minimum 128 (a full TPU lane tile)."""
+    return 1 << max(7, (n - 1).bit_length())
 
 
 def prepare_batch(sets, rand_scalars):
@@ -123,6 +132,8 @@ def prepare_batch(sets, rand_scalars):
     if n == 0:
         return None
     apk_pts, sig_pts, msgs = [], [], []
+    from .. import curve as C
+
     for s, r in zip(sets, rand_scalars):
         if not s.signing_keys:
             return None
@@ -131,8 +142,6 @@ def prepare_batch(sets, rand_scalars):
         if s.signature.point is None:
             return None
         apk = None
-        from .. import curve as C
-
         for k in s.signing_keys:
             apk = C.g1_add(apk, k.point)
         if apk is None:
@@ -142,23 +151,17 @@ def prepare_batch(sets, rand_scalars):
         msgs.append(s.message)
 
     npad = _bucket(n)
-    apk_x = np.stack(
-        [fp.to_limbs(p[0]) for p in apk_pts]
-        + [_G1_GEN_NEG_X] * (npad - n)
+    apk_x = fp.pack([p[0] for p in apk_pts] + [params.G1X] * (npad - n))
+    apk_y = fp.pack([p[1] for p in apk_pts] + [params.G1Y] * (npad - n))
+    sig_x = tower.f2_pack_many(
+        [p[0] for p in sig_pts] + [params.G2X] * (npad - n)
     )
-    apk_y = np.stack(
-        [fp.to_limbs(p[1]) for p in apk_pts]
-        + [fp.to_limbs(params.G1Y)] * (npad - n)
-    )
-    sig_x = np.stack(
-        [tower.f2_pack(p[0]) for p in sig_pts] + [_G2_GEN_X] * (npad - n)
-    )
-    sig_y = np.stack(
-        [tower.f2_pack(p[1]) for p in sig_pts] + [_G2_GEN_Y] * (npad - n)
+    sig_y = tower.f2_pack_many(
+        [p[1] for p in sig_pts] + [params.G2Y] * (npad - n)
     )
     t0, t1 = htc.pack_draws(msgs + [b""] * (npad - n))
-    rbits = np.zeros((npad, 64), dtype=np.int32)
-    rbits[:n] = J.scalars_to_bits(rand_scalars, 64)
+    rbits = np.zeros((64, npad), dtype=np.int32)
+    rbits[:, :n] = J.scalars_to_bits(rand_scalars, 64)
     pad = np.zeros(npad, dtype=bool)
     pad[n:] = True
     return (

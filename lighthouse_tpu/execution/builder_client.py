@@ -1,0 +1,335 @@
+"""External-builder (MEV) client + mock builder — builder_client/src/lib.rs
+and the payload-building arm of beacon_node/execution_layer.
+
+Builder API (ethereum/builder-specs), JSON over HTTP like the
+reference's BuilderHttpClient:
+
+  POST /eth/v1/builder/validators          register_validators
+  GET  /eth/v1/builder/header/{slot}/{parent_hash}/{pubkey}
+                                           -> SignedBuilderBid
+  POST /eth/v1/builder/blinded_blocks      submit signed blinded block
+                                           -> full ExecutionPayload
+
+Transport seam matches engine_api.py: `request(method, path, json_body)
+-> (status, json)`; the default uses urllib, tests/the simulator inject
+`MockBuilder.request` directly (the reference's mock builder posture,
+execution_layer/src/test_utils).
+
+Payload selection policy (ExecutionLayer::get_payload's builder arm,
+beacon_node/execution_layer/src/lib.rs): take the builder's bid iff it
+is available, well-formed, for the right parent, and its value exceeds
+the local payload's value by the configured boost factor; otherwise fall
+back to the local EL payload. A builder failure NEVER fails block
+production.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from ..consensus import types as T
+
+
+class BuilderError(Exception):
+    pass
+
+
+def _default_transport(base_url: str):
+    import urllib.request
+
+    def request(method: str, path: str, body: Optional[dict]):
+        req = urllib.request.Request(
+            base_url.rstrip("/") + path,
+            data=None if body is None else json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+            method=method,
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=3) as resp:
+                return resp.status, json.loads(resp.read() or b"{}")
+        except urllib.error.HTTPError as e:  # pragma: no cover - net path
+            return e.code, {}
+        except (OSError, ValueError) as e:  # pragma: no cover - net path
+            # connection refused / timeout / bad JSON: a synthetic
+            # status the client maps to BuilderError — NEVER an
+            # uncaught exception into block production
+            return 599, {"error": str(e)}
+
+    return request
+
+
+class BuilderClient:
+    """builder_client/src/lib.rs role."""
+
+    def __init__(self, transport: Callable = None, base_url: str = None):
+        if transport is None:
+            if base_url is None:
+                raise BuilderError("need transport or base_url")
+            transport = _default_transport(base_url)
+        self._request = transport
+
+    def register_validators(self, registrations: list) -> None:
+        """registrations: list of dicts {pubkey, fee_recipient,
+        gas_limit, timestamp} (+signature in production)."""
+        status, _ = self._request(
+            "POST", "/eth/v1/builder/validators", registrations
+        )
+        if status != 200:
+            raise BuilderError(f"register_validators: HTTP {status}")
+
+    def get_header(self, slot: int, parent_hash: bytes, pubkey: bytes):
+        """-> (ExecutionPayloadHeader, value_wei) or None if no bid."""
+        status, body = self._request(
+            "GET",
+            f"/eth/v1/builder/header/{slot}/0x{parent_hash.hex()}"
+            f"/0x{pubkey.hex()}",
+            None,
+        )
+        if status == 204:
+            return None
+        if status != 200:
+            raise BuilderError(f"get_header: HTTP {status}")
+        try:
+            bid = body["data"]["message"]
+            header = _header_from_json(bid["header"])
+            return header, int(bid["value"])
+        except (KeyError, ValueError, TypeError) as e:
+            raise BuilderError(f"get_header: malformed bid ({e})")
+
+    def submit_blinded_block(self, signed_blinded: dict):
+        """signed blinded block (json form) -> full ExecutionPayload."""
+        status, body = self._request(
+            "POST", "/eth/v1/builder/blinded_blocks", signed_blinded
+        )
+        if status != 200:
+            raise BuilderError(f"submit_blinded_block: HTTP {status}")
+        try:
+            return _payload_from_json(body["data"])
+        except (KeyError, ValueError, TypeError) as e:
+            raise BuilderError(f"submit_blinded_block: malformed ({e})")
+
+
+# ---------------------------------------------------------------- json codecs
+
+
+def _header_to_json(h) -> dict:
+    return {
+        "parent_hash": "0x" + bytes(h.parent_hash).hex(),
+        "fee_recipient": "0x" + bytes(h.fee_recipient).hex(),
+        "state_root": "0x" + bytes(h.state_root).hex(),
+        "receipts_root": "0x" + bytes(h.receipts_root).hex(),
+        "logs_bloom": "0x" + bytes(h.logs_bloom).hex(),
+        "prev_randao": "0x" + bytes(h.prev_randao).hex(),
+        "block_number": str(int(h.block_number)),
+        "gas_limit": str(int(h.gas_limit)),
+        "gas_used": str(int(h.gas_used)),
+        "timestamp": str(int(h.timestamp)),
+        "extra_data": "0x" + bytes(h.extra_data).hex(),
+        "base_fee_per_gas": str(int(h.base_fee_per_gas)),
+        "block_hash": "0x" + bytes(h.block_hash).hex(),
+        "transactions_root": "0x" + bytes(h.transactions_root).hex(),
+        "withdrawals_root": "0x" + bytes(h.withdrawals_root).hex(),
+        "blob_gas_used": str(int(h.blob_gas_used)),
+        "excess_blob_gas": str(int(h.excess_blob_gas)),
+    }
+
+
+def _hx(s: str) -> bytes:
+    return bytes.fromhex(s[2:] if s.startswith("0x") else s)
+
+
+def _header_from_json(j: dict):
+    return T.ExecutionPayloadHeader.make(
+        parent_hash=_hx(j["parent_hash"]),
+        fee_recipient=_hx(j["fee_recipient"]),
+        state_root=_hx(j["state_root"]),
+        receipts_root=_hx(j["receipts_root"]),
+        logs_bloom=_hx(j["logs_bloom"]),
+        prev_randao=_hx(j["prev_randao"]),
+        block_number=int(j["block_number"]),
+        gas_limit=int(j["gas_limit"]),
+        gas_used=int(j["gas_used"]),
+        timestamp=int(j["timestamp"]),
+        extra_data=_hx(j["extra_data"]),
+        base_fee_per_gas=int(j["base_fee_per_gas"]),
+        block_hash=_hx(j["block_hash"]),
+        transactions_root=_hx(j["transactions_root"]),
+        withdrawals_root=_hx(j["withdrawals_root"]),
+        blob_gas_used=int(j["blob_gas_used"]),
+        excess_blob_gas=int(j["excess_blob_gas"]),
+    )
+
+
+def _payload_to_json(p) -> dict:
+    return {
+        "parent_hash": "0x" + bytes(p.parent_hash).hex(),
+        "fee_recipient": "0x" + bytes(p.fee_recipient).hex(),
+        "state_root": "0x" + bytes(p.state_root).hex(),
+        "receipts_root": "0x" + bytes(p.receipts_root).hex(),
+        "logs_bloom": "0x" + bytes(p.logs_bloom).hex(),
+        "prev_randao": "0x" + bytes(p.prev_randao).hex(),
+        "block_number": str(int(p.block_number)),
+        "gas_limit": str(int(p.gas_limit)),
+        "gas_used": str(int(p.gas_used)),
+        "timestamp": str(int(p.timestamp)),
+        "extra_data": "0x" + bytes(p.extra_data).hex(),
+        "base_fee_per_gas": str(int(p.base_fee_per_gas)),
+        "block_hash": "0x" + bytes(p.block_hash).hex(),
+        "transactions": ["0x" + bytes(t).hex() for t in p.transactions],
+        "withdrawals": [],
+        "blob_gas_used": str(int(p.blob_gas_used)),
+        "excess_blob_gas": str(int(p.excess_blob_gas)),
+    }
+
+
+def _payload_from_json(j: dict):
+    return T.ExecutionPayload.make(
+        parent_hash=_hx(j["parent_hash"]),
+        fee_recipient=_hx(j["fee_recipient"]),
+        state_root=_hx(j["state_root"]),
+        receipts_root=_hx(j["receipts_root"]),
+        logs_bloom=_hx(j["logs_bloom"]),
+        prev_randao=_hx(j["prev_randao"]),
+        block_number=int(j["block_number"]),
+        gas_limit=int(j["gas_limit"]),
+        gas_used=int(j["gas_used"]),
+        timestamp=int(j["timestamp"]),
+        extra_data=_hx(j["extra_data"]),
+        base_fee_per_gas=int(j["base_fee_per_gas"]),
+        block_hash=_hx(j["block_hash"]),
+        transactions=[_hx(t) for t in j.get("transactions", [])],
+        withdrawals=[],
+        blob_gas_used=int(j.get("blob_gas_used", "0")),
+        excess_blob_gas=int(j.get("excess_blob_gas", "0")),
+    )
+
+
+# ---------------------------------------------------------------- mock
+
+
+@dataclass
+class MockBuilder:
+    """In-process builder (execution_layer/src/test_utils mock-builder
+    role): builds payloads from registered state, bids with a
+    configurable value, reveals on submission. `request` IS the
+    transport for BuilderClient.
+
+    `payload_fn(slot, parent_hash) -> ExecutionPayload` lets tests hand
+    in chain-consistent payloads (a real builder tracks the chain and
+    builds valid ones); the default standalone payload is only
+    consensus-valid against a chain that skips payload checks."""
+
+    bid_value_wei: int = 10**18
+    missing: bool = False              # simulate no-bid (204)
+    fail_reveal: bool = False          # simulate withheld payload
+    payload_fn: Optional[Callable] = None
+    registrations: dict = field(default_factory=dict)
+    _payloads: dict = field(default_factory=dict)
+
+    def request(self, method: str, path: str, body):
+        if method == "POST" and path == "/eth/v1/builder/validators":
+            for r in body:
+                self.registrations[r["pubkey"].lower()] = r
+            return 200, {}
+        if method == "GET" and path.startswith("/eth/v1/builder/header/"):
+            if self.missing:
+                return 204, {}
+            _, _, _, _, _, slot, parent_hash, pubkey = path.split("/")
+            if pubkey.lower() not in self.registrations:
+                return 204, {}
+            payload = self._build_payload(int(slot), _hx(parent_hash))
+            header = T.execution_payload_to_header(payload)
+            self._payloads[bytes(header.block_hash)] = payload
+            return 200, {
+                "data": {
+                    "message": {
+                        "header": _header_to_json(header),
+                        "value": str(self.bid_value_wei),
+                        "pubkey": pubkey,
+                    },
+                    "signature": "0x" + "00" * 96,
+                }
+            }
+        if method == "POST" and path == "/eth/v1/builder/blinded_blocks":
+            if self.fail_reveal:
+                return 500, {}
+            block_hash = _hx(
+                body["message"]["body"]["execution_payload_header"][
+                    "block_hash"
+                ]
+            )
+            payload = self._payloads.get(bytes(block_hash))
+            if payload is None:
+                return 400, {}
+            return 200, {"data": _payload_to_json(payload)}
+        return 404, {}
+
+    def _build_payload(self, slot: int, parent_hash: bytes):
+        if self.payload_fn is not None:
+            return self.payload_fn(slot, parent_hash)
+        import hashlib
+
+        block_hash = hashlib.sha256(
+            b"mock-builder" + parent_hash + slot.to_bytes(8, "little")
+        ).digest()
+        return T.ExecutionPayload.make(
+            parent_hash=parent_hash,
+            fee_recipient=b"\xbb" * 20,
+            state_root=b"\x01" * 32,
+            receipts_root=b"\x02" * 32,
+            logs_bloom=b"\x00" * 256,
+            prev_randao=b"\x00" * 32,
+            block_number=slot,
+            gas_limit=30_000_000,
+            gas_used=21_000,
+            timestamp=slot * 12,
+            extra_data=b"mock-builder",
+            base_fee_per_gas=7,
+            block_hash=block_hash,
+            transactions=[b"\x02" + slot.to_bytes(8, "little")],
+            withdrawals=[],
+            blob_gas_used=0,
+            excess_blob_gas=0,
+        )
+
+
+def signed_blinded_to_json(signed_blinded) -> dict:
+    """Signed blinded block -> builder-API json (the submission body)."""
+    msg = signed_blinded.message
+    return {
+        "message": {
+            "slot": str(int(msg.slot)),
+            "proposer_index": str(int(msg.proposer_index)),
+            "parent_root": "0x" + bytes(msg.parent_root).hex(),
+            "state_root": "0x" + bytes(msg.state_root).hex(),
+            "body": {
+                "execution_payload_header": _header_to_json(
+                    msg.body.execution_payload_header
+                ),
+            },
+        },
+        "signature": "0x" + bytes(signed_blinded.signature).hex(),
+    }
+
+
+# ---------------------------------------------------------------- selection
+
+
+def choose_payload(
+    local_payload,
+    builder_result,
+    builder_boost_factor: int = 100,
+    local_value_wei: int = 0,
+):
+    """The get_payload selection arm: -> ("local", payload) or
+    ("builder", header, value). builder_boost_factor is percent (100 =
+    straight comparison; 0 = never builder; the reference's
+    --builder-boost-factor semantics)."""
+    if builder_result is None or builder_boost_factor == 0:
+        return ("local", local_payload)
+    header, value = builder_result
+    if value * builder_boost_factor // 100 > local_value_wei:
+        return ("builder", header, value)
+    return ("local", local_payload)

@@ -1527,12 +1527,40 @@ class BeaconChain:
     # ------------------------------------------------------------ production
 
     def produce_block(
-        self, slot: int, randao_reveal: bytes = b"\x00" * 96, graffiti=None
+        self,
+        slot: int,
+        randao_reveal: bytes = b"\x00" * 96,
+        graffiti=None,
+        builder=None,
+        fee_recipient: bytes = b"\x00" * 20,
     ):
         """Block production on the canonical head with FULL bodies
         packed from the pools (operation_pool get_attestations max-cover
         + slashings/exits/bls changes + the naive pool's sync aggregate;
-        produce_block.rs role)."""
+        produce_block.rs role).
+
+        With `builder` (an execution.builder_client.BuilderClient), the
+        external-builder bid competes with the local payload
+        (produce_block_v3's builder arm): if the builder wins, a
+        BLINDED block is returned — sign it and hand the signed blinded
+        block to `process_blinded_block`, which reveals the payload and
+        imports the full block. ANY builder failure — transport, no
+        bid, or a consensus-invalid header — falls back to the local
+        payload. The bid fetch is bounded by the transport timeout and
+        keyed to a pre-lock head snapshot (a stale bid is dropped);
+        moving it fully off the lock needs the async production
+        pipeline (reference: execution_layer's block-production task)."""
+        builder_bid = None
+        if builder is not None:
+            # snapshot (parent_hash, head) OUTSIDE the main lock hold:
+            # the remote bid fetch below must not stall chain imports,
+            # and a bid is dropped if the head moves before packing
+            with self._lock:
+                head_root = self.head.root
+                parent_hash = bytes(
+                    self.head_state().latest_execution_payload_header.block_hash
+                )
+            builder_bid = (parent_hash, head_root)
         with self._lock:
             head_state = self.head_state()
             if head_state is None:
@@ -1565,7 +1593,9 @@ class BeaconChain:
             body.sync_aggregate = self.op_pool.get_sync_aggregate(
                 self.agg_pool, state, parent_root
             )
-            body.execution_payload = st.mock_execution_payload(self.spec, state)
+            local_payload = st.mock_execution_payload(self.spec, state)
+            local_payload.fee_recipient = bytes(fee_recipient)
+            body.execution_payload = local_payload
             block = T.BeaconBlock.make(
                 slot=slot,
                 proposer_index=proposer,
@@ -1573,9 +1603,49 @@ class BeaconChain:
                 state_root=b"\x00" * 32,
                 body=body,
             )
+            builder_header = None
+            if builder_bid is not None and builder_bid[1] == parent_root:
+                from ..execution.builder_client import (
+                    BuilderError,
+                    choose_payload,
+                )
+
+                pubkey = bytes(state.validators[proposer].pubkey)
+                try:
+                    bid = builder.get_header(slot, builder_bid[0], pubkey)
+                    chosen = choose_payload(local_payload, bid)
+                    if chosen[0] == "builder":
+                        builder_header = chosen[1]
+                except BuilderError:
+                    builder_header = None  # never fail production
+            if builder_header is not None:
+                try:
+                    bstate = state.copy()
+                    blinded = T.block_to_blinded(block)
+                    blinded.body.execution_payload_header = builder_header
+                    st.process_block(
+                        self.spec, bstate, blinded, verify_signatures=False
+                    )
+                    blinded.state_root = bstate.hash_tree_root()
+                    return blinded
+                except st.BlockProcessingError:
+                    pass  # consensus-invalid header: fall back to local
             st.process_block(self.spec, state, block, verify_signatures=False)
             block.state_root = state.hash_tree_root()
             return block
+
+    def process_blinded_block(self, signed_blinded, builder):
+        """publish_blocks.rs blinded arm: reveal the payload from the
+        builder, substitute it (header-root checked), then import the
+        full block. Returns the signed FULL block for gossip."""
+        from ..execution.builder_client import signed_blinded_to_json
+
+        payload = builder.submit_blinded_block(
+            signed_blinded_to_json(signed_blinded)
+        )
+        signed_full = T.blinded_to_full(signed_blinded, payload)
+        self.process_block(signed_full)
+        return signed_full
 
     # ------------------------------------------------------------ finality
 

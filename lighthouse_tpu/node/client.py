@@ -187,8 +187,11 @@ class ClientBuilder:
         if self._slasher:
             from ..slasher import Slasher, SlasherConfig
 
+            # persist on the node's KV engine (database/mod.rs role) —
+            # the same backend (native C++ or log store) the chain uses
             slasher = Slasher(
-                SlasherConfig(slots_per_epoch=self.spec.preset.slots_per_epoch)
+                SlasherConfig(slots_per_epoch=self.spec.preset.slots_per_epoch),
+                db=store.kv,
             )
         if self._resume:
             chain = BeaconChain.resume(

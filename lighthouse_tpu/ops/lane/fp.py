@@ -132,11 +132,18 @@ def _fold(x, mt):
 
 
 def _conv(a, b):
-    """Schoolbook limb product along the sublane axis -> [..., CONVW, S]."""
-    pads = [[(0, 0)] * (a.ndim - 2) + [(i, CONVW - W - i), (0, 0)] for i in range(W)]
-    acc = jnp.pad(a[..., 0:1, :] * b, pads[0])
+    """Schoolbook limb product along the sublane axis -> [..., CONVW, S].
+
+    One fixed zero-pad of b to CONVW rows, then W shifted
+    multiply-accumulates via jnp.roll on the sublane axis (a cheap
+    vector rotate; the zero rows make the cyclic wrap harmless for
+    shifts <= CONVW - W). Per-step pads of distinct shapes kept ~18
+    CONVW-wide temporaries live and blew Mosaic's 16 MB scoped-VMEM
+    stack on the f12-sized kernels."""
+    b73 = _pad_limbs(b, CONVW)
+    acc = a[..., 0:1, :] * b73
     for i in range(1, W):
-        acc = acc + jnp.pad(a[..., i : i + 1, :] * b, pads[i])
+        acc = acc + a[..., i : i + 1, :] * jnp.roll(b73, i, axis=-2)
     return acc
 
 
@@ -185,9 +192,11 @@ def kernel_op(fn, name: str):
     """
 
     def dispatch(*arrays, **kw):
-        if not use_pallas():
-            return fn(_FOLDS, _TOPFM, *arrays, **kw)
         S = arrays[0].shape[-1]
+        # tiny lane counts (the per-batch finish tail) pad to a full
+        # 128-lane tile inside Mosaic for no win — plain XLA is right
+        if not use_pallas() or S < 128:
+            return fn(_FOLDS, _TOPFM, *arrays, **kw)
         outs = jax.eval_shape(
             lambda *a: fn(_FOLDS, _TOPFM, *a, **kw), *arrays
         )
@@ -345,7 +354,11 @@ def pow_const(a, exponent: int):
 
     def step(carry, bit):
         acc, base = carry
-        acc = jnp.where(bit, mul(acc, base), acc)
+        # scalar per-step flag -> lax.cond: the multiply EXECUTES only
+        # on set bits (~half the steps), vs compute-and-select
+        acc = jax.lax.cond(
+            bit, lambda x, b: mul(x, b), lambda x, b: x, acc, base
+        )
         base = sqr(base)
         return (acc, base), None
 

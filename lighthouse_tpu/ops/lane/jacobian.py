@@ -1,0 +1,439 @@
+"""Lane-major Jacobian group ops for G1 (Fp) and G2 (Fp2) — fused kernels.
+
+Same formulas and completeness scheme as ops/jacobian.py (dbl-2009-l,
+add-2007-bl, structural Z == 0 infinity; see that module's doc for the
+collision-safety argument). Round-3 changes:
+
+- `double` and branchless `add` each run as ONE fused Pallas kernel
+  (~16 / ~40 Fp muls per call kept in VMEM, including the
+  infinity-propagation selects).
+- Scalar ladders over STATIC scalars (the curve parameter |u| used by
+  subgroup checks and cofactor clearing) are Python-unrolled: 63
+  doublings + hamming-weight(u)-1 = 5 adds, instead of a 64-step scan
+  computing a conditional add every step. blst does the same with its
+  hard-coded double-and-add chains (crypto/bls/src/impls/blst.rs).
+- Dynamic ladders (the 64-bit random-linear-combination scalars) remain
+  unrolled-by-64 with one conditional add per step.
+
+Points are (X, Y, Z) tuples of lane-major field arrays: Fp [..., W, S],
+Fp2 [..., 2, W, S].
+"""
+
+from types import SimpleNamespace
+
+import numpy as np
+import jax.numpy as jnp
+
+from ...crypto.bls import curve as C
+from . import fp, tower
+
+W = fp.W
+
+
+def _wh(flag, a, b, elem_ndim):
+    """Select by [..., S] flag over field arrays with elem_ndim trailing
+    element dims before the lane axis."""
+    f = flag[(..., *([None] * elem_ndim), slice(None))]
+    return jnp.where(f, a, b)
+
+
+# ------------------------------------------------------------ fused bodies
+
+
+def _dbl_body(folds, topf, X, Y, Z, f2: bool):
+    sq = tower._f2sqr_body if f2 else None
+
+    def S(v):
+        return (
+            tower._f2sqr_body(folds, topf, v)
+            if f2
+            else fp._sqr_fn(folds, topf, v)
+        )
+
+    def M(u, v):
+        return (
+            tower._f2mul_body(folds, topf, u, v)
+            if f2
+            else fp._mul_fn(folds, topf, u, v)
+        )
+
+    def RL(v):
+        return fp._reduce_light_body(v, folds, topf)
+
+    A = S(X)
+    Bv = S(Y)
+    Cv = S(Bv)
+    D = RL(S(X + Bv) - A - Cv)
+    D = D + D
+    E = A + A + A
+    F = S(E)
+    X3 = RL(F - D - D)
+    Y3 = RL(M(E, D - X3) - 8 * Cv)
+    Z3 = RL(2 * M(Y, Z))
+    return X3, Y3, Z3
+
+
+def _add_body(folds, topf, X1, Y1, Z1, X2, Y2, Z2, f2: bool):
+    def S(v):
+        return (
+            tower._f2sqr_body(folds, topf, v)
+            if f2
+            else fp._sqr_fn(folds, topf, v)
+        )
+
+    def M(u, v):
+        return (
+            tower._f2mul_body(folds, topf, u, v)
+            if f2
+            else fp._mul_fn(folds, topf, u, v)
+        )
+
+    def RL(v):
+        return fp._reduce_light_body(v, folds, topf)
+
+    Z1Z1 = S(Z1)
+    Z2Z2 = S(Z2)
+    U1 = M(X1, Z2Z2)
+    U2 = M(X2, Z1Z1)
+    S1 = M(M(Y1, Z2), Z2Z2)
+    S2 = M(M(Y2, Z1), Z1Z1)
+    H = U2 - U1
+    I = S(H + H)
+    J = M(H, I)
+    r = 2 * (S2 - S1)
+    V = M(U1, I)
+    X3 = RL(S(r) - J - 2 * V)
+    Y3 = RL(M(r, V - X3) - 2 * M(S1, J))
+    Z3 = RL(M(RL(S(Z1 + Z2) - Z1Z1 - Z2Z2), H))
+    # structural-infinity selection, inside the kernel (zero extra passes)
+    ncomp = 2 if f2 else 1
+    p1_inf = _is_zero(Z1, ncomp)
+    p2_inf = _is_zero(Z2, ncomp)
+    out = []
+    for a, b, o in ((X1, X2, X3), (Y1, Y2, Y3), (Z1, Z2, Z3)):
+        o = _wh(p1_inf, b, _wh(p2_inf, a, o, ncomp), ncomp)
+        out.append(o)
+    return tuple(out)
+
+
+def _is_zero(Z, ncomp):
+    axes = tuple(range(-1 - ncomp, -1))
+    return jnp.all(Z == 0, axis=axes)
+
+
+def _dbl_f1_body(folds, topf, X, Y, Z):
+    return _dbl_body(folds, topf, X, Y, Z, f2=False)
+
+
+def _dbl_f2_body(folds, topf, X, Y, Z):
+    return _dbl_body(folds, topf, X, Y, Z, f2=True)
+
+
+def _add_f1_body(folds, topf, *args):
+    return _add_body(folds, topf, *args, f2=False)
+
+
+def _add_f2_body(folds, topf, *args):
+    return _add_body(folds, topf, *args, f2=True)
+
+
+_dbl_f1 = fp.kernel_op(_dbl_f1_body, "jac_dbl_f1")
+_dbl_f2 = fp.kernel_op(_dbl_f2_body, "jac_dbl_f2")
+_add_f1 = fp.kernel_op(_add_f1_body, "jac_add_f1")
+_add_f2 = fp.kernel_op(_add_f2_body, "jac_add_f2")
+
+
+FP1 = SimpleNamespace(
+    name="fp",
+    ndim=1,
+    mul=lambda a, b: fp.mul(a, b),
+    sqr=lambda a: fp.sqr(a),
+    reduce=fp.reduce_light,
+    eq_zero=fp.eq_zero,
+    is_zero_struct=lambda a: _is_zero(a, 1),
+    wh=lambda f, a, b: _wh(f, a, b, 1),
+    zeros=lambda shape, S: jnp.zeros((*shape, W, S), dtype=jnp.int32),
+    dbl=_dbl_f1,
+    addk=_add_f1,
+)
+
+FP2 = SimpleNamespace(
+    name="fp2",
+    ndim=2,
+    mul=tower.f2mul,
+    sqr=tower.f2sqr,
+    reduce=fp.reduce_light,
+    eq_zero=tower.f2_eq_zero,
+    is_zero_struct=lambda a: _is_zero(a, 2),
+    wh=lambda f, a, b: _wh(f, a, b, 2),
+    zeros=lambda shape, S: jnp.zeros((*shape, 2, W, S), dtype=jnp.int32),
+    dbl=_dbl_f2,
+    addk=_add_f2,
+)
+
+
+# ---------------------------------------------------------------- host codecs
+
+
+def pack_g1(points) -> tuple:
+    """Affine points/None -> (X, Y, Z) [W, n] arrays; None -> Z = 0."""
+    xs = fp.pack([0 if pt is None else pt[0] for pt in points])
+    ys = fp.pack([0 if pt is None else pt[1] for pt in points])
+    zs = fp.pack([0 if pt is None else 1 for pt in points])
+    return (jnp.asarray(xs), jnp.asarray(ys), jnp.asarray(zs))
+
+
+def pack_g2(points) -> tuple:
+    z2 = (0, 0)
+    one2 = (1, 0)
+    xs = tower.f2_pack_many([z2 if pt is None else pt[0] for pt in points])
+    ys = tower.f2_pack_many([z2 if pt is None else pt[1] for pt in points])
+    zs = tower.f2_pack_many([z2 if pt is None else one2 for pt in points])
+    return (jnp.asarray(xs), jnp.asarray(ys), jnp.asarray(zs))
+
+
+def unpack_g1(pt):
+    """Device Jacobian point(s) -> list of affine tuples/None (host)."""
+    X, Y, Z = (np.asarray(a) for a in pt)
+    out = []
+    for s in range(X.shape[-1]):
+        zv = fp.from_limbs(Z[..., :, s])
+        if zv == 0:
+            out.append(None)
+            continue
+        zi = pow(zv, C.P - 2, C.P)
+        out.append(
+            (
+                fp.from_limbs(X[..., :, s]) * zi * zi % C.P,
+                fp.from_limbs(Y[..., :, s]) * zi * zi % C.P * zi % C.P,
+            )
+        )
+    return out
+
+
+def unpack_g2(pt):
+    from ...crypto.bls import fields as FF
+
+    X, Y, Z = (np.asarray(a) for a in pt)
+    out = []
+    for s in range(X.shape[-1]):
+        z = (fp.from_limbs(Z[0, :, s]), fp.from_limbs(Z[1, :, s]))
+        if z == (0, 0):
+            out.append(None)
+            continue
+        zi = FF.f2inv(z)
+        zi2 = FF.f2sqr(zi)
+        zi3 = FF.f2mul(zi2, zi)
+        x = (fp.from_limbs(X[0, :, s]), fp.from_limbs(X[1, :, s]))
+        y = (fp.from_limbs(Y[0, :, s]), fp.from_limbs(Y[1, :, s]))
+        out.append((FF.f2mul(x, zi2), FF.f2mul(y, zi3)))
+    return out
+
+
+# ---------------------------------------------------------------- core ops
+
+
+def double(ops, p):
+    return ops.dbl(*p)
+
+
+def add(ops, p1, p2, exact: bool = False):
+    """Fused branchless add; exact=True resolves H == 0 collisions
+    (doubling / infinity) with canonical compares — the aggregation-tree
+    safety net, composed at the XLA level since it is off the hot path."""
+    out = ops.addk(*p1, *p2)
+    if exact:
+        X1, Y1, Z1 = p1
+        X2, Y2, Z2 = p2
+        Z1Z1 = ops.sqr(Z1)
+        Z2Z2 = ops.sqr(Z2)
+        H = ops.mul(X2, Z1Z1) - ops.mul(X1, Z2Z2)
+        r = ops.mul(ops.mul(Y2, Z1), Z1Z1) - ops.mul(ops.mul(Y1, Z2), Z2Z2)
+        h_zero = ops.eq_zero(H)
+        r_zero = ops.eq_zero(r)
+        dbl = double(ops, p1)
+        S = p1[0].shape[-1]
+        shape = p1[0].shape[: p1[0].ndim - ops.ndim - 1]
+        inf = tuple(ops.zeros(shape, S) for _ in range(3))
+        both = h_zero & r_zero
+        # collision logic only applies when neither input is infinity
+        p1_inf = ops.is_zero_struct(Z1)
+        p2_inf = ops.is_zero_struct(Z2)
+        neither = ~(p1_inf | p2_inf)
+        out = tuple(
+            ops.wh(neither & both, d, ops.wh(neither & h_zero, i, o))
+            for d, i, o in zip(dbl, inf, out)
+        )
+    return out
+
+
+def neg(ops, p):
+    return (p[0], -p[1], p[2])
+
+
+def scalar_mul(ops, base, bits):
+    """[k]base for per-element scalars; bits int32/bool [nbits, S]
+    (LSB first), as a lax.scan (ONE fused dbl + add body in the HLO —
+    per-element bits force the conditional add to be computed and
+    selected every step)."""
+    import jax
+
+    S = base[0].shape[-1]
+    shape = base[0].shape[: base[0].ndim - ops.ndim - 1]
+    acc0 = tuple(ops.zeros(shape, S) for _ in range(3))
+
+    def step(carry, bit):
+        acc, addend = carry
+        added = add(ops, acc, addend)
+        acc = tuple(ops.wh(bit, a, o) for a, o in zip(added, acc))
+        addend = double(ops, addend)
+        return (acc, addend), None
+
+    (acc, _), _ = jax.lax.scan(step, (acc0, base), bits.astype(bool))
+    return acc
+
+
+def _static_bits_arr(scalar: int, nbits: int):
+    return np.array([(scalar >> i) & 1 for i in range(nbits)], np.bool_)
+
+
+def scalar_mul_static(ops, base, scalar: int):
+    """[scalar]base for a STATIC scalar: a scan whose conditional add
+    runs under lax.cond on a per-step SCALAR flag — the add body
+    executes only at the scalar's set bits (hamming weight of |u| is 6),
+    and appears once in the HLO."""
+    import jax
+
+    assert scalar > 0
+    nbits = scalar.bit_length()
+    S = base[0].shape[-1]
+    shape = base[0].shape[: base[0].ndim - ops.ndim - 1]
+    acc0 = tuple(ops.zeros(shape, S) for _ in range(3))
+
+    def step(carry, bit):
+        acc, addend = carry
+        acc = jax.lax.cond(
+            bit, lambda a, d: add(ops, a, d), lambda a, d: a, acc, addend
+        )
+        addend = double(ops, addend)
+        return (acc, addend), None
+
+    (acc, _), _ = jax.lax.scan(
+        step, (acc0, base), jnp.asarray(_static_bits_arr(scalar, nbits))
+    )
+    return acc
+
+
+def scalar_mul_with_static(ops, base, bits, static_scalar: int):
+    """([k]base, [static]base) sharing ONE doubling chain.
+
+    The dynamic accumulator pays a computed-and-selected add per step
+    (per-element bits); the static accumulator's add runs under
+    lax.cond and only executes at the static scalar's set bits."""
+    import jax
+
+    nbits = bits.shape[0]
+    S = base[0].shape[-1]
+    shape = base[0].shape[: base[0].ndim - ops.ndim - 1]
+    acc0 = tuple(ops.zeros(shape, S) for _ in range(3))
+    last = max(nbits, static_scalar.bit_length())
+    dyn_bits = jnp.concatenate(
+        [bits.astype(bool), jnp.zeros((last - nbits, S), bool)]
+    )
+    st_bits = jnp.asarray(_static_bits_arr(static_scalar, last))
+
+    def step(carry, xs):
+        bit, sbit = xs
+        acc, acc_s, addend = carry
+        added = add(ops, acc, addend)
+        acc = tuple(ops.wh(bit, a, o) for a, o in zip(added, acc))
+        acc_s = jax.lax.cond(
+            sbit, lambda a, d: add(ops, a, d), lambda a, d: a, acc_s, addend
+        )
+        addend = double(ops, addend)
+        return (acc, acc_s, addend), None
+
+    (acc, acc_s, _), _ = jax.lax.scan(
+        step, (acc0, acc0, base), (dyn_bits, st_bits)
+    )
+    return acc, acc_s
+
+
+def lane_sum(ops, p, n: int):
+    """Complete sum over the LANE axis: [..., W, S] -> [..., W, 1].
+
+    Tree reduction by lane halving: log2(S) exact adds, each over a
+    halved lane dim. Exact (complete) adds throughout — adversarial
+    equal/negated points fold correctly. Padding lanes (>= n) and any
+    pad to the next power of two enter as structural infinity (Z = 0)."""
+    S = p[0].shape[-1]
+    if n < S:
+        # zero out the padding lanes (Z=0 infinity contributes nothing)
+        mask = (jnp.arange(S) < n)[(None,) * (p[0].ndim - 1) + (slice(None),)]
+        p = tuple(jnp.where(mask, c, jnp.zeros_like(c)) for c in p)
+    full = 1 << (S - 1).bit_length()
+    if full != S:
+        p = tuple(
+            jnp.pad(c, [(0, 0)] * (c.ndim - 1) + [(0, full - S)]) for c in p
+        )
+        S = full
+    while S > 1:
+        half = S // 2
+        a = tuple(c[..., :half] for c in p)
+        b = tuple(c[..., half:] for c in p)
+        p = add(ops, a, b, exact=True)
+        S = half
+    return p
+
+
+def scalars_to_bits(scalars, nbits: int) -> np.ndarray:
+    """Host: python ints -> [nbits, n] int32 LSB-first bit matrix
+    (lane-major: bit index leads, batch on lanes)."""
+    out = np.zeros((nbits, len(scalars)), dtype=np.int32)
+    for i, s in enumerate(scalars):
+        for j in range(nbits):
+            out[j, i] = (s >> j) & 1
+    return out
+
+
+# ---------------------------------------------------------------- G2 psi
+
+_PSI_CX = None
+_PSI_CY = None
+
+
+def _psi_consts():
+    global _PSI_CX, _PSI_CY
+    if _PSI_CX is None:
+        from ...crypto.bls import fields as FF
+
+        _PSI_CX = tower.f2_pack(FF.PSI_CX)
+        _PSI_CY = tower.f2_pack(FF.PSI_CY)
+    return _PSI_CX, _PSI_CY
+
+
+def psi(p):
+    """G2 twist endomorphism: psi(X, Y, Z) = (cx X̄, cy Ȳ, Z̄)."""
+    cx, cy = _psi_consts()
+    X, Y, Z = p
+    S = X.shape[-1]
+    return (
+        tower.f2mul(tower.f2conj(X), tower.bcast(jnp.asarray(cx), S)),
+        tower.f2mul(tower.f2conj(Y), tower.bcast(jnp.asarray(cy), S)),
+        tower.f2conj(Z),
+    )
+
+
+def jac_eq(ops, p1, p2):
+    """Exact equality with infinity handling (both-inf == True)."""
+    X1, Y1, Z1 = p1
+    X2, Y2, Z2 = p2
+    Z1Z1 = ops.sqr(Z1)
+    Z2Z2 = ops.sqr(Z2)
+    ex = ops.eq_zero(ops.mul(X1, Z2Z2) - ops.mul(X2, Z1Z1))
+    ey = ops.eq_zero(
+        ops.mul(ops.mul(Y1, Z2), Z2Z2) - ops.mul(ops.mul(Y2, Z1), Z1Z1)
+    )
+    i1 = ops.is_zero_struct(Z1)
+    i2 = ops.is_zero_struct(Z2)
+    return jnp.where(i1 | i2, i1 & i2, ex & ey)

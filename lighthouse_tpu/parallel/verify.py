@@ -1,11 +1,13 @@
 """Sharded batch BLS verification over a jax.sharding.Mesh.
 
-Layout: all per-set inputs sharded on the leading batch axis; per-device
-`local_phase` (hash-to-curve, subgroup checks, ladders, local Miller
-product, local signature sum) needs NO communication; the cross-device
-step is one all_gather of an Fp12 value and one of a G2 point per batch
-— a few KB over ICI — then every device finishes redundantly (replicated
-final exp) so the verdict is replicated.
+Layout: all per-set inputs sharded on the TRAILING lane axis (the
+round-3 lane-major layout — batch rides the 128-wide lane dimension,
+ops/lane/__init__.py); per-device `local_phase` (hash-to-curve,
+subgroup checks, ladders, local Miller product, local signature sum)
+needs NO communication; the cross-device step is one all_gather of an
+Fp12 value and one of a G2 point per batch — a few KB over ICI — then
+every device finishes redundantly (replicated final exp) so the verdict
+is replicated.
 
 This is the scaling seam BASELINE.json names ("shards SignatureSet
 batches across a TPU pod slice"): throughput scales with devices because
@@ -21,7 +23,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..crypto.bls.backends import tpu as TB
-from ..ops import jacobian as J, pairing as OP
+from ..ops.lane import jacobian as J, pairing as OP
 
 
 def _shard_map(f, *, mesh, in_specs, out_specs, relaxed_replication):
@@ -55,10 +57,22 @@ def make_mesh(n_devices: int = None) -> Mesh:
 
 def sharded_verify_fn(mesh: Mesh):
     """Build the jitted sharded verifier for `mesh`. Inputs are the same
-    8 arrays as backends.tpu._verify_kernel; batch divisible by mesh
-    size (bucketing already pads to powers of two)."""
+    8 arrays as backends.tpu._verify_kernel (lane-major: batch on the
+    trailing axis); batch divisible by mesh size (bucketing already pads
+    to powers of two)."""
     ndev = mesh.devices.size
-    spec = P("batch")
+    # shard every array on its trailing (lane) axis
+    last = lambda r: P(*([None] * (r - 1) + ["batch"]))
+    in_specs = (
+        last(2),  # apk_x [W, S]
+        last(2),  # apk_y
+        last(3),  # sig_x [2, W, S]
+        last(3),  # sig_y
+        last(3),  # t0
+        last(3),  # t1
+        last(2),  # rbits [64, S]
+        last(1),  # pad [S]
+    )
 
     # check_vma off: the kernel's scan carries are zeros-initialized
     # inside the shard (unvarying) while bodies produce batch-varying
@@ -69,7 +83,7 @@ def sharded_verify_fn(mesh: Mesh):
     @partial(
         _shard_map,
         mesh=mesh,
-        in_specs=(spec,) * 8,
+        in_specs=in_specs,
         out_specs=P(),
         relaxed_replication=True,
     )
@@ -77,13 +91,16 @@ def sharded_verify_fn(mesh: Mesh):
         f_local, s_local, sub_ok = TB.local_phase(
             apk_x, apk_y, sig_x, sig_y, t0, t1, rbits, pad
         )
-        # cross-device: gather tiny partials, finish redundantly
-        f_all = jax.lax.all_gather(f_local, "batch")        # [ndev, ...]
-        f_prod = OP.f12_product_tree(f_all, ndev)
+        # cross-device: gather tiny partials onto the lane axis, finish
+        # redundantly. all_gather(axis=-1, tiled) turns the [.., 1]
+        # per-device partials into [.., ndev] lane stacks.
+        f_all = jax.lax.all_gather(f_local, "batch", axis=f_local.ndim - 1, tiled=True)
+        f_prod = OP.lane_product(f_all, ndev)
         s_all = tuple(
-            jax.lax.all_gather(c, "batch") for c in s_local
+            jax.lax.all_gather(c, "batch", axis=c.ndim - 1, tiled=True)
+            for c in s_local
         )
-        s_agg = J.sum_tree(J.FP2, s_all, ndev)
+        s_agg = J.lane_sum(J.FP2, s_all, ndev)
         ok_all = jnp.all(jax.lax.all_gather(sub_ok, "batch"))
         return TB.finish_phase(f_prod, s_agg, ok_all)
 

@@ -1,0 +1,167 @@
+"""Persistent slasher storage over the node's KV engine
+(slasher/src/database/mod.rs analog; backends there are MDBX/LMDB/redb —
+here any lighthouse_tpu.node.store.KVStore, including the native C++
+engine, native/kvstore.cpp).
+
+Layout (array.rs's chunked min/max targets, made durable):
+
+  column b"slc" — min/max-target chunks:
+      key = validator u64be || chunk_index u32be
+      val = CHUNK x (min_target i64le || max_target i64le)
+    Chunks are CHUNK epochs wide in WINDOW coordinates; only dirty
+    chunks are rewritten on update (the reference's chunked write
+    batching, array.rs).
+
+  column b"slo" — per-validator window offset:
+      key = validator u64be ; val = offset u64le
+
+  column b"sla" — recorded attestations:
+      key = validator u64be || target u64be
+      val = data_root 32B || source u64le || ssz(IndexedAttestation)
+
+  column b"slp" — proposals:
+      key = proposer u64be || slot u64be ; val = ssz(SignedHeader)
+
+  column b"slq" — ingest queue (crash replay):
+      key = kind 1B || seq u64be ; val = ssz payload
+    Entries are appended by queue_* and deleted after process_queued
+    commits its batch — a restart replays anything still queued
+    (attestation_queue.rs durability the reference gets from running
+    detection inside a txn).
+"""
+
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+from ..consensus import types as T
+
+CHUNK = 256
+
+
+class SlasherDB:
+    """Thin column codec over a KVStore; the Slasher owns the policy."""
+
+    def __init__(self, kv):
+        self.kv = kv
+        self._seq = 0
+        for key in self.kv.keys(b"slq"):
+            self._seq = max(self._seq, struct.unpack(">Q", key[1:9])[0] + 1)
+
+    # ------------------------------------------------------------ chunks
+
+    def load_history(self, v: int, window: int):
+        """-> (min_targets, max_targets, offset) or None if absent."""
+        off_raw = self.kv.get(b"slo", struct.pack(">Q", v))
+        if off_raw is None:
+            return None
+        n_chunks = -(-window // CHUNK)
+        mins = np.full(n_chunks * CHUNK, np.iinfo(np.int64).max, np.int64)
+        maxs = np.full(n_chunks * CHUNK, -1, np.int64)
+        for c in range(n_chunks):
+            raw = self.kv.get(b"slc", struct.pack(">QI", v, c))
+            if raw is None:
+                continue
+            arr = np.frombuffer(raw, dtype=np.int64).reshape(-1, 2)
+            mins[c * CHUNK : c * CHUNK + len(arr)] = arr[:, 0]
+            maxs[c * CHUNK : c * CHUNK + len(arr)] = arr[:, 1]
+        return (
+            mins[:window].copy(),
+            maxs[:window].copy(),
+            struct.unpack("<Q", off_raw)[0],
+        )
+
+    def store_chunks(self, v: int, mins, maxs, offset: int, dirty) -> None:
+        """Write offset + the dirty chunk set (None -> all chunks)."""
+        self.kv.put(b"slo", struct.pack(">Q", v), struct.pack("<Q", offset))
+        window = len(mins)
+        chunks = (
+            range(-(-window // CHUNK)) if dirty is None else sorted(dirty)
+        )
+        for c in chunks:
+            lo = c * CHUNK
+            hi = min(lo + CHUNK, window)
+            arr = np.empty((hi - lo, 2), np.int64)
+            arr[:, 0] = mins[lo:hi]
+            arr[:, 1] = maxs[lo:hi]
+            self.kv.put(
+                b"slc", struct.pack(">QI", v, c), arr.tobytes()
+            )
+
+    # ------------------------------------------------------- attestations
+
+    def store_attestation(self, v: int, target: int, root: bytes, source: int, att) -> None:
+        self.kv.put(
+            b"sla",
+            struct.pack(">QQ", v, target),
+            bytes(root) + struct.pack("<Q", source) + T.IndexedAttestation.serialize(att),
+        )
+
+    def load_attestations(self, v: int):
+        """-> list of (target, root, source, att) for validator v."""
+        out = []
+        prefix = struct.pack(">Q", v)
+        for key in list(self.kv.keys(b"sla")):
+            if not key.startswith(prefix):
+                continue
+            target = struct.unpack(">Q", key[8:16])[0]
+            raw = self.kv.get(b"sla", key)
+            if raw is None:
+                continue
+            root = raw[:32]
+            source = struct.unpack("<Q", raw[32:40])[0]
+            att = T.IndexedAttestation.deserialize(raw[40:])
+            out.append((target, root, source, att))
+        return out
+
+    def delete_attestation(self, v: int, target: int) -> None:
+        self.kv.delete(b"sla", struct.pack(">QQ", v, target))
+
+    # ---------------------------------------------------------- proposals
+
+    def store_proposal(self, proposer: int, slot: int, signed_header) -> None:
+        self.kv.put(
+            b"slp",
+            struct.pack(">QQ", proposer, slot),
+            T.SignedBeaconBlockHeader.serialize(signed_header),
+        )
+
+    def load_proposals(self):
+        out = {}
+        for key in list(self.kv.keys(b"slp")):
+            raw = self.kv.get(b"slp", key)
+            if raw is None:
+                continue
+            proposer, slot = struct.unpack(">QQ", key)
+            sh = T.SignedBeaconBlockHeader.deserialize(raw)
+            out[(proposer, slot)] = (
+                T.BeaconBlockHeader.hash_tree_root(sh.message),
+                sh,
+            )
+        return out
+
+    def delete_proposal(self, proposer: int, slot: int) -> None:
+        self.kv.delete(b"slp", struct.pack(">QQ", proposer, slot))
+
+    # -------------------------------------------------------------- queue
+
+    def enqueue(self, kind: bytes, payload: bytes) -> bytes:
+        key = kind + struct.pack(">Q", self._seq)
+        self._seq += 1
+        self.kv.put(b"slq", key, payload)
+        return key
+
+    def drain_queue(self):
+        """-> list of (kind, payload, key), oldest first."""
+        keys = sorted(self.kv.keys(b"slq"), key=lambda k: k[1:9])
+        out = []
+        for key in keys:
+            raw = self.kv.get(b"slq", key)
+            if raw is not None:
+                out.append((key[:1], raw, key))
+        return out
+
+    def dequeue(self, key: bytes) -> None:
+        self.kv.delete(b"slq", key)

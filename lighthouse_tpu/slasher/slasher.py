@@ -17,6 +17,13 @@ Ingest is queue-then-batch like the reference: `queue_attestation` /
 `queue_block_header` buffer, `process_queued` runs detection for the
 whole batch (slasher/service ties this to block import,
 beacon_chain.rs:4306).
+
+Persistence (slasher/src/database/mod.rs role): pass `db` (any
+node.store.KVStore — the native C++ engine included) and every vote,
+proposal and min/max-target chunk is written through via
+slasher/database.py; queued-but-unprocessed items are journaled and
+REPLAYED on restart, and per-validator history is lazily reloaded, so a
+surround vote recorded before a restart is still detected after it.
 """
 
 from __future__ import annotations
@@ -46,6 +53,8 @@ class _ValidatorHistory:
     # the absolute epoch arrays[0] represents: the window SLIDES as the
     # chain advances (no wraparound blind spot past history_length)
     offset: int = 0
+    # window-chunk indices touched since the last flush
+    dirty: set = field(default_factory=set)
     # target_epoch -> (data_root, attestation) for double votes +
     # materializing slashings
     by_target: dict = field(default_factory=dict)
@@ -54,7 +63,7 @@ class _ValidatorHistory:
 
 
 class Slasher:
-    def __init__(self, config: SlasherConfig = None):
+    def __init__(self, config: SlasherConfig = None, db=None):
         self.config = config or SlasherConfig()
         self._validators: dict[int, _ValidatorHistory] = {}
         # (proposer, slot) -> (header_root, signed_header)
@@ -64,15 +73,42 @@ class Slasher:
         # detected slashings, deduped by content root
         self.attester_slashings: dict[bytes, object] = {}
         self.proposer_slashings: dict[bytes, object] = {}
+        self.db = None
+        if db is not None:
+            from .database import SlasherDB
+
+            self.db = SlasherDB(db) if not isinstance(db, SlasherDB) else db
+            self._proposals = self.db.load_proposals()
+            # crash replay: anything journaled but not processed
+            for kind, payload, key in self.db.drain_queue():
+                if kind == b"a":
+                    self._att_queue.append(
+                        (T.IndexedAttestation.deserialize(payload), key)
+                    )
+                else:
+                    self._block_queue.append(
+                        (T.SignedBeaconBlockHeader.deserialize(payload), key)
+                    )
 
     # ------------------------------------------------------------ ingest
 
     def queue_attestation(self, indexed_att) -> None:
-        """Batch ingest buffer (attestation_queue.rs)."""
-        self._att_queue.append(indexed_att)
+        """Batch ingest buffer (attestation_queue.rs); journaled when
+        persistent so a crash between queue and process replays it."""
+        key = None
+        if self.db is not None:
+            key = self.db.enqueue(
+                b"a", T.IndexedAttestation.serialize(indexed_att)
+            )
+        self._att_queue.append((indexed_att, key))
 
     def queue_block_header(self, signed_header) -> None:
-        self._block_queue.append(signed_header)
+        key = None
+        if self.db is not None:
+            key = self.db.enqueue(
+                b"b", T.SignedBeaconBlockHeader.serialize(signed_header)
+            )
+        self._block_queue.append((signed_header, key))
 
     def process_queued(self) -> tuple:
         """Drain the queues; returns (new_attester_slashings,
@@ -80,13 +116,38 @@ class Slasher:
         new_att, new_prop = [], []
         atts, self._att_queue = self._att_queue, []
         blocks, self._block_queue = self._block_queue, []
-        for ia in atts:
+        for ia, _ in atts:
             new_att.extend(self._process_attestation(ia))
-        for sh in blocks:
+        for sh, _ in blocks:
             s = self._process_block_header(sh)
             if s is not None:
                 new_prop.append(s)
+        # commit order: chunks/attestations FIRST, then the journal —
+        # a crash in between replays (idempotent) rather than losing
+        # votes from the on-disk detection arrays
+        self._flush_dirty()
+        if self.db is not None:
+            for _, key in atts:
+                if key is not None:
+                    self.db.dequeue(key)
+            for _, key in blocks:
+                if key is not None:
+                    self.db.dequeue(key)
         return new_att, new_prop
+
+    def _flush_dirty(self) -> None:
+        if self.db is None:
+            return
+        for v, hist in self._validators.items():
+            if hist.dirty:
+                self.db.store_chunks(
+                    v,
+                    hist.min_targets,
+                    hist.max_targets,
+                    hist.offset,
+                    hist.dirty,
+                )
+                hist.dirty.clear()
 
     # ------------------------------------------------------------ blocks
 
@@ -97,6 +158,8 @@ class Slasher:
         prev = self._proposals.get(key)
         if prev is None:
             self._proposals[key] = (root, signed_header)
+            if self.db is not None:
+                self.db.store_proposal(key[0], key[1], signed_header)
             return None
         prev_root, prev_signed = prev
         if prev_root == root:
@@ -116,10 +179,21 @@ class Slasher:
         hist = self._validators.get(v)
         if hist is None:
             w = self.config.history_length
-            hist = self._validators[v] = _ValidatorHistory(
-                min_targets=np.full(w, _NO_MIN, dtype=np.int64),
-                max_targets=np.full(w, _NO_MAX, dtype=np.int64),
-            )
+            loaded = self.db.load_history(v, w) if self.db else None
+            if loaded is not None:
+                mins, maxs, offset = loaded
+                hist = _ValidatorHistory(
+                    min_targets=mins, max_targets=maxs, offset=offset
+                )
+                for target, root, source, att in self.db.load_attestations(v):
+                    hist.by_target[target] = (bytes(root), att)
+                    hist.votes.append((source, target))
+            else:
+                hist = _ValidatorHistory(
+                    min_targets=np.full(w, _NO_MIN, dtype=np.int64),
+                    max_targets=np.full(w, _NO_MAX, dtype=np.int64),
+                )
+            self._validators[v] = hist
         return hist
 
     def _slide_window(self, hist: _ValidatorHistory, epoch: int) -> None:
@@ -139,6 +213,10 @@ class Slasher:
             hist.max_targets[:-shift] = hist.max_targets[shift:]
             hist.max_targets[-shift:] = _NO_MAX
         hist.offset += shift
+        if self.db is not None:
+            from .database import CHUNK
+
+            hist.dirty.update(range(0, -(-w // CHUNK)))
 
     def _process_attestation(self, indexed_att) -> list:
         data = indexed_att.data
@@ -177,13 +255,31 @@ class Slasher:
                 hist.by_target[target] = (root, indexed_att)
                 hist.votes.append((source, target))
                 lo_end = max(0, min(idx, w))
+                changed = []
                 if lo_end > 0:
                     lo = hist.min_targets[:lo_end]
+                    if self.db is not None:
+                        changed.append(np.flatnonzero(lo > target))
                     np.minimum(lo, target, out=lo)
                 hi_start = max(0, idx + 1)
                 if hi_start < w:
                     hi = hist.max_targets[hi_start:]
+                    if self.db is not None:
+                        changed.append(
+                            np.flatnonzero(hi < target) + hi_start
+                        )
                     np.maximum(hi, target, out=hi)
+                if self.db is not None:
+                    self.db.store_attestation(
+                        v, target, root, source, indexed_att
+                    )
+                    from .database import CHUNK
+
+                    for arr in changed:
+                        if len(arr):
+                            hist.dirty.update(
+                                range(arr[0] // CHUNK, arr[-1] // CHUNK + 1)
+                            )
         return [s for s in found if s is not None]
 
     def _find_vote(self, hist: _ValidatorHistory, pred):
@@ -217,13 +313,19 @@ class Slasher:
     def prune(self, current_epoch: int) -> None:
         """Drop history beyond the window (migrate.rs role)."""
         cutoff = max(0, current_epoch - self.config.history_length)
-        for hist in self._validators.values():
+        for v, hist in self._validators.items():
             hist.votes = [(s, t) for s, t in hist.votes if t >= cutoff]
-            hist.by_target = {
-                t: e for t, e in hist.by_target.items() if t >= cutoff
-            }
-        self._proposals = {
-            k: v
-            for k, v in self._proposals.items()
-            if k[1] >= cutoff * self.config.slots_per_epoch
-        }
+            dropped = [t for t in hist.by_target if t < cutoff]
+            for t in dropped:
+                del hist.by_target[t]
+                if self.db is not None:
+                    self.db.delete_attestation(v, t)
+        dropped_props = [
+            k
+            for k in self._proposals
+            if k[1] < cutoff * self.config.slots_per_epoch
+        ]
+        for k in dropped_props:
+            del self._proposals[k]
+            if self.db is not None:
+                self.db.delete_proposal(k[0], k[1])

@@ -1,0 +1,129 @@
+"""Preparation service — validator_services/src/preparation_service.rs.
+
+Two duties, both ahead of proposal slots:
+
+1. **Fee-recipient preparation**: push (validator_index, fee_recipient)
+   for every managed validator to the BN each epoch (the BN forwards
+   them into payload attributes / prepare_beacon_proposer).
+2. **Builder registration**: when an external builder is configured,
+   sign ValidatorRegistrationData (DOMAIN_APPLICATION_BUILDER, epoch-
+   independent domain) per validator and submit the batch to the
+   builder (via the BN in the reference; directly to the builder client
+   here — same wire contract).
+
+Registrations are re-sent when stale (the reference refreshes every
+epoch; builders expire them)."""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+from ..consensus import types as T
+from ..consensus.domains import compute_domain, compute_signing_root
+
+# builder specs: domain type 0x00000001, genesis fork, empty root
+DOMAIN_APPLICATION_BUILDER = bytes.fromhex("00000001")
+DEFAULT_GAS_LIMIT = 30_000_000
+
+
+class PreparationService:
+    def __init__(
+        self,
+        spec,
+        store,
+        beacon_node=None,
+        builder_client=None,
+        fee_recipient_for: Optional[Callable] = None,
+        default_fee_recipient: bytes = b"\x00" * 20,
+        now: Callable = None,
+    ):
+        self.spec = spec
+        self.store = store
+        self.bn = beacon_node
+        self.builder = builder_client
+        self.fee_recipient_for = fee_recipient_for or (
+            lambda pk: default_fee_recipient
+        )
+        self._now = now or (lambda: int(time.time()))
+        self._registered_at: dict[bytes, int] = {}
+
+    # ------------------------------------------------------------ duties
+
+    def prepare_proposers(self) -> list:
+        """(index-less) fee-recipient preparation batch -> BN."""
+        prep = []
+        for pk in self.store.pubkeys():
+            prep.append(
+                {
+                    "pubkey": bytes(pk),
+                    "fee_recipient": bytes(self.fee_recipient_for(pk)),
+                }
+            )
+        if self.bn is not None and hasattr(self.bn, "prepare_proposers"):
+            self.bn.prepare_proposers(prep)
+        return prep
+
+    def register_with_builder(self, epoch: int) -> int:
+        """Sign + submit builder registrations for all managed keys.
+        Returns the number submitted (0 when no builder configured)."""
+        if self.builder is None:
+            return 0
+        regs = []
+        now = self._now()
+        for pk in self.store.pubkeys():
+            if self._registered_at.get(bytes(pk)) == epoch:
+                continue  # fresh this epoch
+            reg = T.ValidatorRegistrationData.make(
+                fee_recipient=bytes(self.fee_recipient_for(pk)),
+                gas_limit=DEFAULT_GAS_LIMIT,
+                timestamp=now,
+                pubkey=bytes(pk),
+            )
+            domain = compute_domain(
+                DOMAIN_APPLICATION_BUILDER,
+                self.spec.genesis_fork_version,
+                b"\x00" * 32,
+            )
+            root = compute_signing_root(
+                T.ValidatorRegistrationData.make(
+                    fee_recipient=bytes(reg.fee_recipient),
+                    gas_limit=int(reg.gas_limit),
+                    timestamp=int(reg.timestamp),
+                    pubkey=bytes(reg.pubkey),
+                ),
+                domain,
+            )
+            sig = self.store.sign_application(bytes(pk), root)
+            regs.append(
+                (
+                    bytes(pk),
+                    {
+                        "pubkey": "0x" + bytes(pk).hex(),
+                        "fee_recipient": "0x"
+                        + bytes(reg.fee_recipient).hex(),
+                        "gas_limit": str(DEFAULT_GAS_LIMIT),
+                        "timestamp": str(now),
+                        "signature": "0x" + sig.to_bytes().hex(),
+                    },
+                )
+            )
+        if regs:
+            # mark registered only AFTER the submit succeeds, so a
+            # failed batch is retried on the next tick of the epoch
+            self.builder.register_validators([r for _, r in regs])
+            for pk, _ in regs:
+                self._registered_at[pk] = epoch
+        return len(regs)
+
+    def on_epoch(self, epoch: int) -> None:
+        """Epoch tick: failures are contained (the reference logs and
+        retries next epoch; registration retries NEXT TICK since
+        _registered_at is only advanced on success)."""
+        from ..execution.builder_client import BuilderError
+
+        self.prepare_proposers()
+        try:
+            self.register_with_builder(epoch)
+        except BuilderError:
+            pass

@@ -111,11 +111,24 @@ class ValidatorStore:
         except SlashingProtectionError:
             SLASHING_VETOES.inc()
             raise
-        signed = T.SignedBeaconBlock.make(
-            message=block, signature=m.sign(root).to_bytes()
+        wrapper = (
+            T.SignedBlindedBeaconBlock
+            if hasattr(block.body, "execution_payload_header")
+            else T.SignedBeaconBlock
         )
+        signed = wrapper.make(message=block, signature=m.sign(root).to_bytes())
         SIGNED_BLOCKS.inc()
         return signed
+
+    def sign_application(self, pubkey: bytes, signing_root: bytes):
+        """Non-consensus application signature (builder registration,
+        DOMAIN_APPLICATION_BUILDER): no slashing protection applies, and
+        the doppelganger hold does not block it (the reference signs
+        registrations during the doppelganger window too)."""
+        m = self._signers.get(bytes(pubkey))
+        if m is None:
+            raise KeyError("unknown validator")
+        return m.sign(signing_root)
 
     def sign_attestation(self, pubkey: bytes, data, fork) -> bytes:
         """Slashing-gated attestation signature (sign_attestation);

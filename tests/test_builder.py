@@ -1,0 +1,169 @@
+"""Builder/MEV path (builder_client/src/lib.rs + execution_layer payload
+selection + preparation_service.rs analogs) against the mock builder."""
+
+import pytest
+
+from lighthouse_tpu.consensus import state_transition as st
+from lighthouse_tpu.consensus import types as T
+from lighthouse_tpu.consensus.spec import mainnet_spec
+from lighthouse_tpu.crypto.bls.keys import SecretKey
+from lighthouse_tpu.execution.builder_client import (
+    BuilderClient,
+    BuilderError,
+    MockBuilder,
+    choose_payload,
+)
+from lighthouse_tpu.node.beacon_chain import BeaconChain
+from lighthouse_tpu.validator import LocalKeystoreSigner, ValidatorStore
+from lighthouse_tpu.validator.preparation_service import PreparationService
+
+N = 16
+SPEC = mainnet_spec()
+
+
+def _chain():
+    keys = [SecretKey.from_seed(i.to_bytes(4, "big")) for i in range(N)]
+    genesis = st.interop_genesis_state(
+        SPEC, [k.public_key().to_bytes() for k in keys]
+    )
+    return keys, BeaconChain(SPEC, genesis, bls_backend="fake")
+
+
+def _builder_for(chain, value=10**18):
+    """Mock builder producing chain-consistent payloads (a real builder
+    tracks the chain; the mock borrows the chain's state)."""
+
+    def payload_fn(slot, parent_hash):
+        state = chain.head_state().copy()
+        if state.slot < slot:
+            st.process_slots(SPEC, state, slot)
+        p = st.mock_execution_payload(SPEC, state)
+        p.extra_data = b"mev-builder"
+        p.transactions = [b"\xfe\xed"]
+        return p
+
+    mock = MockBuilder(bid_value_wei=value, payload_fn=payload_fn)
+    return mock, BuilderClient(transport=mock.request)
+
+
+def test_header_roundtrip_and_bid():
+    keys, chain = _chain()
+    mock, client = _builder_for(chain)
+    pk = keys[0].public_key().to_bytes()
+    client.register_validators(
+        [{"pubkey": "0x" + pk.hex(), "fee_recipient": "0x" + "aa" * 20,
+          "gas_limit": "30000000", "timestamp": "1", "signature": "0x" + "00" * 96}]
+    )
+    parent = bytes(chain.head_state().latest_execution_payload_header.block_hash)
+    bid = client.get_header(1, parent, pk)
+    assert bid is not None
+    header, value = bid
+    assert value == 10**18
+    assert bytes(header.parent_hash) == parent
+
+
+def test_no_bid_and_failure_fall_back_to_local():
+    local = object()
+    assert choose_payload(local, None)[0] == "local"
+    # low bid loses to valued local payload
+    hdr = object()
+    assert choose_payload(local, (hdr, 5), local_value_wei=10)[0] == "local"
+    assert choose_payload(local, (hdr, 20), local_value_wei=10)[0] == "builder"
+    # boost factor 0 disables the builder entirely
+    assert choose_payload(local, (hdr, 10**20), builder_boost_factor=0)[0] == "local"
+
+
+def test_produce_blinded_sign_reveal_import_roundtrip():
+    """produce_block chooses the builder bid -> blinded block; signing
+    commits to the revealed full block; process_blinded_block unblinds
+    via the builder and imports (publish_blocks.rs blinded arm)."""
+    keys, chain = _chain()
+    mock, client = _builder_for(chain)
+    pks = {k.public_key().to_bytes(): k for k in keys}
+    for pk in pks:
+        client.register_validators(
+            [{"pubkey": "0x" + pk.hex(), "fee_recipient": "0x" + "aa" * 20,
+              "gas_limit": "30000000", "timestamp": "1", "signature": "0x" + "00" * 96}]
+        )
+    chain.on_slot(1)
+    sig = b"\xc0" + b"\x00" * 95  # parseable; fake backend accepts
+    blinded = chain.produce_block(1, randao_reveal=sig, builder=client)
+    assert hasattr(blinded.body, "execution_payload_header"), (
+        "builder bid should have produced a blinded block"
+    )
+    assert bytes(blinded.body.execution_payload_header.extra_data) == b"mev-builder"
+
+    # blinded/full body roots agree (the signature commits to both)
+    store = ValidatorStore(SPEC, chain.genesis_validators_root)
+    proposer_pk = bytes(
+        chain.head_state().validators[int(blinded.proposer_index)].pubkey
+    )
+    store.add_validator(LocalKeystoreSigner(pks[proposer_pk]))
+    fork = chain.head_state().fork
+    signed_blinded = store.sign_block(proposer_pk, blinded, fork)
+    assert signed_blinded._type is T.SignedBlindedBeaconBlock
+
+    signed_full = chain.process_blinded_block(signed_blinded, client)
+    assert bytes(signed_full.message.body.execution_payload.extra_data) == b"mev-builder"
+    assert chain.head.slot == 1
+    # the revealed block's root is the blinded block's root
+    assert T.BeaconBlock.hash_tree_root(
+        signed_full.message
+    ) == T.BlindedBeaconBlock.hash_tree_root(blinded)
+
+
+def test_builder_down_production_still_succeeds():
+    keys, chain = _chain()
+    mock, client = _builder_for(chain)
+    mock.missing = True
+    chain.on_slot(1)
+    block = chain.produce_block(1, builder=client)
+    assert not hasattr(block.body, "execution_payload_header")
+
+
+def test_withheld_payload_rejected_without_import():
+    keys, chain = _chain()
+    mock, client = _builder_for(chain)
+    pks = {k.public_key().to_bytes(): k for k in keys}
+    for pk in pks:
+        client.register_validators(
+            [{"pubkey": "0x" + pk.hex(), "fee_recipient": "0x" + "aa" * 20,
+              "gas_limit": "30000000", "timestamp": "1", "signature": "0x" + "00" * 96}]
+        )
+    chain.on_slot(1)
+    sig = b"\xc0" + b"\x00" * 95  # parseable; fake backend accepts
+    blinded = chain.produce_block(1, randao_reveal=sig, builder=client)
+    store = ValidatorStore(SPEC, chain.genesis_validators_root)
+    proposer_pk = bytes(
+        chain.head_state().validators[int(blinded.proposer_index)].pubkey
+    )
+    store.add_validator(LocalKeystoreSigner(pks[proposer_pk]))
+    signed_blinded = store.sign_block(
+        proposer_pk, blinded, chain.head_state().fork
+    )
+    mock.fail_reveal = True
+    with pytest.raises(BuilderError):
+        chain.process_blinded_block(signed_blinded, client)
+    assert chain.head.slot == 0  # nothing imported
+
+
+def test_preparation_service_registers_once_per_epoch():
+    keys, chain = _chain()
+    mock, client = _builder_for(chain)
+    store = ValidatorStore(SPEC, chain.genesis_validators_root)
+    for k in keys[:4]:
+        store.add_validator(LocalKeystoreSigner(k))
+    svc = PreparationService(
+        SPEC,
+        store,
+        builder_client=client,
+        default_fee_recipient=b"\xaa" * 20,
+        now=lambda: 1234,
+    )
+    assert svc.register_with_builder(epoch=0) == 4
+    assert len(mock.registrations) == 4
+    # idempotent within the epoch, refreshed on the next
+    assert svc.register_with_builder(epoch=0) == 0
+    assert svc.register_with_builder(epoch=1) == 4
+    prep = svc.prepare_proposers()
+    assert len(prep) == 4 and prep[0]["fee_recipient"] == b"\xaa" * 20

@@ -1,0 +1,158 @@
+"""Lane-major jacobian/htc/pairing (ops/lane/*) vs the host oracles."""
+
+import secrets
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from lighthouse_tpu.crypto.bls import params, curve as C
+from lighthouse_tpu.crypto.bls import fields as FF, pairing_fast as PF
+from lighthouse_tpu.crypto.bls import hash_to_curve as H2C
+from lighthouse_tpu.ops.lane import fp as L, tower as T, jacobian as J
+from lighthouse_tpu.ops.lane import htc as HT, pairing as OP
+
+
+def rand_g1(n):
+    return [C.g1_mul(C.G1_GEN, secrets.randbits(200) % params.R) for _ in range(n)]
+
+
+def rand_g2(n):
+    return [C.g2_mul(C.G2_GEN, secrets.randbits(200) % params.R) for _ in range(n)]
+
+
+class TestLaneJacobian:
+    def test_pack_unpack_roundtrip(self):
+        pts1 = rand_g1(3) + [None]
+        pts2 = rand_g2(3) + [None]
+        assert J.unpack_g1(J.pack_g1(pts1)) == pts1
+        assert J.unpack_g2(J.pack_g2(pts2)) == pts2
+
+    def test_double(self):
+        pts1 = rand_g1(4) + [None]
+        pts2 = rand_g2(2) + [None]
+        got1 = J.unpack_g1(J.double(J.FP1, J.pack_g1(pts1)))
+        got2 = J.unpack_g2(J.double(J.FP2, J.pack_g2(pts2)))
+        assert got1 == [C.g1_double(p) for p in pts1]
+        assert got2 == [C.g2_double(p) for p in pts2]
+
+    def test_add_generic_inf_and_collisions(self):
+        a = rand_g1(4)
+        b = rand_g1(4)
+        cases_a = a + [None, a[0], None, a[1], a[2]]
+        cases_b = b + [b[0], None, None, a[1], C.g1_neg(a[2])]
+        got = J.unpack_g1(
+            J.add(J.FP1, J.pack_g1(cases_a), J.pack_g1(cases_b), exact=True)
+        )
+        want = [C.g1_add(x, y) for x, y in zip(cases_a, cases_b)]
+        assert got == want
+
+    def test_add_g2(self):
+        a = rand_g2(3)
+        b = rand_g2(3)
+        got = J.unpack_g2(J.add(J.FP2, J.pack_g2(a), J.pack_g2(b)))
+        assert got == [C.g2_add(x, y) for x, y in zip(a, b)]
+
+    def test_scalar_mul_dynamic(self):
+        pts = rand_g1(4)
+        ks = [secrets.randbits(64) | 1 for _ in range(4)]
+        bits = jnp.asarray(J.scalars_to_bits(ks, 64))
+        got = J.unpack_g1(J.scalar_mul(J.FP1, J.pack_g1(pts), bits))
+        assert got == [C.g1_mul(p, k) for p, k in zip(pts, ks)]
+
+    def test_scalar_mul_static(self):
+        pts = rand_g2(3)
+        m = -params.X
+        got = J.unpack_g2(J.scalar_mul_static(J.FP2, J.pack_g2(pts), m))
+        assert got == [C.g2_mul(p, m) for p in pts]
+
+    def test_scalar_mul_with_static(self):
+        pts = rand_g2(2)
+        ks = [secrets.randbits(64) | 1 for _ in range(2)]
+        bits = jnp.asarray(J.scalars_to_bits(ks, 64))
+        m = -params.X
+        dyn, stat = J.scalar_mul_with_static(J.FP2, J.pack_g2(pts), bits, m)
+        assert J.unpack_g2(dyn) == [C.g2_mul(p, k) for p, k in zip(pts, ks)]
+        assert J.unpack_g2(stat) == [C.g2_mul(p, m) for p in pts]
+
+    def test_lane_sum(self):
+        pts = rand_g1(5) + [None, rand_g1(1)[0]]
+        got = J.unpack_g1(J.lane_sum(J.FP1, J.pack_g1(pts), len(pts)))
+        want = None
+        for p in pts:
+            want = C.g1_add(want, p)
+        assert got == [want]
+
+    def test_psi_and_eq(self):
+        pts = rand_g2(3)
+        got = J.unpack_g2(J.psi(J.pack_g2(pts)))
+        assert got == [C.psi(p) for p in pts]
+        p1 = J.pack_g2(pts)
+        assert np.asarray(J.jac_eq(J.FP2, p1, p1)).all()
+        assert not np.asarray(
+            J.jac_eq(J.FP2, p1, J.double(J.FP2, p1))
+        ).any()
+
+
+class TestLaneHtc:
+    def test_map_and_clear(self):
+        msgs = [b"lane-a", b"lane-b", b"lane-c"]
+        t0, t1 = HT.pack_draws(msgs)
+        got = J.unpack_g2(HT.hash_draws_to_g2(t0, t1))
+        want = [H2C.hash_to_g2(m) for m in msgs]
+        assert got == want
+
+
+class TestLanePairing:
+    def test_miller_loop_and_final_exp(self):
+        g1s = rand_g1(2)
+        g2s = rand_g2(2)
+        xP = jnp.asarray(L.pack([p[0] for p in g1s]))
+        yP = jnp.asarray(L.pack([p[1] for p in g1s]))
+        xQ = jnp.asarray(T.f2_pack_many([q[0] for q in g2s]))
+        yQ = jnp.asarray(T.f2_pack_many([q[1] for q in g2s]))
+        fs = OP.miller_loop(xP, yP, xQ, yQ)
+        arr = np.asarray(L.canonical(fs))
+        for i in range(2):
+            want = PF.miller_loop_fast(g1s[i], g2s[i])
+            got = tuple(
+                tuple(
+                    (
+                        L.from_limbs(arr[j, k, 0, :, i]),
+                        L.from_limbs(arr[j, k, 1, :, i]),
+                    )
+                    for k in range(3)
+                )
+                for j in range(2)
+            )
+            assert got == want
+
+    def test_pairing_bilinearity_verdict(self):
+        """e([a]P, Q) * e(-P, [a]Q) == 1 — end-to-end product check."""
+        a = 7
+        p1 = C.g1_mul(C.G1_GEN, a)
+        q1 = C.G2_GEN
+        p2 = C.g1_neg(C.G1_GEN)
+        q2 = C.g2_mul(C.G2_GEN, a)
+        xP = jnp.asarray(L.pack([p1[0], p2[0]]))
+        yP = jnp.asarray(L.pack([p1[1], p2[1]]))
+        xQ = jnp.asarray(T.f2_pack_many([q1[0], q2[0]]))
+        yQ = jnp.asarray(T.f2_pack_many([q1[1], q2[1]]))
+        fs = OP.miller_loop(xP, yP, xQ, yQ)
+        ok = np.asarray(OP.pairing_product_is_one(fs, 2))
+        assert ok.all()
+        # and a broken pair must fail
+        fs2 = OP.miller_loop(xP, yP, xQ[..., ::-1], yQ[..., ::-1])
+        assert not np.asarray(OP.pairing_product_is_one(fs2, 2)).any()
+
+    def test_infinity_masks(self):
+        g1s = rand_g1(2)
+        g2s = rand_g2(2)
+        xP = jnp.asarray(L.pack([p[0] for p in g1s]))
+        yP = jnp.asarray(L.pack([p[1] for p in g1s]))
+        xQ = jnp.asarray(T.f2_pack_many([q[0] for q in g2s]))
+        yQ = jnp.asarray(T.f2_pack_many([q[1] for q in g2s]))
+        inf = jnp.asarray(np.array([True, False]))
+        fs = OP.miller_loop(xP, yP, xQ, yQ, p_inf=inf)
+        one = np.asarray(T.f12_eq_one(fs))
+        assert one[0] and not one[1]

@@ -187,3 +187,97 @@ def test_prune_drops_old_history():
     s.prune(current_epoch=100)
     assert s._validators[1].by_target == {}
     assert s._validators[1].votes == []
+
+
+# ------------------------------------------------------------ persistence
+
+
+def _mk_att(vals, source, target, root_seed=0):
+    import lighthouse_tpu.consensus.types as T
+
+    return T.IndexedAttestation.make(
+        attesting_indices=list(vals),
+        data=T.AttestationData.make(
+            slot=target * 32,
+            index=root_seed,
+            beacon_block_root=bytes([root_seed]) * 32,
+            source=T.Checkpoint.make(epoch=source, root=b"\x01" * 32),
+            target=T.Checkpoint.make(epoch=target, root=b"\x02" * 32),
+        ),
+        signature=b"\x00" * 96,
+    )
+
+
+def test_persistent_slasher_detects_surround_across_restart(tmp_path):
+    """The VERDICT r2 #9 'done' criterion: a surround vote recorded
+    before a restart is detected after it (database/mod.rs role, on the
+    node's KV engine)."""
+    from lighthouse_tpu.node.store import LogStore
+    from lighthouse_tpu.slasher.slasher import Slasher, SlasherConfig
+
+    path = str(tmp_path / "slasher_db")
+    cfg = SlasherConfig(history_length=64)
+
+    s1 = Slasher(cfg, db=LogStore(path))
+    s1.queue_attestation(_mk_att([7], source=2, target=9))
+    atts, props = s1.process_queued()
+    assert atts == []
+    s1.db.kv.close()
+
+    # restart: fresh process state, same directory
+    s2 = Slasher(cfg, db=LogStore(path))
+    s2.queue_attestation(_mk_att([7], source=1, target=10))  # surrounds
+    atts, props = s2.process_queued()
+    assert len(atts) == 1, "surround vote lost across restart"
+    # double vote across restart too
+    s2.queue_attestation(_mk_att([7], source=2, target=9, root_seed=3))
+    atts, _ = s2.process_queued()
+    # detects BOTH the double vote vs the pre-restart (2,9) and the
+    # surround by the post-restart (1,10)
+    assert len(atts) == 2, "double vote lost across restart"
+    s2.db.kv.close()
+
+
+def test_persistent_slasher_replays_journaled_queue(tmp_path):
+    """Items queued but not processed before a crash are replayed."""
+    from lighthouse_tpu.node.store import LogStore
+    from lighthouse_tpu.slasher.slasher import Slasher, SlasherConfig
+
+    path = str(tmp_path / "slasher_db2")
+    cfg = SlasherConfig(history_length=64)
+    s1 = Slasher(cfg, db=LogStore(path))
+    s1.queue_attestation(_mk_att([3], source=4, target=8))
+    # crash before process_queued
+    s1.db.kv.close()
+
+    s2 = Slasher(cfg, db=LogStore(path))
+    s2.process_queued()  # replays the journaled attestation
+    s2.queue_attestation(_mk_att([3], source=3, target=9))  # surrounds it
+    atts, _ = s2.process_queued()
+    assert len(atts) == 1, "journaled queue entry lost"
+    s2.db.kv.close()
+
+
+def test_persistent_slasher_on_native_engine(tmp_path):
+    """Same restart scenario on the C++ KV engine when available."""
+    from lighthouse_tpu.node.native_store import (
+        NativeLogStore,
+        native_available,
+    )
+    from lighthouse_tpu.slasher.slasher import Slasher, SlasherConfig
+
+    if not native_available():
+        import pytest
+
+        pytest.skip("native engine not built")
+    path = str(tmp_path / "slasher_native")
+    cfg = SlasherConfig(history_length=64)
+    s1 = Slasher(cfg, db=NativeLogStore(path))
+    s1.queue_attestation(_mk_att([5], source=2, target=9))
+    s1.process_queued()
+    s1.db.kv.close()
+    s2 = Slasher(cfg, db=NativeLogStore(path))
+    s2.queue_attestation(_mk_att([5], source=1, target=10))
+    atts, _ = s2.process_queued()
+    assert len(atts) == 1
+    s2.db.kv.close()
