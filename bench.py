@@ -46,6 +46,17 @@ import statistics
 import sys
 import time
 
+# The round-3 lane kernels hold f12-sized tensors (~19.5 MB at batch
+# 4096) in VMEM inside scan bodies; the default 16 MB scoped-VMEM limit
+# rejects them at compile time. v5e has 128 MB physical VMEM — raise the
+# scoped limit BEFORE jax/libtpu initializes. (Also in the memory notes:
+# cache keys include these args, keep the value stable.)
+_VMEM_ARGS = "--xla_tpu_scoped_vmem_limit_kib=65536"
+if _VMEM_ARGS not in os.environ.get("LIBTPU_INIT_ARGS", ""):
+    os.environ["LIBTPU_INIT_ARGS"] = (
+        os.environ.get("LIBTPU_INIT_ARGS", "") + " " + _VMEM_ARGS
+    ).strip()
+
 import numpy as np
 
 BLST_SETS_PER_S_PER_CORE = 1200

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import argparse
 import getpass
+import time
 import json
 import os
 import sys
@@ -53,6 +54,16 @@ def _build_parser() -> argparse.ArgumentParser:
                     help="fresh interop genesis with N deterministic keys")
     bn.add_argument("--resume", action="store_true",
                     help="resume the chain persisted in --datadir")
+    bn.add_argument("--listen-port", type=int, default=0,
+                    help="TCP gossip/rpc listen port (0 = no networking)")
+    bn.add_argument("--peer", action="append", default=[],
+                    help="host:port of a peer to dial (repeatable)")
+    bn.add_argument("--genesis-time", type=int, default=0,
+                    help="interop genesis time (0 = now); both nodes of "
+                         "a testnet must agree on it")
+    bn.add_argument("--test-extend", type=int, default=0,
+                    help="testing: produce+gossip N blocks after startup")
+    bn.add_argument("--test-extend-interval", type=float, default=0.2)
     bn.add_argument("--bls-backend", choices=["cpu", "tpu", "fake"],
                     default=None)
 
@@ -163,18 +174,58 @@ def cmd_bn(args) -> int:
         .http_api(args.http_port)
         .bls_backend(args.bls_backend)
     )
+    if args.listen_port:
+        from .network.socket_transport import SocketHub
+
+        builder.network(
+            SocketHub(port=args.listen_port),
+            peer_id=f"bn@{args.listen_port}",
+        )
     if args.resume:
         builder.resume_from_store()
     elif args.interop_validators > 0:
         pubkeys = st.interop_pubkeys(args.interop_validators)
-        builder.genesis_state(st.interop_genesis_state(spec, pubkeys))
+        # fresh dev chain starts NOW (slot 0 at startup), not at the
+        # unix epoch — a zero genesis_time puts the slot clock ~150M
+        # slots ahead
+        builder.genesis_state(
+            st.interop_genesis_state(
+                spec,
+                pubkeys,
+                genesis_time=args.genesis_time or int(time.time()),
+            )
+        )
     else:
         print("need --interop-validators N or --resume", file=sys.stderr)
         return 2
     client = builder.build()
+    for peer in args.peer:
+        host, _, port = peer.rpartition(":")
+        pid = client.service.connect_remote(host or "127.0.0.1", int(port))
+        client.sync.add_peer(pid)
+        print(f"dialed {peer} -> {pid}")
+    if args.test_extend:
+        import threading as _th
+
+        def _extend():
+            sig = b"\xc0" + b"\x00" * 95
+            from .consensus import types as T
+
+            for i in range(args.test_extend):
+                time.sleep(args.test_extend_interval)
+                slot = int(client.chain.head.slot) + 1
+                client.chain.on_slot(slot)
+                block = client.chain.produce_block(slot, randao_reveal=sig)
+                signed = T.SignedBeaconBlock.make(message=block, signature=sig)
+                client.chain.process_block(signed)
+                if client.nbp is not None:
+                    client.nbp.publish_block(signed)
+
+        _th.Thread(target=_extend, daemon=True).start()
     print(
         f"beacon node up: head slot {client.chain.head.slot}, "
-        f"http :{client.api_server.port if client.api_server else '-'}"
+        f"http :{client.api_server.port if client.api_server else '-'}",
+        flush=True,
     )
     try:
         client.run()

@@ -43,6 +43,10 @@ class NetworkService:
         self.gossip = GossipRouter(self.endpoint)
         self.rpc = RpcHandler(self.endpoint)
         self.peers = PeerManager()
+        # socket transports announce inbound peers (HELLO handshake);
+        # graft them like a discovery hit
+        if hasattr(self.endpoint, "on_peer_connected"):
+            self.endpoint.on_peer_connected = self._on_remote_peer
 
     # -- topology
 
@@ -54,6 +58,19 @@ class NetworkService:
         for topic in self.gossip.subscriptions & other.gossip.subscriptions:
             self.gossip.graft(topic, other.peer_id)
             other.gossip.graft(topic, self.peer_id)
+
+    def connect_remote(self, host: str, port: int) -> str:
+        """Dial a TCP peer (socket transport): HELLO handshake, then
+        one-sided connect + graft of OUR subscriptions — the remote
+        side grafts its own when its on_peer_connected fires."""
+        peer = self.endpoint.connect(host, port)
+        self._on_remote_peer(peer)
+        return peer
+
+    def _on_remote_peer(self, peer_id: str) -> None:
+        self.peers.connect(peer_id)
+        for topic in self.gossip.subscriptions:
+            self.gossip.graft(topic, peer_id)
 
     def subscribe(self, topic: str) -> None:
         self.gossip.subscribe(topic)

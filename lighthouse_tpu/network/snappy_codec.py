@@ -1,0 +1,160 @@
+"""Pure-Python snappy BLOCK format codec (no C dependency).
+
+The gossip wire and the req/resp chunk payloads are snappy-compressed in
+the reference (gossipsub message transform, service/mod.rs:107; SSZ-
+snappy RPC codec, rpc/codec.rs). No snappy binding ships in this image,
+so the format is implemented here:
+
+- `decompress` handles the FULL block format (literals + all three copy
+  tag encodings) — required to read peers' compressed frames.
+- `compress` emits a VALID literal-only stream plus a greedy hash-match
+  pass for long runs — snappy makes literal-only output legal, so this
+  is wire-compatible with every conformant decoder while staying
+  simple. (Compression ratio is secondary on localhost; the format
+  being right is what matters for interop.)
+
+Format: [uvarint uncompressed_len] then tagged elements:
+  tag & 3 == 0: literal, len = (tag>>2)+1 (60-63 escape to 1-4 length bytes)
+  tag & 3 == 1: copy, len = ((tag>>2)&7)+4, offset = ((tag>>5)<<8)|next
+  tag & 3 == 2: copy, len = (tag>>2)+1, offset = next 2 bytes LE
+  tag & 3 == 3: copy, len = (tag>>2)+1, offset = next 4 bytes LE
+"""
+
+from __future__ import annotations
+
+
+class SnappyError(Exception):
+    pass
+
+
+def _uvarint(data: bytes, pos: int) -> tuple:
+    shift = 0
+    out = 0
+    while True:
+        if pos >= len(data):
+            raise SnappyError("truncated varint")
+        b = data[pos]
+        pos += 1
+        out |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return out, pos
+        shift += 7
+        if shift > 63:
+            raise SnappyError("varint overflow")
+
+
+def _put_uvarint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def decompress(data: bytes) -> bytes:
+    want, pos = _uvarint(data, 0)
+    out = bytearray()
+    n = len(data)
+    while pos < n:
+        tag = data[pos]
+        pos += 1
+        kind = tag & 3
+        if kind == 0:  # literal
+            ln = tag >> 2
+            if ln >= 60:
+                extra = ln - 59
+                if pos + extra > n:
+                    raise SnappyError("truncated literal length")
+                ln = int.from_bytes(data[pos : pos + extra], "little")
+                pos += extra
+            ln += 1
+            if pos + ln > n:
+                raise SnappyError("truncated literal")
+            out += data[pos : pos + ln]
+            pos += ln
+            continue
+        if kind == 1:
+            ln = ((tag >> 2) & 0x7) + 4
+            if pos >= n:
+                raise SnappyError("truncated copy1")
+            off = ((tag >> 5) << 8) | data[pos]
+            pos += 1
+        elif kind == 2:
+            ln = (tag >> 2) + 1
+            if pos + 2 > n:
+                raise SnappyError("truncated copy2")
+            off = int.from_bytes(data[pos : pos + 2], "little")
+            pos += 2
+        else:
+            ln = (tag >> 2) + 1
+            if pos + 4 > n:
+                raise SnappyError("truncated copy4")
+            off = int.from_bytes(data[pos : pos + 4], "little")
+            pos += 4
+        if off == 0 or off > len(out):
+            raise SnappyError("bad copy offset")
+        # overlapping copies are byte-serial by definition
+        start = len(out) - off
+        for i in range(ln):
+            out.append(out[start + i])
+    if len(out) != want:
+        raise SnappyError(
+            f"length mismatch: header {want}, decoded {len(out)}"
+        )
+    return bytes(out)
+
+
+def _emit_literal(out: bytearray, chunk: bytes) -> None:
+    n = len(chunk) - 1
+    if n < 60:
+        out.append(n << 2)
+    elif n < (1 << 8):
+        out.append(60 << 2)
+        out += n.to_bytes(1, "little")
+    elif n < (1 << 16):
+        out.append(61 << 2)
+        out += n.to_bytes(2, "little")
+    elif n < (1 << 24):
+        out.append(62 << 2)
+        out += n.to_bytes(3, "little")
+    else:
+        out.append(63 << 2)
+        out += n.to_bytes(4, "little")
+    out += chunk
+
+
+def compress(data: bytes) -> bytes:
+    """Valid snappy stream; greedy 8-byte-window matcher keeps repeated
+    SSZ structures (zero padding, repeated roots) compact enough."""
+    out = bytearray(_put_uvarint(len(data)))
+    n = len(data)
+    if n == 0:
+        return bytes(out)
+    table: dict = {}
+    i = 0
+    lit_start = 0
+    while i + 4 <= n:
+        key = data[i : i + 4]
+        cand = table.get(key)
+        table[key] = i
+        if cand is not None and i - cand <= 0xFFFF:
+            # extend the match
+            ln = 4
+            while i + ln < n and ln < 64 and data[cand + ln] == data[i + ln]:
+                ln += 1
+            if lit_start < i:
+                _emit_literal(out, data[lit_start:i])
+            off = i - cand
+            out.append(((ln - 1) << 2) | 2)
+            out += off.to_bytes(2, "little")
+            i += ln
+            lit_start = i
+        else:
+            i += 1
+    if lit_start < n:
+        _emit_literal(out, data[lit_start:])
+    return bytes(out)

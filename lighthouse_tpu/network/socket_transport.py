@@ -1,0 +1,212 @@
+"""TCP socket transport — the real process boundary.
+
+Implements the transport.Endpoint seam over localhost/LAN TCP so two
+`lighthouse_tpu.cli bn` OS processes can handshake, gossip and
+range-sync (the role of lighthouse_network's TCP stack,
+service/utils.rs:52-63 — minus QUIC/noise/yamux, which ride behind the
+same seam later; frames carry snappy-compressed payloads like the
+reference's gossip transform and SSZ-snappy RPC codec).
+
+Wire format, one frame:
+    u32le  frame_length (of everything after this field)
+    u8     channel      (CHANNEL_GOSSIP / CHANNEL_RPC / 255 = HELLO)
+    bytes  snappy(payload)
+
+Connection lifecycle: dial -> send HELLO{our peer_id} -> receive
+HELLO{their peer_id} -> frames flow. The acceptor side mirrors it.
+Reader threads push decoded frames into the same inbox `poll()`/
+`drain()` the in-process hub uses, so NetworkService and everything
+above it is transport-agnostic.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from collections import deque
+from typing import Callable, Optional
+
+from . import snappy_codec as snappy
+from .transport import Frame
+
+CHANNEL_HELLO = 255
+_MAX_FRAME = 1 << 24  # 16 MiB cap (DoS guard; RPC chunks are far smaller)
+
+
+class SocketEndpoint:
+    """transport.Endpoint over TCP. join via SocketHub below."""
+
+    def __init__(self, peer_id: str, host: str = "127.0.0.1", port: int = 0):
+        self.peer_id = peer_id
+        self._inbox: deque[Frame] = deque()
+        self._lock = threading.Lock()
+        self._conns: dict[str, socket.socket] = {}
+        self._closed = False
+        self.on_peer_connected: Optional[Callable] = None
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, port))
+        self._listener.listen(16)
+        self.addr = self._listener.getsockname()
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+
+    # ------------------------------------------------------------ wiring
+
+    def connect(self, host: str, port: int, timeout: float = 5.0) -> str:
+        """Dial a peer; returns its peer_id after the HELLO exchange."""
+        s = socket.create_connection((host, port), timeout=timeout)
+        s.settimeout(timeout)
+        _send_frame(s, CHANNEL_HELLO, self.peer_id.encode())
+        ch, payload = _recv_frame(s)
+        if ch != CHANNEL_HELLO:
+            s.close()
+            raise ConnectionError("peer did not HELLO")
+        peer = payload.decode()
+        s.settimeout(None)
+        self._register(peer, s)
+        return peer
+
+    def _accept_loop(self) -> None:
+        while not self._closed:
+            try:
+                s, _ = self._listener.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._accept_one, args=(s,), daemon=True
+            ).start()
+
+    def _accept_one(self, s: socket.socket) -> None:
+        try:
+            s.settimeout(5.0)
+            ch, payload = _recv_frame(s)
+            if ch != CHANNEL_HELLO:
+                s.close()
+                return
+            peer = payload.decode()
+            _send_frame(s, CHANNEL_HELLO, self.peer_id.encode())
+            s.settimeout(None)
+            self._register(peer, s)
+        except (OSError, ConnectionError, snappy.SnappyError):
+            s.close()
+
+    def _register(self, peer: str, s: socket.socket) -> None:
+        with self._lock:
+            old = self._conns.pop(peer, None)
+            self._conns[peer] = s
+        if old is not None:
+            try:
+                old.close()
+            except OSError:
+                pass
+        threading.Thread(
+            target=self._read_loop, args=(peer, s), daemon=True
+        ).start()
+        cb = self.on_peer_connected
+        if cb is not None:
+            cb(peer)
+
+    def _read_loop(self, peer: str, s: socket.socket) -> None:
+        try:
+            while not self._closed:
+                ch, payload = _recv_frame(s)
+                with self._lock:
+                    self._inbox.append(
+                        Frame(sender=peer, channel=ch, payload=payload)
+                    )
+        except (OSError, ConnectionError, snappy.SnappyError):
+            pass
+        finally:
+            with self._lock:
+                if self._conns.get(peer) is s:
+                    del self._conns[peer]
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    # ------------------------------------------------------- Endpoint API
+
+    def send(self, to_peer: str, channel: int, payload: bytes) -> bool:
+        with self._lock:
+            s = self._conns.get(to_peer)
+        if s is None:
+            return False
+        try:
+            _send_frame(s, channel, payload)
+            return True
+        except OSError:
+            return False
+
+    def poll(self) -> Optional[Frame]:
+        with self._lock:
+            return self._inbox.popleft() if self._inbox else None
+
+    def drain(self) -> list:
+        with self._lock:
+            out = list(self._inbox)
+            self._inbox.clear()
+            return out
+
+    def push(self, frame: Frame) -> None:
+        with self._lock:
+            self._inbox.append(frame)
+
+    def connected_peers(self) -> list:
+        with self._lock:
+            return list(self._conns)
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._lock:
+            conns = list(self._conns.values())
+            self._conns.clear()
+        for s in conns:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
+class SocketHub:
+    """hub.join() shim so NetworkService builds unchanged on sockets."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.host = host
+        self.port = port
+        self.endpoint: Optional[SocketEndpoint] = None
+
+    def join(self, peer_id: str) -> SocketEndpoint:
+        self.endpoint = SocketEndpoint(peer_id, self.host, self.port)
+        return self.endpoint
+
+
+# ---------------------------------------------------------------- framing
+
+
+def _send_frame(s: socket.socket, channel: int, payload: bytes) -> None:
+    body = bytes([channel]) + snappy.compress(payload)
+    s.sendall(struct.pack("<I", len(body)) + body)
+
+
+def _recv_exact(s: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = s.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return bytes(buf)
+
+
+def _recv_frame(s: socket.socket) -> tuple:
+    (ln,) = struct.unpack("<I", _recv_exact(s, 4))
+    if ln < 1 or ln > _MAX_FRAME:
+        raise ConnectionError(f"bad frame length {ln}")
+    body = _recv_exact(s, ln)
+    return body[0], snappy.decompress(body[1:])

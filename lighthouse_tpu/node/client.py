@@ -37,10 +37,17 @@ class SlotTimer:
         self.state_advance = StateAdvanceTimer(chain)
         self._advanced_for_slot = -1
 
+    # a node waking far behind the clock (old genesis_time, resume
+    # after downtime) must not fire millions of per-slot callbacks —
+    # jump, then fire only the recent window (checkpoint-sync posture)
+    MAX_CATCHUP_SLOTS = 64
+
     def poll(self) -> int:
         """Advance to the clock's slot; returns slots fired."""
         now = self.clock.current_slot()
         fired = 0
+        if now - self._last_slot > self.MAX_CATCHUP_SLOTS:
+            self._last_slot = now - self.MAX_CATCHUP_SLOTS
         while self._last_slot < now:
             self._last_slot += 1
             self.on_slot(self._last_slot)
