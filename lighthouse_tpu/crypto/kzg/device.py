@@ -2,9 +2,10 @@
 AND the pairings move off the host).
 
 `device_kzg(setup)` builds a `Kzg` whose MSM seam is the windowed
-device kernel (ops/msm) and whose pairing seam runs the 2-pairing
-product check as one jitted program: batched Miller loops + shared
-final exponentiation (ops/pairing), the same kernel family the BLS
+device kernel (ops/lane/msm — round 3 moved it onto the lane-major
+Pallas stack) and whose pairing seam runs the 2-pairing product check
+as one jitted program: batched Miller loops + shared final
+exponentiation (ops/lane/pairing), the same kernel family the BLS
 verifier uses (crypto/kzg/src/lib.rs:156-183 parity on TPU).
 """
 
@@ -14,7 +15,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ...ops import fp, msm as dev_msm, pairing as OP, tower
+from ...ops.lane import fp, msm as dev_msm, pairing as OP, tower
 from . import Kzg, TrustedSetup
 
 
@@ -22,33 +23,27 @@ from . import Kzg, TrustedSetup
 def _pairing_product_kernel(px, py, p_inf, qx, qy, q_inf):
     """e-product over packed affine pairs == 1 (after final exp)."""
     fs = OP.miller_loop(px, py, qx, qy, p_inf=p_inf, q_inf=q_inf)
-    return OP.pairing_product_is_one(fs, px.shape[0])
+    return jnp.all(OP.pairing_product_is_one(fs, px.shape[-1]))
 
 
 def pairings_product_is_one_device(pairs) -> bool:
     """pairs: [(G1 affine | None, G2 affine | None)] host points."""
-    n = max(1, len(pairs))
-    px, py, qx, qy, p_inf, q_inf = [], [], [], [], [], []
-    for g1, g2 in pairs:
-        p_inf.append(g1 is None)
-        q_inf.append(g2 is None)
-        px.append(fp.to_limbs(g1[0] if g1 else 0))
-        py.append(fp.to_limbs(g1[1] if g1 else 0))
-        qx.append(tower.f2_pack(g2[0] if g2 else (0, 0)))
-        qy.append(tower.f2_pack(g2[1] if g2 else (0, 0)))
-    while len(px) < n:  # empty input: trivially one
-        p_inf.append(True)
-        q_inf.append(True)
-        px.append(fp.to_limbs(0))
-        py.append(fp.to_limbs(0))
-        qx.append(tower.f2_pack((0, 0)))
-        qy.append(tower.f2_pack((0, 0)))
+    p_inf = [g1 is None for g1, _ in pairs] or [True]
+    q_inf = [g2 is None for _, g2 in pairs] or [True]
+    px = fp.pack([g1[0] if g1 else 0 for g1, _ in pairs] or [0])
+    py = fp.pack([g1[1] if g1 else 0 for g1, _ in pairs] or [0])
+    qx = tower.f2_pack_many(
+        [g2[0] if g2 else (0, 0) for _, g2 in pairs] or [(0, 0)]
+    )
+    qy = tower.f2_pack_many(
+        [g2[1] if g2 else (0, 0) for _, g2 in pairs] or [(0, 0)]
+    )
     out = _pairing_product_kernel(
-        jnp.asarray(np.stack(px)),
-        jnp.asarray(np.stack(py)),
+        jnp.asarray(px),
+        jnp.asarray(py),
         jnp.asarray(np.array(p_inf)),
-        jnp.asarray(np.stack(qx)),
-        jnp.asarray(np.stack(qy)),
+        jnp.asarray(qx),
+        jnp.asarray(qy),
         jnp.asarray(np.array(q_inf)),
     )
     return bool(np.asarray(out))

@@ -1,0 +1,94 @@
+"""Batched G1 multi-scalar multiplication — lane-major, fused kernels.
+
+Port of ops/msm.py to the round-3 lane layout (see that module's doc
+for the windowed-shared-ladder design argument vs Pippenger): per point
+a 2^w-entry multiples table, then a Horner walk over 255/w windows —
+all group ops are the fused Pallas dbl/add kernels, the batch rides the
+128-wide lane axis, and the final reduction is the lane-halving exact
+sum tree.
+
+The KZG hot op (SURVEY.md §2.7 item 2; crypto/kzg/src/lib.rs:156-183
+batch verification reduces to one MSM + two pairings).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...crypto.bls.params import R
+from . import fp, jacobian as J
+
+WINDOW = 4
+NDIGITS = -(-255 // WINDOW)  # 64
+
+
+def scalars_to_digits(scalars) -> np.ndarray:
+    """[n] ints -> [NDIGITS, n] int32 WINDOW-bit digits, MSB window
+    FIRST (Horner order), lane-major."""
+    out = np.zeros((NDIGITS, len(scalars)), dtype=np.int32)
+    mask = (1 << WINDOW) - 1
+    for i, s in enumerate(scalars):
+        s = int(s) % R
+        for d in range(NDIGITS):
+            out[NDIGITS - 1 - d, i] = (s >> (d * WINDOW)) & mask
+    return out
+
+
+@jax.jit
+def _msm_kernel(xs, ys, zs, digits):
+    """sum_i scalar_i * P_i for lane-major Jacobian G1 arrays [W, S] +
+    MSB-first digit matrix [NDIGITS, S] in [0, 2^WINDOW)."""
+    S = xs.shape[-1]
+    base = (xs, ys, zs)
+
+    # multiples table T[d] = [d]P: one scan collecting T[1..]
+    def tab_step(acc, _):
+        nxt = J.add(J.FP1, acc, base, exact=True)
+        return nxt, nxt
+
+    zero = tuple(J.FP1.zeros((), S) for _ in range(3))
+    _, tail = jax.lax.scan(tab_step, base, None, length=(1 << WINDOW) - 2)
+    table = tuple(
+        jnp.concatenate([z[None], b[None], t], axis=0)  # [2^w, W, S]
+        for z, b, t in zip(zero, base, tail)
+    )
+
+    # Horner over windows: acc = [2^w]acc + T[digit]
+    def win_step(acc, digit):
+        for _ in range(WINDOW):
+            acc = J.double(J.FP1, acc)
+        sel = tuple(
+            jnp.take_along_axis(
+                t,
+                jnp.broadcast_to(
+                    digit.reshape((1,) + (1,) * (t.ndim - 2) + (-1,)),
+                    (1,) + t.shape[1:],
+                ),
+                axis=0,
+            )[0]
+            for t in table
+        )
+        return J.add(J.FP1, acc, sel, exact=True), None
+
+    acc0 = tuple(J.FP1.zeros((), S) for _ in range(3))
+    acc, _ = jax.lax.scan(win_step, acc0, digits)
+    return J.lane_sum(J.FP1, acc, S)
+
+
+def _bucket(n: int) -> int:
+    return 1 << max(7, (n - 1).bit_length())
+
+
+def msm_g1(points: list, scalars: list):
+    """Host wrapper: affine points (or None) x python ints -> affine
+    point or None. Pads to power-of-two lane buckets (>= 128)."""
+    n = len(points)
+    if n == 0:
+        return None
+    npad = _bucket(n)
+    pts = list(points) + [None] * (npad - n)
+    sc = [s % R for s in scalars] + [0] * (npad - n)
+    xs, ys, zs = J.pack_g1(pts)
+    digits = jnp.asarray(scalars_to_digits(sc))
+    out = _msm_kernel(xs, ys, zs, digits)
+    return J.unpack_g1(out)[0]

@@ -1,0 +1,103 @@
+"""500k-validator scale probe (VERDICT r2 #10).
+
+Generates a synthetic N-validator post-altair state (no real crypto —
+pubkeys are unique opaque bytes; epoch processing never checks them),
+then measures the hot regime the north star names:
+
+  - one full epoch transition (process_epoch, the single-pass analog,
+    consensus/state_processing/src/per_epoch_processing/single_pass.rs)
+  - one slot's committee resolution (get_beacon_committee for every
+    committee of a slot — the attestation-verification lookup path)
+  - proposer index for one slot
+  - state copy (BeaconState.copy) — the per-block fork-state cost
+
+Run:  python -m lighthouse_tpu.tools.scale_probe [n_validators]
+Numbers land in BASELINE.md §"scale probe".
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+from ..consensus import state_transition as st
+from ..consensus import types as T
+from ..consensus.spec import mainnet_spec
+
+
+def build_state(n: int):
+    spec = mainnet_spec()
+    state = st.empty_genesis_shell(spec, genesis_time=0)
+    eb = spec.max_effective_balance
+    validators = []
+    balances = []
+    for i in range(n):
+        validators.append(
+            T.Validator.make(
+                pubkey=i.to_bytes(8, "little") * 6,
+                withdrawal_credentials=b"\x01" + b"\x00" * 31,
+                effective_balance=eb,
+                slashed=False,
+                activation_eligibility_epoch=0,
+                activation_epoch=0,
+                exit_epoch=st.FAR_FUTURE_EPOCH,
+                withdrawable_epoch=st.FAR_FUTURE_EPOCH,
+            )
+        )
+        balances.append(eb)
+    state.validators = validators
+    state.balances = balances
+    n_active = len(validators)
+    state.previous_epoch_participation = [7] * n_active  # full participation
+    state.current_epoch_participation = [7] * n_active
+    state.inactivity_scores = [0] * n_active
+    # mid-chain posture: slot at an epoch tail, checkpoints wired
+    spe = spec.preset.slots_per_epoch
+    state.slot = 10 * spe - 1
+    state.finalized_checkpoint = T.Checkpoint.make(epoch=8, root=b"\x08" * 32)
+    state.current_justified_checkpoint = T.Checkpoint.make(
+        epoch=9, root=b"\x09" * 32
+    )
+    state.previous_justified_checkpoint = T.Checkpoint.make(
+        epoch=8, root=b"\x08" * 32
+    )
+    state.justification_bits = [True, True, True, True]
+    return spec, state
+
+
+def probe(n: int = 500_000) -> dict:
+    out = {"validators": n}
+    t0 = time.perf_counter()
+    spec, state = build_state(n)
+    out["build_s"] = round(time.perf_counter() - t0, 2)
+
+    t0 = time.perf_counter()
+    st.process_epoch(spec, state)
+    out["epoch_transition_s"] = round(time.perf_counter() - t0, 2)
+
+    state.slot += 1
+    epoch = st.get_current_epoch(spec, state)
+    t0 = time.perf_counter()
+    cps = st.get_committee_count_per_slot(spec, state, epoch)
+    members = 0
+    for idx in range(cps):
+        members += len(
+            st.get_beacon_committee(spec, state, int(state.slot), idx)
+        )
+    out["slot_committees"] = cps
+    out["slot_committee_members"] = members
+    out["slot_committee_resolution_s"] = round(time.perf_counter() - t0, 2)
+
+    t0 = time.perf_counter()
+    st.get_beacon_proposer_index(spec, state)
+    out["proposer_index_s"] = round(time.perf_counter() - t0, 2)
+
+    t0 = time.perf_counter()
+    state.copy()
+    out["state_copy_s"] = round(time.perf_counter() - t0, 2)
+    return out
+
+
+if __name__ == "__main__":
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 500_000
+    print(probe(n))
