@@ -42,6 +42,7 @@ BENCH_BLOCK_AGGS (128), BENCH_AGG_KEYS (128).
 
 import json
 import os
+import signal
 import statistics
 import sys
 import time
@@ -62,6 +63,66 @@ import numpy as np
 BLST_SETS_PER_S_PER_CORE = 1200
 BLST_HOST_CORES = 16
 BLST_HOST_ANCHOR = BLST_SETS_PER_S_PER_CORE * BLST_HOST_CORES
+
+# ------------------------------------------------------------ time budget
+# VERDICT r3 weak #1: the driver runs this under an external timeout; a
+# run that dies mid-compile reports NOTHING. Every config is therefore
+# (a) skipped up front if the remaining budget is too small, (b) wrapped
+# so its failure doesn't lose the others, and (c) the JSON line is also
+# flushed from a SIGTERM/SIGALRM handler so even a driver kill captures
+# whatever finished.
+_T_START = time.monotonic()
+_BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "1100"))
+_STATE = {"detail": {}, "rate1": 0.0, "emitted": False}
+
+
+def _left() -> float:
+    return _BUDGET_S - (time.monotonic() - _T_START)
+
+
+def _emit():
+    if _STATE["emitted"]:
+        return
+    _STATE["emitted"] = True
+    rate1 = _STATE["rate1"]
+    print(
+        json.dumps(
+            {
+                "metric": "bls_verify_signature_sets_throughput",
+                "value": round(rate1, 2),
+                "unit": "sets/s",
+                "vs_baseline": round(rate1 / BLST_HOST_ANCHOR, 4),
+                "detail": _STATE["detail"],
+            }
+        ),
+        flush=True,
+    )
+
+
+def _on_term(signum, frame):
+    _STATE["detail"]["aborted"] = {
+        "signal": int(signum),
+        "at_s": round(time.monotonic() - _T_START, 1),
+    }
+    _emit()
+    os._exit(0 if _STATE["rate1"] else 3)
+
+
+def _run_config(key: str, min_budget_s: float, fn, *args):
+    """Run one config under the global budget; failures are recorded,
+    never fatal."""
+    detail = _STATE["detail"]
+    if _left() < min_budget_s:
+        detail[key] = {
+            "skipped": "budget",
+            "left_s": round(_left(), 1),
+            "needed_s": min_budget_s,
+        }
+        return
+    try:
+        fn(detail, *args)
+    except Exception as e:  # record and continue — partial data > none
+        detail[key] = {"error": f"{type(e).__name__}: {e}"}
 
 
 def _pcts(xs):
@@ -102,6 +163,43 @@ def _incremental_sets(n, messages):
     return sets
 
 
+def _config1(detail, sets1, scalars1, n_sets, reps):
+    import jax
+
+    from lighthouse_tpu.crypto.bls.backends import tpu as TB
+
+    args1 = TB.prepare_batch(sets1[:n_sets], scalars1[:n_sets])
+    out = jax.block_until_ready(TB._verify_kernel(*args1))
+    assert bool(np.asarray(out)), "config1 batch must verify"
+    times1 = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(TB._verify_kernel(*args1))
+        times1.append(time.perf_counter() - t0)
+    rate1 = n_sets / min(times1)
+    _STATE["rate1"] = rate1
+    # one-set batch isolates the fixed launch/transfer overhead of
+    # the tunneled chip; the marginal per-set cost is the honest
+    # kernel-throughput figure
+    args_one = TB.prepare_batch(sets1[:1], scalars1[:1])
+    jax.block_until_ready(TB._verify_kernel(*args_one))
+    t_one = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        jax.block_until_ready(TB._verify_kernel(*args_one))
+        t_one.append(time.perf_counter() - t0)
+    overhead = min(t_one)
+    marginal = max(min(times1) - overhead, 1e-9) / max(n_sets - 1, 1)
+    detail["config1_raw_batch"] = {
+        "batch": n_sets,
+        "sets_per_s": round(rate1, 2),
+        "launch_overhead_s": round(overhead, 4),
+        "marginal_ms_per_set": round(marginal * 1e3, 4),
+        "marginal_sets_per_s": round(1.0 / marginal, 2),
+        **_pcts(times1),
+    }
+
+
 def main():
     n_sets = int(os.environ.get("BENCH_SETS", "4096"))
     reps = int(os.environ.get("BENCH_REPS", "5"))
@@ -112,6 +210,10 @@ def main():
     configs = set(os.environ.get("BENCH_CONFIGS", "1,2,3,4,5").split(","))
     n_aggs = int(os.environ.get("BENCH_BLOCK_AGGS", "128"))
     keys_per_agg = int(os.environ.get("BENCH_AGG_KEYS", "128"))
+
+    signal.signal(signal.SIGTERM, _on_term)
+    signal.signal(signal.SIGALRM, _on_term)
+    signal.alarm(int(_BUDGET_S) + 30)  # backstop if a compile overruns
 
     # honor an explicit cpu request: the TPU-tunnel plugin may override
     # JAX_PLATFORMS at interpreter startup (same guard as __graft_entry__)
@@ -126,95 +228,60 @@ def main():
     import jax
 
     from lighthouse_tpu.crypto import bls
-    from lighthouse_tpu.crypto.bls.backends import cpu as CB, tpu as TB
+    from lighthouse_tpu.crypto.bls.backends import cpu as CB
 
-    detail = {"device": str(jax.devices()[0]), "blst_anchor": {
+    detail = _STATE["detail"]
+    detail["device"] = str(jax.devices()[0])
+    detail["blst_anchor"] = {
         "sets_per_s_per_core": BLST_SETS_PER_S_PER_CORE,
         "host_cores": BLST_HOST_CORES,
         "host_sets_per_s": BLST_HOST_ANCHOR,
         "provenance": "published blst batch-verify figures; see BASELINE.md",
-    }}
+    }
 
-    # ---------------- config 1: raw verify_signature_sets throughput
     msgs1 = [b"bench-config1-%d" % i for i in range(8)]
     sets1 = _incremental_sets(max(n_sets, cpu_sets), msgs1)
     scalars1 = bls.gen_batch_scalars(len(sets1))
-    rate1 = 0.0
+
+    # min-budget figures assume a WARM compile cache (the seeded state
+    # the driver is supposed to run against); a cold bucket blows them
+    # and the alarm backstop emits whatever finished.
     if "1" in configs:
-        args1 = TB.prepare_batch(sets1[:n_sets], scalars1[:n_sets])
-        out = jax.block_until_ready(TB._verify_kernel(*args1))
-        assert bool(np.asarray(out)), "config1 batch must verify"
-        times1 = []
-        for _ in range(reps):
-            t0 = time.perf_counter()
-            jax.block_until_ready(TB._verify_kernel(*args1))
-            times1.append(time.perf_counter() - t0)
-        rate1 = n_sets / min(times1)
-        # one-set batch isolates the fixed launch/transfer overhead of
-        # the tunneled chip; the marginal per-set cost is the honest
-        # kernel-throughput figure
-        args_one = TB.prepare_batch(sets1[:1], scalars1[:1])
-        jax.block_until_ready(TB._verify_kernel(*args_one))
-        t_one = []
-        for _ in range(3):
-            t0 = time.perf_counter()
-            jax.block_until_ready(TB._verify_kernel(*args_one))
-            t_one.append(time.perf_counter() - t0)
-        overhead = min(t_one)
-        marginal = max(min(times1) - overhead, 1e-9) / max(n_sets - 1, 1)
-        detail["config1_raw_batch"] = {
-            "batch": n_sets,
-            "sets_per_s": round(rate1, 2),
-            "launch_overhead_s": round(overhead, 4),
-            "marginal_ms_per_set": round(marginal * 1e3, 4),
-            "marginal_sets_per_s": round(1.0 / marginal, 2),
-            **_pcts(times1),
-        }
+        _run_config(
+            "config1_raw_batch", 60, _config1, sets1, scalars1, n_sets, reps
+        )
     else:
         detail["config1_raw_batch"] = {"skipped": "BENCH_CONFIGS"}
 
-    # ---------------- config 2: gossip load through the batch former
     if "2" in configs:
-        _config2(detail, n_atts, batch_cap)
+        _run_config("config2_gossip_pipeline", 60, _config2, n_atts, batch_cap)
     else:
         detail["config2_gossip_pipeline"] = {"skipped": "BENCH_CONFIGS"}
 
-    # ---------------- config 3: full-block batch (aggregate-heavy)
     if "3" in configs:
-        _config3(detail, reps, n_aggs, keys_per_agg)
+        _run_config("config3_full_block", 30, _config3, reps, n_aggs, keys_per_agg)
     else:
         detail["config3_full_block"] = {"skipped": "BENCH_CONFIGS"}
 
-    # ---------------- config 4: 512-key sync contribution
     if "4" in configs:
-        _config4(detail, reps)
+        _run_config("config4_sync_contribution", 20, _config4, reps)
     else:
         detail["config4_sync_contribution"] = {"skipped": "BENCH_CONFIGS"}
 
-    # ---------------- config 5: KZG blob batch (on by default, r3)
     if run_kzg and "5" in configs:
-        _config5(detail)
+        _run_config("config5_kzg_blob_batch", 60, _config5)
     else:
         detail["config5_kzg_blob_batch"] = {"skipped": "BENCH_KZG=0"}
 
     # ------------- in-repo CPU control (sanity only, NOT the baseline)
-    t0 = time.perf_counter()
-    ok = CB.verify_signature_sets(sets1[:cpu_sets], scalars1[:cpu_sets])
-    cpu_dt = time.perf_counter() - t0
-    assert ok
-    detail["cpu_control_sets_per_s"] = round(cpu_sets / cpu_dt, 2)
+    if _left() > 30:
+        t0 = time.perf_counter()
+        ok = CB.verify_signature_sets(sets1[:cpu_sets], scalars1[:cpu_sets])
+        cpu_dt = time.perf_counter() - t0
+        assert ok
+        detail["cpu_control_sets_per_s"] = round(cpu_sets / cpu_dt, 2)
 
-    print(
-        json.dumps(
-            {
-                "metric": "bls_verify_signature_sets_throughput",
-                "value": round(rate1, 2),
-                "unit": "sets/s",
-                "vs_baseline": round(rate1 / BLST_HOST_ANCHOR, 4),
-                "detail": detail,
-            }
-        )
-    )
+    _emit()
 
 
 def _config2(detail, n_atts, batch_cap):

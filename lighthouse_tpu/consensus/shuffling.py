@@ -97,6 +97,26 @@ def shuffle_permutation(n: int, seed: bytes, rounds: int):
     return idx
 
 
+# (n, seed, rounds) -> permutation array. The permutation is identical
+# for every committee of every slot of an epoch (the seed binds epoch +
+# domain), so one entry serves ~2048 mainnet committee resolutions —
+# without it a 500k-validator slot cost ~10 minutes (round-4 scale
+# probe, BASELINE.md §scale). Keyed on pure inputs: safe under state
+# mutation. Tiny LRU: epochs roll, two seeds (current+previous) live.
+_PERM_CACHE: dict = {}
+
+
+def _perm_cached(n: int, seed: bytes, rounds: int):
+    key = (n, seed, rounds)
+    p = _PERM_CACHE.get(key)
+    if p is None:
+        p = shuffle_permutation(n, seed, rounds)
+        while len(_PERM_CACHE) >= 4:
+            _PERM_CACHE.pop(next(iter(_PERM_CACHE)))
+        _PERM_CACHE[key] = p
+    return p
+
+
 def compute_committee(
     indices: list, seed: bytes, index: int, count: int, rounds: int
 ) -> list:
@@ -104,9 +124,9 @@ def compute_committee(
     n = len(indices)
     start = n * index // count
     end = n * (index + 1) // count
-    if end - start > 64:
-        perm = shuffle_permutation(n, seed, rounds)
-        return [indices[perm[i]] for i in range(start, end)]
+    if end - start > 64 or (n, seed, rounds) in _PERM_CACHE:
+        perm = _perm_cached(n, seed, rounds)
+        return [indices[p] for p in perm[start:end]]
     return [
         indices[compute_shuffled_index(i, n, seed, rounds)]
         for i in range(start, end)

@@ -1586,17 +1586,28 @@ def mock_execution_payload(spec: ChainSpec, state):
     hash, expected withdrawals included. Replaced by engine-API
     get_payload when a real EL is attached."""
     parent = bytes(state.latest_execution_payload_header.block_hash)
-    return T.ExecutionPayload.make(
+    payload = T.ExecutionPayload.make(
         parent_hash=parent,
         prev_randao=get_randao_mix(spec, state, get_current_epoch(spec, state)),
         block_number=state.latest_execution_payload_header.block_number + 1,
         gas_limit=30_000_000,
         timestamp=compute_timestamp_at_slot(spec, state, state.slot),
-        block_hash=_hash(
-            b"mock-el-block" + parent + state.slot.to_bytes(8, "little")
-        ),
+        block_hash=b"\x00" * 32,
         withdrawals=_expected_withdrawals_for_fork(spec, state),
     )
+    # the REAL keccak(rlp(header)) hash (round 4): every payload in the
+    # system — mock EL included — carries an EL-derivable block hash, so
+    # the import-path hash verification can be unconditional
+    # (execution_layer/src/block_hash.rs parity)
+    from ..execution.block_hash import calculate_execution_block_hash
+
+    # EIP-4788 parent_beacon_block_root = the root of the block this
+    # payload's block will sit ON TOP of — the state's latest header
+    # (matches the block.parent_root the import path verifies against)
+    payload.block_hash, _ = calculate_execution_block_hash(
+        payload, state.latest_block_header.hash_tree_root()
+    )
+    return payload
 
 
 def _expected_withdrawals_for_fork(spec: ChainSpec, state) -> list:

@@ -1,0 +1,79 @@
+"""Legacy Keccak-256 (pre-NIST padding 0x01) — Ethereum's hash.
+
+hashlib ships SHA3-256 (padding 0x06) but not the legacy Keccak the
+execution layer uses for block hashes / RLP tries (the reference binds
+keccak-hash / alloy at beacon_node/execution_layer/src/keccak.rs).
+Sponge with rate 136, Keccak-f[1600], 24 rounds; pure Python — the
+block-hash path hashes one ~600-byte header per payload, so speed is
+irrelevant next to correctness.
+"""
+
+from __future__ import annotations
+
+_ROUNDS = 24
+_RC = [
+    0x0000000000000001, 0x0000000000008082, 0x800000000000808A,
+    0x8000000080008000, 0x000000000000808B, 0x0000000080000001,
+    0x8000000080008081, 0x8000000000008009, 0x000000000000008A,
+    0x0000000000000088, 0x0000000080008009, 0x000000008000000A,
+    0x000000008000808B, 0x800000000000008B, 0x8000000000008089,
+    0x8000000000008003, 0x8000000000008002, 0x8000000000000080,
+    0x000000000000800A, 0x800000008000000A, 0x8000000080008081,
+    0x8000000000008080, 0x0000000080000001, 0x8000000080008008,
+]
+# rotation offsets r[x][y]
+_ROT = [
+    [0, 36, 3, 41, 18],
+    [1, 44, 10, 45, 2],
+    [62, 6, 43, 15, 61],
+    [28, 55, 25, 21, 56],
+    [27, 20, 39, 8, 14],
+]
+_MASK = (1 << 64) - 1
+
+
+def _rol(v: int, n: int) -> int:
+    n %= 64
+    return ((v << n) | (v >> (64 - n))) & _MASK
+
+
+def _keccak_f(A: list) -> None:
+    """In-place Keccak-f[1600] on a 5x5 lane matrix A[x][y]."""
+    for rnd in range(_ROUNDS):
+        # theta
+        C = [A[x][0] ^ A[x][1] ^ A[x][2] ^ A[x][3] ^ A[x][4] for x in range(5)]
+        D = [C[(x - 1) % 5] ^ _rol(C[(x + 1) % 5], 1) for x in range(5)]
+        for x in range(5):
+            for y in range(5):
+                A[x][y] ^= D[x]
+        # rho + pi
+        B = [[0] * 5 for _ in range(5)]
+        for x in range(5):
+            for y in range(5):
+                B[y][(2 * x + 3 * y) % 5] = _rol(A[x][y], _ROT[x][y])
+        # chi
+        for x in range(5):
+            for y in range(5):
+                A[x][y] = B[x][y] ^ ((~B[(x + 1) % 5][y]) & B[(x + 2) % 5][y])
+        # iota
+        A[0][0] ^= _RC[rnd]
+
+
+def keccak256(data: bytes) -> bytes:
+    rate = 136  # 1088-bit rate for 256-bit output
+    # legacy multi-rate padding: 0x01 ... 0x80
+    padlen = rate - (len(data) % rate)
+    padded = data + (
+        b"\x81" if padlen == 1 else b"\x01" + b"\x00" * (padlen - 2) + b"\x80"
+    )
+    A = [[0] * 5 for _ in range(5)]
+    for off in range(0, len(padded), rate):
+        block = padded[off : off + rate]
+        for i in range(rate // 8):
+            lane = int.from_bytes(block[8 * i : 8 * i + 8], "little")
+            A[i % 5][i // 5] ^= lane
+        _keccak_f(A)
+    out = b""
+    for i in range(4):  # 32 bytes = 4 lanes
+        out += A[i % 5][i // 5].to_bytes(8, "little")
+    return out

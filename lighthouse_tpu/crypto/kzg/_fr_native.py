@@ -1,0 +1,111 @@
+"""ctypes binding for the native Fr batch engine (native/fr_field.cpp).
+
+The reference's KZG host math is C (c-kzg via crypto/kzg/src/lib.rs);
+this is the analogous native seam for the barycentric-evaluation hot
+path. Builds on demand with g++ (cached by source mtime, same pattern
+as node/native_store.py); callers fall back to the pure-Python Fr path
+when no toolchain is available — identical results, just slower.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_SRC = os.path.join(
+    os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    ),
+    "native",
+    "fr_field.cpp",
+)
+_SO = os.path.join(os.path.dirname(_SRC), "build", "libfr_field.so")
+
+_lib = None
+_build_err: Optional[str] = None
+_build_lock = threading.Lock()
+
+
+def _load():
+    global _lib, _build_err
+    with _build_lock:
+        if _lib is not None or _build_err is not None:
+            return _lib
+        try:
+            os.makedirs(os.path.dirname(_SO), exist_ok=True)
+            if (
+                not os.path.exists(_SO)
+                or os.path.getmtime(_SO) < os.path.getmtime(_SRC)
+            ):
+                subprocess.run(
+                    ["g++", "-O2", "-shared", "-fPIC", "-o", _SO, _SRC],
+                    check=True,
+                    capture_output=True,
+                )
+            lib = ctypes.CDLL(_SO)
+            lib.fr_eval_barycentric.restype = ctypes.c_int
+            lib.fr_eval_barycentric.argtypes = [
+                ctypes.c_char_p,
+                ctypes.c_char_p,
+                ctypes.c_char_p,
+                ctypes.c_long,
+                ctypes.c_long,
+                ctypes.c_char_p,
+            ]
+            lib.fr_batch_inverse.restype = ctypes.c_int
+            lib.fr_batch_inverse.argtypes = [
+                ctypes.c_char_p,
+                ctypes.c_long,
+                ctypes.c_char_p,
+            ]
+            _lib = lib
+        except (OSError, subprocess.CalledProcessError) as e:
+            _build_err = str(e)
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+_ROOTS_BYTES_CACHE: dict = {}
+
+
+def _roots_bytes(roots) -> bytes:
+    # roots lists are long-lived TrustedSetup members; key by identity.
+    # The cache entry HOLDS the keying list so its id can never be
+    # recycled by a different roots object while the entry lives
+    # (id-reuse after GC would silently serve another setup's domain).
+    entry = _ROOTS_BYTES_CACHE.get(id(roots))
+    if entry is None or entry[0] is not roots:
+        encoded = b"".join(int(w).to_bytes(32, "big") for w in roots)
+        _ROOTS_BYTES_CACHE.clear()  # setups change rarely; keep one
+        _ROOTS_BYTES_CACHE[id(roots)] = (roots, encoded)
+        return encoded
+    return entry[1]
+
+
+def eval_barycentric_batch(blobs, zs, roots) -> Optional[list]:
+    """[blob bytes] x [z ints] -> [y ints] via the native engine, or
+    None when the library is unavailable. Raises ValueError on a
+    non-canonical field element (mirrors bytes_to_fr)."""
+    lib = _load()
+    if lib is None:
+        return None
+    n = len(roots)
+    fields = b"".join(blobs)
+    zbytes = b"".join(int(z).to_bytes(32, "big") for z in zs)
+    out = ctypes.create_string_buffer(32 * len(blobs))
+    rc = lib.fr_eval_barycentric(
+        fields, zbytes, _roots_bytes(roots), len(blobs), n, out
+    )
+    if rc != 0:
+        raise ValueError(f"non-canonical field element (index {-rc - 1})")
+    raw = out.raw
+    return [
+        int.from_bytes(raw[32 * i : 32 * (i + 1)], "big")
+        for i in range(len(blobs))
+    ]

@@ -55,4 +55,5 @@ def device_kzg(setup: TrustedSetup = None) -> Kzg:
         setup,
         msm=dev_msm.msm_g1,
         pairing=pairings_product_is_one_device,
+        msm_multi=dev_msm.msm_g1_groups,
     )

@@ -1,0 +1,246 @@
+"""Execution block-hash verification (block_hash.rs analog).
+
+The engine/builder APIs hand the CL an ExecutionPayload whose
+`block_hash` field is CLAIMED; binding it requires re-deriving the hash
+the EL way: keccak256 of the RLP-encoded execution block header, whose
+transactions_root / withdrawals_root are ordered Merkle-Patricia trie
+roots over the raw payload lists
+(beacon_node/execution_layer/src/block_hash.rs:17-59).
+
+RLP, the hex-prefix trie, and the header field order are implemented
+from the Ethereum specs; correctness is pinned by the reference's own
+test vectors (two synthetic headers with full RLP expectations plus
+real mainnet blocks 16182891 / a deneb devnet block —
+tests/test_block_hash.py).
+"""
+
+from __future__ import annotations
+
+from ..crypto.keccak import keccak256
+
+KECCAK_EMPTY_LIST_RLP = bytes.fromhex(
+    "1dcc4de8dec75d7aab85b567b6ccd41ad312451b948a7413f0a142fd40d49347"
+)
+
+
+# ---------------------------------------------------------------- RLP
+
+
+def rlp_bytes(b: bytes) -> bytes:
+    if len(b) == 1 and b[0] < 0x80:
+        return b
+    if len(b) < 56:
+        return bytes([0x80 + len(b)]) + b
+    ln = _minimal_be(len(b))
+    return bytes([0xB7 + len(ln)]) + ln + b
+
+
+def rlp_int(x: int) -> bytes:
+    """Integers are big-endian minimal-length byte strings (0 -> empty)."""
+    return rlp_bytes(b"" if x == 0 else _minimal_be(x))
+
+
+def rlp_list(items: list) -> bytes:
+    body = b"".join(items)
+    if len(body) < 56:
+        return bytes([0xC0 + len(body)]) + body
+    ln = _minimal_be(len(body))
+    return bytes([0xF7 + len(ln)]) + ln + body
+
+
+def _minimal_be(x: int) -> bytes:
+    return x.to_bytes((x.bit_length() + 7) // 8 or 1, "big")
+
+
+# ------------------------------------------------- ordered trie (MPT)
+
+
+def _hex_prefix(nibbles: list, leaf: bool) -> bytes:
+    flag = 2 if leaf else 0
+    if len(nibbles) % 2:
+        out = [(flag + 1) << 4 | nibbles[0]]
+        rest = nibbles[1:]
+    else:
+        out = [flag << 4]
+        rest = nibbles
+    for i in range(0, len(rest), 2):
+        out.append(rest[i] << 4 | rest[i + 1])
+    return bytes(out)
+
+
+def _nibbles(key: bytes) -> list:
+    out = []
+    for b in key:
+        out.append(b >> 4)
+        out.append(b & 0xF)
+    return out
+
+
+def _node_ref(encoded: bytes) -> bytes:
+    """Nodes < 32 bytes embed inline; otherwise the keccak hash."""
+    return encoded if len(encoded) < 32 else rlp_bytes(keccak256(encoded))
+
+
+def _build_trie(items: list, depth: int) -> bytes:
+    """items: [(nibble_list, value_bytes)] all distinct; returns the
+    rlp-encoded node."""
+    if not items:
+        return rlp_bytes(b"")
+    if len(items) == 1:
+        nib, val = items[0]
+        return rlp_list([rlp_bytes(_hex_prefix(nib, True)), rlp_bytes(val)])
+    # common prefix -> extension node
+    first = items[0][0]
+    prefix_len = 0
+    while all(
+        len(nib) > prefix_len and nib[prefix_len] == first[prefix_len]
+        for nib, _ in items
+    ):
+        prefix_len += 1
+    if prefix_len:
+        child = _build_trie(
+            [(nib[prefix_len:], v) for nib, v in items], depth + prefix_len
+        )
+        return rlp_list(
+            [rlp_bytes(_hex_prefix(first[:prefix_len], False)), _node_ref(child)]
+        )
+    # branch node
+    slots = [b"" for _ in range(16)]
+    value = b""
+    buckets: dict = {}
+    for nib, v in items:
+        if not nib:
+            value = v
+            continue
+        buckets.setdefault(nib[0], []).append((nib[1:], v))
+    children = []
+    for k in range(16):
+        if k in buckets:
+            child = _build_trie(buckets[k], depth + 1)
+            children.append(_node_ref(child))
+        else:
+            children.append(rlp_bytes(b""))
+    children.append(rlp_bytes(value))
+    return rlp_list(children)
+
+
+def ordered_trie_root(values: list) -> bytes:
+    """Root of the MPT keyed by rlp(index) — the transactions /
+    withdrawals trie shape (triehash::ordered_trie_root)."""
+    items = [(_nibbles(rlp_int(i)), v) for i, v in enumerate(values)]
+    root_node = _build_trie(items, 0)
+    return keccak256(root_node)
+
+
+# ------------------------------------------------------------ header
+
+
+def rlp_encode_withdrawal(w) -> bytes:
+    return rlp_list(
+        [
+            rlp_int(int(w.index)),
+            rlp_int(int(w.validator_index)),
+            rlp_bytes(bytes(w.address)),
+            rlp_int(int(w.amount)),
+        ]
+    )
+
+
+def rlp_encode_block_header(
+    *,
+    parent_hash: bytes,
+    ommers_hash: bytes,
+    beneficiary: bytes,
+    state_root: bytes,
+    transactions_root: bytes,
+    receipts_root: bytes,
+    logs_bloom: bytes,
+    difficulty: int,
+    number: int,
+    gas_limit: int,
+    gas_used: int,
+    timestamp: int,
+    extra_data: bytes,
+    mix_hash: bytes,
+    nonce: bytes,
+    base_fee_per_gas: int = None,
+    withdrawals_root: bytes = None,
+    blob_gas_used: int = None,
+    excess_blob_gas: int = None,
+    parent_beacon_block_root: bytes = None,
+) -> bytes:
+    """EncodableExecutionBlockHeader field order
+    (consensus/types/src/execution_block_header.rs:34-54); the optional
+    tail fields append in fork order and are never encoded as empty."""
+    fields = [
+        rlp_bytes(parent_hash),
+        rlp_bytes(ommers_hash),
+        rlp_bytes(beneficiary),
+        rlp_bytes(state_root),
+        rlp_bytes(transactions_root),
+        rlp_bytes(receipts_root),
+        rlp_bytes(logs_bloom),
+        rlp_int(difficulty),
+        rlp_int(number),
+        rlp_int(gas_limit),
+        rlp_int(gas_used),
+        rlp_int(timestamp),
+        rlp_bytes(extra_data),
+        rlp_bytes(mix_hash),
+        rlp_bytes(nonce),
+    ]
+    if base_fee_per_gas is not None:
+        fields.append(rlp_int(base_fee_per_gas))
+    if withdrawals_root is not None:
+        fields.append(rlp_bytes(withdrawals_root))
+    if blob_gas_used is not None:
+        fields.append(rlp_int(blob_gas_used))
+    if excess_blob_gas is not None:
+        fields.append(rlp_int(excess_blob_gas))
+    if parent_beacon_block_root is not None:
+        fields.append(rlp_bytes(parent_beacon_block_root))
+    return rlp_list(fields)
+
+
+def calculate_execution_block_hash(
+    payload, parent_beacon_block_root: bytes = None
+) -> tuple:
+    """(block_hash, transactions_root) from an ExecutionPayload
+    (block_hash.rs:17 calculate_execution_block_hash)."""
+    tx_root = ordered_trie_root([bytes(t) for t in payload.transactions])
+    withdrawals = getattr(payload, "withdrawals", None)
+    withdrawals_root = (
+        ordered_trie_root([rlp_encode_withdrawal(w) for w in withdrawals])
+        if withdrawals is not None
+        else None
+    )
+    rlp = rlp_encode_block_header(
+        parent_hash=bytes(payload.parent_hash),
+        ommers_hash=KECCAK_EMPTY_LIST_RLP,
+        beneficiary=bytes(payload.fee_recipient),
+        state_root=bytes(payload.state_root),
+        transactions_root=tx_root,
+        receipts_root=bytes(payload.receipts_root),
+        logs_bloom=bytes(payload.logs_bloom),
+        difficulty=0,
+        number=int(payload.block_number),
+        gas_limit=int(payload.gas_limit),
+        gas_used=int(payload.gas_used),
+        timestamp=int(payload.timestamp),
+        extra_data=bytes(payload.extra_data),
+        mix_hash=bytes(payload.prev_randao),
+        nonce=b"\x00" * 8,
+        base_fee_per_gas=int(payload.base_fee_per_gas),
+        withdrawals_root=withdrawals_root,
+        blob_gas_used=int(payload.blob_gas_used),
+        excess_blob_gas=int(payload.excess_blob_gas),
+        parent_beacon_block_root=parent_beacon_block_root,
+    )
+    return keccak256(rlp), tx_root
+
+
+def verify_payload_block_hash(payload, parent_beacon_block_root: bytes = None) -> bool:
+    """True iff the payload's claimed block_hash matches the re-derived
+    one (the import-path check block_hash.rs exists to power)."""
+    got, _ = calculate_execution_block_hash(payload, parent_beacon_block_root)
+    return got == bytes(payload.block_hash)

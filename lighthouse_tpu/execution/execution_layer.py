@@ -72,6 +72,14 @@ class ExecutionLayer:
         (block_verification's ExecutionPendingBlock stage). INVALID
         raises so the block is rejected outright; SYNCING/ACCEPTED map
         to OPTIMISTIC (optimistic sync, resolved by later fcu)."""
+        # client-side keccak/RLP hash binding BEFORE trusting the EL
+        # (execution_layer/src/block_hash.rs via execution_payload.rs:
+        # a payload whose claimed hash doesn't re-derive is invalid no
+        # matter what the engine says)
+        from .block_hash import verify_payload_block_hash
+
+        if not verify_payload_block_hash(payload, parent_beacon_block_root):
+            raise InvalidPayload("block_hash does not match RLP header keccak")
         hashes = [
             kzg_commitment_to_versioned_hash(bytes(c))
             for c in blob_commitments

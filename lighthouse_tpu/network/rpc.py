@@ -1,18 +1,20 @@
 """Req/resp RPC (lighthouse_network rpc/protocol.rs:294-334 analog).
 
 Protocols carried: Status, Goodbye, Ping, MetaData, BlocksByRange,
-BlocksByRoot, BlobsByRange, BlobsByRoot — the sync-critical subset of
-the reference's 14 (light-client and PeerDAS column protocols slot into
-the same enum when those subsystems land).
+BlocksByRoot, BlobsByRange, BlobsByRoot, light-client and PeerDAS
+column protocols.
 
-Framing over the transport's RPC channel:
-  request : <req_id u32><proto u8><is_resp=0><ssz payload>
-  response: <req_id u32><proto u8><is_resp=1><code u8><n u16><len-prefixed chunks>
+Framing over the transport's RPC channel (round 4):
+  <req_id u32><proto u8><is_resp u8>  -- mux header: the stream-id role
+                                         yamux plays in the reference
+  then SPEC-EXACT ssz_snappy chunk bytes (network/rpc_codec.py,
+  rpc/codec.rs parity):
+  request : <uvarint ssz_len><snappy-FRAME(ssz)>
+  response: chunks of <result u8>[<context 4B>]<uvarint len><frames>
 
 Responses are chunk lists (a BlocksByRange response is a chunk per
-block, like the reference's streamed chunks, rpc/codec.rs). An inbound
-token-bucket rate limiter guards each protocol (rpc/rate_limiter.rs:531
-role).
+block, like the reference's streamed chunks). An inbound token-bucket
+rate limiter guards each protocol (rpc/rate_limiter.rs:531 role).
 """
 
 from __future__ import annotations
@@ -24,6 +26,7 @@ from enum import IntEnum
 from typing import Callable, Optional
 
 from ..consensus.ssz import Container, uint64, Bytes4, Bytes32
+from . import rpc_codec
 from .transport import CHANNEL_RPC, Endpoint
 
 
@@ -49,11 +52,48 @@ class Protocol(IntEnum):
 
 
 class ResponseCode(IntEnum):
+    """Wire values per methods.rs:614-635 (round 4: RATE_LIMITED moved
+    from the private value 4 to the spec's 139)."""
+
     SUCCESS = 0
     INVALID_REQUEST = 1
     SERVER_ERROR = 2
     RESOURCE_UNAVAILABLE = 3
-    RATE_LIMITED = 4
+    RATE_LIMITED = 139
+    BLOBS_NOT_FOUND = 140
+
+
+# Protocol -> (rpc_codec name, has_context_bytes); DISCOVERY is the
+# boot-node's private protocol (no spec id).
+_PROTO_NAMES = {
+    Protocol.STATUS: "status",
+    Protocol.GOODBYE: "goodbye",
+    Protocol.PING: "ping",
+    Protocol.METADATA: "metadata",
+    Protocol.BLOCKS_BY_RANGE: "beacon_blocks_by_range",
+    Protocol.BLOCKS_BY_ROOT: "beacon_blocks_by_root",
+    Protocol.BLOBS_BY_RANGE: "blob_sidecars_by_range",
+    Protocol.BLOBS_BY_ROOT: "blob_sidecars_by_root",
+    Protocol.LIGHT_CLIENT_BOOTSTRAP: "light_client_bootstrap",
+    Protocol.LIGHT_CLIENT_OPTIMISTIC_UPDATE: "light_client_optimistic_update",
+    Protocol.LIGHT_CLIENT_FINALITY_UPDATE: "light_client_finality_update",
+    Protocol.LIGHT_CLIENT_UPDATES_BY_RANGE: "light_client_updates_by_range",
+    Protocol.DATA_COLUMNS_BY_ROOT: "data_column_sidecars_by_root",
+    Protocol.DATA_COLUMNS_BY_RANGE: "data_column_sidecars_by_range",
+}
+
+
+def protocol_has_context(proto: Protocol) -> bool:
+    name = _PROTO_NAMES.get(proto)
+    if name is None:
+        return False
+    return rpc_codec.PROTOCOL_IDS[name][1]
+
+
+def protocol_id(proto: Protocol) -> str:
+    """The spec's /eth2/beacon_chain/req/... identifier."""
+    name = _PROTO_NAMES.get(proto)
+    return rpc_codec.PROTOCOL_IDS[name][0] if name else f"/lh-tpu/{proto.name}"
 
 
 Status = Container(
@@ -135,10 +175,18 @@ class RpcHandler:
     """Owns request issue/dispatch over an endpoint. Server behavior is
     supplied as per-protocol callables returning (code, [chunks])."""
 
-    def __init__(self, endpoint: Endpoint, clock=time.monotonic):
+    def __init__(
+        self,
+        endpoint: Endpoint,
+        clock=time.monotonic,
+        fork_digest: bytes = b"\x00\x00\x00\x00",
+    ):
         self.endpoint = endpoint
         self.handlers: dict[Protocol, Callable] = {}
         self.limiter = RateLimiter(clock)
+        # context bytes stamped on success chunks of context-carrying
+        # protocols (the fork digest of the payload's fork)
+        self.fork_digest = fork_digest
         self._next_req = 0
         # req_id -> (protocol, callback(peer, code, chunks))
         self._pending: dict[int, tuple] = {}
@@ -158,7 +206,9 @@ class RpcHandler:
         # the target peer is recorded so another peer cannot forge or
         # cancel this request's response with a guessed req_id
         self._pending[req_id] = (proto, peer_id, callback)
-        frame = struct.pack("<IBB", req_id, proto, 0) + payload
+        frame = struct.pack("<IBB", req_id, proto, 0) + rpc_codec.encode_request(
+            payload
+        )
         if not self.endpoint.send(peer_id, CHANNEL_RPC, frame):
             self._pending.pop(req_id, None)
             callback(peer_id, ResponseCode.RESOURCE_UNAVAILABLE, [])
@@ -185,12 +235,16 @@ class RpcHandler:
                 raise MalformedFrame("response from wrong peer")
             self._pending.pop(req_id, None)
             try:
-                code, chunks = _decode_response(body)
-            except (struct.error, ValueError) as e:
+                code, chunks = _decode_response(proto, body)
+            except (rpc_codec.RpcCodecError, ValueError) as e:
                 raise MalformedFrame(str(e)) from None
             callback(sender, code, chunks)
             return
         # request path
+        try:
+            body = rpc_codec.decode_request(body)
+        except rpc_codec.RpcCodecError as e:
+            raise MalformedFrame(str(e)) from None
         if not self.limiter.allow(sender, proto):
             self._respond(sender, req_id, proto, ResponseCode.RATE_LIMITED, [])
             return
@@ -210,20 +264,28 @@ class RpcHandler:
         self._respond(sender, req_id, proto, code, chunks)
 
     def _respond(self, peer, req_id, proto, code, chunks) -> None:
-        frame = (
-            struct.pack("<IBB", req_id, proto, 1)
-            + struct.pack("<BH", code, len(chunks))
-            + b"".join(struct.pack("<I", len(c)) + c for c in chunks)
-        )
+        """Success: one spec chunk per payload (context bytes stamped on
+        context-carrying protocols). Error: one chunk whose ssz body is
+        the ErrorType message (rpc/codec.rs RpcResponse::Error arm)."""
+        ctx = self.fork_digest if protocol_has_context(proto) else None
+        if code == ResponseCode.SUCCESS:
+            body = b"".join(
+                rpc_codec.encode_response_chunk(int(code), c, ctx)
+                for c in chunks
+            )
+        else:
+            body = rpc_codec.encode_response_chunk(int(code), b"")
+        frame = struct.pack("<IBB", req_id, proto, 1) + body
         self.endpoint.send(peer, CHANNEL_RPC, frame)
 
 
-def _decode_response(body: bytes) -> tuple:
-    code, n = struct.unpack("<BH", body[:3])
-    chunks, pos = [], 3
-    for _ in range(n):
-        (ln,) = struct.unpack("<I", body[pos : pos + 4])
-        pos += 4
-        chunks.append(body[pos : pos + ln])
-        pos += ln
-    return ResponseCode(code), chunks
+def _decode_response(proto: Protocol, body: bytes) -> tuple:
+    parsed = rpc_codec.decode_response_chunks(
+        body, has_context=protocol_has_context(proto)
+    )
+    if not parsed:
+        return ResponseCode.SUCCESS, []
+    first = parsed[0][0]
+    if first != rpc_codec.SUCCESS:
+        return ResponseCode(first), []
+    return ResponseCode.SUCCESS, [ssz for _, _, ssz in parsed]

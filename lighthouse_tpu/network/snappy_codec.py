@@ -1,9 +1,11 @@
 """Pure-Python snappy BLOCK format codec (no C dependency).
 
-The gossip wire and the req/resp chunk payloads are snappy-compressed in
-the reference (gossipsub message transform, service/mod.rs:107; SSZ-
-snappy RPC codec, rpc/codec.rs). No snappy binding ships in this image,
-so the format is implemented here:
+The gossip wire is snappy-BLOCK-compressed in the reference (gossipsub
+message transform, service/mod.rs:107). NOTE the req/resp spec uses the
+snappy FRAME format instead (rpc/codec.rs) — that lives in
+`network.rpc_codec` (round 4); THIS module's block format matches the
+gossip transform and the internal socket-transport framing only
+(advisor r3: the old docstring overstated rpc/codec.rs parity).
 
 - `decompress` handles the FULL block format (literals + all three copy
   tag encodings) — required to read peers' compressed frames.
@@ -55,11 +57,21 @@ def _put_uvarint(n: int) -> bytes:
             return bytes(out)
 
 
-def decompress(data: bytes) -> bytes:
+def decompress(data: bytes, max_output: int = 1 << 25) -> bytes:
+    """Decode a snappy block stream, refusing decompression bombs.
+
+    `max_output` (default 32 MiB) bounds the DECLARED length up front
+    and the produced length as copies expand — a hostile 16 MiB frame
+    could otherwise expand ~350x and pin a reader thread for minutes
+    (advisor r3, medium)."""
     want, pos = _uvarint(data, 0)
+    if want > max_output:
+        raise SnappyError(f"declared length {want} > cap {max_output}")
     out = bytearray()
     n = len(data)
     while pos < n:
+        if len(out) > want:
+            raise SnappyError("output exceeds declared length")
         tag = data[pos]
         pos += 1
         kind = tag & 3
@@ -97,10 +109,16 @@ def decompress(data: bytes) -> bytes:
             pos += 4
         if off == 0 or off > len(out):
             raise SnappyError("bad copy offset")
-        # overlapping copies are byte-serial by definition
         start = len(out) - off
-        for i in range(ln):
-            out.append(out[start + i])
+        if off >= ln:
+            # non-overlapping: one slice copy
+            out += out[start : start + ln]
+        else:
+            # overlapping copy == repeat the trailing `off` bytes; build
+            # it with slice ops instead of a per-byte Python loop
+            pattern = bytes(out[start:])
+            reps, rem = divmod(ln, off)
+            out += pattern * reps + pattern[:rem]
     if len(out) != want:
         raise SnappyError(
             f"length mismatch: header {want}, decoded {len(out)}"

@@ -32,6 +32,11 @@ from .transport import Frame
 
 CHANNEL_HELLO = 255
 _MAX_FRAME = 1 << 24  # 16 MiB cap (DoS guard; RPC chunks are far smaller)
+# Per-peer inbox high-water mark: a peer with this many frames QUEUED
+# (not yet drained) gets disconnected instead of exhausting memory —
+# per-peer accounting so a flooder can't get honest peers shed
+# (advisor r3 + round-4 review).
+_MAX_INBOX_PER_PEER = 4096
 
 
 class SocketEndpoint:
@@ -40,6 +45,7 @@ class SocketEndpoint:
     def __init__(self, peer_id: str, host: str = "127.0.0.1", port: int = 0):
         self.peer_id = peer_id
         self._inbox: deque[Frame] = deque()
+        self._inbox_counts: dict[str, int] = {}
         self._lock = threading.Lock()
         self._conns: dict[str, socket.socket] = {}
         self._closed = False
@@ -112,8 +118,15 @@ class SocketEndpoint:
             while not self._closed:
                 ch, payload = _recv_frame(s)
                 with self._lock:
+                    if self._inbox_counts.get(peer, 0) >= _MAX_INBOX_PER_PEER:
+                        raise ConnectionError(
+                            f"inbox overflow from {peer}: disconnecting"
+                        )
                     self._inbox.append(
                         Frame(sender=peer, channel=ch, payload=payload)
+                    )
+                    self._inbox_counts[peer] = (
+                        self._inbox_counts.get(peer, 0) + 1
                     )
         except (OSError, ConnectionError, snappy.SnappyError):
             pass
@@ -141,17 +154,32 @@ class SocketEndpoint:
 
     def poll(self) -> Optional[Frame]:
         with self._lock:
-            return self._inbox.popleft() if self._inbox else None
+            if not self._inbox:
+                return None
+            f = self._inbox.popleft()
+            self._dec_count(f.sender)
+            return f
 
     def drain(self) -> list:
         with self._lock:
             out = list(self._inbox)
             self._inbox.clear()
+            self._inbox_counts.clear()
             return out
+
+    def _dec_count(self, peer: str) -> None:
+        c = self._inbox_counts.get(peer, 0) - 1
+        if c <= 0:
+            self._inbox_counts.pop(peer, None)
+        else:
+            self._inbox_counts[peer] = c
 
     def push(self, frame: Frame) -> None:
         with self._lock:
             self._inbox.append(frame)
+            self._inbox_counts[frame.sender] = (
+                self._inbox_counts.get(frame.sender, 0) + 1
+            )
 
     def connected_peers(self) -> list:
         with self._lock:

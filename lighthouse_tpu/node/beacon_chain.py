@@ -1546,21 +1546,42 @@ class BeaconChain:
         block to `process_blinded_block`, which reveals the payload and
         imports the full block. ANY builder failure — transport, no
         bid, or a consensus-invalid header — falls back to the local
-        payload. The bid fetch is bounded by the transport timeout and
-        keyed to a pre-lock head snapshot (a stale bid is dropped);
-        moving it fully off the lock needs the async production
-        pipeline (reference: execution_layer's block-production task)."""
+        payload. The remote bid fetch runs entirely OUTSIDE the chain
+        lock (advisor r3: a slow builder must never stall imports or
+        attestation processing); the proposer pubkey comes from the
+        proposer cache against a pre-fetch head snapshot, and the bid
+        is dropped if the head moves before packing."""
         builder_bid = None
         if builder is not None:
-            # snapshot (parent_hash, head) OUTSIDE the main lock hold:
-            # the remote bid fetch below must not stall chain imports,
-            # and a bid is dropped if the head moves before packing
+            from ..execution.builder_client import BuilderError
+            from .caches import shuffling_decision_root
+
             with self._lock:
                 head_root = self.head.root
-                parent_hash = bytes(
-                    self.head_state().latest_execution_payload_header.block_hash
-                )
-            builder_bid = (parent_hash, head_root)
+                hs = self.head_state()
+                pubkey = None
+                if hs is not None:
+                    parent_hash = bytes(
+                        hs.latest_execution_payload_header.block_hash
+                    )
+                    e = st.compute_epoch_at_slot(self.spec, slot)
+                    decision = shuffling_decision_root(
+                        self.spec, hs, e + 1, head_root
+                    )
+                    proposers = self.proposer_cache.get_epoch_proposers(
+                        self.spec, hs, e, decision
+                    )
+                    start = st.compute_start_slot_at_epoch(self.spec, e)
+                    pubkey = bytes(
+                        hs.validators[proposers[slot - start]].pubkey
+                    )
+            if pubkey is not None:
+                try:  # the HTTP fetch — no lock held
+                    bid = builder.get_header(slot, parent_hash, pubkey)
+                except BuilderError:
+                    bid = None
+                if bid is not None:
+                    builder_bid = (head_root, bid)
         with self._lock:
             head_state = self.head_state()
             if head_state is None:
@@ -1604,16 +1625,14 @@ class BeaconChain:
                 body=body,
             )
             builder_header = None
-            if builder_bid is not None and builder_bid[1] == parent_root:
+            if builder_bid is not None and builder_bid[0] == parent_root:
                 from ..execution.builder_client import (
                     BuilderError,
                     choose_payload,
                 )
 
-                pubkey = bytes(state.validators[proposer].pubkey)
                 try:
-                    bid = builder.get_header(slot, builder_bid[0], pubkey)
-                    chosen = choose_payload(local_payload, bid)
+                    chosen = choose_payload(local_payload, builder_bid[1])
                     if chosen[0] == "builder":
                         builder_header = chosen[1]
                 except BuilderError:

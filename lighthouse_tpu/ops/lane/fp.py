@@ -170,12 +170,16 @@ def _reduce_light_body(x, folds, topf):
 
 
 def _lane_tile(n_elems_per_lane: int) -> int:
-    """Lane-tile size keeping the working set well under VMEM (~16 MB).
+    """Lane-tile size keeping the working set under the (raised, 64 MB)
+    scoped-VMEM limit.
 
     n_elems_per_lane = number of Fp elements per batch lane inside the
-    kernel (stack size x intermediates multiplier)."""
+    kernel (stack size x intermediates multiplier). LH_TPU_TILE_BUDGET
+    overrides the per-kernel byte budget for experiments."""
+    import os
+
     # ~6 live CONVW-wide int32 copies per mul in flight, 4 bytes each
-    budget = 6 * 1024 * 1024
+    budget = int(os.environ.get("LH_TPU_TILE_BUDGET", 6 * 1024 * 1024))
     per_lane = n_elems_per_lane * CONVW * 4 * 6
     ts = budget // max(per_lane, 1)
     if ts < 128:
@@ -193,17 +197,21 @@ def kernel_op(fn, name: str):
 
     def dispatch(*arrays, **kw):
         S = arrays[0].shape[-1]
-        # tiny lane counts (the per-batch finish tail) pad to a full
-        # 128-lane tile inside Mosaic for no win — plain XLA is right
-        if not use_pallas() or S < 128:
+        if not use_pallas():
             return fn(_FOLDS, _TOPFM, *arrays, **kw)
+        # tiny lane counts (the per-batch finish tail: lane_product /
+        # final_exp / inversions at S == 1) still dispatch ONE padded
+        # 128-lane kernel: the wasted lanes are free, while the XLA
+        # fallback fans each field op into hundreds of tiny HLO ops —
+        # the dispatch-bound path behind round 3's 0.19 s fixed launch
+        # overhead (BASELINE.md round-4 note).
         outs = jax.eval_shape(
             lambda *a: fn(_FOLDS, _TOPFM, *a, **kw), *arrays
         )
         tuple_out = isinstance(outs, (tuple, list))
         out_shapes = outs if tuple_out else (outs,)
         stack = sum(int(np.prod(a.shape[:-1])) for a in arrays) // W + 1
-        ts = min(_lane_tile(stack), S)
+        ts = min(_lane_tile(stack), max(S, 128))
         spad = -S % ts
         if spad:  # pad the lane axis up to a tile multiple (VMEM budget)
             arrays = tuple(

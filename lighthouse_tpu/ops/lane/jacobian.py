@@ -121,6 +121,29 @@ def _is_zero(Z, ncomp):
     return jnp.all(Z == 0, axis=axes)
 
 
+def _ladder_step_body(folds, topf, X1, Y1, Z1, Xa, Ya, Za, bit, f2: bool):
+    """ONE fused dynamic-ladder step (round 4): conditional-add via
+    in-kernel select + doubling of the addend chain. bit [1, S] int32.
+    Replaces three dispatches (add kernel, XLA where, dbl kernel) and
+    the HBM round-trips between them."""
+    added = _add_body(folds, topf, X1, Y1, Z1, Xa, Ya, Za, f2)
+    ncomp = 2 if f2 else 1
+    flag = bit[..., 0, :] != 0
+    acc = tuple(
+        _wh(flag, a, o, ncomp) for a, o in zip(added, (X1, Y1, Z1))
+    )
+    dbl = _dbl_body(folds, topf, Xa, Ya, Za, f2)
+    return (*acc, *dbl)
+
+
+def _ladder_step_f1_body(folds, topf, *args):
+    return _ladder_step_body(folds, topf, *args, f2=False)
+
+
+def _ladder_step_f2_body(folds, topf, *args):
+    return _ladder_step_body(folds, topf, *args, f2=True)
+
+
 def _dbl_f1_body(folds, topf, X, Y, Z):
     return _dbl_body(folds, topf, X, Y, Z, f2=False)
 
@@ -141,6 +164,8 @@ _dbl_f1 = fp.kernel_op(_dbl_f1_body, "jac_dbl_f1")
 _dbl_f2 = fp.kernel_op(_dbl_f2_body, "jac_dbl_f2")
 _add_f1 = fp.kernel_op(_add_f1_body, "jac_add_f1")
 _add_f2 = fp.kernel_op(_add_f2_body, "jac_add_f2")
+_ladder_step_f1 = fp.kernel_op(_ladder_step_f1_body, "ladder_step_f1")
+_ladder_step_f2 = fp.kernel_op(_ladder_step_f2_body, "ladder_step_f2")
 
 
 FP1 = SimpleNamespace(
@@ -155,6 +180,7 @@ FP1 = SimpleNamespace(
     zeros=lambda shape, S: jnp.zeros((*shape, W, S), dtype=jnp.int32),
     dbl=_dbl_f1,
     addk=_add_f1,
+    ladder_step=_ladder_step_f1,
 )
 
 FP2 = SimpleNamespace(
@@ -169,6 +195,7 @@ FP2 = SimpleNamespace(
     zeros=lambda shape, S: jnp.zeros((*shape, 2, W, S), dtype=jnp.int32),
     dbl=_dbl_f2,
     addk=_add_f2,
+    ladder_step=_ladder_step_f2,
 )
 
 
@@ -273,23 +300,23 @@ def neg(ops, p):
 
 def scalar_mul(ops, base, bits):
     """[k]base for per-element scalars; bits int32/bool [nbits, S]
-    (LSB first), as a lax.scan (ONE fused dbl + add body in the HLO —
-    per-element bits force the conditional add to be computed and
-    selected every step)."""
+    (LSB first), as a lax.scan whose body is ONE fused
+    add+select+double kernel (per-element bits force the conditional
+    add to be computed and selected every step — the select rides
+    inside the kernel, round 4)."""
     import jax
 
     S = base[0].shape[-1]
     shape = base[0].shape[: base[0].ndim - ops.ndim - 1]
     acc0 = tuple(ops.zeros(shape, S) for _ in range(3))
+    bits2 = bits.astype(jnp.int32)[:, None, :]  # [nbits, 1, S]
 
     def step(carry, bit):
         acc, addend = carry
-        added = add(ops, acc, addend)
-        acc = tuple(ops.wh(bit, a, o) for a, o in zip(added, acc))
-        addend = double(ops, addend)
-        return (acc, addend), None
+        out = ops.ladder_step(*acc, *addend, bit)
+        return (tuple(out[:3]), tuple(out[3:])), None
 
-    (acc, _), _ = jax.lax.scan(step, (acc0, base), bits.astype(bool))
+    (acc, _), _ = jax.lax.scan(step, (acc0, base), bits2)
     return acc
 
 
@@ -338,20 +365,20 @@ def scalar_mul_with_static(ops, base, bits, static_scalar: int):
     acc0 = tuple(ops.zeros(shape, S) for _ in range(3))
     last = max(nbits, static_scalar.bit_length())
     dyn_bits = jnp.concatenate(
-        [bits.astype(bool), jnp.zeros((last - nbits, S), bool)]
-    )
+        [bits.astype(jnp.int32), jnp.zeros((last - nbits, S), jnp.int32)]
+    )[:, None, :]  # [last, 1, S]
     st_bits = jnp.asarray(_static_bits_arr(static_scalar, last))
 
     def step(carry, xs):
         bit, sbit = xs
         acc, acc_s, addend = carry
-        added = add(ops, acc, addend)
-        acc = tuple(ops.wh(bit, a, o) for a, o in zip(added, acc))
+        # the static add consumes the PRE-doubling addend (the fused
+        # kernel returns the doubled chain for the next step)
+        out = ops.ladder_step(*acc, *addend, bit)
         acc_s = jax.lax.cond(
             sbit, lambda a, d: add(ops, a, d), lambda a, d: a, acc_s, addend
         )
-        addend = double(ops, addend)
-        return (acc, acc_s, addend), None
+        return (tuple(out[:3]), acc_s, tuple(out[3:])), None
 
     (acc, acc_s, _), _ = jax.lax.scan(
         step, (acc0, acc0, base), (dyn_bits, st_bits)

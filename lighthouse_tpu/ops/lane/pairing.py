@@ -26,14 +26,7 @@ import jax.numpy as jnp
 
 from ...crypto.bls.params import P, X
 from . import fp, tower
-from .tower import (
-    f2mul_xi,
-    f12conj,
-    f12mul,
-    f12mul_034,
-    f12sqr,
-    f6mul_by_v,
-)
+from .tower import f2mul_xi, f12conj, f12mul
 
 W = fp.W
 
@@ -110,8 +103,29 @@ def _add_step_body(folds, topf, XT, YT, ZT, xQ, yQ, xP, yP):
     return X3, Y3, Z3, c0, c1, c4
 
 
-_dbl_step = fp.kernel_op(_dbl_step_body, "miller_dbl_step")
-_add_step = fp.kernel_op(_add_step_body, "miller_add_step")
+def _dbl_iter_body(folds, topf, f, XT, YT, ZT, xP, yP):
+    """ONE fused Miller doubling ITERATION: point doubling + line
+    coefficients + f12sqr(f) + sparse 034 line product, all on
+    VMEM-resident tiles (round-4; BASELINE.md roofline item 1 — the
+    three-kernel round 3 version paid two full f12 HBM round-trips per
+    iteration plus the inter-kernel glue)."""
+    X3, Y3, Z3, c0, c1, c4 = _dbl_step_body(folds, topf, XT, YT, ZT, xP, yP)
+    f2 = tower._f12sqr_body(folds, topf, f)
+    fn = tower._f12mul_034_body(folds, topf, f2, c0, c1, c4)
+    return fn, X3, Y3, Z3
+
+
+def _add_iter_body(folds, topf, f, XT, YT, ZT, xQ, yQ, xP, yP):
+    """ONE fused Miller addition iteration: add step + 034 product."""
+    X3, Y3, Z3, c0, c1, c4 = _add_step_body(
+        folds, topf, XT, YT, ZT, xQ, yQ, xP, yP
+    )
+    fn = tower._f12mul_034_body(folds, topf, f, c0, c1, c4)
+    return fn, X3, Y3, Z3
+
+
+_dbl_iter = fp.kernel_op(_dbl_iter_body, "miller_dbl_iter")
+_add_iter = fp.kernel_op(_add_iter_body, "miller_add_iter")
 
 
 # ------------------------------------------------------------ miller loop
@@ -121,8 +135,12 @@ def miller_loop(xP, yP, xQ, yQ, p_inf=None, q_inf=None):
     """Batched f_{|u|,Q}(P), conjugated (u < 0).
 
     xP/yP [..., W, S]; xQ/yQ [..., 2, W, S]; masks [..., S] bool.
-    Returns Fp12 [..., 2, 3, 2, W, S]. Unrolled over the 63 static ate
-    bits: 63 dbl steps, 5 add steps."""
+    Returns Fp12 [..., 2, 3, 2, W, S]. Scans the 63 static ate bits
+    with f initialized to 1: each step is ONE fused
+    dbl+f12sqr+line-product kernel, and the fused addition kernel runs
+    under lax.cond only on the |u| set bits (hamming weight 6). The
+    wasted f12sqr(1) of the first step costs ~1.5% of the loop and
+    halves the number of distinct Mosaic kernels vs peeling it."""
     import jax
 
     S = xP.shape[-1]
@@ -130,34 +148,25 @@ def miller_loop(xP, yP, xQ, yQ, p_inf=None, q_inf=None):
         jnp.asarray(np.stack([fp.ONE, fp.ZERO])[..., None]), S
     )
     T = (xQ, yQ, jnp.broadcast_to(one2, xQ.shape).astype(jnp.int32))
-
-    # peel iteration 0 (its f12sqr/034 degenerate to assembling the
-    # line), then scan the remaining 62 bits: the doubling body appears
-    # ONCE in the HLO and the addition body runs under lax.cond only on
-    # the |u| set bits (hamming weight 6)
-    T2 = _dbl_step(*T, xP, yP)
-    T = T2[:3]
-    f = _line_to_f12(*T2[3:], S)
-    assert _ATE_BITS[0] == 1
-    T3 = _add_step(*T, xQ, yQ, xP, yP)
-    T = T3[:3]
-    f = f12mul_034(f, *T3[3:])
+    f = jnp.broadcast_to(
+        tower.bcast(tower.F12_ONE, S), (*xQ.shape[:-3], 2, 3, 2, fp.W, S)
+    ).astype(jnp.int32)
 
     def step(carry, bit):
         f, T = carry
-        T2 = _dbl_step(*T, xP, yP)
-        f2_ = f12mul_034(f12sqr(f), *T2[3:])
+        r = _dbl_iter(f, *T, xP, yP)
+        f2_, T2 = r[0], tuple(r[1:])
 
         def with_add(f_in, T_in):
-            T3 = _add_step(*T_in, xQ, yQ, xP, yP)
-            return f12mul_034(f_in, *T3[3:]), T3[:3]
+            ra = _add_iter(f_in, *T_in, xQ, yQ, xP, yP)
+            return ra[0], tuple(ra[1:])
 
         f_n, T_n = jax.lax.cond(
-            bit, with_add, lambda f_in, T_in: (f_in, T_in), f2_, T2[:3]
+            bit, with_add, lambda f_in, T_in: (f_in, T_in), f2_, T2
         )
         return (f_n, T_n), None
 
-    bits = jnp.asarray(np.array(_ATE_BITS[1:], np.bool_))
+    bits = jnp.asarray(np.array(_ATE_BITS, np.bool_))
     (f, _), _ = jax.lax.scan(step, (f, T), bits)
     f = f12conj(f)
 
@@ -171,14 +180,6 @@ def miller_loop(xP, yP, xQ, yQ, p_inf=None, q_inf=None):
         onef = jnp.broadcast_to(onef, f.shape).astype(jnp.int32)
         f = jnp.where(inf[..., None, None, None, None, :], onef, f)
     return f
-
-
-def _line_to_f12(c0, c1, c4, S):
-    """First iteration: f = 1 * line, assembled directly."""
-    z = jnp.zeros_like(c0)
-    row0 = jnp.stack([c0, c1, z], -4)
-    row1 = jnp.stack([z, c4, z], -4)
-    return jnp.stack([row0, row1], -5)
 
 
 def lane_product(f, n: int):

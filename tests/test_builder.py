@@ -167,3 +167,31 @@ def test_preparation_service_registers_once_per_epoch():
     assert svc.register_with_builder(epoch=1) == 4
     prep = svc.prepare_proposers()
     assert len(prep) == 4 and prep[0]["fee_recipient"] == b"\xaa" * 20
+
+
+def test_bid_signature_pinned_builder():
+    """Pinned-builder mode (advisor r3): a bid signed by the mock's real
+    identity key verifies; a tampered signature or wrong pubkey is a
+    BuilderError, never an accepted header."""
+    keys, chain = _chain()
+    mock, _ = _builder_for(chain)
+    client = BuilderClient(transport=mock.request, builder_pubkey=mock.pubkey)
+    pk = keys[0].public_key().to_bytes()
+    client.register_validators(
+        [{"pubkey": "0x" + pk.hex(), "fee_recipient": "0x" + "aa" * 20,
+          "gas_limit": "30000000", "timestamp": "1", "signature": "0x" + "00" * 96}]
+    )
+    parent = bytes(chain.head_state().latest_execution_payload_header.block_hash)
+    header, value = client.get_header(1, parent, pk)
+    assert value == 10**18
+
+    mock.tamper_bid = True
+    with pytest.raises(BuilderError, match="bad bid signature"):
+        client.get_header(1, parent, pk)
+
+    mock.tamper_bid = False
+    wrong_pin = BuilderClient(
+        transport=mock.request, builder_pubkey=b"\xaa" * 48
+    )
+    with pytest.raises(BuilderError, match="pinned builder"):
+        wrong_pin.get_header(1, parent, pk)

@@ -54,26 +54,42 @@ def test_jwt_auth_roundtrip_and_rejection():
 
 
 def test_payload_status_mapping():
+    from lighthouse_tpu.execution.block_hash import (
+        calculate_execution_block_hash,
+    )
+
     mock, el = _engine()
     payload = T.ExecutionPayload.default()
     payload.parent_hash = b"\x00" * 32  # known to the mock
-    payload.block_hash = b"\x01" * 32
+    # the claimed hash must RE-DERIVE (round-4 keccak/RLP binding) —
+    # an arbitrary hash is now InvalidPayload before the engine runs
+    payload.block_hash, _ = calculate_execution_block_hash(
+        payload, b"\x22" * 32
+    )
     status = el.notify_new_payload(payload, [], b"\x22" * 32)
     assert status == ExecutionStatus.VALID
 
     orphan = T.ExecutionPayload.default()
     orphan.parent_hash = b"\x77" * 32  # unknown parent -> SYNCING
-    orphan.block_hash = b"\x78" * 32
+    orphan.block_hash, _ = calculate_execution_block_hash(
+        orphan, b"\x22" * 32
+    )
     assert el.notify_new_payload(orphan, [], b"\x22" * 32) == (
         ExecutionStatus.OPTIMISTIC
     )
 
     bad = T.ExecutionPayload.default()
     bad.parent_hash = b"\x00" * 32
-    bad.block_hash = b"\x99" * 32
-    mock.invalid_hashes.add(b"\x99" * 32)
+    bad.block_hash, _ = calculate_execution_block_hash(bad, b"\x22" * 32)
+    mock.invalid_hashes.add(bytes(bad.block_hash))
     with pytest.raises(InvalidPayload):
         el.notify_new_payload(bad, [], b"\x22" * 32)
+
+    spoofed = T.ExecutionPayload.default()
+    spoofed.parent_hash = b"\x00" * 32
+    spoofed.block_hash = b"\x99" * 32  # does not re-derive
+    with pytest.raises(InvalidPayload, match="keccak"):
+        el.notify_new_payload(spoofed, [], b"\x22" * 32)
 
 
 # ------------------------------------------------------------ chain + EL

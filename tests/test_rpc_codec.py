@@ -1,0 +1,217 @@
+"""SSZ-snappy RPC chunk codec against hand-constructed golden frames
+(rpc/codec.rs + the consensus req/resp spec rules). The vectors are
+built from the SPEC definitions — uvarint length prefix, snappy
+framing-format stream identifier, CRC32C (Castagnoli) masked checksums
+— not from this codec, so encoder and decoder are pinned independently."""
+
+import struct
+
+import pytest
+
+from lighthouse_tpu.network import rpc_codec as rc
+from lighthouse_tpu.network import snappy_codec
+
+
+def test_crc32c_known_vectors():
+    # canonical CRC-32C check value (RFC 3720 / "123456789")
+    assert rc.crc32c(b"123456789") == 0xE3069283
+    assert rc.crc32c(b"") == 0x00000000
+    # all-zeros 32 bytes: iSCSI test vector
+    assert rc.crc32c(b"\x00" * 32) == 0x8A9136AA
+
+
+def test_masked_crc_formula():
+    c = rc.crc32c(b"abc")
+    want = (((c >> 15) | (c << 17)) + 0xA282EAD8) & 0xFFFFFFFF
+    assert rc._masked_crc(b"abc") == want
+
+
+def test_stream_identifier_bytes():
+    # spec: ff 06 00 00 73 4e 61 50 70 59
+    assert rc._STREAM_IDENT == bytes.fromhex("ff060000734e61507059")
+    assert rc.frame_compress(b"x").startswith(rc._STREAM_IDENT)
+
+
+def test_hand_built_uncompressed_frame_decodes():
+    """A framing stream built byte-by-byte from the spec: identifier +
+    one UNCOMPRESSED chunk (type 0x01, 3-byte LE length, masked crc)."""
+    payload = b"hello world"
+    crc = rc._masked_crc(payload)
+    stream = (
+        bytes.fromhex("ff060000734e61507059")
+        + bytes([0x01])
+        + (4 + len(payload)).to_bytes(3, "little")
+        + struct.pack("<I", crc)
+        + payload
+    )
+    assert rc.frame_decompress(stream) == payload
+
+
+def test_hand_built_compressed_frame_decodes():
+    payload = b"aaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaa"  # compressible
+    block = snappy_codec.compress(payload)
+    crc = rc._masked_crc(payload)  # crc is over the UNCOMPRESSED data
+    stream = (
+        rc._STREAM_IDENT
+        + bytes([0x00])
+        + (4 + len(block)).to_bytes(3, "little")
+        + struct.pack("<I", crc)
+        + block
+    )
+    assert rc.frame_decompress(stream) == payload
+
+
+def test_bad_crc_rejected():
+    payload = b"hello"
+    stream = (
+        rc._STREAM_IDENT
+        + bytes([0x01])
+        + (4 + len(payload)).to_bytes(3, "little")
+        + struct.pack("<I", 0xDEADBEEF)
+        + payload
+    )
+    with pytest.raises(rc.RpcCodecError, match="crc"):
+        rc.frame_decompress(stream)
+
+
+def test_padding_and_skippable_chunks_skipped():
+    payload = b"data"
+    crc = rc._masked_crc(payload)
+    stream = (
+        rc._STREAM_IDENT
+        + bytes([0xFE]) + (3).to_bytes(3, "little") + b"pad"     # padding
+        + bytes([0x80]) + (2).to_bytes(3, "little") + b"sk"      # skippable
+        + bytes([0x01]) + (4 + 4).to_bytes(3, "little")
+        + struct.pack("<I", crc) + payload
+    )
+    assert rc.frame_decompress(stream) == payload
+
+
+def test_frame_roundtrip_various_sizes():
+    for size in (0, 1, 100, 65536, 65537, 200_000):
+        data = bytes((i * 7 + size) % 251 for i in range(size))
+        assert rc.frame_decompress(rc.frame_compress(data)) == data
+
+
+def test_request_chunk_layout():
+    """Spec: <uvarint ssz_len> then the framed stream — verify the
+    prefix bytes directly for an 84-byte Status ssz (fits one varint
+    byte) and a 300-byte body (two varint bytes, LEB128)."""
+    ssz84 = bytes(range(84))
+    enc = rc.encode_request(ssz84)
+    assert enc[0] == 84  # uvarint(84) is the single byte 0x54
+    assert enc[1:11] == rc._STREAM_IDENT
+    assert rc.decode_request(enc) == ssz84
+
+    ssz300 = bytes(i % 256 for i in range(300))
+    enc = rc.encode_request(ssz300)
+    assert enc[0] == (300 & 0x7F) | 0x80 and enc[1] == 300 >> 7
+    assert rc.decode_request(enc) == ssz300
+
+
+def test_request_length_bounds_enforced():
+    enc = rc.encode_request(b"x" * 100)
+    with pytest.raises(rc.RpcCodecError, match="bounds"):
+        rc.decode_request(enc, min_len=0, max_len=10)
+
+
+def test_response_chunk_with_context_bytes():
+    digest = b"\x01\x02\x03\x04"
+    ssz = b"block-bytes"
+    chunk = rc.encode_response_chunk(rc.SUCCESS, ssz, digest)
+    assert chunk[0] == 0                 # result byte
+    assert chunk[1:5] == digest          # context bytes
+    assert chunk[5] == len(ssz)          # uvarint length
+    [(res, ctx, got)] = rc.decode_response_chunks(chunk, has_context=True)
+    assert (res, ctx, got) == (rc.SUCCESS, digest, ssz)
+
+
+def test_response_multi_chunk_stream():
+    digest = b"\xaa\xbb\xcc\xdd"
+    chunks = [b"chunk-%d" % i * (i + 1) for i in range(5)]
+    body = b"".join(
+        rc.encode_response_chunk(rc.SUCCESS, c, digest) for c in chunks
+    )
+    parsed = rc.decode_response_chunks(body, has_context=True)
+    assert [p[2] for p in parsed] == chunks
+    assert all(p[1] == digest for p in parsed)
+
+
+def test_error_chunk_has_no_context_bytes():
+    # error responses never carry context bytes (codec.rs context_bytes
+    # is Some only for Success)
+    body = rc.encode_response_chunk(rc.RATE_LIMITED, b"")
+    [(res, ctx, ssz)] = rc.decode_response_chunks(body, has_context=True)
+    assert res == 139 and ctx is None and ssz == b""
+
+
+def test_protocol_ids_spec_shape():
+    pid, has_ctx = rc.PROTOCOL_IDS["beacon_blocks_by_range"]
+    assert pid == "/eth2/beacon_chain/req/beacon_blocks_by_range/2/ssz_snappy"
+    assert has_ctx
+    pid, has_ctx = rc.PROTOCOL_IDS["status"]
+    assert pid == "/eth2/beacon_chain/req/status/1/ssz_snappy"
+    assert not has_ctx
+
+
+def test_two_endpoint_status_and_blocks_roundtrip():
+    """Status + BlocksByRange over two real RpcHandlers using the spec
+    chunk encoding (VERDICT r3 next-step #6's done criterion)."""
+    from lighthouse_tpu.network.transport import InProcessHub
+    from lighthouse_tpu.network.rpc import (
+        BlocksByRangeRequest,
+        Protocol,
+        ResponseCode,
+        RpcHandler,
+        Status,
+    )
+
+    hub = InProcessHub()
+    a = hub.join("peer-a")
+    b = hub.join("peer-b")
+    ra = RpcHandler(a, fork_digest=b"\x11\x22\x33\x44")
+    rb = RpcHandler(b, fork_digest=b"\x11\x22\x33\x44")
+
+    served_status = Status.make(
+        fork_digest=b"\x11\x22\x33\x44",
+        finalized_root=b"\x01" * 32,
+        finalized_epoch=7,
+        head_root=b"\x02" * 32,
+        head_slot=255,
+    )
+    rb.register(
+        Protocol.STATUS,
+        lambda peer, req: (ResponseCode.SUCCESS, [Status.serialize(served_status)]),
+    )
+    blocks = [b"ssz-block-%d" % i for i in range(3)]
+    rb.register(
+        Protocol.BLOCKS_BY_RANGE,
+        lambda peer, req: (ResponseCode.SUCCESS, list(blocks)),
+    )
+
+    got = {}
+    ra.request(
+        "peer-b",
+        Protocol.STATUS,
+        Status.serialize(served_status),
+        lambda peer, code, chunks: got.update(status=(code, chunks)),
+    )
+    ra.request(
+        "peer-b",
+        Protocol.BLOCKS_BY_RANGE,
+        BlocksByRangeRequest.serialize(
+            BlocksByRangeRequest.make(start_slot=0, count=3, step=1)
+        ),
+        lambda peer, code, chunks: got.update(blocks=(code, chunks)),
+    )
+    # pump frames both ways
+    for _ in range(4):
+        for ep, handler in ((b, rb), (a, ra)):
+            for frame in ep.drain():
+                handler.handle_frame(frame.sender, frame.payload)
+    code, chunks = got["status"]
+    assert code == ResponseCode.SUCCESS
+    decoded = Status.deserialize(chunks[0])
+    assert int(decoded.head_slot) == 255
+    code, chunks = got["blocks"]
+    assert code == ResponseCode.SUCCESS and chunks == blocks

@@ -1,0 +1,43 @@
+"""Scale regression gate (VERDICT r3 weak #7): the 500k/1M-validator
+numbers live in BASELINE.md §"scale probe"; this test replays the probe
+at 250k and fails if the epoch transition or state copy regresses >2x
+from the round-4 measurements (which scale ~linearly: 250k is half the
+500k cost)."""
+
+import time
+
+from lighthouse_tpu.tools.scale_probe import build_state
+from lighthouse_tpu.consensus import state_transition as st
+
+N = 250_000
+# round-4 measured at 500k: epoch 14.0 s, copy 9.7 s (BASELINE.md
+# §scale probe). Halve for 250k, then 2x regression headroom + CI
+# machine slack.
+EPOCH_BUDGET_S = 20.0
+COPY_BUDGET_S = 12.0
+COMMITTEE_BUDGET_S = 10.0
+
+
+def test_scale_epoch_copy_committee_budgets():
+    spec, state = build_state(N)
+
+    t0 = time.perf_counter()
+    st.process_epoch(spec, state)
+    epoch_s = time.perf_counter() - t0
+    assert epoch_s < EPOCH_BUDGET_S, f"epoch transition regressed: {epoch_s:.1f}s"
+
+    t0 = time.perf_counter()
+    state.copy()
+    copy_s = time.perf_counter() - t0
+    assert copy_s < COPY_BUDGET_S, f"state copy regressed: {copy_s:.1f}s"
+
+    # one slot's committees with the shared-permutation cache warm
+    state.slot += 1
+    epoch = st.get_current_epoch(spec, state)
+    cps = st.get_committee_count_per_slot(spec, state, epoch)
+    st.get_beacon_committee(spec, state, int(state.slot), 0)  # warm perm
+    t0 = time.perf_counter()
+    for idx in range(1, min(cps, 8)):
+        st.get_beacon_committee(spec, state, int(state.slot), idx)
+    comm_s = time.perf_counter() - t0
+    assert comm_s < COMMITTEE_BUDGET_S, f"committee resolution regressed: {comm_s:.1f}s"

@@ -7,6 +7,8 @@ import pytest
 
 from lighthouse_tpu.tools.simulator import Simulation
 
+pytestmark = pytest.mark.slow
+
 
 def test_four_nodes_reach_finality_through_fork_and_partition():
     sim = Simulation(n_nodes=4, n_validators=32, electra_fork_epoch=2)

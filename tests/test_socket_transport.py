@@ -163,3 +163,32 @@ def test_two_process_nodes_sync_and_gossip(tmp_path):
                 b.wait(timeout=10)
             except subprocess.TimeoutExpired:
                 b.kill()
+
+
+class TestSnappyBombGuard:
+    def test_declared_length_capped(self):
+        from lighthouse_tpu.network import snappy_codec as snappy
+
+        # declared 1 GiB: refused before any decode work
+        stream = snappy._put_uvarint(1 << 30) + b"\x00a"
+        with pytest.raises(snappy.SnappyError, match="cap"):
+            snappy.decompress(stream)
+
+    def test_expanding_copies_capped(self):
+        from lighthouse_tpu.network import snappy_codec as snappy
+
+        # 4-byte literal then overlapping copies that repeat it far past
+        # the declared length: the decoder must stop early, not expand
+        body = bytearray(snappy._put_uvarint(64))
+        body += bytes([(4 - 1) << 2]) + b"abcd"
+        for _ in range(100):
+            body += bytes([(64 - 1) << 2 | 2]) + (4).to_bytes(2, "little")
+        with pytest.raises(snappy.SnappyError):
+            snappy.decompress(bytes(body))
+
+    def test_overlapping_copy_slice_path(self):
+        from lighthouse_tpu.network import snappy_codec as snappy
+
+        # run-length: "ab" repeated via overlapping copy (off=2 < len)
+        data = b"ab" * 40
+        assert snappy.decompress(snappy.compress(data)) == data

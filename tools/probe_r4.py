@@ -1,0 +1,167 @@
+"""Round-4 probe: smoke the fused kernels, break down config-5, then
+compile+time the new verify program at buckets 128 and 4096.
+
+Run ON THE REAL CHIP (holds the axon lock). Prints phase timings.
+"""
+import os, sys, time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_VMEM_ARGS = "--xla_tpu_scoped_vmem_limit_kib=65536"
+if _VMEM_ARGS not in os.environ.get("LIBTPU_INIT_ARGS", ""):
+    os.environ["LIBTPU_INIT_ARGS"] = (
+        os.environ.get("LIBTPU_INIT_ARGS", "") + " " + _VMEM_ARGS
+    ).strip()
+
+import numpy as np
+import lighthouse_tpu
+
+lighthouse_tpu.enable_compilation_cache()
+import jax
+import jax.numpy as jnp
+
+print("device:", jax.devices()[0], flush=True)
+
+from lighthouse_tpu.ops.lane import fp, tower, jacobian as J, pairing as OP
+
+# ---------------- phase 0: standalone Mosaic smoke of the new fused kernels
+rng = np.random.default_rng(7)
+S = 128
+
+
+def rand_fp(*lead):
+    return jnp.asarray(
+        rng.integers(0, 2047, size=(*lead, fp.W, S), dtype=np.int64).astype(np.int32)
+    )
+
+
+t0 = time.time()
+# ladder_step_f2: acc + addend G2 points (use valid-ish random limbs —
+# numerics only need mod-p consistency vs the XLA body, not curve points)
+acc = (rand_fp(2), rand_fp(2), rand_fp(2))
+addend = (rand_fp(2), rand_fp(2), rand_fp(2))
+bit = jnp.asarray(rng.integers(0, 2, size=(1, S), dtype=np.int64).astype(np.int32))
+out_k = J._ladder_step_f2(*acc, *addend, bit)
+out_x = J._ladder_step_f2_body(fp._FOLDS, fp._TOPFM, *acc, *addend, bit)
+for a, b in zip(out_k, out_x):
+    ca, cb = np.asarray(fp.canonical(a)), np.asarray(fp.canonical(b))
+    assert (ca == cb).all(), "ladder_step_f2 kernel != XLA body"
+print("smoke ladder_step_f2 ok:", round(time.time() - t0, 1), "s", flush=True)
+
+t0 = time.time()
+f = rand_fp(2, 3, 2)
+T = (rand_fp(2), rand_fp(2), rand_fp(2))
+xP, yP = rand_fp(), rand_fp()
+out_k = OP._dbl_iter(f, *T, xP, yP)
+out_x = OP._dbl_iter_body(fp._FOLDS, fp._TOPFM, f, *T, xP, yP)
+for a, b in zip(out_k, out_x):
+    assert (np.asarray(fp.canonical(a)) == np.asarray(fp.canonical(b))).all(), "dbl_iter mismatch"
+print("smoke miller_dbl_iter ok:", round(time.time() - t0, 1), "s", flush=True)
+
+t0 = time.time()
+xQ, yQ = rand_fp(2), rand_fp(2)
+out_k = OP._add_iter(f, *T, xQ, yQ, xP, yP)
+out_x = OP._add_iter_body(fp._FOLDS, fp._TOPFM, f, *T, xQ, yQ, xP, yP)
+for a, b in zip(out_k, out_x):
+    assert (np.asarray(fp.canonical(a)) == np.asarray(fp.canonical(b))).all(), "add_iter mismatch"
+print("smoke miller_add_iter ok:", round(time.time() - t0, 1), "s", flush=True)
+
+# small-S padded dispatch: f12mul at S=1 must go through the kernel now
+t0 = time.time()
+a1 = jnp.asarray(rng.integers(0, 2047, size=(2, 3, 2, fp.W, 1), dtype=np.int64).astype(np.int32))
+b1 = jnp.asarray(rng.integers(0, 2047, size=(2, 3, 2, fp.W, 1), dtype=np.int64).astype(np.int32))
+got = tower.f12mul(a1, b1)
+want = tower._f12mul_body(fp._FOLDS, fp._TOPFM, a1, b1)
+assert (np.asarray(fp.canonical(got)) == np.asarray(fp.canonical(want))).all()
+print("smoke f12mul S=1 padded ok:", round(time.time() - t0, 1), "s", flush=True)
+
+# ---------------- phase B: config-5 piece timings (MSM warm from cache)
+from lighthouse_tpu.crypto.kzg import TrustedSetup, blob_to_field_elements, G1_GEN, G2_GEN, R
+from lighthouse_tpu.crypto.kzg.device import device_kzg
+from lighthouse_tpu.crypto.bls import curve as C
+
+t0 = time.time()
+kzg = device_kzg(TrustedSetup.mainnet())
+print("mainnet setup load:", round(time.time() - t0, 2), flush=True)
+
+blob = b"".join(b"\x00" + (i % 251).to_bytes(1, "big") * 31 for i in range(4096))
+t0 = time.time()
+commitment = kzg.blob_to_kzg_commitment(blob)
+print("blob_to_kzg_commitment first (msm 4096):", round(time.time() - t0, 2), flush=True)
+t0 = time.time()
+commitment = kzg.blob_to_kzg_commitment(blob)
+print("  warm:", round(time.time() - t0, 2), flush=True)
+t0 = time.time()
+proof, _ = kzg.compute_blob_kzg_proof(blob, commitment)
+print("compute_blob_kzg_proof:", round(time.time() - t0, 2), flush=True)
+
+N = 192
+t0 = time.time()
+items = []
+for _ in range(N):
+    z = kzg._blob_challenge(blob, commitment)
+    y = kzg.evaluate_polynomial(blob_to_field_elements(blob, kzg.n), z)
+    items.append((commitment, z, y, proof))
+print(f"host challenge+eval x{N}: {time.time()-t0:.2f}s", flush=True)
+
+t0 = time.time()
+rs = kzg._batch_r_powers(items)
+print("r_powers:", round(time.time() - t0, 3), flush=True)
+
+lhs_points, lhs_scalars, proof_points, proof_scalars = [], [], [], []
+for (cm, z, y, pr), r in zip(items, rs):
+    lhs_points.append(cm); lhs_scalars.append(r)
+    lhs_points.append(G1_GEN); lhs_scalars.append((-(y * r)) % R)
+    lhs_points.append(pr); lhs_scalars.append(z * r % R)
+    proof_points.append(pr); proof_scalars.append(r)
+
+t0 = time.time()
+lhs = kzg._msm(lhs_points, lhs_scalars)
+print(f"device MSM {len(lhs_points)} pts first: {time.time()-t0:.2f}s", flush=True)
+t0 = time.time()
+lhs = kzg._msm(lhs_points, lhs_scalars)
+print(f"  warm: {time.time()-t0:.2f}s", flush=True)
+t0 = time.time()
+pagg = kzg._msm(proof_points, proof_scalars)
+print(f"device MSM {len(proof_points)} pts first: {time.time()-t0:.2f}s", flush=True)
+
+t0 = time.time()
+pairs = [(lhs, G2_GEN), (C.g1_neg(pagg), kzg.setup.g2_tau)]
+okp = kzg._pairing(pairs)
+print(f"device pairing product first (incl compile of NEW kernels): {time.time()-t0:.2f}s ok={okp}", flush=True)
+t0 = time.time()
+okp = kzg._pairing(pairs)
+print(f"  warm: {time.time()-t0:.2f}s", flush=True)
+
+# ---------------- phase C: new verify program, buckets 128 then 4096
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.crypto.bls.backends import tpu as TB
+from lighthouse_tpu.crypto.bls.keys import SecretKey, SignatureSet
+
+
+def _sets(n):
+    sets = []
+    sk = SecretKey.from_seed(b"\x11" * 4)
+    for i in range(n):
+        msg = b"probe-%d" % (i % 3)
+        sets.append(SignatureSet.single_pubkey(sk.sign(msg), sk.public_key(), msg))
+    return sets
+
+
+for nb in (1, 4096):
+    sets = _sets(min(nb, 8)) * (nb // min(nb, 8))
+    args = TB.prepare_batch(sets, bls.gen_batch_scalars(len(sets)))
+    t0 = time.time()
+    out = jax.block_until_ready(TB._verify_kernel(*args))
+    t_first = time.time() - t0
+    ts = []
+    for _ in range(3):
+        t0 = time.time()
+        jax.block_until_ready(TB._verify_kernel(*args))
+        ts.append(time.time() - t0)
+    print(
+        f"verify bucket({nb}): first={t_first:.2f}s warm={min(ts):.3f}s "
+        f"ok={bool(np.asarray(out))}",
+        flush=True,
+    )
+print("PROBE DONE", flush=True)

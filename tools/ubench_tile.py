@@ -1,0 +1,89 @@
+"""Tile-size ubench for the fused round-4 kernels + post-brp-fix KZG
+config-5 re-measure. Run on the real chip; each standalone kernel
+compile is ~1-3 min (not the 25-min full-program cost)."""
+import os, sys, time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_VMEM_ARGS = "--xla_tpu_scoped_vmem_limit_kib=65536"
+if _VMEM_ARGS not in os.environ.get("LIBTPU_INIT_ARGS", ""):
+    os.environ["LIBTPU_INIT_ARGS"] = (
+        os.environ.get("LIBTPU_INIT_ARGS", "") + " " + _VMEM_ARGS
+    ).strip()
+
+import numpy as np
+import lighthouse_tpu
+
+lighthouse_tpu.enable_compilation_cache()
+import jax
+import jax.numpy as jnp
+
+print("device:", jax.devices()[0], flush=True)
+
+S = 4096
+REPS = 20
+
+
+def bench_kernel(label, budget):
+    os.environ["LH_TPU_TILE_BUDGET"] = str(budget)
+    # fresh import-level dispatch reads the env at call time (dispatch
+    # computes _lane_tile per call; jit caches per (fn, shapes) — use a
+    # fresh jit wrapper per budget so the tile is re-derived)
+    from lighthouse_tpu.ops.lane import fp, pairing as OP
+
+    rng = np.random.default_rng(3)
+
+    def rand_fp(*lead):
+        return jnp.asarray(
+            rng.integers(0, 2047, size=(*lead, fp.W, S), dtype=np.int64).astype(
+                np.int32
+            )
+        )
+
+    f = rand_fp(2, 3, 2)
+    T = (rand_fp(2), rand_fp(2), rand_fp(2))
+    xP, yP = rand_fp(), rand_fp()
+
+    @jax.jit
+    def run(f, XT, YT, ZT, xP, yP):
+        out = OP._dbl_iter(f, XT, YT, ZT, xP, yP)
+        return out[0]
+
+    t0 = time.time()
+    out = jax.block_until_ready(run(f, *T, xP, yP))
+    t_compile = time.time() - t0
+    ts = []
+    for _ in range(REPS):
+        t0 = time.time()
+        jax.block_until_ready(run(f, *T, xP, yP))
+        ts.append(time.time() - t0)
+    per_set = min(ts) / S * 1e6
+    print(
+        f"{label}: budget={budget>>20}MB compile={t_compile:.0f}s "
+        f"best={min(ts)*1e3:.2f}ms ({per_set:.3f} us/set/iter)",
+        flush=True,
+    )
+
+
+for budget in (6 << 20, 24 << 20, 48 << 20):
+    bench_kernel("dbl_iter", budget)
+
+os.environ.pop("LH_TPU_TILE_BUDGET", None)
+
+# ---------------- KZG config-5 re-measure after the brp fix
+from lighthouse_tpu.crypto.kzg import TrustedSetup
+from lighthouse_tpu.crypto.kzg.device import device_kzg
+
+t0 = time.time()
+kzg = device_kzg(TrustedSetup.mainnet())
+print("setup load:", round(time.time() - t0, 1), flush=True)
+blob = b"".join(b"\x00" + (i % 251).to_bytes(1, "big") * 31 for i in range(4096))
+commitment = kzg.blob_to_kzg_commitment(blob)
+proof, _ = kzg.compute_blob_kzg_proof(blob, commitment)
+N = 192
+ok = kzg.verify_blob_kzg_proof_batch([blob] * 2, [commitment] * 2, [proof] * 2)
+print("warm 2-blob:", ok, flush=True)
+t0 = time.time()
+ok = kzg.verify_blob_kzg_proof_batch([blob] * N, [commitment] * N, [proof] * N)
+dt = time.time() - t0
+print(f"config5: {N} blobs in {dt:.2f}s = {N/dt:.1f} blobs/s ok={ok}", flush=True)
+print("UBENCH DONE", flush=True)

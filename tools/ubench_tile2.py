@@ -1,0 +1,71 @@
+"""Tile-budget ubench v2: the Miller dbl iteration under lax.scan (63
+steps in ONE jit) so the ~60 ms tunnel round-trip amortizes and the
+kernel time is visible. Prints us/set/iter per tile budget."""
+import os, sys, time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_VMEM_ARGS = "--xla_tpu_scoped_vmem_limit_kib=65536"
+if _VMEM_ARGS not in os.environ.get("LIBTPU_INIT_ARGS", ""):
+    os.environ["LIBTPU_INIT_ARGS"] = (
+        os.environ.get("LIBTPU_INIT_ARGS", "") + " " + _VMEM_ARGS
+    ).strip()
+
+import numpy as np
+import lighthouse_tpu
+
+lighthouse_tpu.enable_compilation_cache()
+import jax
+import jax.numpy as jnp
+
+print("device:", jax.devices()[0], flush=True)
+
+S = 4096
+ITERS = 63
+
+
+def bench(budget):
+    os.environ["LH_TPU_TILE_BUDGET"] = str(budget)
+    from lighthouse_tpu.ops.lane import fp, pairing as OP
+
+    rng = np.random.default_rng(3)
+
+    def rand_fp(*lead):
+        return jnp.asarray(
+            rng.integers(0, 2047, size=(*lead, fp.W, S), dtype=np.int64).astype(
+                np.int32
+            )
+        )
+
+    f = rand_fp(2, 3, 2)
+    T = (rand_fp(2), rand_fp(2), rand_fp(2))
+    xP, yP = rand_fp(), rand_fp()
+
+    @jax.jit
+    def run(f, XT, YT, ZT, xP, yP):
+        def step(carry, _):
+            f, T = carry
+            r = OP._dbl_iter(f, *T, xP, yP)
+            return (r[0], tuple(r[1:])), None
+
+        (f_out, _), _ = jax.lax.scan(step, (f, (XT, YT, ZT)), None, length=ITERS)
+        return f_out
+
+    t0 = time.time()
+    jax.block_until_ready(run(f, *T, xP, yP))
+    t_compile = time.time() - t0
+    ts = []
+    for _ in range(8):
+        t0 = time.time()
+        jax.block_until_ready(run(f, *T, xP, yP))
+        ts.append(time.time() - t0)
+    per = (min(ts)) / S / ITERS * 1e6
+    print(
+        f"budget={budget>>20}MB compile={t_compile:.0f}s best={min(ts)*1e3:.1f}ms"
+        f" -> {per:.3f} us/set/iter (63 iters x 4096 sets)",
+        flush=True,
+    )
+
+
+for b in (6 << 20, 24 << 20):
+    bench(b)
+print("DONE", flush=True)
