@@ -109,6 +109,17 @@ def _build_parser() -> argparse.ArgumentParser:
     ps = lcli_sub.add_parser("parse-ssz")
     ps.add_argument("type_name")
     ps.add_argument("file")
+    sr = lcli_sub.add_parser("state-root")
+    sr.add_argument("--state", required=True)
+    br = lcli_sub.add_parser("block-root")
+    br.add_argument("--block", required=True)
+    iv = lcli_sub.add_parser("insecure-validators")
+    iv.add_argument("--count", type=int, required=True)
+    iv.add_argument("--first-index", type=int, default=0)
+    nt = lcli_sub.add_parser("new-testnet")
+    nt.add_argument("--count", type=int, required=True)
+    nt.add_argument("--genesis-time", type=int, default=0)
+    nt.add_argument("--out-dir", required=True)
     ig = lcli_sub.add_parser("interop-genesis")
     ig.add_argument("--count", type=int, required=True)
     ig.add_argument("--genesis-time", type=int, default=0)
@@ -121,11 +132,34 @@ def _build_parser() -> argparse.ArgumentParser:
     vc_create.add_argument("--count", type=int, required=True)
     vc_create.add_argument("--out-dir", required=True)
     vc_create.add_argument("--first-index", type=int, default=0)
-    for name in ("import", "list"):
+    vc_create.add_argument(
+        "--deposit-gwei", type=int, default=32 * 10**9,
+        help="also write deposit_data.json with entries of this amount",
+    )
+    vc_create.add_argument(
+        "--withdrawal-address", default=None,
+        help="0x01-credentialed EL withdrawal address (hex); default "
+        "derives the BLS (0x00) credential from the withdrawal key",
+    )
+    for name in ("import", "list", "delete", "move"):
         cmd = vm_sub.add_parser(name)
         cmd.add_argument("--vc-url", required=True)
         cmd.add_argument("--vc-token", required=True)
         if name == "import":
+            cmd.add_argument("--keystores", nargs="*", default=[])
+            cmd.add_argument(
+                "--validators-file",
+                help="JSON list of {enabled, voting_keystore, "
+                "fee_recipient, ...} entries (the reference's "
+                "--validators-file flow)",
+            )
+            cmd.add_argument("--password", required=True)
+        if name == "delete":
+            cmd.add_argument("--pubkeys", nargs="+", required=True)
+        if name == "move":
+            cmd.add_argument("--dest-vc-url", required=True)
+            cmd.add_argument("--dest-vc-token", required=True)
+            cmd.add_argument("--pubkeys", nargs="+", required=True)
             cmd.add_argument("--keystores", nargs="+", required=True)
             cmd.add_argument("--password", required=True)
 
@@ -533,6 +567,35 @@ def cmd_lcli(args) -> int:
             f.write(out)
         print(f"wrote {args.count}-validator genesis to {args.out}")
         return 0
+    if args.lcli_cmd == "state-root":
+        with open(args.state, "rb") as f:
+            print(L.state_root(f.read()))
+        return 0
+    if args.lcli_cmd == "block-root":
+        with open(args.block, "rb") as f:
+            print(L.block_root(f.read()))
+        return 0
+    if args.lcli_cmd == "insecure-validators":
+        print(json.dumps(L.insecure_validators(args.count, args.first_index)))
+        return 0
+    if args.lcli_cmd == "new-testnet":
+        bundle = L.new_testnet(spec, args.count, args.genesis_time)
+        os.makedirs(args.out_dir, exist_ok=True)
+        with open(os.path.join(args.out_dir, "config.json"), "w") as f:
+            json.dump(bundle["config"], f, indent=1)
+        with open(os.path.join(args.out_dir, "genesis.ssz"), "wb") as f:
+            f.write(bundle["genesis_ssz"])
+        print(
+            json.dumps(
+                {
+                    "out_dir": args.out_dir,
+                    "genesis_validators_root": bundle[
+                        "genesis_validators_root"
+                    ],
+                }
+            )
+        )
+        return 0
     return 2
 
 
@@ -541,11 +604,18 @@ def cmd_vm(args) -> int:
 
     if args.vm_cmd == "create":
         password = getpass.getpass("keystore password: ")
-        pairs = VM.create_validators(
+        wa = (
+            bytes.fromhex(args.withdrawal_address.replace("0x", ""))
+            if args.withdrawal_address
+            else None
+        )
+        pairs, deposits = VM.create_validators_with_deposits(
             bytes.fromhex(args.seed_hex),
             args.count,
             password,
             first_index=args.first_index,
+            amount_gwei=args.deposit_gwei,
+            withdrawal_address=wa,
         )
         os.makedirs(args.out_dir, exist_ok=True)
         for ks_json, pk in pairs:
@@ -553,18 +623,49 @@ def cmd_vm(args) -> int:
             with open(path, "w") as f:
                 f.write(ks_json)
             print("wrote", path)
+        dd = os.path.join(args.out_dir, "deposit_data.json")
+        with open(dd, "w") as f:
+            json.dump(deposits, f, indent=1)
+        print("wrote", dd)
         return 0
     client = VM.ValidatorClientHttpClient(args.vc_url, args.vc_token)
     if args.vm_cmd == "list":
         print(json.dumps(client.list_keystores(), indent=2))
         return 0
     if args.vm_cmd == "import":
+        if args.validators_file:
+            with open(args.validators_file) as f:
+                entries = json.load(f)
+            statuses = VM.import_from_validators_file(
+                client, entries, args.password
+            )
+        else:
+            keystores = []
+            for path in args.keystores:
+                with open(path) as f:
+                    keystores.append(f.read())
+            statuses = client.import_keystores(
+                keystores, [args.password] * len(keystores)
+            )
+        print(json.dumps(statuses, indent=2))
+        return 0
+    if args.vm_cmd == "delete":
+        print(json.dumps(client.delete_keystores(args.pubkeys), indent=2))
+        return 0
+    if args.vm_cmd == "move":
+        dst = VM.ValidatorClientHttpClient(
+            args.dest_vc_url, args.dest_vc_token
+        )
         keystores = []
         for path in args.keystores:
             with open(path) as f:
                 keystores.append(f.read())
-        statuses = client.import_keystores(
-            keystores, [args.password] * len(keystores)
+        statuses = VM.move_validators(
+            client,
+            dst,
+            args.pubkeys,
+            keystores,
+            [args.password] * len(keystores),
         )
         print(json.dumps(statuses, indent=2))
         return 0

@@ -177,6 +177,18 @@ class BeaconNodeHttpClient:
             int(d["index"]) for d in json.loads(raw)["data"] if d["is_live"]
         }
 
+    def validators_bulk(self, state_id: str = "head", ids: list = None) -> list:
+        """GET .../validators (round-4 bulk endpoint)."""
+        path = f"/eth/v1/beacon/states/{state_id}/validators"
+        if ids:
+            path += "?id=" + ",".join(str(i) for i in ids)
+        return self._get_json(path)["data"]
+
+    def block_rewards(self, block_id: str) -> dict:
+        return self._get_json(f"/eth/v1/beacon/rewards/blocks/{block_id}")[
+            "data"
+        ]
+
     # ------------------------------------------------------------ publish
 
     def publish_attestation_ssz(self, ssz: bytes) -> None:

@@ -25,6 +25,7 @@ individual check path for `verify_signatures=True`.
 from __future__ import annotations
 
 import hashlib
+import threading
 from typing import Iterable, Optional
 
 import numpy as np
@@ -35,6 +36,46 @@ from . import types as T
 from .domains import compute_domain, compute_signing_root, get_domain
 from .shuffling import compute_committee, compute_shuffled_index
 from .spec import ChainSpec, FAR_FUTURE_EPOCH, GENESIS_EPOCH, GENESIS_SLOT
+
+# --------------------------------------------------------- reward meter
+# Thread-local accumulator for the PROPOSER-ROLE reward components of
+# one block replay (the beacon-API /rewards/blocks decomposition,
+# beacon_chain/src/beacon_block_reward.rs role). A raw balance delta
+# conflates roles: a proposer who is also a non-participating sync
+# member nets negative even though their proposer reward is positive.
+_REWARD_METER = threading.local()
+
+
+class BlockRewardMeter:
+    """Collects proposer rewards while `metered()` is active."""
+
+    def __init__(self):
+        self.attestations = 0
+        self.sync_aggregate = 0
+        self.proposer_slashings = 0
+        self.attester_slashings = 0
+
+    def __enter__(self):
+        _REWARD_METER.meter = self
+        return self
+
+    def __exit__(self, *exc):
+        _REWARD_METER.meter = None
+
+    @property
+    def total(self) -> int:
+        return (
+            self.attestations
+            + self.sync_aggregate
+            + self.proposer_slashings
+            + self.attester_slashings
+        )
+
+
+def _meter_add(component: str, amount: int) -> None:
+    m = getattr(_REWARD_METER, "meter", None)
+    if m is not None:
+        setattr(m, component, getattr(m, component) + int(amount))
 
 # Altair participation flags (participation_flags.rs analog)
 TIMELY_SOURCE_FLAG_INDEX = 0
@@ -273,7 +314,11 @@ def initiate_validator_exit(spec: ChainSpec, state, index: int) -> None:
 
 
 def slash_validator(
-    spec: ChainSpec, state, index: int, whistleblower_index: Optional[int] = None
+    spec: ChainSpec,
+    state,
+    index: int,
+    whistleblower_index: Optional[int] = None,
+    _meter_component: str = "attester_slashings",
 ) -> None:
     epoch = get_current_epoch(spec, state)
     initiate_validator_exit(spec, state, index)
@@ -305,6 +350,7 @@ def slash_validator(
         whistleblower_reward * PROPOSER_WEIGHT // WEIGHT_DENOMINATOR
     )
     increase_balance(state, proposer_index, proposer_reward)
+    _meter_add(_meter_component, proposer_reward)
     increase_balance(
         state, whistleblower_index, whistleblower_reward - proposer_reward
     )
@@ -795,7 +841,9 @@ def process_proposer_slashing(
         )
         if not bls.verify_signature_sets(sets):
             raise BlockProcessingError("invalid slashing signatures")
-    slash_validator(spec, state, h1.proposer_index)
+    slash_validator(
+        spec, state, h1.proposer_index, _meter_component="proposer_slashings"
+    )
 
 
 def is_slashable_attestation_data(d1, d2) -> bool:
@@ -1000,11 +1048,9 @@ def process_attestation(
         * WEIGHT_DENOMINATOR
         // PROPOSER_WEIGHT
     )
-    increase_balance(
-        state,
-        ctx.proposer_index(),
-        proposer_reward_numerator // proposer_reward_denominator,
-    )
+    att_proposer_reward = proposer_reward_numerator // proposer_reward_denominator
+    increase_balance(state, ctx.proposer_index(), att_proposer_reward)
+    _meter_add("attestations", att_proposer_reward)
 
 
 def is_valid_merkle_branch(
@@ -1213,6 +1259,7 @@ def process_sync_aggregate(
         if bit:
             increase_balance(state, index, participant_reward)
             increase_balance(state, proposer_index, proposer_reward)
+            _meter_add("sync_aggregate", proposer_reward)
         else:
             decrease_balance(state, index, participant_reward)
 
@@ -1675,15 +1722,19 @@ def finalize_genesis_state(spec: ChainSpec, state, el_anchor: bytes = b""):
     return state
 
 
-def interop_pubkeys(count: int) -> list:
-    """The canonical interop key derivation (eth2_interop_keypairs
-    role): seed = index as 4 big-endian bytes. The ONE definition every
-    caller (CLI, lcli, tests) shares."""
+def interop_secret_key(index: int):
+    """The canonical interop secret key for `index` (seed = index as 4
+    big-endian bytes)."""
     from ..crypto.bls.keys import SecretKey
 
+    return SecretKey.from_seed(index.to_bytes(4, "big"))
+
+
+def interop_pubkeys(count: int) -> list:
+    """The canonical interop key derivation (eth2_interop_keypairs
+    role). The ONE definition every caller (CLI, lcli, tests) shares."""
     return [
-        SecretKey.from_seed(i.to_bytes(4, "big")).public_key().to_bytes()
-        for i in range(count)
+        interop_secret_key(i).public_key().to_bytes() for i in range(count)
     ]
 
 

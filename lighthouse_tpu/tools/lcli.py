@@ -98,3 +98,77 @@ def interop_genesis(spec: ChainSpec, count: int, genesis_time: int = 0) -> bytes
 
 def pretty_ssz(type_name: str, raw: bytes) -> str:
     return json.dumps(parse_ssz(type_name, raw), indent=2)
+
+
+# ------------------------------------------------------ round-4 toolbox
+
+
+def state_root(pre_ssz: bytes) -> str:
+    """lcli state-root: hash_tree_root of a BeaconState SSZ."""
+    return "0x" + T.BeaconState.deserialize(pre_ssz).hash_tree_root().hex()
+
+
+def block_root(block_ssz: bytes) -> str:
+    """lcli block-root: hash_tree_root of a SignedBeaconBlock's message."""
+    return (
+        "0x"
+        + T.SignedBeaconBlock.deserialize(block_ssz)
+        .message.hash_tree_root()
+        .hex()
+    )
+
+
+def insecure_validators(count: int, first_index: int = 0) -> list:
+    """lcli insecure-validators: the interop deterministic keypairs as
+    {privkey, pubkey} hex entries (testnet bootstrapping)."""
+    from ..crypto.bls.keys import SecretKey
+
+    out = []
+    for i in range(first_index, first_index + count):
+        sk = st.interop_secret_key(i)
+        out.append(
+            {
+                "index": i,
+                "privkey": "0x%064x" % sk.scalar,
+                "pubkey": "0x" + sk.public_key().to_bytes().hex(),
+            }
+        )
+    return out
+
+
+def new_testnet(
+    spec: ChainSpec,
+    validator_count: int,
+    genesis_time: int,
+    *,
+    altair_epoch: int = 0,
+    bellatrix_epoch: int = 0,
+    capella_epoch: int = 0,
+    deneb_epoch: int = 0,
+    electra_epoch: int = 0,
+) -> dict:
+    """lcli new-testnet: a deployable testnet bundle — config.yaml
+    fields + the genesis state SSZ (base64 would bloat; returned raw
+    under 'genesis_ssz')."""
+    pubkeys = st.interop_pubkeys(validator_count)
+    state = st.interop_genesis_state(spec, pubkeys, genesis_time)
+    config = {
+        "CONFIG_NAME": "lighthouse-tpu-testnet",
+        "MIN_GENESIS_ACTIVE_VALIDATOR_COUNT": validator_count,
+        "MIN_GENESIS_TIME": genesis_time,
+        "GENESIS_FORK_VERSION": "0x"
+        + spec.genesis_fork_version.hex(),
+        "ALTAIR_FORK_EPOCH": altair_epoch,
+        "BELLATRIX_FORK_EPOCH": bellatrix_epoch,
+        "CAPELLA_FORK_EPOCH": capella_epoch,
+        "DENEB_FORK_EPOCH": deneb_epoch,
+        "ELECTRA_FORK_EPOCH": electra_epoch,
+        "SECONDS_PER_SLOT": spec.seconds_per_slot,
+        "SLOTS_PER_EPOCH": spec.preset.slots_per_epoch,
+    }
+    return {
+        "config": config,
+        "genesis_ssz": state.serialize(),
+        "genesis_validators_root": "0x"
+        + bytes(state.genesis_validators_root).hex(),
+    }

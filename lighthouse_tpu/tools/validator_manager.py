@@ -77,6 +77,16 @@ class ValidatorClientHttpClient:
             "DELETE", "/eth/v1/keystores", {"pubkeys": pubkeys}
         )
 
+    def set_validator_settings(self, pubkey: str, settings: dict) -> None:
+        """Per-validator proposal settings via the keymanager API
+        (feerecipient endpoint; other knobs ride the same route family)."""
+        if "fee_recipient" in settings:
+            self._request(
+                "POST",
+                f"/eth/v1/validator/{pubkey}/feerecipient",
+                {"ethaddress": settings["fee_recipient"]},
+            )
+
 
 # ---------------------------------------------------------------- create
 
@@ -97,6 +107,136 @@ def create_validators(
         ks = Keystore.encrypt(sk, password, path=path, scrypt_n=scrypt_n)
         out.append((ks.to_json(), "0x" + ks.pubkey.hex()))
     return out
+
+
+def create_validators_with_deposits(
+    seed: bytes,
+    count: int,
+    password: str,
+    *,
+    first_index: int = 0,
+    amount_gwei: int = 32 * 10**9,
+    fork_version: bytes = b"\x00\x00\x00\x00",
+    withdrawal_address: Optional[bytes] = None,
+    scrypt_n: int = 262144,
+) -> tuple:
+    """The reference `validator_manager create` output in full
+    (create_validators.rs): keystores PLUS the standard
+    deposit_data.json entries (the shape the staking deposit-cli
+    produces and launchpads consume — pinned against deposit-cli
+    vectors in tests/test_external_vectors.py).
+
+    Returns ([(keystore_json, pubkey_hex)], [deposit_entry_dict]).
+    withdrawal_address: 0x01-credentialed EL address; None derives the
+    BLS (0x00) withdrawal credential from the EIP-2334 withdrawal key.
+    """
+    from ..consensus import types as T
+    from ..crypto.keystore.key_derivation import validator_withdrawal_path
+
+    keystores = []
+    deposits = []
+    domain = _deposit_domain(fork_version)
+    for i in range(first_index, first_index + count):
+        path = validator_signing_path(i)
+        sk = SecretKey(derive_path(seed, path))
+        ks = Keystore.encrypt(sk, password, path=path, scrypt_n=scrypt_n)
+        pk = ks.pubkey
+        keystores.append((ks.to_json(), "0x" + pk.hex()))
+        if withdrawal_address is not None:
+            wc = b"\x01" + b"\x00" * 11 + withdrawal_address
+        else:
+            import hashlib
+
+            wk = SecretKey(derive_path(seed, validator_withdrawal_path(i)))
+            wc = b"\x00" + hashlib.sha256(
+                wk.public_key().to_bytes()
+            ).digest()[1:]
+        msg = T.DepositMessage.make(
+            pubkey=pk, withdrawal_credentials=wc, amount=amount_gwei
+        )
+        msg_root = T.DepositMessage.hash_tree_root(msg)
+        from ..consensus.types import SigningData
+
+        signing_root = SigningData.make(
+            object_root=msg_root, domain=domain
+        ).hash_tree_root()
+        sig = sk.sign(signing_root).to_bytes()
+        data = T.DepositData.make(
+            pubkey=pk,
+            withdrawal_credentials=wc,
+            amount=amount_gwei,
+            signature=sig,
+        )
+        deposits.append(
+            {
+                "pubkey": pk.hex(),
+                "withdrawal_credentials": wc.hex(),
+                "amount": amount_gwei,
+                "signature": sig.hex(),
+                "deposit_message_root": msg_root.hex(),
+                "deposit_data_root": T.DepositData.hash_tree_root(data).hex(),
+                "fork_version": fork_version.hex(),
+                "network_name": "mainnet",
+                "deposit_cli_version": "lighthouse-tpu-vm",
+            }
+        )
+    return keystores, deposits
+
+
+def _deposit_domain(fork_version: bytes) -> bytes:
+    from ..consensus import types as T
+
+    fd = T.ForkData.make(
+        current_version=fork_version, genesis_validators_root=b"\x00" * 32
+    )
+    return b"\x03\x00\x00\x00" + T.ForkData.hash_tree_root(fd)[:28]
+
+
+# -------------------------------------------------------- validators file
+
+
+def import_from_validators_file(
+    client: ValidatorClientHttpClient, entries: list, password: str
+) -> list:
+    """The reference's --validators-file import flow
+    (import_validators.rs): entries are
+    {enabled, voting_keystore (json str or dict), fee_recipient?,
+    gas_limit?, builder_proposals?}; disabled entries are skipped, and
+    per-validator proposal settings are pushed after the key lands."""
+    keystores, passwords, extras = [], [], []
+    for e in entries:
+        if not e.get("enabled", True):
+            continue
+        ks = e["voting_keystore"]
+        keystores.append(ks if isinstance(ks, str) else json.dumps(ks))
+        passwords.append(e.get("password", password))
+        extras.append(e)
+    statuses = client.import_keystores(keystores, passwords)
+    for e, status in zip(extras, statuses):
+        if status.get("status") not in ("imported", "duplicate"):
+            continue
+        ks = e["voting_keystore"]
+        pk = (
+            json.loads(ks)["pubkey"] if isinstance(ks, str) else ks["pubkey"]
+        )
+        if not pk.startswith("0x"):
+            pk = "0x" + pk
+        applied, unsupported = {}, []
+        if "fee_recipient" in e:
+            applied["fee_recipient"] = e["fee_recipient"]
+        for knob in ("gas_limit", "builder_proposals"):
+            if knob in e:
+                unsupported.append(knob)
+        if applied:
+            try:
+                client.set_validator_settings(pk, applied)
+            except VcApiError as err:
+                status["settings_error"] = str(err)
+        if unsupported:
+            # NEVER silently drop an operator's intent: surface what the
+            # keymanager API here cannot carry yet
+            status["settings_unsupported"] = unsupported
+    return statuses
 
 
 # ---------------------------------------------------------------- move

@@ -28,7 +28,46 @@ CREATE TABLE IF NOT EXISTS canonical_blocks (
 );
 CREATE INDEX IF NOT EXISTS blocks_by_proposer
     ON canonical_blocks (proposer);
+-- per-included-attestation record (watch suboptimal_attestations role:
+-- inclusion delay is the lateness signal blocks alone can provide)
+CREATE TABLE IF NOT EXISTS block_attestations (
+    block_slot INTEGER NOT NULL,
+    att_slot INTEGER NOT NULL,
+    committee_index INTEGER NOT NULL,
+    inclusion_delay INTEGER NOT NULL
+);
+CREATE INDEX IF NOT EXISTS atts_by_att_slot
+    ON block_attestations (att_slot, committee_index);
+-- periodic registry snapshot (watch validators table role)
+CREATE TABLE IF NOT EXISTS validator_snapshots (
+    snapshot_slot INTEGER NOT NULL,
+    validator_index INTEGER NOT NULL,
+    status TEXT NOT NULL,
+    balance INTEGER NOT NULL,
+    PRIMARY KEY (snapshot_slot, validator_index)
+);
+-- proposer reward per canonical block (watch block_rewards role)
+CREATE TABLE IF NOT EXISTS block_rewards (
+    slot INTEGER PRIMARY KEY,
+    proposer INTEGER NOT NULL,
+    total INTEGER NOT NULL,
+    attestations INTEGER NOT NULL,
+    sync_aggregate INTEGER NOT NULL
+);
 """
+
+
+def _committee_index(att) -> int:
+    """Pre-electra: data.index. Electra (EIP-7549): data.index is
+    constitutionally 0 and the committee rides committee_bits — record
+    the first set bit (single-committee aggregates in this framework's
+    canonical shape)."""
+    bits = getattr(att, "committee_bits", None)
+    if bits is not None:
+        for i, b in enumerate(bits):
+            if b:
+                return i
+    return int(att.data.index)
 
 
 class WatchDB:
@@ -58,6 +97,53 @@ class WatchDB:
                     len(body.voluntary_exits),
                     sum(1 for b in sync_bits if b),
                     graffiti.decode(errors="replace"),
+                ),
+            )
+            self._db.execute(
+                "DELETE FROM block_attestations WHERE block_slot = ?",
+                (int(msg.slot),),
+            )
+            self._db.executemany(
+                "INSERT INTO block_attestations VALUES (?,?,?,?)",
+                [
+                    (
+                        int(msg.slot),
+                        int(a.data.slot),
+                        _committee_index(a),
+                        int(msg.slot) - int(a.data.slot),
+                    )
+                    for a in body.attestations
+                ],
+            )
+            self._db.commit()
+
+    def record_validator_snapshot(self, slot: int, entries: list) -> None:
+        """entries: beacon-API validator dicts (index/status/balance)."""
+        with self._lock:
+            self._db.executemany(
+                "INSERT OR REPLACE INTO validator_snapshots VALUES (?,?,?,?)",
+                [
+                    (
+                        int(slot),
+                        int(e["index"]),
+                        e["status"],
+                        int(e["balance"]),
+                    )
+                    for e in entries
+                ],
+            )
+            self._db.commit()
+
+    def record_reward(self, slot: int, reward: dict) -> None:
+        with self._lock:
+            self._db.execute(
+                "INSERT OR REPLACE INTO block_rewards VALUES (?,?,?,?,?)",
+                (
+                    int(slot),
+                    int(reward["proposer_index"]),
+                    int(reward["total"]),
+                    int(reward.get("attestations", 0)),
+                    int(reward.get("sync_aggregate", 0)),
                 ),
             )
             self._db.commit()
@@ -104,6 +190,52 @@ class WatchDB:
         ).fetchone()
         return row[0]
 
+    def inclusion_delay_stats(self) -> dict:
+        """The suboptimal-attestation signal: how late attestations land."""
+        rows = self._db.execute(
+            "SELECT COUNT(*), AVG(inclusion_delay), MAX(inclusion_delay),"
+            " SUM(inclusion_delay > 1) FROM block_attestations"
+        ).fetchone()
+        return {
+            "attestations": rows[0],
+            "avg_delay": rows[1],
+            "max_delay": rows[2],
+            "late": rows[3] or 0,
+        }
+
+    def missed_slots(self) -> list:
+        """Canonical gaps between lowest and highest recorded slots —
+        the proposer-miss surface (watch's missed-block detection)."""
+        lo, hi = self.lowest_slot(), self.highest_slot()
+        if lo is None or hi is None:
+            return []
+        have = {
+            r[0]
+            for r in self._db.execute(
+                "SELECT slot FROM canonical_blocks"
+            ).fetchall()
+        }
+        return [s for s in range(lo, hi + 1) if s not in have]
+
+    def reward_stats(self) -> dict:
+        rows = self._db.execute(
+            "SELECT COUNT(*), AVG(total), MIN(total), MAX(total)"
+            " FROM block_rewards"
+        ).fetchone()
+        return {
+            "blocks": rows[0],
+            "avg_total": rows[1],
+            "min_total": rows[2],
+            "max_total": rows[3],
+        }
+
+    def balance_history(self, validator_index: int) -> list:
+        return self._db.execute(
+            "SELECT snapshot_slot, balance FROM validator_snapshots"
+            " WHERE validator_index = ? ORDER BY snapshot_slot",
+            (validator_index,),
+        ).fetchall()
+
 
 class WatchService:
     """The updater task: follow the head backwards until known ground."""
@@ -111,13 +243,18 @@ class WatchService:
     def __init__(self, client: BeaconNodeHttpClient, db: WatchDB):
         self.client = client
         self.db = db
+        self._last_snapshot: Optional[int] = None
 
-    def update(self, max_blocks: int = 64) -> int:
+    def update(
+        self, max_blocks: int = 64, snapshot_every: int = 32
+    ) -> int:
         """One poll round; returns blocks newly recorded. Walks head →
         known ground, then resumes the historical backfill below the
         lowest recorded slot, so a fresh DB on an old chain converges to
         full coverage over successive rounds instead of abandoning the
-        gap at max_blocks."""
+        gap at max_blocks. Also records per-block proposer rewards and a
+        validator-registry snapshot every `snapshot_every` slots (the
+        reference daemon's block_rewards + validators updaters)."""
         try:
             head = self.client.header("head")
         except ApiClientError as e:
@@ -130,6 +267,16 @@ class WatchService:
             recorded += self._walk(
                 low - 1, floor=None, budget=max_blocks - recorded
             )
+        head_slot = int(head["slot"])
+        last_snap = self._last_snapshot
+        if last_snap is None or head_slot - last_snap >= snapshot_every:
+            try:
+                self.db.record_validator_snapshot(
+                    head_slot, self.client.validators_bulk()
+                )
+                self._last_snapshot = head_slot
+            except ApiClientError as e:
+                log.warning("validator snapshot failed", error=str(e))
         return recorded
 
     def _walk(self, slot: int, floor, budget: int) -> int:
@@ -150,6 +297,13 @@ class WatchService:
             signed = T.SignedBeaconBlock.deserialize(raw)
             root = signed.message.hash_tree_root()
             self.db.record_block(signed, root)
+            try:
+                self.db.record_reward(
+                    int(signed.message.slot),
+                    self.client.block_rewards("0x" + root.hex()),
+                )
+            except ApiClientError:
+                pass  # parent state pruned: packing stats still land
             recorded += 1
             slot = int(signed.message.slot) - 1
         return recorded

