@@ -72,7 +72,14 @@ BLST_HOST_ANCHOR = BLST_SETS_PER_S_PER_CORE * BLST_HOST_CORES
 # flushed from a SIGTERM/SIGALRM handler so even a driver kill captures
 # whatever finished.
 _T_START = time.monotonic()
-_BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "1100"))
+# the driver's observed outer timeout is ~25-40 min (r3 forensics).
+# Even on a fully warm compile cache, jax TRACE+LOWER costs ~5-8 min
+# per distinct batch-bucket program (measured round 4) — the bench is
+# therefore architected around trace count: three distinct buckets
+# (4096 / 1024 / 128 — config 3 sizes itself into the 128 bucket), the
+# headline + KZG configs run FIRST, and the alarm/SIGTERM flush emits
+# whatever finished.
+_BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "2100"))
 _STATE = {"detail": {}, "rate1": 0.0, "emitted": False}
 
 
@@ -178,9 +185,31 @@ def _config1(detail, sets1, scalars1, n_sets, reps):
         times1.append(time.perf_counter() - t0)
     rate1 = n_sets / min(times1)
     _STATE["rate1"] = rate1
-    # one-set batch isolates the fixed launch/transfer overhead of
-    # the tunneled chip; the marginal per-set cost is the honest
-    # kernel-throughput figure
+    # record the headline IMMEDIATELY: later configs can still blow the
+    # budget, and these numbers must reach the driver regardless
+    detail["config1_raw_batch"] = {
+        "batch": n_sets,
+        "sets_per_s": round(rate1, 2),
+        **_pcts(times1),
+    }
+    _STATE["times1"] = times1
+
+
+def _config1_marginal(detail, sets1, scalars1, n_sets):
+    """One-set overhead + marginal rate. Runs LAST: it needs the
+    128-lane bucket program, which config 3/4 have already traced by
+    then — no extra trace cost, and a budget overrun here only loses
+    this refinement, never the headline."""
+    import jax
+
+    from lighthouse_tpu.crypto.bls.backends import tpu as TB
+
+    times1 = _STATE.get("times1")
+    if not times1:
+        detail["config1_raw_batch"] = detail.get(
+            "config1_raw_batch", {"skipped": "config1 did not run"}
+        )
+        return
     args_one = TB.prepare_batch(sets1[:1], scalars1[:1])
     jax.block_until_ready(TB._verify_kernel(*args_one))
     t_one = []
@@ -190,14 +219,11 @@ def _config1(detail, sets1, scalars1, n_sets, reps):
         t_one.append(time.perf_counter() - t0)
     overhead = min(t_one)
     marginal = max(min(times1) - overhead, 1e-9) / max(n_sets - 1, 1)
-    detail["config1_raw_batch"] = {
-        "batch": n_sets,
-        "sets_per_s": round(rate1, 2),
-        "launch_overhead_s": round(overhead, 4),
-        "marginal_ms_per_set": round(marginal * 1e3, 4),
-        "marginal_sets_per_s": round(1.0 / marginal, 2),
-        **_pcts(times1),
-    }
+    detail["config1_raw_batch"].update(
+        launch_overhead_s=round(overhead, 4),
+        marginal_ms_per_set=round(marginal * 1e3, 4),
+        marginal_sets_per_s=round(1.0 / marginal, 2),
+    )
 
 
 def main():
@@ -208,7 +234,11 @@ def main():
     cpu_sets = int(os.environ.get("BENCH_CPU_SETS", "4"))
     run_kzg = os.environ.get("BENCH_KZG", "1") == "1"
     configs = set(os.environ.get("BENCH_CONFIGS", "1,2,3,4,5").split(","))
-    n_aggs = int(os.environ.get("BENCH_BLOCK_AGGS", "128"))
+    # 125 aggregates + proposer/randao/sync = 128 sets EXACTLY: config 3
+    # lands in the 128-lane bucket config 4 also uses, so the bench
+    # traces three distinct programs instead of four (trace+lower is
+    # minutes per program; see _BUDGET_S note)
+    n_aggs = int(os.environ.get("BENCH_BLOCK_AGGS", "125"))
     keys_per_agg = int(os.environ.get("BENCH_AGG_KEYS", "128"))
 
     signal.signal(signal.SIGTERM, _on_term)
@@ -243,15 +273,22 @@ def main():
     sets1 = _incremental_sets(max(n_sets, cpu_sets), msgs1)
     scalars1 = bls.gen_batch_scalars(len(sets1))
 
-    # min-budget figures assume a WARM compile cache (the seeded state
-    # the driver is supposed to run against); a cold bucket blows them
-    # and the alarm backstop emits whatever finished.
+    # Config ORDER is budget-driven (headline first, cheap-trace KZG
+    # second, then the remaining buckets); min-budget figures assume a
+    # WARM compile cache (the seeded state the driver runs against) —
+    # a cold bucket blows them and the alarm backstop emits whatever
+    # finished.
     if "1" in configs:
         _run_config(
             "config1_raw_batch", 60, _config1, sets1, scalars1, n_sets, reps
         )
     else:
         detail["config1_raw_batch"] = {"skipped": "BENCH_CONFIGS"}
+
+    if run_kzg and "5" in configs:
+        _run_config("config5_kzg_blob_batch", 60, _config5)
+    else:
+        detail["config5_kzg_blob_batch"] = {"skipped": "BENCH_KZG=0"}
 
     if "2" in configs:
         _run_config("config2_gossip_pipeline", 60, _config2, n_atts, batch_cap)
@@ -268,10 +305,10 @@ def main():
     else:
         detail["config4_sync_contribution"] = {"skipped": "BENCH_CONFIGS"}
 
-    if run_kzg and "5" in configs:
-        _run_config("config5_kzg_blob_batch", 60, _config5)
-    else:
-        detail["config5_kzg_blob_batch"] = {"skipped": "BENCH_KZG=0"}
+    if "1" in configs:
+        _run_config(
+            "config1_marginal", 20, _config1_marginal, sets1, scalars1, n_sets
+        )
 
     # ------------- in-repo CPU control (sanity only, NOT the baseline)
     if _left() > 30:
@@ -457,9 +494,12 @@ def _config5(detail):
     commitment = kzg.blob_to_kzg_commitment(blob)
     proof, _ = kzg.compute_blob_kzg_proof(blob, commitment)
     blobs = [blob] * (6 * 32)
-    # warm the device MSM + pairing kernels: their first-ever compile
-    # is minutes on the tunneled chip and must not count as throughput
-    kzg.verify_blob_kzg_proof_batch(blobs[:2], [commitment] * 2, [proof] * 2)
+    # warm with the SAME batch shape: the segmented MSM's bucket depends
+    # on the blob count, and a different warmup shape would leave the
+    # timed run paying the bucket's trace+lower (minutes) itself
+    kzg.verify_blob_kzg_proof_batch(
+        blobs, [commitment] * len(blobs), [proof] * len(blobs)
+    )
     t0 = time.perf_counter()
     ok5 = kzg.verify_blob_kzg_proof_batch(
         blobs, [commitment] * len(blobs), [proof] * len(blobs)

@@ -1,0 +1,106 @@
+"""ChaCha20-Poly1305 AEAD (RFC 8439) — the noise transport cipher
+(lighthouse_network's Noise_XX_25519_ChaChaPoly_SHA256 stack). Pure
+Python, pinned against the RFC 8439 §2.4.2/§2.5.2/§2.8.2 vectors in
+tests/test_noise.py."""
+
+from __future__ import annotations
+
+import struct
+
+_MASK32 = 0xFFFFFFFF
+
+
+def _rotl(v: int, n: int) -> int:
+    return ((v << n) | (v >> (32 - n))) & _MASK32
+
+
+def _quarter(state, a, b, c, d):
+    state[a] = (state[a] + state[b]) & _MASK32
+    state[d] = _rotl(state[d] ^ state[a], 16)
+    state[c] = (state[c] + state[d]) & _MASK32
+    state[b] = _rotl(state[b] ^ state[c], 12)
+    state[a] = (state[a] + state[b]) & _MASK32
+    state[d] = _rotl(state[d] ^ state[a], 8)
+    state[c] = (state[c] + state[d]) & _MASK32
+    state[b] = _rotl(state[b] ^ state[c], 7)
+
+
+def _chacha20_block(key: bytes, counter: int, nonce: bytes) -> bytes:
+    state = (
+        [0x61707865, 0x3320646E, 0x79622D32, 0x6B206574]
+        + list(struct.unpack("<8I", key))
+        + [counter]
+        + list(struct.unpack("<3I", nonce))
+    )
+    working = list(state)
+    for _ in range(10):
+        _quarter(working, 0, 4, 8, 12)
+        _quarter(working, 1, 5, 9, 13)
+        _quarter(working, 2, 6, 10, 14)
+        _quarter(working, 3, 7, 11, 15)
+        _quarter(working, 0, 5, 10, 15)
+        _quarter(working, 1, 6, 11, 12)
+        _quarter(working, 2, 7, 8, 13)
+        _quarter(working, 3, 4, 9, 14)
+    return struct.pack(
+        "<16I", *((w + s) & _MASK32 for w, s in zip(working, state))
+    )
+
+
+def chacha20_xor(key: bytes, counter: int, nonce: bytes, data: bytes) -> bytes:
+    out = bytearray()
+    for i in range(0, len(data), 64):
+        block = _chacha20_block(key, counter + i // 64, nonce)
+        chunk = data[i : i + 64]
+        out += bytes(a ^ b for a, b in zip(chunk, block))
+    return bytes(out)
+
+
+def poly1305(key: bytes, msg: bytes) -> bytes:
+    r = int.from_bytes(key[:16], "little") & 0x0FFFFFFC0FFFFFFC0FFFFFFC0FFFFFFF
+    s = int.from_bytes(key[16:32], "little")
+    p = (1 << 130) - 5
+    acc = 0
+    for i in range(0, len(msg), 16):
+        block = msg[i : i + 16]
+        n = int.from_bytes(block + b"\x01", "little")
+        acc = (acc + n) * r % p
+    return ((acc + s) & ((1 << 128) - 1)).to_bytes(16, "little")
+
+
+def _pad16(data: bytes) -> bytes:
+    return b"\x00" * (-len(data) % 16)
+
+
+def seal(key: bytes, nonce: bytes, plaintext: bytes, aad: bytes = b"") -> bytes:
+    """AEAD encrypt -> ciphertext || 16-byte tag (RFC 8439 §2.8)."""
+    otk = _chacha20_block(key, 0, nonce)[:32]
+    ct = chacha20_xor(key, 1, nonce, plaintext)
+    mac_data = (
+        aad
+        + _pad16(aad)
+        + ct
+        + _pad16(ct)
+        + struct.pack("<QQ", len(aad), len(ct))
+    )
+    return ct + poly1305(otk, mac_data)
+
+
+def open_(key: bytes, nonce: bytes, sealed: bytes, aad: bytes = b"") -> bytes:
+    """AEAD decrypt; raises ValueError on tag mismatch."""
+    import hmac as _hmac
+
+    if len(sealed) < 16:
+        raise ValueError("ciphertext too short")
+    ct, tag = sealed[:-16], sealed[-16:]
+    otk = _chacha20_block(key, 0, nonce)[:32]
+    mac_data = (
+        aad
+        + _pad16(aad)
+        + ct
+        + _pad16(ct)
+        + struct.pack("<QQ", len(aad), len(ct))
+    )
+    if not _hmac.compare_digest(poly1305(otk, mac_data), tag):
+        raise ValueError("chacha20poly1305: tag mismatch")
+    return chacha20_xor(key, 1, nonce, ct)

@@ -1,0 +1,69 @@
+"""X25519 (RFC 7748) — the Diffie-Hellman primitive under the libp2p
+noise transport (lighthouse_network's snow/Noise dependency). Pure
+Python; handshakes happen once per connection, so speed is irrelevant.
+Pinned against the RFC 7748 §5.2 test vectors in tests/test_noise.py."""
+
+from __future__ import annotations
+
+P = 2**255 - 19
+_A24 = 121665
+
+
+def _decode_u(u: bytes) -> int:
+    if len(u) != 32:
+        raise ValueError("x25519 u-coordinate must be 32 bytes")
+    x = bytearray(u)
+    x[31] &= 0x7F  # mask the high bit per RFC 7748
+    return int.from_bytes(bytes(x), "little") % P
+
+
+def _decode_scalar(k: bytes) -> int:
+    if len(k) != 32:
+        raise ValueError("x25519 scalar must be 32 bytes")
+    s = bytearray(k)
+    s[0] &= 248
+    s[31] &= 127
+    s[31] |= 64
+    return int.from_bytes(bytes(s), "little")
+
+
+def x25519(k: bytes, u: bytes) -> bytes:
+    """scalar * u-coordinate -> shared u-coordinate (RFC 7748 §5)."""
+    scalar = _decode_scalar(k)
+    x1 = _decode_u(u)
+    x2, z2, x3, z3 = 1, 0, x1, 1
+    swap = 0
+    for t in reversed(range(255)):
+        k_t = (scalar >> t) & 1
+        swap ^= k_t
+        if swap:
+            x2, x3 = x3, x2
+            z2, z3 = z3, z2
+        swap = k_t
+        a = (x2 + z2) % P
+        aa = a * a % P
+        b = (x2 - z2) % P
+        bb = b * b % P
+        e = (aa - bb) % P
+        c = (x3 + z3) % P
+        d = (x3 - z3) % P
+        da = d * a % P
+        cb = c * b % P
+        x3 = (da + cb) % P
+        x3 = x3 * x3 % P
+        z3 = (da - cb) % P
+        z3 = x1 * (z3 * z3 % P) % P
+        x2 = aa * bb % P
+        z2 = e * (aa + _A24 * e) % P
+    if swap:
+        x2, x3 = x3, x2
+        z2, z3 = z3, z2
+    out = x2 * pow(z2, P - 2, P) % P
+    return out.to_bytes(32, "little")
+
+
+BASEPOINT = (9).to_bytes(32, "little")
+
+
+def public_key(private: bytes) -> bytes:
+    return x25519(private, BASEPOINT)

@@ -1,22 +1,20 @@
 """Gossip pub/sub (the vendored-gossipsub role, lighthouse_network/gossipsub).
 
-Kept to the parts that shape system behavior rather than wire
-compatibility:
+Round 4: frames on the wire are REAL gossipsub protobuf RPC envelopes
+(network/gossipsub_wire.py — eth2 StrictNoSign messages, snappy-BLOCK
+payloads, the spec's SHA256-domain message-id), so the frame a peer
+reads off the GOSSIP channel is the byte shape a gossipsub v1.x node
+produces. Behavior kept from round 3:
   - fork-digest-scoped topics (types/pubsub.rs:482 style),
   - a per-topic MESH of peers messages are eagerly forwarded to,
-  - a seen-cache so each message id propagates once (the IDONTWANT
-    economy reduced to its effect: no duplicate re-entry),
+  - a seen-cache so each message id propagates once,
   - per-peer delivery accounting feeding peer scoring
     (gossipsub/src/peer_score.rs role).
-
-Message ids are content hashes (sha256 of topic+data, like the
-reference's message-id function over decompressed payloads).
+Mesh membership changes also emit spec GRAFT/PRUNE control frames.
 """
 
 from __future__ import annotations
 
-import hashlib
-import struct
 from collections import OrderedDict
 from dataclasses import dataclass
 from typing import Callable, Optional
@@ -47,19 +45,7 @@ def topic_for(template: str, fork_digest: bytes, subnet: int = None) -> str:
     return f"/eth2/{fork_digest.hex()}/{name}/ssz_snappy"
 
 
-def _message_id(topic: str, data: bytes) -> bytes:
-    return hashlib.sha256(topic.encode() + b"\x00" + data).digest()[:20]
-
-
-def _encode(topic: str, data: bytes) -> bytes:
-    t = topic.encode()
-    return struct.pack("<H", len(t)) + t + data
-
-
-def _decode(payload: bytes) -> tuple:
-    (tlen,) = struct.unpack("<H", payload[:2])
-    topic = payload[2 : 2 + tlen].decode()
-    return topic, payload[2 + tlen :]
+from . import gossipsub_wire as W
 
 
 class GossipRouter:
@@ -88,44 +74,95 @@ class GossipRouter:
         self.mesh.setdefault(topic, set())
         if len(self.mesh[topic]) < MESH_SIZE:
             self.mesh[topic].add(peer_id)
+            # announce mesh membership with a spec GRAFT control frame
+            rpc = W.GossipRpc()
+            rpc.control.graft.append(topic)
+            self.endpoint.send(peer_id, CHANNEL_GOSSIP, W.encode_rpc(rpc))
 
     def prune(self, peer_id: str) -> None:
+        pruned = [t for t, peers in self.mesh.items() if peer_id in peers]
         for peers in self.mesh.values():
             peers.discard(peer_id)
         self.delivery_stats.pop(peer_id, None)
+        if pruned:
+            rpc = W.GossipRpc()
+            rpc.control.prune = [(t, 0) for t in pruned]
+            self.endpoint.send(peer_id, CHANNEL_GOSSIP, W.encode_rpc(rpc))
 
     # -- data plane
 
     def publish(self, topic: str, data: bytes) -> int:
-        """Originate a message: mark seen, forward to the mesh."""
-        mid = _message_id(topic, data)
+        """Originate a message (data = raw SSZ): snappy-compress into
+        the wire form, mark seen, forward to the mesh. The id hashes
+        the SSZ we already hold — no decompress round-trip."""
+        wire = W.compress_payload(data)
+        mid = W.message_id_from_ssz(topic, data)
         self._mark_seen(mid)
-        return self._forward(topic, data, exclude=None)
+        return self._forward(topic, wire, exclude=None)
 
     def handle_frame(self, sender: str, payload: bytes) -> Optional[tuple]:
-        """Inbound gossip frame: dedup, deliver locally, forward on.
-        Returns (sender, topic, data) for fresh messages on subscribed
-        topics, else None."""
-        topic, data = _decode(payload)
-        mid = _message_id(topic, data)
-        stats = self.delivery_stats.setdefault(sender, [0, 0])
-        if mid in self._seen:
-            stats[1] += 1  # duplicate: mesh overlap, mild negative signal
+        """Inbound gossipsub RPC frame: dedup/forward every published
+        message, apply control messages, deliver fresh subscribed
+        payloads locally. Returns (sender, topic, ssz_data) for the
+        first fresh message on a subscribed topic, else None."""
+        try:
+            rpc = W.decode_rpc(payload)
+        except Exception:
+            # ANY malformed remote bytes (bad protobuf, non-UTF8 topic,
+            # wrong wire types) score negatively — they must never reach
+            # the service poll loop as an exception
+            stats = self.delivery_stats.setdefault(sender, [0, 0])
+            stats[1] += 1
             return None
-        stats[0] += 1
-        self._mark_seen(mid)
-        self._forward(topic, data, exclude=sender)
-        if topic in self.subscriptions:
-            if self.on_message is not None:
-                self.on_message(sender, topic, data)
-            return (sender, topic, data)
-        return None
+        for topic in rpc.control.graft:
+            # spec posture: GRAFT on a topic we aren't subscribed to
+            # (or whose mesh is full) is answered with PRUNE — and
+            # never grows state for arbitrary remote strings
+            if topic in self.subscriptions and len(
+                self.mesh.setdefault(topic, set())
+            ) < MESH_SIZE:
+                self.mesh[topic].add(sender)
+            else:
+                rej = W.GossipRpc()
+                rej.control.prune.append((topic, 0))
+                self.endpoint.send(sender, CHANNEL_GOSSIP, W.encode_rpc(rej))
+        for topic, _backoff in rpc.control.prune:
+            self.mesh.get(topic, set()).discard(sender)
+        delivered = None
+        for m in rpc.publish:
+            stats = self.delivery_stats.setdefault(sender, [0, 0])
+            try:
+                ssz = W.decompress_payload(m.data)
+                mid = W.message_id_from_ssz(m.topic, ssz)
+            except Exception:
+                stats[1] += 1  # undecodable payload: dedup junk by id
+                try:
+                    self._mark_seen(W.message_id(m.topic, m.data))
+                except Exception:
+                    pass
+                continue
+            if mid in self._seen:
+                stats[1] += 1  # duplicate: mesh overlap, mild negative
+                continue
+            stats[0] += 1
+            self._mark_seen(mid)
+            self._forward(m.topic, m.data, exclude=sender)
+            if m.topic in self.subscriptions:
+                if self.on_message is not None:
+                    self.on_message(sender, m.topic, ssz)
+                if delivered is None:
+                    delivered = (sender, m.topic, ssz)
+        return delivered
 
-    def _forward(self, topic: str, data: bytes, exclude: Optional[str]) -> int:
+    def _forward(self, topic: str, wire: bytes, exclude: Optional[str]) -> int:
+        rpc = W.GossipRpc(
+            publish=[W.PublishedMessage(topic=topic, data=wire)]
+        )
+        frame = W.encode_rpc(rpc)
         n = 0
         for peer in self.mesh.get(topic, ()):
             if peer != exclude and self.endpoint.send(
-                peer, CHANNEL_GOSSIP, _encode(topic, data)
+                peer, CHANNEL_GOSSIP, frame
             ):
                 n += 1
         return n

@@ -1,0 +1,206 @@
+"""Noise_XX_25519_ChaChaPoly_SHA256 — the libp2p-noise handshake the
+reference runs under every connection (lighthouse_network
+service/utils.rs builds noise-over-TCP via snow; protocol name
+`Noise_XX_25519_ChaChaPoly_SHA256`).
+
+Implements the Noise spec (rev 34) state machine for the XX pattern:
+
+    XX:
+      -> e
+      <- e, ee, s, es
+      -> s, se
+
+plus the transport phase (CipherState pair from Split()). Primitives:
+crypto/x25519.py + crypto/chacha20poly1305.py (RFC-vector pinned),
+SHA256/HMAC from hashlib. The handshake payloads carry whatever the
+caller supplies (libp2p puts a signed identity blob there; the socket
+transport uses the peer-id HELLO).
+
+Symmetry is proven by tests/test_noise.py: both roles derive identical
+transport keys, messages tamper-fail, and nonces advance per message.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import os
+import struct
+from typing import Optional
+
+from ..crypto import chacha20poly1305 as aead
+from ..crypto import x25519
+
+PROTOCOL_NAME = b"Noise_XX_25519_ChaChaPoly_SHA256"
+
+
+class NoiseError(Exception):
+    pass
+
+
+def _hkdf(chaining_key: bytes, ikm: bytes, n: int) -> tuple:
+    """Noise HKDF: returns n (2 or 3) 32-byte outputs."""
+    temp = hmac.new(chaining_key, ikm, hashlib.sha256).digest()
+    out1 = hmac.new(temp, b"\x01", hashlib.sha256).digest()
+    out2 = hmac.new(temp, out1 + b"\x02", hashlib.sha256).digest()
+    if n == 2:
+        return out1, out2
+    out3 = hmac.new(temp, out2 + b"\x03", hashlib.sha256).digest()
+    return out1, out2, out3
+
+
+class CipherState:
+    def __init__(self):
+        self.k: Optional[bytes] = None
+        self.n = 0
+
+    def initialize_key(self, key: Optional[bytes]) -> None:
+        self.k = key
+        self.n = 0
+
+    def _nonce(self) -> bytes:
+        return b"\x00" * 4 + struct.pack("<Q", self.n)
+
+    def encrypt_with_ad(self, ad: bytes, plaintext: bytes) -> bytes:
+        if self.k is None:
+            return plaintext
+        out = aead.seal(self.k, self._nonce(), plaintext, ad)
+        self.n += 1
+        return out
+
+    def decrypt_with_ad(self, ad: bytes, ciphertext: bytes) -> bytes:
+        if self.k is None:
+            return ciphertext
+        try:
+            out = aead.open_(self.k, self._nonce(), ciphertext, ad)
+        except ValueError as e:
+            raise NoiseError(str(e)) from None
+        self.n += 1
+        return out
+
+
+class SymmetricState:
+    def __init__(self):
+        self.ck = hashlib.sha256(PROTOCOL_NAME).digest() if len(
+            PROTOCOL_NAME
+        ) > 32 else PROTOCOL_NAME.ljust(32, b"\x00")
+        self.h = self.ck
+        self.cipher = CipherState()
+
+    def mix_key(self, ikm: bytes) -> None:
+        self.ck, temp_k = _hkdf(self.ck, ikm, 2)
+        self.cipher.initialize_key(temp_k)
+
+    def mix_hash(self, data: bytes) -> None:
+        self.h = hashlib.sha256(self.h + data).digest()
+
+    def encrypt_and_hash(self, plaintext: bytes) -> bytes:
+        ct = self.cipher.encrypt_with_ad(self.h, plaintext)
+        self.mix_hash(ct)
+        return ct
+
+    def decrypt_and_hash(self, ciphertext: bytes) -> bytes:
+        pt = self.cipher.decrypt_with_ad(self.h, ciphertext)
+        self.mix_hash(ciphertext)
+        return pt
+
+    def split(self) -> tuple:
+        k1, k2 = _hkdf(self.ck, b"", 2)
+        c1, c2 = CipherState(), CipherState()
+        c1.initialize_key(k1)
+        c2.initialize_key(k2)
+        return c1, c2
+
+
+class NoiseXX:
+    """One side of a Noise XX handshake.
+
+    Usage (initiator):     Usage (responder):
+      m1 = a.write_msg1()    b.read_msg1(m1)
+      a.read_msg2(m2)        m2 = b.write_msg2(payload)
+      m3 = a.write_msg3(pl)  b.read_msg3(m3)
+      a.split() / b.split() -> (send_cipher, recv_cipher), role-aware.
+    """
+
+    def __init__(self, initiator: bool, static_private: bytes = None):
+        self.initiator = initiator
+        self.s_priv = static_private or os.urandom(32)
+        self.s_pub = x25519.public_key(self.s_priv)
+        self.e_priv: Optional[bytes] = None
+        self.e_pub: Optional[bytes] = None
+        self.re: Optional[bytes] = None
+        self.rs: Optional[bytes] = None
+        self.ss = SymmetricState()
+        self.ss.mix_hash(b"")  # empty prologue
+        self.remote_payload: bytes = b""
+
+    # -- message 1: -> e
+
+    def write_msg1(self) -> bytes:
+        assert self.initiator
+        self.e_priv = self.e_priv or os.urandom(32)
+        self.e_pub = x25519.public_key(self.e_priv)
+        self.ss.mix_hash(self.e_pub)
+        return self.e_pub + self.ss.encrypt_and_hash(b"")
+
+    def read_msg1(self, msg: bytes) -> None:
+        assert not self.initiator
+        if len(msg) < 32:
+            raise NoiseError("short msg1")
+        self.re = msg[:32]
+        self.ss.mix_hash(self.re)
+        self.ss.decrypt_and_hash(msg[32:])
+
+    # -- message 2: <- e, ee, s, es
+
+    def write_msg2(self, payload: bytes = b"") -> bytes:
+        assert not self.initiator
+        self.e_priv = self.e_priv or os.urandom(32)
+        self.e_pub = x25519.public_key(self.e_priv)
+        out = bytearray()
+        self.ss.mix_hash(self.e_pub)
+        out += self.e_pub
+        self.ss.mix_key(x25519.x25519(self.e_priv, self.re))      # ee
+        out += self.ss.encrypt_and_hash(self.s_pub)               # s
+        self.ss.mix_key(x25519.x25519(self.s_priv, self.re))      # es
+        out += self.ss.encrypt_and_hash(payload)
+        return bytes(out)
+
+    def read_msg2(self, msg: bytes) -> None:
+        assert self.initiator
+        if len(msg) < 32 + 48:
+            raise NoiseError("short msg2")
+        self.re = msg[:32]
+        self.ss.mix_hash(self.re)
+        self.ss.mix_key(x25519.x25519(self.e_priv, self.re))      # ee
+        self.rs = self.ss.decrypt_and_hash(msg[32:80])            # s
+        self.ss.mix_key(x25519.x25519(self.e_priv, self.rs))      # es
+        self.remote_payload = self.ss.decrypt_and_hash(msg[80:])
+
+    # -- message 3: -> s, se
+
+    def write_msg3(self, payload: bytes = b"") -> bytes:
+        assert self.initiator
+        out = bytearray()
+        out += self.ss.encrypt_and_hash(self.s_pub)               # s
+        self.ss.mix_key(x25519.x25519(self.s_priv, self.re))      # se
+        out += self.ss.encrypt_and_hash(payload)
+        return bytes(out)
+
+    def read_msg3(self, msg: bytes) -> None:
+        assert not self.initiator
+        if len(msg) < 48:
+            raise NoiseError("short msg3")
+        self.rs = self.ss.decrypt_and_hash(msg[:48])              # s
+        self.ss.mix_key(x25519.x25519(self.e_priv, self.rs))      # se
+        self.remote_payload = self.ss.decrypt_and_hash(msg[48:])
+
+    def split(self) -> tuple:
+        """(send, recv) CipherStates for THIS role (noise spec: the
+        first split cipher is the initiator->responder direction)."""
+        c1, c2 = self.ss.split()
+        return (c1, c2) if self.initiator else (c2, c1)
+
+    @property
+    def handshake_hash(self) -> bytes:
+        return self.ss.h

@@ -3,18 +3,25 @@
 Implements the transport.Endpoint seam over localhost/LAN TCP so two
 `lighthouse_tpu.cli bn` OS processes can handshake, gossip and
 range-sync (the role of lighthouse_network's TCP stack,
-service/utils.rs:52-63 — minus QUIC/noise/yamux, which ride behind the
-same seam later; frames carry snappy-compressed payloads like the
-reference's gossip transform and SSZ-snappy RPC codec).
+service/utils.rs:52-63 — minus QUIC/yamux, which ride behind the same
+seam later; frames carry snappy-compressed payloads like the
+reference's gossip transform).
 
 Wire format, one frame:
     u32le  frame_length (of everything after this field)
     u8     channel      (CHANNEL_GOSSIP / CHANNEL_RPC / 255 = HELLO)
     bytes  snappy(payload)
 
-Connection lifecycle: dial -> send HELLO{our peer_id} -> receive
-HELLO{their peer_id} -> frames flow. The acceptor side mirrors it.
-Reader threads push decoded frames into the same inbox `poll()`/
+With `noise=True` (round 4) the connection first runs a REAL
+Noise_XX_25519_ChaChaPoly_SHA256 handshake (network/noise.py — the
+protocol the reference's snow stack speaks, service/utils.rs:38-63);
+the peer-id HELLO rides the handshake payloads, and every subsequent
+frame body (channel byte + snappy payload) is AEAD-encrypted under the
+session's transport ciphers. Plaintext mode stays the default for the
+in-repo twin-node tests.
+
+Connection lifecycle: dial -> HELLO (or noise handshake) -> frames
+flow. Reader threads push decoded frames into the same inbox `poll()`/
 `drain()` the in-process hub uses, so NetworkService and everything
 above it is transport-agnostic.
 """
@@ -42,12 +49,23 @@ _MAX_INBOX_PER_PEER = 4096
 class SocketEndpoint:
     """transport.Endpoint over TCP. join via SocketHub below."""
 
-    def __init__(self, peer_id: str, host: str = "127.0.0.1", port: int = 0):
+    def __init__(
+        self,
+        peer_id: str,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        noise: bool = False,
+        static_key: bytes = None,
+    ):
         self.peer_id = peer_id
+        self.noise = noise
+        self._static_key = static_key
         self._inbox: deque[Frame] = deque()
         self._inbox_counts: dict[str, int] = {}
         self._lock = threading.Lock()
         self._conns: dict[str, socket.socket] = {}
+        # peer -> (send_cipher, recv_cipher, send_lock); None = plaintext
+        self._ciphers: dict[str, tuple] = {}
         self._closed = False
         self.on_peer_connected: Optional[Callable] = None
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -60,18 +78,54 @@ class SocketEndpoint:
     # ------------------------------------------------------------ wiring
 
     def connect(self, host: str, port: int, timeout: float = 5.0) -> str:
-        """Dial a peer; returns its peer_id after the HELLO exchange."""
+        """Dial a peer; returns its peer_id after the HELLO exchange
+        (or the noise handshake when encryption is on)."""
         s = socket.create_connection((host, port), timeout=timeout)
-        s.settimeout(timeout)
-        _send_frame(s, CHANNEL_HELLO, self.peer_id.encode())
-        ch, payload = _recv_frame(s)
-        if ch != CHANNEL_HELLO:
-            s.close()
-            raise ConnectionError("peer did not HELLO")
-        peer = payload.decode()
-        s.settimeout(None)
-        self._register(peer, s)
-        return peer
+        try:
+            s.settimeout(timeout)
+            if self.noise:
+                peer, ciphers = self._noise_dial(s)
+                s.settimeout(None)
+                self._register(peer, s, ciphers)
+                return peer
+            _send_frame(s, CHANNEL_HELLO, self.peer_id.encode())
+            ch, payload = _recv_frame(s)
+            if ch != CHANNEL_HELLO:
+                raise ConnectionError("peer did not HELLO")
+            peer = payload.decode()
+            s.settimeout(None)
+            self._register(peer, s)
+            return peer
+        except BaseException:
+            try:
+                s.close()  # never leak the fd on a failed handshake
+            except OSError:
+                pass
+            raise
+
+    # ---------------------------------------------------------- noise
+
+    def _noise_dial(self, s: socket.socket) -> tuple:
+        from .noise import NoiseXX
+
+        hs = NoiseXX(initiator=True, static_private=self._static_key)
+        _send_raw(s, hs.write_msg1())
+        hs.read_msg2(_recv_raw(s))
+        _send_raw(s, hs.write_msg3(self.peer_id.encode()))
+        peer = hs.remote_payload.decode()
+        send, recv = hs.split()
+        return peer, (send, recv, threading.Lock())
+
+    def _noise_accept(self, s: socket.socket) -> tuple:
+        from .noise import NoiseXX
+
+        hs = NoiseXX(initiator=False, static_private=self._static_key)
+        hs.read_msg1(_recv_raw(s))
+        _send_raw(s, hs.write_msg2(self.peer_id.encode()))
+        hs.read_msg3(_recv_raw(s))
+        peer = hs.remote_payload.decode()
+        send, recv = hs.split()
+        return peer, (send, recv, threading.Lock())
 
     def _accept_loop(self) -> None:
         while not self._closed:
@@ -86,6 +140,11 @@ class SocketEndpoint:
     def _accept_one(self, s: socket.socket) -> None:
         try:
             s.settimeout(5.0)
+            if self.noise:
+                peer, ciphers = self._noise_accept(s)
+                s.settimeout(None)
+                self._register(peer, s, ciphers)
+                return
             ch, payload = _recv_frame(s)
             if ch != CHANNEL_HELLO:
                 s.close()
@@ -94,13 +153,22 @@ class SocketEndpoint:
             _send_frame(s, CHANNEL_HELLO, self.peer_id.encode())
             s.settimeout(None)
             self._register(peer, s)
-        except (OSError, ConnectionError, snappy.SnappyError):
-            s.close()
+        except Exception:
+            # remote bytes must never kill the acceptor thread or leak
+            # the fd (non-UTF8 handshake payloads, codec errors, ...)
+            try:
+                s.close()
+            except OSError:
+                pass
 
-    def _register(self, peer: str, s: socket.socket) -> None:
+    def _register(self, peer: str, s: socket.socket, ciphers=None) -> None:
         with self._lock:
             old = self._conns.pop(peer, None)
             self._conns[peer] = s
+            if ciphers is not None:
+                self._ciphers[peer] = ciphers
+            else:
+                self._ciphers.pop(peer, None)
         if old is not None:
             try:
                 old.close()
@@ -114,9 +182,13 @@ class SocketEndpoint:
             cb(peer)
 
     def _read_loop(self, peer: str, s: socket.socket) -> None:
+        from .noise import NoiseError
+
+        ciphers = self._ciphers.get(peer)
+        recv_cipher = ciphers[1] if ciphers else None
         try:
             while not self._closed:
-                ch, payload = _recv_frame(s)
+                ch, payload = _recv_frame(s, recv_cipher)
                 with self._lock:
                     if self._inbox_counts.get(peer, 0) >= _MAX_INBOX_PER_PEER:
                         raise ConnectionError(
@@ -128,12 +200,13 @@ class SocketEndpoint:
                     self._inbox_counts[peer] = (
                         self._inbox_counts.get(peer, 0) + 1
                     )
-        except (OSError, ConnectionError, snappy.SnappyError):
+        except (OSError, ConnectionError, snappy.SnappyError, NoiseError):
             pass
         finally:
             with self._lock:
                 if self._conns.get(peer) is s:
                     del self._conns[peer]
+                    self._ciphers.pop(peer, None)
             try:
                 s.close()
             except OSError:
@@ -144,10 +217,17 @@ class SocketEndpoint:
     def send(self, to_peer: str, channel: int, payload: bytes) -> bool:
         with self._lock:
             s = self._conns.get(to_peer)
+            ciphers = self._ciphers.get(to_peer)
         if s is None:
             return False
         try:
-            _send_frame(s, channel, payload)
+            if ciphers is not None:
+                send_cipher, _, send_lock = ciphers
+                # nonce ordering: one in-flight encrypt+send per conn
+                with send_lock:
+                    _send_frame(s, channel, payload, send_cipher)
+            else:
+                _send_frame(s, channel, payload)
             return True
         except OSError:
             return False
@@ -217,8 +297,12 @@ class SocketHub:
 # ---------------------------------------------------------------- framing
 
 
-def _send_frame(s: socket.socket, channel: int, payload: bytes) -> None:
+def _send_frame(
+    s: socket.socket, channel: int, payload: bytes, cipher=None
+) -> None:
     body = bytes([channel]) + snappy.compress(payload)
+    if cipher is not None:
+        body = cipher.encrypt_with_ad(b"", body)
     s.sendall(struct.pack("<I", len(body)) + body)
 
 
@@ -232,9 +316,22 @@ def _recv_exact(s: socket.socket, n: int) -> bytes:
     return bytes(buf)
 
 
-def _recv_frame(s: socket.socket) -> tuple:
+def _recv_frame(s: socket.socket, cipher=None) -> tuple:
     (ln,) = struct.unpack("<I", _recv_exact(s, 4))
     if ln < 1 or ln > _MAX_FRAME:
         raise ConnectionError(f"bad frame length {ln}")
     body = _recv_exact(s, ln)
+    if cipher is not None:
+        body = cipher.decrypt_with_ad(b"", body)
     return body[0], snappy.decompress(body[1:])
+
+
+def _send_raw(s: socket.socket, data: bytes) -> None:
+    s.sendall(struct.pack("<I", len(data)) + data)
+
+
+def _recv_raw(s: socket.socket) -> bytes:
+    (ln,) = struct.unpack("<I", _recv_exact(s, 4))
+    if ln > _MAX_FRAME:
+        raise ConnectionError(f"bad handshake length {ln}")
+    return _recv_exact(s, ln)

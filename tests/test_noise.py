@@ -1,0 +1,106 @@
+"""Noise stack: primitives against RFC vectors, handshake by mutual
+derivation + tamper rejection."""
+
+import pytest
+
+from lighthouse_tpu.crypto import chacha20poly1305 as aead
+from lighthouse_tpu.crypto import x25519
+from lighthouse_tpu.network.noise import NoiseError, NoiseXX
+
+
+def test_x25519_rfc7748_vectors():
+    # RFC 7748 §5.2 vector 1
+    k = bytes.fromhex(
+        "a546e36bf0527c9d3b16154b82465edd62144c0ac1fc5a18506a2244ba449ac4"
+    )
+    u = bytes.fromhex(
+        "e6db6867583030db3594c1a424b15f7c726624ec26b3353b10a903a6d0ab1c4c"
+    )
+    want = "c3da55379de9c6908e94ea4df28d084f32eccf03491c71f754b4075577a28552"
+    assert x25519.x25519(k, u).hex() == want
+    # RFC 7748 §6.1 Diffie-Hellman vector
+    a = bytes.fromhex(
+        "77076d0a7318a57d3c16c17251b26645df4c2f87ebc0992ab177fba51db92c2a"
+    )
+    b = bytes.fromhex(
+        "5dab087e624a8a4b79e17f8b83800ee66f3bb1292618b6fd1c2f8b27ff88e0eb"
+    )
+    a_pub = x25519.public_key(a)
+    b_pub = x25519.public_key(b)
+    assert a_pub.hex() == (
+        "8520f0098930a754748b7ddcb43ef75a0dbf3a0d26381af4eba4a98eaa9b4e6a"
+    )
+    assert b_pub.hex() == (
+        "de9edb7d7b7dc1b4d35b61c2ece435373f8343c85b78674dadfc7e146f882b4f"
+    )
+    shared = "4a5d9d5ba4ce2de1728e3bf480350f25e07e21c947d19e3376f09b3c1e161742"
+    assert x25519.x25519(a, b_pub).hex() == shared
+    assert x25519.x25519(b, a_pub).hex() == shared
+
+
+def test_chacha20poly1305_rfc8439_vector():
+    # RFC 8439 §2.8.2 AEAD test vector
+    key = bytes(range(0x80, 0xA0))
+    nonce = bytes.fromhex("070000004041424344454647")
+    aad = bytes.fromhex("50515253c0c1c2c3c4c5c6c7")
+    plaintext = (
+        b"Ladies and Gentlemen of the class of '99: If I could offer you "
+        b"only one tip for the future, sunscreen would be it."
+    )
+    sealed = aead.seal(key, nonce, plaintext, aad)
+    assert sealed[:16].hex() == "d31a8d34648e60db7b86afbc53ef7ec2"
+    assert sealed[-16:].hex() == "1ae10b594f09e26a7e902ecbd0600691"
+    assert aead.open_(key, nonce, sealed, aad) == plaintext
+    with pytest.raises(ValueError):
+        aead.open_(key, nonce, sealed[:-1] + b"\x00", aad)
+
+
+def test_poly1305_rfc8439_vector():
+    key = bytes.fromhex(
+        "85d6be7857556d337f4452fe42d506a8"
+        "0103808afb0db2fd4abff6af4149f51b"
+    )
+    msg = b"Cryptographic Forum Research Group"
+    assert aead.poly1305(key, msg).hex() == (
+        "a8061dc1305136c6c22b8baf0c0127a9"
+    )
+
+
+def test_noise_xx_handshake_and_transport():
+    a = NoiseXX(initiator=True)
+    b = NoiseXX(initiator=False)
+    b.read_msg1(a.write_msg1())
+    a.read_msg2(b.write_msg2(b"resp-identity"))
+    b.read_msg3(a.write_msg3(b"init-identity"))
+
+    # payloads crossed, static keys learned, transcripts agree
+    assert a.remote_payload == b"resp-identity"
+    assert b.remote_payload == b"init-identity"
+    assert a.rs == b.s_pub and b.rs == a.s_pub
+    assert a.handshake_hash == b.handshake_hash
+
+    a_send, a_recv = a.split()
+    b_send, b_recv = b.split()
+    # transport: both directions round-trip, nonces advance
+    for i in range(3):
+        ct = a_send.encrypt_with_ad(b"", b"ping-%d" % i)
+        assert b_recv.decrypt_with_ad(b"", ct) == b"ping-%d" % i
+    ct = b_send.encrypt_with_ad(b"", b"pong")
+    assert a_recv.decrypt_with_ad(b"", ct) == b"pong"
+
+    # tampered transport frame is rejected
+    ct = a_send.encrypt_with_ad(b"", b"secret")
+    with pytest.raises(NoiseError):
+        b_recv.decrypt_with_ad(b"", b"\x00" + ct[1:])
+
+
+def test_noise_xx_mitm_static_swap_fails():
+    """An attacker replacing the responder's static key cannot complete:
+    message 2's es-encrypted section fails to authenticate."""
+    a = NoiseXX(initiator=True)
+    b = NoiseXX(initiator=False)
+    b.read_msg1(a.write_msg1())
+    msg2 = bytearray(b.write_msg2())
+    msg2[40] ^= 1  # flip one bit inside the encrypted static key
+    with pytest.raises(NoiseError):
+        a.read_msg2(bytes(msg2))

@@ -192,3 +192,49 @@ class TestSnappyBombGuard:
         # run-length: "ab" repeated via overlapping copy (off=2 < len)
         data = b"ab" * 40
         assert snappy.decompress(snappy.compress(data)) == data
+
+
+class TestNoiseTransport:
+    def test_encrypted_endpoints_handshake_and_frame(self):
+        """Two SocketEndpoints in noise mode: the XX handshake carries
+        the peer ids, frames are AEAD-encrypted on the wire, and the
+        Endpoint API is unchanged."""
+        a = SocketEndpoint("enc-a", noise=True)
+        b = SocketEndpoint("enc-b", noise=True)
+        try:
+            peer = a.connect(*b.addr)
+            assert peer == "enc-b"
+            deadline = time.time() + 5
+            while "enc-a" not in b.connected_peers() and time.time() < deadline:
+                time.sleep(0.01)
+            assert a.send("enc-b", 7, b"ciphered-payload")
+            frame = None
+            deadline = time.time() + 5
+            while frame is None and time.time() < deadline:
+                frame = b.poll()
+                time.sleep(0.01)
+            assert frame is not None
+            assert (frame.sender, frame.channel, frame.payload) == (
+                "enc-a", 7, b"ciphered-payload"
+            )
+            # and the reverse direction
+            assert b.send("enc-a", 9, b"back")
+            frame = None
+            deadline = time.time() + 5
+            while frame is None and time.time() < deadline:
+                frame = a.poll()
+                time.sleep(0.01)
+            assert frame.payload == b"back"
+        finally:
+            a.close()
+            b.close()
+
+    def test_plaintext_peer_cannot_talk_to_noise_listener(self):
+        a = SocketEndpoint("plain-a", noise=False)
+        b = SocketEndpoint("noise-b", noise=True)
+        try:
+            with pytest.raises((ConnectionError, OSError)):
+                a.connect(*b.addr, timeout=2.0)
+        finally:
+            a.close()
+            b.close()
