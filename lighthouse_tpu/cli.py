@@ -109,6 +109,11 @@ def _build_parser() -> argparse.ArgumentParser:
     ps = lcli_sub.add_parser("parse-ssz")
     ps.add_argument("type_name")
     ps.add_argument("file")
+    ge = lcli_sub.add_parser("generate-bootnode-enr")
+    ge.add_argument("--private-key", required=True, help="secp256k1 hex")
+    ge.add_argument("--ip", default="127.0.0.1")
+    ge.add_argument("--udp-port", type=int, default=9000)
+    ge.add_argument("--tcp-port", type=int, default=9000)
     sr = lcli_sub.add_parser("state-root")
     sr.add_argument("--state", required=True)
     br = lcli_sub.add_parser("block-root")
@@ -566,6 +571,15 @@ def cmd_lcli(args) -> int:
         with open(args.out, "wb") as f:
             f.write(out)
         print(f"wrote {args.count}-validator genesis to {args.out}")
+        return 0
+    if args.lcli_cmd == "generate-bootnode-enr":
+        print(
+            json.dumps(
+                L.generate_bootnode_enr(
+                    args.private_key, args.ip, args.udp_port, args.tcp_port
+                )
+            )
+        )
         return 0
     if args.lcli_cmd == "state-root":
         with open(args.state, "rb") as f:

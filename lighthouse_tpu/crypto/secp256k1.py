@@ -1,0 +1,129 @@
+"""secp256k1 ECDSA — the discv5 ENR identity scheme ("v4") signature
+algorithm (enr crate / discv5 dependency in the reference). Pure
+Python: ENR signing/verification happens at discovery cadence, not on
+a hot path. Deterministic nonces per RFC 6979 (required for
+reproducible ENR vectors). Pinned against the EIP-778 example record in
+tests/test_enr.py (known private key -> known signed ENR)."""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+
+P = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEFFFFFC2F
+N = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
+Gx = 0x79BE667EF9DCBBAC55A06295CE870B07029BFCDB2DCE28D959F2815B16F81798
+Gy = 0x483ADA7726A3C4655DA4FBFC0E1108A8FD17B448A68554199C47D08FFB10D4B8
+
+
+def _inv(a: int, m: int) -> int:
+    return pow(a, m - 2, m)
+
+
+def _add(p1, p2):
+    if p1 is None:
+        return p2
+    if p2 is None:
+        return p1
+    x1, y1 = p1
+    x2, y2 = p2
+    if x1 == x2:
+        if (y1 + y2) % P == 0:
+            return None
+        lam = (3 * x1 * x1) * _inv(2 * y1, P) % P
+    else:
+        lam = (y2 - y1) * _inv((x2 - x1) % P, P) % P
+    x3 = (lam * lam - x1 - x2) % P
+    return x3, (lam * (x1 - x3) - y1) % P
+
+
+def _mul(k: int, point):
+    acc = None
+    addend = point
+    while k:
+        if k & 1:
+            acc = _add(acc, addend)
+        addend = _add(addend, addend)
+        k >>= 1
+    return acc
+
+
+def pubkey(private: bytes):
+    return _mul(int.from_bytes(private, "big"), (Gx, Gy))
+
+
+def pubkey_compressed(private: bytes) -> bytes:
+    x, y = pubkey(private)
+    return bytes([2 + (y & 1)]) + x.to_bytes(32, "big")
+
+
+def decompress(pub: bytes):
+    if len(pub) != 33 or pub[0] not in (2, 3):
+        raise ValueError("bad compressed secp256k1 point")
+    x = int.from_bytes(pub[1:], "big")
+    y2 = (pow(x, 3, P) + 7) % P
+    y = pow(y2, (P + 1) // 4, P)
+    if y * y % P != y2:
+        raise ValueError("not on curve")
+    if (y & 1) != (pub[0] & 1):
+        y = P - y
+    return x, y
+
+
+def _rfc6979_k(msg_hash: bytes, private: bytes) -> int:
+    """Deterministic nonce (RFC 6979, SHA-256)."""
+    v = b"\x01" * 32
+    k = b"\x00" * 32
+    x = private
+    h1 = msg_hash
+    k = hmac.new(k, v + b"\x00" + x + h1, hashlib.sha256).digest()
+    v = hmac.new(k, v, hashlib.sha256).digest()
+    k = hmac.new(k, v + b"\x01" + x + h1, hashlib.sha256).digest()
+    v = hmac.new(k, v, hashlib.sha256).digest()
+    while True:
+        v = hmac.new(k, v, hashlib.sha256).digest()
+        cand = int.from_bytes(v, "big")
+        if 1 <= cand < N:
+            return cand
+        k = hmac.new(k, v + b"\x00", hashlib.sha256).digest()
+        v = hmac.new(k, v, hashlib.sha256).digest()
+
+
+def sign(msg_hash: bytes, private: bytes) -> bytes:
+    """64-byte r||s signature (low-s normalized, the ENR convention)."""
+    z = int.from_bytes(msg_hash, "big")
+    d = int.from_bytes(private, "big")
+    while True:
+        k = _rfc6979_k(msg_hash, private)
+        x, _y = _mul(k, (Gx, Gy))
+        r = x % N
+        if r == 0:
+            continue
+        s = _inv(k, N) * (z + r * d) % N
+        if s == 0:
+            continue
+        if s > N // 2:
+            s = N - s
+        return r.to_bytes(32, "big") + s.to_bytes(32, "big")
+
+
+def verify(msg_hash: bytes, sig: bytes, pub) -> bool:
+    if len(sig) != 64:
+        return False
+    r = int.from_bytes(sig[:32], "big")
+    s = int.from_bytes(sig[32:], "big")
+    if not (1 <= r < N and 1 <= s < N):
+        return False
+    if isinstance(pub, (bytes, bytearray)):
+        try:
+            pub = decompress(bytes(pub))
+        except ValueError:
+            return False
+    z = int.from_bytes(msg_hash, "big")
+    w = _inv(s, N)
+    u1 = z * w % N
+    u2 = r * w % N
+    pt = _add(_mul(u1, (Gx, Gy)), _mul(u2, pub))
+    if pt is None:
+        return False
+    return pt[0] % N == r

@@ -24,19 +24,66 @@ MAX_DISCOVERY_RESPONSE = 16
 
 @dataclass
 class PeerRecord:
-    """ENR analog. `attnets` is a bitfield int over 64 subnets."""
+    """Peer advertisement. `attnets` is a bitfield int over 64 subnets.
+
+    Round 4: records can additionally carry (and be built from) a REAL
+    signed EIP-778 ENR (`enr` field = textual form, network/enr.py).
+    `validated()` is the ingest gate every untrusted source must pass:
+    when an ENR is present, the signature is verified, the peer_id is
+    BOUND to the record's node id, and seq / attnets / csc (custody
+    subnet count) are read from the SIGNED document — the surrounding
+    JSON claims are discarded."""
 
     peer_id: str
     seq: int = 0
     attnets: int = 0
     custody_subnet_count: int = dc.CUSTODY_REQUIREMENT
+    enr: str = ""
 
     def to_bytes(self) -> bytes:
         return json.dumps(asdict(self)).encode()
 
+    def validated(self) -> "PeerRecord":
+        """Return a copy whose claims come from the signed ENR (raises
+        ValueError on a bad signature); identity passthrough when no ENR
+        is attached (legacy JSON-only records)."""
+        if not self.enr:
+            return self
+        rec = PeerRecord.from_enr(self.enr)
+        return rec
+
     @classmethod
     def from_bytes(cls, raw: bytes) -> "PeerRecord":
-        return cls(**json.loads(raw))
+        return cls(**json.loads(raw)).validated()
+
+    @classmethod
+    def from_enr(cls, enr_text: str) -> "PeerRecord":
+        """A record whose EVERY field derives from the verified ENR:
+        the peer id IS the node id (an attacker replaying someone
+        else's signed ENR under a different name gains nothing — the
+        name is overwritten), and the custody claim comes from the
+        signed `csc` key or falls back to the spec minimum."""
+        from .enr import Enr, EnrError
+
+        try:
+            parsed = Enr.from_text(enr_text)  # verifies the signature
+        except EnrError as e:
+            raise ValueError(f"invalid ENR: {e}") from None
+        raw_attnets = parsed.pairs.get(b"attnets")
+        raw_csc = parsed.pairs.get(b"csc")
+        return cls(
+            peer_id=parsed.node_id().hex()[:16],
+            seq=parsed.seq,
+            attnets=(
+                int.from_bytes(raw_attnets, "little") if raw_attnets else 0
+            ),
+            custody_subnet_count=(
+                int.from_bytes(raw_csc, "big")
+                if raw_csc
+                else dc.CUSTODY_REQUIREMENT
+            ),
+            enr=enr_text,
+        )
 
     def custody_columns(self) -> list:
         return dc.get_custody_columns(
@@ -118,7 +165,9 @@ class BootNode:
             req = json.loads(body)
             kind, value = req.get("kind", "all"), int(req.get("value", 0))
             if "from" in req:
-                self.discovery.insert(PeerRecord(**req["from"]))
+                # the ingest gate: ENR-carrying records are verified and
+                # their claims re-derived from the signed document
+                self.discovery.insert(PeerRecord(**req["from"]).validated())
         except (ValueError, TypeError, KeyError):
             return ResponseCode.INVALID_REQUEST, []
         if kind == "subnet":

@@ -1,0 +1,187 @@
+"""Ethereum Node Records (EIP-778) — the discv5 identity document the
+reference's discovery layer serves and consumes
+(beacon_node/lighthouse_network/src/discovery + the enr crate).
+
+An ENR is an RLP list [signature, seq, k, v, k, v, ...] with keys in
+sorted order; the "v4" identity scheme signs keccak256(rlp([seq, k, v,
+...])) with secp256k1 and derives the node id as keccak256(uncompressed
+pubkey xy). Textual form: "enr:" + base64url(rlp) without padding.
+
+Eth2-specific payload: the `eth2` key carries the SSZ ENRForkID
+(fork_digest, next_fork_version, next_fork_epoch), and `attnets` /
+`syncnets` carry subnet bitfields — the fields the reference's
+discovery queries filter on.
+
+Pinned against the EIP-778 example record (known private key, known
+textual encoding) in tests/test_enr.py.
+"""
+
+from __future__ import annotations
+
+import base64
+from typing import Optional
+
+from ..crypto import secp256k1
+from ..crypto.keccak import keccak256
+from ..execution.block_hash import rlp_bytes, rlp_int, rlp_list
+
+ID_V4 = b"v4"
+
+
+class EnrError(Exception):
+    pass
+
+
+def _rlp_decode(data: bytes, pos: int = 0):
+    """Minimal RLP decoder -> (item, new_pos); item = bytes | list."""
+    if pos >= len(data):
+        raise EnrError("truncated rlp")
+    b0 = data[pos]
+    if b0 < 0x80:
+        return data[pos : pos + 1], pos + 1
+    if b0 < 0xB8:
+        ln = b0 - 0x80
+        return data[pos + 1 : pos + 1 + ln], pos + 1 + ln
+    if b0 < 0xC0:
+        lln = b0 - 0xB7
+        ln = int.from_bytes(data[pos + 1 : pos + 1 + lln], "big")
+        start = pos + 1 + lln
+        return data[start : start + ln], start + ln
+    if b0 < 0xF8:
+        ln = b0 - 0xC0
+        end = pos + 1 + ln
+        items = []
+        p = pos + 1
+        while p < end:
+            item, p = _rlp_decode(data, p)
+            items.append(item)
+        return items, end
+    lln = b0 - 0xF7
+    ln = int.from_bytes(data[pos + 1 : pos + 1 + lln], "big")
+    start = pos + 1 + lln
+    end = start + ln
+    items = []
+    p = start
+    while p < end:
+        item, p = _rlp_decode(data, p)
+        items.append(item)
+    return items, end
+
+
+class Enr:
+    def __init__(self, seq: int, pairs: dict, signature: bytes = b""):
+        self.seq = seq
+        self.pairs = dict(pairs)  # key (bytes) -> value (bytes)
+        self.signature = signature
+
+    # ------------------------------------------------------------ build
+
+    @classmethod
+    def build(
+        cls,
+        private_key: bytes,
+        *,
+        seq: int = 1,
+        ip: Optional[bytes] = None,
+        udp: Optional[int] = None,
+        tcp: Optional[int] = None,
+        eth2: Optional[bytes] = None,
+        attnets: Optional[bytes] = None,
+        syncnets: Optional[bytes] = None,
+        csc: Optional[int] = None,
+    ) -> "Enr":
+        pairs = {b"id": ID_V4, b"secp256k1": secp256k1.pubkey_compressed(private_key)}
+        if csc is not None:  # PeerDAS custody subnet count (signed claim)
+            pairs[b"csc"] = csc.to_bytes(1, "big")
+        if ip is not None:
+            pairs[b"ip"] = ip
+        if udp is not None:
+            pairs[b"udp"] = udp.to_bytes(2, "big")
+        if tcp is not None:
+            pairs[b"tcp"] = tcp.to_bytes(2, "big")
+        if eth2 is not None:
+            pairs[b"eth2"] = eth2
+        if attnets is not None:
+            pairs[b"attnets"] = attnets
+        if syncnets is not None:
+            pairs[b"syncnets"] = syncnets
+        enr = cls(seq, pairs)
+        enr.sign(private_key)
+        return enr
+
+    def _content_rlp_items(self) -> list:
+        items = [rlp_int(self.seq)]
+        for k in sorted(self.pairs):
+            items.append(rlp_bytes(k))
+            items.append(rlp_bytes(self.pairs[k]))
+        return items
+
+    def signing_hash(self) -> bytes:
+        return keccak256(rlp_list(self._content_rlp_items()))
+
+    def sign(self, private_key: bytes) -> None:
+        self.signature = secp256k1.sign(self.signing_hash(), private_key)
+
+    # ------------------------------------------------------------ codec
+
+    def encode(self) -> bytes:
+        return rlp_list(
+            [rlp_bytes(self.signature)] + self._content_rlp_items()
+        )
+
+    def to_text(self) -> str:
+        return "enr:" + base64.urlsafe_b64encode(self.encode()).decode().rstrip(
+            "="
+        )
+
+    @classmethod
+    def decode(cls, data: bytes) -> "Enr":
+        items, _ = _rlp_decode(data)
+        if not isinstance(items, list) or len(items) < 2 or len(items) % 2:
+            raise EnrError("malformed record")
+        sig = items[0]
+        seq = int.from_bytes(items[1], "big")
+        pairs = {}
+        prev = None
+        for i in range(2, len(items), 2):
+            k, v = items[i], items[i + 1]
+            if prev is not None and k <= prev:
+                raise EnrError("keys not strictly sorted")
+            prev = k
+            pairs[k] = v
+        enr = cls(seq, pairs, sig)
+        if not enr.verify():
+            raise EnrError("bad signature")
+        return enr
+
+    @classmethod
+    def from_text(cls, text: str) -> "Enr":
+        if not text.startswith("enr:"):
+            raise EnrError("missing enr: prefix")
+        b64 = text[4:]
+        b64 += "=" * (-len(b64) % 4)
+        return cls.decode(base64.urlsafe_b64decode(b64))
+
+    # ------------------------------------------------------------ checks
+
+    def verify(self) -> bool:
+        if self.pairs.get(b"id") != ID_V4:
+            return False
+        pub = self.pairs.get(b"secp256k1")
+        if pub is None:
+            return False
+        return secp256k1.verify(self.signing_hash(), self.signature, pub)
+
+    def node_id(self) -> bytes:
+        x, y = secp256k1.decompress(self.pairs[b"secp256k1"])
+        return keccak256(x.to_bytes(32, "big") + y.to_bytes(32, "big"))
+
+    @property
+    def ip(self) -> Optional[str]:
+        raw = self.pairs.get(b"ip")
+        return ".".join(str(b) for b in raw) if raw else None
+
+    @property
+    def udp(self) -> Optional[int]:
+        raw = self.pairs.get(b"udp")
+        return int.from_bytes(raw, "big") if raw else None

@@ -103,6 +103,31 @@ def pretty_ssz(type_name: str, raw: bytes) -> str:
 # ------------------------------------------------------ round-4 toolbox
 
 
+def generate_bootnode_enr(
+    private_key_hex: str,
+    ip: str,
+    udp_port: int,
+    tcp_port: int,
+    fork_digest: bytes = b"\x00" * 4,
+) -> dict:
+    """lcli generate-bootnode-enr: a signed EIP-778 record with the
+    eth2 ENRForkID field (next fork = far-future), plus the node id."""
+    from ..network.enr import Enr
+
+    sk = bytes.fromhex(private_key_hex.replace("0x", ""))
+    eth2 = fork_digest + b"\x00" * 4 + (2**64 - 1).to_bytes(8, "little")
+    enr = Enr.build(
+        sk,
+        seq=1,
+        ip=bytes(int(p) for p in ip.split(".")),
+        udp=udp_port,
+        tcp=tcp_port,
+        eth2=eth2,
+        attnets=b"\x00" * 8,
+    )
+    return {"enr": enr.to_text(), "node_id": "0x" + enr.node_id().hex()}
+
+
 def state_root(pre_ssz: bytes) -> str:
     """lcli state-root: hash_tree_root of a BeaconState SSZ."""
     return "0x" + T.BeaconState.deserialize(pre_ssz).hash_tree_root().hex()

@@ -1,0 +1,121 @@
+"""ENR (EIP-778) against the spec's OWN example record — an external
+vector: the EIP publishes a private key and the exact textual record it
+must produce (ip 127.0.0.1, udp 30303, seq 1)."""
+
+import pytest
+
+from lighthouse_tpu.crypto import secp256k1
+from lighthouse_tpu.network.enr import Enr, EnrError
+
+# EIP-778 "Test Vectors" section
+EIP778_TEXT = (
+    "enr:-IS4QHCYrYZbAKWCBRlAy5zzaDZXJBGkcnh4MHcBFZntXNFrdvJjX04jRzjzCBOonrkTfj4"
+    "99SZuOh8R33Ls8RRcy5wBgmlkgnY0gmlwhH8AAAGJc2VjcDI1NmsxoQPKY0yuDUmstAHYpMa2_o"
+    "xVtw0RW_QAdpzBQA8yWM0xOIN1ZHCCdl8"
+)
+EIP778_PRIVKEY = bytes.fromhex(
+    "b71c71a67e1177ad4e901695e1b4b9ee17ae16c6668d313eac2f96dbcda3f291"
+)
+EIP778_NODE_ID = bytes.fromhex(
+    "a448f24c6d18e575453db13171562b71999873db5b286df957af199ec94617f7"
+)
+
+
+def test_eip778_vector_decodes_and_verifies():
+    enr = Enr.from_text(EIP778_TEXT)  # decode() verifies the signature
+    assert enr.seq == 1
+    assert enr.ip == "127.0.0.1"
+    assert enr.udp == 30303
+    assert enr.pairs[b"id"] == b"v4"
+    assert enr.node_id() == EIP778_NODE_ID
+    # the embedded pubkey is the EIP's private key's pubkey
+    assert enr.pairs[b"secp256k1"] == secp256k1.pubkey_compressed(
+        EIP778_PRIVKEY
+    )
+
+
+def test_eip778_vector_reproduced_from_private_key():
+    """Build the record ourselves from the EIP's private key: RFC 6979
+    deterministic signing must reproduce the EXACT published text."""
+    enr = Enr.build(
+        EIP778_PRIVKEY, seq=1, ip=bytes([127, 0, 0, 1]), udp=30303
+    )
+    assert enr.to_text() == EIP778_TEXT
+
+
+def test_tampered_record_rejected():
+    enr = Enr.from_text(EIP778_TEXT)
+    raw = bytearray(enr.encode())
+    raw[-1] ^= 1  # flip a bit in the udp port
+    with pytest.raises(EnrError, match="signature"):
+        Enr.decode(bytes(raw))
+
+
+def test_eth2_fields_roundtrip():
+    sk = b"\x07" * 32
+    enr = Enr.build(
+        sk,
+        seq=3,
+        ip=bytes([10, 0, 0, 2]),
+        udp=9000,
+        tcp=9000,
+        eth2=b"\xaa\xbb\xcc\xdd" + b"\x00" * 12,
+        attnets=b"\xff" * 8,
+        syncnets=b"\x0f",
+    )
+    back = Enr.from_text(enr.to_text())
+    assert back.pairs[b"eth2"][:4] == b"\xaa\xbb\xcc\xdd"
+    assert back.pairs[b"attnets"] == b"\xff" * 8
+    assert back.seq == 3
+    assert back.verify()
+
+
+def test_peer_record_carries_verified_enr():
+    """Discovery PeerRecords can carry a signed ENR; the record's claims
+    then come from the VERIFIED document, and tampering is rejected."""
+    from lighthouse_tpu.network.discovery import PeerRecord
+    from lighthouse_tpu.network.enr import Enr
+
+    sk = b"\x09" * 32
+    enr = Enr.build(
+        sk, seq=5, ip=bytes([10, 0, 0, 3]), udp=9000,
+        attnets=(1 << 7).to_bytes(8, "little"),
+    )
+    rec = PeerRecord.from_enr(enr.to_text())
+    assert rec.seq == 5
+    assert rec.attnets == 1 << 7
+    # the peer id is BOUND to the signed document's node id
+    from lighthouse_tpu.network.enr import Enr as _Enr
+
+    assert rec.peer_id == _Enr.from_text(enr.to_text()).node_id().hex()[:16]
+    wire = rec.to_bytes()
+    back = PeerRecord.from_bytes(wire)
+    assert back.attnets == 1 << 7 and back.seq == 5
+
+    # JSON claims (attnets, custody, even peer_id) are DISCARDED in
+    # favor of the signed ENR; a corrupted ENR is rejected outright
+    import json as _json
+
+    d = _json.loads(wire)
+    d["attnets"] = 0xFFFF           # lie
+    d["peer_id"] = "attacker"       # replay under a different name
+    d["custody_subnet_count"] = 128  # unsigned custody inflation
+    back2 = PeerRecord.from_bytes(_json.dumps(d).encode())
+    assert back2.attnets == 1 << 7
+    assert back2.peer_id == rec.peer_id  # bound to the node id
+    assert back2.custody_subnet_count == back.custody_subnet_count
+    d["enr"] = d["enr"][:-2] + "qq"
+    with pytest.raises(ValueError):
+        PeerRecord.from_bytes(_json.dumps(d).encode())
+
+
+def test_lcli_generate_bootnode_enr():
+    from lighthouse_tpu.tools.lcli import generate_bootnode_enr
+    from lighthouse_tpu.network.enr import Enr
+
+    out = generate_bootnode_enr("11" * 32, "192.168.1.5", 9000, 9001)
+    enr = Enr.from_text(out["enr"])
+    assert enr.ip == "192.168.1.5"
+    assert enr.udp == 9000
+    assert b"eth2" in enr.pairs
+    assert out["node_id"] == "0x" + enr.node_id().hex()
