@@ -125,6 +125,25 @@ def _bucket(n: int) -> int:
     return 1 << max(7, (n - 1).bit_length())
 
 
+def _pack_draws_fast(messages):
+    """htc.pack_draws with the vectorized limb packer: SHA-256 message
+    expansion on host (irreducible), fastpack for the Fp2 limb arrays."""
+    import jax.numpy as jnp
+
+    from ....crypto.bls import hash_to_curve as H2C_host
+    from ....ops.lane import fastpack
+
+    t0s, t1s = [], []
+    for m in messages:
+        u0, u1 = H2C_host.hash_to_field_fp2(m, 2)
+        t0s.append(u0)
+        t1s.append(u1)
+    return (
+        jnp.asarray(fastpack.f2_pack_many(t0s)),
+        jnp.asarray(fastpack.f2_pack_many(t1s)),
+    )
+
+
 def prepare_batch(sets, rand_scalars):
     """Host packing: sets -> kernel inputs, or None if policy-rejected
     (empty input / empty keys / infinity points — blst.rs:42,80-89)."""
@@ -151,15 +170,24 @@ def prepare_batch(sets, rand_scalars):
         msgs.append(s.message)
 
     npad = _bucket(n)
-    apk_x = fp.pack([p[0] for p in apk_pts] + [params.G1X] * (npad - n))
-    apk_y = fp.pack([p[1] for p in apk_pts] + [params.G1Y] * (npad - n))
-    sig_x = tower.f2_pack_many(
+    # vectorized host packing (ops/lane/fastpack): at 10k+ sets/s device
+    # throughput the per-int python limb conversion was the sustained
+    # pipeline bottleneck (BASELINE.md round-4 notes)
+    from ....ops.lane import fastpack
+
+    apk_x = fastpack.pack_ints(
+        [p[0] for p in apk_pts] + [params.G1X] * (npad - n)
+    )
+    apk_y = fastpack.pack_ints(
+        [p[1] for p in apk_pts] + [params.G1Y] * (npad - n)
+    )
+    sig_x = fastpack.f2_pack_many(
         [p[0] for p in sig_pts] + [params.G2X] * (npad - n)
     )
-    sig_y = tower.f2_pack_many(
+    sig_y = fastpack.f2_pack_many(
         [p[1] for p in sig_pts] + [params.G2Y] * (npad - n)
     )
-    t0, t1 = htc.pack_draws(msgs + [b""] * (npad - n))
+    t0, t1 = _pack_draws_fast(msgs + [b""] * (npad - n))
     rbits = np.zeros((64, npad), dtype=np.int32)
     rbits[:, :n] = J.scalars_to_bits(rand_scalars, 64)
     pad = np.zeros(npad, dtype=bool)

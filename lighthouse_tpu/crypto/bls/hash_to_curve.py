@@ -43,9 +43,11 @@ def expand_message_xmd(msg: bytes, dst: bytes, len_in_bytes: int) -> bytes:
     l_i_b = len_in_bytes.to_bytes(2, "big")
     b0 = hashlib.sha256(z_pad + msg + l_i_b + b"\x00" + dst_prime).digest()
     bvals = [hashlib.sha256(b0 + b"\x01" + dst_prime).digest()]
+    b0_int = int.from_bytes(b0, "big")
     for i in range(2, ell + 1):
-        prev = bvals[-1]
-        mixed = bytes(a ^ b for a, b in zip(b0, prev))
+        # strxor via int xor: C-speed, ~10x the per-byte genexpr on the
+        # gossip packing hot path (hash draws per message)
+        mixed = (b0_int ^ int.from_bytes(bvals[-1], "big")).to_bytes(32, "big")
         bvals.append(hashlib.sha256(mixed + bytes([i]) + dst_prime).digest())
     return b"".join(bvals)[:len_in_bytes]
 

@@ -196,3 +196,20 @@ class TestLaneTower:
         one = jnp.asarray(np.asarray(T.f12_pack(FF.F12_ONE)))
         assert np.asarray(T.f12_eq_one(one)).all()
         assert not np.asarray(T.f12_eq_one(f12k(A12))).any()
+
+
+def test_fastpack_bit_identical():
+    """The vectorized host packer must produce byte-for-byte the same
+    kernel inputs as the reference per-int path — the compile cache
+    depends on the traced program being identical."""
+    import secrets
+
+    import numpy as np
+
+    from lighthouse_tpu.ops.lane import fastpack, fp as lfp, tower as ltw
+    from lighthouse_tpu.crypto.bls.params import P
+
+    vals = [secrets.randbelow(P) for _ in range(300)] + [0, 1, P - 1]
+    assert (lfp.pack(vals) == fastpack.pack_ints(vals)).all()
+    pairs = [(secrets.randbelow(P), secrets.randbelow(P)) for _ in range(64)]
+    assert (ltw.f2_pack_many(pairs) == fastpack.f2_pack_many(pairs)).all()
