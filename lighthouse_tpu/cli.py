@@ -56,6 +56,9 @@ def _build_parser() -> argparse.ArgumentParser:
                     help="resume the chain persisted in --datadir")
     bn.add_argument("--listen-port", type=int, default=0,
                     help="TCP gossip/rpc listen port (0 = no networking)")
+    bn.add_argument("--transport", choices=["tcp", "libp2p"], default="tcp",
+                    help="wire stack: private tcp framing, or the full "
+                         "libp2p layering (mss/noise/yamux substreams)")
     bn.add_argument("--peer", action="append", default=[],
                     help="host:port of a peer to dial (repeatable)")
     bn.add_argument("--genesis-time", type=int, default=0,
@@ -214,12 +217,15 @@ def cmd_bn(args) -> int:
         .bls_backend(args.bls_backend)
     )
     if args.listen_port:
-        from .network.socket_transport import SocketHub
+        if args.transport == "libp2p":
+            from .network.libp2p_transport import Libp2pHub
 
-        builder.network(
-            SocketHub(port=args.listen_port),
-            peer_id=f"bn@{args.listen_port}",
-        )
+            hub = Libp2pHub(port=args.listen_port)
+        else:
+            from .network.socket_transport import SocketHub
+
+            hub = SocketHub(port=args.listen_port)
+        builder.network(hub, peer_id=f"bn@{args.listen_port}")
     if args.resume:
         builder.resume_from_store()
     elif args.interop_validators > 0:

@@ -134,8 +134,12 @@ def _pack_draws_fast(messages):
     from ....ops.lane import fastpack
 
     t0s, t1s = [], []
+    cache = {}  # bucket padding repeats b"" npad-n times; expand once
     for m in messages:
-        u0, u1 = H2C_host.hash_to_field_fp2(m, 2)
+        hit = cache.get(m)
+        if hit is None:
+            hit = cache[m] = H2C_host.hash_to_field_fp2(m, 2)
+        u0, u1 = hit
         t0s.append(u0)
         t1s.append(u1)
     return (

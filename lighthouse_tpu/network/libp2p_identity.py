@@ -1,0 +1,253 @@
+"""libp2p identity: secp256k1 peer keys, PeerIds, and the signed noise
+handshake payload.
+
+The reference's network identity is a libp2p secp256k1 keypair
+(lighthouse_network service/utils.rs:30-50 loads/creates `Keypair` and
+derives the node's `PeerId`); the noise handshake proves it by sending
+a signed payload binding the identity key to the connection's
+ephemeral noise static key (libp2p-noise spec; snow handles the XX
+pattern, rust-libp2p the payload).
+
+Wire artifacts implemented here, byte-exact per the libp2p specs:
+
+- `PublicKey` protobuf: { enum KeyType Type = 1; bytes Data = 2 } with
+  KeyType Secp256k1 = 2 and Data = the 33-byte compressed SEC1 point;
+- PeerId = multihash(identity, protobuf(PublicKey)) — the encoded key
+  is 37 bytes <= 42, so the identity multihash (code 0x00) applies —
+  rendered in base58btc (the familiar `16Uiu2HA...` / `Qm...` form);
+- `NoiseHandshakePayload` protobuf:
+  { bytes identity_key = 1; bytes identity_sig = 2; bytes data = 3 }
+  where identity_sig = Sign(identity_key,
+  "noise-libp2p-static-key:" || noise_static_pubkey). secp256k1
+  signatures are DER-encoded ECDSA over SHA256(message) (libp2p peer-id
+  spec's secp256k1 signing rule).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+from typing import Optional
+
+from ..crypto import secp256k1
+
+KEYTYPE_SECP256K1 = 2
+_SIG_PREFIX = b"noise-libp2p-static-key:"
+_B58_ALPHABET = "123456789ABCDEFGHJKLMNPQRSTUVWXYZabcdefghijkmnopqrstuvwxyz"
+
+
+class IdentityError(Exception):
+    pass
+
+
+# ------------------------------------------------------------- protobuf
+
+from .rpc_codec import RpcCodecError, uvarint_encode
+
+
+def _uvarint(data: bytes, pos: int):
+    from .rpc_codec import uvarint_decode
+
+    try:
+        return uvarint_decode(data, pos)
+    except RpcCodecError as e:
+        raise IdentityError(str(e)) from None
+
+
+def _field_varint(num: int, value: int) -> bytes:
+    return uvarint_encode(num << 3 | 0) + uvarint_encode(value)
+
+
+def _field_bytes(num: int, value: bytes) -> bytes:
+    return uvarint_encode(num << 3 | 2) + uvarint_encode(len(value)) + value
+
+
+def _parse_fields(data: bytes) -> dict:
+    """Minimal protobuf parse: {field_num: last value} (varint/bytes)."""
+    out = {}
+    pos = 0
+    while pos < len(data):
+        key, pos = _uvarint(data, pos)
+        num, wire = key >> 3, key & 7
+        if wire == 0:
+            val, pos = _uvarint(data, pos)
+        elif wire == 2:
+            ln, pos = _uvarint(data, pos)
+            if len(data) - pos < ln:
+                raise IdentityError("truncated field")
+            val = data[pos : pos + ln]
+            pos += ln
+        else:
+            raise IdentityError(f"unsupported wire type {wire}")
+        out[num] = val
+    return out
+
+
+# -------------------------------------------------------------- base58
+
+def b58encode(data: bytes) -> str:
+    n = int.from_bytes(data, "big")
+    out = []
+    while n:
+        n, r = divmod(n, 58)
+        out.append(_B58_ALPHABET[r])
+    pad = 0
+    for b in data:
+        if b:
+            break
+        pad += 1
+    return "1" * pad + "".join(reversed(out))
+
+
+def b58decode(s: str) -> bytes:
+    n = 0
+    for c in s:
+        i = _B58_ALPHABET.find(c)
+        if i < 0:
+            raise IdentityError(f"bad base58 char {c!r}")
+        n = n * 58 + i
+    raw = n.to_bytes((n.bit_length() + 7) // 8, "big")
+    pad = 0
+    for c in s:
+        if c != "1":
+            break
+        pad += 1
+    return b"\x00" * pad + raw
+
+
+# ------------------------------------------------------- DER signatures
+
+def _der_int(n: int) -> bytes:
+    raw = n.to_bytes((n.bit_length() + 7) // 8 or 1, "big")
+    if raw[0] & 0x80:
+        raw = b"\x00" + raw
+    return b"\x02" + bytes([len(raw)]) + raw
+
+
+def sig_to_der(compact: bytes) -> bytes:
+    """64-byte r||s -> DER SEQUENCE(INTEGER r, INTEGER s)."""
+    r = int.from_bytes(compact[:32], "big")
+    s = int.from_bytes(compact[32:], "big")
+    body = _der_int(r) + _der_int(s)
+    return b"\x30" + bytes([len(body)]) + body
+
+
+def der_to_sig(der: bytes) -> bytes:
+    """DER ECDSA signature -> 64-byte r||s compact form. Every malformed
+    shape raises IdentityError (remote input must map to one exception
+    type, not IndexError/OverflowError)."""
+    if len(der) < 8 or der[0] != 0x30:
+        raise IdentityError("bad DER signature")
+    pos = 2
+    ints = []
+    for _ in range(2):
+        if pos + 2 > len(der) or der[pos] != 0x02:
+            raise IdentityError("bad DER integer")
+        ln = der[pos + 1]
+        if pos + 2 + ln > len(der):
+            raise IdentityError("truncated DER integer")
+        val = int.from_bytes(der[pos + 2 : pos + 2 + ln], "big")
+        if val >> 256:
+            raise IdentityError("DER integer exceeds 256 bits")
+        ints.append(val)
+        pos += 2 + ln
+    return ints[0].to_bytes(32, "big") + ints[1].to_bytes(32, "big")
+
+
+# ------------------------------------------------------------- identity
+
+def encode_public_key(compressed: bytes) -> bytes:
+    """The libp2p PublicKey protobuf for a secp256k1 key."""
+    return _field_varint(1, KEYTYPE_SECP256K1) + _field_bytes(2, compressed)
+
+
+def decode_public_key(data: bytes) -> bytes:
+    fields = _parse_fields(data)
+    if fields.get(1) != KEYTYPE_SECP256K1:
+        raise IdentityError(f"unsupported key type {fields.get(1)}")
+    key = fields.get(2)
+    if not isinstance(key, (bytes, bytearray)) or len(key) != 33:
+        raise IdentityError("bad secp256k1 key data")
+    return bytes(key)
+
+
+def peer_id_from_pubkey(compressed: bytes) -> str:
+    """base58 PeerId: identity multihash of the PublicKey protobuf."""
+    encoded = encode_public_key(compressed)
+    if len(encoded) <= 42:
+        mh = b"\x00" + bytes([len(encoded)]) + encoded  # identity
+    else:  # pragma: no cover - secp256k1 keys always fit
+        mh = b"\x12\x20" + hashlib.sha256(encoded).digest()
+    return b58encode(mh)
+
+
+def pubkey_from_peer_id(peer_id: str) -> Optional[bytes]:
+    """Compressed key embedded in an identity-multihash PeerId, if any."""
+    mh = b58decode(peer_id)
+    if len(mh) >= 2 and mh[0] == 0x00 and mh[1] == len(mh) - 2:
+        return decode_public_key(mh[2:])
+    return None
+
+
+@dataclass
+class Keypair:
+    """A libp2p secp256k1 identity."""
+
+    private: bytes
+
+    @classmethod
+    def generate(cls, seed: bytes = None) -> "Keypair":
+        import os as _os
+
+        if seed is not None:
+            private = hashlib.sha256(b"libp2p-id:" + seed).digest()
+        else:
+            private = _os.urandom(32)
+        return cls(private=private)
+
+    @property
+    def public_compressed(self) -> bytes:
+        return secp256k1.pubkey_compressed(self.private)
+
+    @property
+    def peer_id(self) -> str:
+        return peer_id_from_pubkey(self.public_compressed)
+
+    def sign(self, message: bytes) -> bytes:
+        """libp2p secp256k1 signing: DER ECDSA over SHA256(message)."""
+        digest = hashlib.sha256(message).digest()
+        return sig_to_der(secp256k1.sign(digest, self.private))
+
+
+def verify_identity_sig(
+    compressed: bytes, message: bytes, der_sig: bytes
+) -> bool:
+    try:
+        compact = der_to_sig(der_sig)
+        point = secp256k1.decompress(compressed)
+    except (IdentityError, ValueError):
+        return False
+    return secp256k1.verify(hashlib.sha256(message).digest(), compact, point)
+
+
+# ------------------------------------------------- noise payload binding
+
+def make_noise_payload(keypair: Keypair, noise_static_pub: bytes) -> bytes:
+    """NoiseHandshakePayload proving `keypair` owns this connection."""
+    sig = keypair.sign(_SIG_PREFIX + noise_static_pub)
+    return _field_bytes(1, encode_public_key(keypair.public_compressed)) + _field_bytes(2, sig)
+
+
+def verify_noise_payload(payload: bytes, noise_static_pub: bytes) -> str:
+    """Validate the identity binding; returns the sender's PeerId."""
+    fields = _parse_fields(payload)
+    key_pb = fields.get(1)
+    sig = fields.get(2)
+    if not key_pb or not sig:
+        raise IdentityError("noise payload missing identity fields")
+    compressed = decode_public_key(bytes(key_pb))
+    if not verify_identity_sig(
+        compressed, _SIG_PREFIX + noise_static_pub, bytes(sig)
+    ):
+        raise IdentityError("noise payload identity signature invalid")
+    return peer_id_from_pubkey(compressed)

@@ -1,0 +1,706 @@
+"""The real libp2p connection stack over TCP.
+
+Layering, byte-for-byte the one the reference's lighthouse_network
+builds (service/utils.rs:38-63: tcp -> multistream-select -> noise ->
+yamux; rpc/protocol.rs + gossipsub ride yamux substreams):
+
+    TCP
+     └─ multistream-select 1.0          "/noise"
+         └─ Noise XX (u16be-framed, identity payload proving the
+            secp256k1 libp2p key -> the peer's REAL base58 PeerId)
+             └─ multistream-select       "/yamux/1.0.0"
+                 └─ yamux session
+                     ├─ substream "/meshsub/1.1.0"  (persistent, one
+                     │   per direction; varint-delimited gossipsub
+                     │   protobuf envelopes — network/gossipsub_wire)
+                     └─ substream per req/resp request, negotiated as
+                         /eth2/beacon_chain/req/<name>/<v>/ssz_snappy
+                         (network/rpc_codec chunks; requester
+                         half-closes after the request, responder
+                         streams chunks then closes — rpc/handler.rs
+                         stream lifecycle)
+
+Presented to the node as a `transport.Endpoint`: gossip frames map to
+the meshsub substream, RPC frames (rpc.py's `<req_id><proto><is_resp>`
+mux header + spec chunk bytes) map to real per-request substreams —
+the mux header never hits this wire; yamux stream ids play that role,
+exactly as in the reference.
+
+Outbound substreams negotiate optimistically (rust-libp2p `V1Lazy`):
+the multistream header, protocol proposal and payload are pipelined in
+one flight; the echo is validated when it arrives.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from collections import deque
+from typing import Callable, Dict, Optional, Tuple
+
+from . import multistream as mss
+from . import yamux as ymx
+from .libp2p_identity import (
+    IdentityError,
+    Keypair,
+    make_noise_payload,
+    verify_noise_payload,
+)
+from .noise import NoiseError, NoiseXX
+from .transport import CHANNEL_GOSSIP, CHANNEL_RPC, Frame
+
+PROTO_NOISE = "/noise"
+PROTO_YAMUX = "/yamux/1.0.0"
+PROTO_MESHSUB = ["/meshsub/1.2.0", "/meshsub/1.1.0", "/meshsub/1.0.0"]
+
+_NOISE_MAX_PT = 65535 - 16  # u16be wire frames, minus the AEAD tag
+_MAX_INBOX_PER_PEER = 4096
+_MAX_STREAM_BUF = 1 << 24  # 16 MiB per-substream accumulation cap
+_MAX_OUT_FRAMES = 1024     # ~64 MiB outbound queue before shedding a peer
+
+
+class Libp2pError(Exception):
+    pass
+
+
+def _rpc_protocol_ids():
+    """proto byte <-> spec protocol-id string maps (from rpc.py)."""
+    from .rpc import Protocol, protocol_id
+
+    by_proto = {}
+    by_id = {}
+    for proto in Protocol:
+        pid = protocol_id(proto)
+        by_proto[int(proto)] = pid
+        by_id[pid] = int(proto)
+    return by_proto, by_id
+
+
+def _uvarint_frame(data: bytes) -> bytes:
+    from .rpc_codec import uvarint_encode
+
+    return uvarint_encode(len(data)) + data
+
+
+class _Substream:
+    """Per-substream state machine driven from the reader thread."""
+
+    __slots__ = (
+        "sid", "kind", "proto", "req_id", "reader", "negotiated",
+        "buf", "gossip_pending", "expect_echo",
+    )
+
+    def __init__(self, sid: int, kind: str):
+        self.sid = sid
+        self.kind = kind          # meshsub-out | rpc-out | inbound
+        self.proto: Optional[str] = None
+        self.req_id: Optional[int] = None
+        self.reader = mss.StreamReader()
+        self.negotiated = False
+        self.buf = bytearray()    # rpc payload accumulation
+        self.gossip_pending = bytearray()
+        self.expect_echo: Optional[str] = None  # V1Lazy echo to validate
+
+
+class _Conn:
+    __slots__ = (
+        "sock", "peer", "send_cipher", "recv_cipher", "session",
+        "lock", "streams", "out_req", "in_req", "meshsub_out",
+        "out_q", "out_ev", "dead",
+    )
+
+    def __init__(self, sock, peer, send_cipher, recv_cipher, session):
+        self.sock = sock
+        self.peer = peer
+        self.send_cipher = send_cipher
+        self.recv_cipher = recv_cipher
+        self.session: ymx.YamuxSession = session
+        self.lock = threading.RLock()  # yamux ops + noise nonce order
+        self.streams: Dict[int, _Substream] = {}
+        self.out_req: Dict[int, int] = {}   # sid -> our req_id
+        self.in_req: Dict[int, int] = {}    # local req_id -> sid
+        self.meshsub_out: Optional[int] = None
+        # encrypted wire frames awaiting the writer thread: sendall
+        # must never run under conn.lock (mutual bulk transfer would
+        # deadlock both peers: each reader needs the lock its sender
+        # holds while blocked on a full kernel buffer)
+        self.out_q: deque = deque()
+        self.out_ev = threading.Event()
+        self.dead = False
+
+
+class Libp2pEndpoint:
+    """transport.Endpoint over the full libp2p stack."""
+
+    def __init__(
+        self,
+        identity: Keypair = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+    ):
+        self.identity = identity or Keypair.generate()
+        self.peer_id = self.identity.peer_id
+        self._rpc_by_proto, self._rpc_by_id = _rpc_protocol_ids()
+        self._inbox: deque[Frame] = deque()
+        self._inbox_counts: Dict[str, int] = {}
+        self._lock = threading.Lock()
+        self._conns: Dict[str, _Conn] = {}
+        self._next_req = 1 << 20  # local ids for inbound requests
+        self._closed = False
+        self.on_peer_connected: Optional[Callable] = None
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, port))
+        self._listener.listen(16)
+        self.addr = self._listener.getsockname()
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+
+    # ------------------------------------------------------- handshake
+
+    def connect(self, host: str, port: int, timeout: float = 10.0) -> str:
+        s = socket.create_connection((host, port), timeout=timeout)
+        try:
+            s.settimeout(timeout)
+            read = lambda: s.recv(4096)
+            write = lambda b: s.sendall(b)
+            mss.negotiate_dialer(read, write, [PROTO_NOISE])
+            hs = NoiseXX(initiator=True)
+            _noise_send(s, hs.write_msg1())
+            hs.read_msg2(_noise_recv(s))
+            peer = verify_noise_payload(hs.remote_payload, hs.rs)
+            payload = make_noise_payload(self.identity, hs.s_pub)
+            _noise_send(s, hs.write_msg3(payload))
+            send_c, recv_c = hs.split()
+            # yamux negotiation rides encrypted transport messages
+            reader = mss.StreamReader()
+            enc_read = lambda: recv_c.decrypt_with_ad(b"", _noise_recv(s))
+            enc_write = lambda b: _noise_send(
+                s, send_c.encrypt_with_ad(b"", b)
+            )
+            mss.negotiate_dialer(enc_read, enc_write, [PROTO_YAMUX], reader)
+            s.settimeout(None)
+            conn = _Conn(
+                s, peer, send_c, recv_c, ymx.YamuxSession(is_client=True)
+            )
+            self._finish_connect(conn, reader)
+            return peer
+        except BaseException:
+            try:
+                s.close()
+            except OSError:
+                pass
+            raise
+
+    def _accept_loop(self) -> None:
+        while not self._closed:
+            try:
+                s, _ = self._listener.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._accept_one, args=(s,), daemon=True
+            ).start()
+
+    def _accept_one(self, s: socket.socket) -> None:
+        try:
+            s.settimeout(10.0)
+            read = lambda: s.recv(4096)
+            write = lambda b: s.sendall(b)
+            mss.negotiate_listener(read, write, [PROTO_NOISE])
+            hs = NoiseXX(initiator=False)
+            hs.read_msg1(_noise_recv(s))
+            payload = make_noise_payload(self.identity, hs.s_pub)
+            _noise_send(s, hs.write_msg2(payload))
+            hs.read_msg3(_noise_recv(s))
+            peer = verify_noise_payload(hs.remote_payload, hs.rs)
+            send_c, recv_c = hs.split()
+            reader = mss.StreamReader()
+            enc_read = lambda: recv_c.decrypt_with_ad(b"", _noise_recv(s))
+            enc_write = lambda b: _noise_send(
+                s, send_c.encrypt_with_ad(b"", b)
+            )
+            mss.negotiate_listener(enc_read, enc_write, [PROTO_YAMUX], reader)
+            s.settimeout(None)
+            conn = _Conn(
+                s, peer, send_c, recv_c, ymx.YamuxSession(is_client=False)
+            )
+            self._finish_connect(conn, reader)
+        except Exception:
+            # hostile/failed handshakes must not kill the acceptor or
+            # leak the fd
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    def _finish_connect(self, conn: _Conn, reader: mss.StreamReader) -> None:
+        with self._lock:
+            old = self._conns.pop(conn.peer, None)
+            self._conns[conn.peer] = conn
+        if old is not None:
+            try:
+                old.sock.close()
+            except OSError:
+                pass
+        with conn.lock:
+            # leftover buffered bytes from negotiation belong to yamux
+            leftovers = bytes(reader._buf)
+            if leftovers:
+                self._dispatch(conn, conn.session.receive(leftovers))
+            self._open_meshsub(conn)
+            self._flush(conn)
+        threading.Thread(
+            target=self._read_loop, args=(conn,), daemon=True
+        ).start()
+        threading.Thread(
+            target=self._write_loop, args=(conn,), daemon=True
+        ).start()
+        cb = self.on_peer_connected
+        if cb is not None:
+            cb(conn.peer)
+
+    def _open_meshsub(self, conn: _Conn, proto: str = None) -> None:
+        proto = proto or PROTO_MESHSUB[0]
+        sid = conn.session.open_stream()
+        st = _Substream(sid, "meshsub-out")
+        st.expect_echo = proto
+        st.negotiated = True  # V1Lazy: pipeline without waiting
+        conn.streams[sid] = st
+        conn.meshsub_out = sid
+        conn.session.send(
+            sid,
+            mss.encode_msg(mss.MULTISTREAM_PROTO) + mss.encode_msg(proto),
+        )
+
+    def _fail_rpc_out(self, conn: _Conn, st: _Substream) -> None:
+        """A dead rpc-out substream must surface as a SERVER_ERROR
+        response or its pending request leaks forever (RpcHandler has
+        no response timeout)."""
+        req_id = conn.out_req.pop(st.sid, None)
+        if req_id is not None:
+            from . import rpc_codec
+
+            proto_byte = self._rpc_by_id.get(st.proto, 0)
+            self._push(
+                conn.peer,
+                CHANNEL_RPC,
+                struct.pack("<IBB", req_id, proto_byte, 1)
+                + rpc_codec.encode_response_chunk(2, b""),
+            )
+
+    # ------------------------------------------------------ reader side
+
+    def _read_loop(self, conn: _Conn) -> None:
+        try:
+            while not self._closed:
+                ct = _noise_recv(conn.sock)
+                pt = conn.recv_cipher.decrypt_with_ad(b"", ct)
+                with conn.lock:
+                    events = conn.session.receive(pt)
+                    self._dispatch(conn, events)
+                    self._flush(conn)
+        except (
+            OSError,
+            ConnectionError,
+            NoiseError,
+            ymx.YamuxError,
+            mss.MultistreamError,
+            IdentityError,
+        ):
+            pass
+        finally:
+            with self._lock:
+                if self._conns.get(conn.peer) is conn:
+                    del self._conns[conn.peer]
+            conn.dead = True
+            conn.out_ev.set()  # release the writer thread
+            try:
+                conn.sock.close()
+            except OSError:
+                pass
+
+    def _dispatch(self, conn: _Conn, events) -> None:
+        for kind, sid, payload in events:
+            if kind == ymx.EV_STREAM_OPENED:
+                conn.streams[sid] = _Substream(sid, "inbound")
+            elif kind == ymx.EV_DATA:
+                st = conn.streams.get(sid)
+                if st is not None:
+                    self._on_stream_data(conn, st, payload)
+            elif kind == ymx.EV_STREAM_CLOSED:
+                st = conn.streams.get(sid)
+                if st is not None:
+                    self._on_stream_closed(conn, st)
+            elif kind == ymx.EV_STREAM_RESET:
+                st = conn.streams.pop(sid, None)
+                if st is not None:
+                    self._fail_rpc_out(conn, st)
+                    if st.sid == conn.meshsub_out:
+                        # transient remote reset must not permanently
+                        # silence gossip to a live peer — reopen
+                        # (bounded: once per peer RST packet)
+                        conn.meshsub_out = None
+                        self._open_meshsub(conn)
+
+    def _on_stream_data(self, conn: _Conn, st: _Substream, data: bytes) -> None:
+        if st.kind == "inbound" and not st.negotiated:
+            data = self._negotiate_inbound(conn, st, data)
+            if data is None:
+                return
+        elif st.expect_echo is not None:
+            data = self._check_echo(conn, st, data)
+            if data is None:
+                return
+        if st.proto in PROTO_MESHSUB and st.kind == "inbound":
+            self._on_gossip_bytes(conn, st, data)
+        elif st.kind == "inbound" or st.kind == "rpc-out":
+            if len(st.buf) + len(data) > _MAX_STREAM_BUF:
+                conn.session.reset_stream(st.sid)
+                conn.streams.pop(st.sid, None)
+                self._fail_rpc_out(conn, st)
+                return
+            st.buf += data
+        # meshsub-out receives nothing after its echo
+
+    def _negotiate_inbound(
+        self, conn: _Conn, st: _Substream, data: bytes
+    ) -> Optional[bytes]:
+        """Listener half of mss on a fresh inbound substream. Returns
+        surplus app bytes once negotiated, None while still talking."""
+        st.reader.feed(data)
+        while True:
+            try:
+                msg = st.reader.next_msg()
+            except mss.MultistreamError:
+                conn.session.reset_stream(st.sid)
+                conn.streams.pop(st.sid, None)
+                return None
+            if msg is None:
+                return None
+            if msg == mss.MULTISTREAM_PROTO:
+                conn.session.send(
+                    st.sid, mss.encode_msg(mss.MULTISTREAM_PROTO)
+                )
+                continue
+            if msg == mss.LS:
+                supported = PROTO_MESHSUB + sorted(self._rpc_by_id)
+                conn.session.send(
+                    st.sid,
+                    b"".join(mss.encode_msg(p) for p in supported),
+                )
+                continue
+            if msg in PROTO_MESHSUB or msg in self._rpc_by_id:
+                conn.session.send(st.sid, mss.encode_msg(msg))
+                st.proto = msg
+                st.negotiated = True
+                if msg in self._rpc_by_id:
+                    st.req_id = self._alloc_req(conn, st.sid)
+                surplus = bytes(st.reader._buf)
+                st.reader._buf.clear()
+                return surplus
+            conn.session.send(st.sid, mss.encode_msg(mss.NA))
+
+    def _check_echo(
+        self, conn: _Conn, st: _Substream, data: bytes
+    ) -> Optional[bytes]:
+        """V1Lazy dialer: validate the pipelined negotiation echo."""
+        st.reader.feed(data)
+        while st.expect_echo is not None:
+            try:
+                msg = st.reader.next_msg()
+            except mss.MultistreamError:
+                msg = mss.NA  # force the reset path
+            if msg is None:
+                return None
+            if msg == mss.MULTISTREAM_PROTO:
+                continue
+            if msg == st.expect_echo:
+                st.expect_echo = None
+                break
+            # refused: kill the stream; a pending request surfaces as
+            # an empty (error) response upstream, a refused meshsub
+            # proposal falls back to the next protocol version
+            conn.session.reset_stream(st.sid)
+            conn.streams.pop(st.sid, None)
+            self._fail_rpc_out(conn, st)
+            if st.sid == conn.meshsub_out:
+                conn.meshsub_out = None
+                tried = st.expect_echo
+                if tried in PROTO_MESHSUB:
+                    idx = PROTO_MESHSUB.index(tried) + 1
+                    if idx < len(PROTO_MESHSUB):
+                        self._open_meshsub(conn, PROTO_MESHSUB[idx])
+            return None
+        surplus = bytes(st.reader._buf)
+        st.reader._buf.clear()
+        return surplus
+
+    def _on_gossip_bytes(self, conn: _Conn, st: _Substream, data: bytes) -> None:
+        """Varint-delimited gossipsub envelopes -> gossip frames."""
+        from .rpc_codec import RpcCodecError, uvarint_decode
+
+        st.gossip_pending += data
+        while True:
+            buf = st.gossip_pending
+            try:
+                n, pos = uvarint_decode(buf, 0)
+            except RpcCodecError as e:
+                if "truncated" in str(e):
+                    return  # wait for more bytes
+                conn.session.reset_stream(st.sid)  # varint overflow
+                conn.streams.pop(st.sid, None)
+                return
+            if n > _MAX_STREAM_BUF:
+                conn.session.reset_stream(st.sid)
+                conn.streams.pop(st.sid, None)
+                return
+            if len(buf) - pos < n:
+                return
+            msg = bytes(buf[pos : pos + n])
+            del buf[: pos + n]
+            self._push(conn.peer, CHANNEL_GOSSIP, msg)
+
+    def _on_stream_closed(self, conn: _Conn, st: _Substream) -> None:
+        if st.kind == "rpc-out":
+            req_id = conn.out_req.pop(st.sid, None)
+            if req_id is not None:
+                proto_byte = self._rpc_by_id.get(st.proto, 0)
+                self._push(
+                    conn.peer,
+                    CHANNEL_RPC,
+                    struct.pack("<IBB", req_id, proto_byte, 1)
+                    + bytes(st.buf),
+                )
+            conn.session.close_stream(st.sid)
+            conn.streams.pop(st.sid, None)
+        elif st.kind == "inbound" and st.proto in self._rpc_by_id:
+            # request fully received; response flows back via send()
+            self._push(
+                conn.peer,
+                CHANNEL_RPC,
+                struct.pack(
+                    "<IBB", st.req_id, self._rpc_by_id[st.proto], 0
+                )
+                + bytes(st.buf),
+            )
+            st.buf = bytearray()
+
+    def _alloc_req(self, conn: _Conn, sid: int) -> int:
+        with self._lock:
+            req_id = self._next_req
+            self._next_req += 1
+        conn.in_req[req_id] = sid
+        return req_id
+
+    # ------------------------------------------------------- Endpoint API
+
+    def send(self, to_peer: str, channel: int, payload: bytes) -> bool:
+        with self._lock:
+            conn = self._conns.get(to_peer)
+        if conn is None or conn.dead:
+            return False
+        try:
+            with conn.lock:
+                if channel == CHANNEL_GOSSIP:
+                    if conn.meshsub_out is None:
+                        return False
+                    conn.session.send(
+                        conn.meshsub_out, _uvarint_frame(payload)
+                    )
+                elif channel == CHANNEL_RPC:
+                    self._send_rpc(conn, payload)
+                else:
+                    return False
+                self._flush(conn)
+            return True
+        except (OSError, ymx.YamuxError, Libp2pError):
+            return False
+
+    def _send_rpc(self, conn: _Conn, payload: bytes) -> None:
+        if len(payload) < 6:
+            raise Libp2pError("rpc frame shorter than its mux header")
+        req_id, proto_byte, is_resp = struct.unpack("<IBB", payload[:6])
+        body = payload[6:]
+        if is_resp:
+            sid = conn.in_req.pop(req_id, None)
+            if sid is None:
+                raise Libp2pError(f"no inbound stream for req {req_id}")
+            conn.session.send(sid, body)
+            conn.session.close_stream(sid)
+            conn.streams.pop(sid, None)
+            return
+        proto_id = self._rpc_by_proto.get(proto_byte)
+        if proto_id is None:
+            raise Libp2pError(f"unknown rpc protocol byte {proto_byte}")
+        sid = conn.session.open_stream()
+        st = _Substream(sid, "rpc-out")
+        st.proto = proto_id
+        st.expect_echo = proto_id
+        st.negotiated = True
+        conn.streams[sid] = st
+        conn.out_req[sid] = req_id
+        conn.session.send(
+            sid,
+            mss.encode_msg(mss.MULTISTREAM_PROTO)
+            + mss.encode_msg(proto_id)
+            + body,
+        )
+        conn.session.close_stream(sid)  # requester half-close
+
+    def _flush(self, conn: _Conn) -> None:
+        """Encrypt pending yamux bytes and hand them to the writer
+        thread (callers hold conn.lock — encryption order IS the noise
+        nonce order; the blocking socket write happens lock-free)."""
+        out = conn.session.data_to_send()
+        view = memoryview(out)
+        while view:
+            chunk = bytes(view[:_NOISE_MAX_PT])
+            view = view[_NOISE_MAX_PT:]
+            ct = conn.send_cipher.encrypt_with_ad(b"", chunk)
+            conn.out_q.append(struct.pack(">H", len(ct)) + ct)
+        if len(conn.out_q) > _MAX_OUT_FRAMES:
+            # peer is not consuming: shed it rather than buffer forever
+            conn.dead = True
+            try:
+                conn.sock.close()
+            except OSError:
+                pass
+        conn.out_ev.set()
+
+    def _write_loop(self, conn: _Conn) -> None:
+        try:
+            while not conn.dead:
+                conn.out_ev.wait(timeout=1.0)
+                with conn.lock:
+                    chunks = list(conn.out_q)
+                    conn.out_q.clear()
+                    conn.out_ev.clear()
+                if not chunks:
+                    if self._closed:
+                        return
+                    continue
+                for c in chunks:
+                    conn.sock.sendall(c)
+        except OSError:
+            pass
+        finally:
+            conn.dead = True
+            try:
+                conn.sock.close()
+            except OSError:
+                pass
+
+    def poll(self) -> Optional[Frame]:
+        with self._lock:
+            if not self._inbox:
+                return None
+            f = self._inbox.popleft()
+            self._dec_count(f.sender)
+            return f
+
+    def drain(self) -> list:
+        with self._lock:
+            out = list(self._inbox)
+            self._inbox.clear()
+            self._inbox_counts.clear()
+            return out
+
+    def push(self, frame: Frame) -> None:
+        with self._lock:
+            self._inbox.append(frame)
+            self._inbox_counts[frame.sender] = (
+                self._inbox_counts.get(frame.sender, 0) + 1
+            )
+
+    def _push(self, peer: str, channel: int, payload: bytes) -> None:
+        with self._lock:
+            if self._inbox_counts.get(peer, 0) >= _MAX_INBOX_PER_PEER:
+                raise ConnectionError(f"inbox overflow from {peer}")
+            self._inbox.append(Frame(sender=peer, channel=channel, payload=payload))
+            self._inbox_counts[peer] = self._inbox_counts.get(peer, 0) + 1
+
+    def _dec_count(self, peer: str) -> None:
+        c = self._inbox_counts.get(peer, 0) - 1
+        if c <= 0:
+            self._inbox_counts.pop(peer, None)
+        else:
+            self._inbox_counts[peer] = c
+
+    def connected_peers(self) -> list:
+        with self._lock:
+            return list(self._conns)
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._lock:
+            conns = list(self._conns.values())
+            self._conns.clear()
+        for conn in conns:
+            try:
+                with conn.lock:
+                    conn.session.go_away()
+                    self._flush(conn)
+            except (OSError, ymx.YamuxError):
+                pass
+            conn.dead = True
+            conn.out_ev.set()  # wake the writer so it exits
+            try:
+                conn.sock.close()
+            except OSError:
+                pass
+
+
+class Libp2pHub:
+    """hub.join() shim so ClientBuilder/NetworkService stack the full
+    libp2p transport unchanged (SocketHub counterpart). The identity
+    is RANDOM by default — deriving it from the requested peer-id
+    string (a public value like "bn@9000") would make node private
+    keys predictable and collide PeerIds across hosts; pass
+    identity_seed only in tests that need determinism."""
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        identity_seed: bytes = None,
+    ):
+        self.host = host
+        self.port = port
+        self.identity_seed = identity_seed
+        self.endpoint: Optional[Libp2pEndpoint] = None
+
+    def join(self, peer_id: str) -> Libp2pEndpoint:
+        self.endpoint = Libp2pEndpoint(
+            Keypair.generate(seed=self.identity_seed), self.host, self.port
+        )
+        return self.endpoint
+
+
+# ----------------------------------------------------- noise wire frames
+
+
+def _noise_send(s: socket.socket, msg: bytes) -> None:
+    """libp2p-noise framing: u16be length prefix, max 65535."""
+    if len(msg) > 65535:
+        raise NoiseError(f"noise message too large: {len(msg)}")
+    s.sendall(struct.pack(">H", len(msg)) + msg)
+
+
+def _recv_exact(s: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = s.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return bytes(buf)
+
+
+def _noise_recv(s: socket.socket) -> bytes:
+    (ln,) = struct.unpack(">H", _recv_exact(s, 2))
+    return _recv_exact(s, ln)

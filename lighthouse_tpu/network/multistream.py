@@ -1,0 +1,144 @@
+"""multistream-select 1.0 — libp2p protocol negotiation.
+
+Every libp2p connection and every yamux substream the reference opens
+starts with this negotiation (lighthouse_network rides rust-libp2p's
+`multistream-select`; service/utils.rs stacks tcp -> noise -> yamux and
+each RPC/gossipsub substream negotiates its protocol id with it, e.g.
+`/eth2/beacon_chain/req/status/1/ssz_snappy` or `/meshsub/1.1.0`).
+
+Wire format (multistream-select spec): each message is
+
+    <uvarint length> <utf8 protocol string> '\n'
+
+where length counts the string plus the trailing newline. The
+handshake: both sides send `/multistream/1.0.0`; the dialer then
+proposes protocol ids one at a time and the listener echoes the id to
+accept or replies `na` to refuse. `ls` (list) is answered with the
+supported ids, one message each.
+
+Sans-IO: `encode_msg`/`StreamReader.next_msg` work on bytes; the
+blocking `negotiate_dialer`/`negotiate_listener` helpers drive any
+(read_cb, write_cb) byte-stream pair — TCP sockets, noise transport
+messages, or yamux substreams.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable, Optional
+
+from .rpc_codec import uvarint_encode
+
+MULTISTREAM_PROTO = "/multistream/1.0.0"
+NA = "na"
+LS = "ls"
+_MAX_MSG = 1024  # protocol ids are short; refuse absurd lengths
+
+
+class MultistreamError(Exception):
+    pass
+
+
+def encode_msg(proto: str) -> bytes:
+    """One multistream message: uvarint(len+1) || proto || '\\n'."""
+    raw = proto.encode() + b"\n"
+    return uvarint_encode(len(raw)) + raw
+
+
+class StreamReader:
+    """Incremental reader: feed() bytes in, next_msg() strings out."""
+
+    def __init__(self):
+        self._buf = bytearray()
+
+    def feed(self, data: bytes) -> None:
+        self._buf += data
+
+    def next_msg(self) -> Optional[str]:
+        """Decode one message if fully buffered, else None."""
+        n = 0
+        shift = 0
+        pos = 0
+        while True:
+            if pos >= len(self._buf):
+                return None
+            b = self._buf[pos]
+            pos += 1
+            n |= (b & 0x7F) << shift
+            if not b & 0x80:
+                break
+            shift += 7
+            if shift > 21:
+                raise MultistreamError("varint too long")
+        if n > _MAX_MSG:
+            raise MultistreamError(f"message length {n} > {_MAX_MSG}")
+        if len(self._buf) - pos < n:
+            return None
+        raw = bytes(self._buf[pos : pos + n])
+        del self._buf[: pos + n]
+        if not raw.endswith(b"\n"):
+            raise MultistreamError("message missing newline")
+        return raw[:-1].decode()
+
+
+def _read_msg(read_cb: Callable[[], bytes], reader: StreamReader) -> str:
+    while True:
+        msg = reader.next_msg()
+        if msg is not None:
+            return msg
+        data = read_cb()
+        if not data:
+            raise MultistreamError("stream closed during negotiation")
+        reader.feed(data)
+
+
+def negotiate_dialer(
+    read_cb: Callable[[], bytes],
+    write_cb: Callable[[bytes], None],
+    protocols: Iterable[str],
+    reader: Optional[StreamReader] = None,
+) -> str:
+    """Dial-side negotiation: propose `protocols` in order, return the
+    first the listener accepts. The header and first proposal are sent
+    together (optimistic pipelining, as rust-libp2p does)."""
+    reader = reader or StreamReader()
+    protos = list(protocols)
+    if not protos:
+        raise MultistreamError("no protocols to propose")
+    write_cb(encode_msg(MULTISTREAM_PROTO) + encode_msg(protos[0]))
+    hdr = _read_msg(read_cb, reader)
+    if hdr != MULTISTREAM_PROTO:
+        raise MultistreamError(f"bad multistream header {hdr!r}")
+    for i, proto in enumerate(protos):
+        if i > 0:
+            write_cb(encode_msg(proto))
+        reply = _read_msg(read_cb, reader)
+        if reply == proto:
+            return proto
+        if reply != NA:
+            raise MultistreamError(f"unexpected reply {reply!r}")
+    raise MultistreamError(f"all protocols refused: {protos}")
+
+
+def negotiate_listener(
+    read_cb: Callable[[], bytes],
+    write_cb: Callable[[bytes], None],
+    supported: Iterable[str],
+    reader: Optional[StreamReader] = None,
+) -> str:
+    """Listen-side negotiation: answer proposals until one matches
+    `supported`; returns the agreed protocol id."""
+    reader = reader or StreamReader()
+    supported = list(supported)
+    hdr = _read_msg(read_cb, reader)
+    if hdr != MULTISTREAM_PROTO:
+        raise MultistreamError(f"bad multistream header {hdr!r}")
+    write_cb(encode_msg(MULTISTREAM_PROTO))
+    while True:
+        msg = _read_msg(read_cb, reader)
+        if msg == LS:
+            write_cb(b"".join(encode_msg(p) for p in supported))
+            continue
+        if msg in supported:
+            write_cb(encode_msg(msg))
+            return msg
+        write_cb(encode_msg(NA))

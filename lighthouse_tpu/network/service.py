@@ -38,8 +38,10 @@ class NetworkEvent:
 
 class NetworkService:
     def __init__(self, hub: InProcessHub, peer_id: str):
-        self.peer_id = peer_id
         self.endpoint = hub.join(peer_id)
+        # transports with wire-derived identities (libp2p base58 ids)
+        # override the requested name; in-process/socket hubs echo it
+        self.peer_id = getattr(self.endpoint, "peer_id", peer_id)
         self.gossip = GossipRouter(self.endpoint)
         self.rpc = RpcHandler(self.endpoint)
         self.peers = PeerManager()

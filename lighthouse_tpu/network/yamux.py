@@ -1,0 +1,287 @@
+"""yamux 1.0 stream multiplexer — sans-IO session core.
+
+The reference multiplexes every connection with yamux over noise
+(lighthouse_network service/utils.rs:52-63 builds
+`yamux::Config::default()` into the transport; each gossipsub mesh
+link and each req/resp request is a yamux substream). This module
+implements the yamux spec (hashicorp/yamux spec.md, the wire protocol
+rust-yamux speaks) as a sans-IO state machine so it can run over TCP,
+noise transport messages, or an in-memory pipe in tests.
+
+Frame header — 12 bytes, all multi-byte fields BIG-endian:
+
+    u8  version   (0)
+    u8  type      0 Data | 1 WindowUpdate | 2 Ping | 3 GoAway
+    u16 flags     1 SYN | 2 ACK | 4 FIN | 8 RST
+    u32 stream_id (odd = client-opened, even = server-opened)
+    u32 length    Data: payload bytes following; WindowUpdate: delta;
+                  Ping: opaque value; GoAway: error code
+
+Flow control: each direction of a stream starts with a 256 KiB receive
+window; Data consumes it, WindowUpdate replenishes. This session
+auto-replenishes (queues a WindowUpdate once half the window is
+consumed) because delivered events hand the bytes straight to the
+application. Writes past the peer's window are buffered per-stream and
+flushed as updates arrive.
+
+Usage:
+    s = YamuxSession(is_client=True)
+    sid = s.open_stream()
+    s.send(sid, b"hello")            # queues frames
+    wire_bytes = s.data_to_send()     # -> socket/noise
+    events = s.receive(peer_bytes)    # [(kind, sid, payload), ...]
+"""
+
+from __future__ import annotations
+
+import struct
+from collections import deque
+from typing import List, Optional, Tuple
+
+TYPE_DATA = 0x0
+TYPE_WINDOW_UPDATE = 0x1
+TYPE_PING = 0x2
+TYPE_GO_AWAY = 0x3
+
+FLAG_SYN = 0x1
+FLAG_ACK = 0x2
+FLAG_FIN = 0x4
+FLAG_RST = 0x8
+
+INITIAL_WINDOW = 256 * 1024
+_MAX_FRAME_DATA = 1 << 20  # sanity cap on one Data frame
+
+GOAWAY_NORMAL = 0x0
+GOAWAY_PROTO_ERROR = 0x1
+GOAWAY_INTERNAL_ERROR = 0x2
+
+# receive() event kinds
+EV_STREAM_OPENED = "stream_opened"   # remote SYN
+EV_DATA = "data"                     # payload bytes
+EV_STREAM_CLOSED = "stream_closed"   # remote FIN (half-close)
+EV_STREAM_RESET = "stream_reset"     # remote RST
+EV_PING = "ping"                     # remote SYN ping (ACK auto-queued)
+EV_GO_AWAY = "go_away"               # session teardown, payload = code
+
+
+class YamuxError(Exception):
+    pass
+
+
+def encode_frame(
+    typ: int, flags: int, stream_id: int, length: int, payload: bytes = b""
+) -> bytes:
+    return struct.pack(">BBHII", 0, typ, flags, stream_id, length) + payload
+
+
+class _Stream:
+    __slots__ = (
+        "sid", "send_window", "recv_consumed", "pending",
+        "local_closed", "remote_closed", "acked", "fin_pending",
+    )
+
+    def __init__(self, sid: int):
+        self.sid = sid
+        self.send_window = INITIAL_WINDOW
+        self.recv_consumed = 0          # since last WindowUpdate we sent
+        self.pending = deque()          # buffered writes past peer window
+        self.local_closed = False       # we sent FIN
+        self.remote_closed = False      # peer sent FIN
+        self.acked = False              # peer ACKed our SYN
+        self.fin_pending = False        # FIN deferred behind buffered data
+
+
+class YamuxSession:
+    """One yamux session (one underlying connection)."""
+
+    def __init__(self, is_client: bool):
+        self.is_client = is_client
+        self._next_sid = 1 if is_client else 2
+        self._streams: dict[int, _Stream] = {}
+        self._out = bytearray()
+        self._in = bytearray()
+        self._goaway_sent = False
+        self._goaway_recv: Optional[int] = None
+
+    # ----------------------------------------------------------- opening
+
+    def open_stream(self) -> int:
+        """Allocate a stream and queue its SYN (empty window update)."""
+        sid = self._next_sid
+        self._next_sid += 2
+        self._streams[sid] = _Stream(sid)
+        self._out += encode_frame(TYPE_WINDOW_UPDATE, FLAG_SYN, sid, 0)
+        return sid
+
+    # ----------------------------------------------------------- sending
+
+    def send(self, sid: int, data: bytes) -> None:
+        st = self._require(sid)
+        if st.local_closed or st.fin_pending:
+            raise YamuxError(f"stream {sid} closed for sending")
+        if st.pending:
+            # earlier bytes are still queued behind the peer's window;
+            # emitting now would reorder the stream
+            st.pending.append(bytes(data))
+            return
+        self._emit_data(st, data)
+
+    def _emit_data(self, st: _Stream, data: bytes) -> None:
+        view = memoryview(bytes(data))
+        while view:
+            if st.send_window == 0:
+                # remainder goes FIRST in the queue: it precedes any
+                # chunk queued after it
+                st.pending.appendleft(bytes(view))
+                return
+            n = min(len(view), st.send_window, _MAX_FRAME_DATA)
+            st.send_window -= n
+            self._out += encode_frame(
+                TYPE_DATA, 0, st.sid, n, bytes(view[:n])
+            )
+            view = view[n:]
+
+    def _drain_pending(self, st: _Stream) -> None:
+        while st.pending and st.send_window:
+            self._emit_data(st, st.pending.popleft())
+        if st.fin_pending and not st.pending:
+            st.fin_pending = False
+            self._finish_close(st)
+
+    def close_stream(self, sid: int) -> None:
+        """Half-close: FIN. Peer may keep sending until its own FIN.
+        If writes are still buffered behind the peer's window, the FIN
+        is deferred until they flush (a FIN ahead of buffered data
+        would truncate the transfer)."""
+        st = self._streams.get(sid)
+        if st is None or st.local_closed or st.fin_pending:
+            return
+        if st.pending:
+            st.fin_pending = True
+            return
+        self._finish_close(st)
+
+    def _finish_close(self, st: _Stream) -> None:
+        st.local_closed = True
+        self._out += encode_frame(TYPE_DATA, FLAG_FIN, st.sid, 0)
+        self._gc(st)
+
+    def reset_stream(self, sid: int) -> None:
+        st = self._streams.pop(sid, None)
+        if st is not None:
+            self._out += encode_frame(TYPE_WINDOW_UPDATE, FLAG_RST, sid, 0)
+
+    def ping(self, value: int = 0) -> None:
+        self._out += encode_frame(TYPE_PING, FLAG_SYN, 0, value)
+
+    def go_away(self, code: int = GOAWAY_NORMAL) -> None:
+        if not self._goaway_sent:
+            self._goaway_sent = True
+            self._out += encode_frame(TYPE_GO_AWAY, 0, 0, code)
+
+    def data_to_send(self) -> bytes:
+        out = bytes(self._out)
+        del self._out[:]
+        return out
+
+    # ---------------------------------------------------------- receiving
+
+    def receive(self, data: bytes) -> List[Tuple[str, int, bytes]]:
+        """Feed wire bytes; returns ordered events (kind, sid, payload)."""
+        self._in += data
+        events: List[Tuple[str, int, bytes]] = []
+        while True:
+            if len(self._in) < 12:
+                return events
+            ver, typ, flags, sid, length = struct.unpack(
+                ">BBHII", bytes(self._in[:12])
+            )
+            if ver != 0:
+                raise YamuxError(f"bad yamux version {ver}")
+            body = b""
+            if typ == TYPE_DATA:
+                if length > _MAX_FRAME_DATA:
+                    raise YamuxError(f"oversized data frame {length}")
+                if len(self._in) - 12 < length:
+                    return events
+                body = bytes(self._in[12 : 12 + length])
+                del self._in[: 12 + length]
+            else:
+                del self._in[:12]
+            self._handle(typ, flags, sid, length, body, events)
+
+    def _handle(self, typ, flags, sid, length, body, events) -> None:
+        if typ == TYPE_PING:
+            if flags & FLAG_SYN:
+                self._out += encode_frame(TYPE_PING, FLAG_ACK, 0, length)
+                events.append((EV_PING, 0, struct.pack(">I", length)))
+            return
+        if typ == TYPE_GO_AWAY:
+            self._goaway_recv = length
+            events.append((EV_GO_AWAY, 0, struct.pack(">I", length)))
+            return
+        if typ not in (TYPE_DATA, TYPE_WINDOW_UPDATE):
+            raise YamuxError(f"unknown frame type {typ}")
+
+        st = self._streams.get(sid)
+        if flags & FLAG_SYN:
+            if st is not None:
+                raise YamuxError(f"SYN on existing stream {sid}")
+            if self._inbound_sid_invalid(sid):
+                self._out += encode_frame(
+                    TYPE_WINDOW_UPDATE, FLAG_RST, sid, 0
+                )
+                return
+            st = _Stream(sid)
+            st.acked = True
+            self._streams[sid] = st
+            self._out += encode_frame(TYPE_WINDOW_UPDATE, FLAG_ACK, sid, 0)
+            events.append((EV_STREAM_OPENED, sid, b""))
+        if st is None:
+            # frames on unknown/reset streams are dropped (late data
+            # after our RST is legal peer behavior)
+            return
+        if flags & FLAG_ACK:
+            st.acked = True
+        if flags & FLAG_RST:
+            self._streams.pop(sid, None)
+            events.append((EV_STREAM_RESET, sid, b""))
+            return
+
+        if typ == TYPE_WINDOW_UPDATE:
+            st.send_window += length
+            self._drain_pending(st)
+        elif body:
+            st.recv_consumed += len(body)
+            if st.recv_consumed >= INITIAL_WINDOW // 2:
+                self._out += encode_frame(
+                    TYPE_WINDOW_UPDATE, 0, sid, st.recv_consumed
+                )
+                st.recv_consumed = 0
+            events.append((EV_DATA, sid, body))
+
+        if flags & FLAG_FIN:
+            st.remote_closed = True
+            events.append((EV_STREAM_CLOSED, sid, b""))
+            self._gc(st)
+
+    def _inbound_sid_invalid(self, sid: int) -> bool:
+        # peers open odd ids when they are the client, even otherwise;
+        # an inbound SYN must come from the peer's id space
+        peer_is_client = not self.is_client
+        return sid % 2 != (1 if peer_is_client else 0) or sid == 0
+
+    def _gc(self, st: _Stream) -> None:
+        if st.local_closed and st.remote_closed:
+            self._streams.pop(st.sid, None)
+
+    # ------------------------------------------------------------- misc
+
+    def _require(self, sid: int) -> _Stream:
+        st = self._streams.get(sid)
+        if st is None:
+            raise YamuxError(f"unknown stream {sid}")
+        return st
+
+    def stream_ids(self) -> list:
+        return sorted(self._streams)

@@ -1,0 +1,355 @@
+"""The libp2p connection stack: multistream-select, yamux, identity,
+and the full tcp->noise->yamux transport (VERDICT r3 missing #1 — the
+layering lighthouse_network builds in service/utils.rs:38-63)."""
+
+import struct
+import threading
+import time
+
+import pytest
+
+from lighthouse_tpu.network import multistream as mss
+from lighthouse_tpu.network import yamux as ymx
+from lighthouse_tpu.network import libp2p_identity as ident
+from lighthouse_tpu.network.libp2p_transport import Libp2pEndpoint
+from lighthouse_tpu.network.transport import CHANNEL_GOSSIP, CHANNEL_RPC
+
+
+# ------------------------------------------------------ multistream-select
+
+
+def test_mss_message_encoding_golden():
+    # '/multistream/1.0.0' is 18 bytes + newline = 19 -> varint 0x13
+    assert mss.encode_msg("/multistream/1.0.0") == b"\x13/multistream/1.0.0\n"
+    assert mss.encode_msg("na") == b"\x03na\n"
+
+
+def test_mss_negotiation_pipe():
+    a2b, b2a = [], []
+
+    def mk(rx, tx):
+        def read():
+            while not rx:
+                time.sleep(0.001)
+            return rx.pop(0)
+
+        return read, lambda b: tx.append(b)
+
+    results = {}
+
+    def listener():
+        r, w = mk(a2b, b2a)
+        results["l"] = mss.negotiate_listener(r, w, ["/noise", "/yamux/1.0.0"])
+
+    t = threading.Thread(target=listener, daemon=True)
+    t.start()
+    r, w = mk(b2a, a2b)
+    got = mss.negotiate_dialer(r, w, ["/tls/1.0.0", "/noise"])
+    t.join(timeout=5)
+    assert got == "/noise"
+    assert results["l"] == "/noise"
+
+
+def test_mss_reader_handles_split_messages():
+    r = mss.StreamReader()
+    msg = mss.encode_msg("/meshsub/1.1.0")
+    r.feed(msg[:3])
+    assert r.next_msg() is None
+    r.feed(msg[3:])
+    assert r.next_msg() == "/meshsub/1.1.0"
+
+
+# ----------------------------------------------------------------- yamux
+
+
+def test_yamux_header_golden():
+    # 12-byte header, big-endian: ver=0 type=Data flags=SYN sid=1 len=5
+    frame = ymx.encode_frame(ymx.TYPE_DATA, ymx.FLAG_SYN, 1, 5, b"hello")
+    assert frame[:12] == bytes([0, 0, 0, 1, 0, 0, 0, 1, 0, 0, 0, 5])
+    assert frame[12:] == b"hello"
+
+
+def test_yamux_open_send_receive_roundtrip():
+    a = ymx.YamuxSession(is_client=True)
+    b = ymx.YamuxSession(is_client=False)
+    sid = a.open_stream()
+    assert sid == 1  # client ids are odd
+    a.send(sid, b"ping-data")
+    evs = b.receive(a.data_to_send())
+    kinds = [e[0] for e in evs]
+    assert kinds == [ymx.EV_STREAM_OPENED, ymx.EV_DATA]
+    assert evs[1][2] == b"ping-data"
+    # reply on the same stream
+    b.send(sid, b"pong")
+    evs = a.receive(b.data_to_send())
+    assert (ymx.EV_DATA, sid, b"pong") in evs
+
+
+def test_yamux_server_ids_even():
+    b = ymx.YamuxSession(is_client=False)
+    assert b.open_stream() == 2
+
+
+def test_yamux_fin_half_close_and_reset():
+    a = ymx.YamuxSession(is_client=True)
+    b = ymx.YamuxSession(is_client=False)
+    sid = a.open_stream()
+    a.send(sid, b"req")
+    a.close_stream(sid)
+    evs = b.receive(a.data_to_send())
+    assert (ymx.EV_STREAM_CLOSED, sid, b"") in evs
+    # responder can still send back (half-close)
+    b.send(sid, b"resp")
+    b.close_stream(sid)
+    evs = a.receive(b.data_to_send())
+    assert (ymx.EV_DATA, sid, b"resp") in evs
+    assert (ymx.EV_STREAM_CLOSED, sid, b"") in evs
+    # reset on a fresh stream
+    sid2 = a.open_stream()
+    b.receive(a.data_to_send())
+    b.reset_stream(sid2)
+    evs = a.receive(b.data_to_send())
+    assert (ymx.EV_STREAM_RESET, sid2, b"") in evs
+
+
+def test_yamux_ping_autoack():
+    a = ymx.YamuxSession(is_client=True)
+    b = ymx.YamuxSession(is_client=False)
+    a.ping(0xDEAD)
+    evs = b.receive(a.data_to_send())
+    assert evs[0][0] == ymx.EV_PING
+    # b auto-queued the ACK
+    ack = b.data_to_send()
+    assert struct.unpack(">BBHII", ack[:12]) == (
+        0, ymx.TYPE_PING, ymx.FLAG_ACK, 0, 0xDEAD,
+    )
+
+
+def test_yamux_window_backpressure():
+    a = ymx.YamuxSession(is_client=True)
+    b = ymx.YamuxSession(is_client=False)
+    sid = a.open_stream()
+    big = bytes(ymx.INITIAL_WINDOW + 1000)
+    a.send(sid, big)
+    wire = a.data_to_send()
+    # only INITIAL_WINDOW bytes may be in flight
+    received = b.receive(wire)
+    got = b"".join(p for k, s, p in received if k == ymx.EV_DATA)
+    assert len(got) == ymx.INITIAL_WINDOW
+    # b's auto window update releases the remainder
+    a.receive(b.data_to_send())
+    received = b.receive(a.data_to_send())
+    got2 = b"".join(p for k, s, p in received if k == ymx.EV_DATA)
+    assert len(got2) == 1000
+
+
+def test_yamux_fin_deferred_behind_buffered_writes():
+    """A >window transfer followed by close_stream must deliver every
+    byte before the FIN (code-review r4: FIN-ahead-of-pending truncated
+    large RPC responses)."""
+    a = ymx.YamuxSession(is_client=True)
+    b = ymx.YamuxSession(is_client=False)
+    sid = a.open_stream()
+    big = bytes(range(256)) * ((ymx.INITIAL_WINDOW + 50_000) // 256)
+    a.send(sid, big)
+    a.close_stream(sid)  # FIN must wait for the buffered tail
+    got = bytearray()
+    closed = []
+    for _ in range(10):
+        for k, s, p in b.receive(a.data_to_send()):
+            if k == ymx.EV_DATA:
+                got += p
+            elif k == ymx.EV_STREAM_CLOSED:
+                closed.append(len(got))
+        a.receive(b.data_to_send())  # window updates flow back
+        if closed:
+            break
+    assert bytes(got) == big
+    assert closed == [len(big)]  # FIN seen only after ALL the bytes
+
+
+def test_yamux_backpressure_preserves_byte_order():
+    """Two sends queued behind a zero window, released by a partial
+    window update, must arrive in order (code-review r4: the remainder
+    was re-queued behind later chunks)."""
+    a = ymx.YamuxSession(is_client=True)
+    b = ymx.YamuxSession(is_client=False)
+    sid = a.open_stream()
+    first = b"A" * (ymx.INITIAL_WINDOW + 100)  # tail of A gets buffered
+    second = b"B" * 200
+    a.send(sid, first)
+    a.send(sid, second)
+    got = bytearray()
+    for _ in range(10):
+        for k, s, p in b.receive(a.data_to_send()):
+            if k == ymx.EV_DATA:
+                got += p
+        a.receive(b.data_to_send())
+        if len(got) == len(first) + len(second):
+            break
+    assert bytes(got) == first + second
+
+
+# -------------------------------------------------------------- identity
+
+
+def test_peer_id_roundtrip():
+    kp = ident.Keypair.generate(seed=b"node-a")
+    pid = kp.peer_id
+    assert ident.b58decode(pid)[0] == 0x00  # identity multihash
+    assert ident.pubkey_from_peer_id(pid) == kp.public_compressed
+
+
+def test_noise_payload_binding():
+    kp = ident.Keypair.generate(seed=b"node-a")
+    static = b"\x42" * 32
+    payload = ident.make_noise_payload(kp, static)
+    assert ident.verify_noise_payload(payload, static) == kp.peer_id
+    with pytest.raises(ident.IdentityError):
+        ident.verify_noise_payload(payload, b"\x43" * 32)
+
+
+def test_der_signature_roundtrip():
+    compact = bytes(range(1, 33)) + bytes(range(33, 65))
+    assert ident.der_to_sig(ident.sig_to_der(compact)) == compact
+
+
+# --------------------------------------------------- full stacked endpoint
+
+
+@pytest.fixture
+def pair():
+    a = Libp2pEndpoint(ident.Keypair.generate(seed=b"ep-a"))
+    b = Libp2pEndpoint(ident.Keypair.generate(seed=b"ep-b"))
+    yield a, b
+    a.close()
+    b.close()
+
+
+def _wait(cond, timeout=10.0):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        v = cond()
+        if v:
+            return v
+        time.sleep(0.01)
+    raise AssertionError("timed out")
+
+
+def test_stack_connect_derives_real_peer_ids(pair):
+    a, b = pair
+    peer = a.connect(*b.addr)
+    assert peer == b.peer_id
+    _wait(lambda: a.peer_id in b.connected_peers())
+    assert b.connected_peers() == [a.peer_id]
+
+
+def test_stack_gossip_frames_flow_both_ways(pair):
+    a, b = pair
+    a.connect(*b.addr)
+    _wait(lambda: a.peer_id in b.connected_peers())
+    assert a.send(b.peer_id, CHANNEL_GOSSIP, b"gossip-envelope-1")
+    f = _wait(lambda: b.poll())
+    assert (f.sender, f.channel, f.payload) == (
+        a.peer_id, CHANNEL_GOSSIP, b"gossip-envelope-1",
+    )
+    assert b.send(a.peer_id, CHANNEL_GOSSIP, b"reply")
+    f = _wait(lambda: a.poll())
+    assert (f.sender, f.payload) == (b.peer_id, b"reply")
+
+
+def test_stack_rpc_request_response_over_substreams(pair):
+    from lighthouse_tpu.network.rpc import Protocol
+
+    a, b = pair
+    a.connect(*b.addr)
+    _wait(lambda: a.peer_id in b.connected_peers())
+    # a makes a request: mux header + opaque chunk bytes
+    req = struct.pack("<IBB", 7, int(Protocol.PING), 0) + b"req-chunk"
+    assert a.send(b.peer_id, CHANNEL_RPC, req)
+    f = _wait(lambda: b.poll())
+    assert f.channel == CHANNEL_RPC
+    rid, proto, is_resp = struct.unpack("<IBB", f.payload[:6])
+    assert (proto, is_resp) == (int(Protocol.PING), 0)
+    assert f.payload[6:] == b"req-chunk"
+    # b answers on the same (remote-id) stream
+    resp = struct.pack("<IBB", rid, proto, 1) + b"resp-chunk"
+    assert b.send(a.peer_id, CHANNEL_RPC, resp)
+    f = _wait(lambda: a.poll())
+    rid2, proto2, is_resp2 = struct.unpack("<IBB", f.payload[:6])
+    assert (rid2, proto2, is_resp2) == (7, int(Protocol.PING), 1)
+    assert f.payload[6:] == b"resp-chunk"
+
+
+def test_stack_concurrent_rpc_streams(pair):
+    from lighthouse_tpu.network.rpc import Protocol
+
+    a, b = pair
+    a.connect(*b.addr)
+    _wait(lambda: a.peer_id in b.connected_peers())
+    for i in range(8):
+        req = struct.pack("<IBB", 100 + i, int(Protocol.STATUS), 0) + bytes(
+            [i]
+        ) * 10
+        assert a.send(b.peer_id, CHANNEL_RPC, req)
+    got = []
+    def collect():
+        f = b.poll()
+        if f is not None:
+            # req ids are link-local (the responder allocates its own,
+            # playing the yamux stream-id role); match on payloads
+            got.append(f.payload[6:])
+        return len(got) == 8
+    _wait(collect)
+    assert sorted(got) == [bytes([i]) * 10 for i in range(8)]
+
+
+# ----------------------------------- NetworkService over the full stack
+
+
+def test_network_service_gossip_and_rpc_over_libp2p():
+    """Two NetworkServices stacked on tcp/noise/yamux: gossipsub
+    protobuf envelopes ride a /meshsub substream, an RPC ping rides its
+    own negotiated substream (the reference's full connection shape)."""
+    from lighthouse_tpu.network.libp2p_transport import Libp2pHub
+    from lighthouse_tpu.network.rpc import Protocol, ResponseCode
+    from lighthouse_tpu.network.service import EventKind, NetworkService
+
+    a = NetworkService(Libp2pHub(), "svc-a")
+    b = NetworkService(Libp2pHub(), "svc-b")
+    try:
+        assert a.peer_id != "svc-a"  # adopted the wire identity
+        topic = "/eth2/00000000/beacon_block/ssz_snappy"
+        a.subscribe(topic)
+        b.subscribe(topic)
+        peer = a.connect_remote(*b.endpoint.addr)
+        assert peer == b.peer_id
+        _wait(lambda: a.peer_id in b.endpoint.connected_peers())
+        _wait(lambda: a.peer_id in b.peers.connected())
+        b.gossip.graft(topic, a.peer_id)
+        a.publish(topic, b"ssz-block-bytes")
+        events = _wait(lambda: b.poll())
+        assert events[0].kind == EventKind.GOSSIP
+        assert events[0].data == b"ssz-block-bytes"
+
+        b.rpc.register(
+            Protocol.PING,
+            lambda peer, body: (ResponseCode.SUCCESS, [b"\x05" + b"\x00" * 7]),
+        )
+        got = []
+        a.request(
+            b.peer_id,
+            Protocol.PING,
+            b"\x01" + b"\x00" * 7,
+            lambda peer, code, chunks: got.append((peer, code, chunks)),
+        )
+        def pump():
+            a.poll()
+            b.poll()
+            return got
+        _wait(pump)
+        assert got[0][1] == ResponseCode.SUCCESS
+        assert got[0][2] == [b"\x05" + b"\x00" * 7]
+    finally:
+        a.endpoint.close()
+        b.endpoint.close()
