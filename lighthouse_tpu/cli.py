@@ -178,6 +178,17 @@ def _build_parser() -> argparse.ArgumentParser:
 
     boot = sub.add_parser("boot-node", help="standalone discovery node")
     boot.add_argument("--peer-id", default="boot")
+    boot.add_argument("--udp-port", type=int, default=0,
+                      help="serve REAL discv5 v5.1 over UDP on this port "
+                           "(0 = in-process transport only)")
+    boot.add_argument("--listen-address", default="0.0.0.0",
+                      help="UDP bind address for --udp-port mode")
+    boot.add_argument("--enr-address", default="127.0.0.1",
+                      help="IP advertised in this node's signed ENR")
+    boot.add_argument("--enr", action="append", default=[],
+                      help="enr:... record to seed the table (repeatable)")
+    boot.add_argument("--print-enr", action="store_true",
+                      help="print this node's signed ENR and exit")
 
     return p
 
@@ -717,6 +728,40 @@ def cmd_watch(args) -> int:
 
 def cmd_boot_node(args) -> int:
     import time
+
+    if args.udp_port:
+        # the reference boot_node binary's role: a chain-less discv5
+        # server answering PING/FINDNODE over real UDP packets
+        import socket as _socket
+
+        from .network.discv5 import Discv5Node
+        from .network.enr import Enr, EnrError
+
+        node = Discv5Node(
+            host=args.listen_address,
+            port=args.udp_port,
+            enr_kwargs={"ip": _socket.inet_aton(args.enr_address)},
+        )
+        seeded = 0
+        for text in args.enr:
+            try:
+                seeded += bool(node.add_enr(Enr.from_text(text)))
+            except EnrError as e:
+                print(f"rejected --enr record: {e}", file=sys.stderr)
+                node.close()
+                return 2
+        print(node.enr.to_text())
+        if args.print_enr:
+            node.close()
+            return 0
+        print(f"discv5 boot node on udp/{node.addr[1]} "
+              f"({seeded} seeded records)")
+        try:
+            while True:
+                time.sleep(1.0)
+        except KeyboardInterrupt:
+            node.close()
+            return 0
 
     from .network.discovery import BootNode
     from .network.transport import InProcessHub

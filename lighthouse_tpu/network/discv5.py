@@ -1,0 +1,419 @@
+"""discv5 v5.1 node: UDP service, sessions, handshakes, FINDNODE.
+
+The runtime half of the discovery wire layer (packet codec:
+network/discv5_wire.py; records: network/enr.py). Plays the role
+sigp/discv5's `Discv5` service plays for the reference
+(`beacon_node/lighthouse_network/src/discovery/mod.rs` drives it for
+peer discovery; `boot_node/` runs one standalone).
+
+Protocol flow implemented (discv5-theory spec):
+
+  A has no session with B:
+    A -> B  ordinary packet, random message data (can't encrypt yet)
+    B -> A  WHOAREYOU (id-nonce challenge, references A's nonce)
+    A -> B  HANDSHAKE packet: id-signature over the challenge data,
+            ephemeral pubkey, [A's ENR if B's view is stale], plus the
+            original message encrypted under the fresh session keys
+    B       verifies the id-signature against A's ENR key, derives the
+            same keys, decrypts; session established both ways.
+
+  With a session: ordinary packets, AES-128-GCM.
+
+Server side answers PING with PONG (ip/port echo) and FINDNODE with
+NODES chunked at NODES_PER_MSG records; TALKREQ gets an empty
+TALKRESP (no sub-protocols registered).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..crypto import secp256k1
+from . import discv5_wire as W
+from .enr import Enr
+
+NODES_PER_MSG = 4
+REQUEST_TIMEOUT = 2.0
+MAX_TABLE = 1024
+# unauthenticated-state bounds: spoofed src ids must not grow memory
+# without limit (oldest entries evicted, insertion order)
+MAX_TRANSIENT = 4096
+
+
+def _bounded_put(d: dict, key, value, cap: int = MAX_TRANSIENT) -> None:
+    if key not in d and len(d) >= cap:
+        d.pop(next(iter(d)))
+    d[key] = value
+
+
+class Discv5Error(Exception):
+    pass
+
+
+class Discv5Node:
+    """One UDP discovery endpoint."""
+
+    def __init__(
+        self,
+        private_key: bytes = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        enr_kwargs: dict = None,
+    ):
+        self.private_key = private_key or os.urandom(32)
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self.sock.bind((host, port))
+        self.addr = self.sock.getsockname()
+        kwargs = dict(enr_kwargs or {})
+        kwargs.setdefault("ip", socket.inet_aton(host))
+        kwargs.setdefault("udp", self.addr[1])
+        self.enr = Enr.build(self.private_key, **kwargs)
+        self.node_id = self.enr.node_id()
+        # peer state
+        self._table: Dict[bytes, Enr] = {}          # node_id -> ENR
+        self._sessions: Dict[bytes, W.Session] = {}  # node_id -> keys
+        self._addrs: Dict[bytes, tuple] = {}         # node_id -> udp addr
+        # our outbound packets awaiting WHOAREYOU: nonce -> (node_id, msg)
+        self._pending_hs: Dict[bytes, tuple] = {}
+        # challenges we issued: node_id -> challenge-data
+        self._challenges: Dict[bytes, bytes] = {}
+        # request/response correlation: req_id -> [reply Messages]
+        self._responses: Dict[bytes, list] = {}
+        self._resp_cv = threading.Condition()
+        self._lock = threading.RLock()
+        self._closed = False
+        self.on_enr_discovered: Optional[Callable] = None
+        threading.Thread(target=self._recv_loop, daemon=True).start()
+
+    # ------------------------------------------------------------ table
+
+    def add_enr(self, enr: Enr) -> bool:
+        if not enr.verify():
+            return False
+        nid = enr.node_id()
+        with self._lock:
+            known = self._table.get(nid)
+            if known is not None and known.seq >= enr.seq:
+                return False
+            if len(self._table) >= MAX_TABLE and nid not in self._table:
+                return False
+            self._table[nid] = enr
+            if enr.ip and enr.udp:
+                self._addrs[nid] = (enr.ip, enr.udp)
+        cb = self.on_enr_discovered
+        if cb is not None:
+            cb(enr)
+        return True
+
+    def known_enrs(self) -> List[Enr]:
+        with self._lock:
+            return list(self._table.values())
+
+    # ------------------------------------------------------- client ops
+
+    def ping(self, enr: Enr, timeout: float = REQUEST_TIMEOUT) -> Optional[W.Message]:
+        """PING; returns the PONG message (enr_seq tells us whether to
+        re-fetch their record) or None."""
+        req_id = os.urandom(4)
+        msg = W.encode_ping(req_id, self.enr.seq)
+        replies = self._request(enr, req_id, msg, timeout, want=1)
+        return replies[0] if replies else None
+
+    def find_node(
+        self, enr: Enr, distances: List[int], timeout: float = REQUEST_TIMEOUT
+    ) -> List[Enr]:
+        """FINDNODE at the given log2 distances; NODES replies are
+        signature-verified and ingested into the table."""
+        req_id = os.urandom(4)
+        msg = W.encode_findnode(req_id, distances)
+        replies = self._request(enr, req_id, msg, timeout, want=None)
+        out = []
+        for reply in replies:
+            if reply.kind != W.MSG_NODES:
+                continue
+            for rec in reply.records:
+                # Enr.decode already verified the signature inside
+                # decode_message; add_enr re-verifies at its own gate
+                self.add_enr(rec)
+                out.append(rec)
+        return out
+
+    def _request(
+        self, enr: Enr, req_id: bytes, msg: bytes, timeout: float, want
+    ) -> list:
+        """Send a request (handshaking if needed) and gather replies.
+        want=N waits for N messages; want=None waits for a NODES total."""
+        nid = enr.node_id()
+        self.add_enr(enr)
+        with self._resp_cv:
+            self._responses[req_id] = []
+        got: list = []
+        try:
+            self._send_message(nid, msg)
+            deadline = time.time() + timeout
+            with self._resp_cv:
+                while time.time() < deadline:
+                    got = self._responses.get(req_id, [])
+                    if want is not None and len(got) >= want:
+                        break
+                    if want is None and got and sum(
+                        1 for m in got if m.kind == W.MSG_NODES
+                    ) >= (got[0].total or 1):
+                        break
+                    self._resp_cv.wait(timeout=0.05)
+        except Discv5Error:
+            pass  # e.g. the ENR carries no ip/udp: behave as a timeout
+        finally:
+            with self._resp_cv:
+                self._responses.pop(req_id, None)
+        return got
+
+    # ---------------------------------------------------------- sending
+
+    def _send_message(self, nid: bytes, message_pt: bytes) -> None:
+        with self._lock:
+            session = self._sessions.get(nid)
+            addr = self._addrs.get(nid)
+        if addr is None:
+            raise Discv5Error("no address for node")
+        if session is None:
+            # no session: random packet to elicit WHOAREYOU
+            nonce = os.urandom(12)
+            with self._lock:
+                _bounded_put(self._pending_hs, nonce, (nid, message_pt))
+            pkt = W.encode_packet(
+                nid, W.FLAG_ORDINARY, nonce, self.node_id, os.urandom(16)
+            )
+            self.sock.sendto(pkt, addr)
+            return
+        nonce = session.next_nonce()
+        masking_iv = os.urandom(16)
+        header = self._header_bytes(W.FLAG_ORDINARY, nonce, self.node_id)
+        ct = W.aes_gcm_encrypt(
+            session.send_key, nonce, message_pt, masking_iv + header
+        )
+        pkt = W.encode_packet(
+            nid, W.FLAG_ORDINARY, nonce, self.node_id, ct, masking_iv
+        )
+        self.sock.sendto(pkt, addr)
+
+    @staticmethod
+    def _header_bytes(flag: int, nonce: bytes, authdata: bytes) -> bytes:
+        return (
+            W.PROTOCOL_ID
+            + struct.pack(">H", W.VERSION)
+            + bytes([flag])
+            + nonce
+            + struct.pack(">H", len(authdata))
+            + authdata
+        )
+
+    # -------------------------------------------------------- receiving
+
+    def _recv_loop(self) -> None:
+        while not self._closed:
+            try:
+                data, addr = self.sock.recvfrom(2048)
+            except OSError:
+                return
+            try:
+                pkt = W.decode_packet(self.node_id, data)
+                self._handle_packet(pkt, addr)
+            except Exception:
+                # ANY malformed remote datagram (bad rlp, EnrError, a
+                # short struct field, ...) must never kill the receive
+                # thread — one escape deafens the node permanently
+                continue
+
+    def _handle_packet(self, pkt: W.Packet, addr) -> None:
+        if pkt.flag == W.FLAG_WHOAREYOU:
+            self._on_whoareyou(pkt, addr)
+        elif pkt.flag == W.FLAG_HANDSHAKE:
+            self._on_handshake(pkt, addr)
+        elif pkt.flag == W.FLAG_ORDINARY:
+            self._on_ordinary(pkt, addr)
+
+    def _on_ordinary(self, pkt: W.Packet, addr) -> None:
+        nid = pkt.src_id
+        with self._lock:
+            session = self._sessions.get(nid)
+            if nid not in self._addrs:
+                _bounded_put(self._addrs, nid, addr)
+        if session is None:
+            self._send_whoareyou(pkt, nid, addr)
+            return
+        try:
+            pt = W.aes_gcm_decrypt(
+                session.recv_key,
+                pkt.nonce,
+                pkt.message_ct,
+                pkt.masking_iv + pkt.header,
+            )
+        except W.Discv5WireError:
+            # undecryptable under the current session: stale keys on
+            # their side -> re-challenge
+            self._send_whoareyou(pkt, nid, addr)
+            return
+        self._on_message(nid, addr, W.decode_message(pt))
+
+    def _send_whoareyou(self, pkt: W.Packet, nid: bytes, addr) -> None:
+        id_nonce = os.urandom(16)
+        with self._lock:
+            known = self._table.get(nid)
+        authdata = W.whoareyou_authdata(
+            id_nonce, known.seq if known is not None else 0
+        )
+        masking_iv = os.urandom(16)
+        challenge_data = (
+            masking_iv
+            + self._header_bytes(W.FLAG_WHOAREYOU, pkt.nonce, authdata)
+        )
+        with self._lock:
+            _bounded_put(self._challenges, nid, challenge_data)
+        out = W.encode_packet(
+            nid, W.FLAG_WHOAREYOU, pkt.nonce, authdata, b"", masking_iv
+        )
+        self.sock.sendto(out, addr)
+
+    def _on_whoareyou(self, pkt: W.Packet, addr) -> None:
+        """Our earlier packet (nonce) was challenged: run the handshake
+        and resend the pending message under the new keys."""
+        if len(pkt.authdata) != 24:
+            return  # id-nonce(16) || enr-seq(8), nothing else is valid
+        with self._lock:
+            pending = self._pending_hs.pop(pkt.nonce, None)
+        if pending is None:
+            return
+        nid, message_pt = pending
+        with self._lock:
+            remote = self._table.get(nid)
+        if remote is None:
+            return
+        remote_pub = remote.pairs.get(b"secp256k1")
+        if remote_pub is None:
+            return
+        challenge_data = pkt.masking_iv + pkt.header
+        eph_priv = os.urandom(32)
+        eph_pub = secp256k1.pubkey_compressed(eph_priv)
+        secret = W.ecdh(remote_pub, eph_priv)
+        ini_key, rec_key = W.derive_session_keys(
+            secret, self.node_id, nid, challenge_data
+        )
+        sig = W.id_sign(self.private_key, challenge_data, eph_pub, nid)
+        # include our record when their view of us is stale
+        their_seq = struct.unpack(">Q", pkt.authdata[16:24])[0]
+        record = self.enr.encode() if their_seq < self.enr.seq else b""
+        authdata = W.handshake_authdata(self.node_id, sig, eph_pub, record)
+        session = W.Session(send_key=ini_key, recv_key=rec_key)
+        nonce = session.next_nonce()
+        masking_iv = os.urandom(16)
+        header = self._header_bytes(W.FLAG_HANDSHAKE, nonce, authdata)
+        ct = W.aes_gcm_encrypt(ini_key, nonce, message_pt, masking_iv + header)
+        out = W.encode_packet(
+            nid, W.FLAG_HANDSHAKE, nonce, authdata, ct, masking_iv
+        )
+        with self._lock:
+            self._sessions[nid] = session
+            self._addrs[nid] = addr
+        self.sock.sendto(out, addr)
+
+    def _on_handshake(self, pkt: W.Packet, addr) -> None:
+        src_id, sig, eph_pub, record_rlp = W.parse_handshake_authdata(
+            pkt.authdata
+        )
+        with self._lock:
+            # peek, don't pop: a forged handshake must not destroy the
+            # legitimate peer's pending challenge (popped on success)
+            challenge_data = self._challenges.get(src_id)
+            known = self._table.get(src_id)
+        if challenge_data is None:
+            return
+        if record_rlp:
+            try:
+                enr = Enr.decode(record_rlp)
+            except Exception:
+                return
+            if enr.node_id() != src_id:
+                return  # record does not prove the claimed source
+            self.add_enr(enr)  # False just means we already knew it
+            known = enr
+        if known is None:
+            return
+        remote_pub = known.pairs.get(b"secp256k1")
+        if remote_pub is None or not W.id_verify(
+            remote_pub, sig, challenge_data, eph_pub, self.node_id
+        ):
+            return
+        secret = W.ecdh(eph_pub, self.private_key)
+        ini_key, rec_key = W.derive_session_keys(
+            secret, src_id, self.node_id, challenge_data
+        )
+        # they are the initiator: their send key is ours to receive
+        session = W.Session(send_key=rec_key, recv_key=ini_key)
+        try:
+            pt = W.aes_gcm_decrypt(
+                ini_key, pkt.nonce, pkt.message_ct, pkt.masking_iv + pkt.header
+            )
+        except W.Discv5WireError:
+            return
+        with self._lock:
+            self._challenges.pop(src_id, None)  # consumed by success
+            self._sessions[src_id] = session
+            self._addrs[src_id] = addr
+        self._on_message(src_id, addr, W.decode_message(pt))
+
+    # ----------------------------------------------------- message plane
+
+    def _on_message(self, nid: bytes, addr, msg: W.Message) -> None:
+        if msg.kind == W.MSG_PING:
+            self._send_message(
+                nid,
+                W.encode_pong(
+                    msg.req_id,
+                    self.enr.seq,
+                    socket.inet_aton(addr[0]),
+                    addr[1],
+                ),
+            )
+        elif msg.kind == W.MSG_FINDNODE:
+            self._serve_findnode(nid, msg)
+        elif msg.kind == W.MSG_TALKREQ:
+            self._send_message(nid, W.encode_talkresp(msg.req_id, b""))
+        elif msg.kind in (W.MSG_PONG, W.MSG_NODES, W.MSG_TALKRESP):
+            with self._resp_cv:
+                if msg.req_id in self._responses:
+                    self._responses[msg.req_id].append(msg)
+                    self._resp_cv.notify_all()
+
+    def _serve_findnode(self, nid: bytes, msg: W.Message) -> None:
+        wanted = set(msg.distances)
+        matches: List[bytes] = []
+        with self._lock:
+            candidates = list(self._table.values())
+        if 0 in wanted:
+            matches.append(self.enr.encode())
+        for enr in candidates:
+            if W.node_distance(self.node_id, enr.node_id()) in wanted:
+                matches.append(enr.encode())
+        matches = matches[:16]  # spec cap on total records
+        chunks = [
+            matches[i : i + NODES_PER_MSG]
+            for i in range(0, len(matches), NODES_PER_MSG)
+        ] or [[]]
+        total = len(chunks)
+        for chunk in chunks:
+            self._send_message(
+                nid, W.encode_nodes(msg.req_id, total, chunk)
+            )
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self.sock.close()
+        except OSError:
+            pass

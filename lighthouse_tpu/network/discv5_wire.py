@@ -1,0 +1,405 @@
+"""discv5 v5.1 UDP wire protocol — packets, sessions, handshake.
+
+The reference's discovery stack is sigp/discv5 under
+`beacon_node/lighthouse_network/src/discovery/mod.rs`; this module
+implements the same wire protocol (devp2p discv5-wire spec v5.1) so
+records served by `network/enr.py` travel in real packets:
+
+Packet layout:
+
+    masking-iv (16B) || masked(header) || message-data
+
+    header       = static-header || authdata
+    static-header= "discv5" (6B) || version 0x0001 (2B) || flag (1B)
+                   || nonce (12B) || authdata-size (2B, big-endian)
+    masking      = AES-128-CTR, key = dest-node-id[:16], iv = masking-iv
+
+Flags: 0 ORDINARY (authdata = 32B src node id; message-data =
+AES-128-GCM(session key, header nonce, message, ad = masking-iv ||
+header)); 1 WHOAREYOU (authdata = id-nonce 16B || enr-seq 8B, no
+message); 2 HANDSHAKE (authdata = src-id || sig-size || eph-key-size
+|| id-signature || eph-pubkey || optional ENR, message encrypted under
+the just-derived keys).
+
+Handshake crypto (discv5-theory spec):
+  ecdh(pub, priv)  = compressed secp256k1 point of priv*pub
+  challenge-data   = masking-iv || static-header || authdata of the
+                     WHOAREYOU packet
+  keys             = HKDF-SHA256(extract salt=challenge-data,
+                     ikm=ecdh secret; expand info="discovery v5 key
+                     agreement" || src-id || dest-id, 32B)
+                     -> initiator-key(16) || recipient-key(16)
+  id-signature     = sign_secp256k1(sha256("discovery v5 identity
+                     proof" || challenge-data || eph-pubkey ||
+                     dest-node-id))  (compact r||s)
+
+Messages (type byte || RLP list):
+  0x01 PING(req-id, enr-seq)        0x02 PONG(req-id, enr-seq, ip, port)
+  0x03 FINDNODE(req-id, [dist...])  0x04 NODES(req-id, total, [ENR...])
+  0x05 TALKREQ(req-id, proto, req)  0x06 TALKRESP(req-id, resp)
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac as hmac_mod
+import os
+import struct
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+from ..crypto import secp256k1
+from .enr import Enr, _rlp_decode
+from ..execution.block_hash import rlp_bytes, rlp_int, rlp_list
+
+PROTOCOL_ID = b"discv5"
+VERSION = 0x0001
+
+FLAG_ORDINARY = 0
+FLAG_WHOAREYOU = 1
+FLAG_HANDSHAKE = 2
+
+MSG_PING = 0x01
+MSG_PONG = 0x02
+MSG_FINDNODE = 0x03
+MSG_NODES = 0x04
+MSG_TALKREQ = 0x05
+MSG_TALKRESP = 0x06
+
+ID_SIGNATURE_TEXT = b"discovery v5 identity proof"
+KDF_INFO_TEXT = b"discovery v5 key agreement"
+
+_STATIC_HEADER_LEN = 6 + 2 + 1 + 12 + 2
+_MIN_PACKET = 16 + _STATIC_HEADER_LEN
+
+
+class Discv5WireError(Exception):
+    pass
+
+
+# ---------------------------------------------------------------- AES
+
+
+def _aes_ctr(key16: bytes, iv16: bytes, data: bytes) -> bytes:
+    enc = Cipher(algorithms.AES(key16), modes.CTR(iv16)).encryptor()
+    return enc.update(data) + enc.finalize()
+
+
+def aes_gcm_encrypt(key16: bytes, nonce12: bytes, pt: bytes, ad: bytes) -> bytes:
+    return AESGCM(key16).encrypt(nonce12, pt, ad)
+
+
+def aes_gcm_decrypt(key16: bytes, nonce12: bytes, ct: bytes, ad: bytes) -> bytes:
+    from cryptography.exceptions import InvalidTag
+
+    try:
+        return AESGCM(key16).decrypt(nonce12, ct, ad)
+    except InvalidTag:
+        raise Discv5WireError("gcm auth failure") from None
+
+
+# ------------------------------------------------------------- key schedule
+
+
+def ecdh(pubkey33: bytes, private: bytes) -> bytes:
+    """discv5 ECDH: compressed encoding of priv * pub."""
+    point = secp256k1.decompress(pubkey33)
+    x, y = secp256k1._mul(int.from_bytes(private, "big"), point)
+    return bytes([2 + (y & 1)]) + x.to_bytes(32, "big")
+
+
+def _hkdf(salt: bytes, ikm: bytes, info: bytes, n: int) -> bytes:
+    prk = hmac_mod.new(salt, ikm, hashlib.sha256).digest()
+    out = b""
+    t = b""
+    i = 1
+    while len(out) < n:
+        t = hmac_mod.new(prk, t + info + bytes([i]), hashlib.sha256).digest()
+        out += t
+        i += 1
+    return out[:n]
+
+
+def derive_session_keys(
+    secret: bytes, src_id: bytes, dest_id: bytes, challenge_data: bytes
+) -> Tuple[bytes, bytes]:
+    """(initiator_key, recipient_key)."""
+    info = KDF_INFO_TEXT + src_id + dest_id
+    okm = _hkdf(challenge_data, secret, info, 32)
+    return okm[:16], okm[16:]
+
+
+def id_sign(
+    private: bytes, challenge_data: bytes, eph_pubkey: bytes, dest_id: bytes
+) -> bytes:
+    digest = hashlib.sha256(
+        ID_SIGNATURE_TEXT + challenge_data + eph_pubkey + dest_id
+    ).digest()
+    return secp256k1.sign(digest, private)
+
+
+def id_verify(
+    pubkey33: bytes,
+    sig64: bytes,
+    challenge_data: bytes,
+    eph_pubkey: bytes,
+    dest_id: bytes,
+) -> bool:
+    digest = hashlib.sha256(
+        ID_SIGNATURE_TEXT + challenge_data + eph_pubkey + dest_id
+    ).digest()
+    try:
+        point = secp256k1.decompress(pubkey33)
+    except ValueError:
+        return False
+    return secp256k1.verify(digest, sig64, point)
+
+
+# ----------------------------------------------------------------- packets
+
+
+@dataclass
+class Packet:
+    flag: int
+    nonce: bytes                      # 12B; WHOAREYOU: request nonce
+    authdata: bytes
+    message_ct: bytes = b""           # encrypted message (not WHOAREYOU)
+    masking_iv: bytes = b""
+    header: bytes = b""               # unmasked header bytes (for ad)
+
+    @property
+    def src_id(self) -> bytes:
+        """For ORDINARY/HANDSHAKE packets: the 32-byte source node id."""
+        if self.flag == FLAG_WHOAREYOU:
+            raise Discv5WireError("whoareyou has no src id")
+        return self.authdata[:32]
+
+
+def encode_packet(
+    dest_id: bytes,
+    flag: int,
+    nonce: bytes,
+    authdata: bytes,
+    message_ct: bytes = b"",
+    masking_iv: bytes = None,
+) -> bytes:
+    if masking_iv is None:
+        masking_iv = os.urandom(16)
+    header = (
+        PROTOCOL_ID
+        + struct.pack(">H", VERSION)
+        + bytes([flag])
+        + nonce
+        + struct.pack(">H", len(authdata))
+        + authdata
+    )
+    masked = _aes_ctr(dest_id[:16], masking_iv, header)
+    return masking_iv + masked + message_ct
+
+
+def decode_packet(local_id: bytes, data: bytes) -> Packet:
+    """Unmask with OUR node id (packets not addressed to us fail the
+    protocol-id check — the spec's addressing mechanism)."""
+    if len(data) < _MIN_PACKET:
+        raise Discv5WireError("short packet")
+    masking_iv = data[:16]
+    dec = Cipher(
+        algorithms.AES(local_id[:16]), modes.CTR(masking_iv)
+    ).decryptor()
+    static = dec.update(data[16 : 16 + _STATIC_HEADER_LEN])
+    if static[:6] != PROTOCOL_ID:
+        raise Discv5WireError("bad protocol id (not addressed to us?)")
+    version = struct.unpack(">H", static[6:8])[0]
+    if version != VERSION:
+        raise Discv5WireError(f"bad version {version}")
+    flag = static[8]
+    nonce = static[9:21]
+    (authdata_size,) = struct.unpack(">H", static[21:23])
+    end = 16 + _STATIC_HEADER_LEN + authdata_size
+    if len(data) < end:
+        raise Discv5WireError("truncated authdata")
+    authdata = dec.update(data[16 + _STATIC_HEADER_LEN : end])
+    header = static + authdata
+    return Packet(
+        flag=flag,
+        nonce=nonce,
+        authdata=authdata,
+        message_ct=data[end:],
+        masking_iv=masking_iv,
+        header=header,
+    )
+
+
+def whoareyou_authdata(id_nonce: bytes, enr_seq: int) -> bytes:
+    return id_nonce + struct.pack(">Q", enr_seq)
+
+
+def handshake_authdata(
+    src_id: bytes, id_signature: bytes, eph_pubkey: bytes, record: bytes = b""
+) -> bytes:
+    return (
+        src_id
+        + bytes([len(id_signature), len(eph_pubkey)])
+        + id_signature
+        + eph_pubkey
+        + record
+    )
+
+
+def parse_handshake_authdata(authdata: bytes) -> Tuple[bytes, bytes, bytes, bytes]:
+    """(src_id, id_signature, eph_pubkey, record_rlp)."""
+    if len(authdata) < 34:
+        raise Discv5WireError("short handshake authdata")
+    src_id = authdata[:32]
+    sig_size, key_size = authdata[32], authdata[33]
+    need = 34 + sig_size + key_size
+    if len(authdata) < need:
+        raise Discv5WireError("truncated handshake authdata")
+    sig = authdata[34 : 34 + sig_size]
+    eph = authdata[34 + sig_size : need]
+    return src_id, sig, eph, authdata[need:]
+
+
+# ---------------------------------------------------------------- messages
+
+
+def _rlp_int_field(item: bytes) -> int:
+    return int.from_bytes(item, "big") if item else 0
+
+
+def encode_ping(req_id: bytes, enr_seq: int) -> bytes:
+    return bytes([MSG_PING]) + rlp_list(
+        [rlp_bytes(req_id), rlp_int(enr_seq)]
+    )
+
+
+def encode_pong(req_id: bytes, enr_seq: int, ip: bytes, port: int) -> bytes:
+    return bytes([MSG_PONG]) + rlp_list(
+        [rlp_bytes(req_id), rlp_int(enr_seq), rlp_bytes(ip), rlp_int(port)]
+    )
+
+
+def encode_findnode(req_id: bytes, distances: List[int]) -> bytes:
+    return bytes([MSG_FINDNODE]) + rlp_list(
+        [
+            rlp_bytes(req_id),
+            rlp_list([rlp_int(d) for d in distances]),
+        ]
+    )
+
+
+def encode_nodes(req_id: bytes, total: int, records: List[bytes]) -> bytes:
+    return bytes([MSG_NODES]) + rlp_list(
+        [
+            rlp_bytes(req_id),
+            rlp_int(total),
+            rlp_list(list(records)),  # records are already RLP lists
+        ]
+    )
+
+
+def encode_talkreq(req_id: bytes, protocol: bytes, request: bytes) -> bytes:
+    return bytes([MSG_TALKREQ]) + rlp_list(
+        [rlp_bytes(req_id), rlp_bytes(protocol), rlp_bytes(request)]
+    )
+
+
+def encode_talkresp(req_id: bytes, response: bytes) -> bytes:
+    return bytes([MSG_TALKRESP]) + rlp_list(
+        [rlp_bytes(req_id), rlp_bytes(response)]
+    )
+
+
+@dataclass
+class Message:
+    kind: int
+    req_id: bytes
+    enr_seq: int = 0
+    ip: bytes = b""
+    port: int = 0
+    distances: List[int] = field(default_factory=list)
+    total: int = 0
+    records: List[Enr] = field(default_factory=list)
+    protocol: bytes = b""
+    payload: bytes = b""
+
+
+def decode_message(data: bytes) -> Message:
+    if not data:
+        raise Discv5WireError("empty message")
+    kind = data[0]
+    try:
+        items, _ = _rlp_decode(data, 1)
+    except Exception as e:
+        raise Discv5WireError(f"bad message rlp: {e}") from None
+    if not isinstance(items, list) or not items:
+        raise Discv5WireError("message body not a list")
+    req_id = items[0] if isinstance(items[0], bytes) else b""
+    if len(req_id) > 8:
+        raise Discv5WireError("req-id too long")
+    msg = Message(kind=kind, req_id=req_id)
+    if kind == MSG_PING:
+        msg.enr_seq = _rlp_int_field(items[1])
+    elif kind == MSG_PONG:
+        msg.enr_seq = _rlp_int_field(items[1])
+        msg.ip = items[2]
+        msg.port = _rlp_int_field(items[3])
+    elif kind == MSG_FINDNODE:
+        msg.distances = [_rlp_int_field(d) for d in items[1]]
+    elif kind == MSG_NODES:
+        msg.total = _rlp_int_field(items[1])
+        for rec in items[2]:
+            if isinstance(rec, list):
+                # re-decode from the re-encoded sublist: Enr.decode
+                # wants raw RLP; reconstruct it
+                msg.records.append(Enr.decode(_reencode_rlp(rec)))
+    elif kind == MSG_TALKREQ:
+        msg.protocol = items[1]
+        msg.payload = items[2]
+    elif kind == MSG_TALKRESP:
+        msg.payload = items[1]
+    else:
+        raise Discv5WireError(f"unknown message type {kind}")
+    return msg
+
+
+def _reencode_rlp(item) -> bytes:
+    if isinstance(item, (bytes, bytearray)):
+        return rlp_bytes(bytes(item))
+    return rlp_list([_reencode_rlp(i) for i in item])
+
+
+def node_distance(a: bytes, b: bytes) -> int:
+    """log2 xor distance (0 = same id), the FINDNODE bucket metric."""
+    x = int.from_bytes(a, "big") ^ int.from_bytes(b, "big")
+    return x.bit_length()
+
+
+# ------------------------------------------------------------- sessions
+
+
+@dataclass
+class Session:
+    """Established AES-GCM keys for one peer (directional)."""
+
+    send_key: bytes
+    recv_key: bytes
+    counter: int = 0
+
+    def next_nonce(self) -> bytes:
+        """96-bit nonce: 32-bit counter || 64 random bits (spec allows
+        any unique construction)."""
+        self.counter += 1
+        return struct.pack(">I", self.counter) + os.urandom(8)
+
+
+class HandshakeState:
+    """Per-peer handshake bookkeeping for Discv5Node (one in flight)."""
+
+    def __init__(self):
+        self.sent_whoareyou: Optional[bytes] = None  # challenge-data
+        self.pending: List[Tuple[bytes, bytes]] = []  # queued (nonce, msg-pt)
+        self.remote_enr: Optional[Enr] = None

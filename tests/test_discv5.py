@@ -1,0 +1,217 @@
+"""discv5 v5.1 wire protocol: packet masking, key schedule, handshake,
+and a live two-node UDP exchange (VERDICT r3 missing #1's discovery
+leg; reference: sigp/discv5 driven by discovery/mod.rs)."""
+
+import os
+import socket
+import struct
+import time
+
+import pytest
+
+from lighthouse_tpu.crypto import secp256k1
+from lighthouse_tpu.network import discv5_wire as W
+from lighthouse_tpu.network.discv5 import Discv5Node
+from lighthouse_tpu.network.enr import Enr
+
+
+# --------------------------------------------------------------- packets
+
+
+def test_packet_mask_roundtrip():
+    dest_id = bytes(range(32))
+    nonce = bytes(12)
+    pkt = W.encode_packet(dest_id, W.FLAG_ORDINARY, nonce, b"\xaa" * 32, b"ct")
+    dec = W.decode_packet(dest_id, pkt)
+    assert dec.flag == W.FLAG_ORDINARY
+    assert dec.nonce == nonce
+    assert dec.authdata == b"\xaa" * 32
+    assert dec.message_ct == b"ct"
+    assert dec.src_id == b"\xaa" * 32
+
+
+def test_packet_not_addressed_to_us_fails():
+    dest_id = bytes(range(32))
+    other_id = bytes(reversed(range(32)))
+    pkt = W.encode_packet(dest_id, W.FLAG_ORDINARY, bytes(12), b"\xaa" * 32)
+    with pytest.raises(W.Discv5WireError):
+        W.decode_packet(other_id, pkt)
+
+
+def test_whoareyou_authdata_layout():
+    ad = W.whoareyou_authdata(b"\x01" * 16, 7)
+    assert ad == b"\x01" * 16 + struct.pack(">Q", 7)
+
+
+def test_handshake_authdata_roundtrip():
+    src = b"\x02" * 32
+    sig = b"\x03" * 64
+    eph = b"\x04" * 33
+    rec = b"\x05" * 10
+    src2, sig2, eph2, rec2 = W.parse_handshake_authdata(
+        W.handshake_authdata(src, sig, eph, rec)
+    )
+    assert (src2, sig2, eph2, rec2) == (src, sig, eph, rec)
+
+
+# ------------------------------------------------------------ key schedule
+
+
+def test_ecdh_symmetry_and_key_derivation():
+    a_priv, b_priv = os.urandom(32), os.urandom(32)
+    a_pub = secp256k1.pubkey_compressed(a_priv)
+    b_pub = secp256k1.pubkey_compressed(b_priv)
+    assert W.ecdh(b_pub, a_priv) == W.ecdh(a_pub, b_priv)
+    secret = W.ecdh(b_pub, a_priv)
+    cd = os.urandom(63)
+    k1 = W.derive_session_keys(secret, b"\x0a" * 32, b"\x0b" * 32, cd)
+    k2 = W.derive_session_keys(secret, b"\x0a" * 32, b"\x0b" * 32, cd)
+    assert k1 == k2 and k1[0] != k1[1] and len(k1[0]) == 16
+
+
+def test_id_signature_verifies_and_binds_inputs():
+    priv = os.urandom(32)
+    pub = secp256k1.pubkey_compressed(priv)
+    cd, eph, dest = os.urandom(63), os.urandom(33), os.urandom(32)
+    sig = W.id_sign(priv, cd, eph, dest)
+    assert W.id_verify(pub, sig, cd, eph, dest)
+    assert not W.id_verify(pub, sig, cd, eph, os.urandom(32))
+    assert not W.id_verify(pub, sig, os.urandom(63), eph, dest)
+
+
+def test_gcm_ad_binds_header():
+    key, nonce = os.urandom(16), os.urandom(12)
+    ct = W.aes_gcm_encrypt(key, nonce, b"msg", b"ad")
+    assert W.aes_gcm_decrypt(key, nonce, ct, b"ad") == b"msg"
+    with pytest.raises(W.Discv5WireError):
+        W.aes_gcm_decrypt(key, nonce, ct, b"other-ad")
+
+
+# --------------------------------------------------------------- messages
+
+
+def test_message_codecs_roundtrip():
+    ping = W.decode_message(W.encode_ping(b"\x01\x02", 9))
+    assert (ping.kind, ping.req_id, ping.enr_seq) == (W.MSG_PING, b"\x01\x02", 9)
+    pong = W.decode_message(
+        W.encode_pong(b"\x01", 3, socket.inet_aton("127.0.0.1"), 9000)
+    )
+    assert (pong.enr_seq, pong.ip, pong.port) == (
+        3, socket.inet_aton("127.0.0.1"), 9000,
+    )
+    fn = W.decode_message(W.encode_findnode(b"\x09", [0, 255, 256]))
+    assert fn.distances == [0, 255, 256]
+    enr = Enr.build(os.urandom(32), ip=socket.inet_aton("10.0.0.1"), udp=30303)
+    nodes = W.decode_message(W.encode_nodes(b"\x07", 1, [enr.encode()]))
+    assert nodes.total == 1
+    assert len(nodes.records) == 1
+    assert nodes.records[0].node_id() == enr.node_id()
+    assert nodes.records[0].verify()
+
+
+def test_node_distance_metric():
+    a = bytes(32)
+    assert W.node_distance(a, a) == 0
+    b = bytes(31) + b"\x01"
+    assert W.node_distance(a, b) == 1
+    c = b"\x80" + bytes(31)
+    assert W.node_distance(a, c) == 256
+
+
+# ----------------------------------------------------------- live UDP nodes
+
+
+@pytest.fixture
+def nodes():
+    a = Discv5Node()
+    b = Discv5Node()
+    yield a, b
+    a.close()
+    b.close()
+
+
+def test_udp_handshake_and_ping(nodes):
+    a, b = nodes
+    pong = a.ping(b.enr, timeout=8)
+    assert pong is not None
+    assert pong.kind == W.MSG_PONG
+    assert pong.enr_seq == b.enr.seq
+    assert pong.port == a.addr[1]  # PONG echoes our observed endpoint
+    # sessions established both ways: b can now reach a directly
+    pong2 = b.ping(a.enr, timeout=8)
+    assert pong2 is not None and pong2.enr_seq == a.enr.seq
+
+
+def test_udp_findnode_returns_signed_enrs(nodes):
+    a, b = nodes
+    # give b a populated table
+    extras = [
+        Enr.build(os.urandom(32), ip=socket.inet_aton("127.0.0.1"), udp=40000 + i)
+        for i in range(6)
+    ]
+    for e in extras:
+        assert b.add_enr(e)
+    dists = sorted(
+        {W.node_distance(b.node_id, e.node_id()) for e in extras}
+    )
+    found = a.find_node(b.enr, dists, timeout=8)
+    # all six extras come back (b may also legitimately return a's own
+    # record, learned in the handshake, if its distance collides)
+    assert {e.node_id() for e in extras} <= {e.node_id() for e in found}
+    # and they were ingested into a's table
+    assert len(a.known_enrs()) >= 7  # b + 6 extras
+
+
+def test_udp_findnode_distance_zero_returns_self(nodes):
+    a, b = nodes
+    found = a.find_node(b.enr, [0], timeout=8)
+    assert any(e.node_id() == b.node_id for e in found)
+
+
+def test_tampered_handshake_rejected(nodes):
+    """A handshake whose id-signature does not verify must not create
+    a session: impersonating node b's ENR without its key fails."""
+    a, b = nodes
+    mallory_priv = os.urandom(32)
+    # mallory claims b's node id by sending b's ENR but signing with
+    # her own key; a must refuse the handshake (no PONG session)
+    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    sock.bind(("127.0.0.1", 0))
+    sock.settimeout(1.0)
+    a.add_enr(b.enr)
+    # random packet to a claiming to be b
+    nonce = os.urandom(12)
+    pkt = W.encode_packet(
+        a.node_id, W.FLAG_ORDINARY, nonce, b.node_id, os.urandom(16)
+    )
+    sock.sendto(pkt, a.addr)
+    data, _ = sock.recvfrom(2048)
+    way = W.decode_packet(b.node_id, data)
+    assert way.flag == W.FLAG_WHOAREYOU
+    # forge the handshake with mallory's key
+    challenge_data = way.masking_iv + way.header
+    eph_priv = os.urandom(32)
+    eph_pub = secp256k1.pubkey_compressed(eph_priv)
+    sig = W.id_sign(mallory_priv, challenge_data, eph_pub, a.node_id)
+    secret = W.ecdh(a.enr.pairs[b"secp256k1"], eph_priv)
+    ini, rec = W.derive_session_keys(
+        secret, b.node_id, a.node_id, challenge_data
+    )
+    authdata = W.handshake_authdata(b.node_id, sig, eph_pub)
+    hnonce = os.urandom(12)
+    masking_iv = os.urandom(16)
+    header = (
+        W.PROTOCOL_ID + struct.pack(">H", W.VERSION) + bytes([W.FLAG_HANDSHAKE])
+        + hnonce + struct.pack(">H", len(authdata)) + authdata
+    )
+    ct = W.aes_gcm_encrypt(ini, hnonce, W.encode_ping(b"\x01", 1), masking_iv + header)
+    sock.sendto(
+        W.encode_packet(
+            a.node_id, W.FLAG_HANDSHAKE, hnonce, authdata, ct, masking_iv
+        ),
+        a.addr,
+    )
+    # a must NOT answer (signature binds b's id to b's key)
+    with pytest.raises(socket.timeout):
+        sock.recvfrom(2048)
+    sock.close()
