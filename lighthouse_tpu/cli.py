@@ -735,7 +735,7 @@ def cmd_boot_node(args) -> int:
         import socket as _socket
 
         from .network.discv5 import Discv5Node
-        from .network.enr import Enr, EnrError
+        from .network.enr import Enr
 
         node = Discv5Node(
             host=args.listen_address,
@@ -746,7 +746,7 @@ def cmd_boot_node(args) -> int:
         for text in args.enr:
             try:
                 seeded += bool(node.add_enr(Enr.from_text(text)))
-            except EnrError as e:
+            except Exception as e:  # EnrError, binascii.Error, ...
                 print(f"rejected --enr record: {e}", file=sys.stderr)
                 node.close()
                 return 2

@@ -78,8 +78,15 @@ class Discv5Node:
         self._table: Dict[bytes, Enr] = {}          # node_id -> ENR
         self._sessions: Dict[bytes, W.Session] = {}  # node_id -> keys
         self._addrs: Dict[bytes, tuple] = {}         # node_id -> udp addr
-        # our outbound packets awaiting WHOAREYOU: nonce -> (node_id, msg)
-        self._pending_hs: Dict[bytes, tuple] = {}
+        # outbound nonces that may be challenged: nonce -> node_id
+        # (session sends register too — a restarted peer WHOAREYOUs an
+        # encrypted packet and we must re-handshake, not go deaf)
+        self._sent_nonces: Dict[bytes, bytes] = {}
+        # messages waiting for a handshake to finish: node_id -> [msg]
+        # (ONE handshake per peer at a time; concurrent requests queue
+        # here instead of racing the challenge)
+        self._pending_msgs: Dict[bytes, list] = {}
+        self._pending_ts: Dict[bytes, float] = {}
         # challenges we issued: node_id -> challenge-data
         self._challenges: Dict[bytes, bytes] = {}
         # request/response correlation: req_id -> [reply Messages]
@@ -182,10 +189,19 @@ class Discv5Node:
         if addr is None:
             raise Discv5Error("no address for node")
         if session is None:
-            # no session: random packet to elicit WHOAREYOU
-            nonce = os.urandom(12)
             with self._lock:
-                _bounded_put(self._pending_hs, nonce, (nid, message_pt))
+                queue = self._pending_msgs.setdefault(nid, [])
+                queue.append(message_pt)
+                now = time.time()
+                fresh = now - self._pending_ts.get(nid, 0) < REQUEST_TIMEOUT
+                if len(queue) > 1 and fresh:
+                    return  # a handshake is already in flight
+                # elicit a WHOAREYOU (first message, or the previous
+                # random packet looks lost); message rides
+                # _pending_msgs, hence the None
+                self._pending_ts[nid] = now
+                nonce = os.urandom(12)
+                _bounded_put(self._sent_nonces, nonce, (nid, None))
             pkt = W.encode_packet(
                 nid, W.FLAG_ORDINARY, nonce, self.node_id, os.urandom(16)
             )
@@ -193,25 +209,18 @@ class Discv5Node:
             return
         nonce = session.next_nonce()
         masking_iv = os.urandom(16)
-        header = self._header_bytes(W.FLAG_ORDINARY, nonce, self.node_id)
+        header = W.build_header(W.FLAG_ORDINARY, nonce, self.node_id)
         ct = W.aes_gcm_encrypt(
             session.send_key, nonce, message_pt, masking_iv + header
         )
         pkt = W.encode_packet(
             nid, W.FLAG_ORDINARY, nonce, self.node_id, ct, masking_iv
         )
+        with self._lock:
+            # a restarted peer may challenge this nonce: remember it so
+            # the WHOAREYOU triggers a re-handshake with this message
+            _bounded_put(self._sent_nonces, nonce, (nid, message_pt))
         self.sock.sendto(pkt, addr)
-
-    @staticmethod
-    def _header_bytes(flag: int, nonce: bytes, authdata: bytes) -> bytes:
-        return (
-            W.PROTOCOL_ID
-            + struct.pack(">H", W.VERSION)
-            + bytes([flag])
-            + nonce
-            + struct.pack(">H", len(authdata))
-            + authdata
-        )
 
     # -------------------------------------------------------- receiving
 
@@ -259,6 +268,11 @@ class Discv5Node:
             # their side -> re-challenge
             self._send_whoareyou(pkt, nid, addr)
             return
+        with self._lock:
+            # authenticated packet: track NAT rebinds, else replies go
+            # to the stale endpoint forever
+            if self._addrs.get(nid) != addr:
+                _bounded_put(self._addrs, nid, addr)
         self._on_message(nid, addr, W.decode_message(pt))
 
     def _send_whoareyou(self, pkt: W.Packet, nid: bytes, addr) -> None:
@@ -271,7 +285,7 @@ class Discv5Node:
         masking_iv = os.urandom(16)
         challenge_data = (
             masking_iv
-            + self._header_bytes(W.FLAG_WHOAREYOU, pkt.nonce, authdata)
+            + W.build_header(W.FLAG_WHOAREYOU, pkt.nonce, authdata)
         )
         with self._lock:
             _bounded_put(self._challenges, nid, challenge_data)
@@ -281,18 +295,25 @@ class Discv5Node:
         self.sock.sendto(out, addr)
 
     def _on_whoareyou(self, pkt: W.Packet, addr) -> None:
-        """Our earlier packet (nonce) was challenged: run the handshake
-        and resend the pending message under the new keys."""
+        """One of our packets was challenged: handshake and (re)send
+        the pending message(s) under the fresh keys. Covers both the
+        deliberate no-session random packet and a session packet a
+        restarted peer could no longer decrypt."""
         if len(pkt.authdata) != 24:
             return  # id-nonce(16) || enr-seq(8), nothing else is valid
         with self._lock:
-            pending = self._pending_hs.pop(pkt.nonce, None)
-        if pending is None:
+            entry = self._sent_nonces.pop(pkt.nonce, None)
+        if entry is None:
             return
-        nid, message_pt = pending
+        nid, challenged_msg = entry
         with self._lock:
+            self._sessions.pop(nid, None)  # stale either way
             remote = self._table.get(nid)
-        if remote is None:
+            queue = self._pending_msgs.pop(nid, [])
+            self._pending_ts.pop(nid, None)
+        if challenged_msg is not None:
+            queue.insert(0, challenged_msg)
+        if remote is None or not queue:
             return
         remote_pub = remote.pairs.get(b"secp256k1")
         if remote_pub is None:
@@ -312,8 +333,10 @@ class Discv5Node:
         session = W.Session(send_key=ini_key, recv_key=rec_key)
         nonce = session.next_nonce()
         masking_iv = os.urandom(16)
-        header = self._header_bytes(W.FLAG_HANDSHAKE, nonce, authdata)
-        ct = W.aes_gcm_encrypt(ini_key, nonce, message_pt, masking_iv + header)
+        header = W.build_header(W.FLAG_HANDSHAKE, nonce, authdata)
+        ct = W.aes_gcm_encrypt(
+            ini_key, nonce, queue[0], masking_iv + header
+        )
         out = W.encode_packet(
             nid, W.FLAG_HANDSHAKE, nonce, authdata, ct, masking_iv
         )
@@ -321,6 +344,9 @@ class Discv5Node:
             self._sessions[nid] = session
             self._addrs[nid] = addr
         self.sock.sendto(out, addr)
+        # any requests queued behind the handshake ride the session
+        for msg in queue[1:]:
+            self._send_message(nid, msg)
 
     def _on_handshake(self, pkt: W.Packet, addr) -> None:
         src_id, sig, eph_pub, record_rlp = W.parse_handshake_authdata(

@@ -178,6 +178,19 @@ class Packet:
         return self.authdata[:32]
 
 
+def build_header(flag: int, nonce: bytes, authdata: bytes) -> bytes:
+    """The unmasked header bytes — ALSO the GCM associated data (with
+    the masking-iv prepended), so there is exactly one construction."""
+    return (
+        PROTOCOL_ID
+        + struct.pack(">H", VERSION)
+        + bytes([flag])
+        + nonce
+        + struct.pack(">H", len(authdata))
+        + authdata
+    )
+
+
 def encode_packet(
     dest_id: bytes,
     flag: int,
@@ -188,14 +201,7 @@ def encode_packet(
 ) -> bytes:
     if masking_iv is None:
         masking_iv = os.urandom(16)
-    header = (
-        PROTOCOL_ID
-        + struct.pack(">H", VERSION)
-        + bytes([flag])
-        + nonce
-        + struct.pack(">H", len(authdata))
-        + authdata
-    )
+    header = build_header(flag, nonce, authdata)
     masked = _aes_ctr(dest_id[:16], masking_iv, header)
     return masking_iv + masked + message_ct
 
@@ -354,8 +360,12 @@ def decode_message(data: bytes) -> Message:
         for rec in items[2]:
             if isinstance(rec, list):
                 # re-decode from the re-encoded sublist: Enr.decode
-                # wants raw RLP; reconstruct it
-                msg.records.append(Enr.decode(_reencode_rlp(rec)))
+                # wants raw RLP; reconstruct it. One stale/invalid
+                # record must not discard the reply's valid records.
+                try:
+                    msg.records.append(Enr.decode(_reencode_rlp(rec)))
+                except Exception:
+                    continue
     elif kind == MSG_TALKREQ:
         msg.protocol = items[1]
         msg.payload = items[2]

@@ -168,6 +168,19 @@ def test_udp_findnode_distance_zero_returns_self(nodes):
     assert any(e.node_id() == b.node_id for e in found)
 
 
+def test_restarted_peer_rehandshakes(nodes):
+    """A peer that lost its session state (restart) WHOAREYOUs our
+    encrypted packet; we must drop the stale keys and re-handshake
+    instead of going deaf (code-review r4)."""
+    a, b = nodes
+    assert a.ping(b.enr, timeout=8) is not None
+    # simulate b restarting: wipe its sessions (keys gone)
+    with b._lock:
+        b._sessions.clear()
+    pong = a.ping(b.enr, timeout=8)
+    assert pong is not None and pong.kind == W.MSG_PONG
+
+
 def test_tampered_handshake_rejected(nodes):
     """A handshake whose id-signature does not verify must not create
     a session: impersonating node b's ENR without its key fails."""
