@@ -1615,6 +1615,11 @@ class BeaconChain:
                 self.agg_pool, state, parent_root
             )
             local_payload = st.mock_execution_payload(self.spec, state)
+            # prepare_beacon_proposer recordings (REST) override the
+            # default; an explicit caller argument wins over both
+            prepared = getattr(self, "fee_recipients", {}).get(proposer)
+            if fee_recipient == b"\x00" * 20 and prepared is not None:
+                fee_recipient = prepared
             local_payload.fee_recipient = bytes(fee_recipient)
             body.execution_payload = local_payload
             block = T.BeaconBlock.make(

@@ -31,6 +31,19 @@ Routes (round 4 widened the surface toward lib.rs's full table):
   POST /eth/v1/beacon/pool/attestations
   POST /eth/v1/beacon/blocks
   GET  /metrics                                       (prometheus text)
+Round 4b additions:
+  GET  /eth/v1/beacon/states/{id}/fork | sync_committees
+  GET  /eth/v1/config/fork_schedule
+  GET  /eth/v1/beacon/blob_sidecars/{block_id}
+  GET  /eth/v1/beacon/headers[?slot=]                 (list form)
+  GET  /eth/v1/node/peer_count
+  GET  /eth/v2/debug/beacon/heads
+  GET  /eth/v1/validator/attestation_data?slot=&committee_index=
+  GET  /eth/v1/validator/aggregate_attestation?slot=&attestation_data_root=
+  POST /eth/v1/validator/{aggregate_and_proofs|prepare_beacon_proposer|
+         register_validator|beacon_committee_subscriptions}
+  POST /eth/v1/beacon/pool/{voluntary_exits|attester_slashings|
+         proposer_slashings|bls_to_execution_changes}
 
 SSZ content negotiation (Accept: application/octet-stream) on block and
 debug-state gets; the state bytes are the FORK-EXACT encoding via
@@ -588,6 +601,217 @@ class BeaconApi:
         self.chain.process_block(signed)
         return 200, {}
 
+    # -------------------------------------------- round-4b surface
+    # (http_api/src/lib.rs routes beyond the round-4 set)
+
+    def state_fork(self, state_id: str):
+        state = self._head_state(state_id)
+        f = state.fork
+        return 200, {
+            "data": {
+                "previous_version": "0x" + bytes(f.previous_version).hex(),
+                "current_version": "0x" + bytes(f.current_version).hex(),
+                "epoch": str(f.epoch),
+            }
+        }
+
+    def fork_schedule(self):
+        from ..consensus.spec import FAR_FUTURE_EPOCH, FORK_ORDER
+
+        spec = self.chain.spec
+        out = []
+        prev = spec.fork_versions[FORK_ORDER[0]]
+        for name in FORK_ORDER:
+            epoch = spec.fork_epochs.get(name, FAR_FUTURE_EPOCH)
+            if epoch == FAR_FUTURE_EPOCH and name != FORK_ORDER[0]:
+                continue
+            cur = spec.fork_versions[name]
+            out.append({
+                "previous_version": "0x" + bytes(prev).hex(),
+                "current_version": "0x" + bytes(cur).hex(),
+                "epoch": str(epoch),
+            })
+            prev = cur
+        return 200, {"data": out}
+
+    def blob_sidecars(self, block_id: str):
+        """GET /eth/v1/beacon/blob_sidecars/{block_id} (lib.rs
+        blob_sidecars route; sidecars come from the DA store)."""
+        root = self._resolve_block_root(block_id)
+        sidecars = self.chain.store.get_blobs(root)
+        return 200, {
+            "data": [_lc_json(sc) for sc in (sidecars or [])]
+        }
+
+    def headers_list(self, query: dict):
+        """GET /eth/v1/beacon/headers?slot=N — canonical header at the
+        slot (default: head), list-shaped per spec."""
+        block_id = query.get("slot") or "head"
+        try:
+            _, payload = self.header(block_id)
+        except ApiError as e:
+            if e.code == 404:
+                return 200, {"data": []}  # empty slot, per spec
+            raise  # malformed input stays a 400
+        return 200, {"data": [payload["data"]]}
+
+    def peer_count(self):
+        # PeerManager lives on the NetworkService behind the sync
+        # manager (network/service.py); no network = zero peers
+        service = getattr(self.sync, "service", None)
+        peers = service.peers.connected() if service is not None else []
+        return 200, {
+            "data": {
+                "connected": str(len(peers)),
+                "connecting": "0",
+                "disconnected": "0",
+                "disconnecting": "0",
+            }
+        }
+
+    def debug_heads(self):
+        """GET /eth/v2/debug/beacon/heads — proto-array leaves."""
+        from ..consensus.proto_array import ExecutionStatus
+
+        pa = self.chain.fork_choice.proto
+        parents = {n.parent for n in pa.nodes if n.parent is not None}
+        heads = [
+            {
+                "root": "0x" + n.root.hex(),
+                "slot": str(n.slot),
+                "execution_optimistic": n.execution_status
+                == ExecutionStatus.OPTIMISTIC,
+            }
+            for i, n in enumerate(pa.nodes)
+            if i not in parents
+        ]
+        return 200, {"data": heads}
+
+    def sync_committees_state(self, state_id: str):
+        """GET states/{id}/sync_committees — indices resolved through
+        the pubkey cache (sync_committee.rs role)."""
+        state = self._head_state(state_id)
+        try:
+            pubkeys = list(state.current_sync_committee.pubkeys)
+        except AttributeError:
+            raise ApiError(404, "no sync committee (pre-altair state)")
+        indices = []
+        for pk in pubkeys:
+            idx = self.chain.pubkey_cache.get_index(bytes(pk))
+            if idx is None:
+                # state/cache skew must surface, not silently report
+                # validator 0 as a committee member
+                raise ApiError(500, "sync-committee pubkey not in cache")
+            indices.append(idx)
+        per_sub = max(1, len(indices) // 4)
+        return 200, {
+            "data": {
+                "validators": [str(i) for i in indices],
+                "validator_aggregates": [
+                    [str(i) for i in indices[k : k + per_sub]]
+                    for k in range(0, len(indices), per_sub)
+                ],
+            }
+        }
+
+    def attestation_data(self, query: dict):
+        """GET /eth/v1/validator/attestation_data?slot=&committee_index=."""
+        try:
+            slot = int(query["slot"])
+            index = int(query.get("committee_index", "0"))
+        except (KeyError, ValueError):
+            raise ApiError(400, "slot and committee_index required")
+        # cap the process_slots replay a request can demand of a
+        # handler thread (same posture as proposer_duties)
+        if not 0 <= slot <= self.chain.current_slot + 1:
+            raise ApiError(400, f"slot {slot} outside the served window")
+        from ..validator.client import InProcessBeaconNode
+
+        data = InProcessBeaconNode(self.chain).attestation_data(slot, index)
+        return 200, {"data": _attestation_data_json(data)}
+
+    def aggregate_attestation(self, query: dict):
+        """GET /eth/v1/validator/aggregate_attestation
+        ?attestation_data_root=&slot= — served from the naive
+        aggregation pool."""
+        try:
+            slot = int(query["slot"])
+            root_hex = query["attestation_data_root"].removeprefix("0x")
+            root = bytes.fromhex(root_hex)
+        except (KeyError, ValueError):
+            raise ApiError(400, "slot and attestation_data_root required")
+        if len(root) != 32:
+            raise ApiError(400, "attestation_data_root must be 32 bytes")
+        for agg in self.chain.agg_pool.aggregates_for_slot(slot):
+            if agg.data.hash_tree_root() == root:
+                return 200, {"data": _attestation_json(agg)}
+        raise ApiError(404, "no matching aggregate")
+
+    def publish_aggregates(self, body: bytes):
+        """POST /eth/v1/validator/aggregate_and_proofs (SSZ body, one
+        SignedAggregateAndProof)."""
+        signed = T.SignedAggregateAndProof.deserialize(body)
+        self.chain.verify_aggregate_for_gossip(signed)
+        return 200, {}
+
+    def prepare_proposer(self, body: bytes):
+        """POST /eth/v1/validator/prepare_beacon_proposer — record fee
+        recipients (execution layer picks them up at payload build)."""
+        entries = json.loads(body)
+        if not isinstance(entries, list):
+            raise ApiError(400, "expected a list")
+        store = getattr(self.chain, "fee_recipients", None)
+        if store is None:
+            store = self.chain.fee_recipients = {}
+        for e in entries:
+            addr = bytes.fromhex(e["fee_recipient"].removeprefix("0x"))
+            if len(addr) != 20:
+                raise ApiError(400, "fee_recipient must be 20 bytes")
+            store[int(e["validator_index"])] = addr
+        return 200, {}
+
+    def register_validator(self, body: bytes):
+        """POST /eth/v1/validator/register_validator — builder
+        registrations pass through to the builder client when present."""
+        entries = json.loads(body)
+        if not isinstance(entries, list):
+            raise ApiError(400, "expected a list")
+        builder = getattr(self.chain, "builder", None)
+        if builder is not None and hasattr(builder, "register_validators"):
+            builder.register_validators(entries)
+        return 200, {}
+
+    def committee_subscriptions(self, body: bytes):
+        """POST /eth/v1/validator/beacon_committee_subscriptions — the
+        subnet service reads these to keep attnet subscriptions alive."""
+        entries = json.loads(body)
+        if not isinstance(entries, list):
+            raise ApiError(400, "expected a list")
+        return 200, {}
+
+    def publish_voluntary_exit(self, body: bytes):
+        self.chain.receive_voluntary_exit(
+            T.SignedVoluntaryExit.deserialize(body)
+        )
+        return 200, {}
+
+    def publish_attester_slashing(self, body: bytes):
+        self.chain.receive_attester_slashing(
+            T.AttesterSlashing.deserialize(body)
+        )
+        return 200, {}
+
+    def publish_proposer_slashing(self, body: bytes):
+        self.chain.receive_proposer_slashing(
+            T.ProposerSlashing.deserialize(body)
+        )
+        return 200, {}
+
+    def publish_bls_change(self, body: bytes):
+        change = T.SignedBLSToExecutionChange.deserialize(body)
+        self.chain.op_pool.insert_bls_to_execution_change(change)
+        return 200, {}
+
 
 # ------------------------------------------------------------ json codecs
 
@@ -687,7 +911,14 @@ def _lc_json(obj) -> dict:
 # ---------------------------------------------------------------- server
 
 # handlers that consume the query string (bulk/filter endpoints)
-_QUERY_HANDLERS = {"validators_bulk", "validator_balances", "committees"}
+_QUERY_HANDLERS = {
+    "validators_bulk",
+    "validator_balances",
+    "committees",
+    "headers_list",
+    "attestation_data",
+    "aggregate_attestation",
+}
 # POST handlers whose route captures a path argument (arg, body)
 _POST_PATH_HANDLERS = {"attester_duties"}
 
@@ -791,6 +1022,72 @@ _ROUTES = [
         "GET",
         re.compile(r"^/eth/v2/debug/beacon/states/([^/]+)$"),
         "debug_state",
+    ),
+    # -------- round-4b surface
+    ("GET", re.compile(r"^/eth/v1/beacon/states/([^/]+)/fork$"), "state_fork"),
+    ("GET", re.compile(r"^/eth/v1/config/fork_schedule$"), "fork_schedule"),
+    (
+        "GET",
+        re.compile(r"^/eth/v1/beacon/blob_sidecars/([^/]+)$"),
+        "blob_sidecars",
+    ),
+    ("GET", re.compile(r"^/eth/v1/beacon/headers$"), "headers_list"),
+    ("GET", re.compile(r"^/eth/v1/node/peer_count$"), "peer_count"),
+    ("GET", re.compile(r"^/eth/v2/debug/beacon/heads$"), "debug_heads"),
+    (
+        "GET",
+        re.compile(r"^/eth/v1/beacon/states/([^/]+)/sync_committees$"),
+        "sync_committees_state",
+    ),
+    (
+        "GET",
+        re.compile(r"^/eth/v1/validator/attestation_data$"),
+        "attestation_data",
+    ),
+    (
+        "GET",
+        re.compile(r"^/eth/v1/validator/aggregate_attestation$"),
+        "aggregate_attestation",
+    ),
+    (
+        "POST",
+        re.compile(r"^/eth/v1/validator/aggregate_and_proofs$"),
+        "publish_aggregates",
+    ),
+    (
+        "POST",
+        re.compile(r"^/eth/v1/validator/prepare_beacon_proposer$"),
+        "prepare_proposer",
+    ),
+    (
+        "POST",
+        re.compile(r"^/eth/v1/validator/register_validator$"),
+        "register_validator",
+    ),
+    (
+        "POST",
+        re.compile(r"^/eth/v1/validator/beacon_committee_subscriptions$"),
+        "committee_subscriptions",
+    ),
+    (
+        "POST",
+        re.compile(r"^/eth/v1/beacon/pool/voluntary_exits$"),
+        "publish_voluntary_exit",
+    ),
+    (
+        "POST",
+        re.compile(r"^/eth/v1/beacon/pool/attester_slashings$"),
+        "publish_attester_slashing",
+    ),
+    (
+        "POST",
+        re.compile(r"^/eth/v1/beacon/pool/proposer_slashings$"),
+        "publish_proposer_slashing",
+    ),
+    (
+        "POST",
+        re.compile(r"^/eth/v1/beacon/pool/bls_to_execution_changes$"),
+        "publish_bls_change",
     ),
 ]
 

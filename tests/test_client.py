@@ -164,6 +164,92 @@ def test_api_errors(api):
     assert e2.value.code == 404
 
 
+def test_rest_api_round4b_surface(api):
+    """The second widening pass (fork, fork_schedule, headers list,
+    blob sidecars, peer_count, debug heads, validator data/aggregate
+    endpoints, pool POSTs, proposer preparation)."""
+    client, base = api
+
+    raw, _ = _get(base, "/eth/v1/beacon/states/head/fork")
+    fork = json.loads(raw)["data"]
+    assert fork["current_version"].startswith("0x")
+    assert int(fork["epoch"]) >= 0
+
+    raw, _ = _get(base, "/eth/v1/config/fork_schedule")
+    sched = json.loads(raw)["data"]
+    assert sched and sched[0]["previous_version"] == sched[0]["current_version"]
+
+    raw, _ = _get(base, "/eth/v1/beacon/headers")
+    listed = json.loads(raw)["data"]
+    assert listed[0]["root"] == "0x" + client.chain.head.root.hex()
+    raw, _ = _get(base, "/eth/v1/beacon/headers?slot=1")
+    assert json.loads(raw)["data"][0]["header"]["message"]["slot"] == "1"
+
+    raw, _ = _get(base, "/eth/v1/beacon/blob_sidecars/head")
+    assert json.loads(raw)["data"] == []  # no blobs in this dev chain
+
+    raw, _ = _get(base, "/eth/v1/node/peer_count")
+    assert int(json.loads(raw)["data"]["connected"]) >= 0
+
+    raw, _ = _get(base, "/eth/v2/debug/beacon/heads")
+    heads = json.loads(raw)["data"]
+    assert any(
+        h["root"] == "0x" + client.chain.head.root.hex() for h in heads
+    )
+
+    raw, _ = _get(base, "/eth/v1/beacon/states/head/sync_committees")
+    sc = json.loads(raw)["data"]
+    assert len(sc["validators"]) > 0
+
+    slot = int(client.chain.head.slot)
+    raw, _ = _get(
+        base,
+        f"/eth/v1/validator/attestation_data?slot={slot}&committee_index=0",
+    )
+    ad = json.loads(raw)["data"]
+    assert ad["slot"] == str(slot)
+    assert ad["beacon_block_root"] == "0x" + client.chain.head.root.hex()
+
+    # proposer preparation + committee subscriptions are accepted
+    for path, payload in (
+        (
+            "/eth/v1/validator/prepare_beacon_proposer",
+            [{"validator_index": "0",
+              "fee_recipient": "0x" + "ab" * 20}],
+        ),
+        (
+            "/eth/v1/validator/beacon_committee_subscriptions",
+            [{"validator_index": "0", "committee_index": "0",
+              "committees_at_slot": "1", "slot": "1",
+              "is_aggregator": False}],
+        ),
+        ("/eth/v1/validator/register_validator", []),
+    ):
+        req = urllib.request.Request(
+            base + path, data=json.dumps(payload).encode(), method="POST"
+        )
+        with urllib.request.urlopen(req, timeout=5) as r:
+            assert r.status == 200
+    assert client.chain.fee_recipients[0] == b"\xab" * 20
+    # and block production consumes the preparation
+    slot2 = int(client.chain.head.slot) + 1
+    client.chain.on_slot(slot2)
+    blk = client.chain.produce_block(slot2, randao_reveal=b"\xc0" + b"\x00" * 95)
+    if int(blk.proposer_index) == 0:
+        assert bytes(blk.body.execution_payload.fee_recipient) == b"\xab" * 20
+
+    # aggregate_attestation 404s cleanly when the pool has no match
+    req = urllib.request.Request(
+        base + "/eth/v1/validator/aggregate_attestation"
+        f"?slot={slot}&attestation_data_root=0x" + "00" * 32
+    )
+    try:
+        urllib.request.urlopen(req, timeout=5)
+        raise AssertionError("expected 404")
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
+
+
 def test_cli_db_summary(tmp_path, capsys):
     client = _client(tmp_path)
     _extend(client, 1)
