@@ -74,9 +74,12 @@ class ApiError(Exception):
 class BeaconApi:
     """Route logic, framework-free (unit-testable without sockets)."""
 
-    def __init__(self, chain, sync=None):
+    def __init__(self, chain, sync=None, subnet_service=None):
         self.chain = chain
         self.sync = sync
+        # attnet subscription sink (network/subnet_service.py); REST
+        # subscriptions are recorded here when a node wires one in
+        self.subnet_service = subnet_service
 
     # ------------------------------------------------------------ gets
 
@@ -703,7 +706,8 @@ class BeaconApi:
                 # validator 0 as a committee member
                 raise ApiError(500, "sync-committee pubkey not in cache")
             indices.append(idx)
-        per_sub = max(1, len(indices) // 4)
+        subnets = self.chain.spec.preset.sync_committee_subnet_count
+        per_sub = max(1, -(-len(indices) // subnets))  # ceil division
         return 200, {
             "data": {
                 "validators": [str(i) for i in indices],
@@ -782,11 +786,22 @@ class BeaconApi:
         return 200, {}
 
     def committee_subscriptions(self, body: bytes):
-        """POST /eth/v1/validator/beacon_committee_subscriptions — the
-        subnet service reads these to keep attnet subscriptions alive."""
+        """POST /eth/v1/validator/beacon_committee_subscriptions —
+        forwarded to the subnet service (when wired) so attnet
+        subscriptions actually happen; accepted-and-dropped would mask
+        lost aggregation duties with a 200."""
         entries = json.loads(body)
         if not isinstance(entries, list):
             raise ApiError(400, "expected a list")
+        if self.subnet_service is not None:
+            for e in entries:
+                self.subnet_service.subscribe_duty(
+                    validator_index=int(e["validator_index"]),
+                    slot=int(e["slot"]),
+                    committee_index=int(e["committee_index"]),
+                    committees_per_slot=int(e["committees_at_slot"]),
+                    is_aggregator=bool(e.get("is_aggregator", False)),
+                )
         return 200, {}
 
     def publish_voluntary_exit(self, body: bytes):
@@ -808,7 +823,20 @@ class BeaconApi:
         return 200, {}
 
     def publish_bls_change(self, body: bytes):
+        """Signature-verified BEFORE pooling (every sibling endpoint
+        verifies via chain.receive_*): an unverified change would poison
+        our own proposals until the credentials actually rotate."""
+        from ..consensus.signature_sets import (
+            bls_execution_change_signature_set,
+        )
+        from ..crypto import bls
+
         change = T.SignedBLSToExecutionChange.deserialize(body)
+        sig_set = bls_execution_change_signature_set(
+            self.chain.spec, change, self.chain.genesis_validators_root
+        )
+        if not bls.verify_signature_sets([sig_set]):
+            raise ApiError(400, "invalid BLSToExecutionChange signature")
         self.chain.op_pool.insert_bls_to_execution_change(change)
         return 200, {}
 

@@ -238,3 +238,62 @@ class TestNoiseTransport:
         finally:
             a.close()
             b.close()
+
+
+class TestNativeSnappy:
+    """native/snappy.cpp must interoperate byte-level with the Python
+    codec (same BLOCK format) and honor the bomb guard."""
+
+    def _both(self):
+        from lighthouse_tpu.network import snappy_codec as sc
+
+        if not sc.native_available():
+            pytest.skip("native snappy unavailable (no toolchain)")
+        return sc
+
+    def _py_decompress(self, sc, data, **kw):
+        lib, sc._lib = sc._lib, None
+        err = sc._build_err
+        sc._build_err = "forced-python"
+        try:
+            return sc.decompress(data, **kw)
+        finally:
+            sc._lib, sc._build_err = lib, err
+
+    def _py_compress(self, sc, data):
+        lib, sc._lib = sc._lib, None
+        err = sc._build_err
+        sc._build_err = "forced-python"
+        try:
+            return sc.compress(data)
+        finally:
+            sc._lib, sc._build_err = lib, err
+
+    def test_cross_implementation_roundtrips(self):
+        sc = self._both()
+        cases = [
+            b"",
+            b"x",
+            b"hello world " * 400,           # long repeats -> copies
+            bytes(range(256)) * 300,          # periodic
+            os.urandom(70_000),               # incompressible, >1 block
+            b"\x00" * 200_000,                # highly compressible
+        ]
+        for data in cases:
+            native_c = sc.compress(data)
+            py_c = self._py_compress(sc, data)
+            # each implementation decodes the other's stream
+            assert sc.decompress(py_c) == data
+            assert self._py_decompress(sc, native_c) == data
+            assert sc.decompress(native_c) == data
+
+    def test_native_bomb_guard(self):
+        sc = self._both()
+        payload = sc.compress(b"\xaa" * (1 << 20))
+        with pytest.raises(sc.SnappyError):
+            sc.decompress(payload, max_output=1 << 16)
+
+    def test_native_rejects_garbage(self):
+        sc = self._both()
+        with pytest.raises(sc.SnappyError):
+            sc.decompress(b"\x0a\xff\xff\xff\xff")
