@@ -21,8 +21,24 @@ from typing import Callable, Optional
 
 from .transport import CHANNEL_GOSSIP, Endpoint
 
-MESH_SIZE = 8  # gossipsub D
+MESH_SIZE = 8        # gossipsub D
+MESH_LOW = 6         # D_low: heartbeat grafts below this
+MESH_HIGH = 12       # D_high: heartbeat prunes above this
+GOSSIP_LAZY = 6      # D_lazy: IHAVE fanout per heartbeat
+MCACHE_LEN = 5       # heartbeats of message history kept
+MCACHE_GOSSIP = 3    # newest heartbeats advertised in IHAVE
 SEEN_CACHE_SIZE = 4096
+
+# peer-score thresholds (gossipsub v1.1 scoring, peer_score.rs role;
+# magnitudes follow the reference's beacon defaults' shape)
+PRUNE_BACKOFF = 60           # heartbeats before re-grafting a pruner
+GOSSIP_THRESHOLD = -40.0     # below: ignore their gossip + IHAVE
+GRAYLIST_THRESHOLD = -80.0   # below: prune everywhere, drop frames
+SCORE_DECAY = 0.9            # per-heartbeat multiplicative decay
+P2_FIRST_DELIVERY = 1.0      # weight per first delivery
+P4_INVALID = -10.0           # weight per invalid/undecodable message
+P7_BEHAVIOUR = -5.0          # weight per behavioural offence (bad GRAFT)
+SCORE_CAP = 50.0
 
 # topic name templates (fork digest scoping like topics in pubsub.rs)
 TOPIC_BLOCK = "beacon_block"
@@ -59,6 +75,17 @@ class GossipRouter:
         self._seen: OrderedDict[bytes, None] = OrderedDict()
         # delivery stats for peer scoring: peer -> (first, duplicate)
         self.delivery_stats: dict[str, list] = {}
+        # v1.1 scoring: peer -> decayed score (P2/P4/P7 weighted)
+        self.scores: dict[str, float] = {}
+        # mcache: deque of heartbeat windows, each {mid: (topic, wire)}
+        self._mcache: list = [dict() for _ in range(MCACHE_LEN)]
+        # IWANT bookkeeping: mid -> heartbeat number requested at (so a
+        # peer that never answers does not burn the mid forever)
+        self._iwant_sent: dict[bytes, int] = {}
+        self._heartbeat_no = 0
+        # PRUNE backoff: (topic, peer) -> heartbeat number we may
+        # re-graft at (spec: respect the pruner's backoff window)
+        self._backoff: dict[tuple, int] = {}
 
     # -- membership
 
@@ -86,7 +113,7 @@ class GossipRouter:
         self.delivery_stats.pop(peer_id, None)
         if pruned:
             rpc = W.GossipRpc()
-            rpc.control.prune = [(t, 0) for t in pruned]
+            rpc.control.prune = [(t, PRUNE_BACKOFF) for t in pruned]
             self.endpoint.send(peer_id, CHANNEL_GOSSIP, W.encode_rpc(rpc))
 
     # -- data plane
@@ -98,6 +125,7 @@ class GossipRouter:
         wire = W.compress_payload(data)
         mid = W.message_id_from_ssz(topic, data)
         self._mark_seen(mid)
+        self._mcache[0][mid] = (topic, wire)  # serve IWANTs for our own
         return self._forward(topic, wire, exclude=None)
 
     def handle_frame(self, sender: str, payload: bytes) -> Optional[tuple]:
@@ -105,6 +133,12 @@ class GossipRouter:
         message, apply control messages, deliver fresh subscribed
         payloads locally. Returns (sender, topic, ssz_data) for the
         first fresh message on a subscribed topic, else None."""
+        if self.scores.get(sender, 0.0) <= GRAYLIST_THRESHOLD:
+            # graylisted: drop unprocessed; continuing to send while
+            # graylisted keeps the score pinned down (decay forgives
+            # silence, not persistence)
+            self._score(sender, P7_BEHAVIOUR)
+            return None
         try:
             rpc = W.decode_rpc(payload)
         except Exception:
@@ -113,21 +147,34 @@ class GossipRouter:
             # the service poll loop as an exception
             stats = self.delivery_stats.setdefault(sender, [0, 0])
             stats[1] += 1
+            self._score(sender, P4_INVALID)
             return None
+        self._handle_gossip_control(sender, rpc)
         for topic in rpc.control.graft:
             # spec posture: GRAFT on a topic we aren't subscribed to
             # (or whose mesh is full) is answered with PRUNE — and
             # never grows state for arbitrary remote strings
             if topic in self.subscriptions and len(
                 self.mesh.setdefault(topic, set())
-            ) < MESH_SIZE:
+            ) < 2 * MESH_HIGH:  # transient overshoot OK (sanity cap);
+                # the heartbeat prunes anything above D_high back to D
                 self.mesh[topic].add(sender)
             else:
+                # unsolicited GRAFT is a behavioural offence (P7)
+                if topic not in self.subscriptions:
+                    self._score(sender, P7_BEHAVIOUR)
                 rej = W.GossipRpc()
                 rej.control.prune.append((topic, 0))
                 self.endpoint.send(sender, CHANNEL_GOSSIP, W.encode_rpc(rej))
-        for topic, _backoff in rpc.control.prune:
+        for topic, backoff in rpc.control.prune:
             self.mesh.get(topic, set()).discard(sender)
+            # honor the pruner's backoff so the heartbeat does not
+            # re-graft next second (GRAFT/PRUNE churn with peers not
+            # subscribed to the topic would mutually P7 honest nodes)
+            until = self._heartbeat_no + min(
+                int(backoff) or PRUNE_BACKOFF, 10 * PRUNE_BACKOFF
+            )
+            self._backoff[(topic, sender)] = until
         delivered = None
         for m in rpc.publish:
             stats = self.delivery_stats.setdefault(sender, [0, 0])
@@ -136,6 +183,7 @@ class GossipRouter:
                 mid = W.message_id_from_ssz(m.topic, ssz)
             except Exception:
                 stats[1] += 1  # undecodable payload: dedup junk by id
+                self._score(sender, P4_INVALID)
                 try:
                     self._mark_seen(W.message_id(m.topic, m.data))
                 except Exception:
@@ -145,7 +193,9 @@ class GossipRouter:
                 stats[1] += 1  # duplicate: mesh overlap, mild negative
                 continue
             stats[0] += 1
+            self._score(sender, P2_FIRST_DELIVERY)
             self._mark_seen(mid)
+            self._mcache[0][mid] = (m.topic, m.data)
             self._forward(m.topic, m.data, exclude=sender)
             if m.topic in self.subscriptions:
                 if self.on_message is not None:
@@ -171,3 +221,125 @@ class GossipRouter:
         self._seen[mid] = None
         while len(self._seen) > SEEN_CACHE_SIZE:
             self._seen.popitem(last=False)
+
+    # -- v1.1 scoring
+
+    def _score(self, peer: str, delta: float) -> None:
+        s = self.scores.get(peer, 0.0) + delta
+        self.scores[peer] = min(s, SCORE_CAP)
+
+    # -- lazy gossip (IHAVE/IWANT over the mcache)
+
+    def _handle_gossip_control(self, sender: str, rpc) -> None:
+        ctrl = rpc.control
+        if ctrl.ihave and self.scores.get(sender, 0.0) > GOSSIP_THRESHOLD:
+            want = []
+            for topic, mids in ctrl.ihave:
+                if topic not in self.subscriptions:
+                    continue
+                for mid in mids:
+                    if mid not in self._seen and mid not in self._iwant_sent:
+                        want.append(mid)
+                        if len(want) >= 32:  # match the serving bound
+                            break
+            if want:
+                # mark ONLY what we actually request; entries expire in
+                # heartbeat() so an unanswered IWANT can be retried
+                # against the next advertiser
+                for mid in want:
+                    self._iwant_sent[mid] = self._heartbeat_no
+                req = W.GossipRpc()
+                req.control.iwant.extend(want)
+                self.endpoint.send(sender, CHANNEL_GOSSIP, W.encode_rpc(req))
+        if ctrl.iwant:
+            out = W.GossipRpc()
+            for mid in ctrl.iwant[:32]:  # response size bound
+                for window in self._mcache:
+                    entry = window.get(mid)
+                    if entry is not None:
+                        out.publish.append(
+                            W.PublishedMessage(topic=entry[0], data=entry[1])
+                        )
+                        break
+            if out.publish:
+                self.endpoint.send(sender, CHANNEL_GOSSIP, W.encode_rpc(out))
+
+    # -- heartbeat (mesh maintenance + IHAVE emission, behaviour.rs role)
+
+    def heartbeat(self, candidates: list = None) -> None:
+        """One gossipsub heartbeat: shed graylisted and overfull mesh
+        peers, graft toward D from `candidates` (connected peers,
+        respecting PRUNE backoffs), advertise recent mcache windows via
+        IHAVE to a sample of non-mesh peers, then decay scores."""
+        import random
+
+        self._heartbeat_no += 1
+        hb = self._heartbeat_no
+        # expire state: answered-or-not IWANTs retry after 2 beats;
+        # elapsed backoffs re-open grafting
+        self._iwant_sent = {
+            mid: n for mid, n in self._iwant_sent.items() if hb - n <= 2
+        }
+        self._backoff = {
+            k: until for k, until in self._backoff.items() if until > hb
+        }
+        candidates = [
+            p
+            for p in (candidates or [])
+            if self.scores.get(p, 0.0) > GRAYLIST_THRESHOLD
+        ]
+        for topic in self.subscriptions:
+            peers = self.mesh.setdefault(topic, set())
+            for peer in [
+                p
+                for p in peers
+                if self.scores.get(p, 0.0) <= GRAYLIST_THRESHOLD
+            ]:
+                self.prune(peer)
+            if len(peers) < MESH_LOW:
+                pool = [
+                    p
+                    for p in candidates
+                    if p not in peers and (topic, p) not in self._backoff
+                ]
+                random.shuffle(pool)
+                for peer in pool[: MESH_SIZE - len(peers)]:
+                    self.graft(topic, peer)
+            elif len(peers) > MESH_HIGH:
+                # shed lowest-scoring members back to D (inbound GRAFTs
+                # are accepted up to D_high, so this branch is live)
+                by_score = sorted(
+                    peers, key=lambda p: self.scores.get(p, 0.0)
+                )
+                rpc = W.GossipRpc()
+                rpc.control.prune.append((topic, PRUNE_BACKOFF))
+                frame = W.encode_rpc(rpc)
+                for peer in by_score[: len(peers) - MESH_SIZE]:
+                    peers.discard(peer)
+                    self.endpoint.send(peer, CHANNEL_GOSSIP, frame)
+            # IHAVE: advertise recent history to non-mesh peers
+            mids = [
+                mid
+                for window in self._mcache[:MCACHE_GOSSIP]
+                for mid, (t, _) in window.items()
+                if t == topic
+            ]
+            if mids:
+                lazy = [p for p in candidates if p not in peers]
+                random.shuffle(lazy)
+                rpc = W.GossipRpc()
+                rpc.control.ihave.append((topic, mids[:64]))
+                frame = W.encode_rpc(rpc)
+                for peer in lazy[:GOSSIP_LAZY]:
+                    self.endpoint.send(peer, CHANNEL_GOSSIP, frame)
+        # decay LAST: shedding above used the scores peers earned;
+        # decay forgives between heartbeats
+        for peer in list(self.scores):
+            s = self.scores[peer] * SCORE_DECAY
+            if abs(s) < 0.01:
+                del self.scores[peer]
+            else:
+                self.scores[peer] = s
+        # rotate the mcache window
+        self._mcache.pop()
+        self._mcache.insert(0, {})

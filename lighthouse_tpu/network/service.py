@@ -105,7 +105,15 @@ class NetworkService:
 
     def poll(self) -> list:
         """Drain inbound frames into events; rpc responses fire their
-        callbacks inline, gossip yields events for the router."""
+        callbacks inline, gossip yields events for the router. A
+        gossipsub heartbeat (mesh maintenance + IHAVE lazy gossip)
+        fires at most once a second."""
+        import time as _time
+
+        now = _time.monotonic()
+        if now - getattr(self, "_last_heartbeat", 0.0) >= 1.0:
+            self._last_heartbeat = now
+            self.gossip.heartbeat(self.peers.connected())
         events = []
         for frame in self.endpoint.drain():
             if not self.peers.is_usable(frame.sender):

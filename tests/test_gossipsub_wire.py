@@ -168,3 +168,160 @@ def test_unsubscribed_graft_rejected_with_prune():
     assert frames
     back = W.decode_rpc(frames[0].payload)
     assert back.control.prune == [("topic-nobody-knows", 0)]
+
+
+def test_heartbeat_grafts_toward_mesh_size_and_emits_ihave():
+    """Heartbeat mesh maintenance (behaviour.rs role): below D_low the
+    router grafts candidates; recent mcache windows are advertised via
+    IHAVE to non-mesh peers."""
+    from lighthouse_tpu.network.gossip import GossipRouter, topic_for
+    from lighthouse_tpu.network.transport import InProcessHub
+
+    hub = InProcessHub()
+    a = hub.join("a")
+    peers = [hub.join(f"p{i}") for i in range(12)]
+    ra = GossipRouter(a)
+    topic = topic_for("beacon_block", b"\x00" * 4)
+    ra.subscribe(topic)
+    ra.publish(topic, b"\x55" * 64)  # seeds the mcache
+    names = [f"p{i}" for i in range(12)]
+    ra.heartbeat(names)
+    assert len(ra.mesh[topic]) == 8  # grafted to D
+    # non-mesh peers got IHAVE frames carrying the message id
+    ihave_seen = 0
+    for p, ep in zip(names, peers):
+        for f in ep.drain():
+            rpc = W.decode_rpc(f.payload)
+            if rpc.control.ihave:
+                assert p not in ra.mesh[topic]
+                ihave_seen += 1
+    assert ihave_seen > 0
+
+
+def test_iwant_serves_cached_messages():
+    """A peer that missed a message IHAVE->IWANTs it and receives the
+    full publish frame from the mcache."""
+    from lighthouse_tpu.network.gossip import GossipRouter, topic_for
+    from lighthouse_tpu.network.transport import InProcessHub
+
+    hub = InProcessHub()
+    a, b = hub.join("a"), hub.join("b")
+    ra = GossipRouter(a)
+    got = []
+    rb = GossipRouter(b, on_message=lambda *args: got.append(args))
+    topic = topic_for("beacon_block", b"\x00" * 4)
+    ra.subscribe(topic)
+    rb.subscribe(topic)
+    # fill a's mesh with phantom peers so b can only take the lazy
+    # IHAVE path (a full mesh never grafts the candidate)
+    for i in range(8):
+        ra.mesh[topic].add(f"phantom{i}")
+    ssz = b"\x77" * 80
+    ra.publish(topic, ssz)  # b is NOT in the mesh: misses the publish
+    b.drain()
+    ra.heartbeat(["b"])  # b is a non-mesh candidate -> IHAVE
+    # drive the exchange until the payload lands (ihave->iwant->publish)
+    for _ in range(4):
+        for f in b.drain():
+            rb.handle_frame(f.sender, f.payload)
+        for f in a.drain():
+            ra.handle_frame(f.sender, f.payload)
+    assert got and got[0][2] == ssz
+
+
+def test_graylisted_peer_is_ignored_and_shed():
+    from lighthouse_tpu.network import gossip as G
+    from lighthouse_tpu.network.gossip import GossipRouter, topic_for
+    from lighthouse_tpu.network.transport import InProcessHub
+
+    hub = InProcessHub()
+    a, b = hub.join("a"), hub.join("b")
+    ra = GossipRouter(a)
+    topic = topic_for("beacon_block", b"\x00" * 4)
+    ra.subscribe(topic)
+    ra.graft(topic, "b")
+    assert "b" in ra.mesh[topic]
+    # hostile frames drive the score below the graylist threshold
+    for _ in range(9):
+        ra.handle_frame("b", b"\xff\xff\xff")
+    assert ra.scores["b"] <= G.GRAYLIST_THRESHOLD
+    # graylisted: frames dropped unprocessed, heartbeat sheds the peer
+    assert ra.handle_frame("b", b"\xff") is None
+    ra.heartbeat(["b"])
+    assert "b" not in ra.mesh[topic]
+    # persistence keeps the score pinned down: another hostile frame
+    # re-offends, so the next heartbeat still refuses to re-graft
+    assert ra.handle_frame("b", b"\xff") is None
+    ra.heartbeat(["b"])
+    assert "b" not in ra.mesh[topic]
+
+
+def test_first_deliveries_raise_score():
+    from lighthouse_tpu.network.gossip import GossipRouter, topic_for
+    from lighthouse_tpu.network.transport import InProcessHub
+
+    hub = InProcessHub()
+    a, b = hub.join("a"), hub.join("b")
+    ra = GossipRouter(a)
+    rb = GossipRouter(b)
+    topic = topic_for("beacon_block", b"\x00" * 4)
+    ra.subscribe(topic)
+    rb.subscribe(topic)
+    rb.graft(topic, "a")
+    a.drain()
+    rb.publish(topic, b"\x01" * 32)
+    for f in a.drain():
+        ra.handle_frame(f.sender, f.payload)
+    assert ra.scores.get("b", 0.0) > 0
+
+
+def test_prune_backoff_stops_graft_churn():
+    """A peer not subscribed to a topic PRUNEs our GRAFT; the backoff
+    must stop the heartbeat from re-grafting every second (mutual P7
+    churn would graylist two honest nodes — code-review r4)."""
+    from lighthouse_tpu.network.gossip import GossipRouter, topic_for
+    from lighthouse_tpu.network.transport import InProcessHub
+
+    hub = InProcessHub()
+    a, b = hub.join("a"), hub.join("b")
+    ra = GossipRouter(a)
+    rb = GossipRouter(b)  # b does NOT subscribe
+    topic = topic_for("beacon_block", b"\x00" * 4)
+    ra.subscribe(topic)
+    ra.heartbeat(["b"])  # grafts b
+    assert "b" in ra.mesh[topic]
+    for f in b.drain():
+        rb.handle_frame(f.sender, f.payload)  # b answers PRUNE
+    for f in a.drain():
+        ra.handle_frame(f.sender, f.payload)  # a honors the backoff
+    assert "b" not in ra.mesh[topic]
+    sent_before = len(b.drain())
+    for _ in range(5):
+        ra.heartbeat(["b"])
+    assert "b" not in ra.mesh[topic]  # no re-graft inside the backoff
+    # and no GRAFT frames were re-sent to b during the backoff window
+    grafts = [
+        f for f in b.drain() if W.decode_rpc(f.payload).control.graft
+    ]
+    assert grafts == []
+
+
+def test_inbound_graft_accepts_to_dhigh_then_heartbeat_prunes():
+    from lighthouse_tpu.network import gossip as G
+    from lighthouse_tpu.network.gossip import GossipRouter, topic_for
+    from lighthouse_tpu.network.transport import InProcessHub
+
+    hub = InProcessHub()
+    a = hub.join("a")
+    ra = GossipRouter(a)
+    topic = topic_for("beacon_block", b"\x00" * 4)
+    ra.subscribe(topic)
+    graft = W.GossipRpc()
+    graft.control.graft.append(topic)
+    frame = W.encode_rpc(graft)
+    for i in range(30):
+        ra.handle_frame(f"p{i}", frame)
+    # transient overshoot accepted up to the sanity cap
+    assert len(ra.mesh[topic]) == 2 * G.MESH_HIGH
+    ra.heartbeat([f"p{i}" for i in range(30)])
+    assert len(ra.mesh[topic]) == G.MESH_SIZE  # pruned back to D
