@@ -1,0 +1,60 @@
+"""ops/lane/chains.py (windowed pow/inv + windowed G1 ladder) vs host."""
+
+import secrets
+
+import numpy as np
+import jax.numpy as jnp
+
+from lighthouse_tpu.crypto.bls import params, curve as C, fields as FF
+from lighthouse_tpu.ops.lane import fp as L, tower as T, jacobian as J, chains
+
+P = params.P
+
+
+def test_pow_const_w4_and_inv():
+    vals = [secrets.randbelow(P) for _ in range(3)] + [1, P - 1]
+    a = jnp.asarray(L.pack(vals))
+    e = 0xDEADBEEFCAFE12345
+    got = L.unpack(L.canonical(chains.pow_const_w4(a, e)))
+    assert got == [pow(v, e, P) for v in vals]
+    gi = L.unpack(L.canonical(chains.inv(a)))
+    assert gi == [pow(v, P - 2, P) for v in vals]
+    # zero maps to zero (Fermat convention)
+    z = jnp.asarray(L.pack([0]))
+    assert L.unpack(L.canonical(chains.inv(z))) == [0]
+
+
+def test_f2inv_windowed():
+    vals = [
+        (secrets.randbelow(P), secrets.randbelow(P)) for _ in range(3)
+    ] + [(1, 0), (0, 1)]
+    a = jnp.asarray(T.f2_pack_many(vals))
+    out = np.asarray(L.canonical(chains.f2inv(a)))
+    for i, v in enumerate(vals):
+        want = FF.f2inv(v)
+        got = (L.from_limbs(out[0, :, i]), L.from_limbs(out[1, :, i]))
+        assert got == want
+
+
+def test_scalar_mul_w2_matches_host_g1():
+    pts = [
+        C.g1_mul(C.G1_GEN, secrets.randbits(200) % params.R)
+        for _ in range(4)
+    ]
+    ks = [secrets.randbits(64) | 1, 1, 2, (1 << 64) - 1]
+    bits = jnp.asarray(J.scalars_to_bits(ks, 64))
+    base = J.pack_g1(pts)
+    # pack_g1 gives Jacobian with Z=1 (affine), as the verify kernel does
+    got = J.unpack_g1(chains.scalar_mul_w2(J.FP1, base, bits))
+    assert got == [C.g1_mul(p, k) for p, k in zip(pts, ks)]
+
+
+def test_scalar_mul_w2_matches_host_g2():
+    pts = [
+        C.g2_mul(C.G2_GEN, secrets.randbits(200) % params.R)
+        for _ in range(3)
+    ]
+    ks = [secrets.randbits(64) | 1, 3, (1 << 63) + 5]
+    bits = jnp.asarray(J.scalars_to_bits(ks, 64))
+    got = J.unpack_g2(chains.scalar_mul_w2(J.FP2, J.pack_g2(pts), bits))
+    assert got == [C.g2_mul(p, k) for p, k in zip(pts, ks)]

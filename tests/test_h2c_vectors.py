@@ -89,6 +89,21 @@ def test_hash_to_g2_rfc_vectors_device():
         assert got == ((x0, x1), (y0, y1)), msg
 
 
+def test_hash_to_g2_rfc_vectors_lane_device():
+    """The lane-major device path (ops/lane/htc — the one the TPU verify
+    kernel uses) against the RFC outputs; round 4 rebuilt this map on
+    inversion-free SSWU + the Frobenius-split ratio chain, so it gets
+    its own external anchor."""
+    from lighthouse_tpu.ops.lane import htc as LHT, jacobian as LJ
+
+    msgs = [b"", b"abc"]
+    t0, t1 = LHT.pack_draws(msgs, dst=RFC_DST)
+    pts = LJ.unpack_g2(LHT.hash_draws_to_g2(t0, t1))
+    for msg, got in zip(msgs, pts):
+        x0, x1, y0, y1 = H2C_G2_VECTORS[msg]
+        assert got == ((x0, x1), (y0, y1)), msg
+
+
 def test_generator_serialization_anchors():
     # ZCash-convention compressed encodings of the standard generators.
     assert C.g1_compress(C.G1_GEN).hex() == (
