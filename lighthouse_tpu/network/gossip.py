@@ -115,6 +115,13 @@ class GossipRouter:
             rpc = W.GossipRpc()
             rpc.control.prune = [(t, PRUNE_BACKOFF) for t in pruned]
             self.endpoint.send(peer_id, CHANNEL_GOSSIP, W.encode_rpc(rpc))
+            # honor our OWN announced backoff: re-GRAFTing a peer inside
+            # the window we told it to wait draws the spec's
+            # GRAFT-during-backoff behaviour penalty from real peers
+            for t in pruned:
+                self._backoff[(t, peer_id)] = (
+                    self._heartbeat_no + PRUNE_BACKOFF
+                )
 
     # -- data plane
 
@@ -167,6 +174,12 @@ class GossipRouter:
                 rej.control.prune.append((topic, 0))
                 self.endpoint.send(sender, CHANNEL_GOSSIP, W.encode_rpc(rej))
         for topic, backoff in rpc.control.prune:
+            # same no-arbitrary-remote-state posture as GRAFT: a PRUNE
+            # for a topic we don't subscribe to can't need backoff (we
+            # would never graft it) — recording it would let one peer
+            # grow _backoff without bound on fabricated topic strings
+            if topic not in self.subscriptions:
+                continue
             self.mesh.get(topic, set()).discard(sender)
             # honor the pruner's backoff so the heartbeat does not
             # re-graft next second (GRAFT/PRUNE churn with peers not
@@ -316,6 +329,9 @@ class GossipRouter:
                 frame = W.encode_rpc(rpc)
                 for peer in by_score[: len(peers) - MESH_SIZE]:
                     peers.discard(peer)
+                    self._backoff[(topic, peer)] = (
+                        self._heartbeat_no + PRUNE_BACKOFF
+                    )
                     self.endpoint.send(peer, CHANNEL_GOSSIP, frame)
             # IHAVE: advertise recent history to non-mesh peers
             mids = [

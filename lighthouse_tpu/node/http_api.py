@@ -45,6 +45,18 @@ Round 4b additions:
   POST /eth/v1/beacon/pool/{voluntary_exits|attester_slashings|
          proposer_slashings|bls_to_execution_changes}
 
+Round 4c additions (sync-committee validator flow + rewards + misc):
+  POST /eth/v1/validator/duties/sync/{epoch}
+  GET  /eth/v1/validator/sync_committee_contribution
+  POST /eth/v1/beacon/pool/sync_committees
+  POST /eth/v1/validator/{contribution_and_proofs|
+         sync_committee_subscriptions}
+  GET  /eth/v1/beacon/states/{id}/randao[?epoch=]
+  GET  /eth/v1/node/peers/{peer_id}
+  GET  /eth/v1/beacon/deposit_snapshot             (EIP-4881 role)
+  POST /eth/v1/beacon/rewards/sync_committee/{block_id}
+  POST /eth/v1/beacon/rewards/attestations/{epoch}
+
 SSZ content negotiation (Accept: application/octet-stream) on block and
 debug-state gets; the state bytes are the FORK-EXACT encoding via
 consensus.forked_types (VERDICT r3 missing #2/#5).
@@ -840,6 +852,332 @@ class BeaconApi:
         self.chain.op_pool.insert_bls_to_execution_change(change)
         return 200, {}
 
+    # -------------------------------------------- round-4c surface
+    # Sync-committee validator flow + rewards + misc, toward lib.rs's
+    # full table (post_validator_duties_sync, sync contribution GET,
+    # pool POSTs, rewards/attestations, rewards/sync_committee,
+    # deposit_snapshot, per-peer lookup, states/{id}/randao).
+
+    def _sync_committee_for_epoch(self, state, epoch: int):
+        """current/next sync committee by period, or 400 outside them."""
+        spec = self.chain.spec
+        period = epoch // spec.preset.epochs_per_sync_committee_period
+        head_epoch = st.compute_epoch_at_slot(spec, int(state.slot))
+        head_period = (
+            head_epoch // spec.preset.epochs_per_sync_committee_period
+        )
+        try:
+            if period == head_period:
+                return state.current_sync_committee
+            if period == head_period + 1:
+                return state.next_sync_committee
+        except AttributeError:
+            raise ApiError(400, "pre-altair state has no sync committees")
+        raise ApiError(400, f"epoch {epoch} outside served sync periods")
+
+    def sync_duties(self, epoch: str, body: bytes):
+        """POST /eth/v1/validator/duties/sync/{epoch} — committee
+        membership positions for the requested validator indices
+        (validator_client sync-duty discovery)."""
+        try:
+            ep = int(epoch)
+            ids = [int(i) for i in json.loads(body)]
+        except (ValueError, TypeError):
+            raise ApiError(400, "bad epoch or index list")
+        state = self._head_state("head")
+        committee = self._sync_committee_for_epoch(state, ep)
+        pubkeys = [bytes(pk) for pk in committee.pubkeys]
+        duties = []
+        for vi in ids:
+            if not 0 <= vi < len(state.validators):
+                continue
+            pk = bytes(state.validators[vi].pubkey)
+            positions = [i for i, cpk in enumerate(pubkeys) if cpk == pk]
+            if positions:
+                duties.append(
+                    {
+                        "pubkey": "0x" + pk.hex(),
+                        "validator_index": str(vi),
+                        "validator_sync_committee_indices": [
+                            str(i) for i in positions
+                        ],
+                    }
+                )
+        return 200, {"data": duties, "execution_optimistic": False}
+
+    def sync_contribution(self, query: dict):
+        """GET /eth/v1/validator/sync_committee_contribution
+        ?slot=&subcommittee_index=&beacon_block_root= — the best
+        locally-aggregated contribution from the naive sync pool."""
+        try:
+            slot = int(query["slot"])
+            sub = int(query["subcommittee_index"])
+            root = bytes.fromhex(
+                query["beacon_block_root"].removeprefix("0x")
+            )
+        except (KeyError, ValueError):
+            raise ApiError(
+                400, "slot, subcommittee_index, beacon_block_root required"
+            )
+        c = self.chain.agg_pool.get_contribution(slot, root, sub)
+        if c is None:
+            raise ApiError(404, "no contribution for that key")
+        return 200, {"data": _lc_json(c)}
+
+    def publish_sync_message(self, body: bytes):
+        """POST /eth/v1/beacon/pool/sync_committees (SSZ body, one
+        SyncCommitteeMessage — the repo's single-item POST convention)."""
+        msg = T.SyncCommitteeMessage.deserialize(body)
+        self.chain.verify_sync_message_for_gossip(msg)
+        return 200, {}
+
+    def publish_contribution(self, body: bytes):
+        """POST /eth/v1/validator/contribution_and_proofs (SSZ body)."""
+        signed = T.SignedContributionAndProof.deserialize(body)
+        self.chain.verify_sync_contribution_for_gossip(signed)
+        return 200, {}
+
+    def sync_subscriptions(self, body: bytes):
+        """POST /eth/v1/validator/sync_committee_subscriptions —
+        forwarded to the subnet service's sync-subnet side."""
+        entries = json.loads(body)
+        if not isinstance(entries, list):
+            raise ApiError(400, "expected a list")
+        if self.subnet_service is not None:
+            subnets = set()
+            spec = self.chain.spec
+            size = spec.preset.sync_committee_size
+            per_sub = size // spec.preset.sync_committee_subnet_count
+            for e in entries:
+                for pos in e.get("sync_committee_indices", []):
+                    subnets.add(int(pos) // per_sub)
+            self.subnet_service.subscribe_sync_subnets(sorted(subnets))
+        return 200, {}
+
+    def state_randao(self, state_id: str, query: dict):
+        """GET /eth/v1/beacon/states/{id}/randao[?epoch=]."""
+        state = self._head_state(state_id)
+        spec = self.chain.spec
+        head_epoch = st.compute_epoch_at_slot(spec, int(state.slot))
+        try:
+            ep = int(query.get("epoch", head_epoch))
+        except ValueError:
+            raise ApiError(400, "bad epoch")
+        # randao_mixes only holds EPOCHS_PER_HISTORICAL_VECTOR entries
+        span = spec.preset.epochs_per_historical_vector
+        if not head_epoch - span < ep <= head_epoch:
+            raise ApiError(400, f"epoch {ep} outside the mixes window")
+        mix = st.get_randao_mix(spec, state, ep)
+        return 200, {"data": {"randao": "0x" + bytes(mix).hex()}}
+
+    def node_peer(self, peer_id: str):
+        """GET /eth/v1/node/peers/{peer_id}."""
+        service = getattr(self.sync, "service", None)
+        peers = service.peers.connected() if service is not None else []
+        for p in peers:
+            if str(p) == peer_id:
+                return 200, {
+                    "data": {
+                        "peer_id": peer_id,
+                        "enr": None,
+                        "last_seen_p2p_address": "",
+                        "state": "connected",
+                        "direction": "outbound",
+                    }
+                }
+        raise ApiError(404, "peer not known")
+
+    def deposit_snapshot(self):
+        """GET /eth/v1/beacon/deposit_snapshot (EIP-4881 role): the
+        eth1 cache's current tree root/count, enough for a fresh node
+        to resume deposit reconstruction (genesis/eth1 follower)."""
+        eth1 = getattr(self.chain, "eth1", None)
+        cache = getattr(eth1, "cache", None)
+        if cache is None:
+            raise ApiError(404, "no eth1 service wired")
+        n = len(cache.logs)
+        return 200, {
+            "data": {
+                "finalized": [],
+                "deposit_root": "0x" + cache.tree.root(n).hex(),
+                "deposit_count": str(n),
+                "execution_block_hash": "0x"
+                + getattr(cache, "latest_block_hash", b"\x00" * 32).hex(),
+                "execution_block_height": str(
+                    getattr(cache, "latest_block_number", 0)
+                ),
+            }
+        }
+
+    def sync_rewards(self, block_id: str, body: bytes):
+        """POST /eth/v1/beacon/rewards/sync_committee/{block_id}: the
+        per-participant sync reward for one block (rewards/sync_committee
+        semantics — participant_reward from the parent state's totals)."""
+        root = self._resolve_block_root(block_id)
+        block = self.chain.store.get_block(root)
+        if block is None:
+            raise ApiError(404, "block not found")
+        msg = block.message
+        try:
+            agg = msg.body.sync_aggregate
+        except AttributeError:
+            raise ApiError(400, "pre-altair block has no sync aggregate")
+        parent_state = self.chain.state_for_block(bytes(msg.parent_root))
+        if parent_state is None:
+            raise ApiError(404, "parent state unavailable (pruned)")
+        spec = self.chain.spec
+        # the committee/reward basis is the state AT the block's slot
+        # (a period-boundary block rotates next->current committee)
+        work = parent_state
+        if int(work.slot) < int(msg.slot):
+            work = parent_state.copy()
+            st.process_slots(spec, work, int(msg.slot))
+        parent_state = work
+        total_active = st.get_total_active_balance(spec, parent_state)
+        inc = spec.effective_balance_increment
+        base_per_inc = (
+            inc * spec.base_reward_factor // st._integer_sqrt(total_active)
+        )
+        total_base = (total_active // inc) * base_per_inc
+        max_rewards = (
+            total_base
+            * st.SYNC_REWARD_WEIGHT
+            // st.WEIGHT_DENOMINATOR
+            // spec.preset.slots_per_epoch
+        )
+        participant_reward = max_rewards // spec.preset.sync_committee_size
+        ids = json.loads(body) if body else []
+        want = {int(i) for i in ids} if ids else None
+        committee = parent_state.current_sync_committee
+        out = []
+        for pos, bit in enumerate(agg.sync_committee_bits):
+            idx = self.chain.pubkey_cache.get_index(
+                bytes(committee.pubkeys[pos])
+            )
+            if idx is None or (want is not None and idx not in want):
+                continue
+            out.append(
+                {
+                    "validator_index": str(idx),
+                    "reward": str(
+                        participant_reward if bit else -participant_reward
+                    ),
+                }
+            )
+        return 200, {"data": out}
+
+    def attestation_rewards(self, epoch: str, body: bytes):
+        """POST /eth/v1/beacon/rewards/attestations/{epoch}: ideal and
+        actual attestation rewards, computed with the same vectorized
+        flag/weight formulas as epoch processing
+        (consensus/state_transition.process_rewards_and_penalties)."""
+        import numpy as np
+
+        try:
+            ep = int(epoch)
+            ids = [int(i) for i in json.loads(body)] if body else []
+        except (ValueError, TypeError):
+            raise ApiError(400, "bad epoch or index list")
+        state = self._head_state("head")
+        spec = self.chain.spec
+        head_epoch = st.compute_epoch_at_slot(spec, int(state.slot))
+        if ep != head_epoch - 1:
+            raise ApiError(
+                400,
+                "only the head state's previous epoch is served "
+                f"(requested {ep}, serving {head_epoch - 1})",
+            )
+        (
+            eff,
+            slashed,
+            act,
+            exit_e,
+            _withdrawable,
+            prev_part,
+            _cur_part,
+        ) = st._epoch_arrays(state)
+        prev = st.get_previous_epoch(spec, state)
+        active_prev = (act <= prev) & (prev < exit_e)
+        unslashed_prev = active_prev & ~slashed
+        inc = spec.effective_balance_increment
+        total_active = max(
+            int(eff[(act <= head_epoch) & (head_epoch < exit_e)].sum()), inc
+        )
+        base_per_inc = (
+            inc * spec.base_reward_factor // st._integer_sqrt(total_active)
+        )
+        base_rewards = (eff // inc).astype(np.int64) * base_per_inc
+        total_inc = total_active // inc
+        leak = st.is_in_inactivity_leak(spec, state)
+        n = len(state.validators)
+        names = ("source", "target", "head")
+        # eligibility gates every delta, as in the canonical pass
+        # (process_rewards_and_penalties): ineligible validators get 0
+        withdrawable = np.fromiter(
+            (min(v.withdrawable_epoch, 2**62) for v in state.validators),
+            np.int64,
+            n,
+        )
+        eligible = active_prev | (
+            slashed & (prev + 1 < withdrawable)
+        )
+        actual = {k: np.zeros(n, np.int64) for k in names}
+        flag_incs = []
+        for flag_index, weight in enumerate(st.PARTICIPATION_FLAG_WEIGHTS):
+            has_flag = unslashed_prev & (
+                (prev_part & (1 << flag_index)) != 0
+            )
+            flag_inc = int(eff[has_flag].sum()) // inc
+            flag_incs.append(flag_inc)
+            rewards = (
+                base_rewards * weight * flag_inc
+                // (total_inc * st.WEIGHT_DENOMINATOR)
+            )
+            penalty = (
+                base_rewards * weight // st.WEIGHT_DENOMINATOR
+                if flag_index != st.TIMELY_HEAD_FLAG_INDEX
+                else np.zeros(n, np.int64)
+            )
+            actual[names[flag_index]] = np.where(
+                eligible,
+                np.where(has_flag, 0 if leak else rewards, -penalty),
+                0,
+            )
+        ideal_by_eff = {}
+        for e_bal in sorted({int(v) for v in eff}):
+            b = (e_bal // inc) * base_per_inc
+            entry = {"effective_balance": str(e_bal)}
+            for flag_index, weight in enumerate(
+                st.PARTICIPATION_FLAG_WEIGHTS
+            ):
+                entry[names[flag_index]] = str(
+                    0
+                    if leak
+                    else b * weight * flag_incs[flag_index]
+                    // (total_inc * st.WEIGHT_DENOMINATOR)
+                )
+            ideal_by_eff[e_bal] = entry
+        which = ids if ids else [
+            i for i in range(n) if active_prev[i]
+        ]
+        total = [
+            {
+                "validator_index": str(i),
+                "head": str(int(actual["head"][i])),
+                "target": str(int(actual["target"][i])),
+                "source": str(int(actual["source"][i])),
+                "inactivity": "0",
+            }
+            for i in which
+            if 0 <= i < n
+        ]
+        return 200, {
+            "data": {
+                "ideal_rewards": list(ideal_by_eff.values()),
+                "total_rewards": total,
+            }
+        }
+
 
 # ------------------------------------------------------------ json codecs
 
@@ -946,9 +1284,16 @@ _QUERY_HANDLERS = {
     "headers_list",
     "attestation_data",
     "aggregate_attestation",
+    "sync_contribution",
+    "state_randao",
 }
 # POST handlers whose route captures a path argument (arg, body)
-_POST_PATH_HANDLERS = {"attester_duties"}
+_POST_PATH_HANDLERS = {
+    "attester_duties",
+    "sync_duties",
+    "sync_rewards",
+    "attestation_rewards",
+}
 
 _ROUTES = [
     ("GET", re.compile(r"^/eth/v1/node/health$"), "node_health"),
@@ -1116,6 +1461,53 @@ _ROUTES = [
         "POST",
         re.compile(r"^/eth/v1/beacon/pool/bls_to_execution_changes$"),
         "publish_bls_change",
+    ),
+    # -------- round-4c surface
+    (
+        "POST",
+        re.compile(r"^/eth/v1/validator/duties/sync/([^/]+)$"),
+        "sync_duties",
+    ),
+    (
+        "GET",
+        re.compile(r"^/eth/v1/validator/sync_committee_contribution$"),
+        "sync_contribution",
+    ),
+    (
+        "POST",
+        re.compile(r"^/eth/v1/beacon/pool/sync_committees$"),
+        "publish_sync_message",
+    ),
+    (
+        "POST",
+        re.compile(r"^/eth/v1/validator/contribution_and_proofs$"),
+        "publish_contribution",
+    ),
+    (
+        "POST",
+        re.compile(r"^/eth/v1/validator/sync_committee_subscriptions$"),
+        "sync_subscriptions",
+    ),
+    (
+        "GET",
+        re.compile(r"^/eth/v1/beacon/states/([^/]+)/randao$"),
+        "state_randao",
+    ),
+    ("GET", re.compile(r"^/eth/v1/node/peers/([^/]+)$"), "node_peer"),
+    (
+        "GET",
+        re.compile(r"^/eth/v1/beacon/deposit_snapshot$"),
+        "deposit_snapshot",
+    ),
+    (
+        "POST",
+        re.compile(r"^/eth/v1/beacon/rewards/sync_committee/([^/]+)$"),
+        "sync_rewards",
+    ),
+    (
+        "POST",
+        re.compile(r"^/eth/v1/beacon/rewards/attestations/([^/]+)$"),
+        "attestation_rewards",
     ),
 ]
 

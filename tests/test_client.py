@@ -352,3 +352,107 @@ def test_rest_api_round4_surface(api):
     state_t = F.beacon_state_t(fork)
     decoded = state_t.deserialize(raw)
     assert state_t.serialize(decoded) == raw
+
+
+def test_rest_api_round4c_surface(api):
+    """Third widening pass: sync-committee validator flow, randao,
+    rewards/attestations + rewards/sync_committee, per-peer lookup,
+    deposit snapshot 404 shape."""
+    client, base = api
+    chain = client.chain
+
+    # sync duties: every dev validator sits in the (tiny) committee
+    req = urllib.request.Request(
+        base + "/eth/v1/validator/duties/sync/0",
+        data=json.dumps(["0", "1"]).encode(),
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=5) as r:
+        duties = json.loads(r.read())["data"]
+    assert {d["validator_index"] for d in duties} <= {"0", "1"}
+    for d in duties:
+        assert d["validator_sync_committee_indices"]
+
+    # randao: current epoch mix matches the state directly
+    raw, _ = _get(base, "/eth/v1/beacon/states/head/randao")
+    mix = json.loads(raw)["data"]["randao"]
+    assert mix.startswith("0x") and len(mix) == 66
+    # out-of-window epoch is a 400
+    req = urllib.request.Request(
+        base + "/eth/v1/beacon/states/head/randao?epoch=999999"
+    )
+    try:
+        urllib.request.urlopen(req, timeout=5)
+        raise AssertionError("expected 400")
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+
+    # sync contribution: miss is a clean 404
+    req = urllib.request.Request(
+        base + "/eth/v1/validator/sync_committee_contribution"
+        "?slot=1&subcommittee_index=0&beacon_block_root=0x" + "00" * 32
+    )
+    try:
+        urllib.request.urlopen(req, timeout=5)
+        raise AssertionError("expected 404")
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
+
+    # per-peer lookup: unknown peer 404s
+    try:
+        urllib.request.urlopen(base + "/eth/v1/node/peers/nope", timeout=5)
+        raise AssertionError("expected 404")
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
+
+    # deposit snapshot: no eth1 service wired in the dev client
+    try:
+        urllib.request.urlopen(
+            base + "/eth/v1/beacon/deposit_snapshot", timeout=5
+        )
+        raise AssertionError("expected 404")
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
+
+    # sync rewards for the head block: every entry carries a reward
+    req = urllib.request.Request(
+        base + "/eth/v1/beacon/rewards/sync_committee/head",
+        data=json.dumps([]).encode(),
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=5) as r:
+        rewards = json.loads(r.read())["data"]
+    assert isinstance(rewards, list)
+    for entry in rewards:
+        assert int(entry["reward"]) != 0
+
+    # attestation rewards: only head_epoch-1 is served; at epoch 0 the
+    # request for it may be epoch -1 -> expect a clean 400 there,
+    # otherwise a well-formed ideal/total payload
+    spec = chain.spec
+    from lighthouse_tpu.consensus import state_transition as st
+
+    head_epoch = st.compute_epoch_at_slot(spec, int(chain.head.slot))
+    req = urllib.request.Request(
+        base + f"/eth/v1/beacon/rewards/attestations/{max(head_epoch - 1, 0)}",
+        data=json.dumps(["0"]).encode(),
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=5) as r:
+            payload = json.loads(r.read())["data"]
+        assert "ideal_rewards" in payload and "total_rewards" in payload
+    except urllib.error.HTTPError as e:
+        assert e.code == 400 and head_epoch == 0
+
+    # sync-committee pool POST: a garbage message is rejected, not 200
+    req = urllib.request.Request(
+        base + "/eth/v1/beacon/pool/sync_committees",
+        data=b"\x00" * 10,
+        method="POST",
+    )
+    try:
+        urllib.request.urlopen(req, timeout=5)
+        raise AssertionError("expected error")
+    except urllib.error.HTTPError as e:
+        assert e.code in (400, 500)
