@@ -132,6 +132,23 @@ def _build_parser() -> argparse.ArgumentParser:
     ig.add_argument("--count", type=int, required=True)
     ig.add_argument("--genesis-time", type=int, default=0)
     ig.add_argument("--out", required=True)
+    cg = lcli_sub.add_parser("change-genesis-time")
+    cg.add_argument("--pre", required=True)
+    cg.add_argument("--genesis-time", type=int, required=True)
+    cg.add_argument("--out", required=True)
+    cd = lcli_sub.add_parser("check-deposit-data")
+    cd.add_argument("file", help="deposit_data.json (list of entries)")
+    ia = lcli_sub.add_parser("indexed-attestations")
+    ia.add_argument("--state", required=True)
+    ia.add_argument("--attestation", required=True)
+    cp = lcli_sub.add_parser("create-payload-header")
+    cp.add_argument("--block-hash", required=True, help="0x.. 32 bytes")
+    cp.add_argument("--timestamp", type=int, required=True)
+    cp.add_argument("--out", required=True)
+    mv = lcli_sub.add_parser("mnemonic-validators")
+    mv.add_argument("--mnemonic", required=True)
+    mv.add_argument("--count", type=int, required=True)
+    mv.add_argument("--first-index", type=int, default=0)
 
     vm = sub.add_parser("vm", help="validator manager (bulk create/import/move)")
     vm_sub = vm.add_subparsers(dest="vm_cmd", required=True)
@@ -608,6 +625,47 @@ def cmd_lcli(args) -> int:
         return 0
     if args.lcli_cmd == "insecure-validators":
         print(json.dumps(L.insecure_validators(args.count, args.first_index)))
+        return 0
+    if args.lcli_cmd == "change-genesis-time":
+        with open(args.pre, "rb") as f:
+            pre = f.read()
+        out = L.change_genesis_time(pre, args.genesis_time)
+        with open(args.out, "wb") as f:
+            f.write(out)
+        print(f"wrote re-stamped state to {args.out}")
+        return 0
+    if args.lcli_cmd == "check-deposit-data":
+        with open(args.file) as f:
+            entries = json.load(f)
+        if isinstance(entries, dict):
+            entries = [entries]
+        results = [L.check_deposit_data(e) for e in entries]
+        print(json.dumps(results, indent=1))
+        return 0 if all(r["valid"] for r in results) else 1
+    if args.lcli_cmd == "indexed-attestations":
+        with open(args.state, "rb") as f:
+            state = f.read()
+        with open(args.attestation, "rb") as f:
+            att = f.read()
+        print(json.dumps(L.indexed_attestation(spec, state, att), indent=1))
+        return 0
+    if args.lcli_cmd == "create-payload-header":
+        out = L.create_payload_header(
+            bytes.fromhex(args.block_hash.replace("0x", "")),
+            args.timestamp,
+        )
+        with open(args.out, "wb") as f:
+            f.write(out)
+        print(f"wrote payload header to {args.out}")
+        return 0
+    if args.lcli_cmd == "mnemonic-validators":
+        print(
+            json.dumps(
+                L.mnemonic_validators(
+                    args.mnemonic, args.count, args.first_index
+                )
+            )
+        )
         return 0
     if args.lcli_cmd == "new-testnet":
         bundle = L.new_testnet(spec, args.count, args.genesis_time)

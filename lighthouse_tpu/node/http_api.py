@@ -965,7 +965,7 @@ class BeaconApi:
             raise ApiError(400, "bad epoch")
         # randao_mixes only holds EPOCHS_PER_HISTORICAL_VECTOR entries
         span = spec.preset.epochs_per_historical_vector
-        if not head_epoch - span < ep <= head_epoch:
+        if ep < 0 or not head_epoch - span < ep <= head_epoch:
             raise ApiError(400, f"epoch {ep} outside the mixes window")
         mix = st.get_randao_mix(spec, state, ep)
         return 200, {"data": {"randao": "0x" + bytes(mix).hex()}}
@@ -1049,21 +1049,22 @@ class BeaconApi:
         ids = json.loads(body) if body else []
         want = {int(i) for i in ids} if ids else None
         committee = parent_state.current_sync_committee
-        out = []
+        # one aggregated entry per VALIDATOR (a validator can hold
+        # several committee positions; clients key on validator_index)
+        totals: dict[int, int] = {}
         for pos, bit in enumerate(agg.sync_committee_bits):
             idx = self.chain.pubkey_cache.get_index(
                 bytes(committee.pubkeys[pos])
             )
             if idx is None or (want is not None and idx not in want):
                 continue
-            out.append(
-                {
-                    "validator_index": str(idx),
-                    "reward": str(
-                        participant_reward if bit else -participant_reward
-                    ),
-                }
+            totals[idx] = totals.get(idx, 0) + (
+                participant_reward if bit else -participant_reward
             )
+        out = [
+            {"validator_index": str(i), "reward": str(r)}
+            for i, r in sorted(totals.items())
+        ]
         return 200, {"data": out}
 
     def attestation_rewards(self, epoch: str, body: bytes):
@@ -1092,7 +1093,7 @@ class BeaconApi:
             slashed,
             act,
             exit_e,
-            _withdrawable,
+            withdrawable,
             prev_part,
             _cur_part,
         ) = st._epoch_arrays(state)
@@ -1113,11 +1114,6 @@ class BeaconApi:
         names = ("source", "target", "head")
         # eligibility gates every delta, as in the canonical pass
         # (process_rewards_and_penalties): ineligible validators get 0
-        withdrawable = np.fromiter(
-            (min(v.withdrawable_epoch, 2**62) for v in state.validators),
-            np.int64,
-            n,
-        )
         eligible = active_prev | (
             slashed & (prev + 1 < withdrawable)
         )

@@ -161,6 +161,125 @@ def insecure_validators(count: int, first_index: int = 0) -> list:
     return out
 
 
+def change_genesis_time(pre_ssz: bytes, genesis_time: int) -> bytes:
+    """lcli change-genesis-time: re-stamp a genesis state (testnet
+    restarts reuse the state with a fresh clock)."""
+    state = T.BeaconState.deserialize(pre_ssz)
+    state.genesis_time = int(genesis_time)
+    return state.serialize()
+
+
+def check_deposit_data(entry: dict) -> dict:
+    """lcli check-deposit-data: validate one staking deposit-cli entry —
+    pubkey/signature well-formed, deposit-message signature verifies
+    under the deposit domain of the entry's fork_version, and both
+    roots recompute. Returns {valid, errors}."""
+    from ..crypto.bls.keys import PublicKey, Signature
+    from ..crypto import bls
+
+    errors = []
+    try:
+        pk_b = bytes.fromhex(entry["pubkey"].replace("0x", ""))
+        wc = bytes.fromhex(entry["withdrawal_credentials"].replace("0x", ""))
+        sig_b = bytes.fromhex(entry["signature"].replace("0x", ""))
+        amount = int(entry["amount"])
+        fork_version = bytes.fromhex(
+            entry.get("fork_version", "00000000").replace("0x", "")
+        )
+    except (KeyError, ValueError) as e:
+        return {"valid": False, "errors": [f"malformed entry: {e}"]}
+    try:
+        pk = PublicKey.from_bytes(pk_b)
+    except Exception as e:
+        return {"valid": False, "errors": [f"bad pubkey: {e}"]}
+    try:
+        sig = Signature.from_bytes(sig_b)
+    except Exception as e:
+        return {"valid": False, "errors": [f"bad signature: {e}"]}
+    msg = T.DepositMessage.make(
+        pubkey=pk_b, withdrawal_credentials=wc, amount=amount
+    )
+    msg_root = T.DepositMessage.hash_tree_root(msg)
+    if "deposit_message_root" in entry:
+        want = bytes.fromhex(entry["deposit_message_root"].replace("0x", ""))
+        if want != msg_root:
+            errors.append("deposit_message_root mismatch")
+    data = T.DepositData.make(
+        pubkey=pk_b,
+        withdrawal_credentials=wc,
+        amount=amount,
+        signature=sig_b,
+    )
+    if "deposit_data_root" in entry:
+        want = bytes.fromhex(entry["deposit_data_root"].replace("0x", ""))
+        if want != T.DepositData.hash_tree_root(data):
+            errors.append("deposit_data_root mismatch")
+    from ..consensus.domains import compute_domain, compute_signing_root
+
+    domain = compute_domain(
+        ChainSpec().domain_deposit, fork_version, b"\x00" * 32
+    )
+    signing_root = compute_signing_root(msg, domain)
+    if not bls.verify(sig, pk, signing_root):
+        errors.append("deposit signature invalid")
+    return {"valid": not errors, "errors": errors}
+
+
+def indexed_attestation(
+    spec: ChainSpec, state_ssz: bytes, attestation_ssz: bytes
+) -> dict:
+    """lcli indexed-attestations: resolve an attestation's committee
+    bits against a state into the indexed form."""
+    state = T.BeaconState.deserialize(state_ssz)
+    att = T.Attestation.deserialize(attestation_ssz)
+    indices = st.get_attesting_indices(spec, state, att)
+    indexed = T.IndexedAttestation.make(
+        attesting_indices=sorted(indices),
+        data=att.data,
+        signature=bytes(att.signature),
+    )
+    return _to_jsonable(indexed)
+
+
+def create_payload_header(
+    block_hash: bytes, timestamp: int, fee_recipient: bytes = b"\x00" * 20
+) -> bytes:
+    """lcli create-payload-header: a merge-testnet genesis
+    ExecutionPayloadHeader SSZ with the given terminal block hash."""
+    h = T.ExecutionPayloadHeader.default()
+    h.block_hash = block_hash
+    h.timestamp = int(timestamp)
+    h.fee_recipient = fee_recipient
+    return h.serialize()
+
+
+def mnemonic_validators(
+    mnemonic: str, count: int, first_index: int = 0, passphrase: str = ""
+) -> list:
+    """lcli mnemonic-validators: EIP-2334 signing keys from a BIP-39
+    mnemonic (the path every launchpad wallet uses; pinned against
+    deposit-cli vectors in tests/test_external_vectors.py)."""
+    from ..crypto.keystore.key_derivation import (
+        derive_path,
+        mnemonic_to_seed,
+        validator_signing_path,
+    )
+    from ..crypto.bls.keys import SecretKey
+
+    seed = mnemonic_to_seed(mnemonic, passphrase)
+    out = []
+    for i in range(first_index, first_index + count):
+        sk = SecretKey(derive_path(seed, validator_signing_path(i)))
+        out.append(
+            {
+                "index": i,
+                "path": validator_signing_path(i),
+                "pubkey": "0x" + sk.public_key().to_bytes().hex(),
+            }
+        )
+    return out
+
+
 def new_testnet(
     spec: ChainSpec,
     validator_count: int,

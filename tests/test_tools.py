@@ -347,3 +347,80 @@ def test_watch_round4_tables(tmp_path):
     assert db.missed_slots() == [5]
     assert db.reward_stats()["blocks"] == 2
     assert db.balance_history(0) == [(6, 32_000_000_000)]
+
+
+def test_lcli_round4c_toolbox(tmp_path):
+    """change-genesis-time / check-deposit-data (against the real
+    deposit-cli vector) / indexed-attestations / create-payload-header /
+    mnemonic-validators."""
+    import json as _json
+    from pathlib import Path
+
+    from lighthouse_tpu.cli import main as cli_main
+    from lighthouse_tpu.consensus import types as T
+    from lighthouse_tpu.consensus.spec import mainnet_spec
+    from lighthouse_tpu.tools import lcli as L
+
+    spec = mainnet_spec()
+
+    # change-genesis-time round-trips through the CLI
+    state_ssz = L.interop_genesis(spec, 4, genesis_time=7)
+    restamped = L.change_genesis_time(state_ssz, 123456)
+    assert T.BeaconState.deserialize(restamped).genesis_time == 123456
+
+    # check-deposit-data on a REAL staking-deposit-cli entry
+    vec = Path(__file__).parent / "vectors" / "external" / (
+        "deposit_data_mainnet_0_2.json"
+    )
+    entries = _json.loads(vec.read_text())
+    for e in entries:
+        res = L.check_deposit_data(e)
+        assert res["valid"], res["errors"]
+    # and a corrupted amount must fail the signature
+    bad = dict(entries[0])
+    bad["amount"] = int(bad["amount"]) + 1
+    assert not L.check_deposit_data(bad)["valid"]
+
+    # create-payload-header decodes back with the fields set
+    h_ssz = L.create_payload_header(b"\x11" * 32, 99)
+    h = T.ExecutionPayloadHeader.deserialize(h_ssz)
+    assert bytes(h.block_hash) == b"\x11" * 32 and int(h.timestamp) == 99
+
+    # mnemonic-validators matches the deposit-cli vector's pubkey
+    # (the staking-deposit-cli test mnemonic, index 0 -> entries[0])
+    MNEMONIC = "test test test test test test test test test test test waste"
+    mv = L.mnemonic_validators(MNEMONIC, 1)
+    assert mv[0]["pubkey"].removeprefix("0x") == entries[0]["pubkey"]
+
+    # indexed-attestations: resolve a crafted single-bit attestation
+    # against the genesis state and check the committee resolution
+    from lighthouse_tpu.consensus import state_transition as st
+
+    state_ssz = L.interop_genesis(spec, 64, genesis_time=0)
+    state = T.BeaconState.deserialize(state_ssz)
+    committee = st.get_beacon_committee(spec, state, 0, 0)
+    bits = [False] * len(committee)
+    bits[0] = True
+    att = T.Attestation.make(
+        aggregation_bits=bits,
+        data=T.AttestationData.make(
+            slot=0,
+            index=0,
+            beacon_block_root=b"\x22" * 32,
+            source=T.Checkpoint.make(epoch=0, root=b"\x00" * 32),
+            target=T.Checkpoint.make(epoch=0, root=b"\x22" * 32),
+        ),
+        signature=b"\xc0" + b"\x00" * 95,
+    )
+    indexed = L.indexed_attestation(spec, state_ssz, att.serialize())
+    assert indexed["attesting_indices"] == [str(committee[0])]
+    assert indexed["data"]["beacon_block_root"] == "0x" + "22" * 32
+
+    # and via the CLI files round-trip
+    (tmp_path / "s.ssz").write_bytes(state_ssz)
+    (tmp_path / "a.ssz").write_bytes(att.serialize())
+    rc = cli_main(
+        ["lcli", "indexed-attestations", "--state", str(tmp_path / "s.ssz"),
+         "--attestation", str(tmp_path / "a.ssz")]
+    )
+    assert rc == 0
