@@ -47,6 +47,7 @@ import os
 import signal
 import statistics
 import sys
+import threading
 import time
 
 # The round-3 lane kernels hold f12-sized tensors (~19.5 MB at batch
@@ -267,7 +268,33 @@ def main():
     from lighthouse_tpu.crypto.bls.backends import cpu as CB
 
     detail = _STATE["detail"]
-    detail["device"] = str(jax.devices()[0])
+    # Bound the FIRST device contact: a dead chip tunnel blocks
+    # jax.devices() inside the PJRT client init (a C call the SIGALRM
+    # handler cannot interrupt — Python signals run between bytecodes),
+    # which is exactly how a driver run turns into an opaque rc=124.
+    # Probe from a daemon thread and emit the JSON error line if the
+    # backend does not come up in time.
+    init_timeout = float(os.environ.get("BENCH_INIT_TIMEOUT_S", "600"))
+    init_box = {}
+
+    def _probe():
+        try:
+            init_box["device"] = str(jax.devices()[0])
+        except BaseException as e:  # noqa: BLE001 - recorded, not raised
+            init_box["error"] = f"{type(e).__name__}: {e}"
+
+    th = threading.Thread(target=_probe, daemon=True)
+    th.start()
+    th.join(init_timeout)
+    if "device" not in init_box:
+        detail["backend_init"] = {
+            "error": init_box.get(
+                "error", f"no backend within {init_timeout:.0f}s"
+            )
+        }
+        _emit()
+        os._exit(3)
+    detail["device"] = init_box["device"]
     detail["blst_anchor"] = {
         "sets_per_s_per_core": BLST_SETS_PER_S_PER_CORE,
         "host_cores": BLST_HOST_CORES,

@@ -90,6 +90,14 @@ def _build_parser() -> argparse.ArgumentParser:
     vd.add_argument("--count", type=int, default=1)
     ki = acct_sub.add_parser("keystore-inspect")
     ki.add_argument("keystore")
+    ve = acct_sub.add_parser("validator-exit")
+    ve.add_argument("--keystore", required=True)
+    ve.add_argument("--validator-index", type=int, required=True)
+    ve.add_argument("--beacon-url", default="http://127.0.0.1:5052")
+    ve.add_argument("--epoch", type=int, default=None,
+                    help="exit epoch (default: the BN fork's epoch)")
+    ve.add_argument("--dry-run", action="store_true",
+                    help="print the signed exit, do not publish")
 
     db = sub.add_parser("db", help="store inspect/compact/prune")
     db.add_argument("--datadir", default="./datadir")
@@ -504,6 +512,63 @@ def cmd_account(args) -> int:
             ks = Keystore.from_json(f.read())
         print(json.dumps({"pubkey": "0x" + ks.pubkey.hex(), "path": ks.path,
                           "uuid": ks.uuid}, indent=2))
+        return 0
+    if args.account_cmd == "validator-exit":
+        # `lighthouse account validator exit` analog: decrypt the
+        # keystore, sign a VoluntaryExit exactly the way the chain
+        # verifies it (signature_sets.exit_signature_set), publish via
+        # the beacon API pool route (SSZ body).
+        from .common.eth2 import BeaconNodeHttpClient
+        from .consensus import types as T
+        from .consensus.domains import compute_signing_root, get_domain
+
+        with open(args.keystore) as f:
+            ks = Keystore.from_json(f.read())
+        password = getpass.getpass("keystore password: ")
+        sk = ks.decrypt(password)
+        bn = BeaconNodeHttpClient(args.beacon_url)
+        # refuse to sign for an index whose registry pubkey is not the
+        # keystore's key — a mistyped index would publish a doomed exit
+        reg_pk = bn.validator(args.validator_index)["pubkey"]
+        if reg_pk != ks.pubkey:
+            print(
+                f"validator {args.validator_index} has pubkey "
+                f"0x{reg_pk.hex()[:16]}.., keystore holds "
+                f"0x{ks.pubkey.hex()[:16]}.. — refusing to sign",
+                file=sys.stderr,
+            )
+            return 1
+        gvr = bn.genesis()["genesis_validators_root"]
+        fork_d = bn.state_fork()
+        fork = T.Fork.make(
+            previous_version=fork_d["previous_version"],
+            current_version=fork_d["current_version"],
+            epoch=fork_d["epoch"],
+        )
+        epoch = args.epoch if args.epoch is not None else fork_d["epoch"]
+        exit_msg = T.VoluntaryExit.make(
+            epoch=epoch, validator_index=args.validator_index
+        )
+        spec = _spec(args)
+        domain = get_domain(
+            spec, spec.domain_voluntary_exit, epoch, fork, gvr
+        )
+        sig = sk.sign(compute_signing_root(exit_msg, domain))
+        signed = T.SignedVoluntaryExit.make(
+            message=exit_msg, signature=sig.to_bytes()
+        )
+        payload = {
+            "message": {
+                "epoch": str(epoch),
+                "validator_index": str(args.validator_index),
+            },
+            "signature": "0x" + sig.to_bytes().hex(),
+        }
+        if args.dry_run:
+            print(json.dumps(payload, indent=1))
+            return 0
+        bn.publish_voluntary_exit_ssz(signed.serialize())
+        print(json.dumps({"published": payload}))
         return 0
     return 2
 

@@ -191,6 +191,19 @@ class BeaconNodeHttpClient:
 
     # ------------------------------------------------------------ publish
 
+    def state_fork(self, state_id: str = "head") -> dict:
+        d = self._get_json(f"/eth/v1/beacon/states/{state_id}/fork")["data"]
+        return {
+            "previous_version": bytes.fromhex(d["previous_version"][2:]),
+            "current_version": bytes.fromhex(d["current_version"][2:]),
+            "epoch": int(d["epoch"]),
+        }
+
+    def publish_voluntary_exit_ssz(self, ssz: bytes) -> None:
+        self._request(
+            "POST", "/eth/v1/beacon/pool/voluntary_exits", body=ssz
+        )
+
     def publish_attestation_ssz(self, ssz: bytes) -> None:
         self._request("POST", "/eth/v1/beacon/pool/attestations", body=ssz)
 

@@ -456,3 +456,34 @@ def test_rest_api_round4c_surface(api):
         raise AssertionError("expected error")
     except urllib.error.HTTPError as e:
         assert e.code in (400, 500)
+
+
+def test_account_validator_exit_cli(api, tmp_path, monkeypatch):
+    """`account validator-exit` end to end: decrypt keystore, sign with
+    the chain-verified domain, publish through the REST pool route, and
+    land in the op pool."""
+    client, base = api
+    from lighthouse_tpu.cli import main as cli_main
+    from lighthouse_tpu.crypto.keystore.keystore import Keystore
+
+    sk = SecretKey.from_seed((0).to_bytes(4, "big"))
+    ks = Keystore.encrypt(sk, "pw", path="m/12381/3600/0/0/0", scrypt_n=8)
+    ks_path = tmp_path / "ks.json"
+    ks_path.write_text(ks.to_json())
+    monkeypatch.setattr("getpass.getpass", lambda *a, **k: "pw")
+
+    rc = cli_main(
+        ["account", "validator-exit", "--keystore", str(ks_path),
+         "--validator-index", "0", "--beacon-url", base, "--dry-run"]
+    )
+    assert rc == 0
+
+    rc = cli_main(
+        ["account", "validator-exit", "--keystore", str(ks_path),
+         "--validator-index", "0", "--beacon-url", base]
+    )
+    assert rc == 0
+    exits = client.chain.op_pool.get_slashings_and_exits(
+        client.chain.head_state()
+    )[2]
+    assert any(int(e.message.validator_index) == 0 for e in exits)
