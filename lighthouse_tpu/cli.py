@@ -529,7 +529,17 @@ def cmd_account(args) -> int:
         bn = BeaconNodeHttpClient(args.beacon_url)
         # refuse to sign for an index whose registry pubkey is not the
         # keystore's key — a mistyped index would publish a doomed exit
-        reg_pk = bn.validator(args.validator_index)["pubkey"]
+        from .common.eth2 import ApiClientError
+
+        try:
+            reg_pk = bn.validator(args.validator_index)["pubkey"]
+        except ApiClientError as e:
+            print(
+                f"validator {args.validator_index} not found at "
+                f"{args.beacon_url}: {e}",
+                file=sys.stderr,
+            )
+            return 1
         if reg_pk != ks.pubkey:
             print(
                 f"validator {args.validator_index} has pubkey "

@@ -11,6 +11,9 @@ produces. Behavior kept from round 3:
   - per-peer delivery accounting feeding peer scoring
     (gossipsub/src/peer_score.rs role).
 Mesh membership changes also emit spec GRAFT/PRUNE control frames.
+Round 4c adds the v1.2 IDONTWANT flow: large received messages are
+announced to the rest of the mesh before the payload forward, and
+incoming IDONTWANTs suppress our duplicate forwards for the window.
 """
 
 from __future__ import annotations
@@ -32,6 +35,10 @@ SEEN_CACHE_SIZE = 4096
 # peer-score thresholds (gossipsub v1.1 scoring, peer_score.rs role;
 # magnitudes follow the reference's beacon defaults' shape)
 PRUNE_BACKOFF = 60           # heartbeats before re-grafting a pruner
+# gossipsub v1.2 (IDONTWANT): only messages at least this large are
+# worth a control round-trip to suppress; cap what one peer may park
+IDONTWANT_SIZE_THRESHOLD = 1000
+IDONTWANT_MAX_PER_PEER = 1024
 GOSSIP_THRESHOLD = -40.0     # below: ignore their gossip + IHAVE
 GRAYLIST_THRESHOLD = -80.0   # below: prune everywhere, drop frames
 SCORE_DECAY = 0.9            # per-heartbeat multiplicative decay
@@ -86,6 +93,10 @@ class GossipRouter:
         # PRUNE backoff: (topic, peer) -> heartbeat number we may
         # re-graft at (spec: respect the pruner's backoff window)
         self._backoff: dict[tuple, int] = {}
+        # gossipsub v1.2 IDONTWANT: peer -> mids the peer told us not
+        # to forward it this window; cleared every heartbeat, capped
+        # per peer so a peer cannot grow our state without bound
+        self._dont_want: dict[str, set] = {}
 
     # -- membership
 
@@ -133,7 +144,7 @@ class GossipRouter:
         mid = W.message_id_from_ssz(topic, data)
         self._mark_seen(mid)
         self._mcache[0][mid] = (topic, wire)  # serve IWANTs for our own
-        return self._forward(topic, wire, exclude=None)
+        return self._forward(topic, wire, exclude=None, mid=mid)
 
     def handle_frame(self, sender: str, payload: bytes) -> Optional[tuple]:
         """Inbound gossipsub RPC frame: dedup/forward every published
@@ -209,7 +220,18 @@ class GossipRouter:
             self._score(sender, P2_FIRST_DELIVERY)
             self._mark_seen(mid)
             self._mcache[0][mid] = (m.topic, m.data)
-            self._forward(m.topic, m.data, exclude=sender)
+            # v1.2: tell the rest of the mesh we hold this message
+            # BEFORE forwarding the (large) payload, so they can skip
+            # sending us their duplicate copy (threshold on the MESSAGE
+            # size, not the snappy wire size)
+            if len(ssz) >= IDONTWANT_SIZE_THRESHOLD:
+                note = W.GossipRpc()
+                note.control.idontwant.append(mid)
+                frame = W.encode_rpc(note)
+                for peer in self.mesh.get(m.topic, ()):
+                    if peer != sender:
+                        self.endpoint.send(peer, CHANNEL_GOSSIP, frame)
+            self._forward(m.topic, m.data, exclude=sender, mid=mid)
             if m.topic in self.subscriptions:
                 if self.on_message is not None:
                     self.on_message(sender, m.topic, ssz)
@@ -217,16 +239,25 @@ class GossipRouter:
                     delivered = (sender, m.topic, ssz)
         return delivered
 
-    def _forward(self, topic: str, wire: bytes, exclude: Optional[str]) -> int:
+    def _forward(
+        self,
+        topic: str,
+        wire: bytes,
+        exclude: Optional[str],
+        mid: Optional[bytes] = None,
+    ) -> int:
         rpc = W.GossipRpc(
             publish=[W.PublishedMessage(topic=topic, data=wire)]
         )
         frame = W.encode_rpc(rpc)
         n = 0
         for peer in self.mesh.get(topic, ()):
-            if peer != exclude and self.endpoint.send(
-                peer, CHANNEL_GOSSIP, frame
-            ):
+            if peer == exclude:
+                continue
+            # v1.2: honor the peer's IDONTWANT for this window
+            if mid is not None and mid in self._dont_want.get(peer, ()):
+                continue
+            if self.endpoint.send(peer, CHANNEL_GOSSIP, frame):
                 n += 1
         return n
 
@@ -276,6 +307,17 @@ class GossipRouter:
                         break
             if out.publish:
                 self.endpoint.send(sender, CHANNEL_GOSSIP, W.encode_rpc(out))
+        if ctrl.idontwant:
+            dw = self._dont_want.setdefault(sender, set())
+            for mid in ctrl.idontwant:
+                # eth2 gossip ids are exactly 20 bytes; anything else is
+                # junk that would otherwise park frame-sized blobs here
+                if len(mid) != 20:
+                    self._score(sender, P4_INVALID)
+                    continue
+                if len(dw) >= IDONTWANT_MAX_PER_PEER:
+                    break
+                dw.add(mid)
 
     # -- heartbeat (mesh maintenance + IHAVE emission, behaviour.rs role)
 
@@ -296,6 +338,9 @@ class GossipRouter:
         self._backoff = {
             k: until for k, until in self._backoff.items() if until > hb
         }
+        # IDONTWANT holds for one window: the suppressed duplicate is
+        # only in flight around the heartbeat it was announced in
+        self._dont_want.clear()
         candidates = [
             p
             for p in (candidates or [])

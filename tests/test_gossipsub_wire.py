@@ -325,3 +325,70 @@ def test_inbound_graft_accepts_to_dhigh_then_heartbeat_prunes():
     assert len(ra.mesh[topic]) == 2 * G.MESH_HIGH
     ra.heartbeat([f"p{i}" for i in range(30)])
     assert len(ra.mesh[topic]) == G.MESH_SIZE  # pruned back to D
+
+
+def test_idontwant_suppresses_forward_and_is_emitted():
+    """gossipsub v1.2: receiving a large message emits IDONTWANT to the
+    rest of the mesh BEFORE the payload forward; an incoming IDONTWANT
+    suppresses our duplicate forward to that peer for the window, and
+    the state clears at the next heartbeat."""
+    from lighthouse_tpu.network.transport import InProcessHub
+    from lighthouse_tpu.network.gossip import (
+        GossipRouter,
+        IDONTWANT_SIZE_THRESHOLD,
+        topic_for,
+    )
+
+    hub = InProcessHub()
+    a, b, c = hub.join("a"), hub.join("b"), hub.join("c")
+    ra, rb, rc = GossipRouter(a), GossipRouter(b), GossipRouter(c)
+    topic = topic_for("beacon_block", b"\x00" * 4)
+    for r in (ra, rb, rc):
+        r.subscribe(topic)
+    # b's mesh contains both a and c
+    rb.mesh[topic] = {"a", "c"}
+
+    # a -> b: a LARGE message; b must emit IDONTWANT to c (not back to
+    # a) before the payload forward
+    import random as _random
+
+    _random.seed(7)
+    big = bytes(
+        _random.getrandbits(8)
+        for _ in range(IDONTWANT_SIZE_THRESHOLD + 200)
+    )
+    ra.mesh[topic] = {"b"}
+    ra.publish(topic, big)
+    for f in b.drain():
+        rb.handle_frame(f.sender, f.payload)
+    c_frames = c.drain()
+    rpcs = [W.decode_rpc(f.payload) for f in c_frames]
+    idw = [r for r in rpcs if r.control.idontwant]
+    pub = [r for r in rpcs if r.publish]
+    assert idw and pub, "c must see IDONTWANT and the payload"
+    assert rpcs.index(idw[0]) < rpcs.index(pub[0]), "IDONTWANT first"
+    mid = idw[0].control.idontwant[0]
+
+    # now c tells b IDONTWANT for a fresh id; b must not forward that
+    # message to c
+    ssz2 = bytes(
+        _random.getrandbits(8)
+        for _ in range(IDONTWANT_SIZE_THRESHOLD + 50)
+    )
+    mid2 = W.message_id_from_ssz(topic, ssz2)
+    note = W.GossipRpc()
+    note.control.idontwant.append(mid2)
+    rb.handle_frame("c", W.encode_rpc(note))
+    c.drain()
+    ra.publish(topic, ssz2)
+    for f in b.drain():
+        rb.handle_frame(f.sender, f.payload)
+    pubs_to_c = [
+        r
+        for r in (W.decode_rpc(f.payload) for f in c.drain())
+        if r.publish
+    ]
+    assert not pubs_to_c, "suppressed by IDONTWANT"
+    # heartbeat clears the window; the same peer gets forwards again
+    rb.heartbeat(candidates=["a", "c"])
+    assert rb._dont_want == {}
