@@ -78,6 +78,15 @@ def _build_parser() -> argparse.ArgumentParser:
                     help="keymanager API port")
     vc.add_argument("--graffiti-file", default=None)
     vc.add_argument("--enable-doppelganger-protection", action="store_true")
+    vc.add_argument("--builder-url", default=None,
+                    help="external builder/relay for validator "
+                         "registrations (preparation service)")
+    vc.add_argument("--builder-pubkey", default=None,
+                    help="pinned relay identity (hex); bids/regs are "
+                         "only trusted for this key")
+    vc.add_argument("--suggested-fee-recipient", default="0x" + "00" * 20,
+                    help="default fee recipient when the keymanager API "
+                         "has no per-validator override")
 
     acct = sub.add_parser("account", help="wallet/keystore management")
     acct_sub = acct.add_subparsers(dest="account_cmd", required=True)
@@ -429,6 +438,67 @@ def cmd_vc(args) -> int:
     server = ValidatorApiServer(api, args.datadir, port=args.http_port)
     server.start()
     log.info("keymanager API up", port=server.port)
+
+    # preparation service: fee recipients + builder registrations each
+    # epoch, fed by the keymanager API's per-validator overrides
+    from .validator.preparation_service import (
+        DEFAULT_GAS_LIMIT,
+        PreparationService,
+    )
+
+    class _PrepBN:
+        """Resolve indices by pubkey and push prepare_beacon_proposer."""
+
+        def prepare_proposers(self, prep):
+            entries = []
+            for p in prep:
+                try:
+                    idx = _index_of(p["pubkey"])
+                except Exception:
+                    idx = None
+                if idx is None:
+                    continue
+                entries.append(
+                    {
+                        "validator_index": str(idx),
+                        "fee_recipient": "0x" + p["fee_recipient"].hex(),
+                    }
+                )
+            if entries:
+                fallback.first_success(
+                    lambda bn: bn.client.prepare_beacon_proposer(entries)
+                )
+
+    builder = None
+    if args.builder_url:
+        from .execution.builder_client import BuilderClient
+
+        builder = BuilderClient(
+            base_url=args.builder_url,
+            builder_pubkey=(
+                bytes.fromhex(args.builder_pubkey.replace("0x", ""))
+                if args.builder_pubkey
+                else None
+            ),
+        )
+    default_fr = bytes.fromhex(
+        args.suggested_fee_recipient.replace("0x", "")
+    )
+    prep_svc = PreparationService(
+        spec,
+        store,
+        beacon_node=_PrepBN(),
+        builder_client=builder,
+        fee_recipient_for=lambda pk: (
+            bytes.fromhex(api.fee_recipients[bytes(pk)].replace("0x", ""))
+            if bytes(pk) in api.fee_recipients
+            else default_fr
+        ),
+        gas_limit_for=lambda pk: api.gas_limits.get(
+            bytes(pk), DEFAULT_GAS_LIMIT
+        ),
+    )
+    last_prepared_epoch = -1
     last_epoch_checked = -1
     try:
         while True:
@@ -468,6 +538,16 @@ def cmd_vc(args) -> int:
                             )
                     if round_ok:
                         last_epoch_checked = now_epoch
+                if now_epoch > last_prepared_epoch:
+                    try:
+                        prep_svc.prepare_proposers()
+                        prep_svc.register_with_builder(now_epoch)
+                        last_prepared_epoch = now_epoch
+                    except Exception as e:  # noqa: BLE001 — retried
+                        log.warning(
+                            "preparation round failed; will retry",
+                            error=str(e),
+                        )
             log.info(
                 "beacon node health",
                 available=fallback.num_available(),

@@ -199,6 +199,16 @@ class BeaconNodeHttpClient:
             "epoch": int(d["epoch"]),
         }
 
+    def prepare_beacon_proposer(self, entries: list) -> None:
+        """POST /eth/v1/validator/prepare_beacon_proposer (JSON list of
+        {validator_index, fee_recipient})."""
+        self._request(
+            "POST",
+            "/eth/v1/validator/prepare_beacon_proposer",
+            body=json.dumps(entries).encode(),
+            content_type="application/json",
+        )
+
     def publish_voluntary_exit_ssz(self, ssz: bytes) -> None:
         self._request(
             "POST", "/eth/v1/beacon/pool/voluntary_exits", body=ssz

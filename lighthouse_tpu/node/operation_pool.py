@@ -74,7 +74,14 @@ class OperationPool:
         """Store an aggregate for packing (op_pool insert_attestation).
         Aggregates whose signers are a subset of an existing one are
         dropped; supersets replace their subsets."""
-        cb = bytes(int(bool(b)) for b in attestation.committee_bits)
+        # pre-electra attestations carry no committee bits (the union
+        # container yields None); key them by data root alone
+        raw_cb = attestation.committee_bits
+        cb = (
+            b""
+            if raw_cb is None
+            else bytes(int(bool(b)) for b in raw_cb)
+        )
         root = (
             T.AttestationData.hash_tree_root(attestation.data),
             cb if any(cb) else b"",

@@ -160,10 +160,15 @@ class ValidatorClient:
         store: ValidatorStore,
         bn: BeaconNodeApi,
         graffiti_provider=None,
+        preparation_service=None,
     ):
         self.spec = spec
         self.store = store
         self.bn = bn
+        # fee-recipient preparation + builder registrations, run once
+        # per epoch from the slot loop (validator_services wiring)
+        self.preparation = preparation_service
+        self._prepared_epochs: set[int] = set()
         # pubkey -> Optional[32 bytes] (GraffitiFile.graffiti_for /
         # keymanager overrides); None falls back to the BN default
         self.graffiti_provider = graffiti_provider
@@ -194,6 +199,27 @@ class ValidatorClient:
         """Block proposal (block_service)."""
         epoch = st.compute_epoch_at_slot(self.spec, slot)
         self._ensure_duties(epoch)
+        try:
+            self._propose(slot, epoch)
+        finally:
+            # preparation runs AFTER the proposal work: registrations
+            # feed the NEXT proposal's builder bid, and a slow signer
+            # or builder endpoint (seconds of HTTP) must never delay
+            # the block we owe this slot
+            self._run_preparation(epoch)
+
+    def _run_preparation(self, epoch: int) -> None:
+        if self.preparation is None or epoch in self._prepared_epochs:
+            return
+        self._prepared_epochs.add(epoch)
+        try:
+            self.preparation.prepare_proposers()
+            self.preparation.register_with_builder(epoch)
+        except Exception:
+            # never fatal; the next epoch retries
+            self._prepared_epochs.discard(epoch)
+
+    def _propose(self, slot: int, epoch: int) -> None:
         duty = self.duties.proposer_duty_at(slot)
         if duty is None:
             return
@@ -241,7 +267,11 @@ class ValidatorClient:
                 aggregation_bits=bits,
                 data=data,
                 signature=sig,
-                committee_bits=committee_bits,
+                # canonical internal shape: all-zero bits pre-electra
+                # (types.Attestation doc) — None would poison block
+                # packing and SSZ roots downstream
+                committee_bits=committee_bits
+                or [False] * self.spec.preset.max_committees_per_slot,
             )
             try:
                 self.bn.publish_attestation(att)

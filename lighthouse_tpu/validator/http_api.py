@@ -32,6 +32,8 @@ from ..crypto.keystore.keystore import Keystore, KeystoreError
 from .signing_method import LocalKeystoreSigner
 
 API_TOKEN_FILE = "api-token.txt"
+# one source of truth for the default builder-registration gas limit
+from .preparation_service import DEFAULT_GAS_LIMIT
 
 
 class ApiError(Exception):
@@ -59,6 +61,7 @@ class KeymanagerApi:
         # runtime (API-set) per-validator fee recipients + graffiti —
         # the reference persists these in the validator definitions
         self.fee_recipients: dict[bytes, str] = {}
+        self.gas_limits: dict[bytes, int] = {}
         self.graffiti: dict[bytes, str] = graffiti_overrides or {}
         self.default_graffiti = default_graffiti
         # hot-imported keys must get the same doppelganger observation
@@ -190,6 +193,134 @@ class KeymanagerApi:
         self.graffiti.pop(bytes.fromhex(pk_hex[2:]), None)
         return 204, {}
 
+    # ------------------------------------------------------ remotekeys
+    # The keymanager remote-keys family (web3signer-backed validators;
+    # validator_client/http_api's standard::remotekeys routes).
+
+    def list_remotekeys(self):
+        data = []
+        for d in self.initialized.definitions:
+            if d.get("type") != "web3signer":
+                continue
+            data.append(
+                {
+                    "pubkey": d["voting_public_key"],
+                    "url": d.get("url", ""),
+                    "readonly": not d.get("enabled", False),
+                }
+            )
+        return 200, {"data": data}
+
+    def import_remotekeys(self, body: bytes):
+        from .signing_method import Web3SignerMethod
+
+        req = json.loads(body)
+        statuses = []
+        known = {
+            d["voting_public_key"].lower()
+            for d in self.initialized.definitions
+        }
+        for entry in req.get("remote_keys", []):
+            try:
+                if not isinstance(entry, dict):
+                    raise ValueError("entry must be an object")
+                pk_hex = entry["pubkey"]
+                url = entry.get("url", "")
+                if not isinstance(pk_hex, str) or not re.fullmatch(
+                    r"0x[0-9a-fA-F]{96}", pk_hex
+                ):
+                    raise ValueError("bad pubkey")
+                if pk_hex.lower() in known:
+                    statuses.append({"status": "duplicate"})
+                    continue
+                pk = bytes.fromhex(pk_hex[2:])
+                self.initialized.definitions.append(
+                    {
+                        "enabled": True,
+                        "voting_public_key": pk_hex,
+                        "type": "web3signer",
+                        "url": url,
+                    }
+                )
+                known.add(pk_hex.lower())
+                self.store.add_validator(
+                    Web3SignerMethod(pk, url),
+                    doppelganger_hold=self.doppelganger_protection,
+                )
+                if self.doppelganger_protection and self.doppelganger_service:
+                    self.doppelganger_service.register(pk)
+                statuses.append({"status": "imported"})
+            except (KeyError, ValueError, TypeError) as e:
+                statuses.append({"status": "error", "message": str(e)})
+        self.initialized.save_definitions()
+        return 200, {"data": statuses}
+
+    def delete_remotekeys(self, body: bytes):
+        req = json.loads(body)
+        remote = {
+            d["voting_public_key"].lower()
+            for d in self.initialized.definitions
+            if d.get("type") == "web3signer"
+        }
+        statuses = []
+        for pk_hex in req.get("pubkeys", []):
+            try:
+                if not isinstance(pk_hex, str) or not re.fullmatch(
+                    r"0x[0-9a-fA-F]{96}", pk_hex
+                ):
+                    raise ValueError("bad pubkey")
+                # this route must only touch web3signer-backed keys —
+                # local keystores are deleted via DELETE /keystores,
+                # which also exports the slashing interchange
+                if pk_hex.lower() not in remote:
+                    statuses.append({"status": "not_found"})
+                    continue
+                pk = bytes.fromhex(pk_hex[2:])
+                self.store.remove_validator(pk)
+                if self.doppelganger_service is not None:
+                    self.doppelganger_service.unregister(pk)
+                self.initialized.delete_definition(pk)
+                statuses.append({"status": "deleted"})
+            except (KeyError, ValueError, TypeError) as e:
+                statuses.append({"status": "error", "message": str(e)})
+        self.initialized.save_definitions()
+        return 200, {"data": statuses}
+
+    # -------------------------------------------------------- gas limit
+
+    def _known_pubkey(self, pk_hex: str) -> bool:
+        low = pk_hex.lower()
+        return any(
+            d["voting_public_key"].lower() == low
+            for d in self.initialized.definitions
+        )
+
+    def get_gas_limit(self, pk_hex: str):
+        if not self._known_pubkey(pk_hex):
+            raise ApiError(404, "unknown validator")
+        pk = bytes.fromhex(pk_hex[2:])
+        limit = self.gas_limits.get(pk, DEFAULT_GAS_LIMIT)
+        return 200, {
+            "data": {"pubkey": pk_hex, "gas_limit": str(limit)}
+        }
+
+    def set_gas_limit(self, pk_hex: str, body: bytes):
+        if not self._known_pubkey(pk_hex):
+            raise ApiError(404, "unknown validator")
+        req = json.loads(body)
+        try:
+            limit = int(req["gas_limit"])
+        except (KeyError, ValueError, TypeError):
+            raise ApiError(400, "gas_limit required")
+        if not 0 < limit < 2**64:
+            raise ApiError(400, "gas_limit must be a positive u64")
+        self.gas_limits[bytes.fromhex(pk_hex[2:])] = limit
+        return 202, {}
+
+    def delete_gas_limit(self, pk_hex: str):
+        self.gas_limits.pop(bytes.fromhex(pk_hex[2:]), None)
+        return 204, {}
+
     def version(self):
         from ..node.http_api import VERSION
 
@@ -234,6 +365,32 @@ _ROUTES = [
         "DELETE",
         re.compile(r"^/eth/v1/validator/(0x[0-9a-fA-F]{96})/graffiti$"),
         "delete_graffiti",
+        False,
+    ),
+    ("GET", re.compile(r"^/eth/v1/remotekeys$"), "list_remotekeys", False),
+    ("POST", re.compile(r"^/eth/v1/remotekeys$"), "import_remotekeys", True),
+    (
+        "DELETE",
+        re.compile(r"^/eth/v1/remotekeys$"),
+        "delete_remotekeys",
+        True,
+    ),
+    (
+        "GET",
+        re.compile(r"^/eth/v1/validator/(0x[0-9a-fA-F]{96})/gas_limit$"),
+        "get_gas_limit",
+        False,
+    ),
+    (
+        "POST",
+        re.compile(r"^/eth/v1/validator/(0x[0-9a-fA-F]{96})/gas_limit$"),
+        "set_gas_limit",
+        True,
+    ),
+    (
+        "DELETE",
+        re.compile(r"^/eth/v1/validator/(0x[0-9a-fA-F]{96})/gas_limit$"),
+        "delete_gas_limit",
         False,
     ),
     ("GET", re.compile(r"^/lighthouse/version$"), "version", False),

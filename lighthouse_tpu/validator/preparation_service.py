@@ -36,6 +36,7 @@ class PreparationService:
         builder_client=None,
         fee_recipient_for: Optional[Callable] = None,
         default_fee_recipient: bytes = b"\x00" * 20,
+        gas_limit_for: Optional[Callable] = None,
         now: Callable = None,
     ):
         self.spec = spec
@@ -44,6 +45,11 @@ class PreparationService:
         self.builder = builder_client
         self.fee_recipient_for = fee_recipient_for or (
             lambda pk: default_fee_recipient
+        )
+        # per-validator gas limit (keymanager /gas_limit routes feed
+        # this in the wired client; defaults otherwise)
+        self.gas_limit_for = gas_limit_for or (
+            lambda pk: DEFAULT_GAS_LIMIT
         )
         self._now = now or (lambda: int(time.time()))
         self._registered_at: dict[bytes, int] = {}
@@ -76,7 +82,7 @@ class PreparationService:
                 continue  # fresh this epoch
             reg = T.ValidatorRegistrationData.make(
                 fee_recipient=bytes(self.fee_recipient_for(pk)),
-                gas_limit=DEFAULT_GAS_LIMIT,
+                gas_limit=int(self.gas_limit_for(pk)),
                 timestamp=now,
                 pubkey=bytes(pk),
             )
@@ -102,7 +108,7 @@ class PreparationService:
                         "pubkey": "0x" + bytes(pk).hex(),
                         "fee_recipient": "0x"
                         + bytes(reg.fee_recipient).hex(),
-                        "gas_limit": str(DEFAULT_GAS_LIMIT),
+                        "gas_limit": str(int(reg.gas_limit)),
                         "timestamp": str(now),
                         "signature": "0x" + sig.to_bytes().hex(),
                     },

@@ -195,3 +195,39 @@ def test_bid_signature_pinned_builder():
     )
     with pytest.raises(BuilderError, match="pinned builder"):
         wrong_pin.get_header(1, parent, pk)
+
+
+def test_vc_slot_loop_drives_preparation_service():
+    """The VC runs preparation once per epoch from its slot loop, with
+    per-validator gas limits from the keymanager surface."""
+    from lighthouse_tpu.validator.client import (
+        InProcessBeaconNode,
+        ValidatorClient,
+    )
+
+    keys, chain = _chain()
+    mock, client = _builder_for(chain)
+    store = ValidatorStore(SPEC, chain.genesis_validators_root)
+    for k in keys[:2]:
+        store.add_validator(LocalKeystoreSigner(k))
+    limits = {bytes(keys[0].public_key().to_bytes()): 25_000_000}
+    svc = PreparationService(
+        SPEC,
+        store,
+        builder_client=client,
+        default_fee_recipient=b"\xbb" * 20,
+        gas_limit_for=lambda pk: limits.get(bytes(pk), 30_000_000),
+        now=lambda: 99,
+    )
+    vc = ValidatorClient(
+        SPEC, store, InProcessBeaconNode(chain), preparation_service=svc
+    )
+    chain.on_slot(1)
+    vc.on_slot_start(1)
+    assert len(mock.registrations) == 2
+    pk0 = "0x" + keys[0].public_key().to_bytes().hex()
+    assert mock.registrations[pk0.lower()]["gas_limit"] == "25000000"
+    # second slot of the same epoch: no duplicate registration
+    chain.on_slot(2)
+    vc.on_slot_start(2)
+    assert len(mock.registrations) == 2

@@ -400,3 +400,69 @@ def test_keymanager_fee_recipient_and_graffiti(tmp_path):
         assert code == 400
     finally:
         server.stop()
+
+
+def test_keymanager_remotekeys_and_gas_limit(tmp_path):
+    """The remote-keys family (web3signer-backed definitions land in
+    the store and the definitions file) and per-validator gas limits."""
+    store, iv, api, server = _km(tmp_path)
+    try:
+        pk_hex = "0x" + SecretKey.from_seed(b"\x31" * 4).public_key().to_bytes().hex()
+
+        # empty at start
+        code, out = _call(server, "GET", "/eth/v1/remotekeys")
+        assert code == 200 and out["data"] == []
+
+        code, out = _call(
+            server, "POST", "/eth/v1/remotekeys",
+            {"remote_keys": [
+                {"pubkey": pk_hex, "url": "http://signer:9000"},
+                {"pubkey": "0xzz", "url": ""},  # malformed
+            ]},
+        )
+        assert code == 200
+        assert out["data"][0]["status"] == "imported"
+        assert out["data"][1]["status"] == "error"
+        # duplicate import reports duplicate
+        code, out = _call(
+            server, "POST", "/eth/v1/remotekeys",
+            {"remote_keys": [{"pubkey": pk_hex, "url": "http://x"}]},
+        )
+        assert out["data"][0]["status"] == "duplicate"
+
+        code, out = _call(server, "GET", "/eth/v1/remotekeys")
+        assert len(out["data"]) == 1
+        assert out["data"][0]["pubkey"] == pk_hex
+        assert out["data"][0]["url"] == "http://signer:9000"
+        # the signer landed in the validator store
+        assert bytes.fromhex(pk_hex[2:]) in store.pubkeys()
+
+        # gas limits: default, set, get, delete
+        code, out = _call(
+            server, "GET", f"/eth/v1/validator/{pk_hex}/gas_limit"
+        )
+        assert code == 200 and out["data"]["gas_limit"] == "30000000"
+        code, _ = _call(
+            server, "POST", f"/eth/v1/validator/{pk_hex}/gas_limit",
+            {"gas_limit": "25000000"},
+        )
+        assert code == 202
+        code, out = _call(
+            server, "GET", f"/eth/v1/validator/{pk_hex}/gas_limit"
+        )
+        assert out["data"]["gas_limit"] == "25000000"
+        code, _ = _call(
+            server, "DELETE", f"/eth/v1/validator/{pk_hex}/gas_limit"
+        )
+        assert code == 204
+
+        # delete the remote key
+        code, out = _call(
+            server, "DELETE", "/eth/v1/remotekeys", {"pubkeys": [pk_hex]}
+        )
+        assert out["data"][0]["status"] == "deleted"
+        code, out = _call(server, "GET", "/eth/v1/remotekeys")
+        assert out["data"] == []
+        assert bytes.fromhex(pk_hex[2:]) not in store.pubkeys()
+    finally:
+        server.stop()
