@@ -166,6 +166,12 @@ def _build_parser() -> argparse.ArgumentParser:
     mv.add_argument("--mnemonic", required=True)
     mv.add_argument("--count", type=int, required=True)
     mv.add_argument("--first-index", type=int, default=0)
+    me = lcli_sub.add_parser("mock-el")
+    me.add_argument("--port", type=int, default=8551)
+    me.add_argument("--jwt-secret", default=None,
+                    help="hex; generated and printed when omitted")
+    me.add_argument("--test-requests", type=int, default=0,
+                    help="testing: exit after serving N requests")
 
     vm = sub.add_parser("vm", help="validator manager (bulk create/import/move)")
     vm_sub = vm.add_subparsers(dest="vm_cmd", required=True)
@@ -452,10 +458,11 @@ def cmd_vc(args) -> int:
         def prepare_proposers(self, prep):
             entries = []
             for p in prep:
-                try:
-                    idx = _index_of(p["pubkey"])
-                except Exception:
-                    idx = None
+                # _index_of maps a definitive 404 to None; any OTHER
+                # failure (all-BN outage) must propagate so the epoch
+                # is retried rather than marked prepared with nothing
+                # delivered
+                idx = _index_of(p["pubkey"])
                 if idx is None:
                     continue
                 entries.append(
@@ -821,6 +828,60 @@ def cmd_lcli(args) -> int:
                 )
             )
         )
+        return 0
+    if args.lcli_cmd == "mock-el":
+        # lcli mock-el analog: the in-process MockExecutionEngine
+        # behind a real engine-API HTTP listener (JWT-authed JSON-RPC),
+        # so a bn in another OS process can run the full payload flow
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        from .execution.mock_el import MockExecutionEngine
+
+        secret = args.jwt_secret or os.urandom(32).hex()
+        engine = MockExecutionEngine(jwt_secret_hex=secret)
+        served = {"n": 0}
+
+        class _H(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", "0"))
+                body = self.rfile.read(n)
+                out = engine.post(
+                    "/", {k: v for k, v in self.headers.items()}, body
+                )
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(out)))
+                self.end_headers()
+                self.wfile.write(out)
+                served["n"] += 1
+
+        httpd = ThreadingHTTPServer(("127.0.0.1", args.port), _H)
+        print(
+            json.dumps(
+                {
+                    "listening": httpd.server_address[1],
+                    "jwt_secret": secret,
+                }
+            ),
+            flush=True,
+        )
+        try:
+            if args.test_requests:
+                # count ACCEPTED connections here: with ThreadingMixIn,
+                # handle_request returns at dispatch time, before the
+                # handler thread bumps served["n"] — gating the loop on
+                # served would block on accept for a request that never
+                # comes
+                for _ in range(args.test_requests):
+                    httpd.handle_request()
+            else:
+                httpd.serve_forever()
+        except KeyboardInterrupt:
+            pass
+        httpd.server_close()
         return 0
     if args.lcli_cmd == "new-testnet":
         bundle = L.new_testnet(spec, args.count, args.genesis_time)

@@ -1,5 +1,11 @@
 """Windowed exponentiation and ladder variants (round 4 op-count cuts).
 
+Reference seam: blst's field/curve layer behind
+crypto/bls/src/impls/blst.rs — blst uses hard-coded addition chains for
+inversions/sqrt and booth-windowed scalar ladders; these are the
+lane-major batched equivalents (window selects ride the 128-wide lane
+axis instead of branching per point).
+
 A separate module rather than edits to fp.py/jacobian.py on purpose:
 Mosaic embeds source locations in compilation-cache keys, so touching
 those files would invalidate every cached device program that shares

@@ -215,8 +215,12 @@ class ValidatorClient:
         try:
             self.preparation.prepare_proposers()
             self.preparation.register_with_builder(epoch)
-        except Exception:
-            # never fatal; the next epoch retries
+        except Exception as e:  # noqa: BLE001 — never fatal, retried
+            from ..common import logging as clog
+
+            clog.get_logger("vc").warning(
+                "preparation round failed; will retry", error=str(e)
+            )
             self._prepared_epochs.discard(epoch)
 
     def _propose(self, slot: int, epoch: int) -> None:

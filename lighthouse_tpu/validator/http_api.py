@@ -318,6 +318,8 @@ class KeymanagerApi:
         return 202, {}
 
     def delete_gas_limit(self, pk_hex: str):
+        if not self._known_pubkey(pk_hex):
+            raise ApiError(404, "unknown validator")
         self.gas_limits.pop(bytes.fromhex(pk_hex[2:]), None)
         return 204, {}
 

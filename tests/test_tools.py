@@ -424,3 +424,46 @@ def test_lcli_round4c_toolbox(tmp_path):
          "--attestation", str(tmp_path / "a.ssz")]
     )
     assert rc == 0
+
+
+def test_lcli_mock_el_http_server(tmp_path):
+    """`lcli mock-el` serves the real engine API over HTTP with JWT:
+    the EngineApi client exchanges capabilities and runs the payload
+    flow against it in another thread (stand-in for another process)."""
+    import secrets as _secrets
+    import threading
+
+    from lighthouse_tpu.cli import main as cli_main
+    from lighthouse_tpu.execution.engine_api import EngineApi, JwtAuth
+
+    secret = _secrets.token_bytes(32).hex()
+    port = 18551
+    t = threading.Thread(
+        target=cli_main,
+        args=(
+            ["lcli", "mock-el", "--port", str(port), "--jwt-secret", secret,
+             "--test-requests", "2"],
+        ),
+        daemon=True,
+    )
+    t.start()
+    import time as _time
+
+    api = EngineApi(f"http://127.0.0.1:{port}", jwt=JwtAuth(secret))
+    for _ in range(50):
+        try:
+            caps = api.exchange_capabilities(["engine_newPayloadV3"])
+            break
+        except Exception:
+            _time.sleep(0.1)
+    else:
+        raise AssertionError("mock EL never came up")
+    assert any("engine_newPayload" in c for c in caps)
+    # a wrong-secret client is refused
+    bad = EngineApi(f"http://127.0.0.1:{port}", jwt=JwtAuth("11" * 32))
+    try:
+        bad.exchange_capabilities(["engine_newPayloadV3"])
+        raise AssertionError("expected auth failure")
+    except Exception:
+        pass
+    t.join(timeout=5)
