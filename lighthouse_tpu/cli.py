@@ -839,7 +839,6 @@ def cmd_lcli(args) -> int:
 
         secret = args.jwt_secret or os.urandom(32).hex()
         engine = MockExecutionEngine(jwt_secret_hex=secret)
-        served = {"n": 0}
 
         class _H(BaseHTTPRequestHandler):
             def log_message(self, *a):
@@ -856,7 +855,6 @@ def cmd_lcli(args) -> int:
                 self.send_header("Content-Length", str(len(out)))
                 self.end_headers()
                 self.wfile.write(out)
-                served["n"] += 1
 
         httpd = ThreadingHTTPServer(("127.0.0.1", args.port), _H)
         print(

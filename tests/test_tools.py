@@ -436,8 +436,12 @@ def test_lcli_mock_el_http_server(tmp_path):
     from lighthouse_tpu.cli import main as cli_main
     from lighthouse_tpu.execution.engine_api import EngineApi, JwtAuth
 
+    import socket as _socket
+
     secret = _secrets.token_bytes(32).hex()
-    port = 18551
+    with _socket.socket() as _s:  # ephemeral free port, not a fixed one
+        _s.bind(("127.0.0.1", 0))
+        port = _s.getsockname()[1]
     t = threading.Thread(
         target=cli_main,
         args=(

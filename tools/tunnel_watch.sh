@@ -1,0 +1,29 @@
+#!/bin/bash
+# Watch for the TPU tunnel to return, then prepare everything the
+# driver's end-of-round artifacts need, in priority order:
+#   1. tools/seed_cache.py      — trace+compile the bench buckets + KZG
+#                                 kernels into .jax_cache
+#   2. tools/export_verify.py   — serialize the lowered verify modules
+#                                 (buckets 4096 + 1) so a fresh bench
+#                                 process skips trace+lower entirely;
+#                                 validation also warms the
+#                                 jit_call_exported cache entries
+#   3. bench.py                 — one full proving run; numbers land in
+#                                 /tmp/bench_tpu.json for BASELINE.md
+# Each step logs to /tmp/seedloop.log. Idempotent: safe to re-run.
+cd /root/repo || exit 1
+while true; do
+  date
+  if timeout 900 python -c "import jax; d=jax.devices(); assert d, d; print(d)" >> /tmp/seedloop.log 2>&1; then
+    echo "TUNNEL BACK - seeding" >> /tmp/seedloop.log
+    python tools/seed_cache.py >> /tmp/seedloop.log 2>&1
+    echo "SEED STEP DONE rc=$? - exporting" >> /tmp/seedloop.log
+    python tools/export_verify.py 4096 1 >> /tmp/seedloop.log 2>&1
+    echo "EXPORT STEP DONE rc=$? - proving bench" >> /tmp/seedloop.log
+    python bench.py > /tmp/bench_tpu.json 2>> /tmp/seedloop.log
+    echo "BENCH STEP DONE rc=$?" >> /tmp/seedloop.log
+    tail -c 2000 /tmp/bench_tpu.json >> /tmp/seedloop.log
+    break
+  fi
+  sleep 300
+done
