@@ -56,9 +56,11 @@ def _build_parser() -> argparse.ArgumentParser:
                     help="resume the chain persisted in --datadir")
     bn.add_argument("--listen-port", type=int, default=0,
                     help="TCP gossip/rpc listen port (0 = no networking)")
-    bn.add_argument("--transport", choices=["tcp", "libp2p"], default="tcp",
-                    help="wire stack: private tcp framing, or the full "
-                         "libp2p layering (mss/noise/yamux substreams)")
+    bn.add_argument("--transport", choices=["libp2p", "tcp"],
+                    default="libp2p",
+                    help="wire stack: the full libp2p layering "
+                         "(mss/noise/yamux substreams; default), or the "
+                         "private tcp framing (debug only)")
     bn.add_argument("--peer", action="append", default=[],
                     help="host:port of a peer to dial (repeatable)")
     bn.add_argument("--genesis-time", type=int, default=0,
@@ -607,7 +609,10 @@ def cmd_account(args) -> int:
         # the beacon API pool route (SSZ body).
         from .common.eth2 import BeaconNodeHttpClient
         from .consensus import types as T
-        from .consensus.domains import compute_signing_root, get_domain
+        from .consensus.domains import (
+            compute_signing_root,
+            voluntary_exit_domain,
+        )
 
         with open(args.keystore) as f:
             ks = Keystore.from_json(f.read())
@@ -647,9 +652,13 @@ def cmd_account(args) -> int:
             epoch=epoch, validator_index=args.validator_index
         )
         spec = _spec(args)
-        domain = get_domain(
-            spec, spec.domain_voluntary_exit, epoch, fork, gvr
-        )
+        # EIP-7044: Deneb+ pins the Capella fork version for exits;
+        # strict — an unknown fork version means the wrong --network
+        try:
+            domain = voluntary_exit_domain(spec, epoch, fork, gvr, strict=True)
+        except ValueError as e:
+            print(str(e), file=sys.stderr)
+            return 1
         sig = sk.sign(compute_signing_root(exit_msg, domain))
         signed = T.SignedVoluntaryExit.make(
             message=exit_msg, signature=sig.to_bytes()

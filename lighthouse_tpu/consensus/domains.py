@@ -38,6 +38,50 @@ def get_domain(
     return compute_domain(domain_type, version, genesis_validators_root)
 
 
+def voluntary_exit_domain(
+    spec: ChainSpec,
+    exit_epoch: int,
+    fork,
+    genesis_validators_root: bytes,
+    strict: bool = False,
+) -> bytes:
+    """EIP-7044 exit domain (chain_spec.rs compute_domain handling via
+    Fork::Deneb special case in exit_signature_set): from Deneb onward
+    the voluntary-exit domain is pinned to the CAPELLA fork version so
+    exits remain valid across future forks, regardless of exit epoch.
+    Pre-Deneb, the domain follows the fork at the exit epoch as usual.
+
+    The state fork is identified from `fork.current_version`. With
+    `strict=True` (the CLI signing path) an unrecognized version is an
+    error — it means the local spec doesn't match the node's network
+    and the signed exit would be invalid; non-strict callers (node-side
+    verification on custom testnets) fall back to the schedule at
+    `fork.epoch`.
+    """
+    by_version = {v: k for k, v in spec.fork_versions.items()}
+    version = bytes(fork.current_version)
+    if strict and version not in by_version:
+        raise ValueError(
+            f"fork version 0x{version.hex()} is not in the configured "
+            f"spec's fork schedule — wrong --network for this node?"
+        )
+    state_fork = by_version.get(
+        version, spec.fork_name_at_epoch(fork.epoch)
+    )
+    from .spec import FORK_ORDER
+
+    if FORK_ORDER.index(state_fork) >= FORK_ORDER.index("deneb"):
+        return compute_domain(
+            spec.domain_voluntary_exit,
+            spec.fork_versions["capella"],
+            genesis_validators_root,
+        )
+    return get_domain(
+        spec, spec.domain_voluntary_exit, exit_epoch, fork,
+        genesis_validators_root,
+    )
+
+
 def compute_signing_root(ssz_value, domain: bytes) -> bytes:
     return T.SigningData.make(
         object_root=ssz_value.hash_tree_root(), domain=domain

@@ -19,7 +19,12 @@ from typing import Callable, Sequence
 from ..crypto import bls
 from ..crypto.bls.keys import PublicKey, Signature, SignatureSet
 from . import types as T
-from .domains import compute_domain, compute_signing_root, get_domain
+from .domains import (
+    compute_domain,
+    compute_signing_root,
+    get_domain,
+    voluntary_exit_domain,
+)
 from .spec import ChainSpec
 
 
@@ -215,12 +220,9 @@ def exit_signature_set(
     genesis_validators_root: bytes,
 ) -> SignatureSet:
     exit_msg = signed_exit.message
-    domain = get_domain(
-        spec,
-        spec.domain_voluntary_exit,
-        exit_msg.epoch,
-        fork,
-        genesis_validators_root,
+    # EIP-7044: Deneb+ states pin the Capella fork version for exits
+    domain = voluntary_exit_domain(
+        spec, exit_msg.epoch, fork, genesis_validators_root
     )
     message = compute_signing_root(exit_msg, domain)
     return SignatureSet.single_pubkey(

@@ -950,7 +950,16 @@ class BeaconApi:
             per_sub = size // spec.preset.sync_committee_subnet_count
             for e in entries:
                 for pos in e.get("sync_committee_indices", []):
-                    subnets.add(int(pos) // per_sub)
+                    pos = int(pos)
+                    # committee positions outside the committee would
+                    # derive subnets past sync_committee_subnet_count
+                    if not 0 <= pos < size:
+                        raise ApiError(
+                            400,
+                            f"sync_committee_index {pos} out of range "
+                            f"[0, {size})",
+                        )
+                    subnets.add(pos // per_sub)
             self.subnet_service.subscribe_sync_subnets(sorted(subnets))
         return 200, {}
 
@@ -1142,7 +1151,9 @@ class BeaconApi:
         ideal_by_eff = {}
         for e_bal in sorted({int(v) for v in eff}):
             b = (e_bal // inc) * base_per_inc
-            entry = {"effective_balance": str(e_bal)}
+            # ideal participants take no inactivity penalty; the field
+            # is part of the IdealAttestationReward schema
+            entry = {"effective_balance": str(e_bal), "inactivity": "0"}
             for flag_index, weight in enumerate(
                 st.PARTICIPATION_FLAG_WEIGHTS
             ):
@@ -1153,6 +1164,24 @@ class BeaconApi:
                     // (total_inc * st.WEIGHT_DENOMINATOR)
                 )
             ideal_by_eff[e_bal] = entry
+        # inactivity-leak penalties: target non-participants pay
+        # eff*score // (BIAS*QUOTIENT), mirroring the canonical epoch
+        # pass (process_rewards_and_penalties) — present in the
+        # reference endpoint's semantics during leaks
+        scores = np.fromiter(
+            state.inactivity_scores, np.uint64, n
+        ).astype(np.int64)
+        has_target = unslashed_prev & (
+            (prev_part & (1 << st.TIMELY_TARGET_FLAG_INDEX)) != 0
+        )
+        inactivity = np.where(
+            eligible & ~has_target,
+            -(
+                eff.astype(np.int64) * scores
+                // (st.INACTIVITY_SCORE_BIAS * st.INACTIVITY_PENALTY_QUOTIENT)
+            ),
+            0,
+        )
         which = ids if ids else [
             i for i in range(n) if active_prev[i]
         ]
@@ -1162,7 +1191,7 @@ class BeaconApi:
                 "head": str(int(actual["head"][i])),
                 "target": str(int(actual["target"][i])),
                 "source": str(int(actual["source"][i])),
-                "inactivity": "0",
+                "inactivity": str(int(inactivity[i])),
             }
             for i in which
             if 0 <= i < n

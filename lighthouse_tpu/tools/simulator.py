@@ -115,7 +115,14 @@ class SimNode:
 
 
 class Simulation:
-    """N nodes, full-mesh connectivity, validators split round-robin."""
+    """N nodes, full-mesh connectivity, validators split round-robin.
+
+    `transport="inproc"` (default) runs all nodes on one InProcessHub —
+    fast, and the only mode supporting the partition fault seam.
+    `transport="libp2p"` gives every node its own Libp2pEndpoint on a
+    real localhost socket: gossip and sync travel as
+    mss/noise/yamux/gossipsub-protobuf frames on the wire, the same
+    stack `cli bn` runs by default."""
 
     def __init__(
         self,
@@ -123,37 +130,71 @@ class Simulation:
         n_validators: int = 32,
         spec: ChainSpec = None,
         electra_fork_epoch: int = None,
+        transport: str = "inproc",
     ):
         self.spec = spec or mainnet_spec()
         if electra_fork_epoch is not None:
             self.spec.fork_epochs = dict(self.spec.fork_epochs)
             self.spec.fork_epochs["electra"] = electra_fork_epoch
-        self.hub = InProcessHub()
+        self.transport = transport
         keys = [SecretKey.from_seed(i.to_bytes(4, "big")) for i in range(n_validators)]
         pubkeys = [k.public_key().to_bytes() for k in keys]
         genesis = st.interop_genesis_state(self.spec, pubkeys)
         digest = b"\x00" * 4
         self.nodes = []
-        for i in range(n_nodes):
-            node_keys = keys[i::n_nodes]
-            self.nodes.append(
-                SimNode(
-                    self.hub,
-                    f"node{i}",
-                    self.spec,
-                    genesis.copy(),
-                    node_keys,
-                    digest,
+        if transport == "libp2p":
+            from ..network.libp2p_transport import Libp2pHub
+
+            self.hub = None
+            for i in range(n_nodes):
+                self.nodes.append(
+                    SimNode(
+                        Libp2pHub(),
+                        f"node{i}",
+                        self.spec,
+                        genesis.copy(),
+                        keys[i::n_nodes],
+                        digest,
+                    )
                 )
-            )
-        for i, a in enumerate(self.nodes):
-            for b in self.nodes[i + 1 :]:
-                a.service.connect_peer(b.service)
+            # full mesh over real sockets: dial once per pair; the
+            # accepting side grafts via on_peer_connected
+            for i, a in enumerate(self.nodes):
+                for b in self.nodes[i + 1 :]:
+                    a.service.connect_remote(*b.service.endpoint.addr)
+        else:
+            self.hub = InProcessHub()
+            for i in range(n_nodes):
+                self.nodes.append(
+                    SimNode(
+                        self.hub,
+                        f"node{i}",
+                        self.spec,
+                        genesis.copy(),
+                        keys[i::n_nodes],
+                        digest,
+                    )
+                )
+            for i, a in enumerate(self.nodes):
+                for b in self.nodes[i + 1 :]:
+                    a.service.connect_peer(b.service)
 
     def settle(self, rounds: int = 50) -> None:
+        import time as _time
+
+        # over sockets a quiescent poll doesn't mean the network is
+        # drained — frames may be in flight; require a few consecutive
+        # idle rounds with a small wait between them
+        idle_needed = 3 if self.transport == "libp2p" else 1
+        idle = 0
         for _ in range(rounds):
             if sum(n.pump() for n in self.nodes) == 0:
-                break
+                idle += 1
+                if idle >= idle_needed:
+                    break
+                _time.sleep(0.05)
+            else:
+                idle = 0
 
     def run_slot(self, slot: int) -> None:
         for n in self.nodes:
@@ -183,6 +224,10 @@ class Simulation:
         last_slot = until_epoch * spe
         checks = SimChecks()
         victim = None
+        if partition and self.transport != "inproc":
+            raise ValueError(
+                "partition fault injection needs the in-process hub"
+            )
         for slot in range(1, last_slot + 1):
             if partition and slot == partition[1]:
                 victim = self.nodes[partition[0]]
@@ -211,3 +256,10 @@ class Simulation:
             for n in self.nodes
         )
         return checks
+
+    def close(self) -> None:
+        """Tear down socket transports (no-op for the in-process hub)."""
+        for n in self.nodes:
+            ep = n.service.endpoint
+            if hasattr(ep, "close"):
+                ep.close()

@@ -170,6 +170,74 @@ def test_deposit_and_exit_and_bls_change(cache):
     )
 
 
+def test_exit_domain_eip7044_deneb_pins_capella(cache):
+    """EIP-7044: on a Deneb+ state the exit domain uses the CAPELLA
+    fork version regardless of the exit epoch; pre-Deneb the domain
+    follows the fork at the exit epoch."""
+    from lighthouse_tpu.consensus.domains import voluntary_exit_domain
+
+    deneb_fork = T.Fork.make(
+        previous_version=SPEC.fork_versions["capella"],
+        current_version=SPEC.fork_versions["deneb"],
+        epoch=SPEC.fork_epochs["deneb"],
+    )
+    exit_epoch = SPEC.fork_epochs["deneb"] + 10
+    ve = T.VoluntaryExit.make(epoch=exit_epoch, validator_index=5)
+    # correct (EIP-7044) signature: capella-pinned domain
+    good_domain = compute_domain(
+        SPEC.domain_voluntary_exit, SPEC.fork_versions["capella"], GVR
+    )
+    assert (
+        voluntary_exit_domain(SPEC, exit_epoch, deneb_fork, GVR)
+        == good_domain
+    )
+    sve = T.SignedVoluntaryExit.make(
+        message=ve,
+        signature=KEYS[5].sign(
+            compute_signing_root(ve, good_domain)
+        ).to_bytes(),
+    )
+    assert bls.verify_signature_sets(
+        [SS.exit_signature_set(SPEC, cache.getter(), sve, deneb_fork, GVR)]
+    )
+    # a pre-7044-style signature (deneb version at the exit epoch) must
+    # NOT verify on a deneb state
+    bad_domain = compute_domain(
+        SPEC.domain_voluntary_exit, SPEC.fork_versions["deneb"], GVR
+    )
+    sve_bad = T.SignedVoluntaryExit.make(
+        message=ve,
+        signature=KEYS[5].sign(
+            compute_signing_root(ve, bad_domain)
+        ).to_bytes(),
+    )
+    assert not bls.verify_signature_sets(
+        [SS.exit_signature_set(SPEC, cache.getter(), sve_bad, deneb_fork, GVR)]
+    )
+    # pre-Deneb states keep the epoch-resolved domain: an exit epoch
+    # BEFORE the capella activation resolves to the PREVIOUS (bellatrix)
+    # version — distinguishable from an unconditional capella pin
+    capella_fork = T.Fork.make(
+        previous_version=SPEC.fork_versions["bellatrix"],
+        current_version=SPEC.fork_versions["capella"],
+        epoch=SPEC.fork_epochs["capella"],
+    )
+    pre_epoch = SPEC.fork_epochs["capella"] - 1
+    assert voluntary_exit_domain(
+        SPEC, pre_epoch, capella_fork, GVR
+    ) == compute_domain(
+        SPEC.domain_voluntary_exit, SPEC.fork_versions["bellatrix"], GVR
+    )
+    # strict mode rejects fork versions outside the configured spec
+    alien_fork = T.Fork.make(
+        previous_version=b"\x90\x00\x00\x72",
+        current_version=b"\x90\x00\x00\x73",
+        epoch=SPEC.fork_epochs["deneb"],
+    )
+    with pytest.raises(ValueError):
+        voluntary_exit_domain(SPEC, exit_epoch, alien_fork, GVR, strict=True)
+
+
 def test_block_signature_verifier_full_batch(cache):
     """All of a block's sets verified in ONE batch
     (block_signature_verifier.rs:127-138)."""

@@ -10,6 +10,21 @@ from lighthouse_tpu.tools.simulator import Simulation
 pytestmark = pytest.mark.slow
 
 
+def test_four_nodes_finalize_over_libp2p_sockets():
+    """The same 4-node sim on REAL localhost libp2p sockets — gossip and
+    sync travel as mss/noise/yamux/gossipsub-protobuf wire frames, the
+    stack `cli bn` defaults to (service/utils.rs:38-63 parity)."""
+    sim = Simulation(n_nodes=4, n_validators=32, transport="libp2p")
+    try:
+        checks = sim.run(until_epoch=5)
+        spe = sim.spec.preset.slots_per_epoch
+        assert checks.head_slots[-1] >= 5 * spe - 1
+        assert checks.consistent_heads
+        assert checks.finalized_epoch >= 3, checks.finalized_epoch
+    finally:
+        sim.close()
+
+
 def test_four_nodes_reach_finality_through_fork_and_partition():
     sim = Simulation(n_nodes=4, n_validators=32, electra_fork_epoch=2)
     spe = sim.spec.preset.slots_per_epoch

@@ -98,9 +98,15 @@ def _wait_http(port, path, deadline):
 
 
 @pytest.mark.slow
-def test_two_process_nodes_sync_and_gossip(tmp_path):
+@pytest.mark.parametrize("transport", ["libp2p", "tcp"])
+def test_two_process_nodes_sync_and_gossip(tmp_path, transport):
     """Spawn two `cli bn` OS processes: A produces blocks (some before
-    B dials — range sync; some after — gossip); B reaches A's head."""
+    B dials — range sync; some after — gossip); B reaches A's head.
+
+    The libp2p variant passes NO --transport flag: it validates that
+    the DEFAULT wire stack is the full mss/noise/yamux/gossipsub
+    layering; the tcp variant covers the debug private framing."""
+    extra = [] if transport == "libp2p" else ["--transport", "tcp"]
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     pa, pb = _free_port(), _free_port()
@@ -114,7 +120,7 @@ def test_two_process_nodes_sync_and_gossip(tmp_path):
          "--listen-port", str(pa), "--interop-validators", "16",
          "--genesis-time", gt,
          "--bls-backend", "fake", "--test-extend", "12",
-         "--test-extend-interval", "0.3"],
+         "--test-extend-interval", "0.3", *extra],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
     )
     b = None
@@ -132,7 +138,8 @@ def test_two_process_nodes_sync_and_gossip(tmp_path):
              "--datadir", str(tmp_path / "b"), "--http-port", str(hb),
              "--listen-port", str(pb), "--interop-validators", "16",
              "--genesis-time", gt,
-             "--bls-backend", "fake", "--peer", f"127.0.0.1:{pa}"],
+             "--bls-backend", "fake", "--peer", f"127.0.0.1:{pa}",
+             *extra],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
         )
         # B must converge to A's (still advancing) head
