@@ -63,6 +63,19 @@ def _build_parser() -> argparse.ArgumentParser:
                          "private tcp framing (debug only)")
     bn.add_argument("--peer", action="append", default=[],
                     help="host:port of a peer to dial (repeatable)")
+    bn.add_argument("--listen-address", default="127.0.0.1",
+                    help="bind address for the tcp transport and "
+                         "discovery UDP socket")
+    bn.add_argument("--udp-port", type=int, default=0,
+                    help="discv5 discovery UDP port (0 = discovery off)")
+    bn.add_argument("--boot-enr", action="append", default=[],
+                    help="boot-node ENR (enr:... text, repeatable); "
+                         "discovered peers are dialed automatically")
+    bn.add_argument("--enr-address", default="127.0.0.1",
+                    help="IP to advertise in our signed ENR")
+    bn.add_argument("--target-peers", type=int, default=16,
+                    help="stop discovering when this many peers are "
+                         "connected")
     bn.add_argument("--genesis-time", type=int, default=0,
                     help="interop genesis time (0 = now); both nodes of "
                          "a testnet must agree on it")
@@ -273,7 +286,7 @@ def cmd_bn(args) -> int:
         if args.transport == "libp2p":
             from .network.libp2p_transport import Libp2pHub
 
-            hub = Libp2pHub(port=args.listen_port)
+            hub = Libp2pHub(host=args.listen_address, port=args.listen_port)
         else:
             from .network.socket_transport import SocketHub
 
@@ -302,6 +315,64 @@ def cmd_bn(args) -> int:
         pid = client.service.connect_remote(host or "127.0.0.1", int(port))
         client.sync.add_peer(pid)
         print(f"dialed {peer} -> {pid}")
+    discovery = None
+    if args.udp_port and client.service is not None:
+        # discv5 runs continuously alongside the node: harvested ENRs
+        # with a tcp endpoint are dialed and handed to sync — joining a
+        # network needs only a boot ENR (discovery/mod.rs:1338 role)
+        from .network.discv5_service import Discv5Service
+
+        def _dial(ip, tcp, enr):
+            try:
+                pid = client.service.connect_remote(ip, tcp)
+                client.sync.add_peer(pid)
+                print(f"discovered+dialed {ip}:{tcp} -> {pid}", flush=True)
+            except Exception as e:  # noqa: BLE001 — peer may be gone
+                print(f"dial {ip}:{tcp} failed: {e}", file=sys.stderr)
+
+        from .consensus.domains import compute_fork_digest
+        from .network.enr import EnrError
+
+        digest = compute_fork_digest(
+            spec.genesis_fork_version, client.chain.genesis_validators_root
+        )
+        sub_svc = client.subnet_service
+        attnets = (
+            sub_svc.attnets_bitfield(int(client.chain.current_slot))
+            if sub_svc is not None
+            else b"\x00" * 8
+        )
+        try:
+            discovery = Discv5Service(
+                tcp_port=args.listen_port,
+                udp_port=args.udp_port,
+                host=args.listen_address,
+                enr_address=args.enr_address,
+                boot_enrs=args.boot_enr,
+                fork_digest=digest,
+                attnets=attnets,
+                on_candidate=_dial,
+                target_peers=lambda: (
+                    len(client.service.peers.connected())
+                    >= args.target_peers
+                ),
+            ).start()
+        except (EnrError, ValueError) as e:  # incl. bad base64
+            print(f"bad --boot-enr record: {e}", file=sys.stderr)
+            client.service.endpoint.close()
+            return 2
+        except OSError as e:
+            print(f"discv5 udp/{args.udp_port} bind failed: {e}",
+                  file=sys.stderr)
+            client.service.endpoint.close()
+            return 2
+        if sub_svc is not None:
+            # subnet rotation now re-signs the discovery ENR, and the
+            # long-lived subnet schedule keys on the discv5 node id
+            sub_svc.discovery = discovery
+            sub_svc.node_id = discovery.local_enr.node_id()
+        print(f"discv5 on udp/{discovery.node.addr[1]} "
+              f"enr={discovery.local_enr.to_text()}", flush=True)
     if args.test_extend:
         import threading as _th
 
@@ -329,6 +400,9 @@ def cmd_bn(args) -> int:
         client.run()
     except KeyboardInterrupt:
         client.shutdown()
+    finally:
+        if discovery is not None:
+            discovery.close()
     return 0
 
 

@@ -1,0 +1,210 @@
+"""Discv5 discovery wired INTO the beacon node — the always-on UDP
+service that finds peers and feeds the dialer, so a node joins a
+network given nothing but a boot-node ENR.
+
+Reference: beacon_node/lighthouse_network/src/discovery/mod.rs — the
+BN runs discv5 continuously; FINDNODE queries walk the DHT, harvested
+ENRs that advertise a tcp port become dial candidates, and subnet
+queries filter on the signed `attnets`/`syncnets` bitfields
+(discovery/mod.rs:1338 subnet_predicate). The local ENR advertises our
+libp2p tcp port and subscriptions; updates bump the sequence number so
+peers re-fetch it (discovery/enr.rs role).
+
+TPU note: discovery is pure host-side I/O — it runs on its own daemon
+thread and never touches the jax/device path.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from typing import Callable, List, Optional
+
+from .discv5 import Discv5Node
+from .enr import Enr
+
+# log2-distance spread for one FINDNODE round: a handful of top buckets
+# holds ~97% of uniformly distributed node ids (distance d bucket holds
+# 2^(d-256) of the keyspace); rotating the tail distances over rounds
+# covers the rest (discv5 spec lookup behavior, compressed to a flat
+# query since our tables are small)
+_BASE_DISTANCES = [256, 255, 254, 253, 252]
+
+
+class Discv5Service:
+    """Continuous discovery loop for a beacon node.
+
+    `on_candidate(ip, tcp_port, enr)` fires (from the discovery thread)
+    for every newly discovered ENR that advertises a tcp endpoint —
+    the CLI wires it to `service.connect_remote` + `sync.add_peer`.
+    `target_peers()` gates querying: when the callable reports the node
+    is at target, the loop idles (peer_manager target semantics,
+    discovery/mod.rs process_queue)."""
+
+    def __init__(
+        self,
+        tcp_port: int,
+        udp_port: int = 0,
+        host: str = "127.0.0.1",
+        enr_address: str = None,
+        boot_enrs: List[str] = (),
+        private_key: bytes = None,
+        fork_digest: bytes = b"\x00" * 4,
+        attnets: bytes = b"\x00" * 8,
+        syncnets: bytes = b"\x00",
+        on_candidate: Callable = None,
+        target_peers: Callable[[], bool] = None,
+        interval: float = 2.0,
+        redial_cooldown: float = 60.0,
+    ):
+        addr = enr_address or host
+        eth2 = fork_digest + b"\x00" * 4 + (2**64 - 1).to_bytes(8, "little")
+        self.node = Discv5Node(
+            private_key=private_key,
+            host=host,
+            port=udp_port,
+            enr_kwargs={
+                "ip": socket.inet_aton(addr),
+                "tcp": tcp_port,
+                "eth2": eth2,
+                "attnets": attnets,
+                "syncnets": syncnets,
+            },
+        )
+        self.on_candidate = on_candidate
+        self._at_target = target_peers or (lambda: False)
+        self.interval = interval
+        self.redial_cooldown = redial_cooldown
+        self._boot_ids = set()
+        # node_id -> monotonic expiry; cooldown (not permanence) so a
+        # peer whose listener was briefly down gets retried
+        self._dialed: dict[bytes, float] = {}
+        self._lock = threading.Lock()
+        self._round = 0
+        self._closed = False
+        self._thread: Optional[threading.Thread] = None
+        for text in boot_enrs:
+            enr = Enr.from_text(text)  # raises EnrError on a bad record
+            if self.node.add_enr(enr):
+                self._boot_ids.add(enr.node_id())
+        # ENRs learned passively (inbound handshakes) are only QUEUED
+        # here: dialing from the discv5 UDP receive thread would deafen
+        # discovery for the duration of a TCP connect — the loop thread
+        # drains the queue
+        self._passive: List[Enr] = []
+        self.node.on_enr_discovered = self._on_passive
+
+    def _on_passive(self, enr: Enr) -> None:
+        with self._lock:
+            if len(self._passive) < 64:
+                self._passive.append(enr)
+
+    # ------------------------------------------------------------ state
+
+    @property
+    def local_enr(self) -> Enr:
+        return self.node.enr
+
+    def update_enr(self, attnets: bytes = None, syncnets: bytes = None):
+        """Re-sign the local record with bumped seq (subnet rotation,
+        discovery/enr.rs update_attnets role); peers see the new seq in
+        PONGs and handshakes and re-fetch. All other keys (csc, ip,
+        eth2, ports, future additions) are carried over wholesale."""
+        old = self.node.enr
+        pairs = dict(old.pairs)
+        if attnets is not None:
+            pairs[b"attnets"] = attnets
+        if syncnets is not None:
+            pairs[b"syncnets"] = syncnets
+        enr = Enr(old.seq + 1, pairs)
+        enr.sign(self.node.private_key)
+        self.node.enr = enr
+
+    # ------------------------------------------------------- the loop
+
+    def start(self) -> "Discv5Service":
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._closed:
+            try:
+                if not self._at_target():
+                    self.discover_round()
+            except Exception:  # noqa: BLE001 — network loop must survive
+                pass
+            time.sleep(self.interval)
+
+    def discover_round(self) -> int:
+        """One query round: FINDNODE every known peer at a rotating
+        distance spread, then surface fresh dial candidates. Returns
+        the number of candidates surfaced (also callable synchronously
+        from tests)."""
+        self._round += 1
+        # rotate two extra tail distances through 251..243 so repeated
+        # rounds eventually cover nearer buckets
+        tail = [251 - (self._round * 2 % 9), 250 - (self._round * 2 % 9)]
+        distances = _BASE_DISTANCES + tail + [0]
+        for enr in self.node.known_enrs():
+            if self._closed:
+                break
+            try:
+                self.node.find_node(enr, distances)
+            except Exception:  # noqa: BLE001 — peer may be gone
+                continue
+        with self._lock:
+            passive, self._passive = self._passive, []
+        n = 0
+        for enr in passive + self.node.known_enrs():
+            n += self._consider(enr)
+        return n
+
+    def _consider(self, enr: Enr) -> int:
+        nid = enr.node_id()
+        now = time.monotonic()
+        # anything advertising a tcp endpoint is dialable — including a
+        # boot record that happens to be a full node; chain-less boot
+        # nodes simply carry no tcp key
+        if (
+            nid == self.node.node_id
+            or self._dialed.get(nid, 0) > now
+            or not enr.ip
+            or not enr.tcp
+        ):
+            return 0
+        self._dialed[nid] = now + self.redial_cooldown
+        cb = self.on_candidate
+        if cb is not None:
+            cb(enr.ip, enr.tcp, enr)
+        return 1
+
+    # -------------------------------------------------- subnet queries
+
+    def peers_on_subnet(self, subnet: int, syncnet: bool = False) -> list:
+        """Table peers whose SIGNED bitfield advertises the subnet
+        (subnet_predicate, discovery/mod.rs:1338)."""
+        key = b"syncnets" if syncnet else b"attnets"
+        out = []
+        for enr in self.node.known_enrs():
+            raw = enr.pairs.get(key)
+            # length-guard: a validly signed ENR may carry a short
+            # bitfield (remote-controlled data must not raise)
+            if (
+                raw
+                and subnet // 8 < len(raw)
+                and (raw[subnet // 8] >> (subnet % 8)) & 1
+            ):
+                out.append(enr)
+        return out
+
+    def discover_subnet(self, subnet: int, syncnet: bool = False) -> list:
+        """Query round + subnet filter — the subnet service's 'find me
+        peers on attestation subnet N' entry point."""
+        self.discover_round()
+        return self.peers_on_subnet(subnet, syncnet)
+
+    def close(self) -> None:
+        self._closed = True
+        self.node.close()

@@ -185,3 +185,8 @@ class Enr:
     def udp(self) -> Optional[int]:
         raw = self.pairs.get(b"udp")
         return int.from_bytes(raw, "big") if raw else None
+
+    @property
+    def tcp(self) -> Optional[int]:
+        raw = self.pairs.get(b"tcp")
+        return int.from_bytes(raw, "big") if raw else None

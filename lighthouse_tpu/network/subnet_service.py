@@ -71,11 +71,22 @@ class SubnetSubscription:
 
 
 class SubnetService:
-    def __init__(self, spec, service, node_id: bytes, fork_digest: bytes):
+    def __init__(
+        self,
+        spec,
+        service,
+        node_id: bytes,
+        fork_digest: bytes,
+        discovery=None,
+    ):
         self.spec = spec
         self.service = service  # NetworkService (subscribe/unsubscribe)
         self.node_id = bytes(node_id)
         self.fork_digest = bytes(fork_digest)
+        # optional Discv5Service: subnet rotation re-signs our ENR so
+        # remote subnet_predicate queries see current subscriptions
+        # (discovery/enr.rs update_attnets role)
+        self.discovery = discovery
         self._duty_subs: list[SubnetSubscription] = []
         self._current_topics: set = set()
 
@@ -146,4 +157,15 @@ class SubnetService:
             if unsub is not None:
                 unsub(t)
             self._current_topics.discard(t)
+        if self.discovery is not None and (to_add or to_remove):
+            self.discovery.update_enr(
+                attnets=self.attnets_bitfield(current_slot)
+            )
         return to_add, to_remove
+
+    def attnets_bitfield(self, current_slot: int) -> bytes:
+        """The wanted-subnet set as the 8-byte ENR `attnets` value."""
+        bits = bytearray(8)
+        for s in self.wanted_subnets(current_slot):
+            bits[s // 8] |= 1 << (s % 8)
+        return bytes(bits)

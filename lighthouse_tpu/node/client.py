@@ -89,6 +89,7 @@ class Client:
         nbp=None,
         sync=None,
         api_server: Optional[ApiServer] = None,
+        subnet_service=None,
     ):
         self.chain = chain
         self.processor = processor
@@ -97,12 +98,17 @@ class Client:
         self.nbp = nbp
         self.sync = sync
         self.api_server = api_server
+        self.subnet_service = subnet_service
         self._stop = threading.Event()
 
     def tick(self) -> int:
         """One pump: timer, network events -> work, scheduler steps,
         sync progress. Returns units of work done."""
         n = self.timer.poll()
+        if n and self.subnet_service is not None:
+            # reconcile gossip meshes with wanted subnets; pushes the
+            # new attnets bitfield into the signed ENR when attached
+            self.subnet_service.on_slot(self.timer._last_slot)
         if self.service is not None and self.nbp is not None:
             for ev in self.service.poll():
                 self.nbp.handle_gossip(ev.peer_id, ev.topic, ev.data)
@@ -217,7 +223,7 @@ class ClientBuilder:
                 slasher=slasher,
             )
         processor = BeaconProcessor()
-        service = nbp = sync = None
+        service = nbp = sync = subnet_service = None
         if self._hub is not None:
             from ..network import (
                 NetworkBeaconProcessor,
@@ -246,6 +252,17 @@ class ClientBuilder:
                 chain, processor, service, fork_digest=digest
             )
             sync = SyncManager(chain, processor, service, nbp)
+            from ..network.subnet_service import SubnetService
+
+            # long-lived subnet rotation keyed on the transport peer id
+            # until a discv5 node id attaches (cmd_bn sets .discovery +
+            # .node_id once the UDP service is up)
+            subnet_service = SubnetService(
+                self.spec,
+                service,
+                node_id=service.peer_id.encode()[:32].ljust(32, b"\x00"),
+                fork_digest=digest,
+            )
         head_state = chain.head_state()
         clock = self._clock or SlotClock(
             genesis_time=head_state.genesis_time if head_state is not None else 0,
@@ -255,7 +272,8 @@ class ClientBuilder:
         api_server = None
         if self._api_port is not None:
             api_server = ApiServer(
-                BeaconApi(chain, sync), port=self._api_port
+                BeaconApi(chain, sync, subnet_service=subnet_service),
+                port=self._api_port,
             )
         return Client(
             chain,
@@ -265,4 +283,5 @@ class ClientBuilder:
             nbp=nbp,
             sync=sync,
             api_server=api_server,
+            subnet_service=subnet_service,
         )
