@@ -320,15 +320,32 @@ def cmd_bn(args) -> int:
         # discv5 runs continuously alongside the node: harvested ENRs
         # with a tcp endpoint are dialed and handed to sync — joining a
         # network needs only a boot ENR (discovery/mod.rs:1338 role)
+        from collections import deque
+
         from .network.discv5_service import Discv5Service
 
+        # candidates surface on the discv5 thread but are DIALED from
+        # the client's main tick (gossip/sync state is single-threaded)
+        dial_q: deque = deque(maxlen=64)
+
         def _dial(ip, tcp, enr):
-            try:
-                pid = client.service.connect_remote(ip, tcp)
-                client.sync.add_peer(pid)
-                print(f"discovered+dialed {ip}:{tcp} -> {pid}", flush=True)
-            except Exception as e:  # noqa: BLE001 — peer may be gone
-                print(f"dial {ip}:{tcp} failed: {e}", file=sys.stderr)
+            dial_q.append((ip, tcp))
+
+        def _drain_dials():
+            n = 0
+            while dial_q:
+                ip, tcp = dial_q.popleft()
+                try:
+                    pid = client.service.connect_remote(ip, tcp)
+                    client.sync.add_peer(pid)
+                    print(f"discovered+dialed {ip}:{tcp} -> {pid}",
+                          flush=True)
+                    n += 1
+                except Exception as e:  # noqa: BLE001 — peer may be gone
+                    print(f"dial {ip}:{tcp} failed: {e}", file=sys.stderr)
+            return n
+
+        client.tick_hooks.append(_drain_dials)
 
         from .consensus.domains import compute_fork_digest
         from .network.enr import EnrError

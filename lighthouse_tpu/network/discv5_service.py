@@ -156,6 +156,12 @@ class Discv5Service:
                 continue
         with self._lock:
             passive, self._passive = self._passive, []
+        # purge elapsed cooldown entries so the dict tracks only live
+        # cooldowns, not every node id ever seen
+        now = time.monotonic()
+        self._dialed = {
+            nid: exp for nid, exp in self._dialed.items() if exp > now
+        }
         n = 0
         for enr in passive + self.node.known_enrs():
             n += self._consider(enr)

@@ -41,11 +41,6 @@ IDONTWANT_SIZE_THRESHOLD = 1000
 IDONTWANT_MAX_PER_PEER = 1024
 GOSSIP_THRESHOLD = -40.0     # below: ignore their gossip + IHAVE
 GRAYLIST_THRESHOLD = -80.0   # below: prune everywhere, drop frames
-SCORE_DECAY = 0.9            # per-heartbeat multiplicative decay
-P2_FIRST_DELIVERY = 1.0      # weight per first delivery
-P4_INVALID = -10.0           # weight per invalid/undecodable message
-P7_BEHAVIOUR = -5.0          # weight per behavioural offence (bad GRAFT)
-SCORE_CAP = 50.0
 
 # topic name templates (fork digest scoping like topics in pubsub.rs)
 TOPIC_BLOCK = "beacon_block"
@@ -82,8 +77,11 @@ class GossipRouter:
         self._seen: OrderedDict[bytes, None] = OrderedDict()
         # delivery stats for peer scoring: peer -> (first, duplicate)
         self.delivery_stats: dict[str, list] = {}
-        # v1.1 scoring: peer -> decayed score (P2/P4/P7 weighted)
-        self.scores: dict[str, float] = {}
+        # v1.1 scoring: the full topic-parameterized P1..P7 model
+        # (network/peer_score.py; peer_score.rs:937 analog)
+        from .peer_score import PeerScore, PeerScoreParams
+
+        self.peer_score = PeerScore(PeerScoreParams())
         # mcache: deque of heartbeat windows, each {mid: (topic, wire)}
         self._mcache: list = [dict() for _ in range(MCACHE_LEN)]
         # IWANT bookkeeping: mid -> heartbeat number requested at (so a
@@ -103,6 +101,15 @@ class GossipRouter:
     def subscribe(self, topic: str) -> None:
         self.subscriptions.add(topic)
         self.mesh.setdefault(topic, set())
+        # register per-topic score params: subnet topics weigh little
+        # individually (their union matters), block/aggregate more
+        if topic not in self.peer_score.params.topics:
+            from .peer_score import beacon_topic_params
+
+            self.peer_score.params.topics[topic] = beacon_topic_params(
+                is_subnet="_attestation_" in topic or "subnet" in topic
+                or "sync_committee_" in topic
+            )
 
     def unsubscribe(self, topic: str) -> None:
         self.subscriptions.discard(topic)
@@ -112,6 +119,7 @@ class GossipRouter:
         self.mesh.setdefault(topic, set())
         if len(self.mesh[topic]) < MESH_SIZE:
             self.mesh[topic].add(peer_id)
+            self.peer_score.graft(peer_id, topic)  # P1 clock starts
             # announce mesh membership with a spec GRAFT control frame
             rpc = W.GossipRpc()
             rpc.control.graft.append(topic)
@@ -121,6 +129,8 @@ class GossipRouter:
         pruned = [t for t, peers in self.mesh.items() if peer_id in peers]
         for peers in self.mesh.values():
             peers.discard(peer_id)
+        for t in pruned:
+            self.peer_score.prune(peer_id, t)  # P3b settles here
         self.delivery_stats.pop(peer_id, None)
         if pruned:
             rpc = W.GossipRpc()
@@ -151,11 +161,11 @@ class GossipRouter:
         message, apply control messages, deliver fresh subscribed
         payloads locally. Returns (sender, topic, ssz_data) for the
         first fresh message on a subscribed topic, else None."""
-        if self.scores.get(sender, 0.0) <= GRAYLIST_THRESHOLD:
+        if self.score(sender) <= GRAYLIST_THRESHOLD:
             # graylisted: drop unprocessed; continuing to send while
             # graylisted keeps the score pinned down (decay forgives
             # silence, not persistence)
-            self._score(sender, P7_BEHAVIOUR)
+            self.peer_score.add_penalty(sender)
             return None
         try:
             rpc = W.decode_rpc(payload)
@@ -165,7 +175,7 @@ class GossipRouter:
             # the service poll loop as an exception
             stats = self.delivery_stats.setdefault(sender, [0, 0])
             stats[1] += 1
-            self._score(sender, P4_INVALID)
+            self.peer_score.add_penalty(sender, 2)
             return None
         self._handle_gossip_control(sender, rpc)
         for topic in rpc.control.graft:
@@ -177,10 +187,11 @@ class GossipRouter:
             ) < 2 * MESH_HIGH:  # transient overshoot OK (sanity cap);
                 # the heartbeat prunes anything above D_high back to D
                 self.mesh[topic].add(sender)
+                self.peer_score.graft(sender, topic)
             else:
                 # unsolicited GRAFT is a behavioural offence (P7)
                 if topic not in self.subscriptions:
-                    self._score(sender, P7_BEHAVIOUR)
+                    self.peer_score.add_penalty(sender)
                 rej = W.GossipRpc()
                 rej.control.prune.append((topic, 0))
                 self.endpoint.send(sender, CHANNEL_GOSSIP, W.encode_rpc(rej))
@@ -192,6 +203,7 @@ class GossipRouter:
             if topic not in self.subscriptions:
                 continue
             self.mesh.get(topic, set()).discard(sender)
+            self.peer_score.prune(sender, topic)
             # honor the pruner's backoff so the heartbeat does not
             # re-graft next second (GRAFT/PRUNE churn with peers not
             # subscribed to the topic would mutually P7 honest nodes)
@@ -207,17 +219,23 @@ class GossipRouter:
                 mid = W.message_id_from_ssz(m.topic, ssz)
             except Exception:
                 stats[1] += 1  # undecodable payload: dedup junk by id
-                self._score(sender, P4_INVALID)
+                if m.topic in self.subscriptions:
+                    self.peer_score.reject(sender, m.topic)  # P4
+                else:
+                    # junk topic strings must not grow per-topic state;
+                    # the bounded P7 scalar absorbs the offence
+                    self.peer_score.add_penalty(sender, 2)
                 try:
                     self._mark_seen(W.message_id(m.topic, m.data))
                 except Exception:
                     pass
                 continue
             if mid in self._seen:
-                stats[1] += 1  # duplicate: mesh overlap, mild negative
+                stats[1] += 1  # duplicate still feeds the P3 mesh rate
+                self.peer_score.deliver_duplicate(sender, m.topic)
                 continue
             stats[0] += 1
-            self._score(sender, P2_FIRST_DELIVERY)
+            self.peer_score.deliver_first(sender, m.topic)  # P2 (+P3)
             self._mark_seen(mid)
             self._mcache[0][mid] = (m.topic, m.data)
             # v1.2: tell the rest of the mesh we hold this message
@@ -268,15 +286,15 @@ class GossipRouter:
 
     # -- v1.1 scoring
 
-    def _score(self, peer: str, delta: float) -> None:
-        s = self.scores.get(peer, 0.0) + delta
-        self.scores[peer] = min(s, SCORE_CAP)
+    def score(self, peer: str) -> float:
+        """The peer's current P1..P7 composite score."""
+        return self.peer_score.score(peer)
 
     # -- lazy gossip (IHAVE/IWANT over the mcache)
 
     def _handle_gossip_control(self, sender: str, rpc) -> None:
         ctrl = rpc.control
-        if ctrl.ihave and self.scores.get(sender, 0.0) > GOSSIP_THRESHOLD:
+        if ctrl.ihave and self.score(sender) > GOSSIP_THRESHOLD:
             want = []
             for topic, mids in ctrl.ihave:
                 if topic not in self.subscriptions:
@@ -313,7 +331,7 @@ class GossipRouter:
                 # eth2 gossip ids are exactly 20 bytes; anything else is
                 # junk that would otherwise park frame-sized blobs here
                 if len(mid) != 20:
-                    self._score(sender, P4_INVALID)
+                    self.peer_score.add_penalty(sender)
                     continue
                 if len(dw) >= IDONTWANT_MAX_PER_PEER:
                     break
@@ -341,17 +359,22 @@ class GossipRouter:
         # IDONTWANT holds for one window: the suppressed duplicate is
         # only in flight around the heartbeat it was announced in
         self._dont_want.clear()
+        scores = {
+            p: self.score(p)
+            for p in set(candidates or [])
+            | {p for peers in self.mesh.values() for p in peers}
+        }
         candidates = [
             p
             for p in (candidates or [])
-            if self.scores.get(p, 0.0) > GRAYLIST_THRESHOLD
+            if scores.get(p, 0.0) > GRAYLIST_THRESHOLD
         ]
         for topic in self.subscriptions:
             peers = self.mesh.setdefault(topic, set())
             for peer in [
                 p
                 for p in peers
-                if self.scores.get(p, 0.0) <= GRAYLIST_THRESHOLD
+                if scores.get(p, 0.0) <= GRAYLIST_THRESHOLD
             ]:
                 self.prune(peer)
             if len(peers) < MESH_LOW:
@@ -367,13 +390,14 @@ class GossipRouter:
                 # shed lowest-scoring members back to D (inbound GRAFTs
                 # are accepted up to D_high, so this branch is live)
                 by_score = sorted(
-                    peers, key=lambda p: self.scores.get(p, 0.0)
+                    peers, key=lambda p: scores.get(p, 0.0)
                 )
                 rpc = W.GossipRpc()
                 rpc.control.prune.append((topic, PRUNE_BACKOFF))
                 frame = W.encode_rpc(rpc)
                 for peer in by_score[: len(peers) - MESH_SIZE]:
                     peers.discard(peer)
+                    self.peer_score.prune(peer, topic)
                     self._backoff[(topic, peer)] = (
                         self._heartbeat_no + PRUNE_BACKOFF
                     )
@@ -395,12 +419,7 @@ class GossipRouter:
                     self.endpoint.send(peer, CHANNEL_GOSSIP, frame)
         # decay LAST: shedding above used the scores peers earned;
         # decay forgives between heartbeats
-        for peer in list(self.scores):
-            s = self.scores[peer] * SCORE_DECAY
-            if abs(s) < 0.01:
-                del self.scores[peer]
-            else:
-                self.scores[peer] = s
+        self.peer_score.refresh()
         # rotate the mcache window
         self._mcache.pop()
         self._mcache.insert(0, {})

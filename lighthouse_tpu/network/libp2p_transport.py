@@ -631,6 +631,36 @@ class Libp2pEndpoint:
         with self._lock:
             return list(self._conns)
 
+    def peer_addr(self, peer_id: str) -> Optional[str]:
+        """Remote IP of a connected peer (peer-score IP colocation)."""
+        with self._lock:
+            conn = self._conns.get(peer_id)
+        if conn is None:
+            return None
+        try:
+            return conn.sock.getpeername()[0]
+        except OSError:
+            return None
+
+    def disconnect(self, peer_id: str) -> None:
+        """Tear down one peer's connection (ban enforcement: a banned
+        peer must lose its transport, not just its score)."""
+        with self._lock:
+            conn = self._conns.pop(peer_id, None)
+        if conn is None:
+            return
+        try:
+            with conn.lock:
+                conn.session.go_away()
+                self._flush(conn)
+        except (OSError, ymx.YamuxError):
+            pass
+        conn.dead = True
+        try:
+            conn.sock.close()
+        except OSError:
+            pass
+
     def close(self) -> None:
         self._closed = True
         try:

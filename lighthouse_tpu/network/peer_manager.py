@@ -18,6 +18,11 @@ MIN_SCORE_BEFORE_DISCONNECT = -20.0
 MIN_SCORE_BEFORE_BAN = -50.0
 SCORE_DECAY_HALFLIFE = 600.0  # seconds
 TARGET_PEERS = 16
+BAN_DURATION = 3600.0         # seconds a ban holds (peerdb BanResult)
+MAX_DISCONNECTED_REMEMBERED = 256
+# gossipsub score below this feeds the app-level score each heartbeat
+# (the reference couples gossip score into peer_manager decisions)
+GOSSIP_SCORE_ACTION_THRESHOLD = -80.0
 
 
 class PeerAction(Enum):
@@ -44,9 +49,15 @@ class PeerInfo:
     last_seen: float = 0.0
     chain_status: object = None  # last Status handshake
     subnets: set = field(default_factory=set)
+    banned_until: float = 0.0
+    ban_count: int = 0           # repeat offenders ban longer
+    disconnected_at: float = 0.0
 
 
 class PeerManager:
+    """The peerdb: connection/ban state machine + app-level scoring
+    (peer_manager/mod.rs + peerdb.rs reduced to their decisions)."""
+
     def __init__(self, clock=time.monotonic, target_peers: int = TARGET_PEERS):
         self._clock = clock
         self.target_peers = target_peers
@@ -58,7 +69,10 @@ class PeerManager:
         info = self.peers.get(peer_id)
         if info is None:
             info = self.peers[peer_id] = PeerInfo(peer_id=peer_id)
-        if info.status == PeerStatus.BANNED:
+        if (
+            info.status == PeerStatus.BANNED
+            and self._clock() < info.banned_until
+        ):
             return info  # stays banned; caller must not use it
         info.status = PeerStatus.CONNECTED
         info.last_seen = self._clock()
@@ -68,6 +82,22 @@ class PeerManager:
         info = self.peers.get(peer_id)
         if info is not None and info.status != PeerStatus.BANNED:
             info.status = PeerStatus.DISCONNECTED
+            info.disconnected_at = self._clock()
+
+    def ban(self, peer_id: str) -> PeerInfo:
+        """Explicit ban (peerdb ban lifecycle): holds for BAN_DURATION,
+        doubling per repeat offence; score pinned at the ban floor so a
+        reconnect attempt inside the window stays refused."""
+        info = self.peers.get(peer_id)
+        if info is None:
+            info = self.peers[peer_id] = PeerInfo(peer_id=peer_id)
+        info.ban_count += 1
+        info.banned_until = self._clock() + BAN_DURATION * (
+            2 ** (info.ban_count - 1)
+        )
+        info.status = PeerStatus.BANNED
+        info.score = min(info.score, MIN_SCORE_BEFORE_BAN)
+        return info
 
     # -- scoring
 
@@ -77,23 +107,66 @@ class PeerManager:
         info = self.connect(peer_id)
         info.score += action.value
         if info.score <= MIN_SCORE_BEFORE_BAN:
-            info.status = PeerStatus.BANNED
+            if info.status != PeerStatus.BANNED:
+                self.ban(peer_id)
         elif info.score <= MIN_SCORE_BEFORE_DISCONNECT:
             info.status = PeerStatus.DISCONNECTED
+            info.disconnected_at = self._clock()
         return info.status
 
     def heartbeat(self, dt: float = None) -> None:
-        """Exponential score decay toward zero (peer_score decay)."""
+        """Exponential score decay toward zero; ban expiry; forget old
+        disconnected peers beyond the remembered cap."""
         if dt is None:
             dt = 1.0
+        now = self._clock()
         decay = 0.5 ** (dt / SCORE_DECAY_HALFLIFE)
         for info in self.peers.values():
             info.score *= decay
             if (
                 info.status == PeerStatus.BANNED
+                and now >= info.banned_until
                 and info.score > MIN_SCORE_BEFORE_BAN / 2
             ):
-                info.status = PeerStatus.DISCONNECTED  # ban expiry path
+                info.status = PeerStatus.DISCONNECTED  # ban served
+                info.disconnected_at = now
+        # bound the remembered-disconnected set (peerdb's size caps)
+        gone = [
+            p
+            for p in self.peers.values()
+            if p.status == PeerStatus.DISCONNECTED
+        ]
+        if len(gone) > MAX_DISCONNECTED_REMEMBERED:
+            gone.sort(key=lambda p: p.disconnected_at)
+            for p in gone[: len(gone) - MAX_DISCONNECTED_REMEMBERED]:
+                del self.peers[p.peer_id]
+
+    def prune_excess_peers(self) -> list:
+        """Connected peers beyond target, worst score first — peers a
+        caller should disconnect. Peers providing a subnet nobody else
+        covers are protected (peer_manager prune protection)."""
+        connected = [
+            p
+            for p in self.peers.values()
+            if p.status == PeerStatus.CONNECTED
+        ]
+        excess = len(connected) - self.target_peers
+        if excess <= 0:
+            return []
+        coverage: dict = {}
+        for p in connected:
+            for s in p.subnets:
+                coverage[s] = coverage.get(s, 0) + 1
+        victims = []
+        for p in sorted(connected, key=lambda p: p.score):
+            if len(victims) >= excess:
+                break
+            if any(coverage.get(s, 0) <= 1 for s in p.subnets):
+                continue  # sole provider of a subnet we need
+            victims.append(p.peer_id)
+            for s in p.subnets:
+                coverage[s] -= 1
+        return victims
 
     # -- selection
 

@@ -1,0 +1,346 @@
+"""Gossipsub v1.1 peer scoring — the full topic-parameterized P1..P7
+model (gossipsub/src/peer_score.rs:937 analog; params shape follows
+the v1.1 spec and lighthouse_network's beacon defaults).
+
+Score(peer) = sum_over_topics( topic_weight * (
+        P1  time_in_mesh          (capped, positive)
+      + P2  first_message_deliveries (capped, positive, decaying)
+      + P3  mesh_message_deliveries  (deficit^2 penalty, decaying)
+      + P3b mesh_failure_penalty     (decaying)
+      + P4  invalid_message_deliveries (squared, decaying)
+    ))  [sum capped at topic_score_cap when positive]
+  + P5 app_specific
+  + P6 ip_colocation (excess^2 penalty per shared IP)
+  + P7 behaviour_penalty (excess^2, decaying)
+
+All counters decay multiplicatively on `refresh()` (the heartbeat);
+positives decay away so reputation must be re-earned, negatives decay
+so the sinner is eventually forgiven (except while still misbehaving).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+
+@dataclass
+class TopicScoreParams:
+    """Per-topic weights/decays (spec TopicScoreParams)."""
+
+    topic_weight: float = 1.0
+    # P1: time in mesh
+    time_in_mesh_weight: float = 0.033
+    time_in_mesh_quantum: float = 12.0  # seconds per point
+    time_in_mesh_cap: float = 300.0
+    # P2: first message deliveries
+    first_message_deliveries_weight: float = 1.0
+    first_message_deliveries_decay: float = 0.5
+    first_message_deliveries_cap: float = 100.0
+    # P3: mesh message delivery rate (deficit penalty)
+    mesh_message_deliveries_weight: float = -1.0
+    mesh_message_deliveries_decay: float = 0.5
+    mesh_message_deliveries_cap: float = 100.0
+    mesh_message_deliveries_threshold: float = 4.0
+    mesh_message_deliveries_activation: float = 60.0  # seconds grafted
+    # P3b: sticky penalty carried out of the mesh on prune
+    mesh_failure_penalty_weight: float = -1.0
+    mesh_failure_penalty_decay: float = 0.5
+    # P4: invalid messages (squared)
+    invalid_message_deliveries_weight: float = -100.0
+    invalid_message_deliveries_decay: float = 0.9
+
+
+@dataclass
+class PeerScoreParams:
+    """Global + per-topic parameters (spec PeerScoreParams)."""
+
+    topics: Dict[str, TopicScoreParams] = field(default_factory=dict)
+    topic_score_cap: float = 50.0
+    # P5: application-specific (the peer manager's own judgement)
+    app_specific_weight: float = 1.0
+    # P6: IP colocation
+    ip_colocation_factor_weight: float = -10.0
+    ip_colocation_factor_threshold: int = 3
+    # P7: behavioural penalty (bad GRAFTs, IWANT spam, ...)
+    behaviour_penalty_weight: float = -10.0
+    behaviour_penalty_threshold: float = 2.0
+    behaviour_penalty_decay: float = 0.9
+    decay_to_zero: float = 0.01  # counters below this snap to 0
+    retain_score: float = 300.0  # seconds to keep disconnected stats
+
+
+def beacon_topic_params(is_subnet: bool = False) -> TopicScoreParams:
+    """Default params shaped like the reference's beacon topics: block
+    and aggregate topics weigh more and expect steady delivery; the 64
+    attestation subnets each weigh little (their union matters)."""
+    if is_subnet:
+        return TopicScoreParams(
+            topic_weight=0.015,
+            first_message_deliveries_cap=64.0,
+            mesh_message_deliveries_threshold=0.6,
+        )
+    return TopicScoreParams(topic_weight=0.5)
+
+
+@dataclass
+class _TopicStats:
+    grafted_at: float = -1.0  # <0 = not in mesh
+    mesh_time_accum: float = 0.0
+    first_message_deliveries: float = 0.0
+    mesh_message_deliveries: float = 0.0
+    mesh_failure_penalty: float = 0.0
+    invalid_message_deliveries: float = 0.0
+
+
+@dataclass
+class _PeerStats:
+    topics: Dict[str, _TopicStats] = field(default_factory=dict)
+    app_specific: float = 0.0
+    behaviour_penalty: float = 0.0
+    ip: Optional[str] = None
+    disconnected_at: float = -1.0
+
+
+class PeerScore:
+    """The score book: counters in, one real number out."""
+
+    def __init__(
+        self, params: PeerScoreParams = None, clock=time.monotonic
+    ):
+        self.params = params or PeerScoreParams()
+        self._clock = clock
+        self._peers: Dict[str, _PeerStats] = {}
+        self._ip_peers: Dict[str, set] = {}
+
+    # ------------------------------------------------------ bookkeeping
+
+    def _peer(self, peer: str) -> _PeerStats:
+        st = self._peers.get(peer)
+        if st is None:
+            st = self._peers[peer] = _PeerStats()
+        return st
+
+    def _topic(self, peer: str, topic: str) -> Optional[_TopicStats]:
+        """Per-topic stats — ONLY for topics with registered params.
+        Arbitrary remote topic strings must never grow state (they
+        would also never decay: refresh skips unparameterized topics)."""
+        if topic not in self.params.topics:
+            return None
+        return self._peer(peer).topics.setdefault(topic, _TopicStats())
+
+    def add_peer(self, peer: str, ip: str = None) -> None:
+        st = self._peer(peer)
+        st.disconnected_at = -1.0
+        if ip and st.ip != ip:
+            if st.ip:
+                self._ip_peers.get(st.ip, set()).discard(peer)
+            st.ip = ip
+            self._ip_peers.setdefault(ip, set()).add(peer)
+
+    def remove_peer(self, peer: str) -> None:
+        """Mark disconnected; stats retained for `retain_score` seconds
+        so a reconnect cannot wash a bad record (peer_score.rs
+        remove_peer semantics)."""
+        st = self._peers.get(peer)
+        if st is None:
+            return
+        now = self._clock()
+        for topic, ts in st.topics.items():
+            self._leave_mesh(topic, ts, now)
+        st.disconnected_at = now
+
+    # ----------------------------------------------------- mesh events
+
+    def graft(self, peer: str, topic: str) -> None:
+        ts = self._topic(peer, topic)
+        if ts is not None and ts.grafted_at < 0:
+            ts.grafted_at = self._clock()
+            ts.mesh_message_deliveries = 0.0
+
+    def prune(self, peer: str, topic: str) -> None:
+        st = self._peers.get(peer)
+        ts = st.topics.get(topic) if st else None
+        if ts is not None:
+            self._leave_mesh(topic, ts, self._clock())
+
+    def _leave_mesh(self, topic: str, ts: _TopicStats, now: float) -> None:
+        if ts.grafted_at < 0:
+            return
+        tp = self.params.topics.get(topic)
+        if tp is not None:
+            ts.mesh_time_accum = min(
+                ts.mesh_time_accum
+                + (now - ts.grafted_at) / tp.time_in_mesh_quantum,
+                tp.time_in_mesh_cap,
+            )
+            # P3b: an under-delivering peer carries its deficit out of
+            # the mesh as a sticky penalty
+            if now - ts.grafted_at >= tp.mesh_message_deliveries_activation:
+                deficit = (
+                    tp.mesh_message_deliveries_threshold
+                    - ts.mesh_message_deliveries
+                )
+                if deficit > 0:
+                    ts.mesh_failure_penalty += deficit * deficit
+        ts.grafted_at = -1.0
+
+    # ------------------------------------------------- delivery events
+
+    def deliver_first(self, peer: str, topic: str) -> None:
+        tp = self.params.topics.get(topic)
+        ts = self._topic(peer, topic)
+        if ts is None:
+            return
+        ts.first_message_deliveries = min(
+            ts.first_message_deliveries + 1.0,
+            tp.first_message_deliveries_cap,
+        )
+        if ts.grafted_at >= 0:
+            ts.mesh_message_deliveries = min(
+                ts.mesh_message_deliveries + 1.0,
+                tp.mesh_message_deliveries_cap,
+            )
+
+    def deliver_duplicate(self, peer: str, topic: str) -> None:
+        """A near-first duplicate still counts toward the mesh delivery
+        rate (the spec's mesh delivery window, collapsed: our transport
+        has no validation delay)."""
+        ts = self._topic(peer, topic)
+        if ts is not None and ts.grafted_at >= 0:
+            tp = self.params.topics[topic]
+            ts.mesh_message_deliveries = min(
+                ts.mesh_message_deliveries + 1.0,
+                tp.mesh_message_deliveries_cap,
+            )
+
+    def reject(self, peer: str, topic: str) -> None:
+        """Invalid message (P4); unparameterized topics fall back to
+        the bounded P7 scalar."""
+        ts = self._topic(peer, topic)
+        if ts is None:
+            self.add_penalty(peer)
+            return
+        ts.invalid_message_deliveries += 1.0
+
+    def add_penalty(self, peer: str, n: int = 1) -> None:
+        """P7 behavioural penalty."""
+        self._peer(peer).behaviour_penalty += float(n)
+
+    def set_app_score(self, peer: str, value: float) -> None:
+        self._peer(peer).app_specific = value
+
+    # ------------------------------------------------------- the score
+
+    def score(self, peer: str) -> float:
+        st = self._peers.get(peer)
+        if st is None:
+            return 0.0
+        p = self.params
+        now = self._clock()
+        topic_sum = 0.0
+        for topic, ts in st.topics.items():
+            tp = p.topics.get(topic)
+            if tp is None:
+                continue
+            t = 0.0
+            # P1
+            mesh_time = ts.mesh_time_accum
+            if ts.grafted_at >= 0:
+                mesh_time = min(
+                    mesh_time
+                    + (now - ts.grafted_at) / tp.time_in_mesh_quantum,
+                    tp.time_in_mesh_cap,
+                )
+            t += tp.time_in_mesh_weight * mesh_time
+            # P2
+            t += (
+                tp.first_message_deliveries_weight
+                * ts.first_message_deliveries
+            )
+            # P3: only an ACTIVE, long-enough-grafted mesh member owes
+            # deliveries
+            if (
+                ts.grafted_at >= 0
+                and now - ts.grafted_at
+                >= tp.mesh_message_deliveries_activation
+                and ts.mesh_message_deliveries
+                < tp.mesh_message_deliveries_threshold
+            ):
+                deficit = (
+                    tp.mesh_message_deliveries_threshold
+                    - ts.mesh_message_deliveries
+                )
+                t += tp.mesh_message_deliveries_weight * deficit * deficit
+            # P3b
+            t += tp.mesh_failure_penalty_weight * ts.mesh_failure_penalty
+            # P4 (squared: repeat offenders fall off a cliff)
+            t += (
+                tp.invalid_message_deliveries_weight
+                * ts.invalid_message_deliveries
+                * ts.invalid_message_deliveries
+            )
+            topic_sum += tp.topic_weight * t
+        if topic_sum > p.topic_score_cap:
+            topic_sum = p.topic_score_cap
+        score = topic_sum
+        # P5
+        score += p.app_specific_weight * st.app_specific
+        # P6: quadratic penalty on peers beyond the colocation threshold
+        if st.ip:
+            surplus = (
+                len(self._ip_peers.get(st.ip, ()))
+                - p.ip_colocation_factor_threshold
+            )
+            if surplus > 0:
+                score += p.ip_colocation_factor_weight * surplus * surplus
+        # P7
+        if st.behaviour_penalty > p.behaviour_penalty_threshold:
+            excess = st.behaviour_penalty - p.behaviour_penalty_threshold
+            score += p.behaviour_penalty_weight * excess * excess
+        return score
+
+    # --------------------------------------------------------- decay
+
+    def refresh(self) -> None:
+        """Heartbeat decay pass (peer_score.rs refresh_scores)."""
+        p = self.params
+        now = self._clock()
+        gone = []
+        for peer, st in self._peers.items():
+            if (
+                st.disconnected_at >= 0
+                and now - st.disconnected_at > p.retain_score
+            ):
+                gone.append(peer)
+                continue
+            st.behaviour_penalty *= p.behaviour_penalty_decay
+            if st.behaviour_penalty < p.decay_to_zero:
+                st.behaviour_penalty = 0.0
+            for topic, ts in st.topics.items():
+                tp = p.topics.get(topic)
+                if tp is None:
+                    continue
+                ts.first_message_deliveries *= (
+                    tp.first_message_deliveries_decay
+                )
+                ts.mesh_message_deliveries *= (
+                    tp.mesh_message_deliveries_decay
+                )
+                ts.mesh_failure_penalty *= tp.mesh_failure_penalty_decay
+                ts.invalid_message_deliveries *= (
+                    tp.invalid_message_deliveries_decay
+                )
+                for attr in (
+                    "first_message_deliveries",
+                    "mesh_message_deliveries",
+                    "mesh_failure_penalty",
+                    "invalid_message_deliveries",
+                ):
+                    if getattr(ts, attr) < p.decay_to_zero:
+                        setattr(ts, attr, 0.0)
+        for peer in gone:
+            st = self._peers.pop(peer)
+            if st.ip:
+                self._ip_peers.get(st.ip, set()).discard(peer)

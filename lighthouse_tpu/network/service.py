@@ -57,6 +57,8 @@ class NetworkService:
         effect of discovery + gossipsub GRAFT control messages)."""
         self.peers.connect(other.peer_id)
         other.peers.connect(self.peer_id)
+        self.gossip.peer_score.add_peer(other.peer_id)
+        other.gossip.peer_score.add_peer(self.peer_id)
         for topic in self.gossip.subscriptions & other.gossip.subscriptions:
             self.gossip.graft(topic, other.peer_id)
             other.gossip.graft(topic, self.peer_id)
@@ -70,7 +72,14 @@ class NetworkService:
         return peer
 
     def _on_remote_peer(self, peer_id: str) -> None:
-        self.peers.connect(peer_id)
+        info = self.peers.connect(peer_id)
+        if info.status.value == "banned":
+            # a banned peer redialing inside its window is refused at
+            # the door — no grafts, no transport
+            self._drop_transport(peer_id)
+            return
+        addr = getattr(self.endpoint, "peer_addr", lambda p: None)(peer_id)
+        self.gossip.peer_score.add_peer(peer_id, ip=addr)
         for topic in self.gossip.subscriptions:
             self.gossip.graft(topic, peer_id)
 
@@ -99,7 +108,19 @@ class NetworkService:
     def report_peer(self, peer_id: str, action: PeerAction) -> None:
         status = self.peers.report(peer_id, action)
         if status.value != "connected":
+            # disconnect means disconnect: mesh prune, score-book
+            # retirement (stats retained against a wash-by-reconnect),
+            # AND the transport connection — never a zombie socket
             self.gossip.prune(peer_id)
+            self.gossip.peer_score.remove_peer(peer_id)
+            self._drop_transport(peer_id)
+
+    def _drop_transport(self, peer_id: str) -> None:
+        """A banned peer loses its transport connection, not just its
+        score (peerdb ban -> swarm disconnect in the reference)."""
+        dc = getattr(self.endpoint, "disconnect", None)
+        if dc is not None:
+            dc(peer_id)
 
     # -- event loop
 
@@ -114,6 +135,21 @@ class NetworkService:
         if now - getattr(self, "_last_heartbeat", 0.0) >= 1.0:
             self._last_heartbeat = now
             self.gossip.heartbeat(self.peers.connected())
+            self.peers.heartbeat()
+            # couple the gossipsub score into peerdb decisions: a peer
+            # pinned below the graylist threshold bleeds app score each
+            # heartbeat until disconnect/ban thresholds act
+            from .peer_manager import GOSSIP_SCORE_ACTION_THRESHOLD
+
+            for pid in self.peers.connected():
+                if self.gossip.score(pid) <= GOSSIP_SCORE_ACTION_THRESHOLD:
+                    self.report_peer(pid, PeerAction.LOW_TOLERANCE)
+            # shed excess peers, worst-scored first, protecting sole
+            # subnet providers (peer_manager excess-peer pruning)
+            for pid in self.peers.prune_excess_peers():
+                self.peers.disconnect(pid)
+                self.gossip.prune(pid)
+                self._drop_transport(pid)
         events = []
         for frame in self.endpoint.drain():
             if not self.peers.is_usable(frame.sender):

@@ -99,12 +99,18 @@ class Client:
         self.sync = sync
         self.api_server = api_server
         self.subnet_service = subnet_service
+        # main-thread callbacks run each tick (e.g. draining discovery
+        # dial candidates: NetworkService/gossip state is not
+        # thread-safe, so dials must not run on the discv5 thread)
+        self.tick_hooks: list = []
         self._stop = threading.Event()
 
     def tick(self) -> int:
         """One pump: timer, network events -> work, scheduler steps,
         sync progress. Returns units of work done."""
         n = self.timer.poll()
+        for hook in self.tick_hooks:
+            n += hook() or 0
         if n and self.subnet_service is not None:
             # reconcile gossip meshes with wanted subnets; pushes the
             # new attnets bitfield into the signed ENR when attached

@@ -244,7 +244,7 @@ def test_graylisted_peer_is_ignored_and_shed():
     # hostile frames drive the score below the graylist threshold
     for _ in range(9):
         ra.handle_frame("b", b"\xff\xff\xff")
-    assert ra.scores["b"] <= G.GRAYLIST_THRESHOLD
+    assert ra.score("b") <= G.GRAYLIST_THRESHOLD
     # graylisted: frames dropped unprocessed, heartbeat sheds the peer
     assert ra.handle_frame("b", b"\xff") is None
     ra.heartbeat(["b"])
@@ -272,7 +272,7 @@ def test_first_deliveries_raise_score():
     rb.publish(topic, b"\x01" * 32)
     for f in a.drain():
         ra.handle_frame(f.sender, f.payload)
-    assert ra.scores.get("b", 0.0) > 0
+    assert ra.score("b") > 0
 
 
 def test_prune_backoff_stops_graft_churn():
