@@ -471,6 +471,165 @@ def spec_block_from_union(signed_block, fork: str):
     )
 
 
+class UnsupportedBlockContent(ValueError):
+    """Spec-valid content the union family cannot represent (today:
+    EIP-7549 multi-committee aggregates — splitting one needs the
+    slot's committee sizes, i.e. state, not available at decode time).
+    Callers must treat this as OUR limitation, never penalize the
+    serving peer for it."""
+
+
+def _union_attestation_from_spec(att, fork: str):
+    """Spec attestation -> union shape. Pre-electra: committee_bits
+    stays all-zero (the committee rides data.index, types.py comment).
+    Electra: the union family keeps ONE committee per attestation
+    (aggregation_bits committee-scoped), so multi-committee aggregates
+    cannot be represented and are rejected."""
+    committee_bits = [0] * _P.max_committees_per_slot
+    agg_bits = list(att.aggregation_bits)
+    if _at_least(fork, "electra"):
+        set_bits = [
+            i for i, b in enumerate(att.committee_bits) if b
+        ]
+        if len(set_bits) > 1:
+            raise UnsupportedBlockContent(
+                "multi-committee electra attestation cannot ingest into "
+                "the single-committee union shape"
+            )
+        for i in set_bits:
+            committee_bits[i] = 1
+    return U.Attestation.make(
+        aggregation_bits=agg_bits,
+        data=att.data,
+        signature=bytes(att.signature),
+        committee_bits=committee_bits,
+    )
+
+
+def _union_payload_from_spec(p, fork: str):
+    """Spec payload -> the union's deneb-shaped payload; fields the
+    fork predates default to zero-values."""
+    vals = {
+        name: getattr(p, name)
+        for name, _ in execution_payload_t(fork).fields
+    }
+    out = U.ExecutionPayload.default()
+    for name, v in vals.items():
+        setattr(out, name, v)
+    return out
+
+
+def union_block_from_spec(spec_signed, fork: str):
+    """Spec-exact SignedBeaconBlock -> union family (the INGEST
+    direction, beacon_block.rs superstruct decode role): externally
+    produced phase0..electra blocks become processable by
+    `process_block`/fork choice. Fields the fork predates default."""
+    msg = spec_signed.message
+    sbody = msg.body
+    body = U.BeaconBlockBody.default()
+    if not _at_least(fork, "altair"):
+        # a defaulted (absent) sync aggregate must still carry a VALID
+        # G2 encoding: the compressed point at infinity, as the
+        # internal block producer emits pre-altair
+        body.sync_aggregate.sync_committee_signature = (
+            b"\xc0" + b"\x00" * 95
+        )
+    for name, _ in beacon_block_body_t(fork).fields:
+        if name == "attestations":
+            body.attestations = [
+                _union_attestation_from_spec(a, fork)
+                for a in sbody.attestations
+            ]
+        elif name == "attester_slashings":
+            body.attester_slashings = [
+                U.AttesterSlashing.make(
+                    attestation_1=U.IndexedAttestation.make(
+                        attesting_indices=list(
+                            s.attestation_1.attesting_indices
+                        ),
+                        data=s.attestation_1.data,
+                        signature=bytes(s.attestation_1.signature),
+                    ),
+                    attestation_2=U.IndexedAttestation.make(
+                        attesting_indices=list(
+                            s.attestation_2.attesting_indices
+                        ),
+                        data=s.attestation_2.data,
+                        signature=bytes(s.attestation_2.signature),
+                    ),
+                )
+                for s in sbody.attester_slashings
+            ]
+        elif name == "execution_payload":
+            body.execution_payload = _union_payload_from_spec(
+                sbody.execution_payload, fork
+            )
+        else:
+            setattr(body, name, getattr(sbody, name))
+    return U.SignedBeaconBlock.make(
+        message=U.BeaconBlock.make(
+            slot=msg.slot,
+            proposer_index=msg.proposer_index,
+            parent_root=bytes(msg.parent_root),
+            state_root=bytes(msg.state_root),
+            body=body,
+        ),
+        signature=bytes(spec_signed.signature),
+    )
+
+
+def union_state_from_spec(spec_state, fork: str):
+    """Spec-exact BeaconState -> union family (altair+ only: phase0's
+    pending-attestation lists cannot become participation flags without
+    an epoch replay — the reference performs that as the
+    upgrade_to_altair fork transition, not a decode)."""
+    if fork == "phase0":
+        raise ValueError(
+            "phase0 state ingest needs the altair upgrade replay; "
+            "decode with beacon_state_t('phase0') instead"
+        )
+    out = U.BeaconState.default()
+    electra_flat = {
+        name for name, _ in U.ElectraStateExtras.fields
+    }
+    for name, _ in beacon_state_t(fork).fields:
+        if name == "latest_execution_payload_header":
+            h = spec_state.latest_execution_payload_header
+            uh = U.ExecutionPayloadHeader.default()
+            for n, _t in execution_payload_header_t(fork).fields:
+                setattr(uh, n, getattr(h, n))
+            out.latest_execution_payload_header = uh
+        elif name in electra_flat:
+            setattr(out.electra, name, getattr(spec_state, name))
+        else:
+            setattr(out, name, getattr(spec_state, name))
+    return out
+
+
+def slot_of_signed_block_ssz(raw: bytes) -> int:
+    """Peek the slot of a serialized SignedBeaconBlock without a full
+    decode: fixed part is [message offset u32][signature 96B]; the
+    message begins with its u64 slot (the reference's
+    from_ssz_bytes fork-dispatch trick, beacon_block.rs)."""
+    if len(raw) < 108:
+        raise ValueError("SignedBeaconBlock SSZ shorter than fixed part")
+    off = int.from_bytes(raw[:4], "little")
+    if off + 8 > len(raw):
+        raise ValueError("bad message offset")
+    return int.from_bytes(raw[off : off + 8], "little")
+
+
+def decode_signed_block(spec, raw: bytes):
+    """Fork-dispatched SignedBeaconBlock decode: peek the slot, pick
+    the slot's fork per the spec schedule, decode the spec-exact
+    container, convert to the union family. THE entry point for
+    externally-encoded blocks (REST POST bodies, RPC BlocksByRange)."""
+    slot = slot_of_signed_block_ssz(raw)
+    fork = spec.fork_name_at_epoch(slot // spec.preset.slots_per_epoch)
+    spec_signed = signed_beacon_block_t(fork).deserialize(raw)
+    return union_block_from_spec(spec_signed, fork)
+
+
 def spec_state_from_union(state, fork: str):
     """Union-family BeaconState -> the fork's spec-exact value
     (flattens the electra sub-container; narrows the payload header)."""

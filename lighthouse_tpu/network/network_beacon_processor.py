@@ -60,7 +60,9 @@ class NetworkBeaconProcessor:
 
     def _on_gossip_block(self, peer_id: str, data: bytes) -> None:
         try:
-            signed = T.SignedBeaconBlock.deserialize(data)
+            from .sync import decode_block_response
+
+            signed = decode_block_response(self.chain.spec, data)
         except Exception:
             self.service.report_peer(peer_id, PeerAction.LOW_TOLERANCE)
             return

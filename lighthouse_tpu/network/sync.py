@@ -25,10 +25,25 @@ from enum import Enum
 from typing import Optional
 
 from ..consensus import types as T
+from ..consensus.forked_types import UnsupportedBlockContent
 from ..node.beacon_chain import BlockError
 from ..node.beacon_processor import Work, WorkType
 from .peer_manager import PeerAction
 from .rpc import BlocksByRangeRequest, Protocol, ResponseCode, Status
+
+
+def decode_block_response(spec, raw: bytes):
+    """Decode a SignedBeaconBlock RPC chunk: the framework's native
+    union encoding first, then the fork-dispatched SPEC-EXACT decode
+    (consensus/forked_types.decode_signed_block) so blocks served by an
+    externally-implemented peer ingest too (beacon_block.rs superstruct
+    decode role). Raises ValueError when neither parses."""
+    try:
+        return T.SignedBeaconBlock.deserialize(raw)
+    except Exception:
+        from ..consensus import forked_types as FT
+
+        return FT.decode_signed_block(spec, raw)
 
 BATCH_SLOTS = 64  # EPOCHS_PER_BATCH * 32 in the reference
 MAX_PARENT_DEPTH = 32  # block_lookups parent-chain length cap
@@ -159,7 +174,11 @@ class SyncManager:
         blocks = []
         for raw in chunks:
             try:
-                blocks.append(T.SignedBeaconBlock.deserialize(raw))
+                blocks.append(decode_block_response(self.chain.spec, raw))
+            except UnsupportedBlockContent:
+                # OUR representational limit, not the peer's fault
+                self._backfill_inflight = False
+                return
             except Exception:
                 self._backfill_inflight = False
                 self.service.report_peer(peer_id, PeerAction.LOW_TOLERANCE)
@@ -213,7 +232,9 @@ class SyncManager:
         blocks = []
         for raw in chunks:
             try:
-                blocks.append(T.SignedBeaconBlock.deserialize(raw))
+                blocks.append(decode_block_response(self.chain.spec, raw))
+            except UnsupportedBlockContent:
+                return  # OUR representational limit, not the peer's fault
             except Exception:
                 self.service.report_peer(peer_id, PeerAction.LOW_TOLERANCE)
                 return
@@ -265,7 +286,9 @@ class SyncManager:
         if code != ResponseCode.SUCCESS or not chunks:
             return
         try:
-            block = T.SignedBeaconBlock.deserialize(chunks[0])
+            block = decode_block_response(self.chain.spec, chunks[0])
+        except UnsupportedBlockContent:
+            return  # OUR representational limit, not the peer's fault
         except Exception:
             self.service.report_peer(peer_id, PeerAction.LOW_TOLERANCE)
             return

@@ -611,8 +611,27 @@ class BeaconApi:
         self.chain.batch_verify_attestations([v])
         return 200, {}
 
-    def publish_block(self, body: bytes):
-        signed = T.SignedBeaconBlock.deserialize(body)
+    def publish_block(self, body: bytes, consensus_version: str = None):
+        """POST /eth/v1/beacon/blocks (SSZ body). With an
+        Eth-Consensus-Version header the body is decoded as that fork's
+        SPEC-EXACT container and converted to the union family (the
+        superstruct ingest direction, beacon_block.rs); without it the
+        body is the framework's native union encoding."""
+        if consensus_version:
+            from ..consensus import forked_types as FT
+
+            fork = consensus_version.strip().lower()
+            if fork not in FT.FORKS:
+                raise ApiError(
+                    400, f"unknown Eth-Consensus-Version {consensus_version!r}"
+                )
+            try:
+                spec_signed = FT.signed_beacon_block_t(fork).deserialize(body)
+                signed = FT.union_block_from_spec(spec_signed, fork)
+            except ValueError as e:
+                raise ApiError(400, f"bad {fork} block SSZ: {e}")
+        else:
+            signed = T.SignedBeaconBlock.deserialize(body)
         self.chain.process_block(signed)
         return 200, {}
 
@@ -1344,7 +1363,7 @@ _ROUTES = [
     ),
     ("POST", re.compile(r"^/eth/v1/validator/liveness$"), "liveness"),
     ("POST", re.compile(r"^/eth/v1/beacon/pool/attestations$"), "publish_attestation"),
-    ("POST", re.compile(r"^/eth/v1/beacon/blocks$"), "publish_block"),
+    ("POST", re.compile(r"^/eth/v[12]/beacon/blocks$"), "publish_block"),
     # -------- round-4 surface
     ("GET", re.compile(r"^/eth/v1/node/identity$"), "node_identity"),
     ("GET", re.compile(r"^/eth/v1/node/peers$"), "node_peers"),
@@ -1641,6 +1660,13 @@ def make_handler(api: BeaconApi):
                             )
                         self._send_octets(api.debug_state_ssz(*match.groups()))
                         return
+                    elif name == "publish_block":
+                        code, obj = api.publish_block(
+                            body,
+                            consensus_version=self.headers.get(
+                                "Eth-Consensus-Version"
+                            ),
+                        )
                     elif name in _QUERY_HANDLERS:
                         code, obj = getattr(api, name)(
                             *match.groups(), parsed_q

@@ -171,3 +171,143 @@ def test_union_to_spec_state_electra_flattens():
     spec_deneb = F.spec_state_from_union(state, "deneb")
     td = F.beacon_state_t("deneb")
     assert td.serialize(spec_deneb)
+
+
+# ------------------------------------------------ ingest (spec -> union)
+
+
+@pytest.mark.parametrize("fork", list(F.FORKS))
+def test_external_block_ingests_per_fork(fork):
+    """The VERDICT r4 #6 criterion: an externally-encoded (spec-exact)
+    block for EVERY fork decodes, converts to the union family, and
+    converts back to identical spec bytes (no information loss for
+    single-committee content)."""
+    sb_t = F.signed_beacon_block_t(fork)
+    body_t = F.beacon_block_body_t(fork)
+    att_t = F.attestation_t(fork)
+
+    att = att_t.default()
+    att.data = T.AttestationData.make(
+        slot=9,
+        index=0 if F._at_least(fork, "electra") else 3,
+        beacon_block_root=b"\x01" * 32,
+        source=T.Checkpoint.make(epoch=1, root=b"\x02" * 32),
+        target=T.Checkpoint.make(epoch=2, root=b"\x03" * 32),
+    )
+    att.aggregation_bits = [True, False, True]
+    if F._at_least(fork, "electra"):
+        att.committee_bits = [i == 2 for i in range(64)]
+    body = body_t.default()
+    body.randao_reveal = b"\x05" * 96
+    body.graffiti = b"\x0a" * 32
+    body.attestations = [att]
+    if F._at_least(fork, "bellatrix"):
+        p = F.execution_payload_t(fork).default()
+        p.block_number = 7
+        p.transactions = [b"\x02\x01"]
+        body.execution_payload = p
+    signed = sb_t.make(
+        message=F.beacon_block_t(fork).make(
+            slot=9,
+            proposer_index=4,
+            parent_root=b"\x06" * 32,
+            state_root=b"\x07" * 32,
+            body=body,
+        ),
+        signature=b"\x08" * 96,
+    )
+    wire = sb_t.serialize(signed)
+    # ingest: spec bytes -> union value
+    union = F.union_block_from_spec(sb_t.deserialize(wire), fork)
+    assert int(union.message.slot) == 9
+    assert bytes(union.message.body.graffiti) == b"\x0a" * 32
+    a0 = union.message.body.attestations[0]
+    assert list(a0.aggregation_bits) == [True, False, True]
+    if F._at_least(fork, "electra"):
+        assert bool(list(a0.committee_bits)[2])
+    # round trip: union -> spec reproduces the external bytes exactly
+    assert sb_t.serialize(F.spec_block_from_union(union, fork)) == wire
+
+
+def test_decode_signed_block_fork_dispatch():
+    """decode_signed_block peeks the slot and picks the schedule's fork
+    (beacon_block.rs any_from_ssz_bytes role)."""
+    from lighthouse_tpu.consensus.spec import mainnet_spec
+
+    spec = mainnet_spec()
+    for fork in ("phase0", "capella", "electra"):
+        epoch = spec.fork_epochs[fork]
+        slot = epoch * spec.preset.slots_per_epoch + 1
+        sb_t = F.signed_beacon_block_t(fork)
+        signed = sb_t.default()
+        signed.message.slot = slot
+        union = F.decode_signed_block(spec, sb_t.serialize(signed))
+        assert int(union.message.slot) == slot
+    with pytest.raises(ValueError):
+        F.decode_signed_block(spec, b"\x00" * 10)
+
+
+def test_external_block_imports_through_process_block():
+    """End-to-end ingest: a spec-encoded deneb block (what an external
+    client would serve) imports through the REST POST path with an
+    Eth-Consensus-Version header and becomes the head. Deneb-at-genesis
+    schedule: the interop chain is post-merge internally, so pre-merge
+    fork encodings (no payload field) are lossy by design."""
+    from lighthouse_tpu.consensus import state_transition as st
+    from lighthouse_tpu.consensus.spec import mainnet_spec
+    from lighthouse_tpu.crypto.bls.keys import SecretKey
+    from lighthouse_tpu.node.beacon_chain import BeaconChain
+    from lighthouse_tpu.node.http_api import BeaconApi
+
+    spec = mainnet_spec()
+    spec.fork_epochs = dict(spec.fork_epochs)
+    for f in ("altair", "bellatrix", "capella", "deneb"):
+        spec.fork_epochs[f] = 0
+    pubkeys = [
+        SecretKey.from_seed(i.to_bytes(4, "big")).public_key().to_bytes()
+        for i in range(8)
+    ]
+    genesis = st.interop_genesis_state(spec, pubkeys)
+    chain = BeaconChain(spec, genesis.copy(), bls_backend="fake")
+    chain.on_slot(1)
+    block = chain.produce_block(1, randao_reveal=b"\xc0" + b"\x00" * 95)
+    signed = T.SignedBeaconBlock.make(
+        message=block, signature=b"\xc0" + b"\x00" * 95
+    )
+    # what an external client would POST: spec-exact deneb encoding
+    ext = F.signed_beacon_block_t("deneb").serialize(
+        F.spec_block_from_union(signed, "deneb")
+    )
+    # a second, fresh node ingests it via the versioned POST body path
+    peer = BeaconChain(spec, genesis.copy(), bls_backend="fake")
+    peer.on_slot(1)
+    api = BeaconApi(peer)
+    code, _ = api.publish_block(ext, consensus_version="deneb")
+    assert code == 200
+    assert int(peer.head.slot) == 1
+    assert bytes(peer.head.root) == block.hash_tree_root()
+
+
+def test_external_state_ingests_electra_lossless():
+    """spec-exact electra state bytes -> union family -> back to the
+    identical spec bytes (the state ingest direction; phase0 is
+    decode-only by design — participation needs the altair upgrade)."""
+    from lighthouse_tpu.consensus import state_transition as st
+    from lighthouse_tpu.consensus.spec import mainnet_spec
+    from lighthouse_tpu.crypto.bls.keys import SecretKey
+
+    spec = mainnet_spec()
+    pubkeys = [
+        SecretKey.from_seed(i.to_bytes(4, "big")).public_key().to_bytes()
+        for i in range(8)
+    ]
+    state = st.interop_genesis_state(spec, pubkeys)
+    t = F.beacon_state_t("electra")
+    wire = t.serialize(F.spec_state_from_union(state, "electra"))
+    union = F.union_state_from_spec(t.deserialize(wire), "electra")
+    assert t.serialize(F.spec_state_from_union(union, "electra")) == wire
+    assert int(union.electra.deposit_requests_start_index) == int(
+        state.electra.deposit_requests_start_index
+    )
+    with pytest.raises(ValueError):
+        F.union_state_from_spec(t.default(), "phase0")
