@@ -826,6 +826,10 @@ def process_proposer_slashing(
         raise BlockProcessingError("slashing headers differ in proposer")
     if h1.hash_tree_root() == h2.hash_tree_root():
         raise BlockProcessingError("slashing headers identical")
+    if not 0 <= int(h1.proposer_index) < len(state.validators):
+        raise BlockProcessingError(
+            f"slashing for unknown proposer {int(h1.proposer_index)}"
+        )
     proposer = state.validators[h1.proposer_index]
     if not is_slashable_validator(proposer, get_current_epoch(spec, state)):
         raise BlockProcessingError("proposer not slashable")
@@ -871,7 +875,14 @@ def process_attester_slashing(
     common = sorted(
         set(a1.attesting_indices) & set(a2.attesting_indices)
     )
+    n_validators = len(state.validators)
     for index in common:
+        # attesting indices are attacker-controlled: out-of-registry
+        # entries make the attestation invalid, not a crash
+        if index >= n_validators:
+            raise BlockProcessingError(
+                f"attester slashing names unknown validator {int(index)}"
+            )
         if is_slashable_validator(state.validators[index], epoch):
             slash_validator(spec, state, index)
             slashed_any = True
@@ -1152,6 +1163,12 @@ def process_voluntary_exit(
     spec: ChainSpec, state, signed_exit, verify_signatures: bool
 ) -> None:
     exit_msg = signed_exit.message
+    if not 0 <= int(exit_msg.validator_index) < len(state.validators):
+        # reference ExitInvalid::ValidatorUnknown — a typed processing
+        # error, not an index crash
+        raise BlockProcessingError(
+            f"exit for unknown validator {int(exit_msg.validator_index)}"
+        )
     v = state.validators[exit_msg.validator_index]
     cur = get_current_epoch(spec, state)
     if not is_active_validator(v, cur):
@@ -1191,6 +1208,10 @@ def process_bls_to_execution_change(
     spec: ChainSpec, state, signed_change, verify_signatures: bool
 ) -> None:
     change = signed_change.message
+    if not 0 <= int(change.validator_index) < len(state.validators):
+        raise BlockProcessingError(
+            f"bls change for unknown validator {int(change.validator_index)}"
+        )
     v = state.validators[change.validator_index]
     wc = bytes(v.withdrawal_credentials)
     if wc[:1] != b"\x00":
