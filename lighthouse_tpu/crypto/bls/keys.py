@@ -15,6 +15,27 @@ from typing import Optional, Sequence
 from . import params, curve as C, hash_to_curve as H2C
 
 
+import hashlib as _hashlib
+
+# pubkey memoization keyed on a DIGEST of the secret, never the raw
+# scalar: the cache must not retain secret key material beyond the
+# SecretKey object's life. Values are immutable affine points.
+_PUBKEY_CACHE: dict = {}
+_PUBKEY_CACHE_MAX = 4096
+
+
+def _pubkey_point(scalar: int):
+    h = _hashlib.sha256(
+        b"lh-pk-cache" + scalar.to_bytes(32, "big")
+    ).digest()
+    pt = _PUBKEY_CACHE.get(h)
+    if pt is None:
+        pt = C.g1_mul(C.G1_GEN, scalar)
+        if len(_PUBKEY_CACHE) < _PUBKEY_CACHE_MAX:
+            _PUBKEY_CACHE[h] = pt
+    return pt
+
+
 class SecretKey:
     __slots__ = ("scalar",)
 
@@ -32,7 +53,8 @@ class SecretKey:
         return cls(int.from_bytes(h + hashlib.sha256(h).digest(), "big") % (params.R - 1) + 1)
 
     def public_key(self) -> "PublicKey":
-        return PublicKey(point=C.g1_mul(C.G1_GEN, self.scalar))
+        pt = _pubkey_point(self.scalar)
+        return PublicKey(point=pt)
 
     def sign(self, message: bytes) -> "Signature":
         return Signature(point=C.g2_mul(H2C.hash_to_g2(message), self.scalar))

@@ -37,15 +37,74 @@ def _add(p1, p2):
     return x3, (lam * (x1 - x3) - y1) % P
 
 
+# ------------------------------------------------- jacobian fast path
+# Scalar multiplication runs in Jacobian coordinates: ONE field
+# inversion per multiplication instead of one per point ADDITION
+# (~256x fewer `pow(x, P-2, P)` calls). Discovery handshakes do 4 EC
+# muls each (id_sign/id_verify/ecdh), so the affine version made every
+# discv5 session setup cost ~a second of pure Python.
+
+
+def _jadd(p1, p2):
+    """Jacobian add; points are (X, Y, Z), Z=0 = infinity."""
+    x1, y1, z1 = p1
+    x2, y2, z2 = p2
+    if z1 == 0:
+        return p2
+    if z2 == 0:
+        return p1
+    z1z1 = z1 * z1 % P
+    z2z2 = z2 * z2 % P
+    u1 = x1 * z2z2 % P
+    u2 = x2 * z1z1 % P
+    s1 = y1 * z2 * z2z2 % P
+    s2 = y2 * z1 * z1z1 % P
+    if u1 == u2:
+        if s1 != s2:
+            return (1, 1, 0)  # infinity
+        return _jdbl(p1)
+    h = (u2 - u1) % P
+    hh = h * h % P
+    hhh = h * hh % P
+    r = (s2 - s1) % P
+    v = u1 * hh % P
+    x3 = (r * r - hhh - 2 * v) % P
+    y3 = (r * (v - x3) - s1 * hhh) % P
+    z3 = z1 * z2 * h % P
+    return (x3, y3, z3)
+
+
+def _jdbl(p):
+    x1, y1, z1 = p
+    if z1 == 0 or y1 == 0:
+        return (1, 1, 0)
+    a = x1 * x1 % P
+    b = y1 * y1 % P
+    c = b * b % P
+    d = 2 * ((x1 + b) * (x1 + b) - a - c) % P
+    e = 3 * a % P
+    f = e * e % P
+    x3 = (f - 2 * d) % P
+    y3 = (e * (d - x3) - 8 * c) % P
+    z3 = 2 * y1 * z1 % P
+    return (x3, y3, z3)
+
+
 def _mul(k: int, point):
-    acc = None
-    addend = point
+    if point is None or k % N == 0:
+        return None
+    acc = (1, 1, 0)
+    addend = (point[0], point[1], 1)
     while k:
         if k & 1:
-            acc = _add(acc, addend)
-        addend = _add(addend, addend)
+            acc = _jadd(acc, addend)
+        addend = _jdbl(addend)
         k >>= 1
-    return acc
+    if acc[2] == 0:
+        return None
+    zinv = _inv(acc[2], P)
+    zinv2 = zinv * zinv % P
+    return (acc[0] * zinv2 % P, acc[1] * zinv2 * zinv % P)
 
 
 def pubkey(private: bytes):

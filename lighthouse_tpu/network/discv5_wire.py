@@ -347,32 +347,43 @@ def decode_message(data: bytes) -> Message:
     if len(req_id) > 8:
         raise Discv5WireError("req-id too long")
     msg = Message(kind=kind, req_id=req_id)
-    if kind == MSG_PING:
-        msg.enr_seq = _rlp_int_field(items[1])
-    elif kind == MSG_PONG:
-        msg.enr_seq = _rlp_int_field(items[1])
-        msg.ip = items[2]
-        msg.port = _rlp_int_field(items[3])
-    elif kind == MSG_FINDNODE:
-        msg.distances = [_rlp_int_field(d) for d in items[1]]
-    elif kind == MSG_NODES:
-        msg.total = _rlp_int_field(items[1])
-        for rec in items[2]:
-            if isinstance(rec, list):
-                # re-decode from the re-encoded sublist: Enr.decode
-                # wants raw RLP; reconstruct it. One stale/invalid
-                # record must not discard the reply's valid records.
-                try:
-                    msg.records.append(Enr.decode(_reencode_rlp(rec)))
-                except Exception:
-                    continue
-    elif kind == MSG_TALKREQ:
-        msg.protocol = items[1]
-        msg.payload = items[2]
-    elif kind == MSG_TALKRESP:
-        msg.payload = items[1]
-    else:
-        raise Discv5WireError(f"unknown message type {kind}")
+    try:
+        if kind == MSG_PING:
+            msg.enr_seq = _rlp_int_field(items[1])
+        elif kind == MSG_PONG:
+            msg.enr_seq = _rlp_int_field(items[1])
+            if not isinstance(items[2], (bytes, bytearray)):
+                raise Discv5WireError("pong ip not bytes")
+            msg.ip = items[2]
+            msg.port = _rlp_int_field(items[3])
+        elif kind == MSG_FINDNODE:
+            if not isinstance(items[1], list):
+                raise Discv5WireError("findnode distances not a list")
+            msg.distances = [_rlp_int_field(d) for d in items[1]]
+        elif kind == MSG_NODES:
+            msg.total = _rlp_int_field(items[1])
+            if not isinstance(items[2], list):
+                raise Discv5WireError("nodes records not a list")
+            for rec in items[2]:
+                if isinstance(rec, list):
+                    # re-decode from the re-encoded sublist: Enr.decode
+                    # wants raw RLP; reconstruct it. One stale/invalid
+                    # record must not discard the reply's valid records.
+                    try:
+                        msg.records.append(Enr.decode(_reencode_rlp(rec)))
+                    except Exception:
+                        continue
+        elif kind == MSG_TALKREQ:
+            msg.protocol = items[1]
+            msg.payload = items[2]
+        elif kind == MSG_TALKRESP:
+            msg.payload = items[1]
+        else:
+            raise Discv5WireError(f"unknown message type {kind}")
+    except (IndexError, TypeError, ValueError) as e:
+        # remote-controlled structure: element count / type surprises
+        # are a malformed message, never an uncaught crash
+        raise Discv5WireError(f"malformed {kind:#x} message: {e}") from None
     return msg
 
 

@@ -136,18 +136,31 @@ class Enr:
 
     @classmethod
     def decode(cls, data: bytes) -> "Enr":
-        items, _ = _rlp_decode(data)
+        try:
+            items, _ = _rlp_decode(data)
+        except Exception as e:
+            raise EnrError(f"bad rlp: {e}") from None
         if not isinstance(items, list) or len(items) < 2 or len(items) % 2:
             raise EnrError("malformed record")
+        # sig/seq/keys must be byte strings — nested lists in their
+        # place are a malformed record, not a TypeError
+        if not all(
+            isinstance(items[i], (bytes, bytearray)) for i in (0, 1)
+        ):
+            raise EnrError("sig/seq not byte strings")
         sig = items[0]
         seq = int.from_bytes(items[1], "big")
         pairs = {}
         prev = None
         for i in range(2, len(items), 2):
             k, v = items[i], items[i + 1]
-            if prev is not None and k <= prev:
+            if not isinstance(k, (bytes, bytearray)) or not isinstance(
+                v, (bytes, bytearray)
+            ):
+                raise EnrError("non-byte key or value")
+            if prev is not None and bytes(k) <= prev:
                 raise EnrError("keys not strictly sorted")
-            prev = k
+            prev = bytes(k)
             pairs[k] = v
         enr = cls(seq, pairs, sig)
         if not enr.verify():

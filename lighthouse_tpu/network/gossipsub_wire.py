@@ -194,58 +194,87 @@ def encode_rpc(rpc: GossipRpc) -> bytes:
     return bytes(out)
 
 
+def _bytes_field(wt: int, val) -> bytes:
+    """A field used as bytes/submessage MUST be length-delimited —
+    a varint in its place (wrong wire type) is a malformed message,
+    not something to duck-type into _pb_scan/str.decode."""
+    if wt != 2:
+        raise GossipWireError(f"expected length-delimited field, got wt {wt}")
+    return val
+
+
+def _uint_field(wt: int, val) -> int:
+    if wt != 0:
+        raise GossipWireError(f"expected varint field, got wt {wt}")
+    return val
+
+
+def _decode_topic(raw: bytes) -> str:
+    try:
+        return raw.decode()
+    except UnicodeDecodeError:
+        raise GossipWireError("topic is not valid utf-8") from None
+
+
 def decode_rpc(data: bytes) -> GossipRpc:
     rpc = GossipRpc()
-    for num, _wt, val in _pb_scan(data):
+    for num, wt, val in _pb_scan(data):
         if num == 1:
             sub, topic = False, ""
-            for n2, w2, v2 in _pb_scan(val):
+            for n2, w2, v2 in _pb_scan(_bytes_field(wt, val)):
                 if n2 == 1:
-                    sub = bool(v2)
+                    sub = bool(_uint_field(w2, v2))
                 elif n2 == 2:
-                    topic = v2.decode()
+                    topic = _decode_topic(_bytes_field(w2, v2))
             rpc.subscriptions.append(SubOpts(sub, topic))
         elif num == 2:
             d, topic = b"", ""
-            for n2, w2, v2 in _pb_scan(val):
+            for n2, w2, v2 in _pb_scan(_bytes_field(wt, val)):
                 if n2 == 2:
-                    d = v2
+                    d = _bytes_field(w2, v2)
                 elif n2 == 4:
-                    topic = v2.decode()
+                    topic = _decode_topic(_bytes_field(w2, v2))
                 # from/seqno/signature/key tolerated on decode (other
                 # networks sign); eth2 validation rejects them upstream
             rpc.publish.append(PublishedMessage(topic=topic, data=d))
         elif num == 3:
             c = rpc.control
-            for n2, w2, v2 in _pb_scan(val):
+            for n2, w2, v2 in _pb_scan(_bytes_field(wt, val)):
+                if n2 not in (1, 2, 3, 4, 5):
+                    continue  # protobuf rule: skip unknown fields
+                # ...but a KNOWN field with the wrong wire type is
+                # malformed, not skippable
+                v2b = _bytes_field(w2, v2)
                 if n2 == 1:
                     topic, ids = "", []
-                    for n3, _w3, v3 in _pb_scan(v2):
+                    for n3, w3, v3 in _pb_scan(v2b):
                         if n3 == 1:
-                            topic = v3.decode()
+                            topic = _decode_topic(_bytes_field(w3, v3))
                         elif n3 == 2:
-                            ids.append(v3)
+                            ids.append(_bytes_field(w3, v3))
                     c.ihave.append((topic, ids))
                 elif n2 == 2:
-                    for n3, _w3, v3 in _pb_scan(v2):
+                    for n3, w3, v3 in _pb_scan(v2b):
                         if n3 == 1:
-                            c.iwant.append(v3)
+                            c.iwant.append(_bytes_field(w3, v3))
                 elif n2 == 3:
-                    for n3, _w3, v3 in _pb_scan(v2):
+                    for n3, w3, v3 in _pb_scan(v2b):
                         if n3 == 1:
-                            c.graft.append(v3.decode())
+                            c.graft.append(
+                                _decode_topic(_bytes_field(w3, v3))
+                            )
                 elif n2 == 4:
                     topic, backoff = "", 0
-                    for n3, _w3, v3 in _pb_scan(v2):
+                    for n3, w3, v3 in _pb_scan(v2b):
                         if n3 == 1:
-                            topic = v3.decode()
+                            topic = _decode_topic(_bytes_field(w3, v3))
                         elif n3 == 3:
-                            backoff = v3
+                            backoff = _uint_field(w3, v3)
                     c.prune.append((topic, backoff))
                 elif n2 == 5:
-                    for n3, _w3, v3 in _pb_scan(v2):
+                    for n3, w3, v3 in _pb_scan(v2b):
                         if n3 == 1:
-                            c.idontwant.append(v3)
+                            c.idontwant.append(_bytes_field(w3, v3))
     return rpc
 
 

@@ -122,7 +122,15 @@ def frame_decompress(data: bytes, max_output: int = 1 << 25) -> bytes:
         want_crc = struct.unpack("<I", body[:4])[0]
         payload = body[4:]
         if ctype == _CHUNK_COMPRESSED:
-            payload = snappy.decompress(payload, max_output=_MAX_FRAME_DATA)
+            try:
+                payload = snappy.decompress(
+                    payload, max_output=_MAX_FRAME_DATA
+                )
+            except snappy.SnappyError as e:
+                # the codec's error contract is RpcCodecError — inner
+                # snappy failures on remote bytes must not leak typed
+                # differently than any other malformed chunk
+                raise RpcCodecError(f"bad snappy chunk: {e}") from None
         if len(payload) > _MAX_FRAME_DATA:
             raise RpcCodecError("chunk exceeds 64 KiB limit")
         if _masked_crc(payload) != want_crc:
@@ -310,7 +318,15 @@ def _frame_decompress_prefix(data: bytes, pos: int, want_len: int) -> tuple:
         want_crc = struct.unpack("<I", body[:4])[0]
         payload = body[4:]
         if ctype == _CHUNK_COMPRESSED:
-            payload = snappy.decompress(payload, max_output=_MAX_FRAME_DATA)
+            try:
+                payload = snappy.decompress(
+                    payload, max_output=_MAX_FRAME_DATA
+                )
+            except snappy.SnappyError as e:
+                # the codec's error contract is RpcCodecError — inner
+                # snappy failures on remote bytes must not leak typed
+                # differently than any other malformed chunk
+                raise RpcCodecError(f"bad snappy chunk: {e}") from None
         if _masked_crc(payload) != want_crc:
             raise RpcCodecError("crc mismatch")
         out += payload

@@ -52,6 +52,10 @@ _CRYPTO_HEAVY = {
     "test_kzg.py",
     "test_lane.py",
     "test_lane_curve.py",
+    # windowed pow/ladder kernels vs host bigint oracles (~60s CPU)
+    "test_chains.py",
+    # 44 production ENRs x secp256k1 verify + re-encode (~7s)
+    "test_boot_enr_vectors.py",
 }
 
 

@@ -131,6 +131,7 @@ def test_bootstrap_roundtrip_and_validation(tmp_path):
 # ------------------------------------------------------------ server cache
 
 
+@pytest.mark.slow  # multi-block chain build with 512-bit sync aggregates
 def test_server_cache_produces_updates(tmp_path):
     chain = _chain(tmp_path)
     chain.light_client_cache = LightClientServerCache(chain)
@@ -161,6 +162,7 @@ def test_server_cache_produces_updates(tmp_path):
 # ----------------------------------------------------------------- rpc
 
 
+@pytest.mark.slow  # multi-block chain build with 512-bit sync aggregates
 def test_light_client_rpc_serving(tmp_path):
     from lighthouse_tpu.network.rpc import Protocol, ResponseCode
 

