@@ -82,8 +82,11 @@ def _build_parser() -> argparse.ArgumentParser:
     bn.add_argument("--test-extend", type=int, default=0,
                     help="testing: produce+gossip N blocks after startup")
     bn.add_argument("--test-extend-interval", type=float, default=0.2)
-    bn.add_argument("--bls-backend", choices=["cpu", "tpu", "fake"],
-                    default=None)
+    bn.add_argument("--bls-backend",
+                    choices=["cpu", "tpu", "tpu-warm", "fake"],
+                    default=None,
+                    help="tpu-warm = tpu with CPU fallback while a "
+                         "first-seen batch bucket compiles")
 
     vc = sub.add_parser("vc", help="validator client")
     vc.add_argument("--datadir", default="./vc-datadir")

@@ -4,10 +4,14 @@ Three backends, like the reference's feature-selected impls
 (crypto/bls/src/lib.rs:130-142: blst | fake_crypto, plus the seam this
 project exists to fill — a TPU backend):
 
-  cpu  — pure-Python oracle (control / correctness baseline)
-  tpu  — JAX/XLA batched kernels (lighthouse_tpu.ops), the hot path
-  fake — always-valid stub for fast consensus-logic tests
-         (crypto/bls/src/impls/fake_crypto.rs:31-35)
+  cpu      — pure-Python oracle (control / correctness baseline)
+  tpu      — JAX/XLA batched kernels (lighthouse_tpu.ops), the hot path
+  tpu-warm — tpu with CPU-fallback-while-compiling: cold batch buckets
+             answer from the CPU backend while a background thread
+             compiles the device program (the node default posture for
+             first-seen bucket sizes; backends/warm.py)
+  fake     — always-valid stub for fast consensus-logic tests
+             (crypto/bls/src/impls/fake_crypto.rs:31-35)
 """
 
 from . import cpu, fake
@@ -20,6 +24,10 @@ def get(name: str):
         from . import tpu  # deferred: importing jax is slow
 
         _BACKENDS["tpu"] = tpu
+    elif name in ("tpu-warm", "tpu_warm"):
+        from . import warm
+
+        _BACKENDS[name] = warm
     try:
         return _BACKENDS[name]
     except KeyError:

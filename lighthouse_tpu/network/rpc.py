@@ -188,8 +188,13 @@ class RpcHandler:
         # protocols (the fork digest of the payload's fork)
         self.fork_digest = fork_digest
         self._next_req = 0
-        # req_id -> (protocol, callback(peer, code, chunks))
+        # req_id -> (protocol, peer, callback(peer, code, chunks),
+        #            issued-at) — issued-at drives expiry: a peer that
+        # accepts a request and never answers must not pin the caller's
+        # state machine forever (the reference's RPC response timeout)
         self._pending: dict[int, tuple] = {}
+        self._clock = clock
+        self.request_timeout = 15.0
         self.goodbyes: list = []
 
     def register(self, proto: Protocol, handler: Callable) -> None:
@@ -205,7 +210,7 @@ class RpcHandler:
         self._next_req += 1
         # the target peer is recorded so another peer cannot forge or
         # cancel this request's response with a guessed req_id
-        self._pending[req_id] = (proto, peer_id, callback)
+        self._pending[req_id] = (proto, peer_id, callback, self._clock())
         frame = struct.pack("<IBB", req_id, proto, 0) + rpc_codec.encode_request(
             payload
         )
@@ -214,6 +219,24 @@ class RpcHandler:
             callback(peer_id, ResponseCode.RESOURCE_UNAVAILABLE, [])
             return -1
         return req_id
+
+    def expire_requests(self) -> list:
+        """Time out pending requests past `request_timeout`: each fires
+        its callback with RESOURCE_UNAVAILABLE and the timed-out peer
+        ids are returned so the caller can penalize. Drive from the
+        service heartbeat."""
+        now = self._clock()
+        expired = [
+            (rid, e)
+            for rid, e in self._pending.items()
+            if now - e[3] >= self.request_timeout
+        ]
+        peers = []
+        for rid, (_proto, peer, callback, _t) in expired:
+            self._pending.pop(rid, None)
+            peers.append(peer)
+            callback(peer, ResponseCode.RESOURCE_UNAVAILABLE, [])
+        return peers
 
     # -- inbound
 
@@ -230,7 +253,7 @@ class RpcHandler:
             entry = self._pending.get(req_id)
             if entry is None:
                 return
-            _, expected_peer, callback = entry
+            _, expected_peer, callback, _issued = entry
             if sender != expected_peer:
                 raise MalformedFrame("response from wrong peer")
             self._pending.pop(req_id, None)

@@ -136,6 +136,10 @@ class NetworkService:
             self._last_heartbeat = now
             self.gossip.heartbeat(self.peers.connected())
             self.peers.heartbeat()
+            # RPC response timeouts: silent peers are penalized and the
+            # waiting state machine (sync batches) gets its error
+            for pid in self.rpc.expire_requests():
+                self.report_peer(pid, PeerAction.MID_TOLERANCE)
             # couple the gossipsub score into peerdb decisions: a peer
             # pinned below the graylist threshold bleeds app score each
             # heartbeat until disconnect/ban thresholds act

@@ -10,6 +10,18 @@ Miller-product ([2,3,2,36] int32) and one Jacobian G2 partial sum —
 via all_gather, followed by a replicated final exponentiation.
 """
 
-from .verify import make_mesh, sharded_verify_fn
-
 __all__ = ["make_mesh", "sharded_verify_fn"]
+
+
+def __getattr__(name):
+    # Lazy: importing .verify pulls the kernel modules, whose
+    # module-level jnp constants INITIALIZE the default jax backend.
+    # `python -m lighthouse_tpu.parallel.bench` must be able to
+    # re-assert jax_platforms (a tunnel PJRT plugin can preset it via
+    # sitecustomize) BEFORE that happens — eager package imports here
+    # would initialize the tunnel backend first and block on the chip.
+    if name in __all__:
+        from . import verify
+
+        return getattr(verify, name)
+    raise AttributeError(name)

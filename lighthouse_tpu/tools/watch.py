@@ -54,7 +54,38 @@ CREATE TABLE IF NOT EXISTS block_rewards (
     attestations INTEGER NOT NULL,
     sync_aggregate INTEGER NOT NULL
 );
+-- per-block client fingerprint (watch blockprint role: the reference
+-- daemon calls an external classifier service; offline analog below)
+CREATE TABLE IF NOT EXISTS block_fingerprints (
+    slot INTEGER PRIMARY KEY,
+    proposer INTEGER NOT NULL,
+    client TEXT NOT NULL
+);
+CREATE INDEX IF NOT EXISTS fingerprints_by_proposer
+    ON block_fingerprints (proposer);
 """
+
+# graffiti substrings the major clients stamp by default — the
+# zero-dependency slice of blockprint (the reference ships the
+# classifier as a separate ML service; watch/src only records its
+# verdicts, which is the shape mirrored here)
+_CLIENT_MARKS = (
+    ("lighthouse", "lighthouse"),
+    ("teku", "teku"),
+    ("nimbus", "nimbus"),
+    ("prysm", "prysm"),
+    ("lodestar", "lodestar"),
+    ("grandine", "grandine"),
+    ("erigon", "caplin"),
+)
+
+
+def classify_client(graffiti: str) -> str:
+    g = graffiti.lower()
+    for mark, name in _CLIENT_MARKS:
+        if mark in g:
+            return name
+    return "unknown"
 
 
 def _committee_index(att) -> int:
@@ -97,6 +128,14 @@ class WatchDB:
                     len(body.voluntary_exits),
                     sum(1 for b in sync_bits if b),
                     graffiti.decode(errors="replace"),
+                ),
+            )
+            self._db.execute(
+                "INSERT OR REPLACE INTO block_fingerprints VALUES (?,?,?)",
+                (
+                    int(msg.slot),
+                    int(msg.proposer_index),
+                    classify_client(graffiti.decode(errors="replace")),
                 ),
             )
             self._db.execute(
@@ -228,6 +267,47 @@ class WatchDB:
             "min_total": rows[2],
             "max_total": rows[3],
         }
+
+    def client_distribution(self) -> dict:
+        """Blockprint-style network share: blocks per classified
+        client (watch blockprint_blocks query role)."""
+        return dict(
+            self._db.execute(
+                "SELECT client, COUNT(*) FROM block_fingerprints"
+                " GROUP BY client"
+            ).fetchall()
+        )
+
+    def proposer_clients(self) -> dict:
+        """Most recent fingerprint per proposer (the validators'
+        blockprint column)."""
+        rows = self._db.execute(
+            "SELECT proposer, client FROM block_fingerprints"
+            " ORDER BY slot"
+        ).fetchall()
+        return {p: c for p, c in rows}
+
+    def packing_by_proposer(self) -> dict:
+        """Per-proposer attestation packing (watch block_packing drilled
+        to the proposer level: who ships thin blocks)."""
+        return {
+            p: {"blocks": n, "avg_attestations": avg}
+            for p, n, avg in self._db.execute(
+                "SELECT proposer, COUNT(*), AVG(attestation_count)"
+                " FROM canonical_blocks GROUP BY proposer"
+            ).fetchall()
+        }
+
+    def attestation_inclusion_by_slot(self) -> dict:
+        """Included-attestation counts keyed by the attested slot —
+        gaps against the committee schedule are the per-slot
+        participation signal (suboptimal_attestations aggregate)."""
+        return dict(
+            self._db.execute(
+                "SELECT att_slot, COUNT(*) FROM block_attestations"
+                " GROUP BY att_slot"
+            ).fetchall()
+        )
 
     def balance_history(self, validator_index: int) -> list:
         return self._db.execute(

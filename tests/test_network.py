@@ -262,3 +262,105 @@ def test_unknown_parent_lookup(pair):
     _settle([a, b])
     assert b.chain.head.slot == 2
     assert b.chain.head.root == a.chain.head.root
+
+
+def test_batch_retry_against_next_peer(pair):
+    """Batch retry economics (range_sync/batch.rs role): a peer whose
+    batch response fails to decode gets penalized and the SAME batch is
+    re-requested from the next-best peer, not re-evaluated from
+    scratch against the failing peer forever."""
+    hub, a, b = pair
+    c = Node(hub, "c", _genesis().copy())
+    a.service.connect_peer(c.service)
+    b.service.connect_peer(c.service)
+    signed = _extend(a, 1, others=[b, c])
+    c.chain.process_block(signed)  # c holds the chain too; b is behind
+    b.sync.add_peer("a")
+    b.sync.add_peer("c")
+    _settle([a, b, c])
+    # sabotage a's BlocksByRange server: garbage chunks
+    a.service.rpc.register(
+        Protocol.BLOCKS_BY_RANGE,
+        lambda peer, body: (ResponseCode.SUCCESS, [b"\xff\xff garbage"]),
+    )
+    # make a look best so sync picks it first
+    b.service.peers.peers["a"].score = 5.0
+    b.sync.tick()
+    _settle([a, b, c])
+    for _ in range(4):
+        _settle([a, b, c])
+        if b.chain.head.root == a.chain.head.root:
+            break
+    # the retry went to c and b reached the head anyway
+    assert b.chain.head.root == a.chain.head.root
+    # the garbage server was penalized below the honest peer
+    assert (
+        b.service.peers.peers["a"].score
+        < b.service.peers.peers["c"].score
+    )
+
+
+def test_rpc_request_timeout_fires_and_penalizes(pair):
+    """A peer that accepts a request and never answers must not pin the
+    caller forever: the pending request expires, the callback gets an
+    error, and the peer is penalized (reference RPC timeout role)."""
+    hub, a, b = pair
+    results = []
+    b.service.rpc.request_timeout = 0.0  # immediate expiry for the test
+    b.service.request(
+        "a",
+        Protocol.BLOCKS_BY_ROOT,
+        b"\x00" * 32,
+        lambda p, code, ch: results.append((p, code)),
+    )
+    # drop the request on the floor: partition before a can answer
+    hub.partition("a", "b")
+    score_before = b.service.peers.peers["a"].score
+    b.service._last_heartbeat = 0.0
+    b.service.poll()  # heartbeat -> expire_requests
+    assert results and results[0][1] == ResponseCode.RESOURCE_UNAVAILABLE
+    assert b.service.peers.peers["a"].score < score_before
+    assert not b.service.rpc._pending
+
+
+def test_sync_drives_peerdas_sampling(pair):
+    """Sampling is DRIVEN from sync (peer_sampling.rs:706 role): every
+    imported range-sync batch flows through maybe_sample, and blocks
+    carrying blob commitments start column sampling against connected
+    peers."""
+    from lighthouse_tpu.network.sampling import PeerSampler
+
+    hub, a, b = pair
+    requests = []
+    sampler = PeerSampler(
+        request_column=lambda peer, root, col, cb: (
+            requests.append((peer, bytes(root), col)),
+            cb(None),
+        )[1],
+        samples_per_slot=2,
+    )
+    b.sync.sampler = sampler
+    # 1) the range-sync import path calls maybe_sample with the batch
+    sampled_batches = []
+    original = b.sync.maybe_sample
+    b.sync.maybe_sample = lambda blocks: sampled_batches.append(
+        list(blocks)
+    ) or original(blocks)
+    signed = _extend(a, 1, others=[b])
+    b.sync.add_peer("a")
+    _settle([a, b])
+    b.sync.tick()
+    _settle([a, b])
+    assert b.chain.head.root == a.chain.head.root
+    assert sampled_batches and sampled_batches[0][0].message.slot == 1
+    assert sampler.active == {}  # no commitments -> nothing to sample
+    # 2) a commitment-carrying block starts sampling with requests to
+    # the connected peers
+    signed.message.body.blob_kzg_commitments = [b"\xc0" + b"\x00" * 47]
+    assert original([signed]) == 1
+    root = signed.message.hash_tree_root()
+    # column requests went out to the connected peer for THIS block
+    # (the unanswerable stub fails the request, which then leaves
+    # sampler.active — exactly the real no-peer-serves outcome)
+    assert requests and all(r == root for _, r, _ in requests)
+    assert {p for p, _, _ in requests} == {"a"}

@@ -185,8 +185,26 @@ def test_watch_records_and_queries(tmp_path):
         assert svc.update() == 0  # idempotent on no new blocks
         _extend(chain, 5)
         assert svc.update() == 1
+        # blockprint-style fingerprints: every canonical block got a
+        # classification (this framework's default graffiti carries its
+        # own lighthouse-derived name)
+        dist = svc.db.client_distribution()
+        assert sum(dist.values()) == 4
+        assert set(dist) <= {"lighthouse", "unknown"}
+        assert svc.db.packing_by_proposer()
+        assert svc.db.attestation_inclusion_by_slot() is not None
     finally:
         server.stop()
+
+
+def test_watch_client_classifier():
+    from lighthouse_tpu.tools.watch import classify_client
+
+    assert classify_client("Lighthouse/v4.5.0-1234") == "lighthouse"
+    assert classify_client("teku/v23.10") == "teku"
+    assert classify_client("Nimbus/v24") == "nimbus"
+    assert classify_client("mysterious validator") == "unknown"
+    assert classify_client("") == "unknown"
 
 
 # -------------------------------------------------------------- discovery

@@ -1,0 +1,122 @@
+"""tpu-warm backend: CPU fallback while a cold bucket 'compiles'
+(VERDICT r4 weak #7 — a first-seen batch bucket must not stall the
+node). A fake device with a controllable compile latch stands in for
+the chip."""
+
+import threading
+import time
+
+import pytest
+
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.crypto.bls.backends import warm
+from lighthouse_tpu.crypto.bls.keys import SecretKey, SignatureSet
+
+
+class FakeDevice:
+    """Slow-to-warm device: the first kernel call blocks on a latch
+    (the compile); later calls return instantly."""
+
+    def __init__(self):
+        self.compile_latch = threading.Event()
+        self.kernel_calls = 0
+        self.result = True
+
+    def _bucket(self, n):
+        return 1 << max(7, (n - 1).bit_length())
+
+    def prepare_batch(self, sets, rand_scalars):
+        import numpy as np
+
+        if not sets:
+            return None
+        npad = self._bucket(len(sets))
+        return (np.zeros((1, npad)),)
+
+    def _exported_for(self, npad):
+        return None
+
+    def _verify_kernel(self, *args):
+        self.kernel_calls += 1
+        if self.kernel_calls == 1:
+            self.compile_latch.wait(10)  # the 'compile'
+        import numpy as np
+
+        return np.asarray(self.result)
+
+    def verify_callable(self, npad):
+        return self._verify_kernel
+
+
+@pytest.fixture
+def fake_device():
+    dev = FakeDevice()
+    warm._device_override = dev
+    warm._warm.clear()
+    warm._inflight.clear()
+    yield dev
+    warm._device_override = None
+    warm._warm.clear()
+    warm._inflight.clear()
+
+
+def _sets(n):
+    sk = SecretKey.from_seed(b"warm-test")
+    msg = b"warm-msg"
+    sig = sk.sign(msg)
+    pk = sk.public_key()
+    return [SignatureSet.single_pubkey(sig, pk, msg) for _ in range(n)]
+
+
+def test_cold_bucket_answers_from_cpu_then_migrates(fake_device):
+    sets = _sets(3)
+    scalars = bls.gen_batch_scalars(3)
+    # cold: the answer must arrive promptly (CPU), while the device
+    # 'compiles' in the background
+    t0 = time.monotonic()
+    ok = warm.verify_signature_sets(sets, scalars)
+    assert ok  # CPU verified the real signatures
+    assert time.monotonic() - t0 < 5  # did not wait out the latch
+    assert 128 not in warm._warm  # still compiling
+    # compile finishes -> bucket becomes warm
+    fake_device.compile_latch.set()
+    deadline = time.monotonic() + 5
+    while 128 not in warm._warm and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert 128 in warm._warm
+    # warm: the device path serves (fake device returns its result and
+    # counts the call)
+    calls_before = fake_device.kernel_calls
+    assert warm.verify_signature_sets(sets, scalars)
+    assert fake_device.kernel_calls == calls_before + 1
+
+
+def test_cold_fallback_still_rejects_bad_signatures(fake_device):
+    # the CPU fallback is a REAL verifier: a poisoned batch fails even
+    # though the (never-consulted) fake device would say True
+    sets = _sets(2)
+    sk = SecretKey.from_seed(b"warm-test")
+    sets.append(
+        SignatureSet.single_pubkey(
+            sk.sign(b"other"), sk.public_key(), b"tampered"
+        )
+    )
+    assert not warm.verify_signature_sets(sets, bls.gen_batch_scalars(3))
+    fake_device.compile_latch.set()
+
+
+def test_only_one_warmup_thread_per_bucket(fake_device):
+    sets = _sets(2)
+    for _ in range(4):
+        warm.verify_signature_sets(sets, bls.gen_batch_scalars(2))
+    # one inflight warmup at most, and only ONE kernel call happened
+    assert len(warm._inflight) <= 1
+    assert fake_device.kernel_calls == 1
+    fake_device.compile_latch.set()
+
+
+def test_registry_exposes_tpu_warm():
+    from lighthouse_tpu.crypto.bls import backends
+
+    assert backends.get("tpu-warm") is warm
+    assert backends.get("tpu_warm") is warm
