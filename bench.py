@@ -141,6 +141,65 @@ def _run_config(key: str, min_budget_s: float, fn, *args):
         detail[key] = {"error": f"{type(e).__name__}: {e}"}
 
 
+def _last_self_measured():
+    """The freshest previously-self-measured bench result on this host:
+    /tmp/bench_tpu.json (tunnel_watch's last proving run) or the
+    checked-in BENCH_r*.json driver artifacts — whichever is newest.
+    Returned with its timestamp so a dead-tunnel run reports the last
+    known device rate instead of a bare zero."""
+    import glob
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    # later rounds win mtime ties (fresh checkouts stamp all artifacts
+    # at once); a genuinely newer /tmp proving run wins on mtime.
+    # bench_tpu_last_good.json is tunnel_watch's archive of the last
+    # NONZERO rate — it survives a zero-value run overwriting the live
+    # file.
+    candidates = [
+        "/tmp/bench_tpu.json",
+        "/tmp/bench_tpu_last_good.json",
+    ] + sorted(glob.glob(os.path.join(here, "BENCH_r*.json")))
+    best = None
+    for path in candidates:
+        try:
+            mtime = os.path.getmtime(path)
+            with open(path) as f:
+                doc = json.loads(f.read())
+        except Exception:
+            continue
+        if not isinstance(doc, dict):
+            continue
+        if doc.get("value") is None and isinstance(doc.get("tail"), str):
+            # driver artifact: the bench JSON line is embedded in `tail`
+            for line in reversed(doc["tail"].splitlines()):
+                if line.startswith('{"metric"'):
+                    try:
+                        doc = json.loads(line)
+                    except Exception:
+                        pass
+                    break
+        if doc.get("value") is None:
+            continue
+        # a zero from an earlier dead-tunnel round is not a measurement:
+        # prefer the newest NONZERO rate, fall back to newest otherwise
+        rank = (bool(doc.get("value")), mtime)
+        if best is None or rank >= best[0]:
+            best = (rank, path, doc)
+    if best is None:
+        return {"note": "no prior self-measured result found"}
+    (_, mtime), path, doc = best
+    return {
+        "value": doc.get("value"),
+        "unit": doc.get("unit"),
+        "vs_baseline": doc.get("vs_baseline"),
+        "source": path,
+        "measured_at": time.strftime(
+            "%Y-%m-%dT%H:%M:%SZ", time.gmtime(mtime)
+        ),
+        "note": "STALE: chip unreachable this run; last self-measured rate",
+    }
+
+
 def _pcts(xs):
     import math
 
@@ -280,29 +339,65 @@ def main():
     # jax.devices() inside the PJRT client init (a C call the SIGALRM
     # handler cannot interrupt — Python signals run between bytecodes),
     # which is exactly how a driver run turns into an opaque rc=124.
-    # Probe from a daemon thread and emit the JSON error line if the
-    # backend does not come up in time.
-    init_timeout = float(os.environ.get("BENCH_INIT_TIMEOUT_S", "600"))
-    init_box = {}
+    # Probe from a daemon thread, and RETRY for the whole driver budget
+    # (VERDICT r5 weak #1): the tunnel flaps, and a chip that appears at
+    # minute 12 still leaves time for the warm-cache configs. Each
+    # attempt's tunnel state lands in detail["backend_init"]; if the
+    # chip never appears, the freshest self-measured result (with its
+    # timestamp) is attached so the driver sees the last known rate
+    # instead of a bare value: 0.0.
+    attempt_timeout = float(os.environ.get("BENCH_INIT_TIMEOUT_S", "120"))
+    # leave enough budget after a successful late probe for config 1
+    reserve_s = 90.0
+    attempts = []
+    device = None
+    while True:
+        box = {}
 
-    def _probe():
-        try:
-            init_box["device"] = str(jax.devices()[0])
-        except BaseException as e:  # noqa: BLE001 - recorded, not raised
-            init_box["error"] = f"{type(e).__name__}: {e}"
+        def _probe(out=box):
+            try:
+                out["device"] = str(jax.devices()[0])
+            except BaseException as e:  # noqa: BLE001 - recorded, not raised
+                out["error"] = f"{type(e).__name__}: {e}"
 
-    th = threading.Thread(target=_probe, daemon=True)
-    th.start()
-    th.join(init_timeout)
-    if "device" not in init_box:
-        detail["backend_init"] = {
-            "error": init_box.get(
-                "error", f"no backend within {init_timeout:.0f}s"
+        th = threading.Thread(target=_probe, daemon=True)
+        t_attempt = time.monotonic()
+        th.start()
+        th.join(min(attempt_timeout, max(_left() - reserve_s, 5.0)))
+        if "device" in box:
+            device = box["device"]
+            attempts.append(
+                {
+                    "at_s": round(time.monotonic() - _T_START, 1),
+                    "state": f"up: {device}",
+                }
             )
-        }
+            break
+        attempts.append(
+            {
+                "at_s": round(time.monotonic() - _T_START, 1),
+                "state": box.get(
+                    "error",
+                    f"tunnel silent: no backend within "
+                    f"{time.monotonic() - t_attempt:.0f}s",
+                ),
+            }
+        )
+        if _left() < attempt_timeout + reserve_s:
+            break
+        try:
+            # drop any poisoned half-initialized client before retrying
+            jax.clear_backends()
+        except Exception:
+            pass
+        time.sleep(min(30.0, max(_left() - reserve_s, 0.0)))
+    detail["backend_init"] = {"attempts": attempts}
+    if device is None:
+        detail["backend_init"]["error"] = "device never appeared"
+        detail["last_self_measured"] = _last_self_measured()
         _emit()
         os._exit(3)
-    detail["device"] = init_box["device"]
+    detail["device"] = device
     detail["blst_anchor"] = {
         "sets_per_s_per_core": BLST_SETS_PER_S_PER_CORE,
         "host_cores": BLST_HOST_CORES,

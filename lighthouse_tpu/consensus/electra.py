@@ -13,6 +13,7 @@ from __future__ import annotations
 
 from .spec import FAR_FUTURE_EPOCH, ChainSpec
 from . import types as T
+from .ssz import seq_get_mut
 
 COMPOUNDING_WITHDRAWAL_PREFIX = b"\x02"
 UNSET_DEPOSIT_REQUESTS_START_INDEX = 2**64 - 1
@@ -134,6 +135,7 @@ def initiate_validator_exit(spec: ChainSpec, state, index: int) -> None:
     exit_epoch = compute_exit_epoch_and_update_churn(
         spec, state, v.effective_balance
     )
+    v = seq_get_mut(state.validators, index)  # CoW: never leak to copies
     v.exit_epoch = exit_epoch
     v.withdrawable_epoch = (
         exit_epoch + spec.min_validator_withdrawability_delay
@@ -149,7 +151,7 @@ def get_pending_balance_to_withdraw(state, index: int) -> int:
 
 
 def switch_to_compounding_validator(spec: ChainSpec, state, index: int) -> None:
-    v = state.validators[index]
+    v = seq_get_mut(state.validators, index)
     v.withdrawal_credentials = (
         COMPOUNDING_WITHDRAWAL_PREFIX + bytes(v.withdrawal_credentials)[1:]
     )
@@ -299,6 +301,7 @@ def process_consolidation_request(spec: ChainSpec, state, request, ctx) -> None:
     exit_epoch = compute_consolidation_epoch_and_update_churn(
         spec, state, source.effective_balance
     )
+    source = seq_get_mut(state.validators, source_index)
     source.exit_epoch = exit_epoch
     source.withdrawable_epoch = (
         exit_epoch + spec.min_validator_withdrawability_delay
@@ -457,7 +460,7 @@ def process_effective_balance_updates(spec: ChainSpec, state) -> None:
             balance + downward < v.effective_balance
             or v.effective_balance + upward < balance
         ):
-            v.effective_balance = min(
+            seq_get_mut(state.validators, i).effective_balance = min(
                 balance - balance % spec.effective_balance_increment, cap
             )
 
@@ -474,6 +477,7 @@ def process_registry_updates(spec: ChainSpec, state) -> None:
             v.activation_eligibility_epoch == FAR_FUTURE_EPOCH
             and v.effective_balance >= spec.min_activation_balance
         ):
+            v = seq_get_mut(state.validators, i)
             v.activation_eligibility_epoch = cur + 1
         if (
             st.is_active_validator(v, cur)
@@ -485,7 +489,9 @@ def process_registry_updates(spec: ChainSpec, state) -> None:
             and v.activation_eligibility_epoch
             <= state.finalized_checkpoint.epoch
         ):
-            v.activation_epoch = cur + 1 + spec.max_seed_lookahead
+            seq_get_mut(state.validators, i).activation_epoch = (
+                cur + 1 + spec.max_seed_lookahead
+            )
 
 
 # ------------------------------------------------------------ withdrawals

@@ -36,36 +36,15 @@ def compute_shuffled_index(
 def shuffle_list(indices: list, seed: bytes, rounds: int) -> list:
     """Whole-list shuffle: shuffled[i] = indices[shuffled_index(i)].
 
-    Batched hash reuse per (round, position-block) keeps it O(n * rounds)
-    hashes worst case with a small cache; a numpy-vectorized whole-list
-    pass (the form the reference optimizes and benches) is a planned
-    speedup — semantics fixed by compute_shuffled_index.
-    """
-    n = len(indices)
-    cache = {}
-
-    def src(r: int, block: int) -> bytes:
-        key = (r, block)
-        if key not in cache:
-            cache[key] = _hash(seed + bytes([r]) + block.to_bytes(4, "little"))
-        return cache[key]
-
-    pivots = [
-        int.from_bytes(_hash(seed + bytes([r]))[:8], "little") % n
-        for r in range(rounds)
-    ]
-    out = []
-    for i in range(n):
-        idx = i
-        for r in range(rounds):
-            pivot = pivots[r]
-            flip = (pivot + n - idx) % n
-            position = max(idx, flip)
-            byte = src(r, position // 256)[(position % 256) // 8]
-            if (byte >> (position % 8)) & 1:
-                idx = flip
-        out.append(indices[idx])
-    return out
+    Runs as ONE numpy pass over the whole list (shuffle_permutation, the
+    form the reference optimizes and benches in
+    consensus/swap_or_not_shuffle/benches/benches.rs) — semantics fixed
+    by compute_shuffled_index; the permutation is cached on its pure
+    inputs so the per-epoch committee sweep pays for it once."""
+    if not indices:
+        return []
+    perm = _perm_cached(len(indices), seed, rounds)
+    return [indices[p] for p in perm]
 
 
 def shuffle_permutation(n: int, seed: bytes, rounds: int):
@@ -102,16 +81,18 @@ def shuffle_permutation(n: int, seed: bytes, rounds: int):
 # domain), so one entry serves ~2048 mainnet committee resolutions —
 # without it a 500k-validator slot cost ~10 minutes (round-4 scale
 # probe, BASELINE.md §scale). Keyed on pure inputs: safe under state
-# mutation. Tiny LRU: epochs roll, two seeds (current+previous) live.
+# mutation. Small LRU: current+previous epoch attester seeds plus the
+# occasional proposer/sync-committee seed across two fork branches.
 _PERM_CACHE: dict = {}
+_PERM_CACHE_MAX = 8
 
 
 def _perm_cached(n: int, seed: bytes, rounds: int):
-    key = (n, seed, rounds)
+    key = (n, bytes(seed), rounds)
     p = _PERM_CACHE.get(key)
     if p is None:
         p = shuffle_permutation(n, seed, rounds)
-        while len(_PERM_CACHE) >= 4:
+        while len(_PERM_CACHE) >= _PERM_CACHE_MAX:
             _PERM_CACHE.pop(next(iter(_PERM_CACHE)))
         _PERM_CACHE[key] = p
     return p
@@ -120,14 +101,11 @@ def _perm_cached(n: int, seed: bytes, rounds: int):
 def compute_committee(
     indices: list, seed: bytes, index: int, count: int, rounds: int
 ) -> list:
-    """Slice `index` of `count` committees over the shuffled indices."""
+    """Slice `index` of `count` committees over the shuffled indices.
+    Always resolved from the cached whole-list permutation: every
+    committee of the epoch shares one vectorized shuffle."""
     n = len(indices)
     start = n * index // count
     end = n * (index + 1) // count
-    if end - start > 64 or (n, seed, rounds) in _PERM_CACHE:
-        perm = _perm_cached(n, seed, rounds)
-        return [indices[p] for p in perm[start:end]]
-    return [
-        indices[compute_shuffled_index(i, n, seed, rounds)]
-        for i in range(start, end)
-    ]
+    perm = _perm_cached(n, seed, rounds)
+    return [indices[p] for p in perm[start:end]]

@@ -7,20 +7,30 @@ uintN, boolean, Bytes{N}, Vector, List, Bitvector, Bitlist, Container,
 and Union is omitted (unused by the types we model).
 
 Types are *descriptors* (instances of SSZType subclasses); values are
-plain Python (ints, bytes, lists, dataclass-like Containers). This keeps
-the host layer simple and keeps hashing vectorizable later (hash-tree-
-root of big state objects is a flagged TPU-offload candidate,
-SURVEY.md §7 P4 note).
+plain Python (ints, bytes, lists, dataclass-like Containers) — except
+big List/Vector values, which live on a chunked copy-on-write spine
+(ChunkedSeq, the milhouse-persistent-list analog) so `state.copy()` is
+O(spine) and hash-tree-root is O(dirty chunks). This keeps the host
+layer simple and keeps hashing vectorizable later (hash-tree-root of
+big state objects is a flagged TPU-offload candidate, SURVEY.md §7 P4
+note).
 """
 
 from __future__ import annotations
 
 import hashlib
+import itertools
 from dataclasses import dataclass
 from typing import Any, Sequence
 
 BYTES_PER_CHUNK = 32
 OFFSET_SIZE = 4
+
+# ChunkedSeq spine geometry: elements per chunk (power of two). A plain
+# list longer than _WRAP_THRESHOLD that lands in a List/Vector container
+# field is converted to a ChunkedSeq so state.copy() is O(spine).
+CHUNK_ELEMS = 1024
+_WRAP_THRESHOLD = 2048
 
 
 def _hash(a: bytes, b: bytes) -> bytes:
@@ -367,6 +377,248 @@ def _deserialize_seq(elem: SSZType, data: bytes):
     return out
 
 
+# ------------------------------------------------------- chunked CoW spine
+#
+# The persistent-list layer (milhouse analog): a big List/Vector value is
+# a spine of fixed-size chunks. `copy()` shares every chunk and is
+# O(spine); the first mutation of a chunk through __setitem__ / append /
+# get_mut copies just that chunk (and, for container elements, just that
+# element). Each chunk also caches its merkle SUBTREE root, so
+# hash_tree_root after k mutated chunks re-hashes O(k + spine) instead
+# of O(n) — the structural sharing the reference gets from milhouse
+# (consensus/types/src/beacon_state.rs) for the 9 state.copy() sites in
+# the per-slot hot path.
+#
+# Sharing contract (CHANGES.md "CoW spine contract"):
+#   - copy() FREEZES both sides: every chunk becomes shared, and all
+#     element-privacy marks are dropped. Either side re-owns a chunk by
+#     mutating it.
+#   - __setitem__ / append invalidate exactly the touched chunk's cached
+#     subtree root and bump the content token.
+#   - container elements fetched for IN-PLACE mutation must come from
+#     get_mut(i) (seq_get_mut for plain-list compatibility): it CoWs the
+#     chunk AND the element, so the sibling copy never observes the
+#     write. Reading via [i] / iteration returns the shared object.
+#   - the content token (seq_token) is equal across copies until one
+#     side mutates: equal tokens imply identical content, which keys the
+#     state_transition active-set / committee caches safely.
+
+_TOKEN_COUNTER = itertools.count(1)
+
+
+class ChunkedSeq:
+    """Chunked persistent sequence backing big SSZ List/Vector values."""
+
+    __slots__ = (
+        "_chunks",
+        "_len",
+        "_owned",
+        "_owned_elems",
+        "_roots",
+        "_root_elem",
+        "_elem",
+        "_token",
+    )
+
+    def __init__(self, values=(), elem: SSZType = None):
+        vals = values if isinstance(values, list) else list(values)
+        self._chunks = [
+            vals[i : i + CHUNK_ELEMS] for i in range(0, len(vals), CHUNK_ELEMS)
+        ]
+        self._len = len(vals)
+        # freshly sliced chunk lists are private; the ELEMENTS inside
+        # came from the caller and may be aliased — not private
+        self._owned = set(range(len(self._chunks)))
+        self._owned_elems = {}
+        self._roots = [None] * len(self._chunks)
+        self._root_elem = None
+        self._elem = elem
+        self._token = next(_TOKEN_COUNTER)
+
+    # ------------------------------------------------------------ sharing
+
+    def copy(self) -> "ChunkedSeq":
+        """O(spine) structural-sharing copy; freezes both sides."""
+        self._owned.clear()
+        self._owned_elems.clear()
+        new = ChunkedSeq.__new__(ChunkedSeq)
+        new._chunks = list(self._chunks)
+        new._len = self._len
+        new._owned = set()
+        new._owned_elems = {}
+        new._roots = list(self._roots)
+        new._root_elem = self._root_elem
+        new._elem = self._elem
+        new._token = self._token
+        return new
+
+    @property
+    def token(self) -> int:
+        return self._token
+
+    def _own_chunk(self, ci: int) -> list:
+        """Make chunk `ci` privately mutable; invalidate its root."""
+        if ci not in self._owned:
+            self._chunks[ci] = list(self._chunks[ci])
+            self._owned.add(ci)
+            self._owned_elems[ci] = set()
+        self._roots[ci] = None
+        self._token = next(_TOKEN_COUNTER)
+        return self._chunks[ci]
+
+    def get_mut(self, i: int):
+        """Fetch element `i` for in-place mutation: CoWs the chunk and
+        the element so no sibling copy observes the write."""
+        ci, off = self._locate(i)
+        chunk = self._own_chunk(ci)
+        priv = self._owned_elems.setdefault(ci, set())
+        if off not in priv:
+            e = chunk[off]
+            if self._elem is not None:
+                e = _fast_copy_value(self._elem, e)
+            elif isinstance(e, SSZValue):
+                e = SSZValue(e._type, dict(e._vals))
+            elif isinstance(e, list):
+                e = list(e)
+            chunk[off] = e
+            priv.add(off)
+        return chunk[off]
+
+    # ----------------------------------------------------------- sequence
+
+    def _locate(self, i):
+        i = int(i)
+        if i < 0:
+            i += self._len
+        if not 0 <= i < self._len:
+            raise IndexError("ChunkedSeq index out of range")
+        return i // CHUNK_ELEMS, i % CHUNK_ELEMS
+
+    def __len__(self) -> int:
+        return self._len
+
+    def __iter__(self):
+        for ci in range(len(self._chunks)):
+            yield from self._chunks[ci]
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            start, stop, step = i.indices(self._len)
+            return [self[j] for j in range(start, stop, step)]
+        ci, off = self._locate(i)
+        return self._chunks[ci][off]
+
+    def __setitem__(self, i, value) -> None:
+        ci, off = self._locate(i)
+        chunk = self._own_chunk(ci)
+        chunk[off] = value
+        # caller-provided object: treat as private to this instance
+        self._owned_elems.setdefault(ci, set()).add(off)
+
+    def append(self, value) -> None:
+        if self._chunks and len(self._chunks[-1]) < CHUNK_ELEMS:
+            ci = len(self._chunks) - 1
+            chunk = self._own_chunk(ci)
+            self._owned_elems.setdefault(ci, set()).add(len(chunk))
+            chunk.append(value)
+        else:
+            ci = len(self._chunks)
+            self._chunks.append([value])
+            self._roots.append(None)
+            self._owned.add(ci)
+            self._owned_elems[ci] = {0}
+            self._token = next(_TOKEN_COUNTER)
+        self._len += 1
+
+    def __eq__(self, other):
+        if other is self:
+            return True
+        try:
+            if len(other) != self._len:
+                return False
+        except TypeError:
+            return NotImplemented
+        return all(a == b for a, b in zip(self, other))
+
+    def __repr__(self):
+        return (
+            f"<ChunkedSeq len={self._len} chunks={len(self._chunks)} "
+            f"token={self._token}>"
+        )
+
+    # -------------------------------------------------------- root caching
+
+    def _cached_chunk_root(self, ci: int, elem: SSZType) -> bytes:
+        if self._root_elem is not elem:
+            # roots were computed under a different descriptor: drop them
+            self._roots = [None] * len(self._chunks)
+            self._root_elem = elem
+        r = self._roots[ci]
+        if r is None:
+            r = _chunk_subtree_root(elem, self._chunks[ci], _chunk_depth(elem))
+            self._roots[ci] = r
+        return r
+
+
+def seq_token(seq):
+    """Content token for cache keys: equal tokens imply identical
+    content. None for plain lists (no cheap identity)."""
+    return seq._token if isinstance(seq, ChunkedSeq) else None
+
+
+def seq_get_mut(seq, i: int):
+    """Element `i` of `seq`, safe to mutate in place. For a ChunkedSeq
+    this CoWs the chunk+element; a plain list was deep-rebuilt by
+    copy(), so the element itself is returned."""
+    if isinstance(seq, ChunkedSeq):
+        return seq.get_mut(i)
+    return seq[i]
+
+
+def _chunk_depth(elem: SSZType) -> int:
+    """Depth of one chunk's merkle subtree: leaf chunks per spine chunk
+    as a power of two (basic elements pack; composite elements
+    contribute one 32-byte root each)."""
+    if isinstance(elem, (Uint, Boolean)):
+        leaf_chunks = elem.fixed_size() * CHUNK_ELEMS // BYTES_PER_CHUNK
+    else:
+        leaf_chunks = CHUNK_ELEMS
+    return leaf_chunks.bit_length() - 1
+
+
+def _chunk_subtree_root(elem: SSZType, chunk: list, depth: int) -> bytes:
+    if isinstance(elem, (Uint, Boolean)):
+        leaves = _pack_bytes(b"".join(elem.serialize(v) for v in chunk))
+    elif isinstance(elem, ByteVector) and elem.length == 32:
+        leaves = [bytes(v) for v in chunk]
+    else:
+        leaves = [elem.hash_tree_root(v) for v in chunk]
+    return merkleize(leaves, 1 << depth)
+
+
+def _chunked_seq_root(elem: SSZType, cs: ChunkedSeq, limit_chunks) -> bytes:
+    """Merkle root of a ChunkedSeq from cached per-chunk subtree roots:
+    O(dirty chunks + spine) instead of O(n)."""
+    if isinstance(elem, (Uint, Boolean)):
+        actual_leaves = (len(cs) * elem.fixed_size() + 31) // BYTES_PER_CHUNK
+    else:
+        actual_leaves = len(cs)
+    total_leaves = limit_chunks if limit_chunks is not None else actual_leaves
+    if actual_leaves > total_leaves:
+        raise ValueError("chunk count exceeds limit")
+    width = _next_pow2(total_leaves)
+    depth = width.bit_length() - 1
+    k = _chunk_depth(elem)
+    if depth < k or not cs._chunks:
+        return _seq_root_plain(elem, list(cs), limit_chunks)
+    layer = [cs._cached_chunk_root(ci, elem) for ci in range(len(cs._chunks))]
+    for d in range(k, depth):
+        if len(layer) % 2:
+            layer.append(_ZERO_CHUNKS[d])
+        layer = [_hash(layer[i], layer[i + 1]) for i in range(0, len(layer), 2)]
+    return layer[0]
+
+
 # Content-keyed root cache for big sequences: beacon-state vectors
 # (randao mixes, block/state roots) are re-rooted every slot but change
 # in at most one entry; one C-speed sha256 over the joined leaves is
@@ -391,6 +643,12 @@ def _cached_merkleize(chunks: list, limit_chunks) -> bytes:
 
 
 def _seq_root(elem: SSZType, values, limit_chunks) -> bytes:
+    if isinstance(values, ChunkedSeq):
+        return _chunked_seq_root(elem, values, limit_chunks)
+    return _seq_root_plain(elem, values, limit_chunks)
+
+
+def _seq_root_plain(elem: SSZType, values, limit_chunks) -> bytes:
     if isinstance(elem, (Uint, Boolean)):
         data = b"".join(elem.serialize(v) for v in values)
         chunks = _pack_bytes(data) if data else []
@@ -413,6 +671,14 @@ class Container(SSZType):
     def __init__(self, name: str, fields: Sequence[tuple]):
         self.name = name
         self.fields = list(fields)  # [(name, SSZType), ...]
+        self.fmap = dict(self.fields)
+        # field names whose values auto-wrap into a ChunkedSeq when a
+        # big plain list is stored (List/Vector container fields)
+        self._seq_fields = {
+            fname: ftype
+            for fname, ftype in self.fields
+            if type(ftype) in (List, Vector)
+        }
 
     def is_fixed_size(self):
         return all(t.is_fixed_size() for _, t in self.fields)
@@ -474,7 +740,7 @@ class Container(SSZType):
         for i in range(len(offsets) - 1):
             fname, start = offsets[i]
             _, end = offsets[i + 1]
-            ftype = dict(self.fields)[fname]
+            ftype = self.fmap[fname]
             if end < start or start > len(data):
                 raise ValueError("offsets not monotonic / out of bounds")
             fixed_vals[fname] = ftype.deserialize(data[start:end])
@@ -507,6 +773,10 @@ class SSZValue:
     __slots__ = ("_type", "_vals")
 
     def __init__(self, ctype: Container, vals: dict):
+        for fname, ftype in ctype._seq_fields.items():
+            v = vals.get(fname)
+            if type(v) is list and len(v) > _WRAP_THRESHOLD:
+                vals[fname] = ChunkedSeq(v, elem=ftype.elem)
         object.__setattr__(self, "_type", ctype)
         object.__setattr__(self, "_vals", vals)
 
@@ -520,16 +790,21 @@ class SSZValue:
         vals = object.__getattribute__(self, "_vals")
         if name not in vals:
             raise AttributeError(f"no field {name}")
+        if type(value) is list and len(value) > _WRAP_THRESHOLD:
+            ftype = object.__getattribute__(self, "_type")._seq_fields.get(name)
+            if ftype is not None:
+                value = ChunkedSeq(value, elem=ftype.elem)
         vals[name] = value
 
     def copy(self) -> "SSZValue":
         """Type-driven fast copy: leaf values (ints, bytes, bools) are
-        immutable and SHARED; containers and element lists are rebuilt.
-        Semantically a deep copy (every mutation path in this codebase
-        goes through __setattr__ / list __setitem__ on the rebuilt
-        spine) at a fraction of generic deepcopy's dispatch cost —
-        state.copy() is the per-block hot path the reference serves
-        with milhouse structural sharing."""
+        immutable and SHARED, nested containers are rebuilt (bounded
+        count), and big List/Vector values are ChunkedSeq spines shared
+        copy-on-write — O(spine), not O(n), the structural sharing the
+        reference gets from milhouse. Semantically a deep copy: scalar
+        writes go through __setitem__ (chunk CoW) and in-place container
+        element mutation through seq_get_mut (chunk + element CoW), so
+        no write on either side is ever visible to the other."""
         return _fast_copy_container(self._type, self)
 
     def __deepcopy__(self, memo) -> "SSZValue":
@@ -554,14 +829,21 @@ class SSZValue:
 
 def _fast_copy_value(ftype: SSZType, value):
     """Copy `value` of SSZ type `ftype`: immutable leaves shared,
-    mutable spines (lists, containers) rebuilt."""
+    ChunkedSeq spines shared copy-on-write, plain lists rebuilt."""
     if isinstance(ftype, Container):
         return _fast_copy_container(ftype, value)
     if isinstance(ftype, (List, Vector)):
+        if isinstance(value, ChunkedSeq):
+            return value.copy()  # O(spine) structural sharing
         elem = ftype.elem
         if isinstance(elem, (Container, List, Vector, Bitlist, Bitvector)):
-            return [_fast_copy_value(elem, v) for v in value]
-        return list(value)  # scalar/bytes elements are immutable
+            copied = [_fast_copy_value(elem, v) for v in value]
+        else:
+            copied = list(value)  # scalar/bytes elements are immutable
+        if len(copied) > _WRAP_THRESHOLD:
+            # promote: the NEXT copy of this value is O(spine)
+            return ChunkedSeq(copied, elem=elem)
+        return copied
     if isinstance(ftype, (Bitlist, Bitvector)):
         return list(value)
     return value  # int / bytes / bool

@@ -33,6 +33,7 @@ import numpy as np
 from ..crypto import bls
 from ..crypto.bls.keys import PublicKey, Signature, SignatureSet
 from . import types as T
+from .ssz import seq_get_mut, seq_token
 from .domains import compute_domain, compute_signing_root, get_domain
 from .shuffling import compute_committee, compute_shuffled_index
 from .spec import ChainSpec, FAR_FUTURE_EPOCH, GENESIS_EPOCH, GENESIS_SLOT
@@ -128,10 +129,40 @@ def is_active_validator(v, epoch: int) -> bool:
     return v.activation_epoch <= epoch < v.exit_epoch
 
 
+# (validators content token, epoch) -> active index list. The active
+# set at epoch E is fixed once the state is inside E (exits/activations
+# only schedule E+1+lookahead), and the ChunkedSeq token is shared
+# across state copies until a registry mutation — so one O(n) scan
+# serves every committee/proposer/balance lookup of the epoch across
+# all fork states with identical registries. Returned lists are
+# READ-ONLY by contract.
+_ACTIVE_CACHE: dict = {}
+_ACTIVE_CACHE_MAX = 8
+# (validators content token, epoch) -> total active balance (gwei)
+_TAB_CACHE: dict = {}
+
+
 def get_active_validator_indices(state, epoch: int) -> list:
-    return [
-        i for i, v in enumerate(state.validators) if is_active_validator(v, epoch)
+    tok = seq_token(state.validators)
+    if tok is not None:
+        hit = _ACTIVE_CACHE.get((tok, epoch))
+        if hit is not None:
+            return hit
+    # inlined is_active_validator: this O(n) scan is the cold-path cost
+    # of the first committee lookup of an epoch at mainnet scale
+    out = [
+        i
+        for i, v in enumerate(state.validators)
+        if v.activation_epoch <= epoch < v.exit_epoch
     ]
+    if tok is not None:
+        try:  # FIFO eviction; benign under concurrent readers
+            while len(_ACTIVE_CACHE) >= _ACTIVE_CACHE_MAX:
+                _ACTIVE_CACHE.pop(next(iter(_ACTIVE_CACHE)))
+        except (KeyError, StopIteration, RuntimeError):
+            pass
+        _ACTIVE_CACHE[(tok, epoch)] = out
+    return out
 
 
 def get_randao_mix(spec: ChainSpec, state, epoch: int) -> bytes:
@@ -156,9 +187,23 @@ def get_total_balance(spec: ChainSpec, state, indices: Iterable[int]) -> int:
 
 
 def get_total_active_balance(spec: ChainSpec, state) -> int:
-    return get_total_balance(
-        spec, state, get_active_validator_indices(state, get_current_epoch(spec, state))
+    epoch = get_current_epoch(spec, state)
+    tok = seq_token(state.validators)
+    if tok is not None:
+        hit = _TAB_CACHE.get((tok, epoch))
+        if hit is not None:
+            return hit
+    total = get_total_balance(
+        spec, state, get_active_validator_indices(state, epoch)
     )
+    if tok is not None:
+        try:
+            while len(_TAB_CACHE) >= _ACTIVE_CACHE_MAX:
+                _TAB_CACHE.pop(next(iter(_TAB_CACHE)))
+        except (KeyError, StopIteration, RuntimeError):
+            pass
+        _TAB_CACHE[(tok, epoch)] = total
+    return total
 
 
 def get_validator_churn_limit(spec: ChainSpec, state) -> int:
@@ -307,6 +352,7 @@ def initiate_validator_exit(spec: ChainSpec, state, index: int) -> None:
     )
     if churn >= get_validator_churn_limit(spec, state):
         exit_queue_epoch += 1
+    v = seq_get_mut(state.validators, index)  # CoW: never leak to copies
     v.exit_epoch = exit_queue_epoch
     v.withdrawable_epoch = (
         exit_queue_epoch + spec.min_validator_withdrawability_delay
@@ -322,7 +368,7 @@ def slash_validator(
 ) -> None:
     epoch = get_current_epoch(spec, state)
     initiate_validator_exit(spec, state, index)
-    v = state.validators[index]
+    v = seq_get_mut(state.validators, index)
     v.slashed = True
     v.withdrawable_epoch = max(
         v.withdrawable_epoch, epoch + spec.preset.epochs_per_slashings_vector
@@ -1226,7 +1272,7 @@ def process_bls_to_execution_change(
         )
         if not bls.verify_signature_sets([s]):
             raise BlockProcessingError("invalid bls-change signature")
-    v.withdrawal_credentials = (
+    seq_get_mut(state.validators, int(change.validator_index)).withdrawal_credentials = (
         b"\x01" + b"\x00" * 11 + bytes(change.to_execution_address)
     )
 
@@ -1532,7 +1578,9 @@ def process_registry_updates(spec: ChainSpec, state) -> None:
             v.activation_eligibility_epoch == FAR_FUTURE_EPOCH
             and v.effective_balance == spec.max_effective_balance
         ):
-            v.activation_eligibility_epoch = cur + 1
+            seq_get_mut(state.validators, i).activation_eligibility_epoch = (
+                cur + 1
+            )
         if (
             is_active_validator(v, cur)
             and v.effective_balance <= spec.ejection_balance
@@ -1552,7 +1600,7 @@ def process_registry_updates(spec: ChainSpec, state) -> None:
         ),
     )
     for i in queue[: get_validator_churn_limit(spec, state)]:
-        state.validators[i].activation_epoch = (
+        seq_get_mut(state.validators, i).activation_epoch = (
             cur + 1 + spec.max_seed_lookahead
         )
 
@@ -1591,7 +1639,7 @@ def process_effective_balance_updates(spec: ChainSpec, state) -> None:
             balance + downward < v.effective_balance
             or v.effective_balance + upward < balance
         ):
-            v.effective_balance = min(
+            seq_get_mut(state.validators, i).effective_balance = min(
                 balance - balance % spec.effective_balance_increment,
                 spec.max_effective_balance,
             )
@@ -1635,7 +1683,10 @@ def process_historical_roots_update(spec: ChainSpec, state) -> None:
 
 
 def process_participation_flag_updates(state) -> None:
-    state.previous_epoch_participation = list(state.current_epoch_participation)
+    # rotate by rebinding: current loses its only other reference, so
+    # handing the object over (no list() rebuild) is safe and keeps the
+    # ChunkedSeq spine + chunk-root caches intact
+    state.previous_epoch_participation = state.current_epoch_participation
     state.current_epoch_participation = [0] * len(state.validators)
 
 

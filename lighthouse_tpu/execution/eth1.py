@@ -216,8 +216,11 @@ def genesis_from_deposits(
     for d in cache.get_deposits(0, n, n):
         st.process_deposit(spec, state, d)
     # genesis activations (spec: full-balance validators start active)
-    for v in state.validators:
+    from ..consensus.ssz import seq_get_mut
+
+    for i, v in enumerate(state.validators):
         if v.effective_balance == spec.max_effective_balance:
+            v = seq_get_mut(state.validators, i)
             v.activation_eligibility_epoch = 0
             v.activation_epoch = 0
     return st.finalize_genesis_state(spec, state, el_anchor=block_hash)

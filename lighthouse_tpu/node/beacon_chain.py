@@ -884,10 +884,12 @@ class BeaconChain:
             for att in block.body.attestations:
                 try:
                     adv = state
-                    committee = st.get_beacon_committee(
-                        self.spec,
+                    # decision-root shuffling cache: the whole epoch's
+                    # committees compute once; every attestation in the
+                    # imported block resolves from the shared entry
+                    committee = self.beacon_committee_cached(
                         adv,
-                        att.data.slot,
+                        int(att.data.slot),
                         st.resolve_committee_index(self.spec, adv, att),
                     )
                     indices = [

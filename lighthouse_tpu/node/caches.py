@@ -57,7 +57,16 @@ def shuffling_decision_root(spec, state, epoch: int, head_root: bytes) -> bytes:
 
 class ShufflingCache:
     """(epoch, decision_root) -> [[committee] per (slot, index)] — the
-    full epoch's committees computed once (shuffling_cache.rs)."""
+    full epoch's committees computed once (shuffling_cache.rs).
+
+    Cost model after the CoW/vectorized-shuffle round: a MISS pays one
+    O(n) active-set scan + one numpy whole-list swap-or-not permutation
+    (both additionally cached inside state_transition/shuffling keyed
+    on the registry content token + seed, so even a cache rebuild after
+    eviction is slice-cheap); a HIT is a dict lookup. Every committee
+    consumer in the chain — gossip verification, aggregate checks, the
+    slasher feed on block import, and the REST committees/duties
+    endpoints — routes through here."""
 
     def __init__(self, capacity: int = 16):
         self._cache = _LRU(capacity)

@@ -323,7 +323,7 @@ class BeaconApi:
             for idx in range(cps):
                 if want_index is not None and idx != want_index:
                     continue
-                members = st.get_beacon_committee(spec, state, slot, idx)
+                members = self.chain.beacon_committee_cached(state, slot, idx)
                 data.append(
                     {
                         "index": str(idx),
@@ -524,7 +524,9 @@ class BeaconApi:
         duties = []
         for slot in range(start, start + spec.preset.slots_per_epoch):
             for idx in range(cps):
-                members = st.get_beacon_committee(spec, state, slot, idx)
+                # served from the decision-root shuffling cache: one
+                # epoch shuffle amortizes the whole duties table
+                members = self.chain.beacon_committee_cached(state, slot, idx)
                 for pos, v in enumerate(members):
                     if v in want:
                         duties.append(

@@ -1,8 +1,9 @@
-"""Scale regression gate (VERDICT r3 weak #7): the 500k/1M-validator
-numbers live in BASELINE.md §"scale probe"; this test replays the probe
-at 250k and fails if the epoch transition or state copy regresses >2x
-from the round-4 measurements (which scale ~linearly: 250k is half the
-500k cost)."""
+"""Scale regression gate (VERDICT r3 weak #7, budgets tightened for the
+CoW-spine + vectorized-shuffle round): the 500k/1M-validator numbers
+live in BASELINE.md §"scale probe"; this test replays the probe at 250k
+and locks in the structural-sharing wins — a regression back to
+rebuild-everything copies (seconds) or per-index shuffling (minutes)
+fails immediately, with head-room for CI machine slack only."""
 
 import time
 
@@ -15,12 +16,16 @@ from lighthouse_tpu.tools.scale_probe import build_state
 from lighthouse_tpu.consensus import state_transition as st
 
 N = 250_000
-# round-4 measured at 500k: epoch 14.0 s, copy 9.7 s (BASELINE.md
-# §scale probe). Halve for 250k, then 2x regression headroom + CI
-# machine slack.
+# Measured this round at 250k (BASELINE.md §scale probe): epoch 6.8 s,
+# copy 0.0004 s, committee cold 1.1 s / warm 0.005 s per slot. Budgets
+# are ~2-3x the measurement for CI slack — NOT the old rebuild-era
+# numbers (copy was 4.9 s, committees 65 s at this scale).
 EPOCH_BUDGET_S = 20.0
-COPY_BUDGET_S = 12.0
-COMMITTEE_BUDGET_S = 10.0
+COPY_BUDGET_S = 0.5
+# first-slot-of-epoch (cold: active-set scan + whole-list shuffle)
+COMMITTEE_COLD_BUDGET_S = 4.0
+# amortized per-slot budget with the epoch's permutation warm
+COMMITTEE_WARM_BUDGET_S = 1.0
 
 
 def test_scale_epoch_copy_committee_budgets():
@@ -32,17 +37,34 @@ def test_scale_epoch_copy_committee_budgets():
     assert epoch_s < EPOCH_BUDGET_S, f"epoch transition regressed: {epoch_s:.1f}s"
 
     t0 = time.perf_counter()
-    state.copy()
+    copied = state.copy()
     copy_s = time.perf_counter() - t0
-    assert copy_s < COPY_BUDGET_S, f"state copy regressed: {copy_s:.1f}s"
+    assert copy_s < COPY_BUDGET_S, f"state copy regressed: {copy_s:.2f}s"
 
-    # one slot's committees with the shared-permutation cache warm
+    # CoW isolation at scale: mutating the copy's registry must not
+    # touch the original (and must stay cheap)
+    from lighthouse_tpu.consensus.ssz import seq_get_mut
+
+    seq_get_mut(copied.validators, 0).slashed = True
+    assert state.validators[0].slashed is False
+
+    # cold: first slot of the epoch pays the active scan + one
+    # vectorized whole-list shuffle for ALL the epoch's committees
     state.slot += 1
     epoch = st.get_current_epoch(spec, state)
     cps = st.get_committee_count_per_slot(spec, state, epoch)
-    st.get_beacon_committee(spec, state, int(state.slot), 0)  # warm perm
     t0 = time.perf_counter()
-    for idx in range(1, min(cps, 8)):
+    st.get_beacon_committee(spec, state, int(state.slot), 0)
+    cold_s = time.perf_counter() - t0
+    assert cold_s < COMMITTEE_COLD_BUDGET_S, (
+        f"cold committee resolution regressed: {cold_s:.1f}s"
+    )
+
+    # warm: a full slot's committees resolve from permutation slices
+    t0 = time.perf_counter()
+    for idx in range(cps):
         st.get_beacon_committee(spec, state, int(state.slot), idx)
-    comm_s = time.perf_counter() - t0
-    assert comm_s < COMMITTEE_BUDGET_S, f"committee resolution regressed: {comm_s:.1f}s"
+    warm_s = time.perf_counter() - t0
+    assert warm_s < COMMITTEE_WARM_BUDGET_S, (
+        f"warm committee resolution regressed: {warm_s:.2f}s"
+    )

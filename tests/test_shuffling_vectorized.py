@@ -1,0 +1,159 @@
+"""Vectorized swap-or-not shuffle: property tests pinning the numpy
+whole-list pass (shuffle_permutation / shuffle_list) to the spec's
+per-index compute_shuffled_index across sizes 1..10k, plus state-level
+copy-on-write aliasing regressions for the hot paths that consume the
+shuffle (committees, epoch processing, block replay)."""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from lighthouse_tpu.consensus import shuffling as sh
+from lighthouse_tpu.consensus import state_transition as st
+from lighthouse_tpu.consensus.ssz import ChunkedSeq, seq_get_mut
+from lighthouse_tpu.tools.scale_probe import build_state
+
+SPEC_ROUNDS = 90  # mainnet shuffle_round_count
+
+
+def _seed(tag: int) -> bytes:
+    return hashlib.sha256(b"shuffle-prop-%d" % tag).digest()
+
+
+@pytest.mark.parametrize("n", [1, 2, 3, 5, 8, 33, 100, 257, 1000, 10_000])
+def test_permutation_matches_spec_per_index(n):
+    """perm[i] == compute_shuffled_index(i) for every i — the exactness
+    contract the whole committee pipeline rests on."""
+    rounds = 10  # property holds per round; 10 keeps the O(n*rounds)
+    # per-index reference affordable at n=10k
+    seed = _seed(n)
+    perm = sh.shuffle_permutation(n, seed, rounds)
+    want = [sh.compute_shuffled_index(i, n, seed, rounds) for i in range(n)]
+    assert perm.tolist() == want
+    # and it IS a permutation
+    assert sorted(perm.tolist()) == list(range(n))
+
+
+def test_permutation_matches_spec_at_mainnet_rounds():
+    """Full 90-round agreement at a committee-realistic size."""
+    n = 512
+    seed = _seed(0xBEEF)
+    perm = sh.shuffle_permutation(n, seed, SPEC_ROUNDS)
+    want = [
+        sh.compute_shuffled_index(i, n, seed, SPEC_ROUNDS) for i in range(n)
+    ]
+    assert perm.tolist() == want
+
+
+def test_shuffle_list_delegates_to_permutation():
+    indices = [100 + i for i in range(777)]
+    seed = _seed(777)
+    out = sh.shuffle_list(indices, seed, 10)
+    assert out == [
+        indices[sh.compute_shuffled_index(i, len(indices), seed, 10)]
+        for i in range(len(indices))
+    ]
+    assert sh.shuffle_list([], seed, 10) == []
+
+
+def test_compute_committee_slices_shared_permutation():
+    indices = list(range(5000))
+    seed = _seed(5000)
+    count = 16
+    got = [
+        sh.compute_committee(indices, seed, k, count, 10) for k in range(count)
+    ]
+    # committees partition the shuffled list exactly
+    flat = [v for c in got for v in c]
+    n = len(indices)
+    assert flat == [
+        indices[sh.compute_shuffled_index(i, n, seed, 10)] for i in range(n)
+    ]
+
+
+# ------------------------------------------------- state-level CoW aliasing
+
+
+N_COW = 3000  # above the wrap threshold: the registry lives on the spine
+
+
+def test_epoch_processing_on_copy_never_touches_parent():
+    spec, state = build_state(N_COW)
+    assert isinstance(state.validators, ChunkedSeq)
+    before = state.serialize()
+    work = state.copy()
+    st.process_epoch(spec, work)
+    work.slot += 1
+    assert state.serialize() == before
+    assert work.serialize() != before
+
+
+def test_registry_mutation_on_copy_never_touches_parent():
+    spec, state = build_state(N_COW)
+    parent_root = state.hash_tree_root()
+    work = state.copy()
+    st.slash_validator(spec, work, 123)
+    st.initiate_validator_exit(spec, work, 456)
+    assert work.validators[123].slashed is True
+    assert state.validators[123].slashed is False
+    assert state.validators[456].exit_epoch == st.FAR_FUTURE_EPOCH
+    assert state.hash_tree_root() == parent_root
+    # and the copy's incremental root reflects the writes
+    assert work.hash_tree_root() != parent_root
+
+
+def test_balance_and_vector_writes_isolated_across_copies():
+    spec, state = build_state(N_COW)
+    work = state.copy()
+    st.increase_balance(work, 7, 10**9)
+    work.randao_mixes[3] = b"\x42" * 32
+    work.slashings[1] += 5
+    assert state.balances[7] == work.balances[7] - 10**9
+    assert bytes(state.randao_mixes[3]) == b"\x00" * 32
+    assert state.slashings[1] == 0
+    # parent writes after the copy stay private too
+    st.decrease_balance(state, 8, 1)
+    assert work.balances[8] == state.balances[8] + 1
+
+
+def test_active_set_cache_tracks_registry_mutations():
+    """The (token, epoch)-keyed active-set cache must miss after any
+    registry write — exits scheduled for a future epoch change that
+    epoch's active set."""
+    spec, state = build_state(N_COW)
+    epoch = st.get_current_epoch(spec, state)
+    assert len(st.get_active_validator_indices(state, epoch)) == N_COW
+    work = state.copy()
+    st.initiate_validator_exit(spec, work, 0)
+    exit_epoch = work.validators[0].exit_epoch
+    assert 0 not in st.get_active_validator_indices(work, exit_epoch)
+    # the untouched parent still reports the full set at that epoch
+    assert 0 in st.get_active_validator_indices(state, exit_epoch)
+
+
+def test_committees_identical_across_copies_and_paths():
+    spec, state = build_state(N_COW)
+    st.process_epoch(spec, state)
+    state.slot += 1
+    slot = int(state.slot)
+    cps = st.get_committee_count_per_slot(
+        spec, state, st.get_current_epoch(spec, state)
+    )
+    direct = [st.get_beacon_committee(spec, state, slot, i) for i in range(cps)]
+    work = state.copy()
+    via_copy = [st.get_beacon_committee(spec, work, slot, i) for i in range(cps)]
+    assert direct == via_copy
+    # per-index spec path agrees with the cached vectorized path
+    epoch = st.compute_epoch_at_slot(spec, slot)
+    indices = st.get_active_validator_indices(state, epoch)
+    seed = st.get_seed(spec, state, epoch, spec.domain_beacon_attester)
+    per_slot = cps * spec.preset.slots_per_epoch
+    k = (slot % spec.preset.slots_per_epoch) * cps
+    n = len(indices)
+    start = n * k // per_slot
+    end = n * (k + 1) // per_slot
+    assert direct[0] == [
+        indices[sh.compute_shuffled_index(i, n, seed, SPEC_ROUNDS)]
+        for i in range(start, end)
+    ]

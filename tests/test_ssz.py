@@ -105,3 +105,134 @@ def test_state_default_roots():
     assert r1 == state2.hash_tree_root()
     state2.slot = 4
     assert r1 != state2.hash_tree_root()
+
+
+# ------------------------------------------------------ chunked CoW spine
+
+
+def _validators(n):
+    return [
+        T.Validator.make(
+            pubkey=i.to_bytes(8, "little") * 6,
+            withdrawal_credentials=b"\x01" + b"\x00" * 31,
+            effective_balance=32 * 10**9,
+            slashed=False,
+            activation_eligibility_epoch=0,
+            activation_epoch=0,
+            exit_epoch=2**64 - 1,
+            withdrawable_epoch=2**64 - 1,
+        )
+        for i in range(n)
+    ]
+
+
+def test_chunked_bit_identity_across_threshold():
+    """ChunkedSeq serialization + hash_tree_root are bit-identical to
+    the plain-list path for every element kind the state uses, at sizes
+    straddling the chunk/threshold boundaries."""
+    for n in (1, 1023, 1024, 1025, 2048, 2049, 5000):
+        t = ssz.List(ssz.uint64, 2**40)
+        vals = list(range(n))
+        cs = ssz.ChunkedSeq(vals, elem=ssz.uint64)
+        assert t.serialize(cs) == t.serialize(vals), n
+        assert t.hash_tree_root(cs) == t.hash_tree_root(vals), n
+    # Bytes32 vector (randao_mixes / block_roots shape)
+    tv = ssz.Vector(ssz.Bytes32, 8192)
+    vals = [i.to_bytes(32, "little") for i in range(8192)]
+    cs = ssz.ChunkedSeq(vals, elem=ssz.Bytes32)
+    assert tv.serialize(cs) == tv.serialize(vals)
+    assert tv.hash_tree_root(cs) == tv.hash_tree_root(vals)
+    # container elements (validators shape)
+    tl = ssz.List(T.Validator, 2**40)
+    vs = _validators(2100)
+    cs = ssz.ChunkedSeq(vs, elem=T.Validator)
+    assert tl.serialize(cs) == tl.serialize(vs)
+    assert tl.hash_tree_root(cs) == tl.hash_tree_root(vs)
+    # uint8 packing (participation shape)
+    t8 = ssz.List(ssz.uint8, 2**40)
+    vals = [i % 7 for i in range(4000)]
+    cs = ssz.ChunkedSeq(vals, elem=ssz.uint8)
+    assert t8.serialize(cs) == t8.serialize(vals)
+    assert t8.hash_tree_root(cs) == t8.hash_tree_root(vals)
+
+
+def test_chunked_root_cache_tracks_mutations():
+    t = ssz.List(ssz.uint64, 2**40)
+    vals = list(range(5000))
+    cs = ssz.ChunkedSeq(vals, elem=ssz.uint64)
+    assert t.hash_tree_root(cs) == t.hash_tree_root(vals)  # warm caches
+    cs[3000] = 7
+    vals[3000] = 7
+    assert t.hash_tree_root(cs) == t.hash_tree_root(vals)
+    cs.append(99)
+    vals.append(99)
+    assert t.hash_tree_root(cs) == t.hash_tree_root(vals)
+    assert len(cs) == len(vals)
+
+
+def test_chunked_copy_isolates_scalar_writes():
+    cs = ssz.ChunkedSeq(list(range(3000)), elem=ssz.uint64)
+    child = cs.copy()
+    child[0] = 111
+    child[2999] = 222
+    child.append(333)
+    assert cs[0] == 0 and cs[2999] == 2999 and len(cs) == 3000
+    assert child[0] == 111 and child[2999] == 222 and len(child) == 3001
+    # the PARENT mutating after copy must not leak into the child either
+    cs[1] = 444
+    assert child[1] == 1
+
+
+def test_chunked_get_mut_isolates_container_writes():
+    """Aliasing regression: in-place mutation of a container element via
+    get_mut never leaks into the sibling copy, in either direction."""
+    t = ssz.List(T.Validator, 2**40)
+    vs = _validators(2100)
+    cs = ssz.ChunkedSeq(vs, elem=T.Validator)
+    parent_root = t.hash_tree_root(cs)
+    child = cs.copy()
+    mv = child.get_mut(1500)
+    mv.slashed = True
+    mv.exit_epoch = 5
+    assert cs[1500].slashed is False
+    assert cs[1500].exit_epoch == 2**64 - 1
+    assert child[1500].slashed is True
+    assert t.hash_tree_root(cs) == parent_root
+    assert t.hash_tree_root(child) != parent_root
+    # reverse direction: parent get_mut after the copy
+    pv = cs.get_mut(7)
+    pv.effective_balance = 1
+    assert child[7].effective_balance == 32 * 10**9
+
+
+def test_big_list_assignment_auto_wraps():
+    """A big plain list stored into a container List/Vector field
+    becomes a ChunkedSeq, so the NEXT copy is O(spine); semantics
+    (serialize/root) are unchanged."""
+    state = T.BeaconState.default()
+    vs = _validators(2100)
+    state.validators = vs
+    assert isinstance(state.validators, ssz.ChunkedSeq)
+    assert isinstance(state.randao_mixes, ssz.ChunkedSeq)  # big Vector default
+    copied = state.copy()
+    # copies share the spine object-identity-wise chunk by chunk but
+    # never observe each other's writes
+    from lighthouse_tpu.consensus.ssz import seq_get_mut
+
+    seq_get_mut(copied.validators, 42).slashed = True
+    assert state.validators[42].slashed is False
+    assert copied.validators[42].slashed is True
+    # small lists stay plain (no wrapping overhead for bodies etc.)
+    state.eth1_data_votes = [T.Eth1Data.default() for _ in range(3)]
+    assert isinstance(state.eth1_data_votes, list)
+
+
+def test_chunked_state_roundtrips_through_serialization():
+    state = T.BeaconState.default()
+    state.validators = _validators(2100)
+    state.balances = [32 * 10**9] * 2100
+    raw = state.serialize()
+    back = T.BeaconState.deserialize(raw)
+    assert isinstance(back.validators, ssz.ChunkedSeq)
+    assert back.serialize() == raw
+    assert back.hash_tree_root() == state.hash_tree_root()

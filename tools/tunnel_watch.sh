@@ -4,10 +4,14 @@
 #   1. tools/seed_cache.py      — trace+compile the bench buckets + KZG
 #                                 kernels into .jax_cache
 #   2. tools/export_verify.py   — serialize the lowered verify modules
-#                                 (buckets 4096 + 1) so a fresh bench
-#                                 process skips trace+lower entirely;
-#                                 validation also warms the
-#                                 jit_call_exported cache entries
+#                                 for ALL FOUR bench buckets
+#                                 (4096/1024/128/1: headline, explicit
+#                                 small-batch gossip, config 3/4 +
+#                                 marginal, singleton fallback) so a
+#                                 fresh driver run never pays minutes of
+#                                 trace+lower for any bucket; validation
+#                                 also warms the jit_call_exported
+#                                 cache entries
 #   3. bench.py                 — one full proving run; numbers land in
 #                                 /tmp/bench_tpu.json for BASELINE.md
 # Each step logs to /tmp/seedloop.log. Idempotent: safe to re-run.
@@ -18,10 +22,24 @@ while true; do
     echo "TUNNEL BACK - seeding" >> /tmp/seedloop.log
     python tools/seed_cache.py >> /tmp/seedloop.log 2>&1
     echo "SEED STEP DONE rc=$? - exporting" >> /tmp/seedloop.log
-    python tools/export_verify.py 4096 1 >> /tmp/seedloop.log 2>&1
+    python tools/export_verify.py 4096 1024 128 1 >> /tmp/seedloop.log 2>&1
     echo "EXPORT STEP DONE rc=$? - proving bench" >> /tmp/seedloop.log
-    python bench.py > /tmp/bench_tpu.json 2>> /tmp/seedloop.log
+    # write via a temp file: bench's dead-tunnel fallback reads the
+    # PREVIOUS /tmp/bench_tpu.json, which a direct `>` would truncate
+    # before the process even starts
+    python bench.py > /tmp/bench_tpu.json.tmp 2>> /tmp/seedloop.log
     echo "BENCH STEP DONE rc=$?" >> /tmp/seedloop.log
+    if [ -s /tmp/bench_tpu.json.tmp ]; then
+      mv /tmp/bench_tpu.json.tmp /tmp/bench_tpu.json
+      # archive the freshest NONZERO rate so a later dead-tunnel run
+      # reports it instead of a stale checked-in artifact
+      python - <<'PY' >> /tmp/seedloop.log 2>&1
+import json, shutil
+doc = json.load(open("/tmp/bench_tpu.json"))
+if doc.get("value"):
+    shutil.copy("/tmp/bench_tpu.json", "/tmp/bench_tpu_last_good.json")
+PY
+    fi
     tail -c 2000 /tmp/bench_tpu.json >> /tmp/seedloop.log
     break
   fi
