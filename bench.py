@@ -100,6 +100,41 @@ def _emit():
     if _STATE["emitted"]:
         return
     _STATE["emitted"] = True
+    # stage-level attribution rides along with every emit (ISSUE 4):
+    # the full labeled /metrics scrape + the busiest traced slot's span
+    # timeline, so the perf trajectory carries queue-wait, batch
+    # occupancy and per-bucket verify-latency series per round.
+    # Snapshot on a TIMED side thread: _emit also runs from the
+    # SIGTERM/SIGALRM handler, which may have interrupted the main
+    # thread INSIDE a metric-family lock — gathering inline there would
+    # deadlock the flush that exists to save the run.
+    box = {}
+
+    def _snapshot():
+        from lighthouse_tpu.common import metrics as _metrics
+        from lighthouse_tpu.common import tracing as _tracing
+
+        obs = {"metrics": _metrics.gather()}
+        by_slot = {}
+        for sp in _tracing.spans():
+            if sp.slot is not None:
+                by_slot[sp.slot] = by_slot.get(sp.slot, 0) + 1
+        if by_slot:
+            busiest = max(by_slot, key=by_slot.get)
+            obs["slot_timeline"] = _tracing.slot_timeline(busiest)
+        box["obs"] = obs
+
+    try:
+        th = threading.Thread(target=_snapshot, daemon=True)
+        th.start()
+        th.join(5.0)
+        _STATE["detail"]["observability"] = box.get(
+            "obs", {"error": "snapshot timed out (lock held at signal)"}
+        )
+    except Exception as e:  # never let the snapshot lose the headline
+        _STATE["detail"]["observability"] = {
+            "error": f"{type(e).__name__}: {e}"
+        }
     rate1 = _STATE["rate1"]
     print(
         json.dumps(
@@ -520,6 +555,9 @@ def _config2(detail, n_atts, batch_cap):
                 process_individual=process_individual,
                 process_batch=process_batch,
                 payload=s,
+                # slot-anchor the scheduler spans: the emitted BENCH
+                # json carries this slot's timeline (_emit)
+                slot=2,
             )
         )
     t0 = time.perf_counter()

@@ -1,9 +1,26 @@
 """Global metrics registry (common/metrics analog, SURVEY.md §5.1).
 
 Prometheus-text-format counters/gauges/histograms with a process-global
-registry; the HTTP scrape endpoint lives in the node layer. Histogram
-timers mirror the reference's start_timer/stop_timer idiom
-(common/metrics/src/lib.rs:1-50)."""
+registry; the HTTP scrape endpoints live in the node and validator
+layers. Histogram timers mirror the reference's start_timer/stop_timer
+idiom (common/metrics/src/lib.rs:1-50).
+
+Label support mirrors the reference's `*_VEC` families
+(metrics::try_create_int_counter_vec): a metric registered with
+`labelnames=(...)` is a FAMILY — call `.labels(...)` to get (or lazily
+create) the child for one label-value tuple, then `inc`/`set`/`observe`
+on the child. Unlabeled metrics keep the old direct `inc`/`set`/
+`observe` surface, so every pre-existing call site works unchanged.
+
+Locking: one lock per metric family (children share their family's
+lock), plus one registry lock taken only at registration/gather time.
+The old process-global `_LOCK` serialized every `Counter.inc` in the
+process against every other metric's writes; hot-path counters in the
+beacon_processor and the BLS dispatch now only contend within their own
+family.
+
+Label values are escaped per the Prometheus text exposition format
+(backslash, double-quote, newline)."""
 
 from __future__ import annotations
 
@@ -11,8 +28,8 @@ import threading
 import time
 from contextlib import contextmanager
 
-_REGISTRY = {}
-_LOCK = threading.Lock()
+_REGISTRY: dict = {}
+_REG_LOCK = threading.Lock()
 
 _DEFAULT_BUCKETS = (
     0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
@@ -20,59 +37,184 @@ _DEFAULT_BUCKETS = (
 )
 
 
-class Counter:
-    def __init__(self, name: str, help_: str):
+def escape_label_value(v) -> str:
+    """Prometheus text-format label-value escaping."""
+    return (
+        str(v)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+class _Family:
+    """Shared family machinery: child management + label rendering."""
+
+    TYPE = "untyped"
+
+    def __init__(self, name: str, help_: str, labelnames=()):
         self.name = name
         self.help = help_
+        self.labelnames = tuple(labelnames)
+        self._lock = threading.Lock()
+        self._children: dict = {}
+        if not self.labelnames:
+            # unlabeled metric: a single anonymous child keeps the old
+            # direct inc/set/observe surface working
+            self._children[()] = self._make_child(())
+
+    def _make_child(self, labelvalues):
+        raise NotImplementedError
+
+    def labels(self, *args, **kwargs):
+        """The child for one label-value tuple (created on first use).
+        Accepts positional values in labelnames order, or kwargs."""
+        if not self.labelnames:
+            raise ValueError(f"metric {self.name!r} has no labels")
+        if args and kwargs:
+            raise ValueError("pass label values positionally OR by name")
+        if args:
+            if len(args) != len(self.labelnames):
+                raise ValueError(
+                    f"{self.name}: expected {len(self.labelnames)} label "
+                    f"values, got {len(args)}"
+                )
+            values = tuple(str(a) for a in args)
+        else:
+            if set(kwargs) != set(self.labelnames):
+                raise ValueError(
+                    f"{self.name}: labels are {self.labelnames}, "
+                    f"got {tuple(kwargs)}"
+                )
+            values = tuple(str(kwargs[k]) for k in self.labelnames)
+        with self._lock:
+            child = self._children.get(values)
+            if child is None:
+                child = self._children[values] = self._make_child(values)
+            return child
+
+    def label_values(self) -> list:
+        """All child label-value tuples (introspection for the lint)."""
+        with self._lock:
+            return list(self._children)
+
+    def _unlabeled(self):
+        if self.labelnames:
+            raise ValueError(
+                f"metric {self.name!r} has labels {self.labelnames}; "
+                "use .labels(...)"
+            )
+        return self._children[()]
+
+    def _label_block(self, labelvalues, extra: str = "") -> str:
+        parts = [
+            f'{k}="{escape_label_value(v)}"'
+            for k, v in zip(self.labelnames, labelvalues)
+        ]
+        if extra:
+            parts.append(extra)
+        return "{" + ",".join(parts) + "}" if parts else ""
+
+    def _header(self) -> list:
+        return [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} {self.TYPE}",
+        ]
+
+    def _render_simple(self) -> str:
+        """One sample line per child — the counter/gauge exposition."""
+        with self._lock:
+            items = [(v, c.value) for v, c in self._children.items()]
+        lines = self._header()
+        for values, val in items:
+            lines.append(f"{self.name}{self._label_block(values)} {val}")
+        return "\n".join(lines) + "\n"
+
+
+class _CounterChild:
+    __slots__ = ("_lock", "value")
+
+    def __init__(self, lock):
+        self._lock = lock
         self.value = 0.0
 
     def inc(self, amount: float = 1.0):
-        with _LOCK:
+        with self._lock:
             self.value += amount
 
+
+class Counter(_Family):
+    TYPE = "counter"
+
+    def _make_child(self, labelvalues):
+        return _CounterChild(self._lock)
+
+    def inc(self, amount: float = 1.0):
+        self._unlabeled().inc(amount)
+
+    @property
+    def value(self) -> float:
+        return self._unlabeled().value
+
     def render(self) -> str:
-        return (
-            f"# HELP {self.name} {self.help}\n# TYPE {self.name} counter\n"
-            f"{self.name} {self.value}\n"
-        )
+        return self._render_simple()
 
 
-class Gauge:
-    def __init__(self, name: str, help_: str):
-        self.name = name
-        self.help = help_
+class _GaugeChild:
+    __slots__ = ("_lock", "value")
+
+    def __init__(self, lock):
+        self._lock = lock
         self.value = 0.0
 
     def set(self, v: float):
-        with _LOCK:
+        with self._lock:
             self.value = v
 
     def inc(self, amount: float = 1.0):
-        with _LOCK:
+        with self._lock:
             self.value += amount
 
     def dec(self, amount: float = 1.0):
-        with _LOCK:
+        with self._lock:
             self.value -= amount
 
+
+class Gauge(_Family):
+    TYPE = "gauge"
+
+    def _make_child(self, labelvalues):
+        return _GaugeChild(self._lock)
+
+    def set(self, v: float):
+        self._unlabeled().set(v)
+
+    def inc(self, amount: float = 1.0):
+        self._unlabeled().inc(amount)
+
+    def dec(self, amount: float = 1.0):
+        self._unlabeled().dec(amount)
+
+    @property
+    def value(self) -> float:
+        return self._unlabeled().value
+
     def render(self) -> str:
-        return (
-            f"# HELP {self.name} {self.help}\n# TYPE {self.name} gauge\n"
-            f"{self.name} {self.value}\n"
-        )
+        return self._render_simple()
 
 
-class Histogram:
-    def __init__(self, name: str, help_: str, buckets=_DEFAULT_BUCKETS):
-        self.name = name
-        self.help = help_
-        self.buckets = list(buckets)
-        self.counts = [0] * (len(self.buckets) + 1)
+class _HistogramChild:
+    __slots__ = ("_lock", "buckets", "counts", "total", "n")
+
+    def __init__(self, lock, buckets):
+        self._lock = lock
+        self.buckets = buckets
+        self.counts = [0] * (len(buckets) + 1)
         self.total = 0.0
         self.n = 0
 
     def observe(self, v: float):
-        with _LOCK:
+        with self._lock:
             self.total += v
             self.n += 1
             for i, b in enumerate(self.buckets):
@@ -89,45 +231,130 @@ class Histogram:
         finally:
             self.observe(time.perf_counter() - t0)
 
+
+class Histogram(_Family):
+    TYPE = "histogram"
+
+    def __init__(self, name, help_, buckets=_DEFAULT_BUCKETS, labelnames=()):
+        self.buckets = list(buckets)
+        if sorted(self.buckets) != self.buckets:
+            raise ValueError(f"histogram {name!r}: buckets must be sorted")
+        super().__init__(name, help_, labelnames=labelnames)
+
+    def _make_child(self, labelvalues):
+        return _HistogramChild(self._lock, self.buckets)
+
+    def observe(self, v: float):
+        self._unlabeled().observe(v)
+
+    def time(self):
+        return self._unlabeled().time()
+
+    # old direct-attribute readers used by tests on unlabeled histograms
+    @property
+    def counts(self):
+        return self._unlabeled().counts
+
+    @property
+    def total(self):
+        return self._unlabeled().total
+
+    @property
+    def n(self):
+        return self._unlabeled().n
+
     def render(self) -> str:
-        out = [
-            f"# HELP {self.name} {self.help}",
-            f"# TYPE {self.name} histogram",
-        ]
-        acc = 0
-        for b, c in zip(self.buckets, self.counts):
-            acc += c
-            out.append(f'{self.name}_bucket{{le="{b}"}} {acc}')
-        acc += self.counts[-1]
-        out.append(f'{self.name}_bucket{{le="+Inf"}} {acc}')
-        out.append(f"{self.name}_sum {self.total}")
-        out.append(f"{self.name}_count {self.n}")
-        return "\n".join(out) + "\n"
+        with self._lock:
+            items = [
+                (v, list(c.counts), c.total, c.n)
+                for v, c in self._children.items()
+            ]
+        lines = self._header()
+        for values, counts, total, n in items:
+            acc = 0
+            for b, c in zip(self.buckets, counts):
+                acc += c
+                le = 'le="%s"' % b
+                lines.append(
+                    f"{self.name}_bucket{self._label_block(values, le)} {acc}"
+                )
+            acc += counts[-1]
+            inf = 'le="+Inf"'
+            lines.append(
+                f"{self.name}_bucket{self._label_block(values, inf)} {acc}"
+            )
+            lines.append(f"{self.name}_sum{self._label_block(values)} {total}")
+            lines.append(f"{self.name}_count{self._label_block(values)} {n}")
+        return "\n".join(lines) + "\n"
 
 
-def counter(name: str, help_: str = "") -> Counter:
-    with _LOCK:
-        if name not in _REGISTRY:
-            _REGISTRY[name] = Counter(name, help_)
-    return _REGISTRY[name]
+def _get_or_register(cls, name, factory, labelnames):
+    with _REG_LOCK:
+        m = _REGISTRY.get(name)
+        if m is None:
+            m = _REGISTRY[name] = factory()
+            return m
+    if type(m) is not cls:
+        raise ValueError(
+            f"metric {name!r} already registered as {type(m).__name__}, "
+            f"re-registered as {cls.__name__}"
+        )
+    if tuple(labelnames) != m.labelnames:
+        raise ValueError(
+            f"metric {name!r} already registered with labels "
+            f"{m.labelnames}, re-registered with {tuple(labelnames)}"
+        )
+    return m
 
 
-def gauge(name: str, help_: str = "") -> Gauge:
-    with _LOCK:
-        if name not in _REGISTRY:
-            _REGISTRY[name] = Gauge(name, help_)
-    return _REGISTRY[name]
+def counter(name: str, help_: str = "", labelnames=()) -> Counter:
+    return _get_or_register(
+        Counter, name, lambda: Counter(name, help_, labelnames), labelnames
+    )
 
 
-def histogram(name: str, help_: str = "", buckets=_DEFAULT_BUCKETS) -> Histogram:
-    with _LOCK:
-        if name not in _REGISTRY:
-            _REGISTRY[name] = Histogram(name, help_, buckets)
-    return _REGISTRY[name]
+def gauge(name: str, help_: str = "", labelnames=()) -> Gauge:
+    return _get_or_register(
+        Gauge, name, lambda: Gauge(name, help_, labelnames), labelnames
+    )
+
+
+def histogram(
+    name: str, help_: str = "", buckets=_DEFAULT_BUCKETS, labelnames=()
+) -> Histogram:
+    m = _get_or_register(
+        Histogram,
+        name,
+        lambda: Histogram(name, help_, buckets, labelnames),
+        labelnames,
+    )
+    if list(buckets) != m.buckets:
+        # silent divergence here is how two call sites end up reading
+        # one series with two incompatible bucket layouts
+        raise ValueError(
+            f"histogram {name!r} already registered with buckets "
+            f"{m.buckets}, re-registered with {list(buckets)}"
+        )
+    return m
+
+
+def get(name: str):
+    """The registered family, or None (introspection for the lint)."""
+    with _REG_LOCK:
+        return _REGISTRY.get(name)
+
+
+def registered_names() -> list:
+    with _REG_LOCK:
+        return list(_REGISTRY)
 
 
 def gather() -> str:
     """Render the whole registry in Prometheus text format."""
-    with _LOCK:
+    with _REG_LOCK:
         items = list(_REGISTRY.values())
     return "".join(m.render() for m in items)
+
+
+# the scrape Content-Type Prometheus expects for this exposition format
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"

@@ -1,0 +1,220 @@
+"""Slot-anchored span tracing for the attestation→TPU-verify pipeline.
+
+The MegaScale/Pathways-style systems in PAPERS.md attribute accelerator
+pipeline time with per-step timelines; Lighthouse attributes the 12 s
+slot budget with per-stage metrics (SURVEY.md §5.1). This module is the
+union of both ideas at node scale:
+
+    with tracing.span("bls_verify", slot=s, bucket=1024):
+        ...hot path stage...
+
+Every span is (kind, slot, start, duration, attrs, thread) and lands in
+
+  1. a bounded process-global ring buffer, queryable per slot — the
+     node serves it as `GET /lighthouse/tracing?slot=N` and can export
+     it as Chrome-trace JSON (chrome://tracing / Perfetto), and
+  2. a labeled histogram family `lighthouse_tracing_span_seconds{kind=}`
+     so every span kind aggregates into the /metrics scrape for free.
+
+The ring buffer makes the tracer always-on: recording a span is a
+perf_counter pair, a deque append, and one histogram observe — no I/O,
+no allocation beyond the span record — so the hot path keeps it enabled
+in production, exactly like the reference's metrics timers.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+
+from . import metrics
+
+DEFAULT_CAPACITY = 8192
+
+_SPAN_SECONDS = metrics.histogram(
+    "lighthouse_tracing_span_seconds",
+    "Duration of traced pipeline spans by span kind",
+    labelnames=("kind",),
+)
+
+
+@dataclass
+class Span:
+    kind: str
+    slot: int | None
+    start: float  # perf_counter at entry (shared monotonic timeline)
+    duration: float
+    thread: str
+    attrs: dict = field(default_factory=dict)
+
+    def to_json(self) -> dict:
+        return {
+            "kind": self.kind,
+            "slot": self.slot,
+            "start_seconds": round(self.start, 6),
+            "duration_seconds": round(self.duration, 6),
+            "thread": self.thread,
+            "attrs": self.attrs,
+        }
+
+
+class Tracer:
+    """Bounded ring buffer of spans + per-kind histogram aggregation.
+
+    Nested spans inherit the enclosing span's slot (per thread): the
+    scheduler anchors its `work:*` stage span to the work's slot, and
+    every stage inside it — attestation_batch, bls_verify, the TPU
+    host/device split — lands on the same slot timeline without
+    threading slot numbers through layers that shouldn't know them."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        self._lock = threading.Lock()
+        self._buf: deque = deque(maxlen=capacity)
+        self._tls = threading.local()
+
+    @property
+    def capacity(self) -> int:
+        return self._buf.maxlen
+
+    def set_capacity(self, capacity: int) -> None:
+        with self._lock:
+            self._buf = deque(self._buf, maxlen=int(capacity))
+
+    def __len__(self) -> int:
+        return len(self._buf)
+
+    def _slot_stack(self) -> list:
+        stack = getattr(self._tls, "slots", None)
+        if stack is None:
+            stack = self._tls.slots = []
+        return stack
+
+    @contextmanager
+    def span(self, kind: str, slot=None, **attrs):
+        """Record one timed stage. Yields the attrs dict so the stage
+        can attach results discovered mid-span (batch size, cache
+        hit...). A None slot inherits the enclosing span's slot."""
+        stack = self._slot_stack()
+        if slot is None and stack:
+            slot = stack[-1]
+        eff = None if slot is None else int(slot)
+        stack.append(eff)
+        t0 = time.perf_counter()
+        try:
+            yield attrs
+        finally:
+            dur = time.perf_counter() - t0
+            stack.pop()
+            sp = Span(
+                kind=kind,
+                slot=eff,
+                start=t0,
+                duration=dur,
+                thread=threading.current_thread().name,
+                attrs=dict(attrs),
+            )
+            with self._lock:
+                self._buf.append(sp)
+            _SPAN_SECONDS.labels(kind=kind).observe(dur)
+
+    def record(self, kind: str, duration: float, slot=None, **attrs) -> None:
+        """Record an externally-timed span (when the caller already
+        holds start/stop timestamps)."""
+        stack = self._slot_stack()
+        if slot is None and stack:
+            slot = stack[-1]
+        sp = Span(
+            kind=kind,
+            slot=None if slot is None else int(slot),
+            start=time.perf_counter() - duration,
+            duration=float(duration),
+            thread=threading.current_thread().name,
+            attrs=dict(attrs),
+        )
+        with self._lock:
+            self._buf.append(sp)
+        _SPAN_SECONDS.labels(kind=kind).observe(duration)
+
+    # ------------------------------------------------------------ queries
+
+    def spans(self, slot=None, kind: str = None) -> list:
+        with self._lock:
+            out = list(self._buf)
+        if slot is not None:
+            slot = int(slot)
+            out = [s for s in out if s.slot == slot]
+        if kind is not None:
+            out = [s for s in out if s.kind == kind]
+        out.sort(key=lambda s: s.start)
+        return out
+
+    def slots(self) -> list:
+        """Slots with at least one recorded span, ascending."""
+        with self._lock:
+            return sorted({s.slot for s in self._buf if s.slot is not None})
+
+    def slot_timeline(self, slot) -> dict:
+        """The JSON timeline the tracing endpoint serves: spans of one
+        slot ordered by start, with per-kind totals and the stage sum
+        (top-level `work:*` scheduler spans — nested stages like the
+        bls_verify inside an attestation batch are NOT double-counted
+        in `stage_total_seconds`)."""
+        spans = self.spans(slot=slot)
+        by_kind: dict = {}
+        for s in spans:
+            by_kind[s.kind] = by_kind.get(s.kind, 0.0) + s.duration
+        stage_total = sum(
+            s.duration for s in spans if s.kind.startswith("work:")
+        )
+        return {
+            "slot": None if slot is None else int(slot),
+            "span_count": len(spans),
+            "stage_total_seconds": round(stage_total, 6),
+            "totals_by_kind": {
+                k: round(v, 6) for k, v in sorted(by_kind.items())
+            },
+            "spans": [s.to_json() for s in spans],
+        }
+
+    def chrome_trace(self, slot=None) -> dict:
+        """Chrome-trace ('trace event') JSON: load in chrome://tracing
+        or Perfetto. Complete 'X' events on the perf_counter timeline."""
+        pid = os.getpid()
+        tids: dict = {}
+        events = []
+        for s in self.spans(slot=slot):
+            tid = tids.setdefault(s.thread, len(tids) + 1)
+            args = {"thread": s.thread, **s.attrs}
+            if s.slot is not None:
+                args["slot"] = s.slot
+            events.append(
+                {
+                    "name": s.kind,
+                    "ph": "X",
+                    "ts": round(s.start * 1e6, 3),
+                    "dur": round(s.duration * 1e6, 3),
+                    "pid": pid,
+                    "tid": tid,
+                    "args": args,
+                }
+            )
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+    def clear(self) -> None:
+        with self._lock:
+            self._buf.clear()
+
+
+# process-global tracer + module-level conveniences (the common idiom:
+# `from ..common import tracing` ... `with tracing.span("stage", slot=s)`)
+TRACER = Tracer()
+span = TRACER.span
+record = TRACER.record
+spans = TRACER.spans
+slots = TRACER.slots
+slot_timeline = TRACER.slot_timeline
+chrome_trace = TRACER.chrome_trace

@@ -92,8 +92,13 @@ def _perm_cached(n: int, seed: bytes, rounds: int):
     p = _PERM_CACHE.get(key)
     if p is None:
         p = shuffle_permutation(n, seed, rounds)
-        while len(_PERM_CACHE) >= _PERM_CACHE_MAX:
-            _PERM_CACHE.pop(next(iter(_PERM_CACHE)))
+        try:  # FIFO eviction; benign under concurrent evictors (same
+            # guard as state_transition's _ACTIVE_CACHE/_TAB_CACHE —
+            # two racing misses can pop the same first key)
+            while len(_PERM_CACHE) >= _PERM_CACHE_MAX:
+                _PERM_CACHE.pop(next(iter(_PERM_CACHE)))
+        except (KeyError, StopIteration, RuntimeError):
+            pass
         _PERM_CACHE[key] = p
     return p
 

@@ -30,6 +30,7 @@ from typing import Iterable, Optional
 
 import numpy as np
 
+from ..common import metrics as _metrics
 from ..crypto import bls
 from ..crypto.bls.keys import PublicKey, Signature, SignatureSet
 from . import types as T
@@ -141,13 +142,33 @@ _ACTIVE_CACHE_MAX = 8
 # (validators content token, epoch) -> total active balance (gwei)
 _TAB_CACHE: dict = {}
 
+# the CoW-spine caches' hit/miss series (PR 2 built the caches; the
+# observability layer exports them — a miss on the active-set cache is
+# an O(n) registry scan on the hot path). Children pre-resolved once:
+# the cache-HIT fast path stays a dict get + one uncontended inc.
+_M_EPOCH_CACHE = _metrics.counter(
+    "state_epoch_cache_total",
+    "Token-keyed epoch cache lookups by cache and result",
+    labelnames=("cache", "result"),
+)
+_M_ACTIVE_HIT = _M_EPOCH_CACHE.labels(cache="active_set", result="hit")
+_M_ACTIVE_MISS = _M_EPOCH_CACHE.labels(cache="active_set", result="miss")
+_M_TAB_HIT = _M_EPOCH_CACHE.labels(
+    cache="total_active_balance", result="hit"
+)
+_M_TAB_MISS = _M_EPOCH_CACHE.labels(
+    cache="total_active_balance", result="miss"
+)
+
 
 def get_active_validator_indices(state, epoch: int) -> list:
     tok = seq_token(state.validators)
     if tok is not None:
         hit = _ACTIVE_CACHE.get((tok, epoch))
         if hit is not None:
+            _M_ACTIVE_HIT.inc()
             return hit
+    _M_ACTIVE_MISS.inc()
     # inlined is_active_validator: this O(n) scan is the cold-path cost
     # of the first committee lookup of an epoch at mainnet scale
     out = [
@@ -192,7 +213,9 @@ def get_total_active_balance(spec: ChainSpec, state) -> int:
     if tok is not None:
         hit = _TAB_CACHE.get((tok, epoch))
         if hit is not None:
+            _M_TAB_HIT.inc()
             return hit
+    _M_TAB_MISS.inc()
     total = get_total_balance(
         spec, state, get_active_validator_indices(state, epoch)
     )

@@ -9,7 +9,10 @@ crypto/bls/src/impls/blst.rs:16,48-68 (RAND_BITS=64, nonzero).
 
 import os
 import secrets
+import time
 
+from ...common import metrics as _metrics
+from ...common import tracing as _tracing
 from . import params
 from .keys import (
     SecretKey,
@@ -22,6 +25,53 @@ from .keys import (
 from . import backends as _backends
 
 _DEFAULT_BACKEND = os.environ.get("LIGHTHOUSE_TPU_BLS_BACKEND", "cpu")
+
+# Backend-agnostic observability at the ONE seam every verifier funnels
+# into (gossip batches, block batches, sync batches). Labeled by backend
+# and by the AOT lane bucket the batch pads into, so the /metrics scrape
+# attributes verify latency and padding waste per compiled program.
+# tools/metrics_lint.py pins these names.
+M_SETS = _metrics.counter(
+    "bls_verify_sets_total",
+    "Signature sets submitted for verification, by backend",
+    labelnames=("backend",),
+)
+M_BATCHES = _metrics.counter(
+    "bls_verify_batches_total",
+    "verify_signature_sets calls, by backend",
+    labelnames=("backend",),
+)
+M_FAILED = _metrics.counter(
+    "bls_verify_failed_batches_total",
+    "verify_signature_sets calls that returned invalid (bad signature "
+    "or policy-rejected input), by backend",
+    labelnames=("backend",),
+)
+M_ERRORED = _metrics.counter(
+    "bls_verify_errored_batches_total",
+    "verify_signature_sets calls where the backend RAISED (device "
+    "error, not an invalid signature), by backend",
+    labelnames=("backend",),
+)
+M_BATCH_SECONDS = _metrics.histogram(
+    "bls_verify_batch_seconds",
+    "Whole-batch verify latency, by backend and AOT lane bucket",
+    labelnames=("backend", "bucket"),
+)
+M_OCCUPANCY = _metrics.histogram(
+    "bls_verify_batch_occupancy_ratio",
+    "Real sets / padded bucket size per batch, by backend and AOT lane "
+    "bucket (only the tpu backends actually pad — filter on backend)",
+    buckets=(0.05, 0.1, 0.25, 0.5, 0.75, 0.9, 1.0),
+    labelnames=("backend", "bucket"),
+)
+M_PADDING = _metrics.counter(
+    "bls_verify_padding_slots_total",
+    "Lane slots the batch's AOT bucket pads (only the tpu backends "
+    "actually pad — filter on backend; cpu/fake report the slots the "
+    "batch WOULD waste on the device path)",
+    labelnames=("backend", "bucket"),
+)
 
 
 def gen_batch_scalars(n: int):
@@ -42,10 +92,39 @@ def verify_signature_sets(sets, *, backend: str = None, rand_scalars=None) -> bo
     attestation batches, whole-block signature batches, sync-committee
     batches (reference call sites: attestation_verification/batch.rs:195,
     block_signature_verifier.rs:380-397)."""
-    b = _backends.get(backend or _DEFAULT_BACKEND)
+    name = backend or _DEFAULT_BACKEND
+    b = _backends.get(name)
     if rand_scalars is None:
         rand_scalars = gen_batch_scalars(len(sets))
-    return b.verify_signature_sets(sets, rand_scalars)
+    n = len(sets)
+    bucket = str(params.lane_bucket(n)) if n else "0"
+    t0 = time.perf_counter()
+    ok = False
+    raised = True
+    try:
+        with _tracing.span(
+            "bls_verify", backend=name, bucket=bucket, sets=n
+        ):
+            ok = b.verify_signature_sets(sets, rand_scalars)
+        raised = False
+    finally:
+        # record in finally: a backend that RAISES (chip drops mid-
+        # batch) is exactly the event these series must attribute —
+        # but as an ERROR, not as an invalid signature
+        M_BATCH_SECONDS.labels(backend=name, bucket=bucket).observe(
+            time.perf_counter() - t0
+        )
+        M_SETS.labels(backend=name).inc(n)
+        M_BATCHES.labels(backend=name).inc()
+        if n:
+            npad = int(bucket)
+            M_OCCUPANCY.labels(backend=name, bucket=bucket).observe(n / npad)
+            M_PADDING.labels(backend=name, bucket=bucket).inc(npad - n)
+        if raised:
+            M_ERRORED.labels(backend=name).inc()
+        elif not ok:
+            M_FAILED.labels(backend=name).inc()
+    return ok
 
 
 def verify(signature, pubkey, message: bytes, *, backend: str = None) -> bool:

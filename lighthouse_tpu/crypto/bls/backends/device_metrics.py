@@ -1,0 +1,26 @@
+"""Shared metric families for the device-path backends (tpu, warm).
+
+One registration site, jax-free, so warm.py can reference the same
+series without importing the jax-heavy tpu module and without a
+copy-pasted registration that could silently drift (the registry
+validates type/labels/buckets on re-registration, but not help text).
+"""
+
+from ....common import metrics as _metrics
+
+M_EXPORT_CACHE = _metrics.counter(
+    "bls_tpu_export_cache_total",
+    "AOT exported-module dispatches by result (hit = exported module, "
+    "miss = jit path despite the ladder being on, disabled = ladder off)",
+    labelnames=("result",),
+)
+M_HOST_PACK_SECONDS = _metrics.histogram(
+    "bls_tpu_host_pack_seconds",
+    "prepare_batch host packing time, by AOT lane bucket",
+    labelnames=("bucket",),
+)
+M_DEVICE_SECONDS = _metrics.histogram(
+    "bls_tpu_device_seconds",
+    "Device verify-call time (dispatch + compute + sync), by bucket",
+    labelnames=("bucket",),
+)

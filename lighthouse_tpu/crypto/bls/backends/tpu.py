@@ -32,10 +32,17 @@ Division of labor:
   device — all field/curve/pairing arithmetic, batched.
 """
 
+import time
+
 import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .device_metrics import (
+    M_DEVICE_SECONDS,
+    M_EXPORT_CACHE,
+    M_HOST_PACK_SECONDS,
+)
 from .. import params
 from lighthouse_tpu.ops.lane import (
     fp,
@@ -47,6 +54,12 @@ from lighthouse_tpu.ops.lane import (
 )
 
 W = fp.W
+
+# Backend observability (families registered in device_metrics.py;
+# tools/metrics_lint.py pins the names): where the batch's wall time
+# goes — host packing vs device compute — and whether the AOT export
+# ladder is actually being hit (a miss means this process pays a
+# multi-minute jax trace+lower for the bucket).
 
 _G1_GEN_NEG_X = fp.to_limbs(params.G1X)
 _G1_GEN_NEG_Y = fp.to_limbs((-params.G1Y) % params.P)
@@ -141,6 +154,14 @@ def _verify_kernel(apk_x, apk_y, sig_x, sig_y, t0, t1, rbits, pad):
 _EXPORTED: dict = {}
 
 
+def _export_enabled() -> bool:
+    """The ONE LH_TPU_USE_EXPORT gate (dispatch + probe must agree, or
+    the export-cache series misclassifies disabled as miss)."""
+    import os
+
+    return os.environ.get("LH_TPU_USE_EXPORT", "0") not in ("", "0")
+
+
 def source_fingerprint(extra_paths=()) -> str:
     """Hash of the kernel-defining sources (any edit invalidates):
     ops/lane/*.py + this file + bls params (whose constants — pad
@@ -195,7 +216,7 @@ def _exported_for(npad: int):
     take this path — the test tier must keep tracing."""
     import os
 
-    if os.environ.get("LH_TPU_USE_EXPORT", "0") in ("", "0"):
+    if not _export_enabled():
         return None
     if npad in _EXPORTED:
         return _EXPORTED[npad]
@@ -214,8 +235,10 @@ def _exported_for(npad: int):
 
 
 def _bucket(n: int) -> int:
-    """Power-of-two lane buckets, minimum 128 (a full TPU lane tile)."""
-    return 1 << max(7, (n - 1).bit_length())
+    """Power-of-two lane buckets, minimum 128 (a full TPU lane tile).
+    One shared definition (params.lane_bucket) so metrics labels and
+    export artifacts agree on the ladder."""
+    return params.lane_bucket(n)
 
 
 def _pack_draws_fast(messages):
@@ -303,16 +326,40 @@ def prepare_batch(sets, rand_scalars):
 
 def verify_callable(npad: int):
     """The verify entry point for a padded bucket: the AOT-exported
-    module when a fresh artifact exists, else the jitted kernel."""
+    module when a fresh artifact exists, else the jitted kernel.
+
+    The export-cache series counts HERE — the dispatch decision — not
+    in _exported_for, whose callers also probe speculatively (warm.py
+    _is_warm): hit = exported module used, miss = the jit path (a cold
+    bucket pays trace+lower), disabled = the ladder is off by config."""
+    if not _export_enabled():
+        M_EXPORT_CACHE.labels(result="disabled").inc()
+        return _verify_kernel
     exp = _exported_for(npad)
-    return exp if exp is not None else _verify_kernel
+    if exp is not None:
+        M_EXPORT_CACHE.labels(result="hit").inc()
+        return exp
+    M_EXPORT_CACHE.labels(result="miss").inc()
+    return _verify_kernel
 
 
 def verify_signature_sets(sets, rand_scalars) -> bool:
+    t0 = time.perf_counter()
     args = prepare_batch(sets, rand_scalars)
     if args is None:
         return False
-    return bool(np.asarray(verify_callable(args[0].shape[-1])(*args)))
+    npad = args[0].shape[-1]
+    bucket = str(npad)
+    M_HOST_PACK_SECONDS.labels(bucket=bucket).observe(
+        time.perf_counter() - t0
+    )
+    fn = verify_callable(npad)
+    t1 = time.perf_counter()
+    # np.asarray blocks on the device result, so this timing covers
+    # dispatch + compute + transfer (the whole device-side share)
+    ok = bool(np.asarray(fn(*args)))
+    M_DEVICE_SECONDS.labels(bucket=bucket).observe(time.perf_counter() - t1)
+    return ok
 
 
 def verify_single(signature, pubkey, message: bytes) -> bool:

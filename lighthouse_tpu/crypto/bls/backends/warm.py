@@ -24,6 +24,11 @@ import threading
 
 from . import cpu as _cpu
 
+# shared device-path families (one registration site, jax-free) —
+# referenced directly, not through the device object, so a test fake in
+# `_device_override` never has to carry metric attributes
+from .device_metrics import M_DEVICE_SECONDS, M_HOST_PACK_SECONDS
+
 _lock = threading.Lock()
 _warm: set = set()
 _inflight: dict = {}
@@ -66,11 +71,19 @@ def _warmup(npad: int, args) -> None:
 
 
 def verify_signature_sets(sets, rand_scalars) -> bool:
+    import time as _time
+
     dev = _device()
+    t0 = _time.perf_counter()
     args = dev.prepare_batch(sets, rand_scalars)
     if args is None:
         return False
     npad = args[0].shape[-1]
+    # same host-pack/device split series as the direct tpu backend —
+    # warm is the node-default posture, its batches must not be blind
+    M_HOST_PACK_SECONDS.labels(bucket=str(npad)).observe(
+        _time.perf_counter() - t0
+    )
     with _lock:
         warm = _is_warm(npad)
         if not warm and npad not in _inflight:
@@ -80,10 +93,14 @@ def verify_signature_sets(sets, rand_scalars) -> bool:
             _inflight[npad] = t
             t.start()
     if warm:
+        t1 = _time.perf_counter()
         result = dev.verify_callable(npad)(*args)
         import numpy as np
 
         ok = bool(np.asarray(result))
+        M_DEVICE_SECONDS.labels(bucket=str(npad)).observe(
+            _time.perf_counter() - t1
+        )
         with _lock:
             _warm.add(npad)
         return ok

@@ -59,3 +59,14 @@ RAND_BITS = 64
 # Fp2 non-residue used to build the tower: Fp2 = Fp[u]/(u^2 + 1),
 # Fp6 = Fp2[v]/(v^3 - XI), Fp12 = Fp6[w]/(w^2 - v), XI = 1 + u.
 XI = (1, 1)
+
+
+def lane_bucket(n: int) -> int:
+    """Power-of-two lane buckets, minimum 128 (a full TPU lane tile).
+
+    The ONE definition of the AOT bucket ladder every layer shares: the
+    TPU backend pads batches to it, tools/export_verify.py serializes
+    per-bucket programs, and the metrics layer labels occupancy/latency
+    series with it. Lives here (pure int math, no jax import) so the
+    dispatch layer can bucket-label without touching a backend."""
+    return 1 << max(7, (n - 1).bit_length())

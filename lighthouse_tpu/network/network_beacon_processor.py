@@ -13,6 +13,7 @@ from __future__ import annotations
 
 from typing import Callable, Optional
 
+from ..common import metrics
 from ..consensus import types as T
 from ..node.beacon_chain import AttestationError, AvailabilityPending, BlockError
 from ..node.beacon_processor import Work, WorkType
@@ -28,6 +29,20 @@ from .rpc import (
     Protocol,
     ResponseCode,
     Status,
+)
+
+# gossip ingest — the FIRST stage of the slot timeline. Labeled by
+# message kind so queue-wait/drop series downstream can be correlated
+# with what actually arrived on the wire.
+GOSSIP_RX = metrics.counter(
+    "network_gossip_messages_total",
+    "Gossip messages received, by kind",
+    labelnames=("kind",),
+)
+GOSSIP_DECODE_FAIL = metrics.counter(
+    "network_gossip_decode_failures_total",
+    "Gossip messages that failed SSZ decoding, by kind",
+    labelnames=("kind",),
 )
 
 
@@ -59,11 +74,13 @@ class NetworkBeaconProcessor:
             self._on_gossip_blob(peer_id, data)
 
     def _on_gossip_block(self, peer_id: str, data: bytes) -> None:
+        GOSSIP_RX.labels(kind="block").inc()
         try:
             from .sync import decode_block_response
 
             signed = decode_block_response(self.chain.spec, data)
         except Exception:
+            GOSSIP_DECODE_FAIL.labels(kind="block").inc()
             self.service.report_peer(peer_id, PeerAction.LOW_TOLERANCE)
             return
 
@@ -101,13 +118,19 @@ class NetworkBeaconProcessor:
                     self.service.report_peer(peer_id, PeerAction.MID_TOLERANCE)
 
         self.processor.submit(
-            Work(kind=WorkType.GOSSIP_BLOCK, process_individual=process)
+            Work(
+                kind=WorkType.GOSSIP_BLOCK,
+                process_individual=process,
+                slot=int(signed.message.slot),
+            )
         )
 
     def _on_gossip_attestation(self, peer_id: str, data: bytes) -> None:
+        GOSSIP_RX.labels(kind="attestation").inc()
         try:
             att = T.Attestation.deserialize(data)
         except Exception:
+            GOSSIP_DECODE_FAIL.labels(kind="attestation").inc()
             self.service.report_peer(peer_id, PeerAction.LOW_TOLERANCE)
             return
 
@@ -138,13 +161,16 @@ class NetworkBeaconProcessor:
                 process_individual=individual,
                 process_batch=batch,
                 payload=att,
+                slot=int(att.data.slot),
             )
         )
 
     def _on_gossip_blob(self, peer_id: str, data: bytes) -> None:
+        GOSSIP_RX.labels(kind="blob_sidecar").inc()
         try:
             sidecar = T.BlobSidecar.deserialize(data)
         except Exception:
+            GOSSIP_DECODE_FAIL.labels(kind="blob_sidecar").inc()
             self.service.report_peer(peer_id, PeerAction.LOW_TOLERANCE)
             return
 
@@ -167,7 +193,11 @@ class NetworkBeaconProcessor:
                         )
 
         self.processor.submit(
-            Work(kind=WorkType.GOSSIP_BLOCK, process_individual=process)
+            Work(
+                kind=WorkType.GOSSIP_BLOCK,
+                process_individual=process,
+                slot=int(sidecar.signed_block_header.message.slot),
+            )
         )
 
     # ------------------------------------------------------------ gossip out

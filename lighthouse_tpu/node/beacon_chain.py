@@ -21,7 +21,7 @@ import threading
 from dataclasses import dataclass, field
 from typing import Optional
 
-from ..common import metrics
+from ..common import metrics, tracing
 from ..consensus import state_transition as st
 from ..consensus import types as T
 from ..consensus.fork_choice import ForkChoice, ForkChoiceError
@@ -576,21 +576,24 @@ class BeaconChain:
             if block.slot > self.current_slot:
                 raise BlockError("block from the future")
 
+            slot = int(block.slot)
             state = parent_state.copy()
             if state.slot < block.slot:
-                st.process_slots(self.spec, state, block.slot)
+                with tracing.span("block_slots_advance", slot=slot):
+                    st.process_slots(self.spec, state, block.slot)
 
             if verify_signatures:
                 # ONE batch for every signature in the block
-                verifier = BlockSignatureVerifier(
-                    self.spec,
-                    self._get_pubkey,
-                    state.fork,
-                    self.genesis_validators_root,
-                )
-                verifier.include_all(self.spec, state, signed_block)
-                if not verifier.verify(backend=self.bls_backend):
-                    raise BlockError("block signature batch invalid")
+                with tracing.span("block_signature_batch", slot=slot):
+                    verifier = BlockSignatureVerifier(
+                        self.spec,
+                        self._get_pubkey,
+                        state.fork,
+                        self.genesis_validators_root,
+                    )
+                    verifier.include_all(self.spec, state, signed_block)
+                    if not verifier.verify(backend=self.bls_backend):
+                        raise BlockError("block signature batch invalid")
 
             # Deneb data availability gate (data_availability_checker
             # role): a block committing to blobs imports only once every
@@ -609,18 +612,20 @@ class BeaconChain:
                         f"{len(commitments)} blobs committed, not all seen"
                     )
 
-            st.process_block(
-                self.spec, state, block, verify_signatures=False
-            )
-            if bytes(block.state_root) != state.hash_tree_root():
-                raise BlockError("state root mismatch")
+            with tracing.span("block_state_transition", slot=slot):
+                st.process_block(
+                    self.spec, state, block, verify_signatures=False
+                )
+                if bytes(block.state_root) != state.hash_tree_root():
+                    raise BlockError("state root mismatch")
 
-            self._import_block(
-                signed_block,
-                block_root,
-                state,
-                execution_status=self._notify_new_payload(block),
-            )
+            with tracing.span("block_import", slot=slot):
+                self._import_block(
+                    signed_block,
+                    block_root,
+                    state,
+                    execution_status=self._notify_new_payload(block),
+                )
             return block_root
 
     def _notify_new_payload(self, block):
@@ -985,6 +990,10 @@ class BeaconChain:
 
     def recompute_head(self) -> bytes:
         """canonical_head.rs:474 recompute_head_at_current_slot."""
+        with tracing.span("fork_choice_recompute", slot=self.current_slot):
+            return self._recompute_head_traced()
+
+    def _recompute_head_traced(self) -> bytes:
         old_head = self.head
         head_root = self.fork_choice.get_head(self.current_slot)
         node = self.fork_choice.proto.nodes[
@@ -1124,7 +1133,12 @@ class BeaconChain:
         (attestation_verification/batch.rs:133-214). Returns the subset
         that verified; falls back to per-item verification if the batch
         fails (poisoning defense)."""
-        with self.t_att_batch.time():
+        slot = (
+            int(verified[0].attestation.data.slot) if verified else None
+        )
+        with self.t_att_batch.time(), tracing.span(
+            "attestation_batch", slot=slot, count=len(verified)
+        ):
             return self._batch_verify_attestations_timed(verified)
 
     def _batch_verify_attestations_timed(self, verified):

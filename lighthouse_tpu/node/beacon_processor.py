@@ -29,12 +29,13 @@ from __future__ import annotations
 
 import heapq
 import threading
+import time
 from collections import deque
 from dataclasses import dataclass, field
 from enum import IntEnum
 from typing import Callable, Optional
 
-from ..common import metrics
+from ..common import metrics, tracing
 
 
 class WorkType(IntEnum):
@@ -61,6 +62,51 @@ class WorkType(IntEnum):
 _LIFO_TYPES = {WorkType.GOSSIP_ATTESTATION, WorkType.GOSSIP_AGGREGATE}
 _BATCH_TYPES = {WorkType.GOSSIP_ATTESTATION, WorkType.GOSSIP_AGGREGATE}
 
+# Per-queue labeled families (lib.rs registers one *_VEC per queue).
+# tools/metrics_lint.py asserts these names stay registered — renaming
+# a series here without updating the lint's contract fails tier-1.
+Q_DEPTH = metrics.gauge(
+    "beacon_processor_queue_depth",
+    "Current length of each work queue",
+    labelnames=("queue",),
+)
+Q_WAIT = metrics.histogram(
+    "beacon_processor_queue_wait_seconds",
+    "Time work items spent queued before a worker popped them",
+    labelnames=("queue",),
+)
+Q_RECEIVED = metrics.counter(
+    "beacon_processor_work_received_total",
+    "Work submitted, by queue",
+    labelnames=("queue",),
+)
+Q_DROPPED = metrics.counter(
+    "beacon_processor_work_dropped_total",
+    "Work dropped by backpressure, by queue",
+    labelnames=("queue",),
+)
+Q_PROCESSED = metrics.counter(
+    "beacon_processor_work_processed_total",
+    "Work completed, by queue",
+    labelnames=("queue",),
+)
+BATCH_SIZE = metrics.histogram(
+    "beacon_processor_batch_size",
+    "Formed batch sizes, by queue",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096),
+    labelnames=("queue",),
+)
+
+# children resolved ONCE per queue: the hot path skips the per-call
+# labels() validation + family-lock dict lookup, and every queue's
+# series exists from process start (no blind queues on first scrape)
+_Q_DEPTH = {t: Q_DEPTH.labels(queue=t.name) for t in WorkType}
+_Q_WAIT = {t: Q_WAIT.labels(queue=t.name) for t in WorkType}
+_Q_RECEIVED = {t: Q_RECEIVED.labels(queue=t.name) for t in WorkType}
+_Q_DROPPED = {t: Q_DROPPED.labels(queue=t.name) for t in WorkType}
+_Q_PROCESSED = {t: Q_PROCESSED.labels(queue=t.name) for t in WorkType}
+_BATCH_SIZE = {t: BATCH_SIZE.labels(queue=t.name) for t in _BATCH_TYPES}
+
 
 @dataclass
 class Work:
@@ -72,6 +118,8 @@ class Work:
     payload: object = None
     process_batch: Optional[Callable[[list], bool]] = None
     # process_batch returns False to request individual fallback
+    slot: Optional[int] = None  # anchors the scheduler span to a slot
+    enqueued_at: float = 0.0  # stamped by submit(); feeds Q_WAIT
 
 
 @dataclass
@@ -126,6 +174,8 @@ class BeaconProcessor:
     def submit(self, work: Work) -> bool:
         """Enqueue; returns False when dropped by backpressure."""
         self.m_received.inc()
+        _Q_RECEIVED[work.kind].inc()
+        work.enqueued_at = time.perf_counter()
         cap = self.config.queue_capacities.get(
             work.kind, self.config.default_capacity
         )
@@ -136,10 +186,16 @@ class BeaconProcessor:
                     # LIFO queues drop the OLDEST (stale) item instead
                     q.popleft()
                     self.m_dropped.inc()
+                    _Q_DROPPED[work.kind].inc()
                 else:
                     self.m_dropped.inc()
+                    _Q_DROPPED[work.kind].inc()
                     return False
             q.append(work)
+            # inside the queue lock: a stale out-of-lock set could pin
+            # the gauge at a nonzero depth on a drained queue (metric
+            # locks never wrap the queue lock, so no ordering cycle)
+            _Q_DEPTH[work.kind].set(len(q))
         self._event.set()
         return True
 
@@ -168,6 +224,7 @@ class BeaconProcessor:
     def _pop_next(self) -> Optional[list]:
         """Highest-priority work, batch-formed where applicable. Returns
         a list of Work sharing one process_batch, or a single-item list."""
+        batch = None
         with self._lock:
             for kind in WorkType:
                 q = self._queues[kind]
@@ -182,11 +239,27 @@ class BeaconProcessor:
                     batch = []
                     while q and len(batch) < limit:
                         batch.append(q.pop())  # LIFO: freshest first
-                    return batch
-                if kind in _LIFO_TYPES:
-                    return [q.pop()]
-                return [q.popleft()]
-        return None
+                elif kind in _LIFO_TYPES:
+                    batch = [q.pop()]
+                else:
+                    batch = [q.popleft()]
+                # depth gauge inside the lock (see submit): last-writer
+                # races would otherwise pin stale depths on the scrape
+                _Q_DEPTH[kind].set(len(q))
+                break
+        if batch is None:
+            return None
+        # per-item observations outside the queue lock — they only
+        # touch the popped items, not shared queue state
+        kind = batch[0].kind
+        now = time.perf_counter()
+        wait = _Q_WAIT[kind]
+        for w in batch:
+            if w.enqueued_at:
+                wait.observe(now - w.enqueued_at)
+        if kind in _BATCH_TYPES:
+            _BATCH_SIZE[kind].observe(len(batch))
+        return batch
 
     def step(self) -> bool:
         """Process one work item (or one formed batch). Returns False
@@ -194,23 +267,32 @@ class BeaconProcessor:
         batch = self._pop_next()
         if batch is None:
             return False
-        if len(batch) > 1 and batch[0].process_batch is not None:
-            self.m_batches.inc()
-            try:
-                ok = batch[0].process_batch([w.payload for w in batch])
-            except Exception:
-                # a raising batch path must not kill the worker loop —
-                # treat it exactly like a poisoned batch
-                ok = False
-            if ok is False:
-                # poisoned batch: fall back to individual verification
-                self.m_batch_fallbacks.inc()
+        kind = batch[0].kind
+        slot = next((w.slot for w in batch if w.slot is not None), None)
+        # the slot-timeline STAGE span: one per executed work unit
+        # (item or formed batch); nested spans (attestation_batch,
+        # bls_verify, ...) attribute the inside of this stage
+        with tracing.span(
+            "work:" + kind.name.lower(), slot=slot, count=len(batch)
+        ):
+            if len(batch) > 1 and batch[0].process_batch is not None:
+                self.m_batches.inc()
+                try:
+                    ok = batch[0].process_batch([w.payload for w in batch])
+                except Exception:
+                    # a raising batch path must not kill the worker loop —
+                    # treat it exactly like a poisoned batch
+                    ok = False
+                if ok is False:
+                    # poisoned batch: fall back to individual verification
+                    self.m_batch_fallbacks.inc()
+                    for w in batch:
+                        w.process_individual(w.payload)
+            else:
                 for w in batch:
                     w.process_individual(w.payload)
-        else:
-            for w in batch:
-                w.process_individual(w.payload)
         self.m_processed.inc(len(batch))
+        _Q_PROCESSED[kind].inc(len(batch))
         return True
 
     # ---------------------------------------------------------- thread loop

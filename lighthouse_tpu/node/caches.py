@@ -15,7 +15,17 @@ import collections
 import threading
 from typing import Callable, Optional
 
+from ..common import metrics
 from ..consensus import state_transition as st
+
+_SHUFFLING_CACHE = metrics.counter(
+    "beacon_chain_shuffling_cache_total",
+    "ShufflingCache lookups by result (miss = full epoch recompute)",
+    labelnames=("result",),
+)
+# pre-resolved children: committee resolution is on the gossip hot path
+_SHUFFLING_HIT = _SHUFFLING_CACHE.labels(result="hit")
+_SHUFFLING_MISS = _SHUFFLING_CACHE.labels(result="miss")
 
 
 class _LRU:
@@ -81,10 +91,12 @@ class ShufflingCache:
         epoch_map = self._cache.get(key)
         if epoch_map is None:
             self.misses += 1
+            _SHUFFLING_MISS.inc()
             epoch_map = self._compute_epoch(spec, state, epoch)
             self._cache.put(key, epoch_map)
         else:
             self.hits += 1
+            _SHUFFLING_HIT.inc()
         return epoch_map[(slot, index)]
 
     @staticmethod

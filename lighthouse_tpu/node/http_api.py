@@ -31,6 +31,7 @@ Routes (round 4 widened the surface toward lib.rs's full table):
   POST /eth/v1/beacon/pool/attestations
   POST /eth/v1/beacon/blocks
   GET  /metrics                                       (prometheus text)
+  GET  /lighthouse/tracing[?slot=N][&format=chrome]   (slot span timeline)
 Round 4b additions:
   GET  /eth/v1/beacon/states/{id}/fork | sync_committees
   GET  /eth/v1/config/fork_schedule
@@ -1604,6 +1605,48 @@ def make_handler(api: BeaconApi):
             except (BrokenPipeError, ConnectionResetError, OSError):
                 return  # client went away — normal SSE termination
 
+        def _serve_tracing(self) -> None:
+            """GET /lighthouse/tracing[?slot=N][&format=chrome] — the
+            slot-anchored span timeline (lighthouse's /lighthouse/*
+            operator namespace). Default JSON: ordered spans + per-kind
+            totals + the top-level stage sum for the slot;
+            format=chrome returns Chrome-trace JSON for chrome://tracing
+            / Perfetto."""
+            from urllib.parse import parse_qs, urlparse
+
+            from ..common import tracing
+
+            q = {
+                k: v[-1]
+                for k, v in parse_qs(urlparse(self.path).query).items()
+            }
+            slot = None
+            if "slot" in q:
+                try:
+                    slot = int(q["slot"])
+                except ValueError:
+                    self._send_json(
+                        400, {"code": 400, "message": "bad slot"}
+                    )
+                    return
+            if q.get("format") == "chrome":
+                self._send_json(200, tracing.chrome_trace(slot=slot))
+                return
+            if slot is None:
+                # no slot: the index — slots with recorded spans
+                self._send_json(
+                    200,
+                    {
+                        "data": {
+                            "slots": tracing.slots(),
+                            "span_count": len(tracing.TRACER),
+                            "capacity": tracing.TRACER.capacity,
+                        }
+                    },
+                )
+                return
+            self._send_json(200, {"data": tracing.slot_timeline(slot)})
+
         def _send_json(self, code: int, obj) -> None:
             raw = json.dumps(obj).encode()
             self.send_response(code)
@@ -1623,10 +1666,18 @@ def make_handler(api: BeaconApi):
             if method == "GET" and self.path == "/metrics":
                 raw = metrics.gather().encode()
                 self.send_response(200)
-                self.send_header("Content-Type", "text/plain; version=0.0.4")
+                # the full versioned content type (incl. charset) stops
+                # Prometheus scrapers from content-sniffing the body
+                self.send_header("Content-Type", metrics.CONTENT_TYPE)
                 self.send_header("Content-Length", str(len(raw)))
                 self.end_headers()
                 self.wfile.write(raw)
+                return
+            if (
+                method == "GET"
+                and self.path.split("?")[0] == "/lighthouse/tracing"
+            ):
+                self._serve_tracing()
                 return
             if method == "GET" and self.path.split("?")[0] == "/eth/v1/events":
                 self._stream_events()

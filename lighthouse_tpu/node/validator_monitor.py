@@ -20,12 +20,24 @@ log = clog.get_logger("validator_monitor")
 _MONITORED = metrics.gauge(
     "validator_monitor_validators", "Validators under monitoring"
 )
+# Per-monitored-validator inclusion counters on the labeled families
+# (validator_monitor.rs registers one *_VEC per observation kind); the
+# per-validator hit/miss records in `_Record` feed the epoch summary,
+# the labeled series feed the scrape.
 _ATT_HITS = metrics.counter(
     "validator_monitor_attestation_hits_total",
     "Monitored validators' attestations seen (gossip or blocks)",
+    labelnames=("validator",),
+)
+_ATT_MISSES = metrics.counter(
+    "validator_monitor_attestation_misses_total",
+    "Epochs a monitored validator was not seen attesting",
+    labelnames=("validator",),
 )
 _BLOCKS = metrics.counter(
-    "validator_monitor_blocks_total", "Monitored validators' blocks seen"
+    "validator_monitor_blocks_total",
+    "Monitored validators' blocks seen",
+    labelnames=("validator",),
 )
 
 
@@ -70,7 +82,7 @@ class ValidatorMonitor:
                 rec.last_attestation_epoch = max(
                     rec.last_attestation_epoch, epoch
                 )
-                _ATT_HITS.inc()
+                _ATT_HITS.labels(validator=index).inc()
 
     def observe_block(self, proposer_index: int, slot: int) -> None:
         with self._lock:
@@ -83,7 +95,7 @@ class ValidatorMonitor:
                 )
                 _MONITORED.set(len(self._records))
             rec.blocks += 1
-            _BLOCKS.inc()
+            _BLOCKS.labels(validator=proposer_index).inc()
 
     # -------------------------------------------------------- summary
 
@@ -99,6 +111,7 @@ class ValidatorMonitor:
                 attested = completed_epoch in rec.epochs_attested
                 out[rec.index] = attested
                 if not attested:
+                    _ATT_MISSES.labels(validator=rec.index).inc()
                     log.warning(
                         "monitored validator missed attestation",
                         validator=rec.index,

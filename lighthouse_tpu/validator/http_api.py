@@ -429,7 +429,9 @@ def make_handler(api: KeymanagerApi, token: str):
             if method == "GET" and path == "/metrics":
                 raw = metrics.gather().encode()
                 self.send_response(200)
-                self.send_header("Content-Type", "text/plain; version=0.0.4")
+                # versioned content type (incl. charset): Prometheus
+                # scrapers stop content-sniffing the exposition body
+                self.send_header("Content-Type", metrics.CONTENT_TYPE)
                 self.send_header("Content-Length", str(len(raw)))
                 self.end_headers()
                 self.wfile.write(raw)

@@ -1,0 +1,229 @@
+#!/usr/bin/env python
+"""Observability contract lint (ISSUE 4 satellite).
+
+Walks the lighthouse_tpu metric surface and asserts that every
+beacon_processor queue and every BLS backend registers its required
+metric series — run from a tier-1 test (tests/test_metrics.py) so a
+rename or a dropped registration can't silently kill a dashboard
+series between PRs.
+
+Checks, in order:
+  1. required FAMILIES exist in the registry with the exact labelnames
+     (module-level registrations happen at import; the lint imports the
+     owning modules first);
+  2. every WorkType queue produces its per-queue labeled children once
+     work flows through a BeaconProcessor (exercised here with no-op
+     work);
+  3. the BLS dispatch seam produces backend+bucket-labeled series for
+     a verify call (exercised with the fake backend — the TPU path's
+     series come from the same dispatch family);
+  4. the whole registry renders and re-parses as Prometheus text
+     (HELP/TYPE headers, sample lines, histogram bucket monotonicity).
+
+Importable (`lint() -> list[str]` of problems) and runnable as a CLI
+(exit 1 on any problem).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+# standalone invocation from anywhere: the repo root owns the package
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+# required family name -> labelnames tuple
+REQUIRED_FAMILIES = {
+    # beacon_processor per-queue series (node/beacon_processor.py)
+    "beacon_processor_queue_depth": ("queue",),
+    "beacon_processor_queue_wait_seconds": ("queue",),
+    "beacon_processor_work_received_total": ("queue",),
+    "beacon_processor_work_dropped_total": ("queue",),
+    "beacon_processor_work_processed_total": ("queue",),
+    "beacon_processor_batch_size": ("queue",),
+    # legacy unlabeled aggregates (kept for continuity)
+    "beacon_processor_work_events_received_total": (),
+    "beacon_processor_work_events_dropped_total": (),
+    "beacon_processor_work_events_processed_total": (),
+    "beacon_processor_batches_formed_total": (),
+    "beacon_processor_batch_individual_fallbacks_total": (),
+    # BLS dispatch seam (crypto/bls/__init__.py) — every backend funnels
+    # through these
+    "bls_verify_sets_total": ("backend",),
+    "bls_verify_batches_total": ("backend",),
+    "bls_verify_failed_batches_total": ("backend",),
+    "bls_verify_errored_batches_total": ("backend",),
+    "bls_verify_batch_seconds": ("backend", "bucket"),
+    "bls_verify_batch_occupancy_ratio": ("backend", "bucket"),
+    "bls_verify_padding_slots_total": ("backend", "bucket"),
+    # TPU backend split (crypto/bls/backends/tpu.py)
+    "bls_tpu_export_cache_total": ("result",),
+    "bls_tpu_host_pack_seconds": ("bucket",),
+    "bls_tpu_device_seconds": ("bucket",),
+    # gossip ingest (network/network_beacon_processor.py)
+    "network_gossip_messages_total": ("kind",),
+    "network_gossip_decode_failures_total": ("kind",),
+    # chain caches + span aggregation
+    "beacon_chain_shuffling_cache_total": ("result",),
+    "state_epoch_cache_total": ("cache", "result"),
+    "lighthouse_tracing_span_seconds": ("kind",),
+    # validator monitor (node/validator_monitor.py)
+    "validator_monitor_validators": (),
+    "validator_monitor_attestation_hits_total": ("validator",),
+    "validator_monitor_attestation_misses_total": ("validator",),
+    "validator_monitor_blocks_total": ("validator",),
+}
+
+# sample line: name{labels} value   (labels optional)
+_SAMPLE_RE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{(?:[a-zA-Z_][a-zA-Z0-9_]*='
+    r'"(?:[^"\\]|\\.)*",?)*\})? (-?[0-9.e+-]+|[+-]?Inf|NaN)$'
+)
+
+
+def _import_surface(problems: list) -> None:
+    """Importing the owning modules registers the module-level
+    families. The TPU backend import is jax-heavy; under the test tier
+    jax is already loaded, standalone it is gated to JAX_PLATFORMS=cpu."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import lighthouse_tpu.network.network_beacon_processor  # noqa: F401
+    import lighthouse_tpu.node.beacon_processor  # noqa: F401
+    import lighthouse_tpu.node.caches  # noqa: F401
+    import lighthouse_tpu.node.validator_monitor  # noqa: F401
+    import lighthouse_tpu.common.tracing  # noqa: F401
+    import lighthouse_tpu.consensus.state_transition  # noqa: F401
+
+    try:
+        import lighthouse_tpu.crypto.bls.backends.tpu  # noqa: F401
+    except Exception as e:  # pragma: no cover — jax-less environments
+        problems.append(f"tpu backend unimportable (metrics unchecked): {e}")
+
+
+def _check_families(problems: list) -> None:
+    from lighthouse_tpu.common import metrics
+
+    for name, labelnames in REQUIRED_FAMILIES.items():
+        fam = metrics.get(name)
+        if fam is None:
+            problems.append(f"required metric family missing: {name}")
+        elif fam.labelnames != tuple(labelnames):
+            problems.append(
+                f"{name}: labelnames {fam.labelnames} != required "
+                f"{tuple(labelnames)}"
+            )
+
+
+def _check_queues(problems: list) -> None:
+    from lighthouse_tpu.common import metrics
+    from lighthouse_tpu.node.beacon_processor import (
+        BeaconProcessor,
+        Work,
+        WorkType,
+    )
+
+    bp = BeaconProcessor()
+    for kind in WorkType:
+        bp.submit(Work(kind=kind, process_individual=lambda p: None))
+    while bp.step():
+        pass
+    for fam_name in (
+        "beacon_processor_queue_depth",
+        "beacon_processor_queue_wait_seconds",
+        "beacon_processor_work_received_total",
+        "beacon_processor_work_processed_total",
+    ):
+        fam = metrics.get(fam_name)
+        if fam is None:
+            continue  # already reported by _check_families
+        have = {v[0] for v in fam.label_values()}
+        for kind in WorkType:
+            if kind.name not in have:
+                problems.append(
+                    f"{fam_name}: no series for queue {kind.name}"
+                )
+
+
+def _check_bls_dispatch(problems: list) -> None:
+    from lighthouse_tpu.common import metrics
+    from lighthouse_tpu.crypto import bls
+
+    bls.verify_signature_sets(
+        [object()] * 3, backend="fake", rand_scalars=[1, 1, 1]
+    )
+    fam = metrics.get("bls_verify_batch_seconds")
+    if fam is not None and not any(
+        v[0] == "fake" for v in fam.label_values()
+    ):
+        problems.append(
+            "bls_verify_batch_seconds: dispatch produced no backend series"
+        )
+    occ = metrics.get("bls_verify_batch_occupancy_ratio")
+    if occ is not None and not occ.label_values():
+        problems.append(
+            "bls_verify_batch_occupancy_ratio: no bucket series after verify"
+        )
+
+
+def _check_scrape_parses(problems: list) -> None:
+    from lighthouse_tpu.common import metrics
+
+    text = metrics.gather()
+    seen_type: dict = {}
+    hist_acc: dict = {}
+    for line in text.splitlines():
+        if not line:
+            problems.append("gather(): blank line in exposition")
+            continue
+        if line.startswith("# HELP "):
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split(" ")
+            seen_type[parts[2]] = parts[3]
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            problems.append(f"gather(): unparseable sample line {line!r}")
+            continue
+        name = m.group(1)
+        base = re.sub(r"_(bucket|sum|count)$", "", name)
+        if name not in seen_type and base not in seen_type:
+            problems.append(f"gather(): sample {name!r} before its # TYPE")
+        # histogram cumulative-bucket monotonicity per child series
+        if name.endswith("_bucket"):
+            key = name + (m.group(2) or "").rsplit("le=", 1)[0]
+            val = float(m.group(3))
+            prev = hist_acc.get(key, 0.0)
+            if val < prev:
+                problems.append(
+                    f"gather(): non-monotonic buckets in {line!r}"
+                )
+            hist_acc[key] = val
+
+
+def lint() -> list:
+    problems: list = []
+    _import_surface(problems)
+    # exercise first: the legacy per-instance counters register in
+    # BeaconProcessor.__init__, not at module import
+    _check_queues(problems)
+    _check_bls_dispatch(problems)
+    _check_families(problems)
+    _check_scrape_parses(problems)
+    return problems
+
+
+def main() -> int:
+    problems = lint()
+    for p in problems:
+        print(f"metrics-lint: {p}", file=sys.stderr)
+    if problems:
+        return 1
+    print("metrics-lint: ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
