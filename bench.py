@@ -4,7 +4,8 @@
 Prints ONE JSON line:
   {"metric", "value" (config-1 sets/s on the device), "unit",
    "vs_baseline" (vs the blst single-HOST anchor, see below),
-   "detail" (all configs, latency percentiles, anchors)}
+   "detail" (all configs, latency percentiles, anchors, per-stage
+   epoch-boundary seconds at 250k/500k under "epoch")}
 
 Baseline anchoring (VERDICT r1 #2): blst is not installable in this
 image, so the denominator is an explicit, documented anchor — NOT the
@@ -331,6 +332,58 @@ def _config1_marginal(detail, sets1, scalars1, n_sets):
     )
 
 
+def _config_epoch(detail):
+    """detail.epoch (ISSUE 6): per-stage epoch-transition seconds at
+    250k/500k, read from the state_epoch_stage_seconds series — pure
+    host/CPU work, so the boundary trajectory stays driver-visible
+    even on rounds where the chip tunnel is down (main forces the
+    numpy epoch backend there; a jit build would hang in device
+    init)."""
+    from lighthouse_tpu.common import metrics
+    from lighthouse_tpu.consensus import state_transition as st
+    from lighthouse_tpu.ops import epoch as epoch_ops
+    from lighthouse_tpu.tools.scale_probe import build_state
+
+    def stage_sums():
+        fam = metrics.get("state_epoch_stage_seconds")
+        if fam is None:
+            return {}
+        return {
+            lv[0]: fam.labels(stage=lv[0]).total
+            for lv in fam.label_values()
+        }
+
+    out = {"backend": epoch_ops.active_backend()}
+    for n in (250_000, 500_000):
+        key = f"n{n // 1000}k"
+        if _left() < 90:
+            out[key] = {"skipped": "budget", "left_s": round(_left(), 1)}
+            continue
+        spec, state = build_state(n)
+        t0 = time.perf_counter()
+        st.process_epoch(spec, state)
+        cold_s = time.perf_counter() - t0
+        # steady state: the next boundary rides dirty-chunk column
+        # refreshes — the cost a live node pays per epoch
+        state.slot += spec.preset.slots_per_epoch
+        before = stage_sums()
+        t0 = time.perf_counter()
+        st.process_epoch(spec, state)
+        warm_s = time.perf_counter() - t0
+        after = stage_sums()
+        stages = {
+            k: round(v - before.get(k, 0.0), 4)
+            for k, v in sorted(after.items())
+            if v - before.get(k, 0.0) > 0.0
+        }
+        out[key] = {
+            "cold_s": round(cold_s, 3),
+            "warm_s": round(warm_s, 3),
+            "stages_warm_s": stages,
+        }
+    detail["epoch"] = out
+
+
 def main():
     n_sets = int(os.environ.get("BENCH_SETS", "4096"))
     reps = int(os.environ.get("BENCH_REPS", "5"))
@@ -430,6 +483,11 @@ def main():
     if device is None:
         detail["backend_init"]["error"] = "device never appeared"
         detail["last_self_measured"] = _last_self_measured()
+        # the epoch boundary trajectory must survive a dead tunnel:
+        # force the numpy epoch backend (the jax build's self-check
+        # would block in device init, exactly like jax.devices())
+        os.environ.setdefault("LIGHTHOUSE_EPOCH_JAX", "0")
+        _run_config("epoch", 60, _config_epoch)
         _emit()
         os._exit(3)
     detail["device"] = device
@@ -480,6 +538,9 @@ def main():
         _run_config(
             "config1_marginal", 20, _config1_marginal, sets1, scalars1, n_sets
         )
+
+    # per-stage epoch-boundary attribution rides every round (ISSUE 6)
+    _run_config("epoch", 60, _config_epoch)
 
     # ------------- in-repo CPU control (sanity only, NOT the baseline)
     if _left() > 30:

@@ -11,6 +11,8 @@ sites in state_transition.py.
 
 from __future__ import annotations
 
+import numpy as np
+
 from .spec import FAR_FUTURE_EPOCH, ChainSpec
 from . import types as T
 from .ssz import seq_get_mut
@@ -43,20 +45,30 @@ def get_max_effective_balance(spec: ChainSpec, v) -> int:
 # ---------------------------------------------------------------- churn
 
 
-def get_balance_churn_limit(spec: ChainSpec, state) -> int:
+def get_balance_churn_limit(
+    spec: ChainSpec, state, total_active: int = None
+) -> int:
+    """`total_active` short-circuits the registry scan when the caller
+    (the columnar epoch pass) already holds the current-epoch active
+    balance — token-keyed caches miss on every registry mutation, so
+    per-ejection rescans would be O(n) each."""
     from . import state_transition as st
 
+    if total_active is None:
+        total_active = st.get_total_active_balance(spec, state)
     limit = max(
         spec.min_per_epoch_churn_limit_electra,
-        st.get_total_active_balance(spec, state) // spec.churn_limit_quotient,
+        total_active // spec.churn_limit_quotient,
     )
     return limit - limit % spec.effective_balance_increment
 
 
-def get_activation_exit_churn_limit(spec: ChainSpec, state) -> int:
+def get_activation_exit_churn_limit(
+    spec: ChainSpec, state, total_active: int = None
+) -> int:
     return min(
         spec.max_per_epoch_activation_exit_churn_limit,
-        get_balance_churn_limit(spec, state),
+        get_balance_churn_limit(spec, state, total_active=total_active),
     )
 
 
@@ -67,7 +79,7 @@ def get_consolidation_churn_limit(spec: ChainSpec, state) -> int:
 
 
 def compute_exit_epoch_and_update_churn(
-    spec: ChainSpec, state, exit_balance: int
+    spec: ChainSpec, state, exit_balance: int, per_epoch_churn: int = None
 ) -> int:
     """Balance-denominated exit queue (EIP-7251 replaces the per-
     validator churn with gwei churn)."""
@@ -78,7 +90,8 @@ def compute_exit_epoch_and_update_churn(
         ex.earliest_exit_epoch,
         st.get_current_epoch(spec, state) + 1 + spec.max_seed_lookahead,
     )
-    per_epoch_churn = get_activation_exit_churn_limit(spec, state)
+    if per_epoch_churn is None:
+        per_epoch_churn = get_activation_exit_churn_limit(spec, state)
     if ex.earliest_exit_epoch < earliest:
         balance_to_consume = per_epoch_churn
     else:
@@ -127,13 +140,15 @@ def compute_consolidation_epoch_and_update_churn(
     return earliest
 
 
-def initiate_validator_exit(spec: ChainSpec, state, index: int) -> None:
+def initiate_validator_exit(
+    spec: ChainSpec, state, index: int, per_epoch_churn: int = None
+) -> None:
     """Electra initiate_validator_exit: balance-churned queue."""
     v = state.validators[index]
     if v.exit_epoch != FAR_FUTURE_EPOCH:
         return
     exit_epoch = compute_exit_epoch_and_update_churn(
-        spec, state, v.effective_balance
+        spec, state, v.effective_balance, per_epoch_churn=per_epoch_churn
     )
     v = seq_get_mut(state.validators, index)  # CoW: never leak to copies
     v.exit_epoch = exit_epoch
@@ -327,7 +342,9 @@ def process_execution_requests(spec: ChainSpec, state, requests, ctx) -> None:
 # ------------------------------------------------------------ epoch passes
 
 
-def process_pending_deposits(spec: ChainSpec, state, ctx=None) -> None:
+def process_pending_deposits(
+    spec: ChainSpec, state, ctx=None, total_active: int = None
+) -> None:
     """Apply queued deposits under the gwei activation churn — spec-exact
     electra branches (single_pass.rs electra pending-deposit arm):
 
@@ -345,7 +362,7 @@ def process_pending_deposits(spec: ChainSpec, state, ctx=None) -> None:
     ex = state.electra
     next_epoch = st.get_current_epoch(spec, state) + 1
     available = (
-        get_activation_exit_churn_limit(spec, state)
+        get_activation_exit_churn_limit(spec, state, total_active=total_active)
         + ex.deposit_balance_to_consume
     )
     finalized_slot = st.compute_start_slot_at_epoch(
@@ -448,50 +465,73 @@ def process_pending_consolidations(spec: ChainSpec, state) -> None:
         ex.pending_consolidations = list(ex.pending_consolidations)[done:]
 
 
-def process_effective_balance_updates(spec: ChainSpec, state) -> None:
-    """Electra variant: per-validator cap (compounding -> 2048 ETH)."""
-    hysteresis_increment = spec.effective_balance_increment // 4
-    downward = hysteresis_increment
-    upward = hysteresis_increment * 2
-    for i, v in enumerate(state.validators):
-        balance = state.balances[i]
-        cap = get_max_effective_balance(spec, v)
-        if (
-            balance + downward < v.effective_balance
-            or v.effective_balance + upward < balance
-        ):
-            seq_get_mut(state.validators, i).effective_balance = min(
-                balance - balance % spec.effective_balance_increment, cap
-            )
-
-
-def process_registry_updates(spec: ChainSpec, state) -> None:
-    """Electra variant: eligibility at MIN_ACTIVATION_BALANCE; the
-    activation queue is churn-free (the gwei churn already ran at the
-    pending-deposit stage)."""
+def process_effective_balance_updates(spec: ChainSpec, state, cols=None) -> None:
+    """Electra variant: per-validator cap (compounding -> 2048 ETH);
+    the masked hysteresis decision + writeback are shared with the
+    phase0 arm."""
     from . import state_transition as st
 
+    cols = cols or st.EpochColumns(state)
+    cap = np.where(
+        cols.compounding,
+        np.int64(spec.max_effective_balance_electra),
+        np.int64(spec.min_activation_balance),
+    )
+    st.apply_effective_balance_hysteresis(spec, state, cols, cap)
+
+
+def process_registry_updates(
+    spec: ChainSpec, state, cols=None, total_active: int = None
+) -> None:
+    """Electra variant: eligibility at MIN_ACTIVATION_BALANCE; the
+    activation queue is churn-free (the gwei churn already ran at the
+    pending-deposit stage). Mask scans over the epoch columns; the
+    balance-churned exit queue runs per ejected index with the churn
+    limit resolved once."""
+    from . import state_transition as st
+
+    cols = cols or st.EpochColumns(state)
     cur = st.get_current_epoch(spec, state)
-    for i, v in enumerate(state.validators):
-        if (
-            v.activation_eligibility_epoch == FAR_FUTURE_EPOCH
-            and v.effective_balance >= spec.min_activation_balance
-        ):
-            v = seq_get_mut(state.validators, i)
-            v.activation_eligibility_epoch = cur + 1
-        if (
-            st.is_active_validator(v, cur)
-            and v.effective_balance <= spec.ejection_balance
-        ):
-            initiate_validator_exit(spec, state, i)
-        if (
-            v.activation_epoch == FAR_FUTURE_EPOCH
-            and v.activation_eligibility_epoch
-            <= state.finalized_checkpoint.epoch
-        ):
-            seq_get_mut(state.validators, i).activation_epoch = (
-                cur + 1 + spec.max_seed_lookahead
+    clamp = st._EPOCH_CLAMP
+    elig_idx = np.nonzero(
+        (cols.eligibility == clamp)
+        & (cols.eff >= spec.min_activation_balance)
+    )[0]
+    for i in elig_idx:
+        seq_get_mut(state.validators, int(i)).activation_eligibility_epoch = (
+            cur + 1
+        )
+    active_cur = (cols.activation <= cur) & (cur < cols.exit_epoch)
+    eject_idx = np.nonzero(
+        active_cur
+        & (cols.eff <= spec.ejection_balance)
+        & (cols.exit_epoch == clamp)
+    )[0]
+    if len(eject_idx):
+        per_epoch_churn = get_activation_exit_churn_limit(
+            spec, state, total_active=total_active
+        )
+        for i in eject_idx:
+            initiate_validator_exit(
+                spec, state, int(i), per_epoch_churn=per_epoch_churn
             )
+    # re-read eligibility after the eligibility writes above (dirty
+    # chunks only): the one-pass spec loop sees its own eligibility
+    # updates when checking activation. Ejections never touch
+    # eligibility, so they don't force a rebuild.
+    elig = (
+        st.EpochColumns(state).eligibility
+        if len(elig_idx)
+        else cols.eligibility
+    )
+    act_idx = np.nonzero(
+        (cols.activation == clamp)
+        & (elig <= int(state.finalized_checkpoint.epoch))
+    )[0]
+    for i in act_idx:
+        seq_get_mut(state.validators, int(i)).activation_epoch = (
+            cur + 1 + spec.max_seed_lookahead
+        )
 
 
 # ------------------------------------------------------------ withdrawals

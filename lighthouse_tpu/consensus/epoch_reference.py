@@ -1,0 +1,278 @@
+"""Scalar reference epoch transition (ISSUE 6 differential oracle).
+
+A spec-literal, per-validator-Python-loop implementation of
+`process_epoch`, retained so the columnar/fused path in
+state_transition.py + ops/epoch.py can be differentially tested against
+an implementation with no numpy in the per-validator math
+(tests/test_epoch_columnar.py asserts bit-identical post-states and
+hash_tree_root on randomized states).
+
+Deliberately NOT performance-relevant: it exists to be obviously
+correct. Shared stages with no per-validator loop (justification,
+resets, participation rotation, sync-committee updates, electra
+pending-deposit/consolidation queues — all already scalar) are reused
+from state_transition/electra so the diff isolates exactly the stages
+the columnar path rewrote."""
+
+from __future__ import annotations
+
+from .spec import FAR_FUTURE_EPOCH, GENESIS_EPOCH, ChainSpec
+from .ssz import seq_get_mut
+from . import state_transition as st
+from . import electra as el
+
+
+def _eligible_indices(spec: ChainSpec, state) -> list:
+    prev = st.get_previous_epoch(spec, state)
+    out = []
+    for i, v in enumerate(state.validators):
+        if st.is_active_validator(v, prev) or (
+            v.slashed and prev + 1 < v.withdrawable_epoch
+        ):
+            out.append(i)
+    return out
+
+
+def process_inactivity_updates(spec: ChainSpec, state) -> None:
+    if st.get_current_epoch(spec, state) == GENESIS_EPOCH:
+        return
+    prev = st.get_previous_epoch(spec, state)
+    leak = st.is_in_inactivity_leak(spec, state)
+    scores = list(state.inactivity_scores)
+    for i in _eligible_indices(spec, state):
+        v = state.validators[i]
+        participated_target = (
+            st.is_active_validator(v, prev)
+            and not v.slashed
+            and (
+                state.previous_epoch_participation[i]
+                & (1 << st.TIMELY_TARGET_FLAG_INDEX)
+            )
+        )
+        if participated_target:
+            scores[i] -= min(1, scores[i])
+        else:
+            scores[i] += st.INACTIVITY_SCORE_BIAS
+        if not leak:
+            scores[i] -= min(st.INACTIVITY_SCORE_RECOVERY_RATE, scores[i])
+    state.inactivity_scores = scores
+
+
+def process_rewards_and_penalties(
+    spec: ChainSpec, state, flag_balances_prev, total_active: int
+) -> None:
+    if st.get_current_epoch(spec, state) == GENESIS_EPOCH:
+        return
+    prev = st.get_previous_epoch(spec, state)
+    inc = spec.effective_balance_increment
+    base_reward_per_inc = (
+        inc * spec.base_reward_factor // st._integer_sqrt(total_active)
+    )
+    total_active_increments = total_active // inc
+    leak = st.is_in_inactivity_leak(spec, state)
+    deltas = [0] * len(state.validators)
+    for i in _eligible_indices(spec, state):
+        v = state.validators[i]
+        base_reward = (v.effective_balance // inc) * base_reward_per_inc
+        unslashed_prev = st.is_active_validator(v, prev) and not v.slashed
+        part = state.previous_epoch_participation[i]
+        for flag_index, weight in enumerate(st.PARTICIPATION_FLAG_WEIGHTS):
+            has_flag = unslashed_prev and (part & (1 << flag_index))
+            if has_flag:
+                if not leak:
+                    unslashed_increments = flag_balances_prev[flag_index] // inc
+                    deltas[i] += (
+                        base_reward * weight * unslashed_increments
+                        // (total_active_increments * st.WEIGHT_DENOMINATOR)
+                    )
+            elif flag_index != st.TIMELY_HEAD_FLAG_INDEX:
+                deltas[i] -= base_reward * weight // st.WEIGHT_DENOMINATOR
+        has_target = unslashed_prev and (
+            part & (1 << st.TIMELY_TARGET_FLAG_INDEX)
+        )
+        if not has_target:
+            deltas[i] -= (
+                v.effective_balance
+                * state.inactivity_scores[i]
+                // (st.INACTIVITY_SCORE_BIAS * st.INACTIVITY_PENALTY_QUOTIENT)
+            )
+    for i, d in enumerate(deltas):
+        if d:
+            state.balances[i] = max(0, state.balances[i] + d)
+
+
+def _initiate_validator_exit_scalar(spec: ChainSpec, state, index: int) -> None:
+    """Phase0 initiate_validator_exit with the literal O(n) rescan."""
+    v = state.validators[index]
+    if v.exit_epoch != FAR_FUTURE_EPOCH:
+        return
+    exit_epochs = [
+        w.exit_epoch
+        for w in state.validators
+        if w.exit_epoch != FAR_FUTURE_EPOCH
+    ]
+    activation_exit = (
+        st.get_current_epoch(spec, state) + 1 + spec.max_seed_lookahead
+    )
+    exit_queue_epoch = max(exit_epochs + [activation_exit])
+    churn = len(
+        [w for w in state.validators if w.exit_epoch == exit_queue_epoch]
+    )
+    if churn >= st.get_validator_churn_limit(spec, state):
+        exit_queue_epoch += 1
+    v = seq_get_mut(state.validators, index)
+    v.exit_epoch = exit_queue_epoch
+    v.withdrawable_epoch = (
+        exit_queue_epoch + spec.min_validator_withdrawability_delay
+    )
+
+
+def process_registry_updates(spec: ChainSpec, state) -> None:
+    cur = st.get_current_epoch(spec, state)
+    for i, v in enumerate(state.validators):
+        if (
+            v.activation_eligibility_epoch == FAR_FUTURE_EPOCH
+            and v.effective_balance == spec.max_effective_balance
+        ):
+            seq_get_mut(state.validators, i).activation_eligibility_epoch = (
+                cur + 1
+            )
+        if (
+            st.is_active_validator(v, cur)
+            and v.effective_balance <= spec.ejection_balance
+        ):
+            _initiate_validator_exit_scalar(spec, state, i)
+    queue = sorted(
+        (
+            i
+            for i, v in enumerate(state.validators)
+            if v.activation_eligibility_epoch
+            <= state.finalized_checkpoint.epoch
+            and v.activation_epoch == FAR_FUTURE_EPOCH
+        ),
+        key=lambda i: (
+            state.validators[i].activation_eligibility_epoch,
+            i,
+        ),
+    )
+    for i in queue[: st.get_validator_churn_limit(spec, state)]:
+        seq_get_mut(state.validators, i).activation_epoch = (
+            cur + 1 + spec.max_seed_lookahead
+        )
+
+
+def process_registry_updates_electra(spec: ChainSpec, state) -> None:
+    cur = st.get_current_epoch(spec, state)
+    for i, v in enumerate(state.validators):
+        if (
+            v.activation_eligibility_epoch == FAR_FUTURE_EPOCH
+            and v.effective_balance >= spec.min_activation_balance
+        ):
+            seq_get_mut(state.validators, i).activation_eligibility_epoch = (
+                cur + 1
+            )
+        if (
+            st.is_active_validator(v, cur)
+            and v.effective_balance <= spec.ejection_balance
+        ):
+            el.initiate_validator_exit(spec, state, i)
+        if (
+            v.activation_epoch == FAR_FUTURE_EPOCH
+            and v.activation_eligibility_epoch
+            <= state.finalized_checkpoint.epoch
+        ):
+            seq_get_mut(state.validators, i).activation_epoch = (
+                cur + 1 + spec.max_seed_lookahead
+            )
+
+
+def process_slashings(spec: ChainSpec, state, total_active: int) -> None:
+    epoch = st.get_current_epoch(spec, state)
+    total_slashings = sum(state.slashings)
+    adjusted = min(
+        total_slashings * st.PROPORTIONAL_SLASHING_MULTIPLIER, total_active
+    )
+    for i, v in enumerate(state.validators):
+        if (
+            v.slashed
+            and epoch + spec.preset.epochs_per_slashings_vector // 2
+            == v.withdrawable_epoch
+        ):
+            increment = spec.effective_balance_increment
+            penalty_numerator = v.effective_balance // increment * adjusted
+            penalty = penalty_numerator // total_active * increment
+            st.decrease_balance(state, i, penalty)
+
+
+def process_effective_balance_updates(
+    spec: ChainSpec, state, electra: bool
+) -> None:
+    hysteresis_increment = spec.effective_balance_increment // 4
+    downward = hysteresis_increment
+    upward = hysteresis_increment * 2
+    for i, v in enumerate(state.validators):
+        balance = state.balances[i]
+        cap = (
+            el.get_max_effective_balance(spec, v)
+            if electra
+            else spec.max_effective_balance
+        )
+        if (
+            balance + downward < v.effective_balance
+            or v.effective_balance + upward < balance
+        ):
+            seq_get_mut(state.validators, i).effective_balance = min(
+                balance - balance % spec.effective_balance_increment, cap
+            )
+
+
+def process_epoch_scalar(spec: ChainSpec, state) -> None:
+    """The full boundary, spec order, all-scalar hot stages."""
+    cur = st.get_current_epoch(spec, state)
+    prev = st.get_previous_epoch(spec, state)
+    total_active = 0
+    for v in state.validators:
+        if st.is_active_validator(v, cur):
+            total_active += v.effective_balance
+    total_active = max(total_active, spec.effective_balance_increment)
+    flag_balances_prev = [0, 0, 0]
+    target_balance_cur = 0
+    for i, v in enumerate(state.validators):
+        if v.slashed:
+            continue
+        if st.is_active_validator(v, prev):
+            part = state.previous_epoch_participation[i]
+            for f in range(3):
+                if part & (1 << f):
+                    flag_balances_prev[f] += v.effective_balance
+        if st.is_active_validator(v, cur):
+            if state.current_epoch_participation[i] & (
+                1 << st.TIMELY_TARGET_FLAG_INDEX
+            ):
+                target_balance_cur += v.effective_balance
+
+    st.process_justification_and_finalization(
+        spec,
+        state,
+        total_active,
+        flag_balances_prev[st.TIMELY_TARGET_FLAG_INDEX],
+        target_balance_cur,
+    )
+    process_inactivity_updates(spec, state)
+    process_rewards_and_penalties(spec, state, flag_balances_prev, total_active)
+    electra_active = spec.electra_enabled(cur)
+    if electra_active:
+        process_registry_updates_electra(spec, state)
+    else:
+        process_registry_updates(spec, state)
+    process_slashings(spec, state, total_active)
+    st.process_eth1_data_reset(spec, state)
+    if electra_active:
+        el.process_pending_deposits(spec, state)
+        el.process_pending_consolidations(spec, state)
+    process_effective_balance_updates(spec, state, electra_active)
+    st.process_slashings_reset(spec, state)
+    st.process_randao_mixes_reset(spec, state)
+    st.process_historical_roots_update(spec, state)
+    st.process_participation_flag_updates(state)
+    st.process_sync_committee_updates(spec, state)

@@ -23,6 +23,8 @@ import itertools
 from dataclasses import dataclass
 from typing import Any, Sequence
 
+import numpy as np
+
 BYTES_PER_CHUNK = 32
 OFFSET_SIZE = 4
 
@@ -402,6 +404,23 @@ def _deserialize_seq(elem: SSZType, data: bytes):
 #   - the content token (seq_token) is equal across copies until one
 #     side mutates: equal tokens imply identical content, which keys the
 #     state_transition active-set / committee caches safely.
+#
+# Column-cache contract (ISSUE 6, the ChunkedSeq→columnar bridge):
+#   - columns(name, builder) materializes numpy columns of the
+#     sequence ONCE and refreshes only chunks whose per-chunk version
+#     changed since the cached build — mutation cost O(dirty chunks),
+#     not O(n). Returned arrays are READ-ONLY (writeable=False); callers
+#     copy (e.g. .astype) before doing math in place.
+#   - copy() shares the column cache both ways (arrays are immutable);
+#     each side refreshes independently against its own chunk versions.
+#   - in-place element mutation must FINISH before the next column
+#     read: get_mut bumps the chunk version at fetch time, so a write
+#     applied after a later column refresh would go unseen.
+#   - assign_array(arr) is the bulk writeback: it diffs `arr` against
+#     the current content per chunk, CoWs + rewrites only the chunks
+#     that actually changed (token and merkle root caches invalidate
+#     for exactly those), and re-seeds the identity column cache with
+#     `arr` itself — ownership of `arr` transfers to the sequence.
 
 _TOKEN_COUNTER = itertools.count(1)
 
@@ -418,6 +437,8 @@ class ChunkedSeq:
         "_root_elem",
         "_elem",
         "_token",
+        "_versions",
+        "_cols",
     )
 
     def __init__(self, values=(), elem: SSZType = None):
@@ -434,6 +455,10 @@ class ChunkedSeq:
         self._root_elem = None
         self._elem = elem
         self._token = next(_TOKEN_COUNTER)
+        # per-chunk mutation counters keying the column cache
+        self._versions = [0] * len(self._chunks)
+        # name -> (tuple of np arrays, versions snapshot, length)
+        self._cols = {}
 
     # ------------------------------------------------------------ sharing
 
@@ -450,6 +475,8 @@ class ChunkedSeq:
         new._root_elem = self._root_elem
         new._elem = self._elem
         new._token = self._token
+        new._versions = list(self._versions)
+        new._cols = dict(self._cols)  # arrays are read-only: share both ways
         return new
 
     @property
@@ -464,6 +491,7 @@ class ChunkedSeq:
             self._owned_elems[ci] = set()
         self._roots[ci] = None
         self._token = next(_TOKEN_COUNTER)
+        self._versions[ci] += 1
         return self._chunks[ci]
 
     def get_mut(self, i: int):
@@ -528,6 +556,7 @@ class ChunkedSeq:
             self._owned.add(ci)
             self._owned_elems[ci] = {0}
             self._token = next(_TOKEN_COUNTER)
+            self._versions.append(0)
         self._len += 1
 
     def __eq__(self, other):
@@ -545,6 +574,98 @@ class ChunkedSeq:
             f"<ChunkedSeq len={self._len} chunks={len(self._chunks)} "
             f"token={self._token}>"
         )
+
+    # ------------------------------------------------------ column caching
+
+    def columns(self, name: str, builder) -> tuple:
+        """Materialize numpy columns of this sequence, cached under
+        `name` and refreshed per dirty chunk.
+
+        `builder(values) -> tuple of arrays (one row per element)` is
+        called per chunk on refresh (and with the full value list by
+        the plain-list fallback in `seq_columns`); it must handle an
+        empty list, and its arity fixes the column count. Returned
+        arrays are read-only."""
+        cur = tuple(self._versions)
+        hit = self._cols.get(name)
+        old = vers = None
+        length = 0
+        if hit is not None:
+            old, vers, length = hit
+            if length == self._len and vers == cur:
+                return old
+        if not self._chunks:
+            arrs = builder([])
+            for a in arrs:
+                a.flags.writeable = False
+            self._cols[name] = (arrs, cur, 0)
+            return arrs
+        outs = None
+        for ci, chunk in enumerate(self._chunks):
+            lo = ci * CHUNK_ELEMS
+            hi = lo + len(chunk)
+            clean = (
+                old is not None
+                and ci < len(vers)
+                and vers[ci] == cur[ci]
+                and hi <= length
+            )
+            if clean:
+                if outs is not None:
+                    for k, out in enumerate(outs):
+                        out[lo:hi] = old[k][lo:hi]
+                continue
+            part = builder(chunk)
+            if outs is None:
+                outs = tuple(
+                    np.empty(self._len, dtype=p.dtype) for p in part
+                )
+                if lo:  # backfill the clean prefix we skipped
+                    for k, out in enumerate(outs):
+                        out[:lo] = old[k][:lo]
+            for k, out in enumerate(outs):
+                out[lo:hi] = part[k]
+        if outs is None:  # all chunks clean yet cache key missed
+            outs = tuple(a[: self._len].copy() for a in old)
+        for a in outs:
+            a.flags.writeable = False
+        self._cols[name] = (outs, cur, self._len)
+        return outs
+
+    def assign_array(self, arr: "np.ndarray") -> int:
+        """Bulk scalar writeback: make this sequence's content equal to
+        `arr`, copying-on-write only the chunks that differ. Ownership
+        of `arr` transfers to the sequence (it becomes the cached
+        identity column and is frozen read-only). Returns the number of
+        chunks rewritten — 0 leaves token and root caches untouched."""
+        if len(arr) != self._len:
+            raise ValueError(
+                f"assign_array length {len(arr)} != seq length {self._len}"
+            )
+        name = f"id:{arr.dtype.name}"
+        hit = self._cols.get(name)
+        cur = tuple(self._versions)
+        prev = None
+        if hit is not None and hit[2] == self._len and hit[1] == cur:
+            prev = hit[0][0]
+        dirty = 0
+        for ci, chunk in enumerate(self._chunks):
+            lo = ci * CHUNK_ELEMS
+            hi = lo + len(chunk)
+            seg = arr[lo:hi]
+            ref = (
+                prev[lo:hi]
+                if prev is not None
+                else np.asarray(chunk, dtype=arr.dtype)
+            )
+            if np.array_equal(seg, ref):
+                continue
+            self._own_chunk(ci)
+            self._chunks[ci][:] = seg.tolist()
+            dirty += 1
+        arr.flags.writeable = False
+        self._cols[name] = ((arr,), tuple(self._versions), self._len)
+        return dirty
 
     # -------------------------------------------------------- root caching
 
@@ -573,6 +694,48 @@ def seq_get_mut(seq, i: int):
     if isinstance(seq, ChunkedSeq):
         return seq.get_mut(i)
     return seq[i]
+
+
+def seq_column(seq, dtype) -> "np.ndarray":
+    """Read-only numpy identity column of a scalar sequence. Cached per
+    dirty chunk on a ChunkedSeq; rebuilt per call on a plain list."""
+    dt = np.dtype(dtype)
+    if isinstance(seq, ChunkedSeq):
+
+        def build(vals, _dt=dt):
+            return (np.asarray(vals, dtype=_dt),)
+
+        return seq.columns(f"id:{dt.name}", build)[0]
+    vals = seq if isinstance(seq, list) else list(seq)
+    return np.asarray(vals, dtype=dt)
+
+
+def seq_columns(seq, name: str, builder) -> tuple:
+    """Derived numpy columns of a sequence (e.g. several validator
+    fields in one pass). Cached per dirty chunk on a ChunkedSeq;
+    rebuilt per call on a plain list."""
+    if isinstance(seq, ChunkedSeq):
+        return seq.columns(name, builder)
+    vals = seq if isinstance(seq, list) else list(seq)
+    return builder(vals)
+
+
+def seq_assign_array(seq, arr, dtype=None) -> int:
+    """Bulk scalar writeback of a numpy column into `seq` — the API
+    that replaces `state.field = [int(x) for x in arr]` scalarization.
+    ChunkedSeq: CoW + token/root invalidation only for changed chunks
+    (ownership of `arr` transfers, see ChunkedSeq.assign_array). Plain
+    list: slice-assigned in place. Returns changed-chunk count (plain
+    lists report 1)."""
+    arr = np.ascontiguousarray(arr, dtype=None if dtype is None else np.dtype(dtype))
+    if isinstance(seq, ChunkedSeq):
+        return seq.assign_array(arr)
+    if len(arr) != len(seq):
+        raise ValueError(
+            f"assign_array length {len(arr)} != seq length {len(seq)}"
+        )
+    seq[:] = arr.tolist()
+    return 1
 
 
 def _chunk_depth(elem: SSZType) -> int:

@@ -25,16 +25,27 @@ individual check path for `verify_signatures=True`.
 from __future__ import annotations
 
 import hashlib
+import operator
 import threading
+import time
+from contextlib import contextmanager
 from typing import Iterable, Optional
 
 import numpy as np
 
 from ..common import metrics as _metrics
+from ..common import tracing as _tracing
+from ..ops import epoch as _epoch_ops
 from ..crypto import bls
 from ..crypto.bls.keys import PublicKey, Signature, SignatureSet
 from . import types as T
-from .ssz import seq_get_mut, seq_token
+from .ssz import (
+    seq_assign_array,
+    seq_column,
+    seq_columns,
+    seq_get_mut,
+    seq_token,
+)
 from .domains import compute_domain, compute_signing_root, get_domain
 from .shuffling import compute_committee, compute_shuffled_index
 from .spec import ChainSpec, FAR_FUTURE_EPOCH, GENESIS_EPOCH, GENESIS_SLOT
@@ -1356,98 +1367,313 @@ def process_sync_aggregate(
 
 # ---------------------------------------------------------------- epoch
 #
-# One vectorized pass (single_pass.rs analog): arrays in, arrays out.
+# Columnar epoch transition (ISSUE 6): per-validator columns come from
+# the ChunkedSeq column-cache bridge (one pass over dirty chunks, not
+# O(n) np.fromiter rebuilds per call), the whole balance pipeline runs
+# as ONE fused program (ops/epoch.py — jitted when JAX reproduces the
+# numpy outputs bit-identically), and writebacks go through
+# seq_assign_array so only changed chunks re-own and re-hash. Each
+# stage records `epoch:<stage>` spans + the
+# state_epoch_stage_seconds{stage=} histogram so slot timelines
+# attribute the boundary (single_pass.rs analog, SoA-batch shaped).
+
+_EPOCH_CLAMP = 2**62  # FAR_FUTURE_EPOCH sentinel clamp for int64 math
+
+# ops/epoch.py carries private copies of these constants so it stays
+# importable standalone; a fork bumping one here must reach the fused
+# program, so divergence fails at import, not at differential-test
+# time (explicit raise, not assert: python -O must not void this)
+if (
+    _epoch_ops.WEIGHTS != tuple(PARTICIPATION_FLAG_WEIGHTS)
+    or _epoch_ops.WEIGHT_DENOMINATOR != WEIGHT_DENOMINATOR
+    or _epoch_ops.TIMELY_TARGET_FLAG_INDEX != TIMELY_TARGET_FLAG_INDEX
+    or _epoch_ops.TIMELY_HEAD_FLAG_INDEX != TIMELY_HEAD_FLAG_INDEX
+    or _epoch_ops.INACTIVITY_SCORE_BIAS != INACTIVITY_SCORE_BIAS
+    or _epoch_ops.INACTIVITY_SCORE_RECOVERY_RATE
+    != INACTIVITY_SCORE_RECOVERY_RATE
+    or _epoch_ops.INACTIVITY_PENALTY_QUOTIENT
+    != INACTIVITY_PENALTY_QUOTIENT
+):
+    raise ImportError(
+        "ops/epoch.py participation/inactivity constants diverge from "
+        "consensus/state_transition.py — the fused epoch program would "
+        "compute stale rewards/penalties"
+    )
+
+_M_EPOCH_STAGE = _metrics.histogram(
+    "state_epoch_stage_seconds",
+    "Epoch-transition wall time by processing stage",
+    labelnames=("stage",),
+)
+
+
+@contextmanager
+def _epoch_stage(name: str):
+    t0 = time.perf_counter()
+    with _tracing.span(f"epoch:{name}"):
+        yield
+    _M_EPOCH_STAGE.labels(stage=name).observe(time.perf_counter() - t0)
+
+
+_VALIDATOR_COLS_KEY = "validator_epoch_cols"
+_VGET = operator.itemgetter(
+    "effective_balance",
+    "slashed",
+    "activation_epoch",
+    "exit_epoch",
+    "withdrawable_epoch",
+    "activation_eligibility_epoch",
+    "withdrawal_credentials",
+)
+
+
+def _validator_columns_builder(chunk) -> tuple:
+    """One pass over a validator chunk -> 7 columns (the seq_columns
+    builder): eff u64, slashed bool, activation/exit/withdrawable/
+    eligibility epochs clamped to int64, compounding-creds bool."""
+    if not chunk:
+        z = np.empty(0, np.int64)
+        return (
+            np.empty(0, np.uint64),
+            np.empty(0, np.bool_),
+            z,
+            z.copy(),
+            z.copy(),
+            z.copy(),
+            np.empty(0, np.bool_),
+        )
+    rows = [_VGET(v._vals) for v in chunk]
+    eff, sl, act, ex, wd, el, wc = zip(*rows)
+
+    def clamp(vals):
+        return np.minimum(
+            np.asarray(vals, np.uint64), np.uint64(_EPOCH_CLAMP)
+        ).astype(np.int64)
+
+    return (
+        np.asarray(eff, np.uint64),
+        np.asarray(sl, np.bool_),
+        clamp(act),
+        clamp(ex),
+        clamp(wd),
+        clamp(el),
+        np.asarray([w[0] == 2 for w in wc], np.bool_),
+    )
+
+
+class EpochColumns:
+    """Every per-validator column one epoch transition reads, built
+    once through the token-keyed column cache and threaded down all
+    stages — no stage re-derives slashed/withdrawable/... on its own.
+    Arrays are read-only; epoch values are clamped at 2**62 so
+    FAR_FUTURE_EPOCH compares as `== _EPOCH_CLAMP`."""
+
+    __slots__ = (
+        "n",
+        "eff",
+        "slashed",
+        "activation",
+        "exit_epoch",
+        "withdrawable",
+        "eligibility",
+        "compounding",
+        "prev_part",
+        "cur_part",
+        "balances",
+        "inactivity",
+    )
+
+    def __init__(self, state):
+        (
+            self.eff,
+            self.slashed,
+            self.activation,
+            self.exit_epoch,
+            self.withdrawable,
+            self.eligibility,
+            self.compounding,
+        ) = seq_columns(
+            state.validators, _VALIDATOR_COLS_KEY, _validator_columns_builder
+        )
+        self.n = len(self.eff)
+        self.prev_part = seq_column(
+            state.previous_epoch_participation, np.uint8
+        )
+        self.cur_part = seq_column(state.current_epoch_participation, np.uint8)
+        self.balances = seq_column(state.balances, np.uint64)
+        self.inactivity = seq_column(state.inactivity_scores, np.uint64)
 
 
 def _epoch_arrays(state):
-    """Extract the per-validator columns once."""
-    n = len(state.validators)
-    eff = np.fromiter(
-        (v.effective_balance for v in state.validators), np.uint64, n
+    """Back-compat 7-tuple view over EpochColumns (http_api rewards
+    endpoints consume this shape)."""
+    c = EpochColumns(state)
+    return (
+        c.eff,
+        c.slashed,
+        c.activation,
+        c.exit_epoch,
+        c.withdrawable,
+        c.prev_part,
+        c.cur_part,
     )
-    slashed = np.fromiter((v.slashed for v in state.validators), np.bool_, n)
-    act = np.fromiter(
-        (min(v.activation_epoch, 2**62) for v in state.validators), np.int64, n
-    )
-    exit_e = np.fromiter(
-        (min(v.exit_epoch, 2**62) for v in state.validators), np.int64, n
-    )
-    withdrawable = np.fromiter(
-        (min(v.withdrawable_epoch, 2**62) for v in state.validators), np.int64, n
-    )
-    prev_part = np.fromiter(state.previous_epoch_participation, np.uint8, n)
-    cur_part = np.fromiter(state.current_epoch_participation, np.uint8, n)
-    return eff, slashed, act, exit_e, withdrawable, prev_part, cur_part
+
+
+def _slashing_penalties(
+    spec: ChainSpec, state, total_active: int, cols: EpochColumns, epoch: int
+) -> np.ndarray:
+    """Dense int64 slashing-penalty column (process_slashings): the
+    cohort whose withdrawable epoch sits at the half-vector point pays
+    proportionally. Per-index Python ints — the increments*adjusted
+    product can exceed int64 on pathological electra registries — over
+    a vectorized mask scan."""
+    vec = spec.preset.epochs_per_slashings_vector
+    out = np.zeros(cols.n, np.int64)
+    idx = np.nonzero(cols.slashed & (cols.withdrawable == epoch + vec // 2))[0]
+    if len(idx):
+        total_slashings = sum(int(s) for s in state.slashings)
+        adjusted = min(
+            total_slashings * PROPORTIONAL_SLASHING_MULTIPLIER, total_active
+        )
+        inc = spec.effective_balance_increment
+        for i in idx:
+            numerator = int(cols.eff[i]) // inc * adjusted
+            out[i] = numerator // total_active * inc
+    return out
 
 
 def process_epoch(spec: ChainSpec, state) -> None:
-    (
-        eff,
-        slashed,
-        act,
-        exit_e,
-        withdrawable,
-        prev_part,
-        cur_part,
-    ) = _epoch_arrays(state)
+    with _epoch_stage("columns"):
+        cols = EpochColumns(state)
     cur = get_current_epoch(spec, state)
     prev = get_previous_epoch(spec, state)
-    active_cur = (act <= cur) & (cur < exit_e)
-    active_prev = (act <= prev) & (prev < exit_e)
+    eff = cols.eff
+    active_cur = (cols.activation <= cur) & (cur < cols.exit_epoch)
+    active_prev = (cols.activation <= prev) & (prev < cols.exit_epoch)
+    unslashed_prev = active_prev & ~cols.slashed
+    unslashed_cur = active_cur & ~cols.slashed
+    inc = spec.effective_balance_increment
 
-    total_active = max(
-        int(eff[active_cur].sum()), spec.effective_balance_increment
-    )
+    with _epoch_stage("tallies"):
+        total_active = max(int(eff[active_cur].sum()), inc)
+        flag_balances_prev = [
+            int(eff[unslashed_prev & ((cols.prev_part & (1 << f)) != 0)].sum())
+            for f in range(3)
+        ]
+        target_balance_cur = int(
+            eff[
+                unslashed_cur
+                & ((cols.cur_part & (1 << TIMELY_TARGET_FLAG_INDEX)) != 0)
+            ].sum()
+        )
 
-    # participating (unslashed) balances per flag, previous epoch
-    unslashed_prev = active_prev & ~slashed
-    flag_balances_prev = [
-        int(eff[unslashed_prev & ((prev_part & (1 << f)) != 0)].sum())
-        for f in range(3)
-    ]
-    unslashed_cur = active_cur & ~slashed
-    target_balance_cur = int(
-        eff[unslashed_cur & ((cur_part & (1 << TIMELY_TARGET_FLAG_INDEX)) != 0)].sum()
-    )
+    with _epoch_stage("justification"):
+        process_justification_and_finalization(
+            spec,
+            state,
+            total_active,
+            flag_balances_prev[TIMELY_TARGET_FLAG_INDEX],
+            target_balance_cur,
+        )
 
-    process_justification_and_finalization(
-        spec,
-        state,
-        total_active,
-        flag_balances_prev[TIMELY_TARGET_FLAG_INDEX],
-        target_balance_cur,
-    )
-    process_inactivity_updates(spec, state, unslashed_prev, prev_part, active_prev)
-    process_rewards_and_penalties(
-        spec,
-        state,
-        eff,
-        active_prev,
-        unslashed_prev,
-        prev_part,
-        flag_balances_prev,
-        total_active,
-    )
+    with _epoch_stage("slashings"):
+        slash_penalty = _slashing_penalties(spec, state, total_active, cols, cur)
+
+    # Fused balance pipeline: inactivity scores + flag rewards/
+    # penalties + slashing application + hysteresis decision in one
+    # program. Exactness of the staging: registry updates never touch
+    # balances or effective balances, slashed validators' withdrawable
+    # epochs are fixed before registry runs (their exit was initiated
+    # at slashing time), and in the non-electra flow nothing between
+    # process_slashings and the effective-balance stage moves balances
+    # — so pre-stage columns feed every output bit-identically to the
+    # sequential spec ordering (differentially tested in
+    # tests/test_epoch_columnar.py).
+    with _epoch_stage("fused_math"):
+        eligible = active_prev | (
+            cols.slashed & (prev + 1 < cols.withdrawable)
+        )
+        arrays = {
+            "eff": eff.astype(np.int64),
+            "unslashed_prev": unslashed_prev,
+            "eligible": eligible,
+            "prev_part": cols.prev_part.astype(np.int64),
+            "scores": cols.inactivity.astype(np.int64),
+            "balances": cols.balances.astype(np.int64),
+            "slash_penalty": slash_penalty,
+        }
+        scalars = {
+            "do_deltas": np.bool_(cur != GENESIS_EPOCH),
+            "leak": np.bool_(is_in_inactivity_leak(spec, state)),
+            "base_reward_per_inc": np.int64(
+                inc * spec.base_reward_factor // _integer_sqrt(total_active)
+            ),
+            "total_active_increments": np.int64(total_active // inc),
+            "flag_inc_0": np.int64(flag_balances_prev[0] // inc),
+            "flag_inc_1": np.int64(flag_balances_prev[1] // inc),
+            "flag_inc_2": np.int64(flag_balances_prev[2] // inc),
+            "increment": np.int64(inc),
+            "cap": np.int64(spec.max_effective_balance),
+            "hysteresis_down": np.int64(inc // 4),
+            "hysteresis_up": np.int64(inc // 4 * 2),
+        }
+        # eff_new/eff_mask are the phase0 (flat-cap) hysteresis arm
+        # ONLY: electra must re-decide hysteresis AFTER pending
+        # deposits/consolidations move balances (spec stage order) and
+        # with per-validator caps, so the electra branch below discards
+        # these two outputs — a couple of elementwise ops inside an
+        # already-fused program, not a separate pass.
+        new_scores, new_balances, eff_new, eff_mask = _epoch_ops.epoch_updates(
+            arrays, scalars
+        )
+
+    with _epoch_stage("inactivity"):
+        seq_assign_array(
+            state.inactivity_scores, new_scores.astype(np.uint64)
+        )
+    with _epoch_stage("rewards_and_penalties"):
+        seq_assign_array(state.balances, new_balances.astype(np.uint64))
+
     electra_active = spec.electra_enabled(cur)
-    if electra_active:
-        from . import electra as _electra
+    with _epoch_stage("registry_updates"):
+        if electra_active:
+            from . import electra as _electra
 
-        _electra.process_registry_updates(spec, state)
-    else:
-        process_registry_updates(spec, state)
-    process_slashings_epoch(spec, state, total_active)
-    process_eth1_data_reset(spec, state)
+            _electra.process_registry_updates(
+                spec, state, cols=cols, total_active=total_active
+            )
+        else:
+            process_registry_updates(spec, state, cols=cols)
+
+    with _epoch_stage("eth1_reset"):
+        process_eth1_data_reset(spec, state)
+
     if electra_active:
-        _electra.process_pending_deposits(spec, state)
-        _electra.process_pending_consolidations(spec, state)
-        _electra.process_effective_balance_updates(spec, state)
+        with _epoch_stage("pending_deposits"):
+            _electra.process_pending_deposits(
+                spec, state, total_active=total_active
+            )
+        with _epoch_stage("pending_consolidations"):
+            _electra.process_pending_consolidations(spec, state)
+        with _epoch_stage("effective_balance"):
+            # fresh columns: pending deposits may have grown the
+            # registry and moved balances (dirty chunks only)
+            _electra.process_effective_balance_updates(spec, state)
     else:
-        process_effective_balance_updates(spec, state)
-    process_slashings_reset(spec, state)
-    process_randao_mixes_reset(spec, state)
-    process_historical_roots_update(spec, state)
-    process_participation_flag_updates(state)
-    process_sync_committee_updates(spec, state)
+        with _epoch_stage("effective_balance"):
+            for i in np.nonzero(eff_mask)[0]:
+                seq_get_mut(state.validators, int(i)).effective_balance = int(
+                    eff_new[i]
+                )
+
+    with _epoch_stage("resets"):
+        process_slashings_reset(spec, state)
+        process_randao_mixes_reset(spec, state)
+        process_historical_roots_update(spec, state)
+    with _epoch_stage("participation_rotation"):
+        process_participation_flag_updates(state)
+    with _epoch_stage("sync_committee"):
+        process_sync_committee_updates(spec, state)
 
 
 def process_justification_and_finalization(
@@ -1497,153 +1723,73 @@ def is_in_inactivity_leak(spec: ChainSpec, state) -> bool:
     )
 
 
-def process_inactivity_updates(
-    spec: ChainSpec, state, unslashed_prev, prev_part, active_prev
+def process_registry_updates(
+    spec: ChainSpec, state, cols: EpochColumns = None
 ) -> None:
-    if get_current_epoch(spec, state) == GENESIS_EPOCH:
-        return
-    scores = np.fromiter(
-        state.inactivity_scores, np.uint64, len(state.inactivity_scores)
-    ).astype(np.int64)
-    participated_target = unslashed_prev & (
-        (prev_part & (1 << TIMELY_TARGET_FLAG_INDEX)) != 0
-    )
-    eligible = active_prev | (
-        np.fromiter(
-            (v.slashed for v in state.validators), np.bool_, len(state.validators)
-        )
-        & (
-            get_previous_epoch(spec, state) + 1
-            < np.fromiter(
-                (min(v.withdrawable_epoch, 2**62) for v in state.validators),
-                np.int64,
-                len(state.validators),
-            )
-        )
-    )
-    delta = np.where(participated_target, -np.minimum(1, scores), INACTIVITY_SCORE_BIAS)
-    scores = np.where(eligible, scores + delta, scores)
-    if not is_in_inactivity_leak(spec, state):
-        scores = np.where(
-            eligible,
-            scores - np.minimum(INACTIVITY_SCORE_RECOVERY_RATE, scores),
-            scores,
-        )
-    state.inactivity_scores = [int(s) for s in scores]
-
-
-def process_rewards_and_penalties(
-    spec: ChainSpec,
-    state,
-    eff,
-    active_prev,
-    unslashed_prev,
-    prev_part,
-    flag_balances_prev,
-    total_active: int,
-) -> None:
-    if get_current_epoch(spec, state) == GENESIS_EPOCH:
-        return
-    n = len(state.validators)
-    balances = np.fromiter(state.balances, np.int64, n)
-    base_reward_per_inc = (
-        spec.effective_balance_increment
-        * spec.base_reward_factor
-        // _integer_sqrt(total_active)
-    )
-    increments = (eff // spec.effective_balance_increment).astype(np.int64)
-    base_rewards = increments * base_reward_per_inc
-    total_active_increments = total_active // spec.effective_balance_increment
-
-    # eligibility: active prev epoch, or slashed and not yet withdrawable
-    withdrawable = np.fromiter(
-        (min(v.withdrawable_epoch, 2**62) for v in state.validators), np.int64, n
-    )
-    slashed = np.fromiter((v.slashed for v in state.validators), np.bool_, n)
-    eligible = active_prev | (
-        slashed & (get_previous_epoch(spec, state) + 1 < withdrawable)
-    )
-
-    leak = is_in_inactivity_leak(spec, state)
-    delta = np.zeros(n, dtype=np.int64)
-    for flag_index, weight in enumerate(PARTICIPATION_FLAG_WEIGHTS):
-        has_flag = unslashed_prev & ((prev_part & (1 << flag_index)) != 0)
-        unslashed_increments = (
-            flag_balances_prev[flag_index] // spec.effective_balance_increment
-        )
-        reward_num = base_rewards * weight * unslashed_increments
-        rewards = reward_num // (total_active_increments * WEIGHT_DENOMINATOR)
-        if not leak:
-            delta = np.where(eligible & has_flag, delta + rewards, delta)
-        if flag_index != TIMELY_HEAD_FLAG_INDEX:
-            penalty = base_rewards * weight // WEIGHT_DENOMINATOR
-            delta = np.where(eligible & ~has_flag, delta - penalty, delta)
-
-    # inactivity penalties (target non-participants pay score-scaled)
-    scores = np.fromiter(state.inactivity_scores, np.uint64, n).astype(np.int64)
-    has_target = unslashed_prev & (
-        (prev_part & (1 << TIMELY_TARGET_FLAG_INDEX)) != 0
-    )
-    penalty_num = eff.astype(np.int64) * scores
-    penalty_den = INACTIVITY_SCORE_BIAS * INACTIVITY_PENALTY_QUOTIENT
-    inactivity_penalty = penalty_num // penalty_den
-    delta = np.where(eligible & ~has_target, delta - inactivity_penalty, delta)
-
-    balances = np.maximum(balances + delta, 0)
-    state.balances = [int(b) for b in balances]
-
-
-def process_registry_updates(spec: ChainSpec, state) -> None:
+    """Vectorized registry pass: mask scans over the epoch columns
+    replace the per-validator Python loop; the churn-limited exit queue
+    is replayed sequentially over just the ejected cohort (spec
+    initiate_validator_exit semantics, without its O(n) rescan per
+    ejection)."""
+    cols = cols or EpochColumns(state)
     cur = get_current_epoch(spec, state)
-    # eligibility + ejection
-    for i, v in enumerate(state.validators):
-        if (
-            v.activation_eligibility_epoch == FAR_FUTURE_EPOCH
-            and v.effective_balance == spec.max_effective_balance
-        ):
-            seq_get_mut(state.validators, i).activation_eligibility_epoch = (
-                cur + 1
-            )
-        if (
-            is_active_validator(v, cur)
-            and v.effective_balance <= spec.ejection_balance
-        ):
-            initiate_validator_exit(spec, state, i)
-    # activation queue, FIFO by (eligibility epoch, index), churn-limited
-    queue = sorted(
-        (
-            i
-            for i, v in enumerate(state.validators)
-            if v.activation_eligibility_epoch <= state.finalized_checkpoint.epoch
-            and v.activation_epoch == FAR_FUTURE_EPOCH
-        ),
-        key=lambda i: (
-            state.validators[i].activation_eligibility_epoch,
-            i,
-        ),
-    )
-    for i in queue[: get_validator_churn_limit(spec, state)]:
-        seq_get_mut(state.validators, i).activation_epoch = (
-            cur + 1 + spec.max_seed_lookahead
+    # eligibility scan
+    elig_idx = np.nonzero(
+        (cols.eligibility == _EPOCH_CLAMP)
+        & (cols.eff == spec.max_effective_balance)
+    )[0]
+    for i in elig_idx:
+        seq_get_mut(state.validators, int(i)).activation_eligibility_epoch = (
+            cur + 1
         )
-
-
-def process_slashings_epoch(spec: ChainSpec, state, total_active: int) -> None:
-    epoch = get_current_epoch(spec, state)
-    total_slashings = sum(state.slashings)
-    adjusted = min(
-        total_slashings * PROPORTIONAL_SLASHING_MULTIPLIER, total_active
+    # ejection sweep, ascending index order as the spec loop visits it
+    active_cur = (cols.activation <= cur) & (cur < cols.exit_epoch)
+    churn_limit = max(
+        spec.min_per_epoch_churn_limit,
+        int(active_cur.sum()) // spec.churn_limit_quotient,
     )
-    for i, v in enumerate(state.validators):
-        if (
-            v.slashed
-            and epoch + spec.preset.epochs_per_slashings_vector // 2
-            == v.withdrawable_epoch
-        ):
-            increment = spec.effective_balance_increment
-            penalty_numerator = v.effective_balance // increment * adjusted
-            penalty = penalty_numerator // total_active * increment
-            decrease_balance(state, i, penalty)
+    eject_idx = np.nonzero(
+        active_cur
+        & (cols.eff <= spec.ejection_balance)
+        & (cols.exit_epoch == _EPOCH_CLAMP)
+    )[0]
+    if len(eject_idx):
+        real_exits = cols.exit_epoch[cols.exit_epoch != _EPOCH_CLAMP]
+        queue_epoch = cur + 1 + spec.max_seed_lookahead
+        queue_churn = 0
+        if len(real_exits):
+            top = int(real_exits.max())
+            if top >= queue_epoch:
+                queue_epoch = top
+                queue_churn = int((real_exits == top).sum())
+        for i in eject_idx:
+            if queue_churn >= churn_limit:
+                queue_epoch += 1
+                queue_churn = 0
+            v = seq_get_mut(state.validators, int(i))
+            v.exit_epoch = queue_epoch
+            v.withdrawable_epoch = (
+                queue_epoch + spec.min_validator_withdrawability_delay
+            )
+            queue_churn += 1
+    # activation queue, FIFO by (eligibility epoch, index), churn-
+    # limited. Re-read eligibility after the eligibility writes above
+    # (dirty chunks only) so the queue sees exactly what the one-pass
+    # spec loop sees; ejections never touch eligibility, so they don't
+    # force a rebuild.
+    elig = (
+        EpochColumns(state).eligibility if len(elig_idx) else cols.eligibility
+    )
+    q_idx = np.nonzero(
+        (elig <= int(state.finalized_checkpoint.epoch))
+        & (cols.activation == _EPOCH_CLAMP)
+    )[0]
+    if len(q_idx):
+        order = q_idx[np.argsort(elig[q_idx], kind="stable")]
+        for i in order[:churn_limit]:
+            seq_get_mut(state.validators, int(i)).activation_epoch = (
+                cur + 1 + spec.max_seed_lookahead
+            )
 
 
 def process_eth1_data_reset(spec: ChainSpec, state) -> None:
@@ -1652,20 +1798,32 @@ def process_eth1_data_reset(spec: ChainSpec, state) -> None:
         state.eth1_data_votes = []
 
 
-def process_effective_balance_updates(spec: ChainSpec, state) -> None:
-    hysteresis_increment = spec.effective_balance_increment // 4
+def apply_effective_balance_hysteresis(spec: ChainSpec, state, cols, cap) -> None:
+    """Shared hysteresis pass (phase0 + electra): `cap` is a scalar
+    (flat MAX_EFFECTIVE_BALANCE) or a per-validator array (electra's
+    compounding-vs-eth1 caps); the masked decision and writeback are
+    identical either way."""
+    inc = spec.effective_balance_increment
+    hysteresis_increment = inc // 4
     downward = hysteresis_increment  # HYSTERESIS_DOWNWARD_MULTIPLIER = 1
     upward = hysteresis_increment * 2  # HYSTERESIS_UPWARD_MULTIPLIER = 2
-    for i, v in enumerate(state.validators):
-        balance = state.balances[i]
-        if (
-            balance + downward < v.effective_balance
-            or v.effective_balance + upward < balance
-        ):
-            seq_get_mut(state.validators, i).effective_balance = min(
-                balance - balance % spec.effective_balance_increment,
-                spec.max_effective_balance,
-            )
+    balances = cols.balances.astype(np.int64)
+    eff = cols.eff.astype(np.int64)
+    mask = ((balances + downward) < eff) | ((eff + upward) < balances)
+    new_eff = np.minimum(balances - balances % inc, cap)
+    for i in np.nonzero(mask)[0]:
+        seq_get_mut(state.validators, int(i)).effective_balance = int(
+            new_eff[i]
+        )
+
+
+def process_effective_balance_updates(
+    spec: ChainSpec, state, cols: EpochColumns = None
+) -> None:
+    cols = cols or EpochColumns(state)
+    apply_effective_balance_hysteresis(
+        spec, state, cols, spec.max_effective_balance
+    )
 
 
 def process_slashings_reset(spec: ChainSpec, state) -> None:

@@ -45,6 +45,16 @@ from .operation_pool import OperationPool
 from .store import HotColdDB
 
 
+# slot-tail pre-advance consumption at block import: a hit means the
+# state (epoch transition included at boundaries) was ready before the
+# block arrived — the overlap ISSUE 6 layer 3 pays for
+_M_ADVANCED_STATE = metrics.counter(
+    "beacon_chain_advanced_state_total",
+    "Block-import pre-advanced-state consumption by result",
+    labelnames=("result",),
+)
+
+
 class BlockError(Exception):
     pass
 
@@ -577,7 +587,19 @@ class BeaconChain:
                 raise BlockError("block from the future")
 
             slot = int(block.slot)
-            state = parent_state.copy()
+            # slot-tail overlap: when the parent is the head and the
+            # state_advance_timer already advanced it to this slot
+            # (crossing the epoch boundary at epoch tails), import
+            # against the ready state — process_slots (and the whole
+            # epoch transition) costs ~0 on the critical path
+            state = None
+            if parent_root == self.head.root:
+                state = self.take_advanced_state(slot)
+                _M_ADVANCED_STATE.labels(
+                    result="hit" if state is not None else "miss"
+                ).inc()
+            if state is None:
+                state = parent_state.copy()
             if state.slot < block.slot:
                 with tracing.span("block_slots_advance", slot=slot):
                     st.process_slots(self.spec, state, block.slot)

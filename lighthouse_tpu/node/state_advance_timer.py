@@ -6,6 +6,13 @@ block production, committee lookups after an epoch boundary — reads a
 ready state instead of paying process_slots on the critical path. The
 reference runs this 3/4 through the slot; here the client timer calls
 `on_slot_tail` and the chain consults `advanced_state`.
+
+On the LAST slot of an epoch the pre-advance carries the whole epoch
+transition (ISSUE 6 layer 3): process_slots crosses the boundary, so
+the columnar epoch program runs here — off the critical path — and the
+first block of the next epoch imports against a ready post-boundary
+state via BeaconChain.take_advanced_state. The epoch boundary then
+costs ~0 at import time.
 """
 
 from __future__ import annotations
@@ -15,6 +22,7 @@ import time
 from typing import Optional
 
 from ..common import logging as clog
+from ..common import tracing
 from ..consensus import state_transition as st
 
 log = clog.get_logger("state_advance")
@@ -43,18 +51,25 @@ class StateAdvanceTimer:
             return False
         # the copy is O(spine) under the CoW SSZ layer — the pre-advance
         # costs one empty-slot transition, not a registry-sized rebuild
+        spe = chain.spec.preset.slots_per_epoch
+        crosses_epoch = target % spe == 0
         t0 = time.perf_counter()
         work = state.copy()
         copy_s = time.perf_counter() - t0
-        st.process_slots(chain.spec, work, target)
+        with tracing.span(
+            "state_advance", slot=target, epoch_boundary=crosses_epoch
+        ):
+            st.process_slots(chain.spec, work, target)
         with self._lock:
             self._advanced = (head_root, target, work)
         # hand the result to the chain — produce_block/attestation-data
-        # paths consume it via take_advanced_state
+        # and the block-import fast path consume it via
+        # take_advanced_state
         chain.cache_advanced_state(head_root, target, work)
         log.info(
             "state pre-advanced",
             slot=target,
+            epoch_boundary=crosses_epoch,
             copy_ms=round(copy_s * 1e3, 2),
             total_ms=round((time.perf_counter() - t0) * 1e3, 2),
         )

@@ -1,0 +1,262 @@
+"""Fused columnar epoch math (ISSUE 6 tentpole, layer 2).
+
+One program computes the entire per-validator balance pipeline of an
+epoch boundary — inactivity-score updates, participation-flag rewards
+and penalties, inactivity-leak penalties, slashing-penalty application
+and the effective-balance hysteresis decision — over the numpy columns
+the ChunkedSeq bridge materializes (consensus/ssz.py `seq_columns`).
+This mirrors the reference's fused single pass
+(consensus/state_processing/src/per_epoch_processing/single_pass.rs)
+but in the SoA-batch shape the JAX backend runs.
+
+Backends
+--------
+numpy   — the always-available reference implementation. All integer
+          math is int64; every division has a non-negative numerator,
+          so floor-vs-truncate rounding never diverges between
+          backends.
+jax     — the same program under `jax.jit`, traced inside a scoped
+          `jax.experimental.enable_x64()` so int64 survives without
+          flipping the process-global x64 switch the int32 lane
+          kernels (ops/fp.py) rely on staying OFF. Selected only when
+          jax imports AND a build-time self-check reproduces the numpy
+          outputs bit-identically; any failure falls back to numpy.
+
+`LIGHTHOUSE_EPOCH_JAX=0` forces numpy; `=1` makes a jax-build failure
+raise instead of falling back (CI for the jit path).
+
+Scalar inputs arrive as 0-d numpy arrays so the jitted program treats
+them as traced values — epoch numbers changing every boundary must not
+retrace.
+
+The caller (state_transition.process_epoch) owns ordering: slashing
+penalties are computed host-side FIRST (exact Python ints — the
+per-increment product can exceed int64 for pathological electra
+registries) and enter here as a dense int64 array; outputs are applied
+back to the state in spec stage order.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+# participation / reward constants (the state_transition values; kept
+# here as defaults so the module is importable standalone)
+WEIGHTS = (14, 26, 14)  # source, target, head
+WEIGHT_DENOMINATOR = 64
+TIMELY_TARGET_FLAG_INDEX = 1
+TIMELY_HEAD_FLAG_INDEX = 2
+INACTIVITY_SCORE_BIAS = 4
+INACTIVITY_SCORE_RECOVERY_RATE = 16
+INACTIVITY_PENALTY_QUOTIENT = 2**24
+
+_I64 = np.int64
+
+# array-input field order shared by both backends
+_ARRAY_FIELDS = (
+    "eff",
+    "unslashed_prev",
+    "eligible",
+    "prev_part",
+    "scores",
+    "balances",
+    "slash_penalty",
+)
+# scalar-input field order (0-d arrays; traced under jit)
+_SCALAR_FIELDS = (
+    "do_deltas",
+    "leak",
+    "base_reward_per_inc",
+    "total_active_increments",
+    "flag_inc_0",
+    "flag_inc_1",
+    "flag_inc_2",
+    "increment",
+    "cap",
+    "hysteresis_down",
+    "hysteresis_up",
+)
+
+
+def _core(xp, a: dict, s: dict) -> tuple:
+    """The fused program body, written against `xp` = numpy | jax.numpy.
+    Every value is int64 (or bool); every division's numerator is
+    non-negative by construction."""
+    eff = a["eff"]
+    unslashed_prev = a["unslashed_prev"]
+    eligible = a["eligible"]
+    prev_part = a["prev_part"]
+    scores = a["scores"]
+    balances = a["balances"]
+    slash_penalty = a["slash_penalty"]
+
+    do_deltas = s["do_deltas"]
+    leak = s["leak"]
+    bri = s["base_reward_per_inc"]
+    total_inc = s["total_active_increments"]
+    flag_incs = (s["flag_inc_0"], s["flag_inc_1"], s["flag_inc_2"])
+    inc = s["increment"]
+    cap = s["cap"]
+    down = s["hysteresis_down"]
+    up = s["hysteresis_up"]
+
+    participated_tgt = unslashed_prev & (
+        (prev_part & (1 << TIMELY_TARGET_FLAG_INDEX)) != 0
+    )
+
+    # --- inactivity-score updates (process_inactivity_updates)
+    delta_score = xp.where(
+        participated_tgt,
+        -xp.minimum(_I64(1), scores),
+        _I64(INACTIVITY_SCORE_BIAS),
+    )
+    new_scores = xp.where(eligible, scores + delta_score, scores)
+    recovered = new_scores - xp.minimum(
+        _I64(INACTIVITY_SCORE_RECOVERY_RATE), new_scores
+    )
+    new_scores = xp.where(eligible & ~leak, recovered, new_scores)
+    new_scores = xp.where(do_deltas, new_scores, scores)
+
+    # --- flag rewards/penalties (process_rewards_and_penalties)
+    base_rewards = (eff // inc) * bri
+    delta = xp.zeros_like(balances)
+    for flag_index, weight in enumerate(WEIGHTS):
+        has_flag = unslashed_prev & ((prev_part & (1 << flag_index)) != 0)
+        rewards = (
+            base_rewards * _I64(weight) * flag_incs[flag_index]
+        ) // (total_inc * _I64(WEIGHT_DENOMINATOR))
+        delta = xp.where(eligible & has_flag & ~leak, delta + rewards, delta)
+        if flag_index != TIMELY_HEAD_FLAG_INDEX:
+            penalty = base_rewards * _I64(weight) // _I64(WEIGHT_DENOMINATOR)
+            delta = xp.where(eligible & ~has_flag, delta - penalty, delta)
+
+    # inactivity-leak penalties read the UPDATED scores (spec order:
+    # inactivity updates land before the reward pass reads them)
+    inactivity_penalty = (eff * new_scores) // _I64(
+        INACTIVITY_SCORE_BIAS * INACTIVITY_PENALTY_QUOTIENT
+    )
+    delta = xp.where(
+        eligible & ~participated_tgt, delta - inactivity_penalty, delta
+    )
+    delta = xp.where(do_deltas, delta, xp.zeros_like(delta))
+
+    balances1 = xp.maximum(balances + delta, _I64(0))
+    # --- slashing penalties (decrease_balance clamps at zero)
+    balances2 = xp.maximum(balances1 - slash_penalty, _I64(0))
+
+    # --- effective-balance hysteresis decision (flat `cap`: the
+    # non-electra arm; electra's per-validator caps re-run this mask
+    # host-side after pending deposits/consolidations move balances)
+    eff_mask = ((balances2 + down) < eff) | ((eff + up) < balances2)
+    eff_new = xp.minimum(balances2 - balances2 % inc, cap)
+    return new_scores, balances2, eff_new, eff_mask
+
+
+def _numpy_backend(arrays: dict, scalars: dict) -> tuple:
+    return _core(np, arrays, scalars)
+
+
+def _build_jax_backend():
+    """Build (and self-check) the jitted program; raises on any
+    mismatch so the dispatcher can fall back to numpy.
+
+    The program is pinned to the CPU backend: the epoch boundary is
+    documented host-side work (bench runs it even on dead-tunnel
+    rounds), and x64 math is not supported on every accelerator — an
+    unpinned jit would compile for the default device, fail (or hang
+    in device init when a tunnel degrades) and silently demote exactly
+    the production hosts the 1 s @1M target is for. No CPU backend in
+    this process (JAX_PLATFORMS excludes cpu) raises here, which the
+    dispatcher turns into the numpy fallback."""
+    import jax
+    from jax.experimental import enable_x64
+    import jax.numpy as jnp
+
+    cpu = jax.devices("cpu")[0]
+
+    with enable_x64():
+
+        @jax.jit
+        def _jitted(arrays, scalars):
+            return _core(jnp, arrays, scalars)
+
+    def call(arrays: dict, scalars: dict) -> tuple:
+        with enable_x64(), jax.default_device(cpu):
+            out = _jitted(arrays, scalars)
+        return tuple(np.asarray(o) for o in out)
+
+    # build-time self-check: bit-identity vs numpy on a randomized input
+    rng = np.random.default_rng(6)
+    n = 257
+    arrays = {
+        "eff": rng.integers(0, 2048 * 10**9, n).astype(_I64),
+        "unslashed_prev": rng.random(n) < 0.8,
+        "eligible": rng.random(n) < 0.9,
+        "prev_part": rng.integers(0, 8, n).astype(_I64),
+        "scores": rng.integers(0, 200, n).astype(_I64),
+        "balances": rng.integers(0, 2048 * 10**9, n).astype(_I64),
+        "slash_penalty": rng.integers(0, 10**9, n).astype(_I64),
+    }
+    scalars = {
+        "do_deltas": np.bool_(True),
+        "leak": np.bool_(False),
+        "base_reward_per_inc": _I64(357),
+        "total_active_increments": _I64(32_000_000),
+        "flag_inc_0": _I64(30_000_000),
+        "flag_inc_1": _I64(31_000_000),
+        "flag_inc_2": _I64(29_000_000),
+        "increment": _I64(10**9),
+        "cap": _I64(32 * 10**9),
+        "hysteresis_down": _I64(10**9 // 4),
+        "hysteresis_up": _I64(10**9 // 2),
+    }
+    want = _numpy_backend(arrays, scalars)
+    got = call(arrays, scalars)
+    for w, g in zip(want, got):
+        if not np.array_equal(w, np.asarray(g)):
+            raise RuntimeError("jax epoch program diverges from numpy")
+    return call
+
+
+_BACKEND = None
+_BACKEND_NAME = None
+
+
+def _resolve_backend():
+    global _BACKEND, _BACKEND_NAME
+    if _BACKEND is not None:
+        return _BACKEND
+    mode = os.environ.get("LIGHTHOUSE_EPOCH_JAX", "")
+    if mode == "0":
+        _BACKEND, _BACKEND_NAME = _numpy_backend, "numpy"
+        return _BACKEND
+    try:
+        _BACKEND = _build_jax_backend()
+        _BACKEND_NAME = "jax"
+    except Exception:
+        if mode == "1":
+            raise
+        _BACKEND, _BACKEND_NAME = _numpy_backend, "numpy"
+    return _BACKEND
+
+
+def active_backend() -> str:
+    """'jax' or 'numpy' — resolved on first use, for bench/log lines."""
+    _resolve_backend()
+    return _BACKEND_NAME
+
+
+def epoch_updates(arrays: dict, scalars: dict) -> tuple:
+    """Run the fused epoch program.
+
+    arrays: int64/bool columns per `_ARRAY_FIELDS`
+    scalars: 0-d numpy values per `_SCALAR_FIELDS`
+    returns (new_scores, new_balances, eff_new, eff_mask) int64/bool
+    numpy arrays — bit-identical across backends."""
+    missing = [k for k in _ARRAY_FIELDS if k not in arrays]
+    missing += [k for k in _SCALAR_FIELDS if k not in scalars]
+    if missing:
+        raise TypeError(f"epoch_updates missing inputs: {missing}")
+    return _resolve_backend()(arrays, scalars)

@@ -1,0 +1,368 @@
+"""Differential suite for the columnar epoch transition (ISSUE 6).
+
+The columnar/fused path (state_transition.process_epoch over the
+ChunkedSeq column bridge + ops/epoch.py fused program) must produce
+BIT-IDENTICAL post-states — full SSZ serialization and hash_tree_root —
+to the retained scalar reference (consensus/epoch_reference.py) on
+randomized states covering: inactivity leak on/off, slashed cohorts at
+the half-vector penalty point, churn-saturated activation queues,
+ejection sweeps, hysteresis edge balances, and electra on/off (incl.
+pending deposits/consolidations). Plus unit coverage for the bridge
+itself (column cache refresh, bulk writeback) and jax-vs-numpy backend
+identity for the fused program."""
+
+import numpy as np
+import pytest
+
+from lighthouse_tpu.consensus import epoch_reference as ref
+from lighthouse_tpu.consensus import state_transition as st
+from lighthouse_tpu.consensus import types as T
+from lighthouse_tpu.consensus.spec import FAR_FUTURE_EPOCH, mainnet_spec
+from lighthouse_tpu.consensus.ssz import (
+    ChunkedSeq,
+    seq_assign_array,
+    seq_column,
+    seq_token,
+)
+from lighthouse_tpu.ops import epoch as epoch_ops
+
+EPOCH = 9  # state sits at the tail of epoch 9; boundary processes it
+
+
+def build_random_state(
+    seed: int,
+    n: int,
+    *,
+    electra: bool = False,
+    leak: bool = False,
+    saturate_queue: bool = False,
+    pending: bool = False,
+):
+    """A mid-chain synthetic state exercising every epoch-stage cohort."""
+    rng = np.random.default_rng(seed)
+    spec = mainnet_spec()
+    if electra:
+        spec.fork_epochs["electra"] = 0
+    state = st.empty_genesis_shell(spec)
+    spe = spec.preset.slots_per_epoch
+    state.slot = (EPOCH + 1) * spe - 1
+    eb = spec.max_effective_balance
+    inc = spec.effective_balance_increment
+    half_vector = spec.preset.epochs_per_slashings_vector // 2
+
+    validators, balances, prev_p, cur_p, scores = [], [], [], [], []
+    for i in range(n):
+        roll = rng.random()
+        eff = int(rng.choice([eb, eb, eb, eb - inc, eb - 2 * inc, 17 * 10**9]))
+        prefix = b"\x01"
+        if electra and rng.random() < 0.25:
+            prefix = b"\x02"
+            if rng.random() < 0.5:
+                eff = int(64 * 10**9)
+        wc = prefix + b"\x00" * 11 + i.to_bytes(20, "big")
+        act, exit_e, wd, elig = 0, FAR_FUTURE_EPOCH, FAR_FUTURE_EPOCH, 0
+        slashed = False
+        if roll < 0.06:
+            # slashed cohort; a slice lands exactly on the half-vector
+            # point so process_slashings charges them this boundary
+            slashed = True
+            exit_e = EPOCH - 1
+            wd = (
+                EPOCH + half_vector
+                if rng.random() < 0.5
+                else int(rng.integers(EPOCH - 1, EPOCH + 3))
+            )
+        elif roll < 0.12:
+            # fresh deposit: not yet eligible (eligibility scan cohort)
+            act, elig = FAR_FUTURE_EPOCH, FAR_FUTURE_EPOCH
+        elif roll < 0.22 or (saturate_queue and roll < 0.45):
+            # activation queue cohort (elig finalized, not yet activated)
+            act = FAR_FUTURE_EPOCH
+            elig = int(rng.integers(1, EPOCH - 3))
+        elif roll < 0.26:
+            # exiting / exited
+            exit_e = int(rng.integers(EPOCH - 1, EPOCH + 6))
+            wd = exit_e + spec.min_validator_withdrawability_delay
+        elif roll < 0.30:
+            # ejection candidate: active with dust effective balance
+            eff = int(spec.ejection_balance - rng.integers(0, 2) * inc)
+        # hysteresis edge balances: cluster around eff +/- the exact
+        # downward/upward thresholds
+        edge = int(rng.choice([-(inc // 4) - 1, -(inc // 4), 0, inc // 2, inc // 2 + 1]))
+        bal = max(0, eff + edge + int(rng.integers(0, 10**6)))
+        validators.append(
+            T.Validator.make(
+                pubkey=i.to_bytes(8, "little") * 6,
+                withdrawal_credentials=wc,
+                effective_balance=eff,
+                slashed=slashed,
+                activation_eligibility_epoch=elig,
+                activation_epoch=act,
+                exit_epoch=exit_e,
+                withdrawable_epoch=wd,
+            )
+        )
+        balances.append(bal)
+        prev_p.append(int(rng.integers(0, 8)))
+        cur_p.append(int(rng.integers(0, 8)))
+        scores.append(int(rng.integers(0, 50)))
+    state.validators = validators
+    state.balances = balances
+    state.previous_epoch_participation = prev_p
+    state.current_epoch_participation = cur_p
+    state.inactivity_scores = scores
+
+    fin = EPOCH - 8 if leak else EPOCH - 2
+    state.finalized_checkpoint = T.Checkpoint.make(
+        epoch=fin, root=bytes([fin]) * 32
+    )
+    state.current_justified_checkpoint = T.Checkpoint.make(
+        epoch=EPOCH - 1, root=bytes([EPOCH - 1]) * 32
+    )
+    state.previous_justified_checkpoint = T.Checkpoint.make(
+        epoch=fin, root=bytes([fin]) * 32
+    )
+    state.justification_bits = [bool(rng.integers(0, 2)) for _ in range(4)]
+    for k in rng.integers(0, spec.preset.epochs_per_slashings_vector, 5):
+        state.slashings[int(k)] = int(rng.integers(0, 64 * 10**9))
+
+    if electra and pending:
+        ex = state.electra
+        for j in range(min(8, n // 4)):
+            i = int(rng.integers(0, n))
+            ex.pending_deposits = list(ex.pending_deposits) + [
+                T.PendingDeposit.make(
+                    pubkey=bytes(validators[i].pubkey),
+                    withdrawal_credentials=bytes(
+                        validators[i].withdrawal_credentials
+                    ),
+                    amount=int(rng.integers(1, 5)) * inc,
+                    signature=b"\x00" * 96,
+                    slot=0,
+                )
+            ]
+        # consolidations: ripe, unripe and slashed sources
+        comp = [
+            i
+            for i, v in enumerate(validators)
+            if bytes(v.withdrawal_credentials)[:1] == b"\x02"
+        ]
+        if len(comp) >= 3:
+            pcs = []
+            for j, src in enumerate(comp[:3]):
+                v = st.seq_get_mut(state.validators, src)
+                if j == 0:
+                    v.withdrawable_epoch = EPOCH - 1  # ripe: transfers
+                elif j == 1:
+                    v.withdrawable_epoch = EPOCH + 64  # unripe: blocks
+                pcs.append(
+                    T.PendingConsolidation.make(
+                        source_index=src, target_index=comp[-1]
+                    )
+                )
+            ex.pending_consolidations = pcs
+    return spec, state
+
+
+def _assert_identical(spec, state):
+    a = state.copy()
+    b = state.copy()
+    st.process_epoch(spec, a)
+    ref.process_epoch_scalar(spec, b)
+    assert a.hash_tree_root() == b.hash_tree_root()
+    assert a.serialize() == b.serialize()
+    return a
+
+
+SCENARIOS = [
+    # (seed, n, electra, leak, saturate_queue, pending)
+    pytest.param(1, 97, False, False, False, False, id="small-plain"),
+    pytest.param(2, 97, False, True, False, False, id="small-leak"),
+    pytest.param(3, 2500, False, False, False, False, id="chunked"),
+    pytest.param(4, 2500, False, True, True, False, id="chunked-leak-queue"),
+    pytest.param(5, 97, True, False, False, True, id="electra-pending"),
+    pytest.param(6, 2500, True, True, True, True, id="electra-chunked"),
+    pytest.param(7, 311, False, False, True, False, id="queue-saturated"),
+]
+
+
+@pytest.mark.parametrize(
+    "seed,n,electra,leak,saturate,pending", SCENARIOS
+)
+def test_columnar_matches_scalar_reference(
+    seed, n, electra, leak, saturate, pending
+):
+    spec, state = build_random_state(
+        seed,
+        n,
+        electra=electra,
+        leak=leak,
+        saturate_queue=saturate,
+        pending=pending,
+    )
+    _assert_identical(spec, state)
+
+
+def test_multi_epoch_differential_cache_invalidation():
+    """Two consecutive boundaries through process_slots: the column
+    cache must refresh across the participation rotation, balance
+    writebacks and registry mutations of the first boundary."""
+    spec, state = build_random_state(11, 2500, saturate_queue=True)
+    a = state.copy()
+    b = state.copy()
+    spe = spec.preset.slots_per_epoch
+    target = int(state.slot) + 2 * spe
+    st.process_slots(spec, a, target)
+    # scalar replay of the same slot walk
+    while b.slot < target:
+        st._process_slot(spec, b)
+        if (b.slot + 1) % spe == 0:
+            ref.process_epoch_scalar(spec, b)
+        b.slot += 1
+    assert a.hash_tree_root() == b.hash_tree_root()
+    assert a.serialize() == b.serialize()
+
+
+def test_genesis_epoch_boundary_differential():
+    """cur == GENESIS skips inactivity/reward deltas but still runs
+    slashings + effective-balance updates — both paths must agree."""
+    spec = mainnet_spec()
+    pubkeys = [i.to_bytes(8, "little") * 6 for i in range(64)]
+    state = st.empty_genesis_shell(spec)
+    state.validators = [
+        st._validator_from_deposit(
+            spec, pk, b"\x01" + b"\x00" * 31, spec.max_effective_balance
+        )
+        for pk in pubkeys
+    ]
+    for v in state.validators:
+        v.activation_eligibility_epoch = 0
+        v.activation_epoch = 0
+    n = len(state.validators)
+    state.balances = [spec.max_effective_balance - 3 * 10**9] * n
+    state.previous_epoch_participation = [7] * n
+    state.current_epoch_participation = [7] * n
+    state.inactivity_scores = [0] * n
+    state.slot = spec.preset.slots_per_epoch - 1
+    _assert_identical(spec, state)
+
+
+# ------------------------------------------------------------- the bridge
+
+
+def test_column_cache_refreshes_only_dirty_chunks():
+    seq = ChunkedSeq(list(range(5000)))
+    col = seq_column(seq, np.uint64)
+    assert col[4999] == 4999 and not col.flags.writeable
+    # cache hit: same object back
+    assert seq_column(seq, np.uint64) is col
+    seq[1024] = 7  # dirties exactly chunk 1
+    col2 = seq_column(seq, np.uint64)
+    assert col2 is not col
+    assert col2[1024] == 7 and col2[0] == 0 and col2[4999] == 4999
+    # appends land in the column too
+    seq.append(123456)
+    col3 = seq_column(seq, np.uint64)
+    assert len(col3) == 5001 and col3[5000] == 123456
+
+
+def test_column_cache_copy_isolation():
+    seq = ChunkedSeq(list(range(4096)))
+    _ = seq_column(seq, np.uint64)
+    other = seq.copy()
+    other[0] = 999
+    assert seq_column(other, np.uint64)[0] == 999
+    assert seq_column(seq, np.uint64)[0] == 0
+    assert seq[0] == 0
+
+
+def test_assign_array_writeback_and_token_semantics():
+    seq = ChunkedSeq(list(range(5000)))
+    tok = seq_token(seq)
+    # identical content: zero dirty chunks, token (and root caches) keep
+    same = np.arange(5000, dtype=np.uint64)
+    assert seq_assign_array(seq, same) == 0
+    assert seq_token(seq) == tok
+    # one changed element: exactly one chunk rewritten, token bumps
+    changed = np.arange(5000, dtype=np.uint64)
+    changed[2048] = 42
+    assert seq_assign_array(seq, changed) == 1
+    assert seq_token(seq) != tok
+    assert seq[2048] == 42 and seq[2047] == 2047
+    assert isinstance(seq[2048], int)
+    # the assigned array becomes the cached identity column
+    assert seq_column(seq, np.uint64) is changed
+    # CoW isolation: a pre-writeback copy never sees the writeback
+    snap = seq.copy()
+    bumped = np.arange(5000, dtype=np.uint64)
+    seq_assign_array(seq, bumped + 1)
+    assert snap[0] == 0 and seq[0] == 1
+
+
+def test_assign_array_plain_list():
+    vals = [1, 2, 3]
+    seq_assign_array(vals, np.asarray([4, 5, 6], np.uint64))
+    assert vals == [4, 5, 6] and all(isinstance(v, int) for v in vals)
+
+
+# ------------------------------------------------------------ fused program
+
+
+def _random_program_inputs(seed: int, n: int = 1999):
+    rng = np.random.default_rng(seed)
+    arrays = {
+        "eff": rng.integers(16 * 10**9, 2048 * 10**9, n).astype(np.int64),
+        "unslashed_prev": rng.random(n) < 0.8,
+        "eligible": rng.random(n) < 0.9,
+        "prev_part": rng.integers(0, 8, n).astype(np.int64),
+        "scores": rng.integers(0, 10**4, n).astype(np.int64),
+        "balances": rng.integers(0, 2049 * 10**9, n).astype(np.int64),
+        "slash_penalty": (
+            rng.integers(0, 2, n) * rng.integers(0, 10**9, n)
+        ).astype(np.int64),
+    }
+    scalars = {
+        "do_deltas": np.bool_(True),
+        "leak": np.bool_(bool(seed % 2)),
+        "base_reward_per_inc": np.int64(int(rng.integers(100, 10**6))),
+        "total_active_increments": np.int64(int(rng.integers(1, 2**25))),
+        "flag_inc_0": np.int64(int(rng.integers(0, 2**25))),
+        "flag_inc_1": np.int64(int(rng.integers(0, 2**25))),
+        "flag_inc_2": np.int64(int(rng.integers(0, 2**25))),
+        "increment": np.int64(10**9),
+        "cap": np.int64(32 * 10**9),
+        "hysteresis_down": np.int64(10**9 // 4),
+        "hysteresis_up": np.int64(10**9 // 2),
+    }
+    return arrays, scalars
+
+
+def test_fused_program_backends_bit_identical():
+    if epoch_ops.active_backend() != "jax":
+        pytest.skip("jax backend unavailable; numpy fallback in use")
+    for seed in (1, 2, 3):
+        arrays, scalars = _random_program_inputs(seed)
+        want = epoch_ops._numpy_backend(arrays, scalars)
+        got = epoch_ops.epoch_updates(arrays, scalars)
+        for w, g in zip(want, got):
+            assert np.array_equal(w, g)
+
+
+def test_epoch_stage_metrics_populated():
+    spec, state = build_random_state(21, 97)
+    from lighthouse_tpu.common import metrics
+
+    st.process_epoch(spec, state.copy())
+    fam = metrics.get("state_epoch_stage_seconds")
+    assert fam is not None
+    stages = {v[0] for v in fam.label_values()}
+    for want in (
+        "columns",
+        "justification",
+        "fused_math",
+        "rewards_and_penalties",
+        "registry_updates",
+        "effective_balance",
+        "participation_rotation",
+    ):
+        assert want in stages, f"missing epoch stage series {want}"

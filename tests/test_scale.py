@@ -1,9 +1,10 @@
-"""Scale regression gate (VERDICT r3 weak #7, budgets tightened for the
-CoW-spine + vectorized-shuffle round): the 500k/1M-validator numbers
-live in BASELINE.md §"scale probe"; this test replays the probe at 250k
-and locks in the structural-sharing wins — a regression back to
-rebuild-everything copies (seconds) or per-index shuffling (minutes)
-fails immediately, with head-room for CI machine slack only."""
+"""Scale regression gate (VERDICT r3 weak #7; budgets re-tightened for
+the columnar epoch transition round): the 500k/1M-validator numbers
+live in BASELINE.md §"scale probe"; this module replays the probe at
+250k (and 1M for the epoch boundary) and locks in the structural wins —
+a regression back to per-validator Python epoch loops (seconds),
+rebuild-everything copies, or per-index shuffling (minutes) fails
+immediately, with head-room for CI machine slack only."""
 
 import time
 
@@ -14,27 +15,50 @@ pytestmark = pytest.mark.slow
 
 from lighthouse_tpu.tools.scale_probe import build_state
 from lighthouse_tpu.consensus import state_transition as st
+from lighthouse_tpu.ops import epoch as epoch_ops
 
 N = 250_000
-# Measured this round at 250k (BASELINE.md §scale probe): epoch 6.8 s,
-# copy 0.0004 s, committee cold 1.1 s / warm 0.005 s per slot. Budgets
-# are ~2-3x the measurement for CI slack — NOT the old rebuild-era
-# numbers (copy was 4.9 s, committees 65 s at this scale).
-EPOCH_BUDGET_S = 20.0
+# Measured this round at 250k (BASELINE.md §scale probe): epoch cold
+# (column build + per-shape jit trace) ~0.5 s, steady-state ~0.06 s —
+# down from 6.8 s. Budgets are ~3x the measurement for CI slack.
+EPOCH_COLD_BUDGET_S = 1.5
+EPOCH_WARM_BUDGET_S = 0.5
 COPY_BUDGET_S = 0.5
 # first-slot-of-epoch (cold: active-set scan + whole-list shuffle)
 COMMITTEE_COLD_BUDGET_S = 4.0
 # amortized per-slot budget with the epoch's permutation warm
 COMMITTEE_WARM_BUDGET_S = 1.0
 
+# 1M probe (slow ladder top): steady-state boundary must stay under the
+# ISSUE 6 target of 1 s; cold (first boundary after a fresh state load:
+# full column materialization + one per-shape jit trace) gets a looser
+# backstop — in a live node the cold build happens once at startup and
+# every later boundary rides dirty-chunk refreshes.
+N_1M = 1_000_000
+EPOCH_1M_WARM_BUDGET_S = 1.0
+EPOCH_1M_COLD_BUDGET_S = 5.0
+
 
 def test_scale_epoch_copy_committee_budgets():
     spec, state = build_state(N)
+    epoch_ops.active_backend()  # resolve/jit-build outside the budget
 
     t0 = time.perf_counter()
     st.process_epoch(spec, state)
-    epoch_s = time.perf_counter() - t0
-    assert epoch_s < EPOCH_BUDGET_S, f"epoch transition regressed: {epoch_s:.1f}s"
+    epoch_cold_s = time.perf_counter() - t0
+    assert epoch_cold_s < EPOCH_COLD_BUDGET_S, (
+        f"cold epoch transition regressed: {epoch_cold_s:.2f}s"
+    )
+
+    # steady state: the next boundary reuses the column caches (only
+    # dirty chunks re-materialize) — the cost a live node pays per epoch
+    state.slot += spec.preset.slots_per_epoch
+    t0 = time.perf_counter()
+    st.process_epoch(spec, state)
+    epoch_warm_s = time.perf_counter() - t0
+    assert epoch_warm_s < EPOCH_WARM_BUDGET_S, (
+        f"steady-state epoch transition regressed: {epoch_warm_s:.2f}s"
+    )
 
     t0 = time.perf_counter()
     copied = state.copy()
@@ -68,3 +92,79 @@ def test_scale_epoch_copy_committee_budgets():
     assert warm_s < COMMITTEE_WARM_BUDGET_S, (
         f"warm committee resolution regressed: {warm_s:.2f}s"
     )
+
+
+def test_scale_epoch_1m_probe():
+    """ISSUE 6 acceptance: epoch <= 1 s @1M validators (CPU-JAX),
+    steady-state; the cold first boundary gets a backstop budget."""
+    spec, state = build_state(N_1M)
+    epoch_ops.active_backend()
+
+    t0 = time.perf_counter()
+    st.process_epoch(spec, state)
+    cold_s = time.perf_counter() - t0
+    assert cold_s < EPOCH_1M_COLD_BUDGET_S, (
+        f"cold 1M epoch transition regressed: {cold_s:.2f}s"
+    )
+
+    state.slot += spec.preset.slots_per_epoch
+    t0 = time.perf_counter()
+    st.process_epoch(spec, state)
+    warm_s = time.perf_counter() - t0
+    assert warm_s < EPOCH_1M_WARM_BUDGET_S, (
+        f"steady-state 1M epoch transition over the 1 s target: "
+        f"{warm_s:.2f}s"
+    )
+
+
+class _StubChain:
+    """The minimal chain surface StateAdvanceTimer drives."""
+
+    def __init__(self, spec, state):
+        self.spec = spec
+        self._state = state
+
+        class _Head:
+            root = b"\x11" * 32
+
+        self.head = _Head()
+        self.cached = None
+
+    def head_state(self):
+        return self._state
+
+    def cache_advanced_state(self, head_root, slot, state):
+        self.cached = (bytes(head_root), int(slot), state)
+
+
+def test_slot_tail_pre_advance_crosses_epoch_boundary():
+    """ISSUE 6 layer 3: on_slot_tail at an epoch tail leaves
+    advanced_state PAST the boundary, so importing the first block of
+    the next epoch pays ~0 epoch cost on the critical path."""
+    from lighthouse_tpu.node.state_advance_timer import StateAdvanceTimer
+
+    spec, state = build_state(50_000)
+    spe = spec.preset.slots_per_epoch
+    tail_slot = int(state.slot)
+    assert (tail_slot + 1) % spe == 0, "probe state must sit at a tail"
+    epoch_before = st.get_current_epoch(spec, state)
+
+    chain = _StubChain(spec, state)
+    timer = StateAdvanceTimer(chain)
+    t0 = time.perf_counter()
+    assert timer.on_slot_tail(tail_slot) is True
+    advance_s = time.perf_counter() - t0
+
+    adv = timer.advanced_state(chain.head.root, tail_slot + 1)
+    assert adv is not None
+    assert adv.slot == tail_slot + 1
+    assert st.get_current_epoch(spec, adv) == epoch_before + 1
+    # the chain-side cache (consumed by produce_block + block import)
+    # got the same post-boundary state
+    root, slot, cached = chain.cached
+    assert slot == tail_slot + 1 and cached is adv
+    # the original head state is untouched — the boundary ran on a copy
+    assert state.slot == tail_slot
+    # generous backstop: the pre-advance carries one epoch transition
+    # plus the slot's cold state hash_tree_root
+    assert advance_s < 30.0

@@ -69,6 +69,11 @@ REQUIRED_FAMILIES = {
     # chain caches + span aggregation
     "beacon_chain_shuffling_cache_total": ("result",),
     "state_epoch_cache_total": ("cache", "result"),
+    # columnar epoch transition (consensus/state_transition.py): per-
+    # stage boundary attribution + the slot-tail pre-advance hit rate
+    # at block import (node/beacon_chain.py)
+    "state_epoch_stage_seconds": ("stage",),
+    "beacon_chain_advanced_state_total": ("result",),
     "lighthouse_tracing_span_seconds": ("kind",),
     # validator monitor (node/validator_monitor.py)
     "validator_monitor_validators": (),
@@ -95,6 +100,7 @@ def _import_surface(problems: list) -> None:
     import lighthouse_tpu.node.validator_monitor  # noqa: F401
     import lighthouse_tpu.common.tracing  # noqa: F401
     import lighthouse_tpu.consensus.state_transition  # noqa: F401
+    import lighthouse_tpu.node.beacon_chain  # noqa: F401
 
     try:
         import lighthouse_tpu.crypto.bls.backends.tpu  # noqa: F401
