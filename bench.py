@@ -384,6 +384,75 @@ def _config_epoch(detail):
     detail["epoch"] = out
 
 
+def _config_scenarios(detail):
+    """detail.scenarios (ISSUE 7): chaos-scenario fleet pass/fail +
+    convergence-time summary per round, so re-convergence health rides
+    next to perf in every BENCH record. Pure CPU (fake BLS, in-process
+    hub) — runs even on rounds where the chip tunnel is down. The full
+    fleet lives in tests/test_scenarios.py; this replays the three
+    shapes that exercise distinct sync machinery: a full partition
+    (range sync from the finalized point), an asymmetric partition
+    (stall detection), and an advertise-and-withhold peer (empty-batch
+    cross-check + penalization)."""
+    from lighthouse_tpu.tools.simulator import (
+        Partition,
+        Simulation,
+        WithholdingPeer,
+        scenario_spec,
+    )
+
+    spe = 4
+    cases = {
+        "partition": lambda: [Partition([3], 2 * spe, 3 * spe)],
+        "asymmetric_partition": lambda: [
+            Partition([3], 2 * spe, 3 * spe, oneway=True)
+        ],
+        "withholding_peer": lambda: [
+            WithholdingPeer(0, spe, 4 * spe),
+            Partition([3], 2 * spe, 3 * spe),
+        ],
+    }
+    out = {}
+    for i, (name, build) in enumerate(cases.items()):
+        if _left() < 45:
+            out[name] = {"skipped": "budget", "left_s": round(_left(), 1)}
+            continue
+        t0 = time.perf_counter()
+        try:
+            faults = build()
+            sim = Simulation(
+                n_nodes=4,
+                n_validators=16,
+                spec=scenario_spec(spe),
+                seed=100 + i,
+                fake_signing=True,
+            )
+            checks = sim.run(until_epoch=5, faults=faults)
+            horizon = max(f.horizon for f in faults)
+            conv = checks.convergence_slot
+            out[name] = {
+                "pass": bool(checks.consistent_heads),
+                "convergence_slot": conv,
+                "slots_to_converge": (
+                    max(0, conv - horizon) if conv is not None else None
+                ),
+                "finalized_epoch": checks.finalized_epoch,
+                "wall_s": round(time.perf_counter() - t0, 2),
+            }
+        except Exception as e:  # noqa: BLE001 — recorded per case
+            out[name] = {
+                "pass": False,
+                "error": f"{type(e).__name__}: {e}",
+                "wall_s": round(time.perf_counter() - t0, 2),
+            }
+    out["pass_all"] = all(
+        c.get("pass", False) or "skipped" in c
+        for c in out.values()
+        if isinstance(c, dict)
+    )
+    detail["scenarios"] = out
+
+
 def main():
     n_sets = int(os.environ.get("BENCH_SETS", "4096"))
     reps = int(os.environ.get("BENCH_REPS", "5"))
@@ -488,6 +557,8 @@ def main():
         # would block in device init, exactly like jax.devices())
         os.environ.setdefault("LIGHTHOUSE_EPOCH_JAX", "0")
         _run_config("epoch", 60, _config_epoch)
+        # convergence health is chip-independent: ship it every round
+        _run_config("scenarios", 60, _config_scenarios)
         _emit()
         os._exit(3)
     detail["device"] = device
@@ -541,6 +612,9 @@ def main():
 
     # per-stage epoch-boundary attribution rides every round (ISSUE 6)
     _run_config("epoch", 60, _config_epoch)
+
+    # chaos-scenario convergence summary rides every round (ISSUE 7)
+    _run_config("scenarios", 60, _config_scenarios)
 
     # ------------- in-repo CPU control (sanity only, NOT the baseline)
     if _left() > 30:

@@ -60,11 +60,15 @@ class Endpoint:
 class InProcessHub:
     """All endpoints in one process; delivery is an append to the
     target's inbox. Supports fault injection: `partition(a, b)` drops
-    frames both ways (failure-detection tests)."""
+    frames both ways, `partition_oneway(src, dst)` drops only src->dst
+    (asymmetric faults: a node that can speak but not hear — requests
+    leave, responses vanish — the shape that exercises stall
+    detection)."""
 
     def __init__(self):
         self._endpoints: dict[str, Endpoint] = {}
         self._partitions: set[frozenset] = set()
+        self._oneway: set[tuple] = set()
         self._lock = threading.Lock()
         self.dropped = 0
 
@@ -84,7 +88,10 @@ class InProcessHub:
 
     def deliver(self, sender: str, to_peer: str, channel: int, payload: bytes) -> bool:
         with self._lock:
-            if frozenset((sender, to_peer)) in self._partitions:
+            if (
+                frozenset((sender, to_peer)) in self._partitions
+                or (sender, to_peer) in self._oneway
+            ):
                 self.dropped += 1
                 return False
             ep = self._endpoints.get(to_peer)
@@ -102,3 +109,12 @@ class InProcessHub:
     def heal(self, a: str, b: str) -> None:
         with self._lock:
             self._partitions.discard(frozenset((a, b)))
+
+    def partition_oneway(self, src: str, dst: str) -> None:
+        """Drop frames src->dst only; dst->src still delivers."""
+        with self._lock:
+            self._oneway.add((src, dst))
+
+    def heal_oneway(self, src: str, dst: str) -> None:
+        with self._lock:
+            self._oneway.discard((src, dst))

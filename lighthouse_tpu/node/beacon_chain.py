@@ -64,6 +64,26 @@ class AvailabilityPending(BlockError):
     (data_availability_checker role): retry once the sidecars land."""
 
 
+class SegmentError(BlockError):
+    """Chain-segment import failure with a machine-readable `reason`, so
+    range sync can tell OUR gaps from the peer's misbehavior:
+
+      unknown_parent — the segment doesn't attach to any block we hold;
+                       the requester's start point was wrong, not the
+                       serving peer (sync restarts the chain, no penalty)
+      not_linked     — blocks inside the response don't form a parent
+                       chain: the server assembled a broken batch
+      invalid_block  — the first new block fails state transition: the
+                       served chain is consensus-invalid
+
+    (the reference's typed ChainSegmentResult/BlockError split,
+    beacon_chain.rs process_chain_segment)."""
+
+    def __init__(self, reason: str, detail: str):
+        super().__init__(f"{reason}: {detail}")
+        self.reason = reason
+
+
 class AttestationError(Exception):
     pass
 
@@ -735,7 +755,7 @@ class BeaconChain:
             blocks = [sb.message for sb in signed_blocks]
             for a, b in zip(blocks, blocks[1:]):
                 if bytes(b.parent_root) != a.hash_tree_root():
-                    raise BlockError("segment not linked")
+                    raise SegmentError("not_linked", "segment not linked")
             # skip already-imported prefix
             start = 0
             while start < len(blocks) and self.fork_choice.contains_block(
@@ -748,7 +768,9 @@ class BeaconChain:
                 return []
             parent_state = self.state_for_block(bytes(blocks[0].parent_root))
             if parent_state is None:
-                raise BlockError("unknown parent for segment")
+                raise SegmentError(
+                    "unknown_parent", "unknown parent for segment"
+                )
 
             # ONE transition pass: advance through the segment capturing
             # per-block post-states (reused at import — no second
@@ -786,7 +808,7 @@ class BeaconChain:
                 post_states.append(state)
             if valid_prefix < len(signed_blocks):
                 if valid_prefix == 0:
-                    raise BlockError("segment head invalid")
+                    raise SegmentError("invalid_block", "segment head invalid")
                 return self.process_chain_segment(
                     signed_blocks[:valid_prefix], verify_signatures
                 )
@@ -809,7 +831,9 @@ class BeaconChain:
                 commitments = list(sb.message.body.blob_kzg_commitments)
                 if commitments:
                     if self.da_checker is None:
-                        raise BlockError("blob block but chain has no kzg")
+                        raise SegmentError(
+                            "unsupported", "blob block but chain has no kzg"
+                        )
                     self.da_checker.expect(root, len(commitments))
                     if not self.da_checker.is_available(root):
                         break  # stop at the first unavailable block

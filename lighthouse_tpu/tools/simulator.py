@@ -1,4 +1,5 @@
-"""In-process multi-node simulator — testing/simulator analog.
+"""In-process multi-node simulator + deterministic chaos-scenario
+fleet — testing/simulator analog grown past the reference (ISSUE 7).
 
 Spins N FULL node assemblies (BeaconChain + BeaconProcessor +
 NetworkService + NetworkBeaconProcessor + SyncManager) and their
@@ -9,23 +10,49 @@ production BNs+VCs on one tokio runtime; node_test_rig/src/lib.rs:1-36).
 The validator set is split across nodes; every block and attestation
 travels over GOSSIP (not direct chain calls), so the simulation
 exercises verification pipelines, fork choice, the naive aggregation
-pool, the operation pool, range sync and peer scoring the way a real
-network does. The accelerated "slot clock" is the driver loop calling
-per-slot phases back-to-back (speed_up_factor role, basic_sim.rs:36).
+pool, the operation pool, per-chain range sync and peer scoring the way
+a real network does. The accelerated "slot clock" is the driver loop
+calling per-slot phases back-to-back (speed_up_factor role,
+basic_sim.rs:36); every node's SyncManager ticks once per slot, the
+production node loop's cadence.
 
 Checks mirror simulator/src/checks.rs: liveness (head advances),
-consistency (all heads equal when connected), and finality (finalized
-epoch advances past the target), plus an optional mid-run
-partition/heal fault (fallback_sim's node-kill analog on the hub's
-partition seam)."""
+consistency (all heads equal when connected), finality (finalized
+epoch advances past the target) — plus convergence tracking: the first
+slot after the last fault window at which every node agrees on one
+head.
+
+Faults are first-class (`Fault` subclasses passed to `run(faults=...)`,
+each a seeded, deterministic, in-process scenario seam):
+
+  Partition            cut a node group from the rest (both ways), heal
+                       + re-handshake at window end
+  Partition(oneway=)   asymmetric cut: the group can speak but not
+                       hear — requests leave, responses vanish (the
+                       stall-detection shape)
+  LateProposer         the duty holder's block is imported + gossiped
+                       one slot late (no proposer boost, attesters vote
+                       the old head)
+  EquivocatingProposer the duty holder signs TWO conflicting blocks for
+                       its slot and gossips both
+  WithholdingPeer      a node keeps advertising its head but serves
+                       empty (or garbage) BlocksByRange/Root
+  OfflineSpell         a node group's validators go silent (validator
+                       churn; >=1/3 silent = a non-finality spell)
+
+tests/test_scenarios.py drives the fleet fast on the minimal preset in
+tier-1; tests/test_simulator.py keeps the slow mainnet-preset runs.
+"""
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+import random
+from dataclasses import dataclass, field, replace
+from typing import Optional
 
 from ..consensus import state_transition as st
 from ..consensus import types as T
-from ..consensus.spec import ChainSpec, mainnet_spec
+from ..consensus.spec import MAINNET_PRESET, ChainSpec, mainnet_spec
 from ..crypto.bls.keys import SecretKey
 from ..node.beacon_chain import BeaconChain
 from ..node.beacon_processor import BeaconProcessor
@@ -35,26 +62,52 @@ from ..network.gossip import (
     topic_for,
 )
 from ..network.network_beacon_processor import NetworkBeaconProcessor
+from ..network.rpc import Protocol, ResponseCode
 from ..network.subnet_service import compute_subnet_for_attestation
 from ..network.sync import SyncManager
 from ..network.service import NetworkService
 from ..network.transport import InProcessHub
-from ..validator import LocalKeystoreSigner, ValidatorClient, ValidatorStore
+from ..validator import (
+    FakeSigner,
+    LocalKeystoreSigner,
+    ValidatorClient,
+    ValidatorStore,
+)
 from ..validator.client import InProcessBeaconNode
 
 ATTESTATION_SUBNET_COUNT = 64
 
 
+def scenario_spec(slots_per_epoch: int = 8) -> ChainSpec:
+    """Fast-scenario spec: epochs shrink to `slots_per_epoch` so
+    justification/finality cycles complete in a few dozen slots, while
+    every SSZ-size constant stays MAINNET (the type layer is bound to
+    the mainnet preset; slots_per_epoch only drives epoch math, and the
+    one list limit derived from it — eth1_data_votes — is an upper
+    bound a shorter voting period can't exceed)."""
+    return ChainSpec(
+        preset=replace(
+            MAINNET_PRESET, name="scenario", slots_per_epoch=slots_per_epoch
+        )
+    )
+
+
 class GossipBeaconNode(InProcessBeaconNode):
     """BeaconNodeApi whose publish side goes over gossip — what the
-    reference VC's HTTP publish endpoints do on a real BN."""
+    reference VC's HTTP publish endpoints do on a real BN. The block
+    publish path carries a fault seam: a scenario hook may consume the
+    publish (delay it, twin it, drop it)."""
 
-    def __init__(self, chain, nbp, spec):
+    def __init__(self, chain, nbp, spec, node=None):
         super().__init__(chain)
         self.nbp = nbp
         self.spec = spec
+        self.node = node  # SimNode back-ref for fault hooks
 
     def publish_block(self, signed_block):
+        hook = getattr(self.node, "block_publish_hook", None)
+        if hook is not None and hook(self.node, signed_block):
+            return  # the fault seam consumed this publish
         # local import first (proposer's own head), then gossip
         self.chain.process_block(signed_block)
         self.nbp.publish_block(signed_block)
@@ -77,15 +130,26 @@ class GossipBeaconNode(InProcessBeaconNode):
 class SimChecks:
     head_slots: list = field(default_factory=list)
     finalized_epoch: int = 0
+    min_finalized_epoch: int = 0
     consistent_heads: bool = True
+    # first slot >= the last fault window's end at which every node
+    # agreed on one head (None = never converged)
+    convergence_slot: Optional[int] = None
+    final_heads: list = field(default_factory=list)
+    # finalized epoch observed at each epoch boundary (non-finality
+    # spell assertions read the plateau out of this)
+    finalized_by_epoch: dict = field(default_factory=dict)
 
 
 class SimNode:
     """One full BN+VC assembly on the hub."""
 
-    def __init__(self, hub, name, spec, genesis_state, keys, fork_digest):
+    def __init__(self, hub, name, spec, genesis_state, keys, fork_digest,
+                 chain=None, fake_signing=False):
         self.name = name
-        self.chain = BeaconChain(spec, genesis_state, bls_backend="fake")
+        self.chain = chain if chain is not None else BeaconChain(
+            spec, genesis_state, bls_backend="fake"
+        )
         self.processor = BeaconProcessor()
         self.service = NetworkService(hub, name)
         self.service.subscribe(topic_for(TOPIC_BLOCK, fork_digest))
@@ -98,11 +162,15 @@ class SimNode:
         )
         self.sync = SyncManager(self.chain, self.processor, self.service, self.nbp)
         store = ValidatorStore(spec, self.chain.genesis_validators_root)
+        signer = FakeSigner if fake_signing else LocalKeystoreSigner
         for k in keys:
-            store.add_validator(LocalKeystoreSigner(k))
+            store.add_validator(signer(k))
         self.vc = ValidatorClient(
-            spec, store, GossipBeaconNode(self.chain, self.nbp, spec)
+            spec, store, GossipBeaconNode(self.chain, self.nbp, spec, node=self)
         )
+        # fault seams
+        self.block_publish_hook = None  # callable(node, signed) -> bool
+        self.offline = False  # validators silent (OfflineSpell)
 
     def pump(self) -> int:
         n = 0
@@ -114,15 +182,226 @@ class SimNode:
         return n
 
 
+# ------------------------------------------------------------------ faults
+
+
+class Fault:
+    """One deterministic fault seam; `run()` drives the hooks."""
+
+    def on_slot_start(self, sim: "Simulation", slot: int) -> None:
+        pass
+
+    def on_slot_end(self, sim: "Simulation", slot: int) -> None:
+        pass
+
+    @property
+    def horizon(self) -> int:
+        """Last slot at which this fault is active (convergence is only
+        measured after every fault's horizon)."""
+        return 0
+
+
+class Partition(Fault):
+    """Cut `group` (node indices) from the rest between start and end
+    slot. `oneway=True` drops only frames INTO the group (the group
+    speaks but cannot hear). Heal re-handshakes both directions so
+    range sync learns the other side's target."""
+
+    def __init__(self, group, start_slot: int, end_slot: int,
+                 oneway: bool = False):
+        self.group = [group] if isinstance(group, int) else list(group)
+        self.start_slot = start_slot
+        self.end_slot = end_slot
+        self.oneway = oneway
+
+    @property
+    def horizon(self) -> int:
+        return self.end_slot
+
+    def _pairs(self, sim):
+        members = {sim.nodes[i].name for i in self.group}
+        for i in self.group:
+            victim = sim.nodes[i]
+            for other in sim.nodes:
+                if other.name not in members:
+                    yield victim, other
+
+    def on_slot_start(self, sim, slot: int) -> None:
+        if slot == self.start_slot:
+            for victim, other in self._pairs(sim):
+                if self.oneway:
+                    sim.hub.partition_oneway(other.name, victim.name)
+                else:
+                    sim.hub.partition(victim.name, other.name)
+        if slot == self.end_slot:
+            for victim, other in self._pairs(sim):
+                if self.oneway:
+                    sim.hub.heal_oneway(other.name, victim.name)
+                else:
+                    sim.hub.heal(victim.name, other.name)
+                # full re-graft (scores may have disconnected peers
+                # while their requests black-holed) + fresh handshakes:
+                # the status exchange is what classifies each side into
+                # the other's head chain for range sync
+                victim.service.connect_peer(other.service)
+            sim.settle()
+            for victim, other in self._pairs(sim):
+                victim.sync.add_peer(other.name)
+                other.sync.add_peer(victim.name)
+            sim.settle()
+            for victim, _ in self._pairs(sim):
+                victim.sync.tick()
+            sim.settle()
+
+
+class LateProposer(Fault):
+    """Blocks produced at `slots` are imported + gossiped one slot
+    late: attesters vote the previous head that slot, the block arrives
+    past its slot (no proposer boost) — the classic late-block reorg
+    shape."""
+
+    def __init__(self, slots):
+        self.slots = set(slots)
+        self._delayed: list = []
+
+    @property
+    def horizon(self) -> int:
+        return max(self.slots) + 1 if self.slots else 0
+
+    def on_slot_start(self, sim, slot: int) -> None:
+        for node, signed in self._delayed:
+            node.chain.process_block(signed)
+            node.nbp.publish_block(signed)
+        self._delayed.clear()
+        if slot in self.slots:
+            def hook(node, signed):
+                self._delayed.append((node, signed))
+                return True
+
+            for n in sim.nodes:
+                n.block_publish_hook = hook
+        else:
+            for n in sim.nodes:
+                n.block_publish_hook = None
+
+
+class EquivocatingProposer(Fault):
+    """The duty holder at each of `slots` signs TWO conflicting blocks
+    (distinct graffiti => distinct state roots) and gossips both — the
+    proposer-equivocation attack. Both import everywhere; fork choice
+    arbitrates one winner deterministically."""
+
+    def __init__(self, slots):
+        self.slots = set(slots)
+
+    @property
+    def horizon(self) -> int:
+        return max(self.slots) if self.slots else 0
+
+    def on_slot_start(self, sim, slot: int) -> None:
+        if slot not in self.slots:
+            for n in sim.nodes:
+                n.block_publish_hook = None
+            return
+
+        def hook(node, signed):
+            msg = signed.message
+            twin = None
+            try:
+                twin_msg = node.chain.produce_block(
+                    int(msg.slot),
+                    randao_reveal=bytes(msg.body.randao_reveal),
+                    graffiti=b"\x66" * 32,
+                )
+                twin = T.SignedBeaconBlock.make(
+                    message=twin_msg, signature=bytes(signed.signature)
+                )
+            except Exception:
+                pass  # equivocation is best-effort; the honest block flows
+            node.chain.process_block(signed)
+            node.nbp.publish_block(signed)
+            if twin is not None:
+                node.nbp.publish_block(twin)
+            return True
+
+        for n in sim.nodes:
+            n.block_publish_hook = hook
+
+
+class WithholdingPeer(Fault):
+    """Node `node` keeps its status honest but serves empty
+    (garbage=False) or undecodable (garbage=True) block responses —
+    the advertise-and-withhold peer range sync must route around."""
+
+    def __init__(self, node: int, start_slot: int, end_slot: int,
+                 garbage: bool = False):
+        self.node = node
+        self.start_slot = start_slot
+        self.end_slot = end_slot
+        self.garbage = garbage
+        self._saved: dict = {}
+
+    @property
+    def horizon(self) -> int:
+        return self.end_slot
+
+    def on_slot_start(self, sim, slot: int) -> None:
+        rpc = sim.nodes[self.node].service.rpc
+        if slot == self.start_slot:
+            if self.garbage:
+                def handler(peer, body):
+                    return ResponseCode.SUCCESS, [b"\xff\xfegarbage"]
+            else:
+                def handler(peer, body):
+                    return ResponseCode.SUCCESS, []
+            for proto in (Protocol.BLOCKS_BY_RANGE, Protocol.BLOCKS_BY_ROOT):
+                self._saved[proto] = rpc.handlers.get(proto)
+                rpc.register(proto, handler)
+        if slot == self.end_slot:
+            for proto, h in self._saved.items():
+                if h is not None:
+                    rpc.register(proto, h)
+            self._saved.clear()
+
+
+class OfflineSpell(Fault):
+    """The validators of `group` go silent for the window (no
+    proposals, no attestations): validator churn when < 1/3 of stake,
+    a non-finality spell when >= 1/3."""
+
+    def __init__(self, group, start_slot: int, end_slot: int):
+        self.group = [group] if isinstance(group, int) else list(group)
+        self.start_slot = start_slot
+        self.end_slot = end_slot
+
+    @property
+    def horizon(self) -> int:
+        return self.end_slot
+
+    def on_slot_start(self, sim, slot: int) -> None:
+        if slot == self.start_slot:
+            for i in self.group:
+                sim.nodes[i].offline = True
+        if slot == self.end_slot:
+            for i in self.group:
+                sim.nodes[i].offline = False
+
+
+# ------------------------------------------------------------------ sim
+
+
 class Simulation:
     """N nodes, full-mesh connectivity, validators split round-robin.
 
     `transport="inproc"` (default) runs all nodes on one InProcessHub —
-    fast, and the only mode supporting the partition fault seam.
+    fast, and the only mode supporting the fault seams.
     `transport="libp2p"` gives every node its own Libp2pEndpoint on a
     real localhost socket: gossip and sync travel as
     mss/noise/yamux/gossipsub-protobuf frames on the wire, the same
-    stack `cli bn` runs by default."""
+    stack `cli bn` runs by default.
+
+    `seed` feeds `self.rng` — scenarios derive any randomized fault
+    scheduling from it, so every run is reproducible."""
 
     def __init__(
         self,
@@ -131,16 +410,24 @@ class Simulation:
         spec: ChainSpec = None,
         electra_fork_epoch: int = None,
         transport: str = "inproc",
+        seed: int = 0,
+        sync_batch_timeout: float = 1.0,
+        fake_signing: bool = False,
     ):
         self.spec = spec or mainnet_spec()
         if electra_fork_epoch is not None:
             self.spec.fork_epochs = dict(self.spec.fork_epochs)
             self.spec.fork_epochs["electra"] = electra_fork_epoch
         self.transport = transport
-        keys = [SecretKey.from_seed(i.to_bytes(4, "big")) for i in range(n_validators)]
-        pubkeys = [k.public_key().to_bytes() for k in keys]
+        self.rng = random.Random(seed)
+        self.keys = [
+            SecretKey.from_seed(i.to_bytes(4, "big"))
+            for i in range(n_validators)
+        ]
+        pubkeys = [k.public_key().to_bytes() for k in self.keys]
         genesis = st.interop_genesis_state(self.spec, pubkeys)
-        digest = b"\x00" * 4
+        self.genesis = genesis
+        self.fork_digest = b"\x00" * 4
         self.nodes = []
         if transport == "libp2p":
             from ..network.libp2p_transport import Libp2pHub
@@ -153,8 +440,9 @@ class Simulation:
                         f"node{i}",
                         self.spec,
                         genesis.copy(),
-                        keys[i::n_nodes],
-                        digest,
+                        self.keys[i::n_nodes],
+                        self.fork_digest,
+                        fake_signing=fake_signing,
                     )
                 )
             # full mesh over real sockets: dial once per pair; the
@@ -171,13 +459,60 @@ class Simulation:
                         f"node{i}",
                         self.spec,
                         genesis.copy(),
-                        keys[i::n_nodes],
-                        digest,
+                        self.keys[i::n_nodes],
+                        self.fork_digest,
+                        fake_signing=fake_signing,
                     )
                 )
             for i, a in enumerate(self.nodes):
                 for b in self.nodes[i + 1 :]:
                     a.service.connect_peer(b.service)
+            # initial status handshakes: every node learns every peer's
+            # chain status up front (discovery+status exchange role), so
+            # range sync has targets the moment someone falls behind
+            for a in self.nodes:
+                a.sync.batch_timeout = sync_batch_timeout
+                for b in self.nodes:
+                    if a is not b:
+                        a.sync.add_peer(b.name)
+            self.settle()
+
+    def add_checkpoint_node(self, source_idx: int = 0) -> SimNode:
+        """Join a FRESH node mid-run via weak-subjectivity checkpoint
+        sync off `source_idx`'s finalized checkpoint: it follows the
+        head via range sync immediately and backfills history
+        genesis-ward — under whatever gossip load the run applies."""
+        if self.transport != "inproc":
+            raise ValueError("checkpoint join needs the in-process hub")
+        src = self.nodes[source_idx].chain
+        fin_root = src.fork_choice.finalized_checkpoint[1]
+        anchor_block = src.store.get_block(fin_root)
+        anchor_state = src.state_for_block(fin_root)
+        chain = BeaconChain.from_checkpoint(
+            self.spec, anchor_state.copy(), anchor_block, bls_backend="fake"
+        )
+        node = SimNode(
+            self.hub,
+            f"node{len(self.nodes)}",
+            self.spec,
+            None,
+            [],
+            self.fork_digest,
+            chain=chain,
+        )
+        node.sync.batch_timeout = self.nodes[0].sync.batch_timeout
+        node.chain.on_slot(max(int(n.chain.current_slot) for n in self.nodes))
+        self.nodes.append(node)
+        for other in self.nodes[:-1]:
+            node.service.connect_peer(other.service)
+        self.settle()
+        for other in self.nodes[:-1]:
+            node.sync.add_peer(other.name)
+            other.sync.add_peer(node.name)
+        self.settle()
+        node.sync.tick()
+        self.settle()
+        return node
 
     def settle(self, rounds: int = 50) -> None:
         import time as _time
@@ -200,58 +535,99 @@ class Simulation:
         for n in self.nodes:
             n.chain.on_slot(slot)
         for n in self.nodes:
-            n.vc.on_slot_start(slot)       # propose (duty holder only)
+            if not n.offline:
+                n.vc.on_slot_start(slot)       # propose (duty holder only)
         self.settle()
         for n in self.nodes:
-            n.vc.on_slot_third(slot)       # attest
+            if not n.offline:
+                n.vc.on_slot_third(slot)       # attest
         self.settle()
         for n in self.nodes:
-            n.vc.on_slot_two_thirds(slot)  # aggregate (local pools)
+            if not n.offline:
+                n.vc.on_slot_two_thirds(slot)  # aggregate (local pools)
         self.settle()
+        # the node loop ticks sync every pump (node/client.py tick());
+        # once per slot is the accelerated-clock equivalent
+        for n in self.nodes:
+            n.sync.tick()
+        self.settle()
+
+    def heads(self) -> set:
+        return {bytes(n.chain.head.root) for n in self.nodes}
+
+    def converge(self, max_rounds: int = 64) -> bool:
+        """Post-run drain: keep ticking sync + settling until every
+        node agrees on one head (or rounds run out). Range sync needs
+        a few request->process->request cycles to walk a long gap."""
+        for _ in range(max_rounds):
+            if len(self.heads()) == 1:
+                return True
+            for n in self.nodes:
+                n.sync.tick()
+            self.settle()
+        return len(self.heads()) == 1
 
     def run(
         self,
         until_epoch: int,
         partition: tuple = None,
         heal_margin_epochs: int = 2,
+        faults: list = None,
     ) -> SimChecks:
         """Drive slots until `until_epoch` ends. `partition`
-        = (victim_index, start_slot, end_slot): the victim node is cut
-        from every peer between those slots, then healed and
-        range-synced back (fault injection, transport.py's partition
-        seam)."""
+        = (victim_index, start_slot, end_slot) is legacy sugar for
+        `faults=[Partition([victim_index], start, end)]`."""
         spe = self.spec.preset.slots_per_epoch
         last_slot = until_epoch * spe
         checks = SimChecks()
-        victim = None
-        if partition and self.transport != "inproc":
-            raise ValueError(
-                "partition fault injection needs the in-process hub"
+        faults = list(faults or [])
+        if partition:
+            faults.append(
+                Partition([partition[0]], partition[1], partition[2])
             )
+        if faults and self.transport != "inproc":
+            raise ValueError("fault injection needs the in-process hub")
+        fault_horizon = max((f.horizon for f in faults), default=0)
         for slot in range(1, last_slot + 1):
-            if partition and slot == partition[1]:
-                victim = self.nodes[partition[0]]
-                for other in self.nodes:
-                    if other is not victim:
-                        self.hub.partition(victim.name, other.name)
-            if partition and slot == partition[2]:
-                for other in self.nodes:
-                    if other is not victim:
-                        self.hub.heal(victim.name, other.name)
-                for other in self.nodes:
-                    if other is not victim:
-                        victim.sync.add_peer(other.name)
-                self.settle()
-                victim.sync.tick()
-                self.settle()
+            for f in faults:
+                f.on_slot_start(self, slot)
             self.run_slot(slot)
+            for f in faults:
+                f.on_slot_end(self, slot)
             checks.head_slots.append(
                 max(int(n.chain.head.slot) for n in self.nodes)
             )
+            if slot % spe == 0:
+                checks.finalized_by_epoch[slot // spe] = max(
+                    int(n.chain.head_state().finalized_checkpoint.epoch)
+                    for n in self.nodes
+                )
+            if (
+                checks.convergence_slot is None
+                and slot >= fault_horizon
+                and len(self.heads()) == 1
+            ):
+                checks.convergence_slot = slot
         self.settle()
-        heads = {bytes(n.chain.head.root) for n in self.nodes}
-        checks.consistent_heads = len(heads) == 1
+        if (
+            checks.convergence_slot is None
+            and last_slot >= fault_horizon
+            and len(self.heads()) == 1
+        ):
+            # the final settle finished the job inside the run window
+            checks.convergence_slot = last_slot
+        if len(self.heads()) != 1:
+            # post-run drain: extra sync rounds may still heal the fleet
+            # (consistent_heads reflects it) but convergence_slot stays
+            # None — convergence did NOT happen during the run
+            self.converge()
+        checks.final_heads = sorted(h.hex() for h in self.heads())
+        checks.consistent_heads = len(self.heads()) == 1
         checks.finalized_epoch = max(
+            int(n.chain.head_state().finalized_checkpoint.epoch)
+            for n in self.nodes
+        )
+        checks.min_finalized_epoch = min(
             int(n.chain.head_state().finalized_checkpoint.epoch)
             for n in self.nodes
         )

@@ -11,7 +11,7 @@
 """
 
 from .slashing_protection import SlashingProtectionDB, SlashingProtectionError
-from .signing_method import LocalKeystoreSigner, SigningMethod
+from .signing_method import FakeSigner, LocalKeystoreSigner, SigningMethod
 from .validator_store import ValidatorStore
 from .duties import AttesterDuty, DutiesService, ProposerDuty
 from .client import ValidatorClient
@@ -20,6 +20,7 @@ __all__ = [
     "SlashingProtectionDB",
     "SlashingProtectionError",
     "SigningMethod",
+    "FakeSigner",
     "LocalKeystoreSigner",
     "ValidatorStore",
     "DutiesService",

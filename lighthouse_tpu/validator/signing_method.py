@@ -34,6 +34,30 @@ class LocalKeystoreSigner(SigningMethod):
         return self._pk
 
 
+# the compressed point at infinity: decompresses to the identity in
+# O(1) and aggregates as the identity, so signature bytes stay wire-
+# valid without any curve math
+_INFINITY_SIGNATURE = bytes([0xC0]) + b"\x00" * 95
+
+
+class FakeSigner(SigningMethod):
+    """The signing half of the fake-crypto backend (crypto/bls/src/
+    impls/fake_crypto.rs AggregateSignature::infinity role): a real
+    public key with infinity signatures. Only meaningful against chains
+    running `bls_backend="fake"` — pure-Python G2 ladders dominate
+    multi-node simulation wall clock otherwise, and the fake verifier
+    never looks at the bytes anyway."""
+
+    def __init__(self, secret_key: SecretKey):
+        self._pk = secret_key.public_key().to_bytes()
+
+    def sign(self, signing_root: bytes) -> Signature:
+        return Signature.from_bytes(_INFINITY_SIGNATURE)
+
+    def public_key_bytes(self) -> bytes:
+        return self._pk
+
+
 class Web3SignerMethod(SigningMethod):
     """SigningMethod::Web3Signer: remote HTTP signer. The transport is a
     callable (url, signing_root) -> signature bytes so the HTTP client

@@ -1,0 +1,230 @@
+"""Deterministic chaos-scenario fleet (ISSUE 7): every scenario runs
+the FULL 4-node in-process stack (gossip, rpc, per-chain range sync,
+peer scoring, fork choice, VC duties) on a dwarf-epoch mainnet-layout
+spec, injects one fault family, and asserts the network RE-CONVERGES
+on a single head — the property every scale claim rests on.
+
+Each scenario is seeded (`Simulation(seed=...)` + fault schedules drawn
+from `sim.rng`), in-process, and fast enough for tier-1: this is the
+regression guard for the consensus-failure class that
+tests/test_simulator.py::test_four_nodes_reach_finality_through_fork_
+and_partition (slow) belongs to.
+
+Tier-1 fleet (the six required shapes): full partition, asymmetric
+(deaf-node) partition, equivocating proposer, late proposer,
+withholding peer, non-finality spell. The slow tier adds the 2|2 split
+partition, garbage-serving peer, validator churn, the
+adversarially-scored withholder, and checkpoint sync under load."""
+
+import pytest
+
+from lighthouse_tpu.tools.simulator import (
+    EquivocatingProposer,
+    Fault,
+    LateProposer,
+    OfflineSpell,
+    Partition,
+    Simulation,
+    WithholdingPeer,
+    scenario_spec,
+)
+
+SPE = 4  # dwarf epochs: justification cycles complete in a few slots
+
+
+def _sim(seed: int, n_nodes: int = 4, n_validators: int = 16) -> Simulation:
+    # fake_signing: the chains verify with the fake backend anyway, and
+    # pure-Python G2 ladders would dominate the fleet's tier-1 wall
+    # clock — scenarios exercise sync/fork-choice/convergence, not BLS
+    return Simulation(
+        n_nodes=n_nodes,
+        n_validators=n_validators,
+        spec=scenario_spec(SPE),
+        seed=seed,
+        fake_signing=True,
+    )
+
+
+def _assert_converged(checks, last_slot: int) -> None:
+    assert checks.consistent_heads, (
+        f"heads diverged at scenario end: {checks.final_heads}"
+    )
+    assert checks.convergence_slot is not None
+    # liveness: the chain kept producing through the fault
+    assert checks.head_slots[-1] >= last_slot - 2
+
+
+def test_partition_reconverges():
+    """Satellite: the fast 2-partition convergence guard — one node cut
+    from the other three for an epoch, healed, range-synced back. The
+    class of regression test_simulator.py:28 belongs to, on every PR."""
+    sim = _sim(seed=101)
+    checks = sim.run(
+        until_epoch=5, faults=[Partition([3], 2 * SPE, 3 * SPE)]
+    )
+    _assert_converged(checks, 5 * SPE)
+    # the 3-node majority kept justifying through the cut
+    assert checks.finalized_epoch >= 1, checks.finalized_by_epoch
+
+
+@pytest.mark.slow
+def test_split_partition_2v2_reconverges():
+    """Symmetric 2|2 split: NEITHER side holds a supermajority, so no
+    finality during the cut — after healing both sides must agree on
+    one winner via fork choice over the synced forks."""
+    sim = _sim(seed=202)
+    checks = sim.run(
+        until_epoch=5, faults=[Partition([2, 3], 2 * SPE, 3 * SPE)]
+    )
+    _assert_converged(checks, 5 * SPE)
+
+
+def test_asymmetric_partition_reconverges():
+    """One-way cut: node 3 can SPEAK but not HEAR — its requests leave,
+    every response vanishes (the silent-peer shape stall detection
+    exists for). After healing it must range-sync back."""
+    sim = _sim(seed=303)
+    checks = sim.run(
+        until_epoch=5,
+        faults=[Partition([3], 2 * SPE, 3 * SPE, oneway=True)],
+    )
+    _assert_converged(checks, 5 * SPE)
+
+
+def test_equivocating_proposer_converges():
+    """Every proposer of one epoch double-signs (two conflicting blocks
+    gossiped network-wide): both import everywhere, fork choice picks
+    one winner deterministically, liveness and convergence hold."""
+    sim = _sim(seed=404)
+    slots = [2 * SPE + i for i in range(SPE)]
+    checks = sim.run(until_epoch=4, faults=[EquivocatingProposer(slots)])
+    _assert_converged(checks, 4 * SPE)
+
+
+def test_late_proposer_converges():
+    """One seeded slot per epoch proposes a full slot late (attesters
+    vote the old head, the block lands boost-less next slot)."""
+    sim = _sim(seed=505)
+    late = [e * SPE + sim.rng.randrange(SPE) for e in range(1, 3)]
+    checks = sim.run(until_epoch=4, faults=[LateProposer(late)])
+    _assert_converged(checks, 4 * SPE)
+
+
+def test_withholding_peer_routed_around():
+    """node0 advertises its head but serves EMPTY block responses while
+    node 3 is partitioned behind it. At heal, node 3's range sync must
+    cross-check the empty batch against an honest peer, convict the
+    withholder, and still converge."""
+    sim = _sim(seed=606)
+    checks = sim.run(
+        until_epoch=5,
+        faults=[
+            WithholdingPeer(0, SPE, 4 * SPE),
+            Partition([3], 2 * SPE, 3 * SPE),
+        ],
+    )
+    _assert_converged(checks, 5 * SPE)
+    victim_book = sim.nodes[3].service.peers.peers
+    assert victim_book["node0"].score < victim_book["node2"].score
+
+
+@pytest.mark.slow
+def test_garbage_serving_peer_penalized():
+    """Same shape, nastier peer: node0 serves undecodable bytes. The
+    decode failure penalizes harder and the batch retries elsewhere."""
+    sim = _sim(seed=707)
+    checks = sim.run(
+        until_epoch=5,
+        faults=[
+            WithholdingPeer(0, SPE, 4 * SPE, garbage=True),
+            Partition([3], 2 * SPE, 3 * SPE),
+        ],
+    )
+    _assert_converged(checks, 5 * SPE)
+    victim_book = sim.nodes[3].service.peers.peers
+    assert victim_book["node0"].score < victim_book["node2"].score
+
+
+def test_non_finality_spell_recovers():
+    """Half the stake goes silent for two epochs: justification stops
+    (a non-finality spell), then resumes once they return — finality
+    at the end must be PAST the pre-spell plateau."""
+    sim = _sim(seed=808)
+    checks = sim.run(
+        until_epoch=8,
+        faults=[OfflineSpell([2, 3], 2 * SPE, 4 * SPE)],
+    )
+    _assert_converged(checks, 8 * SPE)
+    plateau = checks.finalized_by_epoch[4]
+    assert checks.finalized_epoch > plateau, checks.finalized_by_epoch
+    # the spell itself never finalized anything new
+    assert checks.finalized_by_epoch[4] == checks.finalized_by_epoch[3]
+
+
+@pytest.mark.slow
+def test_validator_churn_tolerated():
+    """A quarter of the stake churns out for two epochs and returns:
+    below the 1/3 liveness threshold, so finality keeps advancing and
+    the returning node stays converged."""
+    sim = _sim(seed=909)
+    checks = sim.run(
+        until_epoch=6,
+        faults=[OfflineSpell([3], 2 * SPE, 4 * SPE)],
+    )
+    _assert_converged(checks, 6 * SPE)
+    assert checks.finalized_epoch >= 2, checks.finalized_by_epoch
+
+
+@pytest.mark.slow
+def test_checkpoint_sync_under_load():
+    """A fresh node joins mid-run from node0's finalized checkpoint
+    while gossip keeps flowing: it must follow the head via range sync
+    immediately and backfill history below its anchor."""
+    sim = _sim(seed=111)
+    for slot in range(1, 4 * SPE + 1):
+        sim.run_slot(slot)
+    assert sim.nodes[0].chain.fork_choice.finalized_checkpoint[0] >= 1
+    fresh = sim.add_checkpoint_node()
+    anchor_slot = fresh.chain.oldest_block_slot
+    assert anchor_slot > 0
+    for slot in range(4 * SPE + 1, 6 * SPE + 1):
+        sim.run_slot(slot)
+    assert sim.converge()
+    assert fresh.chain.head.root == sim.nodes[0].chain.head.root
+    # backfill marched below the anchor under load
+    assert fresh.chain.oldest_block_slot < anchor_slot
+
+
+class _ScoreNudge(Fault):
+    """Test-local fault: pin a peer's score in one node's book at a
+    slot (deterministic tie-breaks for peer-selection assertions)."""
+
+    def __init__(self, node: int, peer: str, score: float, slot: int):
+        self.node, self.peer = node, peer
+        self.score, self.slot = score, slot
+
+    def on_slot_start(self, sim, slot: int) -> None:
+        if slot == self.slot:
+            sim.nodes[self.node].service.peers.peers[self.peer].score = (
+                self.score
+            )
+
+
+@pytest.mark.slow
+def test_withholder_preferred_peer_still_routed_around():
+    """Adversarial peer selection: the withholder is the BEST-scored
+    peer when the victim heals, so range sync asks it first — the
+    empty-batch cross-check must still route to an honest peer."""
+    sim = _sim(seed=1212)
+    checks = sim.run(
+        until_epoch=5,
+        faults=[
+            WithholdingPeer(1, SPE, 4 * SPE),
+            Partition([3], 2 * SPE, 3 * SPE),
+            _ScoreNudge(3, "node1", 20.0, 3 * SPE - 1),
+        ],
+    )
+    _assert_converged(checks, 5 * SPE)
+    victim_book = sim.nodes[3].service.peers.peers
+    # the withholder bled score relative to its 20-point head start
+    assert victim_book["node1"].score < 20.0

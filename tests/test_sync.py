@@ -1,0 +1,681 @@
+"""Sync POLICY unit tests (ISSUE 7 satellite): drive
+SyncManager.tick() through peer churn, batch timeout, retry
+exhaustion, chain arbitration and the lookup bookkeeping WITHOUT a
+runtime — fake chain/service/processor, an injected clock, and scripted
+RPC responses. The module docstring of network/sync.py promises this
+testability; the integration behavior lives in tests/test_network.py
+and the scenario fleet in tests/test_scenarios.py."""
+
+from types import SimpleNamespace
+
+import pytest
+
+from lighthouse_tpu.consensus.spec import mainnet_spec
+from lighthouse_tpu.network import sync as sync_mod
+from lighthouse_tpu.network.peer_manager import PeerAction, PeerManager
+from lighthouse_tpu.network.rpc import (
+    BlocksByRangeRequest,
+    Protocol,
+    ResponseCode,
+    Status,
+)
+from lighthouse_tpu.network.sync import (
+    BatchState,
+    SyncManager,
+    SyncState,
+)
+from lighthouse_tpu.node.beacon_chain import BlockError, SegmentError
+
+SPEC = mainnet_spec()
+SPE = SPEC.preset.slots_per_epoch
+GENESIS = b"\x00" * 32
+
+
+class FakeClock:
+    def __init__(self, now: float = 0.0):
+        self.now = now
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, dt: float) -> None:
+        self.now += dt
+
+
+class FakeForkChoice:
+    def __init__(self):
+        self.blocks = {GENESIS}
+        self.finalized_checkpoint = (0, GENESIS)
+
+    def contains_block(self, root: bytes) -> bool:
+        return root in self.blocks
+
+
+class FakeChain:
+    """Just the surface SyncManager consumes."""
+
+    def __init__(self):
+        self.spec = SPEC
+        self.fork_choice = FakeForkChoice()
+        self.head = SimpleNamespace(root=GENESIS, slot=0)
+        self.oldest_block_slot = 0
+        self.segments: list = []  # recorded process_chain_segment calls
+        # scripts: callables(blocks) -> roots, or exceptions to raise
+        self.segment_script: list = []
+        self.block_script: list = []
+
+    def process_chain_segment(self, blocks):
+        self.segments.append(list(blocks))
+        if self.segment_script:
+            step = self.segment_script.pop(0)
+            if isinstance(step, Exception):
+                raise step
+            if callable(step):
+                return step(blocks)
+        # default: import everything
+        roots = [b.message.hash_tree_root() for b in blocks]
+        self.fork_choice.blocks.update(roots)
+        return roots
+
+    def process_block(self, block):
+        if self.block_script:
+            step = self.block_script.pop(0)
+            if isinstance(step, Exception):
+                raise step
+        root = block.message.hash_tree_root()
+        self.fork_choice.blocks.add(root)
+        return root
+
+
+class InlineProcessor:
+    """Runs submitted work immediately: sync policy is synchronous."""
+
+    def submit(self, work) -> bool:
+        work.process_individual(work.payload)
+        return True
+
+
+class FakeService:
+    def __init__(self, clock):
+        self.peers = PeerManager(clock=clock)
+        self.requests: list = []  # (peer, proto, payload, callback)
+        self.reports: list = []  # (peer, action)
+
+    def request(self, peer, proto, payload, cb):
+        if not self.peers.is_usable(peer):
+            cb(peer, ResponseCode.RESOURCE_UNAVAILABLE, [])
+            return -1
+        self.requests.append((peer, proto, payload, cb))
+        return len(self.requests) - 1
+
+    def report_peer(self, peer, action):
+        self.reports.append((peer, action))
+        self.peers.report(peer, action)
+
+    # test helpers
+    def pop_requests(self, proto=None):
+        out = [r for r in self.requests if proto is None or r[1] == proto]
+        self.requests = [
+            r for r in self.requests if not (proto is None or r[1] == proto)
+        ]
+        return out
+
+
+class FakeNbp:
+    def __init__(self):
+        self.on_unknown_parent = None
+
+    def local_status(self):
+        return Status.make(
+            fork_digest=b"\x00" * 4,
+            finalized_root=GENESIS,
+            finalized_epoch=0,
+            head_root=GENESIS,
+            head_slot=0,
+        )
+
+
+class FB:
+    """Fake signed block: just enough surface for the sync layer."""
+
+    def __init__(self, root: bytes, parent: bytes = GENESIS, slot: int = 0):
+        self.message = SimpleNamespace(
+            hash_tree_root=lambda: root,
+            parent_root=parent,
+            slot=slot,
+            body=SimpleNamespace(blob_kzg_commitments=[]),
+        )
+
+
+@pytest.fixture()
+def rig(monkeypatch):
+    clock = FakeClock(1000.0)
+    chain = FakeChain()
+    service = FakeService(clock)
+    sm = SyncManager(
+        chain, InlineProcessor(), service, FakeNbp(), clock=clock
+    )
+    sm.status_refresh = 10**9  # keep ticks from re-statusing mid-test
+    # batch chunks carry fake-block markers; the decode seam resolves
+    # them through this registry instead of SSZ
+    registry: dict = {}
+    monkeypatch.setattr(
+        sync_mod, "decode_block_response", lambda spec, raw: registry[raw]
+    )
+    return SimpleNamespace(
+        clock=clock,
+        chain=chain,
+        service=service,
+        sm=sm,
+        registry=registry,
+    )
+
+
+def _connect(rig, *peers):
+    for p in peers:
+        rig.service.peers.connect(p)
+
+
+def _status(head_root: bytes, head_slot: int):
+    return Status.serialize(
+        Status.make(
+            fork_digest=b"\x00" * 4,
+            finalized_root=GENESIS,
+            finalized_epoch=0,
+            head_root=head_root,
+            head_slot=head_slot,
+        )
+    )
+
+
+def _handshake(rig, peer: str, head_root: bytes, head_slot: int):
+    """add_peer + scripted STATUS response."""
+    rig.sm.add_peer(peer)
+    (p, proto, _payload, cb) = rig.service.pop_requests(Protocol.STATUS)[-1]
+    assert p == peer and proto == Protocol.STATUS
+    cb(peer, ResponseCode.SUCCESS, [_status(head_root, head_slot)])
+
+
+def _serve(rig, request, blocks):
+    """Answer a recorded BLOCKS_BY_RANGE request with fake blocks."""
+    peer, proto, payload, cb = request
+    assert proto == Protocol.BLOCKS_BY_RANGE
+    chunks = []
+    for b in blocks:
+        marker = b.message.hash_tree_root() + bytes([len(rig.registry)])
+        rig.registry[marker] = b
+        chunks.append(marker)
+    cb(peer, ResponseCode.SUCCESS, chunks)
+
+
+def _range_of(request) -> tuple:
+    req = BlocksByRangeRequest.deserialize(request[2])
+    return int(req.start_slot), int(req.count)
+
+
+def _mk_chain_blocks(start_slot: int, n: int, tag: bytes = b"\xaa"):
+    """A linked run of fake blocks at consecutive slots."""
+    out, parent = [], GENESIS
+    for i in range(n):
+        root = tag + start_slot.to_bytes(4, "big") + i.to_bytes(4, "big")
+        root = root.ljust(32, b"\x00")
+        out.append(FB(root, parent, start_slot + i))
+        parent = root
+    return out
+
+
+# ------------------------------------------------------- classification
+
+
+def test_status_classifies_peers_into_head_chains(rig):
+    _connect(rig, "p1", "p2", "p3", "p4")
+    a, b = b"\xa1" * 32, b"\xb2" * 32
+    for p in ("p1", "p2", "p3"):
+        _handshake(rig, p, a, 40)
+    _handshake(rig, "p4", b, 90)
+    assert set(rig.sm.chains) == {a, b}
+    assert rig.sm.chains[a].peers == {"p1", "p2", "p3"}
+    assert rig.sm.chains[b].peers == {"p4"}
+    # both chains start at the COMMON point (finalized+1), not our head
+    assert rig.sm.chains[a].start_slot == 1
+    assert rig.sm.chains[b].start_slot == 1
+
+
+def test_known_target_needs_no_chain(rig):
+    _connect(rig, "p1")
+    known = b"\xee" * 32
+    rig.chain.fork_choice.blocks.add(known)
+    _handshake(rig, "p1", known, 12)
+    assert rig.sm.chains == {}
+
+
+def test_arbitration_prefers_peers_not_highest_slot(rig):
+    """Chain selection is NOT 'highest advertised head slot wins': the
+    2-peer chain at slot 40 outranks the 1-peer chain at slot 100."""
+    _connect(rig, "p1", "p2", "p3")
+    a, b = b"\xa1" * 32, b"\xb2" * 32
+    _handshake(rig, "p1", a, 40)
+    _handshake(rig, "p2", a, 40)
+    _handshake(rig, "p3", b, 100)
+    rig.sm.tick()
+    assert rig.sm.state is SyncState.RANGE
+    reqs = rig.service.pop_requests(Protocol.BLOCKS_BY_RANGE)
+    assert reqs and all(r[0] in ("p1", "p2") for r in reqs)
+
+
+def test_chain_switch_after_completion(rig):
+    """When the selected chain's target lands, the next tick retires it
+    and syncs the OTHER chain (chain-switch without manual driving)."""
+    _connect(rig, "p1", "p2", "p3")
+    a, b = b"\xa1" * 32, b"\xb2" * 32
+    _handshake(rig, "p1", a, 3)
+    _handshake(rig, "p2", a, 3)
+    _handshake(rig, "p3", b, 5)
+    rig.sm.tick()
+    req = rig.service.pop_requests(Protocol.BLOCKS_BY_RANGE)[0]
+    assert req[0] in ("p1", "p2")
+    blocks = _mk_chain_blocks(1, 3, b"\xa1")
+    blocks[-1].message.hash_tree_root = lambda: a  # tip IS the target
+    _serve(rig, req, blocks)
+    assert rig.chain.fork_choice.contains_block(a)
+    rig.sm.tick()
+    assert a not in rig.sm.chains and b in rig.sm.chains
+    reqs = rig.service.pop_requests(Protocol.BLOCKS_BY_RANGE)
+    assert reqs and reqs[0][0] == "p3"
+
+
+# ------------------------------------------------------- batch machine
+
+
+def test_batch_timeout_penalizes_and_moves_on(rig):
+    """A silent peer cannot wedge the chain: past batch_timeout the
+    batch re-queues against the next peer and the stall is penalized;
+    the stale response arriving later is ignored."""
+    _connect(rig, "p1", "p2")
+    a = b"\xa1" * 32
+    _handshake(rig, "p1", a, 4)
+    _handshake(rig, "p2", a, 4)
+    rig.service.peers.peers["p1"].score = 5.0  # p1 picked first
+    rig.sm.tick()
+    (req1,) = rig.service.pop_requests(Protocol.BLOCKS_BY_RANGE)
+    assert req1[0] == "p1"
+    rig.clock.advance(rig.sm.batch_timeout + 1)
+    rig.sm.tick()
+    assert ("p1", PeerAction.MID_TOLERANCE) in rig.service.reports
+    (req2,) = rig.service.pop_requests(Protocol.BLOCKS_BY_RANGE)
+    assert req2[0] == "p2"
+    assert _range_of(req2) == _range_of(req1)
+    # stale answer from the silent peer: dropped, chain state unchanged
+    sc = rig.sm.chains[a]
+    before = [b.state for b in sc.batches]
+    _serve(rig, req1, _mk_chain_blocks(1, 4, b"\xa1"))
+    assert [b.state for b in sc.batches] == before
+    assert rig.chain.segments == []
+
+
+def test_retry_exhaustion_drops_the_chain(rig):
+    """After MAX_BATCH_ATTEMPTS failed downloads the chain is abandoned
+    (the advertised target may be gone) instead of retrying forever."""
+    peers = [f"p{i}" for i in range(sync_mod.MAX_BATCH_ATTEMPTS + 1)]
+    _connect(rig, *peers)
+    a = b"\xa1" * 32
+    for p in peers:
+        _handshake(rig, p, a, 4)
+    rig.sm.tick()
+    for _ in range(sync_mod.MAX_BATCH_ATTEMPTS):
+        reqs = rig.service.pop_requests(Protocol.BLOCKS_BY_RANGE)
+        if not reqs:
+            break
+        peer, _proto, _payload, cb = reqs[0]
+        cb(peer, ResponseCode.SERVER_ERROR, [])
+        rig.sm.tick()
+    assert a not in rig.sm.chains
+    # every failed serve was penalized
+    assert len(
+        [r for r in rig.service.reports if r[1] == PeerAction.MID_TOLERANCE]
+    ) >= sync_mod.MAX_BATCH_ATTEMPTS - 1
+
+
+def test_peer_churn_mid_download(rig):
+    """The assigned peer disconnects mid-download: the timeout expires
+    the batch and the surviving peer serves it."""
+    _connect(rig, "p1", "p2")
+    a = b"\xa1" * 32
+    _handshake(rig, "p1", a, 2)
+    _handshake(rig, "p2", a, 2)
+    rig.service.peers.peers["p1"].score = 5.0
+    rig.sm.tick()
+    (req1,) = rig.service.pop_requests(Protocol.BLOCKS_BY_RANGE)
+    assert req1[0] == "p1"
+    rig.service.peers.disconnect("p1")  # churned away, never answers
+    rig.clock.advance(rig.sm.batch_timeout + 1)
+    rig.sm.tick()
+    (req2,) = rig.service.pop_requests(Protocol.BLOCKS_BY_RANGE)
+    assert req2[0] == "p2"
+    blocks = _mk_chain_blocks(1, 2, b"\xa1")
+    blocks[-1].message.hash_tree_root = lambda: a
+    _serve(rig, req2, blocks)
+    assert rig.chain.fork_choice.contains_block(a)
+
+
+def test_no_usable_peer_means_stalled(rig):
+    _connect(rig, "p1")
+    a = b"\xa1" * 32
+    _handshake(rig, "p1", a, 4)
+    rig.service.peers.disconnect("p1")
+    rig.sm.tick()
+    assert rig.sm.state is SyncState.STALLED
+
+
+def test_unknown_parent_restarts_chain_without_penalty(rig):
+    """A segment that doesn't attach is OUR gap, not the peer's fault:
+    no penalty, one chain restart; a second unknown-parent drops it."""
+    _connect(rig, "p1")
+    a = b"\xa1" * 32
+    _handshake(rig, "p1", a, 4)
+    rig.chain.segment_script.append(SegmentError("unknown_parent", "x"))
+    rig.sm.tick()
+    (req1,) = rig.service.pop_requests(Protocol.BLOCKS_BY_RANGE)
+    _serve(rig, req1, _mk_chain_blocks(1, 4, b"\xa1"))
+    assert rig.service.reports == []  # the serving peer took no blame
+    assert a in rig.sm.chains  # restarted, not dropped
+    (req2,) = rig.service.pop_requests(Protocol.BLOCKS_BY_RANGE)
+    assert _range_of(req2)[0] == 1
+    rig.chain.segment_script.append(SegmentError("unknown_parent", "x"))
+    _serve(rig, req2, _mk_chain_blocks(1, 4, b"\xa1"))
+    assert a not in rig.sm.chains  # second restart = unattachable
+    assert rig.service.reports == []
+
+
+def test_invalid_segment_penalizes_and_retries(rig):
+    """not_linked/invalid_block ARE the peer's fault: penalized, and
+    the batch re-issues against the next peer of the chain."""
+    _connect(rig, "p1", "p2")
+    a = b"\xa1" * 32
+    _handshake(rig, "p1", a, 2)
+    _handshake(rig, "p2", a, 2)
+    rig.service.peers.peers["p1"].score = 25.0
+    rig.chain.segment_script.append(SegmentError("not_linked", "x"))
+    rig.sm.tick()
+    (req1,) = rig.service.pop_requests(Protocol.BLOCKS_BY_RANGE)
+    assert req1[0] == "p1"
+    _serve(rig, req1, _mk_chain_blocks(1, 2, b"\xa1"))
+    assert ("p1", PeerAction.LOW_TOLERANCE) in rig.service.reports
+    (req2,) = rig.service.pop_requests(Protocol.BLOCKS_BY_RANGE)
+    assert req2[0] == "p2"
+    blocks = _mk_chain_blocks(1, 2, b"\xa1")
+    blocks[-1].message.hash_tree_root = lambda: a
+    _serve(rig, req2, blocks)
+    assert rig.chain.fork_choice.contains_block(a)
+
+
+def test_empty_batch_needs_second_opinion(rig):
+    """Withholding defense: an empty response is accepted as skipped
+    slots only after a second peer confirms; a second peer that serves
+    blocks instead convicts the withholder."""
+    _connect(rig, "p1", "p2")
+    a = b"\xa1" * 32
+    _handshake(rig, "p1", a, 2)
+    _handshake(rig, "p2", a, 2)
+    rig.service.peers.peers["p1"].score = 5.0
+    rig.sm.tick()
+    (req1,) = rig.service.pop_requests(Protocol.BLOCKS_BY_RANGE)
+    assert req1[0] == "p1"
+    req1[3](req1[0], ResponseCode.SUCCESS, [])  # p1: "nothing there"
+    (req2,) = rig.service.pop_requests(Protocol.BLOCKS_BY_RANGE)
+    assert req2[0] == "p2"  # cross-check went out
+    blocks = _mk_chain_blocks(1, 2, b"\xa1")
+    blocks[-1].message.hash_tree_root = lambda: a
+    _serve(rig, req2, blocks)
+    assert ("p1", PeerAction.MID_TOLERANCE) in rig.service.reports
+    assert rig.chain.fork_choice.contains_block(a)
+
+
+def test_confirmed_empty_batch_is_skipped_slots(rig):
+    _connect(rig, "p1", "p2")
+    a = b"\xa1" * 32
+    _handshake(rig, "p1", a, 2)
+    _handshake(rig, "p2", a, 2)
+    rig.sm.tick()
+    (req1,) = rig.service.pop_requests(Protocol.BLOCKS_BY_RANGE)
+    req1[3](req1[0], ResponseCode.SUCCESS, [])
+    (req2,) = rig.service.pop_requests(Protocol.BLOCKS_BY_RANGE)
+    req2[3](req2[0], ResponseCode.SUCCESS, [])
+    sc = rig.sm.chains[a]
+    assert all(b.state == BatchState.PROCESSED for b in sc.batches)
+    assert rig.service.reports == []  # nobody blamed for real skips
+
+
+# ------------------------------------------------------- lookups
+
+
+def test_failed_lookup_releases_request_slot(rig):
+    """ISSUE 7 satellite: a failed BlocksByRoot response must pop the
+    root — leaving it would permanently block any future lookup for
+    that ancestor and strand its parked children."""
+    _connect(rig, "p1")
+    root = b"\xcc" * 32
+    child = FB(b"\xdd" * 32, parent=root, slot=9)
+    rig.sm.on_unknown_parent("p1", root, child)
+    (req,) = rig.service.pop_requests(Protocol.BLOCKS_BY_ROOT)
+    req[3]("p1", ResponseCode.RESOURCE_UNAVAILABLE, [])
+    assert root not in rig.sm._parent_requests  # slot released
+    # the lookup path is open again for this ancestor
+    rig.sm.on_unknown_parent("p1", root, child)
+    assert rig.service.pop_requests(Protocol.BLOCKS_BY_ROOT)
+
+
+def test_failed_lookup_retries_next_peer_first(rig):
+    _connect(rig, "p1", "p2")
+    root = b"\xcc" * 32
+    rig.sm.on_unknown_parent("p1", root, FB(b"\xdd" * 32, root, 9))
+    (req,) = rig.service.pop_requests(Protocol.BLOCKS_BY_ROOT)
+    req[3]("p1", ResponseCode.RESOURCE_UNAVAILABLE, [])
+    (retry,) = rig.service.pop_requests(Protocol.BLOCKS_BY_ROOT)
+    assert retry[0] == "p2"
+    marker = b"mk-parent"
+    rig.registry[marker] = FB(root, GENESIS, 8)
+    retry[3]("p2", ResponseCode.SUCCESS, [marker])
+    # parent imported and the parked child released behind it
+    assert rig.chain.fork_choice.contains_block(root)
+    assert rig.chain.fork_choice.contains_block(b"\xdd" * 32)
+    assert rig.sm._awaiting_parent == {}
+
+
+def test_released_child_with_racing_parent_requeues(rig):
+    """ISSUE 7 satellite: _release_children must not swallow an
+    unknown-parent error — the child re-enters the lookup path."""
+    _connect(rig, "p1")
+    parent_root = b"\xcc" * 32
+    child = FB(b"\xdd" * 32, parent=parent_root, slot=9)
+    rig.sm._awaiting_parent[parent_root] = [child]
+    rig.chain.block_script.append(BlockError("unknown parent"))
+    rig.sm._release_children("p1", parent_root)
+    # the child went back into the lookup path, not the void
+    assert parent_root in rig.sm._awaiting_parent
+    assert rig.sm._awaiting_parent[parent_root] == [child]
+    assert rig.service.pop_requests(Protocol.BLOCKS_BY_ROOT)
+
+
+def test_sync_metrics_families_registered():
+    from lighthouse_tpu.common import metrics
+
+    for fam in (
+        "sync_state",
+        "sync_chains_active",
+        "sync_batches_total",
+        "sync_peer_penalties_total",
+        "sync_parent_lookups_total",
+    ):
+        assert metrics.get(fam) is not None, fam
+
+
+# ------------------------------------------------------- blame hygiene
+
+
+def test_reclassified_peer_leaves_its_old_chain(rig):
+    """A peer advertises exactly ONE head: a new handshake moves it to
+    the new target's chain, and the abandoned chain is GC'd without
+    blaming anyone — an honest reorged/advanced peer must never eat a
+    target_not_served penalty for a head it no longer claims."""
+    _connect(rig, "p1")
+    a, b = b"\xa1" * 32, b"\xb2" * 32
+    _handshake(rig, "p1", a, 4)
+    _handshake(rig, "p1", b, 8)
+    assert rig.sm.chains[b].peers == {"p1"}
+    assert rig.sm.chains[a].peers == set()
+    rig.sm.tick()
+    assert a not in rig.sm.chains
+    assert rig.service.reports == []
+
+
+def test_banned_supporter_chain_is_gcd_not_stalled(rig):
+    """A chain whose only supporter was BANNED has nobody to sync from
+    or blame: it is GC'd (-> IDLE, backfill unblocked) instead of
+    pinning sync_state=stalled forever. Contrast
+    test_no_usable_peer_means_stalled: score-DISCONNECTED peers may
+    decay back in, so their chains stay."""
+    _connect(rig, "p1")
+    a = b"\xa1" * 32
+    _handshake(rig, "p1", a, 4)
+    rig.service.peers.ban("p1")
+    rig.sm.tick()
+    assert a not in rig.sm.chains
+    assert rig.sm.state is SyncState.IDLE
+    assert rig.service.reports == []
+
+
+def test_withheld_conviction_waits_for_importable_blocks(rig):
+    """The empty-batch cross-check only convicts the empty-serving peer
+    once the contradicting blocks PROVE importable: a peer serving
+    decodable-but-invalid fabrications must not frame an honest
+    empty-server (and is itself penalized for the invalid segment)."""
+    _connect(rig, "p1", "p2")
+    a = b"\xa1" * 32
+    _handshake(rig, "p1", a, 4)
+    _handshake(rig, "p2", a, 4)
+    rig.sm.tick()
+    (req1,) = rig.service.pop_requests(Protocol.BLOCKS_BY_RANGE)
+    first = req1[0]
+    req1[3](first, ResponseCode.SUCCESS, [])  # "that range is empty"
+    (req2,) = rig.service.pop_requests(Protocol.BLOCKS_BY_RANGE)
+    other = req2[0]
+    assert other != first
+    rig.chain.segment_script.append(SegmentError("invalid_block", "x"))
+    _serve(rig, req2, _mk_chain_blocks(1, 4, b"\xa1"))
+    assert (first, PeerAction.MID_TOLERANCE) not in rig.service.reports
+    assert (other, PeerAction.LOW_TOLERANCE) in rig.service.reports
+
+
+def test_withheld_conviction_lands_after_import(rig):
+    """...and once a second peer's blocks DO import, the withholder is
+    convicted."""
+    _connect(rig, "p1", "p2")
+    a = b"\xa1" * 32
+    _handshake(rig, "p1", a, 4)
+    _handshake(rig, "p2", a, 4)
+    rig.sm.tick()
+    (req1,) = rig.service.pop_requests(Protocol.BLOCKS_BY_RANGE)
+    first = req1[0]
+    req1[3](first, ResponseCode.SUCCESS, [])
+    (req2,) = rig.service.pop_requests(Protocol.BLOCKS_BY_RANGE)
+    _serve(rig, req2, _mk_chain_blocks(1, 4, b"\xa1"))  # imports fine
+    assert (first, PeerAction.MID_TOLERANCE) in rig.service.reports
+
+
+def test_restart_recomputes_start_slot(rig):
+    """The one allowed unknown-parent restart rebuilds from a FRESHLY
+    computed common point — the stored start slot is exactly what a
+    racing prune/checkpoint made stale, so retrying from it would fail
+    identically."""
+    _connect(rig, "p1")
+    a = b"\xa1" * 32
+    _handshake(rig, "p1", a, 8)
+    rig.sm.tick()
+    (req1,) = rig.service.pop_requests(Protocol.BLOCKS_BY_RANGE)
+    assert _range_of(req1)[0] == 1
+    # a checkpoint anchor lands while the batch is in flight
+    rig.chain.oldest_block_slot = 3
+    rig.chain.segment_script.append(SegmentError("unknown_parent", "x"))
+    _serve(rig, req1, _mk_chain_blocks(1, 8, b"\xa1"))
+    (req2,) = rig.service.pop_requests(Protocol.BLOCKS_BY_RANGE)
+    assert _range_of(req2)[0] == 4
+
+
+def test_lookup_decode_failure_releases_children(rig):
+    """Terminal decode failure (no peer left to retry) must release the
+    request slot AND the parked children — stranding them permanently
+    eats the _awaiting_parent cap until the lookup path denies service."""
+    _connect(rig, "p1")
+    parent_root = b"\xcc" * 32
+    child = FB(b"\xdd" * 32, parent_root, 9)
+    rig.sm.on_unknown_parent("p1", parent_root, child)
+    (req,) = rig.service.pop_requests(Protocol.BLOCKS_BY_ROOT)
+    req[3]("p1", ResponseCode.SUCCESS, [b"\xff\xfe-undecodable"])
+    assert parent_root not in rig.sm._parent_requests
+    assert parent_root not in rig.sm._awaiting_parent
+
+
+def test_segment_submit_backpressure_requeues_batch(rig):
+    """A processor backpressure drop must NOT wedge the batch in
+    PROCESSING (no timeout covers that state): it returns to
+    AWAITING_PROCESSING and the next tick retries."""
+    _connect(rig, "p1")
+    a = b"\xa1" * 32
+    _handshake(rig, "p1", a, 4)
+    rig.sm.tick()
+    (req1,) = rig.service.pop_requests(Protocol.BLOCKS_BY_RANGE)
+    real_submit = rig.sm.processor.submit
+    rig.sm.processor.submit = lambda w: False  # queue full
+    _serve(rig, req1, _mk_chain_blocks(1, 4, b"\xa1"))
+    (batch,) = rig.sm.chains[a].batches
+    assert batch.state is BatchState.AWAITING_PROCESSING
+    rig.sm.processor.submit = real_submit
+    rig.sm.tick()
+    assert batch.state is BatchState.PROCESSED
+
+
+def test_stale_block_response_rejected_as_bad_range(rig):
+    """A peer answering a range request with an already-known block
+    from OUTSIDE the window must not mark the batch PROCESSED — that
+    would advance processed_through with zero actual progress and later
+    blame the honest supporters when the target never lands."""
+    _connect(rig, "p1")
+    a = b"\xa1" * 32
+    _handshake(rig, "p1", a, 4)
+    rig.sm.tick()
+    (req1,) = rig.service.pop_requests(Protocol.BLOCKS_BY_RANGE)
+    stale = FB(b"\xbb" * 32, GENESIS, 9)  # outside [1, 4]
+    rig.chain.fork_choice.blocks.add(b"\xbb" * 32)
+    _serve(rig, req1, [stale])
+    assert ("p1", PeerAction.LOW_TOLERANCE) in rig.service.reports
+    (batch,) = rig.sm.chains[a].batches
+    assert batch.state is not BatchState.PROCESSED
+
+
+def test_lagging_peer_below_anchor_creates_no_chain(rig):
+    """A checkpoint-anchored node hearing a LAGGING honest peer (head
+    below our common start) must not build an empty pipeline — it would
+    be vacuously complete and penalize the peer for a target nobody
+    ever requested."""
+    rig.chain.oldest_block_slot = 10
+    _connect(rig, "p1")
+    _handshake(rig, "p1", b"\xa9" * 32, 8)
+    assert rig.sm.chains == {}
+    rig.sm.tick()
+    assert rig.service.reports == []
+
+
+def test_abandon_lookup_releases_parked_subtree(rig):
+    """A terminally failed lookup drops the whole parked subtree: a
+    dropped child may itself be a parked parent from a multi-hop walk,
+    and stranding it would leak toward the _awaiting_parent cap."""
+    _connect(rig, "p1")
+    gp, p, c = b"\xe1" * 32, b"\xe2" * 32, b"\xe3" * 32
+    child, parent = FB(c, p, 9), FB(p, gp, 8)
+    rig.sm._awaiting_parent[p] = [child]
+    rig.sm.on_unknown_parent("p1", gp, parent, depth=1)
+    (req,) = rig.service.pop_requests(Protocol.BLOCKS_BY_ROOT)
+    req[3]("p1", ResponseCode.SUCCESS, [])  # empty; no retry peer left
+    assert rig.sm._awaiting_parent == {}
+    assert rig.sm._parent_requests == {}

@@ -66,6 +66,14 @@ REQUIRED_FAMILIES = {
     # gossip ingest (network/network_beacon_processor.py)
     "network_gossip_messages_total": ("kind",),
     "network_gossip_decode_failures_total": ("kind",),
+    # per-chain range sync (network/sync.py, ISSUE 7): state machine
+    # position, live chain count, batch outcomes, penalty + lookup
+    # attribution
+    "sync_state": ("state",),
+    "sync_chains_active": (),
+    "sync_batches_total": ("result",),
+    "sync_peer_penalties_total": ("reason",),
+    "sync_parent_lookups_total": ("result",),
     # chain caches + span aggregation
     "beacon_chain_shuffling_cache_total": ("result",),
     "state_epoch_cache_total": ("cache", "result"),
@@ -95,6 +103,7 @@ def _import_surface(problems: list) -> None:
     jax is already loaded, standalone it is gated to JAX_PLATFORMS=cpu."""
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     import lighthouse_tpu.network.network_beacon_processor  # noqa: F401
+    import lighthouse_tpu.network.sync  # noqa: F401
     import lighthouse_tpu.node.beacon_processor  # noqa: F401
     import lighthouse_tpu.node.caches  # noqa: F401
     import lighthouse_tpu.node.validator_monitor  # noqa: F401
