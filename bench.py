@@ -5,7 +5,9 @@ Prints ONE JSON line:
   {"metric", "value" (config-1 sets/s on the device), "unit",
    "vs_baseline" (vs the blst single-HOST anchor, see below),
    "detail" (all configs, latency percentiles, anchors, per-stage
-   epoch-boundary seconds at 250k/500k under "epoch")}
+   epoch-boundary seconds at 250k/500k under "epoch", the chaos fleet
+   under "scenarios", and the traffic-replay SLO report under "load" —
+   the last three are CPU-side and ship tunnel up or down)}
 
 Baseline anchoring (VERDICT r1 #2): blst is not installable in this
 image, so the denominator is an explicit, documented anchor — NOT the
@@ -453,6 +455,30 @@ def _config_scenarios(detail):
     detail["scenarios"] = out
 
 
+def _config_load(detail):
+    """detail.load (ISSUE 8): the traffic-replay SLO report — per-
+    endpoint latency percentiles, duty-response SLO, shed rate and
+    deadline-miss rate from the load observatory. Pure CPU (in-process
+    fleet + fake BLS), so the serving-path trajectory ships every
+    round, tunnel up or down. The report is the schema-checked
+    LoadReport contract shared with tools/loadgen.py; schema drift is
+    recorded next to the report instead of shipped silently."""
+    from lighthouse_tpu.tools import loadgen
+
+    report = loadgen.run_load(
+        loadgen.LoadgenConfig(
+            vcs=int(os.environ.get("BENCH_LOAD_VCS", "50")),
+            slots=int(os.environ.get("BENCH_LOAD_SLOTS", "8")),
+            seed=7,
+        )
+    )
+    doc = report.to_dict()
+    problems = loadgen.LoadReport.validate(doc)
+    if problems:
+        doc["schema_problems"] = problems
+    detail["load"] = doc
+
+
 def main():
     n_sets = int(os.environ.get("BENCH_SETS", "4096"))
     reps = int(os.environ.get("BENCH_REPS", "5"))
@@ -552,6 +578,16 @@ def main():
     if device is None:
         detail["backend_init"]["error"] = "device never appeared"
         detail["last_self_measured"] = _last_self_measured()
+        # ISSUE 8 bugfix (ROADMAP item 2 prereq): a dead tunnel must
+        # never abort the round — log the tunnel state and still emit
+        # EVERY CPU-side detail section (load/scenarios/epoch)
+        print(
+            "bench: no device backend "
+            f"({attempts[-1]['state'] if attempts else 'no probe ran'}); "
+            "emitting CPU-side detail sections (load/scenarios/epoch)",
+            file=sys.stderr,
+            flush=True,
+        )
         # the epoch boundary trajectory must survive a dead tunnel:
         # force the numpy epoch backend (the jax build's self-check
         # would block in device init, exactly like jax.devices())
@@ -559,6 +595,8 @@ def main():
         _run_config("epoch", 60, _config_epoch)
         # convergence health is chip-independent: ship it every round
         _run_config("scenarios", 60, _config_scenarios)
+        # serving-path SLO curves are chip-independent too (ISSUE 8)
+        _run_config("load", 60, _config_load)
         _emit()
         os._exit(3)
     detail["device"] = device
@@ -615,6 +653,9 @@ def main():
 
     # chaos-scenario convergence summary rides every round (ISSUE 7)
     _run_config("scenarios", 60, _config_scenarios)
+
+    # traffic-replay SLO report rides every round (ISSUE 8)
+    _run_config("load", 60, _config_load)
 
     # ------------- in-repo CPU control (sanity only, NOT the baseline)
     if _left() > 30:

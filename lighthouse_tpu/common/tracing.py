@@ -75,6 +75,11 @@ class Tracer:
         self._lock = threading.Lock()
         self._buf: deque = deque(maxlen=capacity)
         self._tls = threading.local()
+        # monotonic run id for exported traces (ISSUE 8): consumers that
+        # record several runs in one process (loadgen, bench) bump this
+        # so two Chrome-trace exports land on distinguishable process
+        # tracks when diffed side-by-side in Perfetto
+        self._run_id = 1
 
     @property
     def capacity(self) -> int:
@@ -180,10 +185,25 @@ class Tracer:
             "spans": [s.to_json() for s in spans],
         }
 
+    def next_run_id(self) -> int:
+        """Advance and return the monotonic run id (one bump per
+        recorded run — loadgen calls this at replay start)."""
+        with self._lock:
+            self._run_id += 1
+            return self._run_id
+
+    def current_run_id(self) -> int:
+        with self._lock:
+            return self._run_id
+
     def chrome_trace(self, slot=None) -> dict:
         """Chrome-trace ('trace event') JSON: load in chrome://tracing
-        or Perfetto. Complete 'X' events on the perf_counter timeline."""
+        or Perfetto. Complete 'X' events on the perf_counter timeline,
+        preceded by process/thread name metadata ('M') events so two
+        exported runs diff side-by-side on named tracks instead of one
+        anonymous pid/tid soup."""
         pid = os.getpid()
+        run_id = self.current_run_id()
         tids: dict = {}
         events = []
         for s in self.spans(slot=slot):
@@ -202,7 +222,30 @@ class Tracer:
                     "args": args,
                 }
             )
-        return {"traceEvents": events, "displayTimeUnit": "ms"}
+        meta = [
+            {
+                "name": "process_name",
+                "ph": "M",
+                "pid": pid,
+                "tid": 0,
+                "args": {"name": f"lighthouse-tpu run {run_id}"},
+            }
+        ]
+        for thread, tid in sorted(tids.items(), key=lambda kv: kv[1]):
+            meta.append(
+                {
+                    "name": "thread_name",
+                    "ph": "M",
+                    "pid": pid,
+                    "tid": tid,
+                    "args": {"name": thread},
+                }
+            )
+        return {
+            "traceEvents": meta + events,
+            "displayTimeUnit": "ms",
+            "otherData": {"runId": run_id, "pid": pid},
+        }
 
     def clear(self) -> None:
         with self._lock:
@@ -218,3 +261,5 @@ spans = TRACER.spans
 slots = TRACER.slots
 slot_timeline = TRACER.slot_timeline
 chrome_trace = TRACER.chrome_trace
+next_run_id = TRACER.next_run_id
+current_run_id = TRACER.current_run_id

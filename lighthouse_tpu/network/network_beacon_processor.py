@@ -11,6 +11,7 @@ handlers serve blocks/blobs out of the chain's store (rpc_methods.rs).
 
 from __future__ import annotations
 
+import time
 from typing import Callable, Optional
 
 from ..common import metrics
@@ -162,6 +163,12 @@ class NetworkBeaconProcessor:
                 process_batch=batch,
                 payload=att,
                 slot=int(att.data.slot),
+                # slot-relative deadline (ISSUE 8): an unaggregated
+                # attestation is only profitable within roughly its own
+                # slot window — work served later counts as a deadline
+                # miss even when it isn't shed
+                deadline=time.perf_counter()
+                + self.chain.spec.seconds_per_slot,
             )
         )
 

@@ -96,6 +96,15 @@ BATCH_SIZE = metrics.histogram(
     buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096),
     labelnames=("queue",),
 )
+# ISSUE 8: work popped AFTER its slot-relative deadline — the
+# denominator the load-shedding curves (ROADMAP item 4) regress
+# against: shed rate says what we refused, this says what we served
+# too late to matter.
+Q_DEADLINE_MISS = metrics.counter(
+    "beacon_processor_deadline_misses_total",
+    "Work processed after its slot-relative deadline, by queue",
+    labelnames=("queue",),
+)
 
 # children resolved ONCE per queue: the hot path skips the per-call
 # labels() validation + family-lock dict lookup, and every queue's
@@ -106,6 +115,7 @@ _Q_RECEIVED = {t: Q_RECEIVED.labels(queue=t.name) for t in WorkType}
 _Q_DROPPED = {t: Q_DROPPED.labels(queue=t.name) for t in WorkType}
 _Q_PROCESSED = {t: Q_PROCESSED.labels(queue=t.name) for t in WorkType}
 _BATCH_SIZE = {t: BATCH_SIZE.labels(queue=t.name) for t in _BATCH_TYPES}
+_Q_DEADLINE_MISS = {t: Q_DEADLINE_MISS.labels(queue=t.name) for t in WorkType}
 
 
 @dataclass
@@ -120,6 +130,10 @@ class Work:
     # process_batch returns False to request individual fallback
     slot: Optional[int] = None  # anchors the scheduler span to a slot
     enqueued_at: float = 0.0  # stamped by submit(); feeds Q_WAIT
+    # slot-relative deadline (perf_counter time) stamped by the
+    # submitter: an attestation is worthless once its slot's inclusion
+    # window closed. None = no deadline (blocks, API work).
+    deadline: Optional[float] = None
 
 
 @dataclass
@@ -254,9 +268,14 @@ class BeaconProcessor:
         kind = batch[0].kind
         now = time.perf_counter()
         wait = _Q_WAIT[kind]
+        misses = _Q_DEADLINE_MISS[kind]
         for w in batch:
             if w.enqueued_at:
+                # queue age at dequeue (ISSUE 8): the wait series IS the
+                # age attribution — deadline misses are the tail of it
                 wait.observe(now - w.enqueued_at)
+            if w.deadline is not None and now > w.deadline:
+                misses.inc()
         if kind in _BATCH_TYPES:
             _BATCH_SIZE[kind].observe(len(batch))
         return batch

@@ -165,27 +165,152 @@ class EarlyAttesterCache:
             return None
 
 
+# ISSUE 8: the slow-subscriber drop counter lives next to the overflow
+# check (emit-side fanout). The SSE send-side series (events sent, lag)
+# live in node/http_api.py where frames actually hit the socket.
+_SSE_SLOW_DROPPED = metrics.counter(
+    "http_sse_slow_clients_dropped_total",
+    "SSE subscriptions dropped after their bounded event queue "
+    "overflowed (stalled slow client)",
+)
+
+
+class SseSubscription:
+    """One subscriber's bounded event queue. The bus appends at emit
+    time (non-blocking); the SSE handler thread drains via `poll`. A
+    full queue marks the subscription dropped instead of blocking the
+    emitter — a stalled client can never stall the broadcast fanout."""
+
+    __slots__ = ("topics", "capacity", "queue", "dropped", "_bus")
+
+    def __init__(self, bus, topics, capacity: int):
+        self._bus = bus
+        self.topics = topics
+        self.capacity = capacity
+        self.queue = collections.deque()
+        self.dropped = False
+
+    def poll(self, timeout: float = 0.0) -> list:
+        """Drain queued events, blocking up to `timeout` for the first.
+        Returns immediately (possibly empty) once the subscription has
+        been marked dropped."""
+        import time as _time
+
+        cv = self._bus._cv
+        deadline = _time.monotonic() + timeout
+        with cv:
+            while True:
+                if self.queue:
+                    out = list(self.queue)
+                    self.queue.clear()
+                    return out
+                if self.dropped:
+                    return []
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0:
+                    return []
+                cv.wait(remaining)
+
+
 class EventBus:
     """Bounded per-topic event queues for the SSE endpoint
     (events.rs ServerSentEventHandler role). Topics: head, block,
-    finalized_checkpoint, attestation, chain_reorg."""
+    finalized_checkpoint, attestation, chain_reorg.
+
+    Two consumption modes: `poll_since` (stateless cursor over the
+    shared history ring) and `subscribe` (ISSUE 8: a bounded
+    per-subscriber queue filled at emit time, the SSE serving path).
+    Every event is stamped with its emit time (`"t"`, perf_counter) so
+    the send side can attribute stream lag."""
 
     TOPICS = ("head", "block", "finalized_checkpoint", "attestation", "chain_reorg")
 
-    def __init__(self, capacity: int = 256):
+    def __init__(self, capacity: int = 256, subscriber_capacity: int = 256):
         self._buf = collections.deque(maxlen=capacity)
         self._cv = threading.Condition()
         self._seq = 0
+        self._subs: list = []
+        self.subscriber_capacity = subscriber_capacity
 
     def emit(self, topic: str, data: dict) -> None:
+        import time as _time
+
         with self._cv:
             self._seq += 1
-            self._buf.append({"seq": self._seq, "event": topic, "data": data})
+            ev = {
+                "seq": self._seq,
+                "event": topic,
+                "data": data,
+                "t": _time.perf_counter(),
+            }
+            self._buf.append(ev)
+            for sub in self._subs:
+                if sub.topics is not None and topic not in sub.topics:
+                    continue
+                if sub.dropped:
+                    continue
+                if len(sub.queue) >= sub.capacity:
+                    # never block the fanout on a stalled client: mark
+                    # it dropped (its handler terminates the stream and
+                    # the client reconnects with Last-Event-ID)
+                    sub.dropped = True
+                    _SSE_SLOW_DROPPED.inc()
+                else:
+                    sub.queue.append(ev)
             self._cv.notify_all()
 
     def current_seq(self) -> int:
         with self._cv:
             return self._seq
+
+    def oldest_retained_seq(self) -> int:
+        """Smallest seq still in the history ring (resume floor)."""
+        with self._cv:
+            return self._buf[0]["seq"] if self._buf else self._seq + 1
+
+    # ------------------------------------------------------ subscriptions
+
+    def subscribe(
+        self, topics=None, since_seq: int = None, capacity: int = None
+    ) -> SseSubscription:
+        """Register a bounded subscription. `since_seq` (Last-Event-ID
+        resume) pre-seeds the queue with retained history newer than
+        that seq; None starts at the live edge (beacon-API semantics:
+        no history replay for fresh clients)."""
+        import time as _time
+
+        sub = SseSubscription(
+            self, set(topics) if topics is not None else None,
+            capacity or self.subscriber_capacity,
+        )
+        with self._cv:
+            if since_seq is not None:
+                now = _time.perf_counter()
+                for e in self._buf:
+                    if e["seq"] > since_seq and (
+                        sub.topics is None or e["event"] in sub.topics
+                    ):
+                        if len(sub.queue) >= sub.capacity:
+                            sub.dropped = True
+                            _SSE_SLOW_DROPPED.inc()
+                            break
+                        # re-stamp replayed history at resume time: the
+                        # lag series measures LIVE delivery, not how old
+                        # the ring's retained events happen to be
+                        sub.queue.append({**e, "t": now})
+            self._subs.append(sub)
+        return sub
+
+    def unsubscribe(self, sub: SseSubscription) -> None:
+        with self._cv:
+            try:
+                self._subs.remove(sub)
+            except ValueError:
+                pass
+
+    def subscriber_count(self) -> int:
+        with self._cv:
+            return len(self._subs)
 
     def poll_since(self, seq: int, topics=None, timeout: float = 0.0) -> list:
         """Events newer than `seq`, blocking up to `timeout` for one."""

@@ -1,0 +1,664 @@
+"""Deterministic traffic-replay harness (ISSUE 8): the load half of
+ROADMAP item 4 ("serve a million users").
+
+Spins ONE full node assembly (the PR 7 simulator seams: BeaconChain +
+BeaconProcessor + NetworkService + VC on the in-process hub), serves it
+over a real `ApiServer` socket, then replays a seeded traffic shape
+against it:
+
+  - N simulated validator clients pulling duties (attester / proposer /
+    sync), polling heads, states and sync status over HTTP — the
+    request mix a real VC population generates;
+  - SSE subscribers following head/block events while slots advance;
+  - a per-slot synthetic gossip burst sized off a SIMULATED network
+    validator count (default 1M), submitted to the node's
+    beacon_processor with slot-relative deadlines — a deterministic
+    fraction arrives already stale, so the deadline-miss and shed
+    series have known-nonzero denominators.
+
+Everything randomized is drawn from `random.Random(seed)`, so the
+report SHAPE (request schedule, gossip burst sizes, which items are
+stale, how many submissions overflow the queue) is reproducible
+run-to-run; only the measured latencies vary.
+
+The emitted `LoadReport` is the schema-checked contract shared with
+`bench.py` (`detail.load`) and gated in tier-1 by
+`tests/test_loadgen.py`: per-endpoint p50/p95/p99, duty-response SLO
+percentiles, shed rate, deadline-miss rate, SSE delivery counters.
+
+CLI: `python tools/loadgen.py --vcs 200 --seed 7`.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import math
+import random
+import socket
+import statistics
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor, wait
+from dataclasses import asdict, dataclass
+
+from ..common import metrics, tracing
+
+SCHEMA = "lighthouse-tpu/load-report/v1"
+MAINNET_SLOTS_PER_EPOCH = 32  # the simulated network's slot cadence
+
+
+class LoadgenError(RuntimeError):
+    """Fleet failed to start or the replay could not run."""
+
+
+# ------------------------------------------------------------ the report
+
+
+@dataclass
+class LoadReport:
+    """The schema-checked run report (shared with bench.py detail.load).
+
+    `validate` is the contract: bench records any problems next to the
+    report instead of shipping a silently-misshapen section, and the
+    tier-1 gate asserts it comes back empty."""
+
+    seed: int
+    vcs: int
+    slots: int
+    simulated_validators: int
+    gossip_submitted: int
+    wall_s: float
+    requests_total: int
+    errors_total: int
+    endpoints: dict  # name -> {requests, errors, p50_ms, p95_ms, p99_ms}
+    duty_response_ms: dict  # {count, p50, p95, p99}
+    shed: dict  # {received, dropped, rate}
+    deadline: dict  # {processed, misses, rate}
+    sse: dict  # {subscribers, events_received, events_sent, slow_client_drops}
+    schema: str = SCHEMA
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+    _ENDPOINT_KEYS = ("requests", "errors", "p50_ms", "p95_ms", "p99_ms")
+    _SECTION_KEYS = {
+        "duty_response_ms": ("count", "p50", "p95", "p99"),
+        "shed": ("received", "dropped", "rate"),
+        "deadline": ("processed", "misses", "rate"),
+        "sse": (
+            "subscribers",
+            "events_received",
+            "events_sent",
+            "slow_client_drops",
+        ),
+    }
+
+    @classmethod
+    def validate(cls, doc: dict) -> list:
+        """Schema problems (empty = conforming)."""
+        problems = []
+        if not isinstance(doc, dict):
+            return [f"report is {type(doc).__name__}, not dict"]
+        if doc.get("schema") != SCHEMA:
+            problems.append(
+                f"schema {doc.get('schema')!r} != required {SCHEMA!r}"
+            )
+        for f_ in cls.__dataclass_fields__:
+            if f_ not in doc:
+                problems.append(f"missing top-level key {f_!r}")
+        for name, entry in (doc.get("endpoints") or {}).items():
+            for k in cls._ENDPOINT_KEYS:
+                if k not in entry:
+                    problems.append(f"endpoints[{name!r}] missing {k!r}")
+        for section, keys in cls._SECTION_KEYS.items():
+            sub = doc.get(section)
+            if not isinstance(sub, dict):
+                continue  # absence already reported above
+            for k in keys:
+                if k not in sub:
+                    problems.append(f"{section} missing {k!r}")
+        return problems
+
+
+@dataclass
+class LoadgenConfig:
+    vcs: int = 200  # simulated validator clients
+    seed: int = 7
+    slots: int = 8  # replay horizon (after warmup)
+    slots_per_epoch: int = 4  # dwarf epochs (scenario_spec)
+    n_validators: int = 16  # real validators backing the fleet
+    warmup_epochs: int = 2  # build finality + warm caches first
+    simulated_validators: int = 1_000_000  # network size the rates model
+    # fraction of the simulated per-slot attestation rate actually
+    # submitted as Work (1M/32 per slot is ~31k objects — the shape,
+    # not the count, is what the observatory measures)
+    gossip_scale: float = 1 / 64.0
+    stale_fraction: float = 0.10  # arrive past their slot deadline
+    attestation_queue_cap: int = 384  # bounded: the burst overflows it
+    attestation_batch_cap: int = 256
+    http_workers: int = 8
+    sse_subscribers: int = 2
+    request_timeout_s: float = 10.0
+    extra_slow_ms: float = 0.0  # per-batch verify stall (stress shapes)
+
+    @property
+    def gossip_per_slot(self) -> int:
+        return max(
+            1,
+            int(
+                self.simulated_validators
+                / MAINNET_SLOTS_PER_EPOCH
+                * self.gossip_scale
+            ),
+        )
+
+
+# the SLO headline: duty pulls are what a million VCs block on
+DUTY_ENDPOINTS = ("duties_attester", "duties_proposer", "duties_sync")
+
+
+def _pcts_ms(xs: list) -> dict:
+    """Nearest-rank percentiles in milliseconds (bench.py convention:
+    p99 is never below the true 99th percentile)."""
+    if not xs:
+        return {"count": 0, "p50": None, "p95": None, "p99": None}
+    xs = sorted(xs)
+    n = len(xs)
+
+    def rank(p):
+        return xs[min(n - 1, max(0, math.ceil(n * p) - 1))]
+
+    return {
+        "count": n,
+        "p50": round(statistics.median(xs) * 1e3, 3),
+        "p95": round(rank(0.95) * 1e3, 3),
+        "p99": round(rank(0.99) * 1e3, 3),
+    }
+
+
+def _counter_value(name: str, **labels) -> float:
+    fam = metrics.get(name)
+    if fam is None:
+        return 0.0
+    try:
+        if labels:
+            return fam.labels(**labels).value
+        return fam.value
+    except Exception:
+        return 0.0
+
+
+# ------------------------------------------------------------ the fleet
+
+
+class _Fleet:
+    """One node + API server + SSE subscribers under replay."""
+
+    def __init__(self, cfg: LoadgenConfig):
+        self.cfg = cfg
+        self.sim = None
+        self.server = None
+        try:
+            from ..node.beacon_processor import WorkType
+            from ..node.http_api import ApiServer, BeaconApi
+            from .simulator import Simulation, scenario_spec
+
+            self.WorkType = WorkType
+            self.sim = Simulation(
+                n_nodes=1,
+                n_validators=cfg.n_validators,
+                spec=scenario_spec(cfg.slots_per_epoch),
+                seed=cfg.seed,
+                fake_signing=True,
+            )
+            self.node = self.sim.nodes[0]
+            # bounded, validator-count-flavored queue for the replay:
+            # the burst must overflow it DETERMINISTICALLY so the shed
+            # series has a reproducible denominator
+            proc = self.node.processor
+            proc.config.queue_capacities[WorkType.GOSSIP_ATTESTATION] = (
+                cfg.attestation_queue_cap
+            )
+            proc.config.max_gossip_attestation_batch_size = (
+                cfg.attestation_batch_cap
+            )
+            self.slot = 0
+            for _ in range(cfg.warmup_epochs * cfg.slots_per_epoch):
+                self.slot += 1
+                self.sim.run_slot(self.slot)
+            self.server = ApiServer(
+                BeaconApi(self.node.chain, sync=self.node.sync),
+                host="127.0.0.1",
+                port=0,
+            )
+            self.server.start()
+        except Exception as e:
+            # a long-lived caller (bench) records the error and moves
+            # on — never leak a half-built fleet's sockets/assembly
+            self.close()
+            raise LoadgenError(f"fleet failed to start: {e}") from e
+        self._lock = threading.Lock()
+        self._samples: dict = {}  # endpoint -> [seconds]
+        self._errors: dict = {}  # endpoint -> count
+        self._sse_counts: list = []
+        self._sse_stop = threading.Event()
+        self._sse_threads: list = []
+
+    # ---------------------------------------------------------- http side
+
+    def _do_request(self, spec_: tuple) -> None:
+        endpoint, method, path, body = spec_
+        t0 = time.perf_counter()
+        status = 0
+        try:
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", self.server.port,
+                timeout=self.cfg.request_timeout_s,
+            )
+            try:
+                headers = {}
+                if body is not None:
+                    headers["Content-Type"] = "application/json"
+                conn.request(method, path, body=body, headers=headers)
+                resp = conn.getresponse()
+                resp.read()
+                status = resp.status
+            finally:
+                conn.close()
+        except Exception:
+            status = 0
+        dt = time.perf_counter() - t0
+        with self._lock:
+            self._samples.setdefault(endpoint, []).append(dt)
+            if not 200 <= status < 300:
+                self._errors[endpoint] = self._errors.get(endpoint, 0) + 1
+
+    def _slot_schedule(
+        self, rng: random.Random, slot: int, first: bool = False
+    ) -> list:
+        """The seeded request mix one slot of VC traffic generates."""
+        cfg = self.cfg
+        spe = cfg.slots_per_epoch
+        epoch = slot // spe
+        out = []
+        for vc in range(cfg.vcs):
+            ids = json.dumps(
+                [
+                    str(vc % cfg.n_validators),
+                    str((vc + 7) % cfg.n_validators),
+                ]
+            )
+            if first or slot % spe == 0:
+                # VC startup (first replay slot) and every epoch
+                # rollover: the whole population re-pulls its duty
+                # tables — the SLO headline always has samples, even on
+                # replays too short to cross an epoch boundary
+                out.append(
+                    (
+                        "duties_attester",
+                        "POST",
+                        f"/eth/v1/validator/duties/attester/{epoch}",
+                        ids,
+                    )
+                )
+                out.append(
+                    (
+                        "duties_proposer",
+                        "GET",
+                        f"/eth/v1/validator/duties/proposer/{epoch}",
+                        None,
+                    )
+                )
+                out.append(
+                    (
+                        "duties_sync",
+                        "POST",
+                        f"/eth/v1/validator/duties/sync/{epoch}",
+                        ids,
+                    )
+                )
+            r = rng.random()
+            if r < 0.8:
+                out.append(
+                    ("headers_head", "GET", "/eth/v1/beacon/headers/head", None)
+                )
+            if r < 0.3:
+                out.append(("syncing", "GET", "/eth/v1/node/syncing", None))
+            if r < 0.2:
+                out.append(
+                    (
+                        "state_root",
+                        "GET",
+                        "/eth/v1/beacon/states/head/root",
+                        None,
+                    )
+                )
+            if r < 0.1:
+                out.append(
+                    (
+                        "validators",
+                        "GET",
+                        "/eth/v1/beacon/states/head/validators?id="
+                        f"{vc % cfg.n_validators}",
+                        None,
+                    )
+                )
+            if r < 0.05:
+                out.append(
+                    (
+                        "finality_checkpoints",
+                        "GET",
+                        "/eth/v1/beacon/states/head/finality_checkpoints",
+                        None,
+                    )
+                )
+        rng.shuffle(out)
+        return out
+
+    # --------------------------------------------------------- gossip side
+
+    def _inject_gossip(self, rng: random.Random, slot: int) -> int:
+        """One slot's synthetic attestation burst: Work with
+        slot-relative deadlines through the real scheduler + fake-BLS
+        dispatch seam. Returns the number submitted."""
+        from ..crypto import bls
+        from ..node.beacon_processor import Work
+
+        cfg = self.cfg
+        proc = self.node.processor
+        n = cfg.gossip_per_slot
+        extra = cfg.extra_slow_ms / 1e3
+
+        def batch(payloads) -> bool:
+            if extra:
+                time.sleep(extra)
+            return bool(
+                bls.verify_signature_sets(
+                    payloads, backend="fake",
+                    rand_scalars=[1] * len(payloads),
+                )
+            )
+
+        def individual(p) -> None:
+            bls.verify_signature_sets([p], backend="fake", rand_scalars=[1])
+
+        now = time.perf_counter()
+        for i in range(n):
+            stale = rng.random() < cfg.stale_fraction
+            proc.submit(
+                Work(
+                    kind=self.WorkType.GOSSIP_ATTESTATION,
+                    process_individual=individual,
+                    process_batch=batch,
+                    payload=i,
+                    slot=slot,
+                    # stale items model arrival AFTER their slot's
+                    # inclusion window — deterministic deadline misses
+                    deadline=now - 1e-4 if stale else now + 60.0,
+                )
+            )
+        return n
+
+    # ------------------------------------------------------------ sse side
+
+    def _sse_reader(self, idx: int) -> None:
+        count = 0
+        try:
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", self.server.port, timeout=2.0
+            )
+            conn.request(
+                "GET", "/eth/v1/events?topics=head,block",
+                headers={"Accept": "text/event-stream"},
+            )
+            resp = conn.getresponse()
+            while not self._sse_stop.is_set():
+                try:
+                    line = resp.fp.readline()
+                except (socket.timeout, OSError):
+                    continue
+                if not line:
+                    break
+                if line.startswith(b"event: "):
+                    count += 1
+            conn.close()
+        except Exception:
+            pass
+        with self._lock:
+            self._sse_counts.append(count)
+
+    def start_sse(self) -> None:
+        for i in range(self.cfg.sse_subscribers):
+            t = threading.Thread(
+                target=self._sse_reader, args=(i,), daemon=True
+            )
+            t.start()
+            self._sse_threads.append(t)
+        # subscriptions must exist before the first replayed slot's
+        # events fire (the report counts delivered events)
+        deadline = time.monotonic() + 2.0
+        bus = self.node.chain.event_bus
+        while (
+            bus.subscriber_count() < self.cfg.sse_subscribers
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.01)
+
+    def stop_sse(self) -> None:
+        self._sse_stop.set()
+        for t in self._sse_threads:
+            t.join(timeout=5.0)
+
+    # -------------------------------------------------------------- replay
+
+    def replay(self) -> LoadReport:
+        cfg = self.cfg
+        # independent streams: the request mix and the gossip staleness
+        # schedule stay reproducible regardless of each other
+        rng_http = random.Random(cfg.seed)
+        rng_gossip = random.Random(cfg.seed + 1)
+        att = self.WorkType.GOSSIP_ATTESTATION.name
+        before = {
+            "received": _counter_value(
+                "beacon_processor_work_received_total", queue=att
+            ),
+            "dropped": _counter_value(
+                "beacon_processor_work_dropped_total", queue=att
+            ),
+            "processed": _counter_value(
+                "beacon_processor_work_processed_total", queue=att
+            ),
+            "misses": _counter_value(
+                "beacon_processor_deadline_misses_total", queue=att
+            ),
+            "sse_sent": self._sse_sent_total(),
+            "sse_drops": _counter_value(
+                "http_sse_slow_clients_dropped_total"
+            ),
+        }
+        gossip_submitted = 0
+        t_start = time.perf_counter()
+        self.start_sse()
+        pool = ThreadPoolExecutor(max_workers=cfg.http_workers)
+        try:
+            for i in range(cfg.slots):
+                self.slot += 1
+                # 1. the chain advances (block production, events to SSE)
+                self.sim.run_slot(self.slot)
+                # 2. the slot's gossip burst lands (deterministic
+                #    overflow of the bounded attestation queue)
+                gossip_submitted += self._inject_gossip(
+                    rng_gossip, self.slot
+                )
+                # 3. the slot's HTTP traffic fires while the node works
+                #    the backlog off — requests contend with verification
+                futures = [
+                    pool.submit(self._do_request, s)
+                    for s in self._slot_schedule(
+                        rng_http, self.slot, first=(i == 0)
+                    )
+                ]
+                while self.node.processor.step():
+                    pass
+                wait(futures, timeout=cfg.request_timeout_s * 4)
+        finally:
+            pool.shutdown(wait=True)
+            self.stop_sse()
+        wall = time.perf_counter() - t_start
+
+        endpoints = {}
+        duty_samples = []
+        requests_total = errors_total = 0
+        with self._lock:
+            samples = {k: list(v) for k, v in self._samples.items()}
+            errors = dict(self._errors)
+            sse_counts = list(self._sse_counts)
+        for name in sorted(samples):
+            xs = samples[name]
+            errs = errors.get(name, 0)
+            requests_total += len(xs)
+            errors_total += errs
+            p = _pcts_ms(xs)
+            endpoints[name] = {
+                "requests": len(xs),
+                "errors": errs,
+                "p50_ms": p["p50"],
+                "p95_ms": p["p95"],
+                "p99_ms": p["p99"],
+            }
+            if name in DUTY_ENDPOINTS:
+                duty_samples.extend(xs)
+
+        received = (
+            _counter_value(
+                "beacon_processor_work_received_total", queue=att
+            )
+            - before["received"]
+        )
+        dropped = (
+            _counter_value(
+                "beacon_processor_work_dropped_total", queue=att
+            )
+            - before["dropped"]
+        )
+        processed = (
+            _counter_value(
+                "beacon_processor_work_processed_total", queue=att
+            )
+            - before["processed"]
+        )
+        misses = (
+            _counter_value(
+                "beacon_processor_deadline_misses_total", queue=att
+            )
+            - before["misses"]
+        )
+        return LoadReport(
+            seed=cfg.seed,
+            vcs=cfg.vcs,
+            slots=cfg.slots,
+            simulated_validators=cfg.simulated_validators,
+            gossip_submitted=gossip_submitted,
+            wall_s=round(wall, 3),
+            requests_total=requests_total,
+            errors_total=errors_total,
+            endpoints=endpoints,
+            duty_response_ms=_pcts_ms(duty_samples),
+            shed={
+                "received": int(received),
+                "dropped": int(dropped),
+                "rate": round(dropped / received, 6) if received else 0.0,
+            },
+            deadline={
+                "processed": int(processed),
+                "misses": int(misses),
+                "rate": round(misses / processed, 6) if processed else 0.0,
+            },
+            sse={
+                "subscribers": len(sse_counts),
+                "events_received": int(sum(sse_counts)),
+                "events_sent": int(
+                    self._sse_sent_total() - before["sse_sent"]
+                ),
+                "slow_client_drops": int(
+                    _counter_value("http_sse_slow_clients_dropped_total")
+                    - before["sse_drops"]
+                ),
+            },
+        )
+
+    @staticmethod
+    def _sse_sent_total() -> float:
+        fam = metrics.get("http_sse_events_sent_total")
+        if fam is None:
+            return 0.0
+        return sum(fam.labels(*lv).value for lv in fam.label_values())
+
+    def close(self) -> None:
+        if self.server is not None:
+            try:
+                self.server.stop()
+            except Exception:
+                pass
+        if self.sim is not None:
+            try:
+                self.sim.close()
+            except Exception:
+                pass
+
+
+def run_load(cfg: LoadgenConfig = None, **kw) -> LoadReport:
+    """Build the fleet, replay the seeded traffic shape, return the
+    report. Raises LoadgenError when the fleet can't start (bench.py
+    degrades to recording the error instead of the section)."""
+    cfg = cfg or LoadgenConfig(**kw)
+    # distinct Perfetto track per run: two exported traces diff
+    # side-by-side instead of merging into one anonymous process
+    tracing.next_run_id()
+    fleet = _Fleet(cfg)
+    try:
+        return fleet.replay()
+    finally:
+        fleet.close()
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="deterministic traffic-replay load harness"
+    )
+    ap.add_argument("--vcs", type=int, default=200)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--slots", type=int, default=8)
+    ap.add_argument("--validators", type=int, default=16)
+    ap.add_argument(
+        "--simulated-validators", type=int, default=1_000_000
+    )
+    ap.add_argument("--gossip-scale", type=float, default=1 / 64.0)
+    ap.add_argument("--http-workers", type=int, default=8)
+    ap.add_argument("--sse-subscribers", type=int, default=2)
+    args = ap.parse_args(argv)
+    try:
+        report = run_load(
+            LoadgenConfig(
+                vcs=args.vcs,
+                seed=args.seed,
+                slots=args.slots,
+                n_validators=args.validators,
+                simulated_validators=args.simulated_validators,
+                gossip_scale=args.gossip_scale,
+                http_workers=args.http_workers,
+                sse_subscribers=args.sse_subscribers,
+            )
+        )
+    except LoadgenError as e:
+        print(json.dumps({"error": str(e), "schema": SCHEMA}))
+        return 1
+    doc = report.to_dict()
+    problems = LoadReport.validate(doc)
+    if problems:
+        doc["schema_problems"] = problems
+    print(json.dumps(doc, indent=2))
+    return 1 if problems else 0

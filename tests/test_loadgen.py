@@ -1,0 +1,383 @@
+"""Load observatory gates (ISSUE 8): the traffic-replay harness's
+report schema + SLO smoke gate, the serving-path series contract, the
+deadline/shed attribution, and SSE-under-concurrency semantics
+(multiple subscribers, slow-client drop at the emit fanout, resume via
+Last-Event-ID).
+
+The tier-1 fleet here is deliberately small (a one-node assembly, a
+dozen VCs, four slots); the heavy replay shape is slow-marked."""
+
+import http.client
+import json
+import socket
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from lighthouse_tpu.common import metrics
+from lighthouse_tpu.node.caches import EventBus
+from lighthouse_tpu.tools import loadgen
+
+
+def _small_cfg(**kw):
+    base = dict(
+        vcs=16,
+        seed=7,
+        slots=4,
+        n_validators=16,
+        warmup_epochs=2,
+        gossip_scale=1 / 64.0,
+        http_workers=6,
+        sse_subscribers=2,
+    )
+    base.update(kw)
+    return loadgen.LoadgenConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def small_report():
+    return loadgen.run_load(_small_cfg()).to_dict()
+
+
+# --------------------------------------------------------- report + SLO
+
+
+def test_report_schema_validates(small_report):
+    assert loadgen.LoadReport.validate(small_report) == []
+    # a mangled report is caught, not shipped
+    broken = dict(small_report)
+    broken.pop("shed")
+    broken["schema"] = "nope"
+    problems = loadgen.LoadReport.validate(broken)
+    assert any("shed" in p for p in problems)
+    assert any("schema" in p for p in problems)
+
+
+def test_slo_p99_duty_response_under_budget(small_report):
+    """The tier-1 SLO smoke gate: duty pulls are what a million VCs
+    block on — p99 must stay under a generous CI-safe budget."""
+    duty = small_report["duty_response_ms"]
+    assert duty["count"] > 0, "no duty requests were replayed"
+    assert duty["p99"] is not None and duty["p99"] < 2000.0, duty
+    # every duty endpoint appears in the per-endpoint table
+    for ep in loadgen.DUTY_ENDPOINTS:
+        assert ep in small_report["endpoints"], ep
+        entry = small_report["endpoints"][ep]
+        assert entry["requests"] > 0
+        assert entry["p99_ms"] is not None
+
+
+def test_replay_was_real_traffic(small_report):
+    assert small_report["requests_total"] > 50
+    # the node must actually answer: a broken fleet serving 100% errors
+    # would otherwise still "pass" the latency gate
+    assert (
+        small_report["errors_total"]
+        <= 0.1 * small_report["requests_total"]
+    )
+    assert small_report["sse"]["subscribers"] == 2
+    assert small_report["sse"]["events_received"] > 0
+
+
+def test_shed_and_deadline_rates_have_denominators(small_report):
+    """The burst overflows the bounded attestation queue and a seeded
+    fraction arrives stale: both regression curves get known-nonzero
+    numerators AND denominators."""
+    shed = small_report["shed"]
+    assert shed["received"] == small_report["gossip_submitted"]
+    assert shed["dropped"] > 0
+    assert 0.0 < shed["rate"] < 1.0
+    dl = small_report["deadline"]
+    assert dl["processed"] > 0
+    assert dl["misses"] > 0
+    assert 0.0 < dl["rate"] < 1.0
+    # LIFO shed accounting: everything not dropped was processed
+    assert dl["processed"] == shed["received"] - shed["dropped"]
+
+
+def test_http_series_contract_after_replay(small_report):
+    """The serving-path series the lint pins actually materialize
+    labeled children under load."""
+    text = metrics.gather()
+    for needle in (
+        # server-side labels are ROUTE names (attester_duties), the
+        # report keys are client-side mix names (duties_attester)
+        'http_request_duration_seconds_bucket{endpoint="attester_duties",method="POST",status="200"',
+        'http_request_duration_seconds_bucket{endpoint="header",method="GET",status="200"',
+        "http_requests_in_flight 0",
+        "http_sse_events_sent_total{",
+        "http_sse_stream_lag_seconds_count",
+        'beacon_processor_deadline_misses_total{queue="GOSSIP_ATTESTATION"}',
+    ):
+        assert needle in text, f"missing series: {needle}"
+
+
+def test_request_spans_land_on_slot_timelines(small_report):
+    """http:request spans are slot-anchored: request latency reads off
+    the same timelines as gossip→verify→import."""
+    from lighthouse_tpu.common import tracing
+
+    duty_routes = {"attester_duties", "proposer_duties", "sync_duties"}
+    spans = [
+        s for s in tracing.spans(kind="http:request")
+        if s.attrs.get("endpoint") in duty_routes
+    ]
+    assert spans, "no http:request spans for duty endpoints"
+    assert any(s.slot is not None for s in spans)
+    assert all("status" in s.attrs for s in spans)
+
+
+def test_deterministic_shape_same_seed():
+    """Same seed → same traffic shape: request schedule, gossip burst,
+    and the engineered overflow/stale counts all reproduce."""
+    a = loadgen.run_load(_small_cfg(vcs=4, slots=2, sse_subscribers=1))
+    b = loadgen.run_load(_small_cfg(vcs=4, slots=2, sse_subscribers=1))
+    for key in ("requests_total", "gossip_submitted"):
+        assert getattr(a, key) == getattr(b, key)
+    assert a.shed["received"] == b.shed["received"]
+    assert a.shed["dropped"] == b.shed["dropped"]
+    assert a.deadline["misses"] == b.deadline["misses"]
+    assert sorted(a.endpoints) == sorted(b.endpoints)
+    for ep in a.endpoints:
+        assert a.endpoints[ep]["requests"] == b.endpoints[ep]["requests"]
+
+
+@pytest.mark.slow
+def test_heavy_replay_shape():
+    """The CLI-default-sized shape (hundreds of VCs): the SLO must hold
+    at population scale, not just the tier-1 dozen."""
+    report = loadgen.run_load(
+        _small_cfg(vcs=150, slots=8, http_workers=8)
+    ).to_dict()
+    assert loadgen.LoadReport.validate(report) == []
+    assert report["duty_response_ms"]["p99"] < 3000.0
+    assert report["shed"]["dropped"] > 0
+
+
+# ------------------------------------------------- SSE under concurrency
+
+
+def test_sse_fanout_drops_slow_subscriber_without_blocking():
+    """Unit contract (ISSUE 8 satellite): one stalled subscriber's full
+    queue marks it dropped and counts it; the emit fanout never blocks
+    and healthy subscribers receive everything."""
+    bus = EventBus(capacity=64)
+    fast1 = bus.subscribe(topics={"head"})
+    fast2 = bus.subscribe(topics={"head"})
+    slow = bus.subscribe(topics={"head"}, capacity=3)
+    drops0 = metrics.get("http_sse_slow_clients_dropped_total").value
+    t0 = time.perf_counter()
+    for i in range(10):
+        bus.emit("head", {"slot": str(i)})
+    emit_wall = time.perf_counter() - t0
+    assert emit_wall < 0.5, "emit fanout must never block on a subscriber"
+    assert slow.dropped
+    assert (
+        metrics.get("http_sse_slow_clients_dropped_total").value
+        == drops0 + 1
+    )
+    # dropped exactly once, not once per overflowing event
+    assert len(slow.queue) == 3
+    for sub in (fast1, fast2):
+        got = sub.poll(timeout=0.1)
+        assert [e["data"]["slot"] for e in got] == [str(i) for i in range(10)]
+    # a dropped subscription's poll returns instead of waiting forever
+    assert slow.poll(timeout=0.05) != []  # drains its 3 retained events
+    assert slow.poll(timeout=0.05) == []
+
+
+class _BusChain:
+    """The minimal chain surface the SSE path touches."""
+
+    def __init__(self, **bus_kw):
+        self.event_bus = EventBus(**bus_kw)
+
+
+def _sse_server(**bus_kw):
+    from lighthouse_tpu.node.http_api import ApiServer, BeaconApi
+
+    chain = _BusChain(**bus_kw)
+    server = ApiServer(BeaconApi(chain), host="127.0.0.1", port=0)
+    server.start()
+    return server, chain.event_bus
+
+
+def test_sse_multiple_subscribers_and_resume_after_reconnect():
+    """Two live subscribers each get every event with id: lines; a
+    reconnect with Last-Event-ID replays exactly the missed retained
+    events (stream resume)."""
+    server, bus = _sse_server()
+    url = f"http://127.0.0.1:{server.port}/eth/v1/events?topics=head"
+
+    def read_frames(resp, n, timeout=5.0):
+        frames, cur = [], {}
+        deadline = time.monotonic() + timeout
+        while len(frames) < n and time.monotonic() < deadline:
+            line = resp.fp.readline().decode()
+            if line.startswith("id: "):
+                cur["id"] = int(line[4:].strip())
+            elif line.startswith("event: "):
+                cur["event"] = line[7:].strip()
+            elif line.startswith("data: "):
+                cur["data"] = json.loads(line[6:])
+            elif line == "\n" and cur:
+                if "event" in cur:
+                    frames.append(cur)
+                cur = {}
+        return frames
+
+    try:
+        r1 = urllib.request.urlopen(url, timeout=5)
+        r2 = urllib.request.urlopen(url, timeout=5)
+        time.sleep(0.05)  # both subscriptions registered
+        for i in range(3):
+            bus.emit("head", {"slot": str(i)})
+        f1 = read_frames(r1, 3)
+        f2 = read_frames(r2, 3)
+        for frames in (f1, f2):
+            assert [f["data"]["slot"] for f in frames] == ["0", "1", "2"]
+            assert all("id" in f for f in frames)
+        last_id = f1[-1]["id"]
+        r1.close()  # subscriber goes away...
+        bus.emit("head", {"slot": "3"})  # ...misses an event...
+        bus.emit("head", {"slot": "4"})
+        req = urllib.request.Request(
+            url, headers={"Last-Event-ID": str(last_id)}
+        )
+        r3 = urllib.request.urlopen(req, timeout=5)  # ...and resumes
+        f3 = read_frames(r3, 2)
+        assert [f["data"]["slot"] for f in f3] == ["3", "4"]
+        r3.close()
+        r2.close()
+    finally:
+        server.stop()
+
+
+def test_sse_stalled_http_client_dropped_and_counted():
+    """A client that stops reading (socket backpressure stalls its
+    handler) overflows its bounded queue; the fanout marks it dropped
+    and counts it while a healthy concurrent subscriber keeps
+    receiving every event."""
+    server, bus = _sse_server(subscriber_capacity=2)
+    drops0 = metrics.get("http_sse_slow_clients_dropped_total").value
+    pad = "x" * 65536  # big frames fill socket buffers fast
+    try:
+        # the stalled client: tiny receive buffer, never reads
+        stalled = socket.socket()
+        stalled.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 1024)
+        stalled.connect(("127.0.0.1", server.port))
+        stalled.sendall(
+            b"GET /eth/v1/events?topics=head HTTP/1.1\r\n"
+            b"Host: x\r\nAccept: text/event-stream\r\n\r\n"
+        )
+        # the healthy client reads everything
+        healthy = urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/eth/v1/events?topics=head",
+            timeout=5,
+        )
+        time.sleep(0.1)  # both subscriptions registered
+        counter = metrics.get("http_sse_slow_clients_dropped_total")
+        received = 0
+        emitted = 0
+        deadline = time.monotonic() + 20.0
+        while counter.value == drops0 and time.monotonic() < deadline:
+            t0 = time.perf_counter()
+            bus.emit("head", {"n": str(emitted), "pad": pad})
+            assert time.perf_counter() - t0 < 0.5, "emit blocked on fanout"
+            emitted += 1
+            # drain the healthy stream so only the stalled client lags
+            line = healthy.fp.readline()
+            while line and not line.startswith(b"data: "):
+                line = healthy.fp.readline()
+            if line.startswith(b"data: "):
+                received += 1
+        assert counter.value == drops0 + 1, (
+            f"stalled client never dropped after {emitted} events"
+        )
+        assert received == emitted
+        stalled.close()
+        healthy.close()
+    finally:
+        server.stop()
+
+
+def test_sse_survives_server_restart_over_same_api():
+    """A fresh ApiServer over a previously-stopped server's BeaconApi
+    must serve live SSE streams (the shutdown signal is per-server,
+    not a one-way latch on the shared api object)."""
+    from lighthouse_tpu.node.http_api import ApiServer, BeaconApi
+
+    chain = _BusChain()
+    api = BeaconApi(chain)
+    s1 = ApiServer(api, host="127.0.0.1", port=0)
+    s1.start()
+    s1.stop()
+    s2 = ApiServer(api, host="127.0.0.1", port=0)
+    s2.start()
+    try:
+        r = urllib.request.urlopen(
+            f"http://127.0.0.1:{s2.port}/eth/v1/events?topics=head",
+            timeout=5,
+        )
+        time.sleep(0.05)
+        chain.event_bus.emit("head", {"slot": "1"})
+        deadline = time.monotonic() + 3.0
+        line = r.fp.readline()
+        while not line.startswith(b"id: ") and time.monotonic() < deadline:
+            line = r.fp.readline()
+        assert line.startswith(b"id: "), line
+        r.close()
+    finally:
+        s2.stop()
+
+
+# --------------------------------------------- dispatch instrumentation
+
+
+def test_http_dispatch_instrumentation_chainless():
+    """The central wrapper covers every route, including unknown ones,
+    with bounded endpoint labels and an in-flight gauge that returns
+    to zero."""
+    from lighthouse_tpu.node.http_api import ApiServer, BeaconApi
+
+    server = ApiServer(BeaconApi(None), host="127.0.0.1", port=0)
+    server.start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        with urllib.request.urlopen(f"{base}/eth/v1/node/health") as r:
+            assert r.status == 200
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(f"{base}/no/such/route")
+        assert exc.value.code == 404
+        fam = metrics.get("http_request_duration_seconds")
+        # the duration child lands in the handler thread's finally,
+        # microseconds after the client sees the response — poll
+        deadline = time.monotonic() + 2.0
+        labels = set(fam.label_values())
+        while ("unknown", "GET", "404") not in labels and (
+            time.monotonic() < deadline
+        ):
+            time.sleep(0.01)
+            labels = set(fam.label_values())
+        assert ("node_health", "GET", "200") in labels
+        # unknown paths collapse into ONE label, never raw-path children
+        assert ("unknown", "GET", "404") in labels
+        assert not any("/no/such/route" in lv[0] for lv in labels)
+        assert metrics.get("http_requests_in_flight").value == 0
+    finally:
+        server.stop()
+
+
+def test_loadgen_cli_entrypoint_importable():
+    """tools/loadgen.py must stay invocable as a script (the acceptance
+    command) — import its module surface without running a fleet."""
+    import importlib.util
+    from pathlib import Path
+
+    path = Path(__file__).resolve().parent.parent / "tools" / "loadgen.py"
+    spec = importlib.util.spec_from_file_location("loadgen_cli", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert callable(mod.main)

@@ -139,10 +139,36 @@ def test_chrome_trace_export_shape():
         pass
     doc = tr.chrome_trace(slot=3)
     assert doc["traceEvents"], "no events exported"
-    ev = doc["traceEvents"][0]
-    assert ev["ph"] == "X" and ev["name"] == "stage_a"
+    spans = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    ev = spans[0]
+    assert ev["name"] == "stage_a"
     assert ev["args"]["slot"] == 3 and ev["dur"] >= 0
     json.dumps(doc)  # must be JSON-serializable as-is
+
+
+def test_chrome_trace_run_metadata_and_track_names():
+    """ISSUE 8 satellite: exports stamp process/thread names and a
+    monotonic run id so two loadgen runs diff side-by-side in Perfetto
+    instead of landing in one anonymous track."""
+    tr = tracing.Tracer(capacity=8)
+    with tr.span("stage_b", slot=7):
+        pass
+    doc = tr.chrome_trace(slot=7)
+    meta = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+    names = {e["name"] for e in meta}
+    assert "process_name" in names and "thread_name" in names
+    proc = next(e for e in meta if e["name"] == "process_name")
+    rid = doc["otherData"]["runId"]
+    assert str(rid) in proc["args"]["name"]
+    # every span's tid has a thread_name track
+    span_tids = {e["tid"] for e in doc["traceEvents"] if e["ph"] == "X"}
+    named_tids = {e["tid"] for e in meta if e["name"] == "thread_name"}
+    assert span_tids <= named_tids
+    # run ids are monotonic per tracer
+    assert tr.next_run_id() == rid + 1
+    assert tr.chrome_trace(slot=7)["otherData"]["runId"] == rid + 1
+    # the module-level conveniences exist on the global tracer
+    assert tracing.current_run_id() >= 1
 
 
 # ------------------------------------------------------- scrape roundtrip

@@ -1,0 +1,28 @@
+#!/usr/bin/env python
+"""CLI for the deterministic traffic-replay load harness (ISSUE 8).
+
+Thin wrapper over lighthouse_tpu.tools.loadgen (where the harness and
+the LoadReport schema contract live, shared with bench.py detail.load):
+
+    python tools/loadgen.py --vcs 200 --seed 7
+
+Prints the schema-checked JSON report: per-endpoint p50/p95/p99,
+duty-response SLO percentiles, shed rate, deadline-miss rate, SSE
+delivery counters. Exit 1 on fleet-start failure or schema drift.
+"""
+
+import os
+import sys
+
+# standalone invocation from anywhere: the repo root owns the package
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+# the harness is CPU-side by design: never touch a real chip tunnel
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from lighthouse_tpu.tools.loadgen import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

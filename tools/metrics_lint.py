@@ -44,6 +44,18 @@ REQUIRED_FAMILIES = {
     "beacon_processor_work_dropped_total": ("queue",),
     "beacon_processor_work_processed_total": ("queue",),
     "beacon_processor_batch_size": ("queue",),
+    # deadline attribution (ISSUE 8): shed-rate curves' denominator
+    "beacon_processor_deadline_misses_total": ("queue",),
+    # HTTP/SSE serving path (node/http_api.py, ISSUE 8): the load
+    # observatory's request-side contract — endpoint label is the ROUTE
+    # NAME (bounded cardinality), never the raw path
+    "http_request_duration_seconds": ("endpoint", "method", "status"),
+    "http_requests_in_flight": (),
+    "http_sse_events_sent_total": ("event",),
+    "http_sse_stream_lag_seconds": (),
+    "http_sse_subscribers": (),
+    # registered next to the emit-side fanout (node/caches.py EventBus)
+    "http_sse_slow_clients_dropped_total": (),
     # legacy unlabeled aggregates (kept for continuity)
     "beacon_processor_work_events_received_total": (),
     "beacon_processor_work_events_dropped_total": (),
@@ -90,6 +102,22 @@ REQUIRED_FAMILIES = {
     "validator_monitor_blocks_total": ("validator",),
 }
 
+# histogram bucket layouts pinned alongside names/labels (ISSUE 8):
+# a silent bucket change breaks every recorded percentile's continuity
+REQUIRED_BUCKETS = {
+    "http_request_duration_seconds": (
+        0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+        1.0, 2.5, 5.0, 10.0,
+    ),
+    "http_sse_stream_lag_seconds": (
+        0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+        1.0, 2.5, 5.0, 10.0,
+    ),
+    "beacon_processor_batch_size": (
+        1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096,
+    ),
+}
+
 # sample line: name{labels} value   (labels optional)
 _SAMPLE_RE = re.compile(
     r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{(?:[a-zA-Z_][a-zA-Z0-9_]*='
@@ -106,6 +134,7 @@ def _import_surface(problems: list) -> None:
     import lighthouse_tpu.network.sync  # noqa: F401
     import lighthouse_tpu.node.beacon_processor  # noqa: F401
     import lighthouse_tpu.node.caches  # noqa: F401
+    import lighthouse_tpu.node.http_api  # noqa: F401
     import lighthouse_tpu.node.validator_monitor  # noqa: F401
     import lighthouse_tpu.common.tracing  # noqa: F401
     import lighthouse_tpu.consensus.state_transition  # noqa: F401
@@ -129,6 +158,15 @@ def _check_families(problems: list) -> None:
                 f"{name}: labelnames {fam.labelnames} != required "
                 f"{tuple(labelnames)}"
             )
+    for name, buckets in REQUIRED_BUCKETS.items():
+        fam = metrics.get(name)
+        if fam is None:
+            continue  # missing family already reported above
+        if tuple(getattr(fam, "buckets", ())) != tuple(buckets):
+            problems.append(
+                f"{name}: buckets {tuple(getattr(fam, 'buckets', ()))} "
+                f"!= pinned {tuple(buckets)}"
+            )
 
 
 def _check_queues(problems: list) -> None:
@@ -149,6 +187,7 @@ def _check_queues(problems: list) -> None:
         "beacon_processor_queue_wait_seconds",
         "beacon_processor_work_received_total",
         "beacon_processor_work_processed_total",
+        "beacon_processor_deadline_misses_total",
     ):
         fam = metrics.get(fam_name)
         if fam is None:
