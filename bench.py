@@ -2,12 +2,17 @@
 """North-star benchmark: the five BASELINE.md configs, honest baseline.
 
 Prints ONE JSON line:
-  {"metric", "value" (config-1 sets/s on the device), "unit",
+  {"metric", "value" (config-1 sets/s on the device; on a chipless box
+   the correctness-checked CPU replay of the exported module — see
+   detail.measurement_mode), "unit",
    "vs_baseline" (vs the blst single-HOST anchor, see below),
    "detail" (all configs, latency percentiles, anchors, per-stage
    epoch-boundary seconds at 250k/500k under "epoch", the chaos fleet
-   under "scenarios", and the traffic-replay SLO report under "load" —
-   the last three are CPU-side and ship tunnel up or down)}
+   under "scenarios", the traffic-replay SLO report under "load", and
+   the kernel op census + v5e roofline under "kernel_costs" — the
+   CPU-side sections ship tunnel up or down, and every round appends a
+   trajectory row to PERF.jsonl for tools/perf_ledger.py /
+   tools/bench_gate.py)}
 
 Baseline anchoring (VERDICT r1 #2): blst is not installable in this
 image, so the denominator is an explicit, documented anchor — NOT the
@@ -138,6 +143,10 @@ def _emit():
         _STATE["detail"]["observability"] = {
             "error": f"{type(e).__name__}: {e}"
         }
+    # the persistent perf ledger (ISSUE 10): every round — device,
+    # replayed or dead — appends its trajectory row before the JSON
+    # line ships, so tools/bench_gate.py always has the newest round
+    _append_ledger(_STATE["detail"])
     rate1 = _STATE["rate1"]
     print(
         json.dumps(
@@ -218,14 +227,18 @@ def _last_self_measured():
                     break
         if doc.get("value") is None:
             continue
-        # a zero from an earlier dead-tunnel round is not a measurement:
-        # prefer the newest NONZERO rate, fall back to newest otherwise
-        rank = (bool(doc.get("value")), mtime)
+        # a zero from an earlier dead-tunnel round is not a measurement,
+        # and a nonzero CPU-replay headline is not a DEVICE rate
+        # (measurement_mode, ISSUE 10): prefer the newest nonzero
+        # device-mode rate, then any nonzero rate, then newest
+        mode = (doc.get("detail") or {}).get("measurement_mode")
+        is_device = bool(doc.get("value")) and mode in ("device", None)
+        rank = (is_device, bool(doc.get("value")), mtime)
         if best is None or rank >= best[0]:
             best = (rank, path, doc)
     if best is None:
         return {"note": "no prior self-measured result found"}
-    (_, mtime), path, doc = best
+    (is_device, _, mtime), path, doc = best
     return {
         "value": doc.get("value"),
         "unit": doc.get("unit"),
@@ -233,6 +246,10 @@ def _last_self_measured():
         "source": path,
         "measured_at": time.strftime(
             "%Y-%m-%dT%H:%M:%SZ", time.gmtime(mtime)
+        ),
+        "measurement_mode": (
+            (doc.get("detail") or {}).get("measurement_mode")
+            or ("device" if is_device else "unknown")
         ),
         "note": "STALE: chip unreachable this run; last self-measured rate",
     }
@@ -455,6 +472,196 @@ def _config_scenarios(detail):
     detail["scenarios"] = out
 
 
+def _config_kernel_costs(detail):
+    """detail.kernel_costs (ISSUE 10 tentpole): the device-independent
+    op census of the verify kernel per AOT bucket + pipeline stage,
+    the v5e roofline columns, and the fused epoch program's XLA cost
+    totals. Pure host work (the census interprets the kernel's own
+    dispatch seam instead of tracing XLA — see ops/costs.py), so every
+    op-cut lands as a number the same round it ships, tunnel up or
+    down. ~1 min on a warm profile cache; a kernel edit re-profiles
+    (~2 min) and refreshes tests/budgets/kernel_profiles.json."""
+    from lighthouse_tpu.ops import costs
+
+    report = costs.kernel_costs()
+    try:
+        report["budget_check"] = (
+            costs.check_budgets(report["buckets"]) or "ok"
+        )
+    except Exception as e:  # budgets file absent/unreadable
+        report["budget_check"] = f"unavailable: {type(e).__name__}: {e}"
+    detail["kernel_costs"] = report
+
+
+def _seed_artifacts(detail):
+    """Record the exported-artifact inventory (bucket, age, source-hash
+    match) in detail.backend_init EVEN ON SUCCESS and mirror it into
+    bls_export_artifact_info. Export of a missing replay artifact
+    happens budget-gated inside _config_replay; tools/seed_cache.py
+    drives the same export_store functions for on-chip seeding."""
+    from lighthouse_tpu.crypto.bls.backends import (
+        device_metrics,
+        export_store,
+    )
+
+    bi = detail.setdefault("backend_init", {})
+    inv = export_store.artifact_inventory()
+    bi["artifacts"] = inv
+    device_metrics.record_artifact_inventory(inv)
+    return inv
+
+
+def _config_replay(detail):
+    """The tunnel-proof headline (ISSUE 10): when no chip answers, the
+    serialized exported module replays on the CPU backend —
+    correctness-checked (valid full bucket verifies, a forged set
+    fails, a padded 4-set batch verifies) — so the round ships a
+    real, nonzero measurement instead of 0.0.
+
+    Runs in a SUBPROCESS under export_store.replay_env(): a fresh
+    JAX_PLATFORMS=cpu process cannot deadlock on this process's
+    poisoned tunnel client, and the pinned env means bench rounds, the
+    tier-1 differential test and manual seeding all share one
+    .jax_cache entry (export ~6 min + first compile tens of minutes,
+    once per box/source-hash; warm replay is seconds). A CPU replay
+    rate is NOT a device rate: detail.measurement_mode says exactly
+    what was measured and the ledger rows keep the modes apart."""
+    import subprocess
+
+    from lighthouse_tpu.crypto.bls.backends import export_store
+
+    bucket = int(os.environ.get("BENCH_REPLAY_BUCKET", "128"))
+    out = {"bucket": bucket}
+    detail["replay"] = out
+    out["was_warm"] = export_store.replay_is_warm(bucket)
+    have_artifact = export_store.replay_callable(bucket) is not None
+    # budget model (measured, one-core image): warm = ~8 min (cached
+    # executable still loads in ~7 min + 3 reps); cold with artifact
+    # adds the ~32 min first compile; cold without adds ~6 min export
+    # on top. A cold box only starts that when the remaining budget is
+    # explicitly generous — otherwise it records why and lets the NEXT
+    # round (warmer: artifact and/or .jax_cache present) measure
+    need_s = 600.0 if out["was_warm"] else (
+        2100.0 if have_artifact else 2400.0
+    )
+    need_s = float(os.environ.get("BENCH_REPLAY_MIN_S", str(need_s)))
+    if _left() < need_s:
+        out["skipped"] = (
+            f"budget: left {_left():.0f}s < {need_s:.0f}s needed for a "
+            + ("warm" if out["was_warm"] else "cold")
+            + " replay (artifact "
+            + ("present" if have_artifact else "absent")
+            + ")"
+        )
+        # a cold box must still CONVERGE to warm: detach the seeding
+        # subprocess (export + compile land in .graft_export/.jax_cache)
+        # so the NEXT round measures; pid-file guards re-spawns
+        try:
+            pid_path = os.path.join(
+                export_store.export_dir(), "replay_seed.pid"
+            )
+            alive = False
+            try:
+                with open(pid_path) as f:
+                    os.kill(int(f.read().strip()), 0)
+                alive = True
+            except (OSError, ValueError):
+                pass
+            if not alive and not out["was_warm"]:
+                log_path = os.path.join(
+                    export_store.export_dir(), "replay_seed.log"
+                )
+                os.makedirs(export_store.export_dir(), exist_ok=True)
+                with open(log_path, "ab") as logf:
+                    proc = subprocess.Popen(
+                        [sys.executable, "-m",
+                         "lighthouse_tpu.crypto.bls.backends."
+                         "export_store",
+                         "replay-bench", str(bucket), "1"],
+                        env=export_store.replay_env(),
+                        stdout=logf,
+                        stderr=logf,
+                        start_new_session=True,
+                        cwd=os.path.dirname(os.path.abspath(__file__)),
+                    )
+                with open(pid_path, "w") as f:
+                    f.write(str(proc.pid))
+                out["seeding_in_background"] = {
+                    "pid": proc.pid, "log": log_path,
+                }
+        except Exception as e:  # noqa: BLE001 — best-effort seeding
+            out["seeding_error"] = f"{type(e).__name__}: {e}"
+        return
+    cmd = [
+        sys.executable, "-m",
+        "lighthouse_tpu.crypto.bls.backends.export_store",
+        "replay-bench", str(bucket),
+        os.environ.get("BENCH_REPLAY_REPS", "3"),
+    ]
+    try:
+        proc = subprocess.run(
+            cmd,
+            env=export_store.replay_env(),
+            capture_output=True,
+            text=True,
+            timeout=max(_left() - 45.0, 30.0),
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    except subprocess.TimeoutExpired:
+        out["error"] = (
+            "replay subprocess exceeded the remaining budget"
+        )
+        if out["was_warm"]:
+            # the warm stamp lied for THIS box (e.g. a committed stamp
+            # + a .jax_cache miss after a jax upgrade): drop it so the
+            # next round takes the cold path — skip, detach the
+            # background seeder, and converge — instead of re-timing
+            # out at every round's tail
+            try:
+                os.remove(
+                    export_store._warm_stamp_path(bucket)
+                )
+                out["warm_stamp_dropped"] = True
+            except OSError:
+                pass
+        return
+    line = ""
+    for cand in reversed((proc.stdout or "").splitlines()):
+        if cand.startswith("{"):
+            line = cand
+            break
+    if not line:
+        out["error"] = (
+            f"replay subprocess rc={proc.returncode}, no JSON "
+            f"(stderr tail: {(proc.stderr or '')[-300:]!r})"
+        )
+        return
+    out.update(json.loads(line))
+    if out.get("checked") and out.get("sets_per_s"):
+        # the replay rate becomes the round's headline: nonzero and
+        # correctness-checked, with measurement_mode making the
+        # meaning unmistakable (a CPU replay is not a chip number)
+        _STATE["rate1"] = float(out["sets_per_s"])
+        detail["measurement_mode"] = "cpu_replay"
+
+
+def _append_ledger(detail):
+    """Append this round to PERF.jsonl (BENCH_LEDGER=0 disables)."""
+    if os.environ.get("BENCH_LEDGER", "1") == "0":
+        return
+    try:
+        from lighthouse_tpu.tools import perf_ledger
+
+        doc = {
+            "value": round(_STATE["rate1"], 2),
+            "detail": detail,
+        }
+        row = perf_ledger.row_from_bench(doc, source="bench.py")
+        perf_ledger.append(row)
+    except Exception as e:  # the ledger must never lose the headline
+        detail["ledger_error"] = f"{type(e).__name__}: {e}"
+
+
 def _config_load(detail):
     """detail.load (ISSUE 8): the traffic-replay SLO report — per-
     endpoint latency percentiles, duty-response SLO, shed rate and
@@ -574,17 +781,40 @@ def main():
         except Exception:
             pass
         time.sleep(min(30.0, max(_left() - reserve_s, 0.0)))
-    detail["backend_init"] = {"attempts": attempts}
-    if device is None:
-        detail["backend_init"]["error"] = "device never appeared"
+    # per-attempt tunnel STATE TRANSITIONS (ISSUE 10 satellite): the
+    # BENCH JSON says *why* a round was driver-verified vs replayed vs
+    # dead, not just that it was
+    transitions = []
+    for a in attempts:
+        s = "up" if a["state"].startswith("up") else "down"
+        if not transitions or transitions[-1]["state"] != s:
+            transitions.append({"at_s": a["at_s"], "state": s})
+    detail["backend_init"] = {
+        "attempts": attempts,
+        "transitions": transitions,
+    }
+    # a CPU device is a live jax backend but NOT a chip: headline
+    # configs (4096-bucket compiles) would blow the whole budget on a
+    # CPU-only box — that is exactly the tunnel-proof replay case
+    is_chip = device is not None and jax.default_backend() not in (
+        "cpu", "",
+    )
+    if not is_chip:
+        why = (
+            "device never appeared"
+            if device is None
+            else f"cpu backend only ({device})"
+        )
+        detail["backend_init"]["error"] = why
         detail["last_self_measured"] = _last_self_measured()
         # ISSUE 8 bugfix (ROADMAP item 2 prereq): a dead tunnel must
         # never abort the round — log the tunnel state and still emit
-        # EVERY CPU-side detail section (load/scenarios/epoch)
+        # EVERY CPU-side detail section, plus (ISSUE 10) the exported-
+        # module replay measurement and the kernel cost census
         print(
-            "bench: no device backend "
-            f"({attempts[-1]['state'] if attempts else 'no probe ran'}); "
-            "emitting CPU-side detail sections (load/scenarios/epoch)",
+            f"bench: no chip backend ({why}); replaying the exported "
+            "module on CPU + emitting CPU-side detail sections "
+            "(kernel_costs/load/scenarios/epoch)",
             file=sys.stderr,
             flush=True,
         )
@@ -592,14 +822,55 @@ def main():
         # force the numpy epoch backend (the jax build's self-check
         # would block in device init, exactly like jax.devices())
         os.environ.setdefault("LIGHTHOUSE_EPOCH_JAX", "0")
+        if device is None:
+            # the tunnel backend is poisoned mid-init: re-point jax at
+            # the CPU platform so the census's eager glue and the
+            # replay can run at all (best-effort — a deadlocked PJRT
+            # lock surfaces as a recorded per-section error + the
+            # SIGALRM flush, never a lost round)
+            try:
+                jax.config.update("jax_platforms", "cpu")
+                jax.clear_backends()
+            except Exception as e:  # noqa: BLE001
+                detail["backend_init"]["cpu_fallback_error"] = (
+                    f"{type(e).__name__}: {e}"
+                )
+        # exported-artifact inventory rides EVERY round (the satellite
+        # contract) — AFTER the cpu re-point: artifact paths resolve
+        # via jax.default_backend(), which must never touch the
+        # poisoned tunnel client
+        try:
+            _seed_artifacts(detail)
+        except Exception as e:  # noqa: BLE001 — best-effort
+            detail["backend_init"]["artifacts_error"] = (
+                f"{type(e).__name__}: {e}"
+            )
+        # jax-free sections FIRST (numpy epoch, fake-BLS fleet, load
+        # replay), then the jax-on-cpu census, then the exported-module
+        # replay LAST: a COLD box pays export (~6 min) + first-call
+        # compile (~15-20 min) there — if that overruns the alarm, the
+        # flush still ships every earlier section, and the compile
+        # lands in .jax_cache so the NEXT round's replay is seconds
         _run_config("epoch", 60, _config_epoch)
         # convergence health is chip-independent: ship it every round
         _run_config("scenarios", 60, _config_scenarios)
         # serving-path SLO curves are chip-independent too (ISSUE 8)
         _run_config("load", 60, _config_load)
+        _run_config("kernel_costs", 60, _config_kernel_costs)
+        _run_config("replay", 60, _config_replay)
         _emit()
-        os._exit(3)
+        # a correctness-checked replay measurement IS a result: rc 0
+        os._exit(0 if _STATE["rate1"] else 3)
     detail["device"] = device
+    detail["measurement_mode"] = "device"
+    # artifact inventory on the SUCCESS path too (the satellite
+    # contract: BENCH JSONs always say which AOT modules were loadable)
+    try:
+        _seed_artifacts(detail)
+    except Exception as e:  # noqa: BLE001 — best-effort
+        detail["backend_init"]["artifacts_error"] = (
+            f"{type(e).__name__}: {e}"
+        )
     detail["blst_anchor"] = {
         "sets_per_s_per_core": BLST_SETS_PER_S_PER_CORE,
         "host_cores": BLST_HOST_CORES,
@@ -647,6 +918,9 @@ def main():
         _run_config(
             "config1_marginal", 20, _config1_marginal, sets1, scalars1, n_sets
         )
+
+    # the kernel cost census + roofline rides every round (ISSUE 10)
+    _run_config("kernel_costs", 60, _config_kernel_costs)
 
     # per-stage epoch-boundary attribution rides every round (ISSUE 6)
     _run_config("epoch", 60, _config_epoch)

@@ -124,6 +124,18 @@ def verify_signature_sets(sets, *, backend: str = None, rand_scalars=None) -> bo
             M_ERRORED.labels(backend=name).inc()
         elif not ok:
             M_FAILED.labels(backend=name).inc()
+        if n and not raised and name == "tpu":
+            # cumulative kernel work for the cost observatory: per-
+            # batch elem-op/byte totals come from the checked-in
+            # census (device_metrics), not from tracing anything here.
+            # Only the DIRECT device backend counts at this seam; the
+            # warm dispatcher answers cold buckets from the CPU
+            # fallback, so it records its own device-path batches
+            # (backends/warm.py) — counting it here would book kernel
+            # flops the device never executed.
+            from .backends import device_metrics as _dm
+
+            _dm.record_kernel_dispatch(bucket)
     return ok
 
 

@@ -4,6 +4,15 @@ One registration site, jax-free, so warm.py can reference the same
 series without importing the jax-heavy tpu module and without a
 copy-pasted registration that could silently drift (the registry
 validates type/labels/buckets on re-registration, but not help text).
+
+Cost-observatory series (ISSUE 10): the per-bucket flops/bytes
+counters are populated from the checked-in census budgets
+(tests/budgets/kernel_costs.json, written by ops/costs.py /
+tools/kernel_report.py) — the same numbers the tier-1 op-count gate
+pins — so the scrape carries cumulative kernel work without paying a
+census at verify time. jax_compile_seconds attributes every observed
+trace/lower/compile event (warm.py background warms, export replays,
+the epoch program build) to a named program.
 """
 
 from ....common import metrics as _metrics
@@ -24,3 +33,96 @@ M_DEVICE_SECONDS = _metrics.histogram(
     "Device verify-call time (dispatch + compute + sync), by bucket",
     labelnames=("bucket",),
 )
+
+# compile events are seconds-to-minutes: the default request-latency
+# bucket layout would collapse everything into +Inf
+_COMPILE_BUCKETS = (
+    0.1, 0.5, 1.0, 5.0, 15.0, 30.0, 60.0, 120.0, 300.0, 600.0, 1200.0,
+    1800.0,
+)
+M_COMPILE_SECONDS = _metrics.histogram(
+    "jax_compile_seconds",
+    "Observed jax trace/lower/compile wall time, by program (verify "
+    "bucket warms, export replays, the fused epoch program)",
+    buckets=_COMPILE_BUCKETS,
+    labelnames=("program",),
+)
+M_KERNEL_FLOPS = _metrics.counter(
+    "bls_kernel_flops_total",
+    "Cumulative elementwise kernel ops dispatched to the device path, "
+    "by AOT bucket (per-batch totals from the checked-in op-count "
+    "census, tests/budgets/kernel_costs.json)",
+    labelnames=("bucket",),
+)
+M_KERNEL_BYTES = _metrics.counter(
+    "bls_kernel_bytes_total",
+    "Cumulative kernel-boundary HBM bytes dispatched to the device "
+    "path, by AOT bucket (census model, kernel_op I/O only)",
+    labelnames=("bucket",),
+)
+M_EXPORT_ARTIFACT = _metrics.gauge(
+    "bls_export_artifact_info",
+    "AOT export artifact age in seconds, by bucket and source-hash "
+    "state (source=match: loadable by this build; source=stale_hash: "
+    "present but the kernel sources changed; absent buckets have no "
+    "artifact)",
+    labelnames=("bucket", "source"),
+)
+
+
+def observe_compile(program: str, seconds: float) -> None:
+    """Record one observed compile/trace event for a named program."""
+    M_COMPILE_SECONDS.labels(program=str(program)).observe(float(seconds))
+
+
+_CENSUS_BY_BUCKET: dict = {}
+_CENSUS_TRIED = False
+
+
+def _census_for(bucket: str):
+    """Per-bucket {elem_ops, hbm_bytes} from the checked-in budgets
+    file, loaded once; None when the file or bucket is absent. Path
+    resolution + parsing live in ops/costs.py (the budgets' owner);
+    costs' module level is jax-free, so the lazy import keeps this
+    module importable everywhere the metrics registry is."""
+    global _CENSUS_TRIED
+    if not _CENSUS_TRIED:
+        _CENSUS_TRIED = True
+        try:
+            from ....ops import costs
+
+            doc = costs.load_budgets()
+            for b, entry in doc.get("buckets", {}).items():
+                _CENSUS_BY_BUCKET[str(b)] = entry
+        except Exception:
+            pass
+    return _CENSUS_BY_BUCKET.get(str(bucket))
+
+
+def record_kernel_dispatch(bucket) -> None:
+    """Count one device-path verify dispatch against the census
+    counters (no-op for buckets without a checked-in census)."""
+    entry = _census_for(str(bucket))
+    if not entry:
+        return
+    elem_ops = entry.get("elem_ops")
+    hbm = entry.get("hbm_bytes")
+    if elem_ops:
+        M_KERNEL_FLOPS.labels(bucket=str(bucket)).inc(float(elem_ops))
+    if hbm:
+        M_KERNEL_BYTES.labels(bucket=str(bucket)).inc(float(hbm))
+
+
+def record_artifact_inventory(inventory) -> None:
+    """Mirror an export-artifact inventory (backends/export_store.py)
+    into the bls_export_artifact_info gauge. The registry cannot drop
+    children, so every previously-seen series is zeroed first — a
+    re-exported bucket's old stale_hash series (or a deleted
+    artifact's) must not keep reporting its last age forever."""
+    for labelvalues in M_EXPORT_ARTIFACT.label_values():
+        M_EXPORT_ARTIFACT.labels(*labelvalues).set(0.0)
+    for item in inventory:
+        src = "match" if item.get("source_hash_match") else "stale_hash"
+        M_EXPORT_ARTIFACT.labels(
+            bucket=str(item.get("bucket")), source=src
+        ).set(float(item.get("age_s", 0.0)))

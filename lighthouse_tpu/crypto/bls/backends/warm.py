@@ -59,10 +59,18 @@ def _is_warm(npad: int) -> bool:
 
 
 def _warmup(npad: int, args) -> None:
+    import time as _time
+
+    t0 = _time.perf_counter()
     try:
         _device()._verify_kernel(*args)
         with _lock:
             _warm.add(npad)
+        # the background compile IS the compile-cliff cost this
+        # dispatcher absorbs — record it per program (ISSUE 10)
+        from .device_metrics import observe_compile
+
+        observe_compile(f"verify_warmup_{npad}", _time.perf_counter() - t0)
     except Exception:
         pass  # chip gone mid-compile: stay on CPU, retry next batch
     finally:
@@ -101,6 +109,13 @@ def verify_signature_sets(sets, rand_scalars) -> bool:
         M_DEVICE_SECONDS.labels(bucket=str(npad)).observe(
             _time.perf_counter() - t1
         )
+        # census flops/bytes count ONLY batches the device program
+        # actually ran — the cold-bucket CPU fallback below does no
+        # kernel work (ISSUE 10; the direct tpu backend is counted at
+        # the crypto/bls dispatch seam instead)
+        from .device_metrics import record_kernel_dispatch
+
+        record_kernel_dispatch(npad)
         with _lock:
             _warm.add(npad)
         return ok

@@ -187,6 +187,15 @@ def _lane_tile(n_elems_per_lane: int) -> int:
     return min(2048, 1 << (int(ts).bit_length() - 1))
 
 
+# Cost-observatory seam (ops/costs.py): when a recorder is installed,
+# every kernel_op dispatch is routed through it instead of computing —
+# the recorder counts (name, shapes) and returns shape-correct dummies,
+# so the whole verify program can be "executed" structurally in seconds
+# (vs minutes of jax tracing). None in production; only ops/costs.py
+# census contexts set it, under a lock, and always restore None.
+CENSUS = None
+
+
 def kernel_op(fn, name: str):
     """Wrap an elementwise-[..., W|*, S] jnp body as a lane-tiled Pallas op.
 
@@ -196,6 +205,8 @@ def kernel_op(fn, name: str):
     """
 
     def dispatch(*arrays, **kw):
+        if CENSUS is not None:
+            return CENSUS(name, fn, arrays, kw)
         S = arrays[0].shape[-1]
         if not use_pallas():
             return fn(_FOLDS, _TOPFM, *arrays, **kw)

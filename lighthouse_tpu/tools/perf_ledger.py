@@ -1,0 +1,290 @@
+"""Persistent perf ledger (ISSUE 10 tentpole, layer 3).
+
+One append-only trajectory (PERF.jsonl at the repo root) where every
+bench round lands as a row: driver-verified rate, freshest
+self-measured rate, measurement mode (device / cpu-replay / dead),
+per-bucket op counts and roofline estimates, and the CPU-side numbers
+(epoch stage seconds, load p99/shed, scenario convergence) that ship
+tunnel up or down. `tools/perf_ledger.py` renders the table and flags
+regressions between consecutive rounds; `tools/bench_gate.py` turns
+the same comparison into a tier-1 exit code.
+
+Row schema ("lighthouse-tpu/perf-ledger/v1") — all fields optional
+except schema/source/recorded_at; compare only what both rows carry:
+
+  source            where the row came from (BENCH_r03.json, bench.py)
+  recorded_at       ISO-8601 UTC
+  mode              "device" | "cpu_replay" | "dead" | "self_measured"
+  value_sets_per_s  the round's headline number (0.0 on dead rounds)
+  device            device string if a chip answered
+  marginal_sets_per_s, batch_sets_per_s
+  replay            {bucket, sets_per_s, checked}   (cpu replay rounds)
+  kernel            {bucket: {fp_muls_per_set, elem_ops_per_set,
+                    roofline_est_sets_per_s}}
+  epoch_warm_s      {"250k": s, "500k": s}
+  load              {duty_p99_s, shed_rate, deadline_miss_rate}
+  scenarios_pass    bool
+  artifacts         export-artifact inventory summary
+  note              free text
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+SCHEMA = "lighthouse-tpu/perf-ledger/v1"
+
+
+def default_path() -> str:
+    here = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    return os.path.join(here, "PERF.jsonl")
+
+
+def now_iso() -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+
+def rows(path: str | None = None) -> list:
+    path = path or default_path()
+    out = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    doc = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(doc, dict) and doc.get("schema") == SCHEMA:
+                    out.append(doc)
+    except OSError:
+        pass
+    return out
+
+
+def append(row: dict, path: str | None = None) -> bool:
+    """Append one row (stamps schema + recorded_at if missing).
+    Dedupes ONLY a row whose entire content (minus the timestamp)
+    matches the last row — re-projecting the same BENCH artifact twice
+    is a duplicate; two live rounds that merely measured the same
+    headline rate are distinct events (their epoch/load/census
+    sections differ) and both belong in the trajectory."""
+    path = path or default_path()
+    row = dict(row)
+    row.setdefault("schema", SCHEMA)
+    row.setdefault("recorded_at", now_iso())
+    prior = rows(path)
+    if prior:
+        def _key(r):
+            return json.dumps(
+                {k: v for k, v in r.items() if k != "recorded_at"},
+                sort_keys=True,
+            )
+
+        if _key(prior[-1]) == _key(row):
+            return False
+    with open(path, "a") as f:
+        f.write(json.dumps(row, sort_keys=True) + "\n")
+    return True
+
+
+def row_from_bench(doc: dict, source: str = "bench.py") -> dict:
+    """Project a bench.py JSON line into a ledger row."""
+    detail = doc.get("detail", {}) or {}
+    row = {
+        "schema": SCHEMA,
+        "source": source,
+        "recorded_at": now_iso(),
+        "value_sets_per_s": float(doc.get("value") or 0.0),
+    }
+    if detail.get("device"):
+        row["mode"] = "device"
+        row["device"] = detail["device"]
+    elif detail.get("replay", {}).get("sets_per_s"):
+        row["mode"] = "cpu_replay"
+    else:
+        row["mode"] = "dead"
+    c1 = detail.get("config1_raw_batch") or {}
+    if isinstance(c1, dict):
+        if c1.get("sets_per_s"):
+            row["batch_sets_per_s"] = c1["sets_per_s"]
+        if c1.get("marginal_sets_per_s"):
+            row["marginal_sets_per_s"] = c1["marginal_sets_per_s"]
+    rep = detail.get("replay")
+    if isinstance(rep, dict) and rep.get("sets_per_s"):
+        row["replay"] = {
+            k: rep.get(k) for k in ("bucket", "sets_per_s", "checked")
+        }
+    kc = detail.get("kernel_costs", {})
+    buckets = kc.get("buckets") if isinstance(kc, dict) else None
+    if isinstance(buckets, dict):
+        row["kernel"] = {
+            b: {
+                "fp_muls_per_set": e.get("fp_muls_per_set"),
+                "elem_ops_per_set": e.get("elem_ops_per_set"),
+                "roofline_est_sets_per_s": (
+                    (e.get("roofline") or {}).get("est_sets_per_s")
+                ),
+            }
+            for b, e in buckets.items()
+            if isinstance(e, dict) and "fp_muls_per_set" in e
+        }
+    ep = detail.get("epoch", {})
+    if isinstance(ep, dict):
+        warm = {
+            k[1:]: v["warm_s"]
+            for k, v in ep.items()
+            if isinstance(v, dict) and "warm_s" in v
+        }
+        if warm:
+            row["epoch_warm_s"] = warm
+    load = detail.get("load", {})
+    if isinstance(load, dict):
+        # LoadReport v1 shape (lighthouse_tpu/tools/loadgen.py):
+        # duty_response_ms.{p50,p95,p99}, shed.rate, deadline.rate
+        sub = {}
+        duty = load.get("duty_response_ms")
+        if isinstance(duty, dict) and duty.get("p99") is not None:
+            sub["duty_p99_s"] = round(float(duty["p99"]) / 1000.0, 6)
+        shed = load.get("shed")
+        if isinstance(shed, dict) and shed.get("rate") is not None:
+            sub["shed_rate"] = shed["rate"]
+        dl = load.get("deadline")
+        if isinstance(dl, dict) and dl.get("rate") is not None:
+            sub["deadline_miss_rate"] = dl["rate"]
+        if sub:
+            row["load"] = sub
+    sc = detail.get("scenarios", {})
+    if isinstance(sc, dict) and "pass_all" in sc:
+        row["scenarios_pass"] = bool(sc["pass_all"])
+    bi = detail.get("backend_init", {})
+    arts = bi.get("artifacts") if isinstance(bi, dict) else None
+    if isinstance(arts, list):
+        row["artifacts"] = [
+            {k: a.get(k) for k in ("bucket", "backend",
+                                   "source_hash_match", "age_s")}
+            for a in arts
+        ]
+    if detail.get("last_self_measured", {}).get("value"):
+        lsm = detail["last_self_measured"]
+        row["last_self_measured"] = {
+            "value": lsm.get("value"), "measured_at": lsm.get("measured_at")
+        }
+    return row
+
+
+# ------------------------------------------------------------------ compare
+
+# (dotted path, label, kind): kind "time" = lower is better, "rate" =
+# higher is better, "count" = lower is better and exact (op census)
+COMPARE_FIELDS = (
+    # absolute floors sized ~2x the warm steady-state values so shared-
+    # CI scheduling noise cannot flap the gate; decays at this scale
+    # are also caught by test_scale/test_loadgen's absolute budgets
+    ("epoch_warm_s.250k", "epoch warm @250k", "time", 0.08),
+    ("epoch_warm_s.500k", "epoch warm @500k", "time", 0.12),
+    ("load.duty_p99_s", "load duty p99", "time", 0.05),
+    ("kernel.4096.fp_muls_per_set", "fp-muls/set @4096", "count", 0.0),
+    ("kernel.1024.fp_muls_per_set", "fp-muls/set @1024", "count", 0.0),
+    ("kernel.128.fp_muls_per_set", "fp-muls/set @128", "count", 0.0),
+    ("value_sets_per_s", "driver-verified sets/s", "rate", 0.0),
+    ("replay.sets_per_s", "cpu-replay sets/s", "rate", 0.0),
+)
+
+
+def _dig(row: dict, dotted: str):
+    cur = row
+    for part in dotted.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    return cur if isinstance(cur, (int, float)) else None
+
+
+def compare(prev: dict, cur: dict, rel_tol: float = 0.20) -> list:
+    """Regressions between two rows: >rel_tol relative decay on any
+    field BOTH rows carry (absolute floors keep shared-CI timing noise
+    from flapping the gate; op counts are exact — any increase flags).
+    Returns human-readable problem strings."""
+    problems = []
+    for dotted, label, kind, floor in COMPARE_FIELDS:
+        a, b = _dig(prev, dotted), _dig(cur, dotted)
+        if a is None or b is None:
+            continue
+        if kind == "count":
+            if b > a:
+                problems.append(
+                    f"{label}: {a} -> {b} (+{b - a} ops; op counts are "
+                    f"exact — this is a kernel regression)"
+                )
+        elif kind == "time":
+            if b > a * (1 + rel_tol) and (b - a) > floor:
+                problems.append(
+                    f"{label}: {a:.4g}s -> {b:.4g}s "
+                    f"(+{(b / a - 1) * 100:.0f}%)"
+                )
+        elif kind == "rate":
+            # a dead round (0.0) is not a measurement; only compare
+            # when both rounds actually measured something, and only
+            # within one measurement mode — a device round followed by
+            # a CPU-replay round is a tunnel outage, not a 250x decay
+            if dotted == "value_sets_per_s" and (
+                prev.get("mode") != cur.get("mode")
+            ):
+                continue
+            if a > 0 and b > 0 and b < a * (1 - rel_tol):
+                problems.append(
+                    f"{label}: {a:.4g} -> {b:.4g} "
+                    f"({(b / a - 1) * 100:.0f}%)"
+                )
+    return problems
+
+
+def latest_comparable(all_rows: list) -> tuple:
+    """The two most recent rows that share at least one comparable
+    field, newest last; (None, None) when fewer than two exist."""
+    for i in range(len(all_rows) - 1, 0, -1):
+        cur = all_rows[i]
+        for j in range(i - 1, -1, -1):
+            prev = all_rows[j]
+            if any(
+                _dig(prev, d) is not None and _dig(cur, d) is not None
+                for d, *_ in COMPARE_FIELDS
+            ):
+                return prev, cur
+    return None, None
+
+
+def render(all_rows: list) -> str:
+    """Fixed-width trajectory table for terminals/logs."""
+    cols = (
+        ("recorded_at", 20), ("source", 16), ("mode", 10),
+        ("value_sets_per_s", 12), ("marginal_sets_per_s", 12),
+        ("replay_rate", 11), ("fpmul/set@4096", 14),
+        ("roofline@4096", 13), ("epoch250k", 9), ("duty_p99", 8),
+    )
+    lines = ["  ".join(name.ljust(w) for name, w in cols)]
+    for r in all_rows:
+        vals = {
+            "recorded_at": r.get("recorded_at", ""),
+            "source": r.get("source", ""),
+            "mode": r.get("mode", ""),
+            "value_sets_per_s": r.get("value_sets_per_s"),
+            "marginal_sets_per_s": r.get("marginal_sets_per_s"),
+            "replay_rate": _dig(r, "replay.sets_per_s"),
+            "fpmul/set@4096": _dig(r, "kernel.4096.fp_muls_per_set"),
+            "roofline@4096": _dig(
+                r, "kernel.4096.roofline_est_sets_per_s"),
+            "epoch250k": _dig(r, "epoch_warm_s.250k"),
+            "duty_p99": _dig(r, "load.duty_p99_s"),
+        }
+        lines.append("  ".join(
+            ("" if vals[name] is None else str(vals[name]))[:w].ljust(w)
+            for name, w in cols
+        ))
+    return "\n".join(lines)

@@ -1,0 +1,160 @@
+"""Differential replay gate (ISSUE 10 satellite): the deserialized
+`.graft_export` module replayed on CPU must return exactly the same
+verdicts as the live paths, across a valid batch, a single forged set,
+and a padding-lane case.
+
+Cost ground rules (measured on this one-core image, BASELINE.md
+§Kernel-costs): export = ~6 min of trace+lower per bucket, the
+module's first backend compile = tens of minutes COLD but seconds once
+`.jax_cache` holds it, and the *jit* path pays its ~3-6 min trace in
+EVERY fresh process. Tier-1 therefore drives the same pinned-env
+replay subprocess bench.py uses (shared .jax_cache entry, gated on
+the warm stamp bench writes) and checks its verdicts against the
+pure-Python CPU oracle; the bit-identical replay-vs-jit comparison
+and the 1024/4096 buckets run slow-marked. A missing/stale artifact
+or a cold box skips with the seeding command — bench records the same
+staleness in detail.backend_init.artifacts, so a skipped gate is
+never silent.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.crypto.bls.backends import export_store
+from lighthouse_tpu.crypto.bls.backends.export_store import _replay_sets
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _skip_unless_ready(bucket):
+    if export_store.replay_callable(bucket) is None:
+        pytest.skip(
+            f"no loadable export artifact for bucket {bucket} on this "
+            "backend/source hash — run `python tools/seed_cache.py "
+            "--exports-only` (bench.py seeds it automatically each round)"
+        )
+    if not export_store.replay_is_warm(bucket):
+        pytest.skip(
+            f"replay module for bucket {bucket} not yet compiled on "
+            "this box (tens of minutes cold on one core; seconds after "
+            "`python bench.py` or `python -m lighthouse_tpu.crypto."
+            f"bls.backends.export_store replay-bench {bucket}` has "
+            "run once under export_store.replay_env())"
+        )
+
+
+@pytest.fixture(scope="module")
+def replay_report():
+    """One pinned-env replay subprocess run: exports if needed (won't
+    happen here — the artifact gate skips first), replays with the
+    built-in correctness checks, returns the parsed JSON report.
+    8-15 min even warm on the one-core image (cached-executable load
+    dominates) — slow tier; tier-1 gates on the recorded evidence
+    (test_replay_round_evidence below) instead."""
+    _skip_unless_ready(128)
+    proc = subprocess.run(
+        [sys.executable, "-m",
+         "lighthouse_tpu.crypto.bls.backends.export_store",
+         "replay-bench", "128", "2"],
+        env=export_store.replay_env(),
+        capture_output=True,
+        text=True,
+        # warm = ~8 min on the one-core image (cached executable load
+        # dominates); scaled headroom for loaded boxes
+        timeout=float(os.environ.get("LH_REPLAY_TEST_TIMEOUT_S", "900")),
+        cwd=_REPO,
+    )
+    line = next(
+        (ln for ln in reversed((proc.stdout or "").splitlines())
+         if ln.startswith("{")),
+        None,
+    )
+    assert line, (
+        f"replay subprocess rc={proc.returncode} "
+        f"stderr={proc.stderr[-500:]!r}"
+    )
+    return json.loads(line)
+
+
+@pytest.mark.slow
+def test_replay_verdicts(replay_report):
+    assert replay_report["checked"] is True, replay_report
+    checks = replay_report["checks"]
+    assert checks["valid_full"] is True
+    assert checks["forged_rejected"] is True
+    assert checks["valid_padded"] is True
+    assert replay_report["sets_per_s"] > 0
+
+
+@pytest.mark.slow
+def test_replay_matches_cpu_oracle(replay_report):
+    """The subprocess's padded-batch verdicts re-derived through the
+    pure-Python oracle over the SAME deterministic sets."""
+    sets = _replay_sets(4)
+    assert bls.verify_signature_sets(sets, backend="cpu") is True
+    forged = _replay_sets(4, forge_index=1)
+    assert bls.verify_signature_sets(forged, backend="cpu") is False
+    # and the replay agreed (checks computed in the subprocess)
+    assert replay_report["checks"]["valid_padded"] is True
+    assert replay_report["checks"]["forged_rejected"] is True
+
+
+def test_oracle_rejects_forged_construction():
+    """Tier-1 anchor for the oracle half of the differential: the
+    deterministic replay sets really are valid / really are forged
+    (the replay side of the same construction is asserted per bench
+    round and by the slow-tier subprocess tests)."""
+    assert bls.verify_signature_sets(_replay_sets(4), backend="cpu")
+    assert not bls.verify_signature_sets(
+        _replay_sets(4, forge_index=2), backend="cpu"
+    )
+
+
+def test_replay_round_evidence():
+    """Tier-1 evidence gate: whenever a ledger round carried a replay
+    measurement, it must have been correctness-checked; and when this
+    box is stamped warm, a loadable artifact must actually exist
+    (stamp/artifact drift would silently disable the replay path)."""
+    from lighthouse_tpu.tools import perf_ledger as L
+
+    replay_rows = [r for r in L.rows() if r.get("replay")]
+    for r in replay_rows:
+        assert r["replay"].get("checked") is True, r
+        assert r["replay"].get("sets_per_s", 0) > 0, r
+    if export_store.replay_is_warm(128):
+        assert export_store.replay_callable(128) is not None
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("bucket,n", [(128, 1), (128, 128),
+                                      (1024, 1000), (4096, 4096)])
+def test_replay_bit_identical_to_jit(bucket, n):
+    """The full differential: deserialized module vs the jit kernel,
+    same packed inputs, verdicts compared as raw device arrays. The
+    1024/4096 buckets export on demand (minutes each) if absent; the
+    jit path pays its own trace (~3-6 min per bucket) — slow tier."""
+    import jax
+
+    from lighthouse_tpu.crypto.bls.backends import tpu as TB
+
+    fn = export_store.replay_callable(bucket)
+    if fn is None:
+        export_store.export_bucket(bucket)
+        fn = export_store.replay_callable(bucket)
+    assert fn is not None
+    for forge in (None, max(0, n - 2)):
+        sets = _replay_sets(n, forge_index=forge)
+        scalars = bls.gen_batch_scalars(n)
+        args = TB.prepare_batch(sets, scalars)
+        assert args[0].shape[-1] == bucket
+        got = np.asarray(jax.block_until_ready(fn(*args)))
+        want = np.asarray(jax.block_until_ready(TB._verify_kernel(*args)))
+        assert got.dtype == want.dtype and got.shape == want.shape
+        assert np.array_equal(got, want)
+        assert bool(want) is (forge is None)

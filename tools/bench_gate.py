@@ -1,0 +1,63 @@
+#!/usr/bin/env python
+"""Bench regression gate (ISSUE 10 satellite): diff the two most
+recent bench rounds' CPU-side sections and exit nonzero on regression.
+
+Compares, via the shared perf-ledger comparator
+(lighthouse_tpu/tools/perf_ledger.py COMPARE_FIELDS):
+  - epoch stage seconds (warm @250k/@500k), >20% + absolute floor
+  - load duty p99, >20% + floor
+  - per-bucket kernel Fp-mul counts — EXACT: any increase fails
+  - device / replay rates when both rounds measured one
+
+Dead-tunnel rounds therefore cannot silently decay the trajectory:
+op counts and CPU-side numbers are present on every round, and those
+are exactly the fields this gate compares. Wired into tier-1 via
+tests/test_kernel_costs.py (fixture-driven + the real ledger).
+
+  python tools/bench_gate.py [--path PERF.jsonl] [--tolerance 0.2]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from lighthouse_tpu.tools import perf_ledger as L  # noqa: E402
+
+
+def gate(path: str | None = None, tolerance: float = 0.20) -> list:
+    """Problems between the two latest comparable rounds ([] = pass;
+    fewer than two comparable rounds also passes — there is nothing to
+    decay from)."""
+    all_rows = L.rows(path)
+    prev, cur = L.latest_comparable(all_rows)
+    if prev is None:
+        return []
+    return [
+        f"{prev.get('source', '?')} -> {cur.get('source', '?')}: {p}"
+        for p in L.compare(prev, cur, rel_tol=tolerance)
+    ]
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--path", default=L.default_path())
+    ap.add_argument("--tolerance", type=float, default=0.20)
+    args = ap.parse_args()
+    problems = gate(args.path, args.tolerance)
+    for p in problems:
+        print(f"bench-gate: REGRESSION {p}", file=sys.stderr)
+    if problems:
+        return 1
+    rows = L.rows(args.path)
+    print(f"bench-gate: ok ({len(rows)} ledger rows)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

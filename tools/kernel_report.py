@@ -1,0 +1,167 @@
+#!/usr/bin/env python
+"""Kernel cost report CLI (ISSUE 10 tentpole): render the verify
+kernel's op census per AOT bucket and pipeline stage, the roofline
+columns, and the fused epoch program's XLA cost totals — all on CPU,
+no chip required, seconds on a warm profile cache.
+
+  python tools/kernel_report.py                    # census + roofline
+  python tools/kernel_report.py --buckets 128 4096
+  python tools/kernel_report.py --json             # machine-readable
+  python tools/kernel_report.py --check            # vs checked-in budgets
+  python tools/kernel_report.py --update-budgets   # deliberate op cut:
+                                                   # rewrite the budget
+                                                   # file in this diff
+  python tools/kernel_report.py --hlo BUCKET       # real jax lowering +
+                                                   # HLO walk (~3 min +
+                                                   # tens of MB of HLO;
+                                                   # for spot audits of
+                                                   # the census model)
+
+The census mechanism (and why it is not plain HLO lowering) is
+documented in lighthouse_tpu/ops/costs.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _render(report: dict) -> str:
+    lines = []
+    lines.append(
+        f"kernel cost census — sources {report['source_fingerprint']}, "
+        f"chip model {report['chip_model']['name']}"
+    )
+    hdr = (f"{'bucket':>7} {'fp-mul/set':>11} {'Melem/set':>10} "
+           f"{'dispatches':>10} {'bound':>8} {'roofline sets/s':>16} "
+           f"{'incl ovh':>9}")
+    lines.append(hdr)
+    for b, e in sorted(report["buckets"].items(), key=lambda kv: int(kv[0])):
+        r = e["roofline"]
+        lines.append(
+            f"{b:>7} {e['fp_muls_per_set']:>11.1f} "
+            f"{e['elem_ops_per_set'] / 1e6:>10.1f} "
+            f"{e['kernel_dispatches']:>10} {r['bound']:>8} "
+            f"{r['est_sets_per_s']:>16.1f} "
+            f"{r['est_sets_per_s_incl_overhead']:>9.1f}"
+        )
+        stages = e.get("stages")
+        if stages:
+            total = max(e["fp_muls"], 1)
+            for name, sub in stages.items():
+                share = 100.0 * sub["fp_muls"] / total
+                lines.append(
+                    f"{'':>7}   {name:<18} fp-muls {sub['fp_muls']:>12} "
+                    f"({share:4.1f}%)  dispatches {sub['kernel_dispatches']:>6}"
+                )
+    ep = report.get("epoch")
+    if isinstance(ep, dict) and "flops" in ep:
+        lines.append(
+            f"epoch program @{ep['validators']}: "
+            f"{ep['flops'] / 1e6:.1f} MFLOP, "
+            f"{ep['bytes_accessed'] / 1e6:.1f} MB accessed "
+            f"(XLA cost analysis, compile {ep['compile_s']}s)"
+        )
+    return "\n".join(lines)
+
+
+def _hlo_report(bucket: int) -> dict:
+    """Ground-truth audit: really lower the kernel and walk the jaxpr
+    (the census model's numbers should agree on op classes)."""
+    import time
+
+    import jax
+
+    from lighthouse_tpu.crypto.bls.backends.export_store import (
+        _abstract_args,
+    )
+    from lighthouse_tpu.crypto.bls.backends import tpu as TB
+    from lighthouse_tpu.ops import costs
+
+    t0 = time.time()
+    jaxpr = jax.make_jaxpr(TB._verify_kernel)(*_abstract_args(bucket))
+    census = costs.walk_jaxpr(jaxpr.jaxpr)
+    return {
+        "bucket": bucket,
+        "trace_s": round(time.time() - t0, 1),
+        "eqns_by_class": dict(census["eqns"]),
+        "elems_by_class": {k: float(v) for k, v in census["elems"].items()},
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--buckets", type=int, nargs="*", default=None)
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument("--check", action="store_true")
+    ap.add_argument("--update-budgets", action="store_true")
+    ap.add_argument("--no-stages", action="store_true")
+    ap.add_argument("--no-epoch", action="store_true")
+    ap.add_argument("--hlo", type=int, metavar="BUCKET")
+    args = ap.parse_args()
+
+    from lighthouse_tpu.ops import costs
+
+    if args.hlo:
+        out = _hlo_report(args.hlo)
+        print(json.dumps(out, indent=1))
+        return 0
+
+    buckets = tuple(args.buckets) if args.buckets else costs.DEFAULT_BUCKETS
+    report = costs.kernel_costs(
+        buckets, stages=not args.no_stages, epoch=not args.no_epoch
+    )
+    if args.json:
+        print(json.dumps(report, indent=1, sort_keys=True))
+    else:
+        print(_render(report))
+
+    if args.update_budgets:
+        budgets = {
+            "comment": "Per-bucket Fp-mul budgets for the verify kernel "
+            "(ops/costs.py census). An accidental increase fails "
+            "tests/test_kernel_costs.py; a deliberate op cut updates "
+            "this file in the same diff (tools/kernel_report.py "
+            "--update-budgets).",
+            "source": "ops/costs.py verify_kernel_costs()",
+            "source_fingerprint": report["source_fingerprint"],
+            "slack_ratio": 0.02,
+            "buckets": {
+                b: {
+                    "fp_muls": e["fp_muls"],
+                    "fp_muls_per_set": e["fp_muls_per_set"],
+                    "kernel_dispatches": e["kernel_dispatches"],
+                    "elem_ops": e["elem_ops"],
+                    "hbm_bytes": e["hbm_bytes"],
+                    "roofline_est_sets_per_s": (
+                        e["roofline"]["est_sets_per_s"]
+                    ),
+                }
+                for b, e in report["buckets"].items()
+            },
+        }
+        with open(costs.budgets_path(), "w") as f:
+            json.dump(budgets, f, indent=1)
+        print(f"budgets written: {costs.budgets_path()}")
+
+    if args.check:
+        problems = costs.check_budgets(report["buckets"])
+        for p in problems:
+            print(f"kernel-report: {p}", file=sys.stderr)
+        if problems:
+            return 1
+        print("kernel-report: census within budgets")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -75,6 +75,13 @@ REQUIRED_FAMILIES = {
     "bls_tpu_export_cache_total": ("result",),
     "bls_tpu_host_pack_seconds": ("bucket",),
     "bls_tpu_device_seconds": ("bucket",),
+    # kernel cost observatory (ISSUE 10, backends/device_metrics.py):
+    # cumulative census flops/bytes per bucket, export-artifact state,
+    # and observed compile events per program
+    "bls_kernel_flops_total": ("bucket",),
+    "bls_kernel_bytes_total": ("bucket",),
+    "bls_export_artifact_info": ("bucket", "source"),
+    "jax_compile_seconds": ("program",),
     # gossip ingest (network/network_beacon_processor.py)
     "network_gossip_messages_total": ("kind",),
     "network_gossip_decode_failures_total": ("kind",),
@@ -116,6 +123,12 @@ REQUIRED_BUCKETS = {
     "beacon_processor_batch_size": (
         1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096,
     ),
+    # compile events are seconds-to-minutes; the request-latency layout
+    # would collapse every observation into +Inf
+    "jax_compile_seconds": (
+        0.1, 0.5, 1.0, 5.0, 15.0, 30.0, 60.0, 120.0, 300.0, 600.0,
+        1200.0, 1800.0,
+    ),
 }
 
 # sample line: name{labels} value   (labels optional)
@@ -139,6 +152,10 @@ def _import_surface(problems: list) -> None:
     import lighthouse_tpu.common.tracing  # noqa: F401
     import lighthouse_tpu.consensus.state_transition  # noqa: F401
     import lighthouse_tpu.node.beacon_chain  # noqa: F401
+
+    # jax-free: the cost-observatory families register even where the
+    # jax-heavy tpu module cannot import
+    import lighthouse_tpu.crypto.bls.backends.device_metrics  # noqa: F401
 
     try:
         import lighthouse_tpu.crypto.bls.backends.tpu  # noqa: F401

@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""Perf-ledger CLI (ISSUE 10): render the PERF.jsonl trajectory and
+flag regressions between the two most recent comparable rounds.
+
+  python tools/perf_ledger.py                   # table + regression check
+  python tools/perf_ledger.py --append BENCH_r06.json
+                                                # project a driver bench
+                                                # artifact into a row
+  python tools/perf_ledger.py --path other.jsonl
+
+Exit code: 0 clean, 1 when the latest comparable pair regressed (see
+tools/bench_gate.py for the tier-1 wiring and thresholds).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from lighthouse_tpu.tools import perf_ledger as L  # noqa: E402
+
+
+def _bench_doc(path: str) -> dict:
+    """A bench JSON line, or a driver artifact whose `tail` embeds one."""
+    with open(path) as f:
+        doc = json.load(f)
+    if doc.get("value") is None and isinstance(doc.get("tail"), str):
+        for line in reversed(doc["tail"].splitlines()):
+            if line.startswith('{"metric"'):
+                return json.loads(line)
+    return doc
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--path", default=L.default_path())
+    ap.add_argument("--append", metavar="BENCH_JSON",
+                    help="project a bench artifact into a ledger row")
+    ap.add_argument("--tolerance", type=float, default=0.20)
+    args = ap.parse_args()
+
+    if args.append:
+        doc = _bench_doc(args.append)
+        row = L.row_from_bench(doc, source=os.path.basename(args.append))
+        added = L.append(row, args.path)
+        print(("appended" if added else "duplicate, skipped")
+              + f" ({row.get('mode')})")
+
+    all_rows = L.rows(args.path)
+    if not all_rows:
+        print(f"no ledger rows at {args.path}")
+        return 0
+    print(L.render(all_rows))
+    prev, cur = L.latest_comparable(all_rows)
+    if prev is None:
+        print("\n(fewer than two comparable rounds — no regression check)")
+        return 0
+    problems = L.compare(prev, cur, rel_tol=args.tolerance)
+    if problems:
+        print(f"\nREGRESSIONS {prev.get('source')} -> {cur.get('source')}:")
+        for p in problems:
+            print(f"  - {p}")
+        return 1
+    print(f"\nok: {prev.get('source')} -> {cur.get('source')} "
+          f"within {args.tolerance:.0%}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -36,7 +36,11 @@ while true; do
       python - <<'PY' >> /tmp/seedloop.log 2>&1
 import json, shutil
 doc = json.load(open("/tmp/bench_tpu.json"))
-if doc.get("value"):
+# device measurements only: a chip that died mid-run makes bench fall
+# back to the CPU replay (measurement_mode="cpu_replay"), whose nonzero
+# value must never overwrite the last genuine device rate
+mode = (doc.get("detail") or {}).get("measurement_mode")
+if doc.get("value") and mode == "device":
     shutil.copy("/tmp/bench_tpu.json", "/tmp/bench_tpu_last_good.json")
 PY
     fi
