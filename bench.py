@@ -8,9 +8,11 @@ Prints ONE JSON line:
    "vs_baseline" (vs the blst single-HOST anchor, see below),
    "detail" (all configs, latency percentiles, anchors, per-stage
    epoch-boundary seconds at 250k/500k under "epoch", the chaos fleet
-   under "scenarios", the traffic-replay SLO report under "load", and
-   the kernel op census + v5e roofline under "kernel_costs" — the
-   CPU-side sections ship tunnel up or down, and every round appends a
+   under "scenarios", the traffic-replay SLO report under "load",
+   the kernel op census + v5e roofline under "kernel_costs", and the
+   state-hashing compression census + lane-kernel roofline under
+   "hash" — the CPU-side sections ship tunnel up or down, and every
+   round appends a
    trajectory row to PERF.jsonl for tools/perf_ledger.py /
    tools/bench_gate.py)}
 
@@ -493,6 +495,20 @@ def _config_kernel_costs(detail):
     detail["kernel_costs"] = report
 
 
+def _config_hash_costs(detail):
+    """detail.hash (ISSUE 11 tentpole): the SHA-256 compression census
+    of the pinned state-hashing scenarios (cold root / epoch boundary /
+    steady slot / block import @250k validators) with per-field and
+    per-cause attribution, dirty-chunk counts, and the v5e lane-kernel
+    roofline — the "what would ROADMAP item 4 buy us" column. Pure
+    host work and exact counts, so the hashing trajectory ships every
+    round, tunnel up or down, and tools/bench_gate.py fails any
+    round-over-round compression increase exactly like op counts."""
+    from lighthouse_tpu.ops import hash_costs
+
+    detail["hash"] = hash_costs.hash_costs()
+
+
 def _seed_artifacts(detail):
     """Record the exported-artifact inventory (bucket, age, source-hash
     match) in detail.backend_init EVEN ON SUCCESS and mirror it into
@@ -814,7 +830,7 @@ def main():
         print(
             f"bench: no chip backend ({why}); replaying the exported "
             "module on CPU + emitting CPU-side detail sections "
-            "(kernel_costs/load/scenarios/epoch)",
+            "(kernel_costs/hash/load/scenarios/epoch)",
             file=sys.stderr,
             flush=True,
         )
@@ -857,6 +873,9 @@ def main():
         # serving-path SLO curves are chip-independent too (ISSUE 8)
         _run_config("load", 60, _config_load)
         _run_config("kernel_costs", 60, _config_kernel_costs)
+        # the merkleization census rides dead-tunnel rounds too
+        # (ISSUE 11): exact compression counts + roofline, host-only
+        _run_config("hash", 45, _config_hash_costs)
         _run_config("replay", 60, _config_replay)
         _emit()
         # a correctness-checked replay measurement IS a result: rc 0
@@ -921,6 +940,9 @@ def main():
 
     # the kernel cost census + roofline rides every round (ISSUE 10)
     _run_config("kernel_costs", 60, _config_kernel_costs)
+
+    # the merkleization cost census rides every round too (ISSUE 11)
+    _run_config("hash", 45, _config_hash_costs)
 
     # per-stage epoch-boundary attribution rides every round (ISSUE 6)
     _run_config("epoch", 60, _config_epoch)

@@ -35,7 +35,21 @@ CHUNK_ELEMS = 1024
 _WRAP_THRESHOLD = 2048
 
 
+# Merkleization census hook (ISSUE 11): ops/hash_costs.py installs a
+# recorder here and every seam below consults it per call — the
+# fp.CENSUS pattern. None (the default) costs one global read on the
+# hot path; a recorder attributes every SHA-256 compression during a
+# hash_tree_root to (top-level field, cause) where cause is one of
+# dirty_chunk / subtree / cache_key / small_container, plus per-field
+# dirty-chunk counts and chunk/root cache hit rates.
+CENSUS = None
+
+
 def _hash(a: bytes, b: bytes) -> bytes:
+    # both operands are 32-byte chunks at every call site: 64 bytes +
+    # SHA-256 padding = exactly 2 compression-function invocations
+    if CENSUS is not None:
+        CENSUS.on_hash(2)
     return hashlib.sha256(a + b).digest()
 
 
@@ -483,6 +497,29 @@ class ChunkedSeq:
     def token(self) -> int:
         return self._token
 
+    # ------------------------------------------------- dirty-set surface
+    #
+    # ISSUE 11: the per-chunk version counters keying the column cache
+    # already know exactly which chunks mutated; surface them so the
+    # merkleization observatory (ops/hash_costs.py) and its soundness
+    # tests can compare "what the spine thinks is dirty" against "what
+    # actually re-hashed" without reaching into slots.
+
+    def versions(self) -> tuple:
+        """Snapshot of the per-chunk mutation counters (pair with
+        dirty_chunks_since)."""
+        return tuple(self._versions)
+
+    def dirty_chunks_since(self, snapshot: tuple) -> list:
+        """Chunk indices whose content may differ from when `snapshot`
+        (a versions() result) was taken: bumped counters plus chunks
+        appended since. Exactly the set hash_tree_root will re-hash,
+        provided the snapshot was taken with root caches warm."""
+        n = min(len(snapshot), len(self._versions))
+        out = [ci for ci in range(n) if self._versions[ci] != snapshot[ci]]
+        out.extend(range(len(snapshot), len(self._chunks)))
+        return out
+
     def _own_chunk(self, ci: int) -> list:
         """Make chunk `ci` privately mutable; invalidate its root."""
         if ci not in self._owned:
@@ -675,9 +712,27 @@ class ChunkedSeq:
             self._roots = [None] * len(self._chunks)
             self._root_elem = elem
         r = self._roots[ci]
+        c = CENSUS
         if r is None:
-            r = _chunk_subtree_root(elem, self._chunks[ci], _chunk_depth(elem))
+            if c is not None:
+                # everything hashed until the chunk root lands — packing,
+                # per-element container roots, the subtree combine — is a
+                # dirty-chunk recompute; the recorder also charges one
+                # dirty chunk to the current field and a chunk-cache miss
+                c.begin_dirty_chunk()
+                try:
+                    r = _chunk_subtree_root(
+                        elem, self._chunks[ci], _chunk_depth(elem)
+                    )
+                finally:
+                    c.end_dirty_chunk()
+            else:
+                r = _chunk_subtree_root(
+                    elem, self._chunks[ci], _chunk_depth(elem)
+                )
             self._roots[ci] = r
+        elif c is not None:
+            c.cache_event("chunk", True)
         return r
 
 
@@ -775,33 +830,61 @@ def _chunked_seq_root(elem: SSZType, cs: ChunkedSeq, limit_chunks) -> bytes:
     if depth < k or not cs._chunks:
         return _seq_root_plain(elem, list(cs), limit_chunks)
     layer = [cs._cached_chunk_root(ci, elem) for ci in range(len(cs._chunks))]
-    for d in range(k, depth):
-        if len(layer) % 2:
-            layer.append(_ZERO_CHUNKS[d])
-        layer = [_hash(layer[i], layer[i + 1]) for i in range(0, len(layer), 2)]
+    c = CENSUS
+    if c is not None:
+        c.push_cause("subtree")
+    try:
+        for d in range(k, depth):
+            if len(layer) % 2:
+                layer.append(_ZERO_CHUNKS[d])
+            layer = [
+                _hash(layer[i], layer[i + 1])
+                for i in range(0, len(layer), 2)
+            ]
+    finally:
+        if c is not None:
+            c.pop_cause()
     return layer[0]
 
 
-# Content-keyed root cache for big sequences: beacon-state vectors
-# (randao mixes, block/state roots) are re-rooted every slot but change
-# in at most one entry; one C-speed sha256 over the joined leaves is
-# ~100x cheaper than the 2N python-level hash calls it skips. Bounded
-# FIFO (dict preserves insertion order).
+# Content-keyed root cache for big plain sequences: beacon-state
+# vectors that stay on plain lists are re-rooted every slot but rarely
+# change. ChunkedSeq-backed fields never land here with a cacheable
+# chunk count (their per-chunk subtree caches already make re-rooting
+# O(dirty chunks)); this cache serves the plain-list leftovers.
+# Bounded FIFO (dict preserves insertion order).
+#
+# Key construction (ISSUE 11 satellite): the key used to be a SHA-256
+# over the joined chunks — in compression count that is HALF of the
+# merkleization a hit avoids, so every "hit" still paid ~33% of the
+# hashing. The chunk tuple itself is the key now: bytes hashes are
+# C-speed siphash (cached per object, and `bytes(v)` of an unchanged
+# Bytes32 entry returns the same object), equality on a hit is
+# content equality — zero SHA-256 compressions, and the census
+# `cache_key` column proves it stays at zero. A tuple key retains its
+# chunk objects, so the FIFO bound is sized for ~64 KB/entry worst
+# case (~16 MB total), not the 4096 entries the 32-byte digest keys
+# allowed — the observed working set is single-digit entries.
 _ROOT_CACHE: dict = {}
-_ROOT_CACHE_MAX = 4096
+_ROOT_CACHE_MAX = 256
 _CACHE_MIN_CHUNKS = 256
 
 
 def _cached_merkleize(chunks: list, limit_chunks) -> bytes:
     if len(chunks) < _CACHE_MIN_CHUNKS:
         return merkleize(chunks, limit_chunks)
-    key = (hashlib.sha256(b"".join(chunks)).digest(), len(chunks), limit_chunks)
-    root = _ROOT_CACHE.get(key)
+    full_key = (tuple(chunks), limit_chunks)
+    root = _ROOT_CACHE.get(full_key)
+    c = CENSUS
     if root is None:
+        if c is not None:
+            c.cache_event("root", False)
         root = merkleize(chunks, limit_chunks)
         if len(_ROOT_CACHE) >= _ROOT_CACHE_MAX:
             _ROOT_CACHE.pop(next(iter(_ROOT_CACHE)))
-        _ROOT_CACHE[key] = root
+        _ROOT_CACHE[full_key] = root
+    elif c is not None:
+        c.cache_event("root", True)
     return root
 
 
@@ -910,10 +993,23 @@ class Container(SSZType):
         return SSZValue(self, fixed_vals)
 
     def hash_tree_root(self, value) -> bytes:
-        roots = [
-            ftype.hash_tree_root(getattr(value, fname))
-            for fname, ftype in self.fields
-        ]
+        c = CENSUS
+        if c is None or not c.wants_fields():
+            # nested containers keep the enclosing top-level field label:
+            # only the OUTERMOST container of a measured root pays the
+            # per-field bookkeeping (the 250k validator containers don't)
+            roots = [
+                ftype.hash_tree_root(getattr(value, fname))
+                for fname, ftype in self.fields
+            ]
+            return merkleize(roots)
+        roots = []
+        for fname, ftype in self.fields:
+            c.begin_field(fname)
+            try:
+                roots.append(ftype.hash_tree_root(getattr(value, fname)))
+            finally:
+                c.end_field()
         return merkleize(roots)
 
     def default(self):

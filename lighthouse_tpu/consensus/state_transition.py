@@ -36,6 +36,7 @@ import numpy as np
 from ..common import metrics as _metrics
 from ..common import tracing as _tracing
 from ..ops import epoch as _epoch_ops
+from ..ops import hash_costs as _hash_costs
 from ..crypto import bls
 from ..crypto.bls.keys import PublicKey, Signature, SignatureSet
 from . import types as T
@@ -461,7 +462,11 @@ def process_slots(spec: ChainSpec, state, slot: int) -> None:
 
 
 def _process_slot(spec: ChainSpec, state) -> None:
-    previous_state_root = state.hash_tree_root()
+    # the dominant pre-advance cost since the columnar epoch transition
+    # (ROADMAP item 4): measured always, so every slot lands htr:<field>
+    # spans on the timelines and the state_hash_* series move in prod
+    with _hash_costs.measure("slot_root", slot=int(state.slot)):
+        previous_state_root = state.hash_tree_root()
     state.state_roots[state.slot % spec.preset.slots_per_historical_root] = (
         previous_state_root
     )
@@ -512,7 +517,9 @@ def state_transition(
         if not bls.verify_signature_sets([s]):
             raise BlockProcessingError("invalid block signature")
     process_block(spec, state, block, verify_signatures=verify_signatures)
-    if bytes(block.state_root) != state.hash_tree_root():
+    with _hash_costs.measure("state_root_check", slot=int(block.slot)):
+        root = state.hash_tree_root()
+    if bytes(block.state_root) != root:
         raise BlockProcessingError("state root mismatch")
 
 

@@ -23,6 +23,7 @@ from typing import Optional
 
 from ..common import metrics, tracing
 from ..consensus import state_transition as st
+from ..ops import hash_costs
 from ..consensus import types as T
 from ..consensus.fork_choice import ForkChoice, ForkChoiceError
 from ..consensus.pubkey_cache import ValidatorPubkeyCache
@@ -658,7 +659,9 @@ class BeaconChain:
                 st.process_block(
                     self.spec, state, block, verify_signatures=False
                 )
-                if bytes(block.state_root) != state.hash_tree_root():
+                with hash_costs.measure("block_import_root", slot=slot):
+                    root = state.hash_tree_root()
+                if bytes(block.state_root) != root:
                     raise BlockError("state root mismatch")
 
             with tracing.span("block_import", slot=slot):
@@ -1712,12 +1715,14 @@ class BeaconChain:
                     st.process_block(
                         self.spec, bstate, blinded, verify_signatures=False
                     )
-                    blinded.state_root = bstate.hash_tree_root()
+                    with hash_costs.measure("produce_block_root", slot=slot):
+                        blinded.state_root = bstate.hash_tree_root()
                     return blinded
                 except st.BlockProcessingError:
                     pass  # consensus-invalid header: fall back to local
             st.process_block(self.spec, state, block, verify_signatures=False)
-            block.state_root = state.hash_tree_root()
+            with hash_costs.measure("produce_block_root", slot=slot):
+                block.state_root = state.hash_tree_root()
             return block
 
     def process_blinded_block(self, signed_blinded, builder):

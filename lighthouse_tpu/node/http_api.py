@@ -83,6 +83,7 @@ from typing import Optional
 from ..common import metrics, tracing
 from ..consensus import state_transition as st
 from ..consensus import types as T
+from ..ops import hash_costs
 
 VERSION = "lighthouse-tpu/0.2.0"
 
@@ -111,6 +112,16 @@ SSE_LAG = metrics.histogram(
 SSE_SUBSCRIBERS = metrics.gauge(
     "http_sse_subscribers",
     "Currently connected SSE subscribers",
+)
+# read-path merkleization attribution (ISSUE 11): how many SHA-256
+# compressions serving each route cost — /eth/v1/beacon/states/.../root
+# hashes the whole head state on the read path, and the load
+# observatory (tools/loadgen.py detail.load) prices exactly that
+HTTP_HASH_COMPRESSIONS = metrics.counter(
+    "http_request_hash_compressions_total",
+    "SHA-256 compressions spent computing hash_tree_root while serving "
+    "REST requests, by endpoint (route name)",
+    labelnames=("endpoint",),
 )
 
 # routes whose single path argument is an EPOCH (the request's slot
@@ -1780,7 +1791,18 @@ def make_handler(api: BeaconApi, shutting_down: threading.Event = None):
                     endpoint=endpoint,
                     method=method,
                 ) as attrs:
-                    fn()
+                    # read-path merkleization attribution (ISSUE 11):
+                    # any hash_tree_root the handler computes lands on
+                    # this request's span and endpoint series
+                    with hash_costs.measure(
+                        f"http:{endpoint}", slot=slot, spans=False
+                    ) as hrec:
+                        fn()
+                    if hrec.compressions:
+                        attrs["hash_compressions"] = hrec.compressions
+                        HTTP_HASH_COMPRESSIONS.labels(
+                            endpoint=endpoint
+                        ).inc(hrec.compressions)
                     attrs["status"] = self._status
             finally:
                 HTTP_IN_FLIGHT.dec()

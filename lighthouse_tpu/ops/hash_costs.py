@@ -1,0 +1,561 @@
+"""Merkleization cost observatory (ISSUE 11 tentpole).
+
+PR 10 priced the pairing kernels (exact Fp-muls per set, roofline,
+budget-gated); hashing — the dominant pre-advance cost since the
+columnar epoch transition — had no numbers at all. This module prices
+it with the same census → budget → roofline → ledger pattern:
+
+- the census rides the ONE sha256 seam in consensus/ssz.py (`_hash`,
+  64-byte input = exactly 2 SHA-256 compressions) plus the cache seams
+  around it. A recorder installed at `ssz.CENSUS` (the fp.CENSUS
+  pattern: one global, consulted per call, None costs a global read)
+  attributes every compression during a `hash_tree_root` to
+  (top-level field, cause):
+
+    dirty_chunk      a ChunkedSeq chunk whose cached subtree root was
+                     invalidated re-hashed (packing, element roots,
+                     subtree combine — the cost the dirty-set
+                     machinery exists to bound)
+    subtree          combining cached chunk roots up the spine
+    cache_key        hashing spent building root-cache keys — pinned
+                     at ZERO since the ISSUE 11 satellite replaced the
+                     content-SHA key with token/identity keys; the
+                     column exists to prove it stays there
+    small_container  everything else: small fields, container-root
+                     combines, mix_in_length
+
+- per-field dirty-chunk counts come straight from the ChunkedSeq
+  `_versions` counters (surfaced as versions()/dirty_chunks_since()),
+  and chunk/root cache hit rates land per level;
+- `measure()` wraps the production root computations (_process_slot,
+  the block-import root check, block production, the HTTP read path):
+  totals flush into the linted `state_hash_compressions_total{field,
+  cause}` / `state_dirty_chunks_total{field}` /
+  `state_merkle_cache_{hits,misses}_total{level}` series and emit
+  slot-anchored `htr:<field>` spans on the PR 3 timelines;
+- `state_scenarios()` replays the pinned scenarios (cold root, steady
+  slot, epoch boundary, block import @250k validators) whose exact
+  compression counts gate tier-1 via tests/budgets/hash_costs.json
+  (any increase fails; >2% slack fails stale), and `roofline()` prices
+  each scenario on the v5e 32-bit-ALU model — the computed "what would
+  a lane-major SHA-256 kernel (ROADMAP item 4) buy us" column.
+
+Counts are exact and deterministic: the same state mutations always
+re-hash the same nodes, so the budget gate has no noise floor.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager
+
+from ..common import metrics, tracing
+from ..consensus import ssz
+
+SCHEMA = "lighthouse-tpu/hash-costs/v1"
+
+CAUSES = ("dirty_chunk", "subtree", "cache_key", "small_container")
+DEFAULT_VALIDATORS = 250_000
+
+# ------------------------------------------------------------------ metrics
+#
+# Pinned in tools/metrics_lint.py. Field label cardinality is bounded
+# by container field names (~30 for BeaconState); hashing outside any
+# container field lands under "_".
+
+M_COMPRESSIONS = metrics.counter(
+    "state_hash_compressions_total",
+    "SHA-256 compression-function invocations during measured "
+    "hash_tree_root computations, by top-level field and cause "
+    "(dirty_chunk / subtree / cache_key / small_container)",
+    labelnames=("field", "cause"),
+)
+M_DIRTY_CHUNKS = metrics.counter(
+    "state_dirty_chunks_total",
+    "ChunkedSeq chunks whose cached subtree root was recomputed "
+    "during measured hash_tree_root computations, by top-level field",
+    labelnames=("field",),
+)
+M_CACHE_HITS = metrics.counter(
+    "state_merkle_cache_hits_total",
+    "Merkle cache hits during measured hash_tree_root computations, "
+    "by level (chunk = per-chunk subtree roots, root = the "
+    "content-keyed whole-sequence root cache)",
+    labelnames=("level",),
+)
+M_CACHE_MISSES = metrics.counter(
+    "state_merkle_cache_misses_total",
+    "Merkle cache misses during measured hash_tree_root computations, "
+    "by level (chunk / root)",
+    labelnames=("level",),
+)
+
+
+# ------------------------------------------------------------------ recorder
+
+
+class HashRecorder:
+    """The ssz.CENSUS hook: counts compressions by (field, cause).
+
+    Thread-confined: only the installing thread records (seam calls
+    from other threads are ignored — attribution would garble). A
+    nested measure() on the same thread stacks a child recorder and
+    merges into its parent on exit, so an HTTP request that triggers a
+    block import still sees the request's total."""
+
+    __slots__ = (
+        "counts", "dirty", "hits", "misses", "field_seconds",
+        "_field", "_ft0", "_causes", "_tid", "parent", "wall_s", "_t0",
+    )
+
+    def __init__(self, parent: "HashRecorder" = None):
+        self.counts: dict = {}  # (field, cause) -> compressions
+        self.dirty: dict = {}  # field -> recomputed chunk count
+        self.hits: dict = {}  # level -> n
+        self.misses: dict = {}  # level -> n
+        self.field_seconds: dict = {}  # field -> seconds
+        self._field = None
+        self._ft0 = 0.0
+        self._causes = ["small_container"]
+        self._tid = threading.get_ident()
+        self.parent = parent
+        self.wall_s = 0.0
+        self._t0 = time.perf_counter()
+
+    # ---- seam protocol (consensus/ssz.py consults these per call) ----
+
+    def on_hash(self, n: int) -> None:
+        if threading.get_ident() != self._tid:
+            return
+        key = (self._field or "_", self._causes[-1])
+        self.counts[key] = self.counts.get(key, 0) + n
+
+    def wants_fields(self) -> bool:
+        return self._field is None and threading.get_ident() == self._tid
+
+    def begin_field(self, name: str) -> None:
+        if threading.get_ident() != self._tid:
+            return
+        self._field = name
+        self._ft0 = time.perf_counter()
+
+    def end_field(self) -> None:
+        if threading.get_ident() != self._tid:
+            return
+        f = self._field
+        if f is not None:
+            dt = time.perf_counter() - self._ft0
+            self.field_seconds[f] = self.field_seconds.get(f, 0.0) + dt
+        self._field = None
+
+    def push_cause(self, cause: str) -> None:
+        if threading.get_ident() != self._tid:
+            return
+        self._causes.append(cause)
+
+    def pop_cause(self) -> None:
+        if threading.get_ident() != self._tid:
+            return
+        if len(self._causes) > 1:
+            self._causes.pop()
+
+    def begin_dirty_chunk(self) -> None:
+        if threading.get_ident() != self._tid:
+            return
+        f = self._field or "_"
+        self.dirty[f] = self.dirty.get(f, 0) + 1
+        self.misses["chunk"] = self.misses.get("chunk", 0) + 1
+        self._causes.append("dirty_chunk")
+
+    def end_dirty_chunk(self) -> None:
+        self.pop_cause()
+
+    def cache_event(self, level: str, hit: bool) -> None:
+        if threading.get_ident() != self._tid:
+            return
+        tab = self.hits if hit else self.misses
+        tab[level] = tab.get(level, 0) + 1
+
+    # ------------------------------------------------------------ results
+
+    def finish(self) -> None:
+        self.wall_s = time.perf_counter() - self._t0
+
+    def merge_into(self, other: "HashRecorder") -> None:
+        for k, v in self.counts.items():
+            other.counts[k] = other.counts.get(k, 0) + v
+        for k, v in self.dirty.items():
+            other.dirty[k] = other.dirty.get(k, 0) + v
+        for tab, mine in (
+            (other.hits, self.hits), (other.misses, self.misses)
+        ):
+            for k, v in mine.items():
+                tab[k] = tab.get(k, 0) + v
+        for k, v in self.field_seconds.items():
+            other.field_seconds[k] = other.field_seconds.get(k, 0.0) + v
+
+    @property
+    def compressions(self) -> int:
+        return int(sum(self.counts.values()))
+
+    def by_cause(self) -> dict:
+        out = {c: 0 for c in CAUSES}
+        for (_f, cause), n in self.counts.items():
+            out[cause] = out.get(cause, 0) + n
+        return out
+
+    def by_field(self) -> dict:
+        out: dict = {}
+        for (f, _c), n in self.counts.items():
+            out[f] = out.get(f, 0) + n
+        return dict(sorted(out.items(), key=lambda kv: -kv[1]))
+
+    def report(self) -> dict:
+        """The per-measure census payload (bench detail.hash scenarios)."""
+        return {
+            "compressions": self.compressions,
+            "dirty_chunks": int(sum(self.dirty.values())),
+            "by_cause": self.by_cause(),
+            "by_field": self.by_field(),
+            "dirty_by_field": dict(
+                sorted(self.dirty.items(), key=lambda kv: -kv[1])
+            ),
+            "cache": {
+                "hits": dict(self.hits),
+                "misses": dict(self.misses),
+            },
+            "wall_s": round(self.wall_s, 4),
+        }
+
+
+class _NullRecorder:
+    """Stand-in when another thread holds the census seam: the caller's
+    `with measure(...) as rec` still works, it just measured nothing."""
+
+    counts: dict = {}
+    dirty: dict = {}
+    hits: dict = {}
+    misses: dict = {}
+    field_seconds: dict = {}
+    compressions = 0
+    wall_s = 0.0
+
+    def by_cause(self):
+        return {c: 0 for c in CAUSES}
+
+    def by_field(self):
+        return {}
+
+    def report(self):
+        return {
+            "compressions": 0, "dirty_chunks": 0,
+            "by_cause": self.by_cause(), "by_field": {},
+            "dirty_by_field": {}, "cache": {"hits": {}, "misses": {}},
+            "wall_s": 0.0, "unmeasured": "census seam busy",
+        }
+
+
+def _flush_metrics(rec: HashRecorder) -> None:
+    for (field, cause), n in rec.counts.items():
+        M_COMPRESSIONS.labels(field=field, cause=cause).inc(n)
+    for field, n in rec.dirty.items():
+        M_DIRTY_CHUNKS.labels(field=field).inc(n)
+    for level, n in rec.hits.items():
+        M_CACHE_HITS.labels(level=level).inc(n)
+    for level, n in rec.misses.items():
+        M_CACHE_MISSES.labels(level=level).inc(n)
+
+
+def _emit_spans(rec: HashRecorder, slot, op: str) -> None:
+    """One slot-anchored `htr:<field>` span per field that hashed —
+    the PR 3 timeline rows that show WHERE a slow slot's root went.
+    `op` names the measured root (slot_root / block_import_root /
+    produce_block_root / http:<endpoint>) so timelines distinguish the
+    per-slot root from a read-path one landing on the same slot."""
+    per_field = rec.by_field()
+    for field, dur in rec.field_seconds.items():
+        comp = per_field.get(field, 0)
+        if comp <= 0:
+            continue
+        tracing.record(
+            f"htr:{field}", dur, slot=slot, op=op,
+            compressions=comp, dirty_chunks=rec.dirty.get(field, 0),
+        )
+
+
+# serializes recorder install/uninstall: without it, two threads could
+# both observe CENSUS=None and the later install would clobber the
+# earlier mid-measurement (its remaining hashes silently dropped by
+# the tid guard). The lock is held only around the pointer swap — the
+# per-hash seam itself stays lock-free.
+_INSTALL_LOCK = threading.Lock()
+
+
+@contextmanager
+def measure(op: str, slot=None, spans: bool = True):
+    """Attribute every SHA-256 compression inside the block.
+
+    Nested measures on the same thread stack (child totals merge into
+    the parent); concurrent measures from other threads run
+    unmeasured (Null recorder) rather than garbling attribution.
+    Metrics flush exactly once, at the outermost measure, so nested
+    production measures never double-count the scrape."""
+    tid = threading.get_ident()
+    with _INSTALL_LOCK:
+        cur = ssz.CENSUS
+        if cur is not None and cur._tid != tid:
+            rec = None
+        else:
+            rec = HashRecorder(parent=cur)
+            ssz.CENSUS = rec
+    if rec is None:
+        yield _NullRecorder()
+        return
+    try:
+        yield rec
+    finally:
+        with _INSTALL_LOCK:
+            ssz.CENSUS = cur
+        rec.finish()
+        if rec.parent is not None:
+            rec.merge_into(rec.parent)
+        else:
+            _flush_metrics(rec)
+        if spans and rec.counts:
+            _emit_spans(rec, slot, op)
+
+
+# ------------------------------------------------------------------ roofline
+#
+# "What would ROADMAP item 4 buy us": SHA-256 is pure 32-bit ALU — an
+# ideal lane-major kernel next to ops/lane. Model provenance:
+# - elem_ops_per_compression: 64 rounds x ~40 int32 ops (Sigma/maj/ch
+#   rotations + adds) + 48 message-schedule steps x ~12 ops ≈ 3100;
+#   pinned at 3200 so the estimate stays an upper bound on device time
+#   per compression (same posture as the PR 10 kernel model).
+# - bytes_per_compression: 64 B message block in + 32 B running state
+#   in/out (HBM-side; chunk data streams once per compression).
+# - chip terms (VPU elem-op rate, HBM bandwidth, launch overhead) are
+#   the SAME pinned v5e model as the pairing kernels (ops/costs.V5E),
+#   so the two observatories' rooflines are comparable by construction.
+
+SHA256_LANE_MODEL = {
+    "name": "sha256-lane-major",
+    "elem_ops_per_compression": 3200,
+    "bytes_per_compression": 96.0,
+}
+
+
+def chip_model() -> dict:
+    from . import costs
+
+    return dict(costs.V5E)
+
+
+def roofline(compressions: int, host_wall_s: float = None) -> dict:
+    """v5e estimate for a lane-major batch of `compressions`: device
+    seconds (compute vs memory bound), compressions/s, and — when the
+    measured host time is known — the speedup column item 4 would buy."""
+    chip = chip_model()
+    m = SHA256_LANE_MODEL
+    compute_s = compressions * m["elem_ops_per_compression"] / chip[
+        "vpu_elem_ops_per_s"
+    ]
+    memory_s = compressions * m["bytes_per_compression"] / chip[
+        "hbm_bytes_per_s"
+    ]
+    t = max(compute_s, memory_s)
+    out = {
+        "chip": chip["name"],
+        "model": m["name"],
+        "bound": "compute" if compute_s >= memory_s else "memory",
+        "device_est_s": round(t, 6),
+        "device_est_s_incl_overhead": round(
+            t + chip["launch_overhead_s"], 6
+        ),
+        "est_compressions_per_s": (
+            round(compressions / t, 1) if t > 0 else None
+        ),
+    }
+    if host_wall_s is not None and host_wall_s > 0 and t > 0:
+        out["host_wall_s"] = round(host_wall_s, 4)
+        out["speedup_vs_host"] = round(host_wall_s / (
+            t + chip["launch_overhead_s"]
+        ), 1)
+    return out
+
+
+# ------------------------------------------------------------------ scenarios
+
+
+def _scenario_state(n: int):
+    """The deterministic probe state the budget scenarios replay: the
+    scale-probe builder plus a resolvable sync committee (block import
+    pays sync-aggregate balance updates like a real import does)."""
+    from ..consensus import types as T
+    from ..tools.scale_probe import build_state
+
+    spec, state = build_state(n)
+    committee = [
+        bytes(state.validators[i].pubkey)
+        for i in range(spec.preset.sync_committee_size)
+    ]
+    state.current_sync_committee = T.SyncCommittee.make(
+        pubkeys=committee, aggregate_pubkey=b"\xaa" * 48
+    )
+    state.next_sync_committee = T.SyncCommittee.make(
+        pubkeys=committee, aggregate_pubkey=b"\xaa" * 48
+    )
+    return spec, state
+
+
+def _import_block(spec, state):
+    """One structurally-valid empty block applied through the full
+    state_transition (slots -> block -> root check), verify_signatures
+    off — the hashing shape of a production import."""
+    from ..consensus import state_transition as st
+    from ..consensus import types as T
+
+    slot = int(state.slot) + 1
+    pre = state.copy()
+    st.process_slots(spec, pre, slot)
+    proposer = st.get_beacon_proposer_index(spec, pre)
+    body = T.BeaconBlockBody.default()
+    body.sync_aggregate = T.SyncAggregate.make(
+        sync_committee_bits=[False] * spec.preset.sync_committee_size,
+        sync_committee_signature=b"\xc0" + b"\x00" * 95,
+    )
+    body.eth1_data = pre.eth1_data
+    body.execution_payload = st.mock_execution_payload(spec, pre)
+    block = T.BeaconBlock.make(
+        slot=slot,
+        proposer_index=proposer,
+        parent_root=pre.latest_block_header.hash_tree_root(),
+        state_root=b"\x00" * 32,
+        body=body,
+    )
+    st.process_block(spec, pre, block, verify_signatures=False)
+    block.state_root = pre.hash_tree_root()
+    signed = T.SignedBeaconBlock.make(message=block, signature=b"\x00" * 96)
+    st.state_transition(spec, state, signed, verify_signatures=False)
+
+
+def state_scenarios(n_validators: int = DEFAULT_VALIDATORS) -> dict:
+    """The pinned census scenarios, exact and deterministic:
+
+      cold_root       first full hash_tree_root of the probe state
+      epoch_boundary  process_slots across an epoch boundary INCLUDING
+                      the next slot's root (the one that re-hashes the
+                      epoch's dirty chunks — balance/participation/
+                      registry writebacks)
+      steady_slot     one mid-epoch slot advance with caches warm
+      block_import    a full empty-block state_transition (slot root +
+                      block ops + the final state-root check)
+
+    The whole-sequence root cache is snapshotted and cleared first so
+    counts never depend on what else hashed in this process."""
+    from ..consensus import state_transition as st
+
+    saved_cache = dict(ssz._ROOT_CACHE)
+    ssz._ROOT_CACHE.clear()
+    try:
+        spec, state = _scenario_state(n_validators)
+        out = {}
+        with measure("scenario:cold_root", spans=False) as rec:
+            state.hash_tree_root()
+        out["cold_root"] = rec.report()
+        # tail slot -> +2: the boundary root, process_epoch, and the
+        # first post-epoch root that pays for the epoch's dirty chunks
+        with measure("scenario:epoch_boundary", spans=False) as rec:
+            st.process_slots(spec, state, int(state.slot) + 2)
+        out["epoch_boundary"] = rec.report()
+        with measure("scenario:steady_slot", spans=False) as rec:
+            st.process_slots(spec, state, int(state.slot) + 1)
+        out["steady_slot"] = rec.report()
+        with measure("scenario:block_import", spans=False) as rec:
+            _import_block(spec, state)
+        out["block_import"] = rec.report()
+        return out
+    finally:
+        ssz._ROOT_CACHE.clear()
+        ssz._ROOT_CACHE.update(saved_cache)
+
+
+def hash_costs(n_validators: int = DEFAULT_VALIDATORS) -> dict:
+    """The bench `detail.hash` payload: per-scenario compression census
+    with per-field/cause attribution, the v5e lane-kernel roofline per
+    scenario, and the budget check."""
+    scenarios = state_scenarios(n_validators)
+    for entry in scenarios.values():
+        entry["roofline"] = roofline(
+            entry["compressions"], entry.get("wall_s")
+        )
+    out = {
+        "schema": SCHEMA,
+        "validators": n_validators,
+        "chip_model": chip_model(),
+        "sha256_model": dict(SHA256_LANE_MODEL),
+        "scenarios": scenarios,
+    }
+    try:
+        out["budget_check"] = check_budgets(scenarios) or "ok"
+    except Exception as e:  # budgets file absent/unreadable
+        out["budget_check"] = f"unavailable: {type(e).__name__}: {e}"
+    return out
+
+
+# ------------------------------------------------------------------ budgets
+
+
+def budgets_path() -> str:
+    here = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    return os.path.join(here, "tests", "budgets", "hash_costs.json")
+
+
+def load_budgets(path: str | None = None) -> dict:
+    with open(path or budgets_path()) as f:
+        return json.load(f)
+
+
+def check_budgets(scenarios: dict, budgets: dict | None = None) -> list:
+    """Per-scenario compression counts vs the checked-in budgets.
+    Counts are exact: EXCEEDING a budget is a hashing regression;
+    sitting more than `slack_ratio` BELOW it means a deliberate cut
+    forgot to update the file (tools/hash_report.py --update-budgets)
+    — both return problem strings (empty = ok)."""
+    budgets = budgets or load_budgets()
+    slack = float(budgets.get("slack_ratio", 0.02))
+    problems = []
+    for name, pinned in budgets.get("scenarios", {}).items():
+        got = scenarios.get(name)
+        if got is None:
+            problems.append(f"scenario {name}: missing from census")
+            continue
+        comp = int(got["compressions"])
+        cap = int(pinned["compressions"])
+        if comp > cap:
+            problems.append(
+                f"scenario {name}: {comp} SHA-256 compressions exceed "
+                f"budget {cap} (+{comp - cap}) — hashing regression; a "
+                f"deliberate change must update "
+                f"tests/budgets/hash_costs.json in the same diff"
+            )
+        elif comp < cap * (1.0 - slack):
+            problems.append(
+                f"scenario {name}: {comp} compressions is >{slack:.0%} "
+                f"below budget {cap} — update the budget to keep the "
+                f"hashing trajectory exact "
+                f"(tools/hash_report.py --update-budgets)"
+            )
+        cap_d = pinned.get("dirty_chunks")
+        if cap_d is not None and int(got.get("dirty_chunks", 0)) > int(cap_d):
+            problems.append(
+                f"scenario {name}: dirty chunks "
+                f"{got['dirty_chunks']} exceed budget {cap_d} — the "
+                f"dirty-set machinery is re-hashing more than it should"
+            )
+    return problems

@@ -76,6 +76,10 @@ class LoadReport:
     shed: dict  # {received, dropped, rate}
     deadline: dict  # {processed, misses, rate}
     sse: dict  # {subscribers, events_received, events_sent, slow_client_drops}
+    # ISSUE 11: what the replay's hashing cost — total SHA-256
+    # compressions measured during the run and the read-path share per
+    # endpoint (states/{id}/root hashes the whole head state per hit)
+    hash: dict  # {compressions, read_path: {endpoint: compressions}}
     schema: str = SCHEMA
 
     def to_dict(self) -> dict:
@@ -92,6 +96,7 @@ class LoadReport:
             "events_sent",
             "slow_client_drops",
         ),
+        "hash": ("compressions", "read_path"),
     }
 
     @classmethod
@@ -476,6 +481,8 @@ class _Fleet:
             "sse_drops": _counter_value(
                 "http_sse_slow_clients_dropped_total"
             ),
+            "hash_total": self._hash_compressions_total(),
+            "hash_read": self._read_path_compressions(),
         }
         gossip_submitted = 0
         t_start = time.perf_counter()
@@ -586,6 +593,16 @@ class _Fleet:
                     - before["sse_drops"]
                 ),
             },
+            hash={
+                "compressions": int(
+                    self._hash_compressions_total() - before["hash_total"]
+                ),
+                "read_path": {
+                    ep: int(v - before["hash_read"].get(ep, 0.0))
+                    for ep, v in self._read_path_compressions().items()
+                    if v - before["hash_read"].get(ep, 0.0) > 0
+                },
+            },
         )
 
     @staticmethod
@@ -594,6 +611,22 @@ class _Fleet:
         if fam is None:
             return 0.0
         return sum(fam.labels(*lv).value for lv in fam.label_values())
+
+    @staticmethod
+    def _hash_compressions_total() -> float:
+        """All measured SHA-256 compressions so far (ISSUE 11 census
+        counters) — the replay delta is the run's hashing bill."""
+        fam = metrics.get("state_hash_compressions_total")
+        if fam is None:
+            return 0.0
+        return sum(fam.labels(*lv).value for lv in fam.label_values())
+
+    @staticmethod
+    def _read_path_compressions() -> dict:
+        fam = metrics.get("http_request_hash_compressions_total")
+        if fam is None:
+            return {}
+        return {lv[0]: fam.labels(*lv).value for lv in fam.label_values()}
 
     def close(self) -> None:
         if self.server is not None:

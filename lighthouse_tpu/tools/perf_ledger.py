@@ -21,6 +21,9 @@ except schema/source/recorded_at; compare only what both rows carry:
   replay            {bucket, sets_per_s, checked}   (cpu replay rounds)
   kernel            {bucket: {fp_muls_per_set, elem_ops_per_set,
                     roofline_est_sets_per_s}}
+  hash              {scenario: sha256 compressions} (ISSUE 11 census:
+                    steady_slot / epoch_boundary / block_import /
+                    cold_root @250k validators — exact counts)
   epoch_warm_s      {"250k": s, "500k": s}
   load              {duty_p99_s, shed_rate, deadline_miss_rate}
   scenarios_pass    bool
@@ -134,6 +137,17 @@ def row_from_bench(doc: dict, source: str = "bench.py") -> dict:
             for b, e in buckets.items()
             if isinstance(e, dict) and "fp_muls_per_set" in e
         }
+    hc = detail.get("hash", {})
+    scen = hc.get("scenarios") if isinstance(hc, dict) else None
+    if isinstance(scen, dict):
+        sub = {
+            name: int(e["compressions"])
+            for name, e in scen.items()
+            if isinstance(e, dict)
+            and isinstance(e.get("compressions"), (int, float))
+        }
+        if sub:
+            row["hash"] = sub
     ep = detail.get("epoch", {})
     if isinstance(ep, dict):
         warm = {
@@ -192,6 +206,13 @@ COMPARE_FIELDS = (
     ("kernel.4096.fp_muls_per_set", "fp-muls/set @4096", "count", 0.0),
     ("kernel.1024.fp_muls_per_set", "fp-muls/set @1024", "count", 0.0),
     ("kernel.128.fp_muls_per_set", "fp-muls/set @128", "count", 0.0),
+    # ISSUE 11: SHA-256 compression counts are exact like op counts —
+    # any round-over-round increase is a hashing regression
+    ("hash.steady_slot", "sha256 compressions @steady-slot", "count", 0.0),
+    ("hash.epoch_boundary", "sha256 compressions @epoch-boundary",
+     "count", 0.0),
+    ("hash.block_import", "sha256 compressions @block-import",
+     "count", 0.0),
     ("value_sets_per_s", "driver-verified sets/s", "rate", 0.0),
     ("replay.sets_per_s", "cpu-replay sets/s", "rate", 0.0),
 )

@@ -1,0 +1,346 @@
+"""Merkleization cost observatory gates (ISSUE 11).
+
+Layers under test:
+  1. ops/hash_costs.py — the SHA-256 compression census at the
+     consensus/ssz.py `_hash` seam: per-scenario counts vs the
+     checked-in budgets (tests/budgets/hash_costs.json). An accidental
+     hashing regression FAILS here; a deliberate change updates the
+     budget file in the same diff (tools/hash_report.py
+     --update-budgets). Counts are exact — no noise floor.
+  2. Dirty-set soundness: the ChunkedSeq version counters' reported
+     dirty set must equal the chunks whose subtree roots actually
+     changed, and the census totals must equal an independently
+     counted (pure-arithmetic) model of the re-hashed nodes — the
+     counter is only a gate if it can't drift.
+  3. tools/bench_gate.py — compression-count increases between
+     comparable bench rounds fail exactly like op-count increases
+     (fixture-driven, alongside the ISSUE 10 op-count fixtures).
+
+The 250k-validator scenario census runs once per module (~15 s: one
+cold root + boundary/steady/import replays, all host work).
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from lighthouse_tpu.common import metrics, tracing  # noqa: E402
+from lighthouse_tpu.consensus import ssz  # noqa: E402
+from lighthouse_tpu.ops import hash_costs as hc  # noqa: E402
+from lighthouse_tpu.tools import perf_ledger as L  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def scenarios():
+    return hc.state_scenarios()
+
+
+def test_census_within_budgets(scenarios):
+    problems = hc.check_budgets(scenarios)
+    assert not problems, "\n".join(problems)
+
+
+def test_census_structure(scenarios):
+    # internal consistency: every compression is attributed exactly once
+    for name, e in scenarios.items():
+        assert e["compressions"] == sum(e["by_cause"].values()), name
+        assert e["compressions"] == sum(e["by_field"].values()), name
+        # satellite proof: root-cache keys spend ZERO SHA-256
+        # compressions (the old content-hash key paid half a
+        # merkleization per lookup)
+        assert e["by_cause"]["cache_key"] == 0, name
+    cold = scenarios["cold_root"]
+    assert cold["compressions"] > 1_000_000
+    # the validator registry dominates a cold root
+    assert max(cold["by_field"], key=cold["by_field"].get) == "validators"
+    # epoch boundary: the balance writeback dirties every balances
+    # chunk (250k / 1024 elems per chunk), and the dirty-set machinery
+    # must re-hash exactly those — not the whole field tree
+    boundary = scenarios["epoch_boundary"]
+    assert boundary["dirty_by_field"]["balances"] == 245
+    assert boundary["by_cause"]["dirty_chunk"] > 0
+    # steady slot: chunk caches must make hashing O(dirty chunks) —
+    # a couple of root-vector chunks, >99% chunk-cache hit rate
+    steady = scenarios["steady_slot"]
+    hits = steady["cache"]["hits"].get("chunk", 0)
+    misses = steady["cache"]["misses"].get("chunk", 0)
+    assert misses <= 4
+    assert hits / (hits + misses) > 0.99
+    assert steady["compressions"] < cold["compressions"] / 100
+
+
+def test_budget_regression_detected(scenarios):
+    steady = scenarios["steady_slot"]["compressions"]
+    budgets = {
+        "slack_ratio": 0.02,
+        "scenarios": {"steady_slot": {"compressions": steady - 10}},
+    }
+    problems = hc.check_budgets(scenarios, budgets)
+    assert problems and "exceed budget" in problems[0]
+    # a stale (too-generous) budget flags the other way
+    budgets = {
+        "slack_ratio": 0.02,
+        "scenarios": {"steady_slot": {"compressions": int(steady * 1.5)}},
+    }
+    problems = hc.check_budgets(scenarios, budgets)
+    assert problems and "below budget" in problems[0]
+    # dirty-chunk creep is its own failure
+    budgets = {
+        "slack_ratio": 0.02,
+        "scenarios": {"steady_slot": {
+            "compressions": steady,
+            "dirty_chunks": 0,
+        }},
+    }
+    problems = hc.check_budgets(scenarios, budgets)
+    assert problems and "dirty chunks" in problems[-1]
+
+
+def test_roofline_columns(scenarios):
+    for name, e in scenarios.items():
+        r = hc.roofline(e["compressions"], e["wall_s"])
+        assert r["bound"] in ("compute", "memory")
+        assert r["est_compressions_per_s"] > 0
+        assert r["device_est_s_incl_overhead"] > r["device_est_s"]
+    # the "what would item 4 buy us" column must say what the numbers
+    # say: a cold root is worth shipping to the device, a steady slot's
+    # few-thousand compressions drown in launch overhead. Compare the
+    # two speedups as a RATIO — host wall clock enters both linearly,
+    # so the assertion is invariant to how fast/loaded the box is
+    # (measured: cold ~138x vs steady ~0.2x, ratio ~700)
+    cold = hc.roofline(
+        scenarios["cold_root"]["compressions"],
+        scenarios["cold_root"]["wall_s"],
+    )
+    steady = hc.roofline(
+        scenarios["steady_slot"]["compressions"],
+        scenarios["steady_slot"]["wall_s"],
+    )
+    assert cold["speedup_vs_host"] > 20 * steady["speedup_vs_host"]
+
+
+# ------------------------------------------------- dirty-set soundness
+
+
+def _merkle_hashes(n_leaves: int, depth: int) -> int:
+    """Node count of ssz.merkleize over `n_leaves` chunks padded to
+    2**depth — the pure-arithmetic model the census is checked against
+    (independent of the instrumented code path)."""
+    total = 0
+    layer = n_leaves
+    for _ in range(depth):
+        if layer % 2:
+            layer += 1
+        total += layer // 2
+        layer //= 2
+    return total
+
+
+def test_dirty_set_soundness():
+    """ISSUE 11 satellite: (a) the reported dirty set exactly matches
+    the chunks whose subtree roots changed, and (b) the census /
+    metric deltas equal the independently-counted re-hashed nodes."""
+    import random
+
+    rng = random.Random(1911)
+    LIMIT = 1 << 24
+    C = ssz.Container("S", [("bal", ssz.List(ssz.uint64, LIMIT))])
+    n0 = 50_000
+    value = C.make(bal=list(range(n0)))
+    seq = value.bal
+    assert isinstance(seq, ssz.ChunkedSeq)
+
+    with hc.measure("seed", spans=False):
+        root0 = C.hash_tree_root(value)
+    snap = seq.versions()
+    before_roots = list(seq._roots)
+
+    # random in-place mutations (guaranteed-new values) + appends that
+    # both extend the tail chunk and open fresh chunks
+    touched = set()
+    for _ in range(40):
+        i = rng.randrange(n0)
+        seq[i] = seq[i] + 1
+        touched.add(i // ssz.CHUNK_ELEMS)
+    n_app = 3000
+    for j in range(n_app):
+        seq.append(10_000_000 + j)
+
+    dirty = seq.dirty_chunks_since(snap)
+    # the mutated chunks, the (previously partial) tail chunk, and the
+    # appended chunks — nothing else
+    n_chunks0 = (n0 + ssz.CHUNK_ELEMS - 1) // ssz.CHUNK_ELEMS
+    expected_dirty = touched | {n_chunks0 - 1} | set(
+        range(n_chunks0, (n0 + n_app + ssz.CHUNK_ELEMS - 1)
+              // ssz.CHUNK_ELEMS)
+    )
+    assert set(dirty) == expected_dirty
+
+    fam = metrics.get("state_hash_compressions_total")
+
+    def _val(cause):
+        try:
+            return fam.labels(field="bal", cause=cause).value
+        except Exception:
+            return 0.0
+
+    before = {c: _val(c) for c in hc.CAUSES}
+    with hc.measure("recheck", spans=False) as rec:
+        root1 = C.hash_tree_root(value)
+    assert root1 != root0
+
+    # (a) exactly the reported-dirty chunks re-hashed, and their roots
+    # all actually changed (mutations were guaranteed-new values)
+    changed = [
+        ci for ci in range(len(seq._chunks))
+        if ci >= len(before_roots) or seq._roots[ci] != before_roots[ci]
+    ]
+    assert sorted(dirty) == changed
+    assert rec.dirty == {"bal": len(dirty)}
+    assert rec.misses.get("chunk", 0) == len(dirty)
+
+    # (b) census totals == the independent node-count model
+    n_total = n0 + n_app
+    n_chunks = (n_total + ssz.CHUNK_ELEMS - 1) // ssz.CHUNK_ELEMS
+    k = 8  # uint64: 1024 elems * 8 B / 32 B = 256 leaves per chunk
+    exp_dirty_hashes = 0
+    for ci in sorted(dirty):
+        m = min(ssz.CHUNK_ELEMS, n_total - ci * ssz.CHUNK_ELEMS)
+        exp_dirty_hashes += _merkle_hashes((m + 3) // 4, k)
+    limit_leaves = (LIMIT * 8 + 31) // 32
+    depth = (limit_leaves - 1).bit_length()
+    exp_subtree_hashes = _merkle_hashes(n_chunks, depth - k)
+    by_cause = rec.by_cause()
+    assert by_cause["dirty_chunk"] == 2 * exp_dirty_hashes
+    assert by_cause["subtree"] == 2 * exp_subtree_hashes
+    assert by_cause["small_container"] == 2  # mix_in_length only
+    assert by_cause["cache_key"] == 0
+
+    # and the flushed metric deltas match the same independent count
+    after = {c: _val(c) for c in hc.CAUSES}
+    assert after["dirty_chunk"] - before["dirty_chunk"] == pytest.approx(
+        2 * exp_dirty_hashes
+    )
+    assert after["subtree"] - before["subtree"] == pytest.approx(
+        2 * exp_subtree_hashes
+    )
+
+
+def test_measure_nesting_no_double_count():
+    """Nested measures merge into the parent; the metric flush happens
+    exactly once, at the outermost measure."""
+    C = ssz.Container("N", [("a", ssz.Bytes32), ("b", ssz.Bytes32)])
+    v = C.make(a=b"\x01" * 32, b=b"\x02" * 32)
+    fam = metrics.get("state_hash_compressions_total")
+
+    def total():
+        return sum(fam.labels(*lv).value for lv in fam.label_values())
+
+    before = total()
+    with hc.measure("outer", spans=False) as outer:
+        with hc.measure("inner", spans=False) as inner:
+            C.hash_tree_root(v)
+        inner_comp = inner.compressions
+    assert inner_comp > 0
+    assert outer.compressions == inner_comp
+    assert total() - before == pytest.approx(inner_comp)
+
+
+def test_htr_spans_slot_anchored():
+    """measure() lands htr:<field> spans on the PR 3 timelines with
+    compression counts as attrs."""
+    C = ssz.Container(
+        "SpanState", [("alpha", ssz.List(ssz.uint64, 1 << 20))]
+    )
+    v = C.make(alpha=list(range(5000)))
+    with hc.measure("spans", slot=4242):
+        C.hash_tree_root(v)
+    spans = tracing.spans(slot=4242, kind="htr:alpha")
+    assert spans, "no htr:alpha span on slot 4242"
+    assert spans[-1].attrs["compressions"] > 0
+    assert "dirty_chunks" in spans[-1].attrs
+
+
+def test_concurrent_measure_does_not_garble():
+    """A second thread measuring while the seam is held runs
+    unmeasured (Null recorder) instead of corrupting attribution."""
+    import threading
+
+    C = ssz.Container("T", [("x", ssz.Bytes32), ("y", ssz.Bytes32)])
+    v = C.make(x=b"\x07" * 32, y=b"\x08" * 32)
+    results = {}
+
+    def other():
+        with hc.measure("other", spans=False) as rec:
+            C.hash_tree_root(v)
+        results["other"] = rec
+
+    with hc.measure("holder", spans=False) as rec:
+        t = threading.Thread(target=other)
+        t.start()
+        t.join()
+        C.hash_tree_root(v)
+    assert isinstance(results["other"], hc._NullRecorder)
+    assert rec.compressions > 0
+
+
+# ------------------------------------------------- bench gate fixtures
+
+
+def _bench_doc(steady=9208, boundary=156544, imp=42808):
+    return {
+        "value": 0.0,
+        "detail": {
+            "replay": {"bucket": 128, "sets_per_s": 11.5, "checked": True},
+            "hash": {
+                "schema": hc.SCHEMA,
+                "scenarios": {
+                    "steady_slot": {"compressions": steady},
+                    "epoch_boundary": {"compressions": boundary},
+                    "block_import": {"compressions": imp},
+                },
+            },
+        },
+    }
+
+
+def test_ledger_row_hash_projection():
+    row = L.row_from_bench(_bench_doc(), source="t")
+    assert row["hash"] == {
+        "steady_slot": 9208,
+        "epoch_boundary": 156544,
+        "block_import": 42808,
+    }
+
+
+def test_bench_gate_hash_fixture(tmp_path):
+    """Compression-count increases between comparable rounds fail the
+    bench gate exactly like op-count increases (ISSUE 11 satellite,
+    alongside the ISSUE 10 op-count fixtures)."""
+    sys.path.insert(0, os.path.join(_REPO, "tools"))
+    import bench_gate
+
+    path = str(tmp_path / "PERF.jsonl")
+    L.append(L.row_from_bench(_bench_doc(), source="r1"), path)
+    same = L.row_from_bench(_bench_doc(), source="r2")
+    same["note"] = "distinct round"
+    L.append(same, path)
+    assert bench_gate.gate(path) == []
+    # ANY compression increase on a pinned scenario fails
+    worse = L.row_from_bench(_bench_doc(steady=9209), source="r3")
+    L.append(worse, path)
+    problems = bench_gate.gate(path)
+    assert problems and "sha256 compressions @steady-slot" in problems[0]
+    # a decrease (deliberate cut) passes the gate — the budget file
+    # staleness check is what forces the same-diff budget update
+    better = L.row_from_bench(
+        _bench_doc(steady=9000, boundary=150000), source="r4"
+    )
+    L.append(better, path)
+    assert bench_gate.gate(path) == []

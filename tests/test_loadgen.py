@@ -81,6 +81,18 @@ def test_replay_was_real_traffic(small_report):
     assert small_report["sse"]["events_received"] > 0
 
 
+def test_read_path_hashing_attributed(small_report):
+    """ISSUE 11: the replay's hashing bill lands in the report — total
+    measured compressions plus the per-endpoint read-path split. The
+    seeded mix always includes states/{id}/root polls, which hash the
+    whole head state per hit, so the state_root split is known-nonzero."""
+    h = small_report["hash"]
+    assert h["compressions"] > 0
+    assert h["read_path"].get("state_root", 0) > 0
+    # read-path hashing is part of, not in addition to, the total
+    assert sum(h["read_path"].values()) <= h["compressions"]
+
+
 def test_shed_and_deadline_rates_have_denominators(small_report):
     """The burst overflows the bounded attestation queue and a seeded
     fraction arrives stale: both regression curves get known-nonzero

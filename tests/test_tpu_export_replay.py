@@ -158,3 +158,34 @@ def test_replay_bit_identical_to_jit(bucket, n):
         assert got.dtype == want.dtype and got.shape == want.shape
         assert np.array_equal(got, want)
         assert bool(want) is (forge is None)
+
+
+def test_export_artifacts_not_stale():
+    """Stale-export lint (ISSUE 11 satellite): a kernel-source edit
+    that changes the fingerprint leaves every checked-in .graft_export
+    artifact unloadable — PR 10's fp.py edit shipped exactly that and
+    nobody noticed until CHANGES.md spelled it out for the next tunnel
+    window. Fail tier-1 the round it happens instead: the inventory is
+    mirrored into bls_export_artifact_info (the same gauge bench
+    records every round) and any source=stale_hash series is a
+    failure naming the buckets to re-seed."""
+    from lighthouse_tpu.common import metrics
+    from lighthouse_tpu.crypto.bls.backends import device_metrics as dm
+
+    inventory = export_store.artifact_inventory()
+    dm.record_artifact_inventory(inventory)
+    gauge = metrics.get("bls_export_artifact_info")
+    stale = sorted(
+        lv[0]
+        for lv in gauge.label_values()
+        if lv[1] == "stale_hash" and gauge.labels(*lv).value > 0.0
+    )
+    assert not stale, (
+        f"stale .graft_export artifacts for bucket(s) {stale}: the "
+        f"kernel source fingerprint changed since they were exported, "
+        f"so the AOT/replay paths cannot load them — re-run "
+        f"tools/tunnel_watch.sh on a chip window (or "
+        f"`python tools/export_verify.py --check-stale` locally / "
+        f"`python tools/seed_cache.py --exports-only` to re-seed the "
+        f"CPU replay artifact)"
+    )

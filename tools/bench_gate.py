@@ -7,6 +7,8 @@ Compares, via the shared perf-ledger comparator
   - epoch stage seconds (warm @250k/@500k), >20% + absolute floor
   - load duty p99, >20% + floor
   - per-bucket kernel Fp-mul counts — EXACT: any increase fails
+  - per-scenario SHA-256 compression counts (ISSUE 11 hash census:
+    steady slot / epoch boundary / block import) — EXACT, same rule
   - device / replay rates when both rounds measured one
 
 Dead-tunnel rounds therefore cannot silently decay the trajectory:

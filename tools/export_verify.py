@@ -8,11 +8,21 @@ bench.py's tunnel-proof replay path measures — bench seeds these
 itself each round via the same backends/export_store functions).
 
     python tools/export_verify.py [buckets...]   # default 4096 128
+    python tools/export_verify.py --check-stale  # ISSUE 11 satellite:
+                                                 # exit 1 listing any
+                                                 # artifact whose source
+                                                 # hash no longer matches
+                                                 # the kernel sources
 
 Validation (EXPORT_VALIDATE=1, default) round-trips the artifact and
 verifies a real batch in THIS process — it pays the deserialized
 module's first backend compile (~20 min on the one-core image; cached
 in .jax_cache afterwards).
+
+The same staleness check gates tier-1
+(tests/test_tpu_export_replay.py::test_export_artifacts_not_stale),
+so a fingerprint-changing kernel edit fails the round it lands instead
+of surfacing at the next tunnel window.
 """
 
 import os
@@ -82,7 +92,40 @@ def export_bucket(n_sets: int) -> str:
     return path
 
 
+def check_stale() -> int:
+    """List the export-artifact inventory; exit 1 naming every bucket
+    whose artifact was built from different kernel sources."""
+    from lighthouse_tpu.crypto.bls.backends import device_metrics as dm
+
+    inventory = export_store.artifact_inventory()
+    dm.record_artifact_inventory(inventory)  # same gauge bench records
+    stale = []
+    for item in inventory:
+        state = "ok" if item["source_hash_match"] else "STALE"
+        print(
+            f"bucket {item['bucket']} ({item['backend']}): "
+            f"{state} source={item['source_hash']} "
+            f"age={item['age_s']:.0f}s size={item['size_bytes']}",
+            flush=True,
+        )
+        if not item["source_hash_match"]:
+            stale.append(item["bucket"])
+    if stale:
+        print(
+            f"STALE artifacts for bucket(s) {stale}: kernel sources "
+            f"changed since export — re-run tools/tunnel_watch.sh on a "
+            f"chip window (or this script on CPU) to re-seed",
+            file=sys.stderr,
+            flush=True,
+        )
+        return 1
+    print("export-verify: all artifacts match the current sources")
+    return 0
+
+
 if __name__ == "__main__":
+    if "--check-stale" in sys.argv[1:]:
+        sys.exit(check_stale())
     buckets = [int(a) for a in sys.argv[1:]] or [4096, 1]
     print("backend:", jax.default_backend(), flush=True)
     for b in buckets:

@@ -1,0 +1,141 @@
+#!/usr/bin/env python
+"""Merkleization cost report CLI (ISSUE 11 tentpole): render the
+SHA-256 compression census of the pinned state-hashing scenarios —
+per-field and per-cause attribution, dirty-chunk counts, cache hit
+rates — plus the v5e lane-kernel roofline column ("what would a
+device-resident SHA-256 kernel, ROADMAP item 4, buy us"). All host
+work, no chip required, ~15 s at 250k validators.
+
+  python tools/hash_report.py                   # census + roofline
+  python tools/hash_report.py --validators 50000
+  python tools/hash_report.py --json            # machine-readable
+  python tools/hash_report.py --check           # vs checked-in budgets
+  python tools/hash_report.py --update-budgets  # deliberate hashing
+                                                # change: rewrite the
+                                                # budget file in this diff
+
+The census mechanism (the ssz.CENSUS seam and the cause taxonomy) is
+documented in lighthouse_tpu/ops/hash_costs.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _render(report: dict) -> str:
+    lines = []
+    chip = report["chip_model"]
+    lines.append(
+        f"merkleization cost census — {report['validators']} validators, "
+        f"chip model {chip['name']} ({report['sha256_model']['name']})"
+    )
+    hdr = (f"{'scenario':>15} {'compressions':>13} {'dirty':>6} "
+           f"{'chunk hit%':>10} {'host s':>8} {'v5e est s':>10} "
+           f"{'speedup':>8}")
+    lines.append(hdr)
+    for name, e in report["scenarios"].items():
+        cache = e.get("cache", {})
+        hits = cache.get("hits", {}).get("chunk", 0)
+        misses = cache.get("misses", {}).get("chunk", 0)
+        hit_pct = (
+            f"{100.0 * hits / (hits + misses):.1f}"
+            if hits + misses else "-"
+        )
+        r = e.get("roofline", {})
+        speed = r.get("speedup_vs_host")
+        lines.append(
+            f"{name:>15} {e['compressions']:>13} {e['dirty_chunks']:>6} "
+            f"{hit_pct:>10} {e['wall_s']:>8.3f} "
+            f"{r.get('device_est_s_incl_overhead', 0.0):>10.4f} "
+            f"{(f'{speed}x' if speed is not None else '-'):>8}"
+        )
+        cause = e["by_cause"]
+        lines.append(
+            f"{'':>15}   cause: dirty_chunk {cause['dirty_chunk']} / "
+            f"subtree {cause['subtree']} / cache_key {cause['cache_key']} "
+            f"/ small_container {cause['small_container']}"
+        )
+    # per-field census for the scenarios the ISSUE names
+    for name in ("steady_slot", "epoch_boundary"):
+        e = report["scenarios"].get(name)
+        if not e:
+            continue
+        lines.append(f"per-field compressions — {name}:")
+        dirty = e.get("dirty_by_field", {})
+        for field, n in list(e["by_field"].items())[:12]:
+            lines.append(
+                f"{'':>4}{field:<32} {n:>10}  dirty chunks "
+                f"{dirty.get(field, 0):>5}"
+            )
+    return "\n".join(lines)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--validators", type=int, default=None)
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument("--check", action="store_true")
+    ap.add_argument("--update-budgets", action="store_true")
+    args = ap.parse_args()
+
+    from lighthouse_tpu.ops import hash_costs as hc
+
+    n = args.validators or hc.DEFAULT_VALIDATORS
+    report = hc.hash_costs(n)
+    if args.json:
+        print(json.dumps(report, indent=1, sort_keys=True))
+    else:
+        print(_render(report))
+
+    if args.update_budgets:
+        if n != hc.DEFAULT_VALIDATORS:
+            print(
+                f"refusing to write budgets for a non-default validator "
+                f"count ({n} != {hc.DEFAULT_VALIDATORS})",
+                file=sys.stderr,
+            )
+            return 2
+        budgets = {
+            "comment": "Per-scenario SHA-256 compression budgets for "
+            "state hash_tree_root (ops/hash_costs.py census). An "
+            "accidental increase fails tests/test_hash_costs.py; a "
+            "deliberate hashing change updates this file in the same "
+            "diff (tools/hash_report.py --update-budgets).",
+            "source": "ops/hash_costs.py state_scenarios()",
+            "validators": n,
+            "slack_ratio": 0.02,
+            "scenarios": {
+                name: {
+                    "compressions": e["compressions"],
+                    "dirty_chunks": e["dirty_chunks"],
+                    "by_cause": e["by_cause"],
+                }
+                for name, e in report["scenarios"].items()
+            },
+        }
+        with open(hc.budgets_path(), "w") as f:
+            json.dump(budgets, f, indent=1)
+        print(f"budgets written: {hc.budgets_path()}")
+
+    if args.check:
+        problems = hc.check_budgets(report["scenarios"])
+        for p in problems:
+            print(f"hash-report: {p}", file=sys.stderr)
+        if problems:
+            return 1
+        print("hash-report: census within budgets")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

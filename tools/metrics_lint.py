@@ -56,6 +56,15 @@ REQUIRED_FAMILIES = {
     "http_sse_subscribers": (),
     # registered next to the emit-side fanout (node/caches.py EventBus)
     "http_sse_slow_clients_dropped_total": (),
+    # merkleization cost observatory (ISSUE 11, ops/hash_costs.py):
+    # SHA-256 compressions attributed to (top-level field, cause),
+    # per-field dirty-chunk counts, chunk/root cache hit rates, and the
+    # read-path hashing bill per route
+    "state_hash_compressions_total": ("field", "cause"),
+    "state_dirty_chunks_total": ("field",),
+    "state_merkle_cache_hits_total": ("level",),
+    "state_merkle_cache_misses_total": ("level",),
+    "http_request_hash_compressions_total": ("endpoint",),
     # legacy unlabeled aggregates (kept for continuity)
     "beacon_processor_work_events_received_total": (),
     "beacon_processor_work_events_dropped_total": (),
@@ -156,6 +165,7 @@ def _import_surface(problems: list) -> None:
     # jax-free: the cost-observatory families register even where the
     # jax-heavy tpu module cannot import
     import lighthouse_tpu.crypto.bls.backends.device_metrics  # noqa: F401
+    import lighthouse_tpu.ops.hash_costs  # noqa: F401
 
     try:
         import lighthouse_tpu.crypto.bls.backends.tpu  # noqa: F401
@@ -238,6 +248,26 @@ def _check_bls_dispatch(problems: list) -> None:
         )
 
 
+def _check_hash_census(problems: list) -> None:
+    """Exercise the ssz.CENSUS seam (ISSUE 11): one measured
+    hash_tree_root must produce field+cause-labeled compression
+    series — a dropped seam would silently zero the whole
+    merkleization dashboard."""
+    from lighthouse_tpu.common import metrics
+    from lighthouse_tpu.consensus import types as T
+    from lighthouse_tpu.ops import hash_costs
+
+    with hash_costs.measure("metrics_lint", spans=False):
+        T.Checkpoint.make(epoch=1, root=b"\x01" * 32).hash_tree_root()
+    fam = metrics.get("state_hash_compressions_total")
+    if fam is not None and not fam.label_values():
+        problems.append(
+            "state_hash_compressions_total: measured hash_tree_root "
+            "produced no (field, cause) series — the ssz.CENSUS seam "
+            "is disconnected"
+        )
+
+
 def _check_scrape_parses(problems: list) -> None:
     from lighthouse_tpu.common import metrics
 
@@ -281,6 +311,7 @@ def lint() -> list:
     # BeaconProcessor.__init__, not at module import
     _check_queues(problems)
     _check_bls_dispatch(problems)
+    _check_hash_census(problems)
     _check_families(problems)
     _check_scrape_parses(problems)
     return problems
