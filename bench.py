@@ -509,6 +509,32 @@ def _config_hash_costs(detail):
     detail["hash"] = hash_costs.hash_costs()
 
 
+def _config_lint(detail):
+    """detail.lint (ISSUE 12): per-rule graft-lint finding counts every
+    round, so a contract regression (CoW bypass, frozen-column write,
+    stale kernel fingerprint...) shows in the perf ledger the round it
+    lands, tunnel up or down. Cheap: mtime+hash-cached full-tree run is
+    milliseconds warm, ~2 s cold."""
+    import sys as _sys
+
+    tools_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "tools")
+    if tools_dir not in _sys.path:
+        _sys.path.insert(0, tools_dir)
+    import graft_lint
+
+    findings, stats = graft_lint.run()
+    detail["lint"] = {
+        "total": len(findings),
+        "per_rule": graft_lint.counts_per_rule(findings),
+        "cache": stats,
+        "findings": [
+            {"file": f.file, "line": f.line, "rule": f.rule, "msg": f.msg}
+            for f in findings[:50]
+        ],
+    }
+
+
 def _seed_artifacts(detail):
     """Record the exported-artifact inventory (bucket, age, source-hash
     match) in detail.backend_init EVEN ON SUCCESS and mirror it into
@@ -876,6 +902,8 @@ def main():
         # the merkleization census rides dead-tunnel rounds too
         # (ISSUE 11): exact compression counts + roofline, host-only
         _run_config("hash", 45, _config_hash_costs)
+        # contract-lint counts ride every round (ISSUE 12)
+        _run_config("lint", 30, _config_lint)
         _run_config("replay", 60, _config_replay)
         _emit()
         # a correctness-checked replay measurement IS a result: rc 0
@@ -952,6 +980,9 @@ def main():
 
     # traffic-replay SLO report rides every round (ISSUE 8)
     _run_config("load", 60, _config_load)
+
+    # per-rule contract-lint finding counts ride every round (ISSUE 12)
+    _run_config("lint", 30, _config_lint)
 
     # ------------- in-repo CPU control (sanity only, NOT the baseline)
     if _left() > 30:

@@ -1,0 +1,268 @@
+"""Runtime sanitizer for the CoW spine + frozen-column contracts
+(ISSUE 12 — the dynamic half of tools/graft_lint.py R1/R2).
+
+Enabled with `LH_SANITIZE=1` (consensus/ssz.py auto-installs at import)
+or programmatically via `install()`/`uninstall()` in tests. When
+active, the ssz seams consult `ssz.SANITIZER` the same way the
+merkleization census consults `ssz.CENSUS`:
+
+- **Shared-element freezing (R1).** Every container element fetched by
+  plain indexing/iteration from a chunk that is not privately owned is
+  registered as frozen; a subsequent `SSZValue.__setattr__` on it
+  raises `SanitizeError` AT THE FAULTING LINE instead of silently
+  corrupting the sibling copy. `get_mut`/`seq_get_mut` return a fresh
+  CoW'd element, which is never frozen — the legal path stays legal.
+
+- **Per-chunk checksums (R1, scalar chunks).** `copy()` records a
+  checksum of every (now shared) chunk on both sides. A write that
+  bypasses `__setitem__` (e.g. through a retained chunk-list alias)
+  leaves the checksum stale; the next `_own_chunk`/chunk-root
+  computation on EITHER side raises, naming the sequence and chunk.
+  Container chunks checksum as None (unhashable) — the freeze guard
+  above covers them.
+
+- **Frozen columns (R2).** Arrays returned by `columns`/`seq_column`/
+  `seq_columns`/`assign_array` are already `writeable=False`; numpy
+  itself raises at the faulting line on `+=`/slice-assign/`out=`. The
+  sanitizer's `column_poison_check` is exercised by tests to prove the
+  poisoning holds.
+
+The registry holds strong references (ids must not be recycled while a
+freeze is live) — this is a debugging/CI mode, not a production one;
+tier-1 runs tests/test_ssz.py + tests/test_epoch_columnar.py under it.
+
+Installation goes through `install()` (graft-lint R5: direct
+`ssz.SANITIZER = ...` assignment outside this module is a finding —
+the same locked-install discipline as `ops/hash_costs.measure`).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+_INSTALL_LOCK = threading.Lock()
+
+
+class SanitizeError(AssertionError):
+    """A CoW-spine / frozen-column contract violation caught live."""
+
+
+def _hashable(v):
+    """Recursive hashable view: plain-list elements (e.g. Bitlist
+    values) fold into nested tuples so cross-copy list mutation is
+    caught by the checksum layer (there is no __setitem__ seam on a
+    plain list to raise at the faulting line)."""
+    if isinstance(v, list):
+        return tuple(_hashable(e) for e in v)
+    return v
+
+
+def _chunk_checksum(chunk: list):
+    """Content digest of a chunk of scalars/lists; None when elements
+    are containers (covered by the freeze guard instead). Uses a real
+    hash over the repr, not Python's hash(): int hashing is modular
+    (x mod 2^61-1), so a corruption shifting a value by exactly that
+    delta would collide — the one event this layer exists to catch."""
+    import hashlib
+
+    try:
+        tup = tuple(_hashable(v) for v in chunk)
+        hash(tup)  # probe: containers (unhashable) fall to the guard
+    except TypeError:
+        return None
+    return hashlib.blake2b(repr(tup).encode(), digest_size=8).digest()
+
+
+class Sanitizer:
+    """The ssz.SANITIZER hook implementation. Methods are called from
+    the ChunkedSeq/SSZValue seams only when installed."""
+
+    def __init__(self):
+        # id(obj) -> obj: strong refs pin ids (no recycling); also
+        # serves as the freeze registry
+        self._frozen: dict = {}
+        self._sszvalue = None  # lazily-cached class ref (hot path)
+
+    def _value_cls(self):
+        cls = self._sszvalue
+        if cls is None:
+            from ..consensus.ssz import SSZValue
+
+            cls = self._sszvalue = SSZValue
+        return cls
+
+    # ---------------------------------------------------------- freezing
+
+    def _is_private(self, seq, ci: int, off: int) -> bool:
+        return ci in seq._owned and off in seq._owned_elems.get(ci, ())
+
+    def _freeze_deep(self, obj, SSZValue) -> None:
+        """Freeze a container element AND its nested containers: a
+        cross-copy write through `elem.data.amount = v` must raise just
+        like a top-level `elem.amount = v` (the early-exit also bounds
+        re-walks of already-frozen subtrees)."""
+        if id(obj) in self._frozen:
+            return
+        self._frozen[id(obj)] = obj
+        for v in obj._vals.values():
+            if isinstance(v, SSZValue):
+                self._freeze_deep(v, SSZValue)
+            elif isinstance(v, list):
+                for e in v:
+                    if isinstance(e, SSZValue):
+                        self._freeze_deep(e, SSZValue)
+
+    def on_element_read(self, seq, ci: int, off: int, value) -> None:
+        """A plain `[]`/iteration fetch: freeze mutable containers that
+        are not privately owned by this sequence."""
+        if value.__class__ is int or value.__class__ is bytes:
+            return  # immutable fast path (the overwhelming majority)
+        SSZValue = self._value_cls()
+        if isinstance(value, SSZValue) and not self._is_private(seq, ci, off):
+            self._freeze_deep(value, SSZValue)
+
+    def on_container_write(self, obj, name: str) -> None:
+        """SSZValue.__setattr__ guard — raises at the faulting line."""
+        if id(obj) in self._frozen:
+            raise SanitizeError(
+                f"cross-copy write: setting `.{name}` on a shared "
+                f"{obj._type.name} element fetched by plain indexing/"
+                "iteration — the write would leak into sibling copies. "
+                "Fetch it with seq_get_mut(seq, i) / seq.get_mut(i) "
+                "(graft-lint R1)."
+            )
+
+    # --------------------------------------------------------- checksums
+
+    def on_copy(self, parent, child) -> None:
+        """copy() froze both sides: checksum every shared scalar chunk
+        so a bypassing write is caught at the next own/root
+        computation, and FREEZE every container element now sitting in
+        a shared chunk — a reference obtained via get_mut BEFORE the
+        copy is only legal to mutate until the copy lands; after it,
+        the same object is shared with the sibling and a write through
+        the stale alias must raise like any other cross-copy write.
+        Records are OWNED by this sanitizer instance: a record written
+        before an uninstall() must not produce spurious errors after a
+        later reinstall (legal writes made while the sanitizer was off
+        legitimately diverge from the old checksums)."""
+        SSZValue = self._value_cls()
+        # verify the PARENT's outstanding records before re-baselining:
+        # a second copy() must not launder a bypassing write that
+        # corrupted a still-shared chunk since the first copy
+        prev = self._records(parent)
+        if prev:
+            for ci in list(prev):
+                if ci < len(parent._chunks):
+                    self._verify(parent, ci)
+        sums = {}
+        for ci, chunk in enumerate(parent._chunks):
+            s = _chunk_checksum(chunk)
+            if s is not None:
+                sums[ci] = s
+            else:
+                for v in chunk:
+                    if isinstance(v, SSZValue):
+                        self._freeze_deep(v, SSZValue)
+        parent._san = (self, dict(sums))
+        child._san = (self, dict(sums))
+
+    def _records(self, seq):
+        """This instance's checksum dict for `seq`, or None. A record
+        left by a PREVIOUS sanitizer is stale — drop it instead of
+        comparing against pre-uninstall content."""
+        san = seq._san
+        if not san:
+            return None
+        owner, sums = san
+        if owner is not self:
+            seq._san = None
+            return None
+        return sums
+
+    def _verify(self, seq, ci: int) -> None:
+        sums = self._records(seq)
+        if not sums:
+            return
+        want = sums.get(ci)
+        if want is None:
+            return
+        got = _chunk_checksum(seq._chunks[ci])
+        if got != want:
+            raise SanitizeError(
+                f"cross-copy chunk corruption: chunk {ci} of {seq!r} "
+                "was modified while shared with a sibling copy — some "
+                "write bypassed __setitem__/get_mut (graft-lint R1)."
+            )
+
+    def on_own_chunk(self, seq, ci: int) -> None:
+        """Chunk is about to be privately copied: its shared content
+        must still match the checksum recorded at copy() time."""
+        self._verify(seq, ci)
+        sums = self._records(seq)
+        if sums:
+            # content legitimately diverges from here on — this side's
+            # record retires; the sibling keeps its own
+            sums.pop(ci, None)
+
+    def on_chunk_root(self, seq, ci: int) -> None:
+        """Root computation (cached or fresh) trusts chunk content —
+        verify it first so a corrupted root never lands in a block."""
+        self._verify(seq, ci)
+
+    # ----------------------------------------------------------- columns
+
+    @staticmethod
+    def column_poison_check(arr) -> bool:
+        """True iff the column array is correctly poisoned read-only."""
+        return not arr.flags.writeable
+
+    # ------------------------------------------------------------- stats
+
+    def stats(self) -> dict:
+        return {"frozen_elements": len(self._frozen)}
+
+
+def enabled() -> bool:
+    from ..consensus import ssz
+
+    return ssz.SANITIZER is not None
+
+
+def install() -> "Sanitizer":
+    """Install (idempotent) the sanitizer at the ssz seam. The lock
+    mirrors the hash-census install discipline: the pointer swap is
+    serialized; the per-read seam itself stays lock-free."""
+    from ..consensus import ssz
+
+    with _INSTALL_LOCK:
+        if ssz.SANITIZER is None:
+            ssz.SANITIZER = Sanitizer()
+        return ssz.SANITIZER
+
+
+def uninstall() -> None:
+    from ..consensus import ssz
+
+    with _INSTALL_LOCK:
+        ssz.SANITIZER = None
+
+
+def restore(instance) -> None:
+    """Test support: put a previously-active sanitizer (or None) back,
+    preserving its freeze registry — a session-wide LH_SANITIZE run
+    must get its ORIGINAL guard back after a test cycles install/
+    uninstall, not a fresh one with an empty registry."""
+    from ..consensus import ssz
+
+    with _INSTALL_LOCK:
+        ssz.SANITIZER = instance
+
+
+def install_from_env() -> None:
+    """Called from consensus/ssz.py at import: LH_SANITIZE=1 turns the
+    sanitizer on for the whole process (how tier-1 runs test_ssz.py +
+    test_epoch_columnar.py under the contract checks)."""
+    if os.environ.get("LH_SANITIZE", "") == "1":
+        install()

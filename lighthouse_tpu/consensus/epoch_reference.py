@@ -38,9 +38,13 @@ def process_inactivity_updates(spec: ChainSpec, state) -> None:
         return
     prev = st.get_previous_epoch(spec, state)
     leak = st.is_in_inactivity_leak(spec, state)
-    scores = list(state.inactivity_scores)
+    # per-element writeback through __setitem__ (the whitelisted CoW
+    # form, graft-lint R1): a whole-list rebuild would replace the
+    # ChunkedSeq spine and drop every clean chunk's shared root cache
     for i in _eligible_indices(spec, state):
         v = state.validators[i]
+        orig = state.inactivity_scores[i]
+        score = orig
         participated_target = (
             st.is_active_validator(v, prev)
             and not v.slashed
@@ -50,12 +54,13 @@ def process_inactivity_updates(spec: ChainSpec, state) -> None:
             )
         )
         if participated_target:
-            scores[i] -= min(1, scores[i])
+            score -= min(1, score)
         else:
-            scores[i] += st.INACTIVITY_SCORE_BIAS
+            score += st.INACTIVITY_SCORE_BIAS
         if not leak:
-            scores[i] -= min(st.INACTIVITY_SCORE_RECOVERY_RATE, scores[i])
-    state.inactivity_scores = scores
+            score -= min(st.INACTIVITY_SCORE_RECOVERY_RATE, score)
+        if score != orig:
+            state.inactivity_scores[i] = score
 
 
 def process_rewards_and_penalties(

@@ -44,6 +44,13 @@ _WRAP_THRESHOLD = 2048
 # dirty-chunk counts and chunk/root cache hit rates.
 CENSUS = None
 
+# Runtime sanitizer hook (ISSUE 12): common/sanitize.py installs a
+# Sanitizer here (LH_SANITIZE=1 or tests), and the ChunkedSeq/SSZValue
+# seams below consult it per call — the CENSUS pattern. None (the
+# default) costs one global read on each seam. Install ONLY through
+# common/sanitize.install() (graft-lint R5 flags direct assignment).
+SANITIZER = None
+
 
 def _hash(a: bytes, b: bytes) -> bytes:
     # both operands are 32-byte chunks at every call site: 64 bytes +
@@ -453,6 +460,7 @@ class ChunkedSeq:
         "_token",
         "_versions",
         "_cols",
+        "_san",
     )
 
     def __init__(self, values=(), elem: SSZType = None):
@@ -473,6 +481,9 @@ class ChunkedSeq:
         self._versions = [0] * len(self._chunks)
         # name -> (tuple of np arrays, versions snapshot, length)
         self._cols = {}
+        # sanitizer-mode per-chunk checksums ({ci: hash}, see
+        # common/sanitize.py); None whenever the sanitizer is off
+        self._san = None
 
     # ------------------------------------------------------------ sharing
 
@@ -491,6 +502,9 @@ class ChunkedSeq:
         new._token = self._token
         new._versions = list(self._versions)
         new._cols = dict(self._cols)  # arrays are read-only: share both ways
+        new._san = None
+        if SANITIZER is not None:
+            SANITIZER.on_copy(self, new)
         return new
 
     @property
@@ -522,6 +536,8 @@ class ChunkedSeq:
 
     def _own_chunk(self, ci: int) -> list:
         """Make chunk `ci` privately mutable; invalidate its root."""
+        if SANITIZER is not None and self._san:
+            SANITIZER.on_own_chunk(self, ci)
         if ci not in self._owned:
             self._chunks[ci] = list(self._chunks[ci])
             self._owned.add(ci)
@@ -563,15 +579,25 @@ class ChunkedSeq:
         return self._len
 
     def __iter__(self):
-        for ci in range(len(self._chunks)):
-            yield from self._chunks[ci]
+        san = SANITIZER
+        if san is None:
+            for ci in range(len(self._chunks)):
+                yield from self._chunks[ci]
+            return
+        for ci, chunk in enumerate(self._chunks):
+            for off, v in enumerate(chunk):
+                san.on_element_read(self, ci, off, v)
+                yield v
 
     def __getitem__(self, i):
         if isinstance(i, slice):
             start, stop, step = i.indices(self._len)
             return [self[j] for j in range(start, stop, step)]
         ci, off = self._locate(i)
-        return self._chunks[ci][off]
+        v = self._chunks[ci][off]
+        if SANITIZER is not None:
+            SANITIZER.on_element_read(self, ci, off, v)
+        return v
 
     def __setitem__(self, i, value) -> None:
         ci, off = self._locate(i)
@@ -711,6 +737,8 @@ class ChunkedSeq:
             # roots were computed under a different descriptor: drop them
             self._roots = [None] * len(self._chunks)
             self._root_elem = elem
+        if SANITIZER is not None and self._san:
+            SANITIZER.on_chunk_root(self, ci)
         r = self._roots[ci]
         c = CENSUS
         if r is None:
@@ -1048,7 +1076,11 @@ class SSZValue:
     def __setattr__(self, name, value):
         vals = object.__getattribute__(self, "_vals")
         if name not in vals:
+            # a typo'd field must stay an AttributeError even on a
+            # frozen element — check BEFORE the sanitizer guard
             raise AttributeError(f"no field {name}")
+        if SANITIZER is not None:
+            SANITIZER.on_container_write(self, name)
         if type(value) is list and len(value) > _WRAP_THRESHOLD:
             ftype = object.__getattribute__(self, "_type")._seq_fields.get(name)
             if ftype is not None:
@@ -1130,3 +1162,18 @@ Bytes20 = ByteVector(20)
 Bytes32 = ByteVector(32)
 Bytes48 = ByteVector(48)
 Bytes96 = ByteVector(96)
+
+
+def _auto_install_sanitizer() -> None:
+    # LH_SANITIZE=1 turns the runtime contract checks on process-wide
+    # (tier-1 re-runs test_ssz/test_epoch_columnar under it). Deferred
+    # import: common/sanitize touches this module only inside install().
+    import os as _os
+
+    if _os.environ.get("LH_SANITIZE", "") == "1":
+        from ..common import sanitize as _sanitize
+
+        _sanitize.install_from_env()
+
+
+_auto_install_sanitizer()

@@ -31,6 +31,17 @@ import lighthouse_tpu
 
 lighthouse_tpu.enable_compilation_cache()
 
+# ---------------------------------------------------------- sanitizer tier
+# LH_SANITIZE=1 arms the runtime CoW/frozen-column contract checks
+# (ISSUE 12): consensus/ssz.py auto-installs at import, but tests may
+# import ssz through paths that bypass the env read ordering — install
+# explicitly so the whole session runs guarded. tests/test_sanitize.py
+# re-runs test_ssz.py + test_epoch_columnar.py under this in tier-1.
+if os.environ.get("LH_SANITIZE", "") == "1":
+    from lighthouse_tpu.common import sanitize as _sanitize
+
+    _sanitize.install()
+
 # ---------------------------------------------------------------- tiers
 # The crypto-kernel tests dominate suite runtime (pure-Python EC math +
 # first-run XLA compiles). Mark them so consensus/node iteration can run

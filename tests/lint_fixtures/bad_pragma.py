@@ -1,0 +1,12 @@
+"""graft-lint pragma fixture: one valid suppression, one stale pragma
+(the stale one must fail as R0 — lint-the-linter)."""
+
+
+def suppressed_violation(state, i):
+    # a true R1, deliberately suppressed — must NOT be reported
+    state.validators[i].slashed = True  # graft-lint: ignore[R1]
+
+
+def stale_pragma_line(state, i):
+    # graft-lint: ignore[R2]  EXPECT[R0]
+    return state.balances[i]

@@ -1,0 +1,61 @@
+"""graft-lint R1 fixture: known-bad CoW-spine mutations.
+
+Never imported — linted by tests/test_graft_lint.py, which asserts a
+finding fires on exactly the lines carrying an expect-marker comment.
+"""
+
+
+def direct_bypass(state, i, epoch):
+    # in-place element mutation through plain indexing: the element is
+    # shared with every sibling copy of the state
+    state.validators[i].exit_epoch = epoch  # EXPECT[R1]
+
+
+def direct_bypass_augassign(state, i, d):
+    state.balances[i] += d  # legal: scalar element via __setitem__? No —
+    # ^ NOT flagged: augmented assign on state.balances[i] is a
+    # read + whole-element __setitem__, the legal scalar form.
+    state.validators[i].effective_balance += d  # EXPECT[R1]
+
+
+def alias_bypass(state, i):
+    v = state.validators[i]
+    if v.slashed:
+        v.withdrawable_epoch = 0  # EXPECT[R1]
+
+
+def loop_alias_bypass(state, cur):
+    for i, v in enumerate(state.validators):
+        if v.activation_epoch > cur:
+            v.activation_epoch = cur  # EXPECT[R1]
+
+
+def scalarization_writeback(state, arr):
+    state.balances = [int(x) for x in arr]  # EXPECT[R1]
+
+
+def scalarization_list_gen(state, arr):
+    state.inactivity_scores = list(int(x) for x in arr)  # EXPECT[R1]
+
+
+def list_rebuild_writeback(state):
+    scores = list(state.inactivity_scores)
+    for i in range(len(scores)):
+        scores[i] += 1
+    state.inactivity_scores = scores  # EXPECT[R1]
+
+
+def legal_forms(state, i, v, n, seq_get_mut, seq_assign_array, arr):
+    # every form below is whitelisted structurally — zero findings
+    state.balances[i] = v
+    state.balances[i] = max(0, state.balances[i] + v)
+    state.validators.append(v)
+    seq_get_mut(state.validators, i).slashed = True
+    state.validators.get_mut(i).slashed = True
+    w = seq_get_mut(state.validators, i)
+    w.exit_epoch = 0
+    state.current_epoch_participation = [0] * n
+    state.previous_epoch_participation = state.current_epoch_participation
+    state.historical_summaries = list(state.historical_summaries) + [v]
+    seq_assign_array(state.balances, arr)
+    state.balances = [0 for _ in range(n)]  # fresh fill over range

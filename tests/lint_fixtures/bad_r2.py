@@ -1,0 +1,48 @@
+"""graft-lint R2 fixture: known-bad writes to frozen column arrays."""
+
+import numpy as np
+
+from lighthouse_tpu.consensus.ssz import seq_column, seq_columns
+
+
+def augassign_on_column(state):
+    bal = seq_column(state.balances, np.uint64)
+    bal += 1  # EXPECT[R2]
+    return bal
+
+
+def slice_assign_on_column(state):
+    part = seq_column(state.previous_epoch_participation, np.uint8)
+    part[3:7] = 0  # EXPECT[R2]
+
+
+def out_kwarg_on_column(state, deltas):
+    bal = seq_column(state.balances, np.int64)
+    np.add(bal, deltas, out=bal)  # EXPECT[R2]
+
+
+def mutating_method_on_column(state):
+    bal = seq_column(state.balances, np.uint64)
+    bal.sort()  # EXPECT[R2]
+
+
+def tuple_unpack_taint(state, builder):
+    eff, slashed = seq_columns(state.validators, "k", builder)
+    eff[0] = 1  # EXPECT[R2]
+
+
+def holder_attr_write(state, EpochColumns):
+    cols = EpochColumns(state)
+    cols.balances += 5  # EXPECT[R2]
+    cols.inactivity[2] = 9  # EXPECT[R2]
+
+
+def legal_copies(state):
+    # astype/copy rebinds produce private arrays — zero findings
+    bal = seq_column(state.balances, np.uint64)
+    bal = bal.astype(np.int64)
+    bal += 1
+    part = seq_column(state.previous_epoch_participation, np.uint8)
+    part = part.copy()
+    part[0] = 1
+    return bal, part

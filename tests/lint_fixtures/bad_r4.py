@@ -1,0 +1,44 @@
+# graft-lint: kernel-module
+"""graft-lint R4 fixture: impure jit/scan bodies (the marker above
+opts this module into the kernel-purity rule)."""
+
+import time
+import random
+
+import jax
+import jax.numpy as jnp
+
+_STEPS = 4
+
+
+@jax.jit
+def timed_kernel(x):
+    t0 = time.perf_counter()  # EXPECT[R4]
+    return x + jnp.int32(t0 > 0)
+
+
+def scan_body(carry, x):
+    jitter = random.random()  # EXPECT[R4]
+    print("step", x)  # EXPECT[R4]
+    return carry + x + int(jitter), None
+
+
+def run_scan(xs):
+    acc, _ = jax.lax.scan(scan_body, jnp.int32(0), xs)
+    return acc
+
+
+_CALLS = 0
+
+
+def cond_branch(x):
+    global _CALLS  # EXPECT[R4]
+    return x.astype(jnp.float32)  # EXPECT[R4]
+
+
+def other_branch(x):
+    return x
+
+
+def run_cond(pred, x):
+    return jax.lax.cond(pred, cond_branch, other_branch, x)

@@ -1,0 +1,42 @@
+"""graft-lint R5 fixture: thread-discipline violations (census seam
+installs outside the locked owners; metric-family lock bypasses)."""
+
+from lighthouse_tpu.consensus import ssz
+from lighthouse_tpu.common import metrics
+
+_FAM = metrics.Counter("lint_fixture_total", "fixture", labelnames=("k",))
+
+
+class MyRecorder:
+    def on_hash(self, n):
+        pass
+
+
+def install_census_directly():
+    ssz.CENSUS = MyRecorder()  # EXPECT[R5]
+
+
+def install_sanitizer_directly():
+    ssz.SANITIZER = object()  # EXPECT[R5]
+
+
+def install_census_dotted():
+    import lighthouse_tpu
+
+    lighthouse_tpu.consensus.ssz.CENSUS = MyRecorder()  # EXPECT[R5]
+
+
+def poke_child_value():
+    child = _FAM.labels(k="a")
+    child.value = 7  # EXPECT[R5]
+
+
+def read_family_internals():
+    return _FAM._children  # EXPECT[R5]
+
+
+def record_spans_without_null_guard(slot):
+    from lighthouse_tpu.ops.hash_costs import HashRecorder
+
+    rec = HashRecorder(parent=None)  # EXPECT[R5]
+    return rec

@@ -1,0 +1,355 @@
+"""graft-lint gates (ISSUE 12): the shipped tree is contract-clean,
+every rule demonstrably fires on its known-bad fixture at the expected
+file:line, pragmas suppress exactly once (stale ones fail), the
+mtime+hash cache works, and the full-tree run fits the tier-1 budget.
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_TOOLS = os.path.join(_REPO, "tools")
+if _TOOLS not in sys.path:
+    sys.path.insert(0, _TOOLS)
+
+import graft_lint  # noqa: E402
+
+FIXTURES = os.path.join(_REPO, "tests", "lint_fixtures")
+
+_EXPECT_RE = re.compile(r"EXPECT\[(R[0-9]+)\]")
+
+
+def _expected(path):
+    """(line, rule) pairs from EXPECT[Rn] markers in a fixture."""
+    out = set()
+    with open(path) as f:
+        for i, text in enumerate(f, start=1):
+            for m in _EXPECT_RE.finditer(text):
+                out.add((i, m.group(1)))
+    return out
+
+
+def _found(path):
+    return {
+        (f.line, f.rule)
+        for f in graft_lint.lint_file(os.path.relpath(path, os.getcwd())
+                                      if not os.path.isabs(path) else path)
+    }
+
+
+# ------------------------------------------------------------ shipped tree
+
+
+def test_shipped_tree_is_clean_and_fits_budget():
+    """tools/graft_lint.py --all exits 0 on the shipped tree (the
+    acceptance bar), and the full static run fits well inside the 20 s
+    tier-1 budget (cached by mtime+hash; even a cold run is seconds)."""
+    t0 = time.perf_counter()
+    findings, stats = graft_lint.run()
+    dt = time.perf_counter() - t0
+    assert findings == [], "\n".join(f.render() for f in findings)
+    assert dt <= 20.0, f"full-tree lint took {dt:.1f}s (> 20s budget)"
+    assert stats["cache_hits"] + stats["cache_misses"] > 100
+
+
+def test_metrics_lint_folds_into_all():
+    """--all = static + R3 + metrics_lint under one exit code (the
+    satellite: one CLI, series contract unchanged)."""
+    findings, _ = graft_lint.run(include_metrics=True)
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+# ------------------------------------------------------------- fixtures
+
+
+@pytest.mark.parametrize(
+    "name",
+    ["bad_r1.py", "bad_r2.py", "bad_r4.py", "bad_r5.py", "bad_pragma.py"],
+)
+def test_fixture_fires_exactly_at_marked_lines(name):
+    path = os.path.join(FIXTURES, name)
+    expected = _expected(path)
+    assert expected, f"fixture {name} has no EXPECT markers"
+    assert _found(path) == expected
+
+
+def test_cli_exits_1_on_fixture_and_0_on_clean(tmp_path):
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_TOOLS, "graft_lint.py"),
+         "--no-cache", os.path.join(FIXTURES, "bad_r1.py")],
+        capture_output=True, text=True, cwd=_REPO,
+    )
+    assert proc.returncode == 1
+    assert "R1" in proc.stderr
+    clean = tmp_path / "clean.py"
+    clean.write_text("x = 1\n")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_TOOLS, "graft_lint.py"),
+         "--no-cache", str(clean)],
+        capture_output=True, text=True, cwd=_REPO,
+    )
+    assert proc.returncode == 0, proc.stderr
+
+
+def test_json_output_is_machine_readable():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_TOOLS, "graft_lint.py"),
+         "--no-cache", "--json", os.path.join(FIXTURES, "bad_r2.py")],
+        capture_output=True, text=True, cwd=_REPO,
+    )
+    doc = json.loads(proc.stdout)
+    assert doc["per_rule"].get("R2", 0) >= 4
+    f0 = doc["findings"][0]
+    assert {"file", "line", "rule", "msg", "hint"} <= set(f0)
+
+
+def test_only_filter():
+    path = os.path.join(FIXTURES, "bad_r1.py")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_TOOLS, "graft_lint.py"),
+         "--no-cache", "--json", "--only", "R2", path],
+        capture_output=True, text=True, cwd=_REPO,
+    )
+    doc = json.loads(proc.stdout)
+    assert doc["per_rule"] == {}  # bad_r1 has no R2 findings
+
+
+# --------------------------------------------------------------- pragmas
+
+
+def test_pragma_suppresses_and_stale_pragma_fails():
+    path = os.path.join(FIXTURES, "bad_pragma.py")
+    found = _found(path)
+    rules = {r for _, r in found}
+    assert rules == {"R0"}, found  # the R1 is suppressed; stale R2 fails
+
+
+def test_used_pragma_produces_no_r0(tmp_path):
+    src = (
+        "def f(state, i):\n"
+        "    state.validators[i].slashed = True  # graft-lint: ignore[R1]\n"
+    )
+    p = tmp_path / "ok_pragma.py"
+    p.write_text(src)
+    assert graft_lint.lint_file(str(p)) == []
+
+
+def test_pragma_covers_formatter_wrapped_statement(tmp_path):
+    """A pragma on ANY line of a multi-line statement suppresses the
+    finding (formatters wrap lines; the suppression must survive)."""
+    src = (
+        "def f(state, i):\n"
+        "    state.validators[\n"
+        "        i\n"
+        "    ].slashed = True  # graft-lint: ignore[R1]\n"
+    )
+    p = tmp_path / "wrapped_pragma.py"
+    p.write_text(src)
+    assert graft_lint.lint_file(str(p)) == []
+
+
+def test_pragma_inside_function_does_not_blanket_suppress(tmp_path):
+    """A pragma on an unrelated line of the same function must neither
+    suppress a violation elsewhere in it nor count as used."""
+    src = (
+        "def f(state, i):\n"
+        "    state.validators[i].slashed = True\n"
+        "    x = 1  # graft-lint: ignore[R1]\n"
+    )
+    p = tmp_path / "blanket.py"
+    p.write_text(src)
+    found = {(f.line, f.rule) for f in graft_lint.lint_file(str(p))}
+    assert found == {(2, "R1"), (3, "R0")}
+
+
+def test_same_line_and_chained_forms_are_caught(tmp_path):
+    """Binding+mutation on one line (semicolon, one-line for) and
+    chained `a = b = ...` assignments must not slip through."""
+    src = (
+        "from lighthouse_tpu.consensus.ssz import seq_column\n"
+        "def f(state, i):\n"
+        "    v = state.validators[i]; v.slashed = True\n"
+        "def g(state):\n"
+        "    for v in state.validators: v.slashed = True\n"
+        "def h(state, i, x):\n"
+        "    state.validators[i].slashed = x = True\n"
+        "def k(state, np):\n"
+        "    bal = seq_column(state.balances, np.uint64); bal += 1\n"
+    )
+    p = tmp_path / "sameline.py"
+    p.write_text(src)
+    found = {(f.line, f.rule) for f in graft_lint.lint_file(str(p))}
+    assert found == {(3, "R1"), (5, "R1"), (7, "R1"), (9, "R2")}
+
+
+def test_nested_container_mutation_is_caught(tmp_path):
+    """Mutation through a NESTED container of a shared element is the
+    same contract class — both the direct and alias forms flag."""
+    src = (
+        "def f(state, i):\n"
+        "    state.deposits[i].data.amount = 0\n"
+        "def g(state, i):\n"
+        "    v = state.validators[i]\n"
+        "    v.data.amount = 0\n"
+    )
+    p = tmp_path / "nested.py"
+    p.write_text(src)
+    found = {(f.line, f.rule) for f in graft_lint.lint_file(str(p))}
+    assert found == {(2, "R1"), (5, "R1")}
+
+
+def test_syntax_error_survives_only_filter(tmp_path):
+    """--only must never make an unparseable file read as clean."""
+    p = tmp_path / "synerr.py"
+    p.write_text("def f(:\n")
+    findings, _ = graft_lint.lint_paths([str(p)], use_cache=False)
+    findings = [f for f in findings if f.rule == "E0"]
+    assert findings, "syntax error produced no E0 finding"
+    got, _ = graft_lint.run(paths=[str(p)], rules={"R1"}, use_cache=False)
+    assert any(f.rule == "E0" for f in got)
+
+
+def test_partially_stale_pragma_member_fails(tmp_path):
+    """ignore[R1,R2] where only the R1 fires: the dead R2 member is an
+    R0 finding (suppressions cannot rot silently, even partially)."""
+    src = (
+        "def f(state, i):\n"
+        "    state.validators[i].slashed = True"
+        "  # graft-lint: ignore[R1,R2]\n"
+    )
+    p = tmp_path / "partial.py"
+    p.write_text(src)
+    found = graft_lint.lint_file(str(p))
+    assert [(f.line, f.rule) for f in found] == [(2, "R0")]
+    assert "R2" in found[0].msg and "R1" not in found[0].msg
+
+
+def test_annotated_walrus_and_tuple_aliases_are_caught(tmp_path):
+    """Annotated assignment, walrus, and tuple-unpack aliases of a
+    shared element must taint exactly like plain assignment."""
+    src = (
+        "def f(state, i):\n"
+        "    v: object = state.validators[i]\n"
+        "    v.slashed = True\n"
+        "def g(state, i):\n"
+        "    if (v := state.validators[i]).slashed:\n"
+        "        v.exit_epoch = 0\n"
+        "def h(state, i, j):\n"
+        "    a, c = state.validators[i], state.validators[j]\n"
+        "    a.slashed = True\n"
+        "def k(state, i):\n"
+        "    w: object = seq_get_mut(state.validators, i)\n"
+        "    w.slashed = True\n"
+    )
+    p = tmp_path / "forms.py"
+    p.write_text(src)
+    found = {(f.line, f.rule) for f in graft_lint.lint_file(str(p))}
+    assert found == {(3, "R1"), (6, "R1"), (9, "R1")}
+
+
+def test_r5_child_taint_is_scope_local(tmp_path):
+    """`c = fam.labels(...)` in one function must not taint an
+    unrelated same-named variable in another function."""
+    src = (
+        "def a(fam):\n"
+        "    c = fam.labels(k='x')\n"
+        "    c.value = 1\n"
+        "def b(cfg):\n"
+        "    c = cfg\n"
+        "    c.value = 3\n"
+    )
+    p = tmp_path / "scoped.py"
+    p.write_text(src)
+    found = {(f.line, f.rule) for f in graft_lint.lint_file(str(p))}
+    assert found == {(3, "R5")}
+
+
+def test_chained_labels_value_write_is_caught(tmp_path):
+    src = "def f(fam):\n    fam.labels(k='a').value = 7\n"
+    p = tmp_path / "chained_value.py"
+    p.write_text(src)
+    found = {(f.line, f.rule) for f in graft_lint.lint_file(str(p))}
+    assert found == {(2, "R5")}
+
+
+def test_pragma_in_string_literal_is_not_a_pragma(tmp_path):
+    src = 'DOC = """example: # graft-lint: ignore[R1]"""\n'
+    p = tmp_path / "doc_pragma.py"
+    p.write_text(src)
+    assert graft_lint.lint_file(str(p)) == []
+
+
+def test_only_metrics_actually_runs_metrics():
+    """--only METRICS without --all must still execute the metrics
+    fold (asking for a rule runs it), and the shipped tree is clean."""
+    findings, _ = graft_lint.run(rules={"METRICS"}, include_metrics=False)
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+# ----------------------------------------------------------------- cache
+
+
+def test_cache_hits_and_invalidates_on_edit(tmp_path, monkeypatch):
+    monkeypatch.setattr(
+        graft_lint, "CACHE_PATH", str(tmp_path / "cache.json")
+    )
+    target = tmp_path / "mod.py"
+    target.write_text("def f(state, i):\n    state.validators[i].x = 1\n")
+    f1, s1 = graft_lint.lint_paths([str(target)])
+    assert s1 == {"cache_hits": 0, "cache_misses": 1}
+    assert [x.rule for x in f1] == ["R1"]
+    f2, s2 = graft_lint.lint_paths([str(target)])
+    assert s2 == {"cache_hits": 1, "cache_misses": 0}
+    assert [(x.line, x.rule) for x in f2] == [(x.line, x.rule) for x in f1]
+    # content edit (mtime may or may not move) -> re-analysis
+    target.write_text(
+        "def f(state, i):\n    pass\n"
+    )
+    f3, s3 = graft_lint.lint_paths([str(target)])
+    assert s3["cache_misses"] == 1
+    assert f3 == []
+
+
+# -------------------------------------------------------------------- R3
+
+
+def test_r3_clean_on_shipped_tree():
+    assert graft_lint.r3_check() == []
+
+
+def test_r3_fires_on_fingerprint_drift(monkeypatch):
+    """Any kernel-source edit without a kernel_profiles.json refresh
+    must fail, naming the re-seed command (the PR 11 stale-export lint
+    generalized from artifacts to budgets)."""
+    monkeypatch.setattr(
+        graft_lint, "kernel_fingerprint", lambda: "deadbeefdeadbeef"
+    )
+    findings = graft_lint.r3_check()
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.rule == "R3"
+    assert "deadbeefdeadbeef" in f.msg
+    assert "kernel_report.py --update-budgets" in f.hint
+
+
+def test_static_fingerprint_matches_backend():
+    """The linter's jax-free reimplementation must track the real
+    TB.source_fingerprint() — a drift here would silently disarm R3."""
+    from lighthouse_tpu.crypto.bls.backends import tpu as TB
+
+    assert graft_lint.kernel_fingerprint() == TB.source_fingerprint()
+
+
+# -------------------------------------------------------- bench integration
+
+
+def test_counts_per_rule_shape():
+    findings = graft_lint.lint_file(os.path.join(FIXTURES, "bad_r4.py"))
+    counts = graft_lint.counts_per_rule(findings)
+    assert counts == {"R4": 5}

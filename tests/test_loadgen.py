@@ -377,7 +377,15 @@ def test_http_dispatch_instrumentation_chainless():
         # unknown paths collapse into ONE label, never raw-path children
         assert ("unknown", "GET", "404") in labels
         assert not any("/no/such/route" in lv[0] for lv in labels)
-        assert metrics.get("http_requests_in_flight").value == 0
+        # the gauge is process-global: a connection thread from an
+        # EARLIER test may still be draining its finally — poll to zero
+        # on a fresh deadline (the label poll may have consumed the
+        # previous one) instead of asserting instantaneously
+        gauge = metrics.get("http_requests_in_flight")
+        deadline = time.monotonic() + 2.0
+        while gauge.value != 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert gauge.value == 0
     finally:
         server.stop()
 

@@ -1,0 +1,1283 @@
+#!/usr/bin/env python
+"""graft-lint — contract analyzer for the CoW spine, frozen columns,
+fingerprint-frozen kernels, jit purity and thread discipline (ISSUE 12).
+
+The repo's hot-path rewrites lean on invariants that used to live only
+as prose in CHANGES.md/BASELINE.md. This tool machine-checks them over
+the whole `lighthouse_tpu/` tree:
+
+  R1 cow-mutation    — in-place mutation of a container element of a
+                       ChunkedSeq-backed state field that bypasses
+                       `get_mut`/`seq_get_mut` (the PR 2 CoW spine
+                       contract), and full-list scalarization
+                       writebacks (`state.f = [int(x) for x in a]`,
+                       `scores = list(state.f); ...; state.f = scores`)
+                       that `seq_assign_array` replaced. Whole-element
+                       `state.f[i] = v` assignment is a LEGAL FORM per
+                       the contract and is whitelisted structurally,
+                       not via pragma — see LEGAL FORMS below.
+  R2 frozen-column   — in-place ops (`+=`, slice assignment,
+                       `np.add(..., out=...)`, `.sort()` etc.) on
+                       arrays obtained from `seq_column`/`seq_columns`/
+                       `ChunkedSeq.columns`/`EpochColumns` without an
+                       intervening `.astype`/`.copy` rebind (the PR 6
+                       column contract: returned arrays are frozen
+                       read-only).
+  R3 fingerprint     — the kernel sources covered by
+                       `TB.source_fingerprint()` (ops/lane/*.py +
+                       crypto/bls/backends/tpu.py + crypto/bls/
+                       params.py) were edited without refreshing
+                       tests/budgets/kernel_profiles.json. Names the
+                       re-seed command.
+  R4 jit-purity      — `ops/` kernel bodies reachable from `jax.jit` /
+                       `lax.scan` / `lax.cond` / `lax.while_loop`
+                       callees must not touch time/random/float dtypes/
+                       host I/O/global mutable state, so the
+                       jit-when-bit-identical self-check (ops/epoch.py)
+                       and the census's eager-loop replay (ops/costs.py)
+                       stay trustworthy.
+  R5 thread          — census/sanitizer seam installs (`ssz.CENSUS = x`,
+     discipline        `ssz.SANITIZER = x`, `fp.CENSUS = x`) outside the
+                       locked owner modules (the PR 11 Null-guard
+                       idiom lives in ops/hash_costs.measure), and
+                       labeled-metric-family internal access
+                       (`._children`, `.labels(...).value` writes) that
+                       bypasses the per-family lock idiom.
+  R0 stale-pragma    — a `# graft-lint: ignore[RULE]` pragma that
+                       suppresses nothing (lint-the-linter).
+
+LEGAL FORMS (R1 whitelist — recognized structurally, never flagged):
+  - `state.f[i] = v`            whole-element `__setitem__` (chunk CoW)
+  - `state.f.append(v)`         append (chunk CoW + token bump)
+  - `seq_get_mut(state.f, i).a = v` / `state.f.get_mut(i).a = v`
+  - `state.f = [CONST] * n`, `state.f = []`   fresh constant fills
+  - `state.f = state.g`         hand-over rotate (rebind, no rebuild)
+  - `state.f = list(state.f) + [item]`        bounded append-rebuild
+  - `seq_assign_array(state.f, arr)`          bulk columnar writeback
+
+Pragmas: `# graft-lint: ignore[R1]` (or `ignore[R1,R2]`) on the finding
+line or the line directly above suppresses the finding; a pragma that
+suppresses nothing is itself an R0 finding.
+
+Findings are machine-readable (`--json`): file, line, rule, msg, hint.
+Exit code 1 iff any finding survives.
+
+CLI:
+  python tools/graft_lint.py [paths...]   static rules + R3
+  --all        also fold in tools/metrics_lint.py (rule id METRICS) —
+               the single tier-1 entry point, one exit code
+  --only R1,R2 run only the named rules (R0..R5, METRICS)
+  --changed    lint only files changed vs git HEAD (plus untracked)
+  --json       machine-readable findings
+  --no-cache   ignore and do not write the mtime+hash result cache
+
+The per-file result cache (.graft_lint_cache.json at the repo root,
+keyed by mtime + content sha256 + LINT_VERSION) keeps the full-tree
+tier-1 run well under its 20 s budget.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import hashlib
+import json
+import os
+import re
+import subprocess
+import sys
+from dataclasses import dataclass, asdict
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+# bump to invalidate cached per-file results when rules change
+LINT_VERSION = 2
+
+STATIC_RULES = ("R0", "R1", "R2", "R4", "R5")
+# E0 (parse failure) always reports and is exempt from --only filtering
+ALL_RULES = ("R0", "R1", "R2", "R3", "R4", "R5", "METRICS", "E0")
+
+CACHE_PATH = os.path.join(_REPO, ".graft_lint_cache.json")
+TREE = os.path.join(_REPO, "lighthouse_tpu")
+
+_PRAGMA_RE = re.compile(r"#\s*graft-lint:\s*ignore\[([A-Z0-9_, ]+)\]")
+
+# ------------------------------------------------------------------ findings
+
+
+@dataclass
+class Finding:
+    file: str
+    line: int
+    rule: str
+    msg: str
+    hint: str = ""
+
+    def render(self) -> str:
+        tail = f" ({self.hint})" if self.hint else ""
+        return f"{self.file}:{self.line}: {self.rule} {self.msg}{tail}"
+
+
+# ------------------------------------------------------- big-seq field names
+
+_BIG_SEQ_FIELDS = None
+
+# fallback when the package cannot import (keep in sync with
+# consensus/types.py; the import path below derives it live)
+_BIG_SEQ_FALLBACK = frozenset(
+    {
+        "validators", "balances", "inactivity_scores",
+        "previous_epoch_participation", "current_epoch_participation",
+        "randao_mixes", "block_roots", "state_roots", "slashings",
+        "historical_roots", "historical_summaries",
+        "pending_deposits", "pending_partial_withdrawals",
+        "pending_consolidations", "transactions", "blob_kzg_commitments",
+        "deposits",
+    }
+)
+
+
+def big_seq_fields() -> frozenset:
+    """Container field names that auto-wrap into a ChunkedSeq (List/
+    Vector fields with limit/length above the wrap threshold), derived
+    from the live type registry so the rule tracks the schema."""
+    global _BIG_SEQ_FIELDS
+    if _BIG_SEQ_FIELDS is not None:
+        return _BIG_SEQ_FIELDS
+    try:
+        # NB: deliberately no JAX_PLATFORMS fiddling here — the types
+        # import chain is numpy-only, and run() is called in-process by
+        # bench.py, where mutating the env would silently re-pin jax
+        from lighthouse_tpu.consensus import ssz, types as T
+
+        names = set()
+        for obj in vars(T).values():
+            if isinstance(obj, ssz.Container):
+                for fname, ftype in obj.fields:
+                    if isinstance(ftype, (ssz.List, ssz.Vector)):
+                        lim = getattr(ftype, "limit", None) or getattr(
+                            ftype, "length", 0
+                        )
+                        if lim > ssz._WRAP_THRESHOLD:
+                            names.add(fname)
+        _BIG_SEQ_FIELDS = frozenset(names) or _BIG_SEQ_FALLBACK
+    except Exception:
+        _BIG_SEQ_FIELDS = _BIG_SEQ_FALLBACK
+    return _BIG_SEQ_FIELDS
+
+
+# --------------------------------------------------------------- AST helpers
+
+
+def _attr_chain(node) -> str:
+    """Dotted name for Name/Attribute chains ('state.validators'), or
+    '' when the expression is not a plain chain."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _call_name(call: ast.Call) -> str:
+    """Trailing name of the called function: `seq_columns`, `columns`,
+    `EpochColumns`... (module qualifiers stripped)."""
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return ""
+
+
+def _is_big_field_access(node) -> str:
+    """`X.field` where field is a big-seq field -> 'X.field', else ''."""
+    if isinstance(node, ast.Attribute) and node.attr in big_seq_fields():
+        base = _attr_chain(node.value)
+        if base:
+            return f"{base}.{node.attr}"
+    return ""
+
+
+def _iter_functions(tree: ast.AST):
+    """Every function/async-function body in the module (including
+    nested ones and the module body itself as a pseudo-function)."""
+    yield tree
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def _walk_scope(func):
+    """ast.walk limited to one scope: does not descend into nested
+    function definitions (they are linted as their own scopes), so a
+    module-level pass and a per-function pass never double-report."""
+    stack = list(ast.iter_child_nodes(func)) if not isinstance(
+        func, ast.Lambda
+    ) else [func.body]
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+# ------------------------------------------------ shared binding dataflow
+
+
+class _Bindings:
+    """Source-position-ordered name bindings shared by the R1/R2
+    dataflow: one implementation so an ordering fix can never silently
+    diverge between the two rules."""
+
+    def __init__(self):
+        self._m: dict = {}
+
+    def record(self, name: str, pos, kind: str) -> None:
+        self._m.setdefault(name, []).append((pos, kind))
+
+    def latest(self, name: str, pos):
+        best = None
+        for p, kind in self._m.get(name, ()):
+            if p < pos and (best is None or p >= best[0]):
+                best = (p, kind)
+        return best[1] if best else None
+
+
+def _bind_stmt(node, b: _Bindings, classify, spread_kinds=frozenset()):
+    """Record bindings for Assign / AnnAssign / walrus (NamedExpr)
+    targets — annotated and walrus aliases must resolve exactly like
+    plain assignments. Tuple targets pair element-wise with a tuple
+    value (`a, b = seq[i], seq[j]`); over a single value, kinds in
+    `spread_kinds` spread to every element (R2's
+    `a, b = seq_columns(...)`), anything else binds clean."""
+    if isinstance(node, ast.Assign):
+        tgts, value = node.targets, node.value
+    elif isinstance(node, ast.AnnAssign):
+        if node.value is None:
+            return
+        tgts, value = [node.target], node.value
+    elif isinstance(node, ast.NamedExpr):
+        tgts, value = [node.target], node.value
+    else:
+        return
+    pos = (node.lineno, node.col_offset)
+    for tgt in tgts:
+        if isinstance(tgt, ast.Name):
+            b.record(tgt.id, pos, classify(value))
+        elif isinstance(tgt, ast.Tuple):
+            vals = (
+                value.elts
+                if isinstance(value, ast.Tuple)
+                and len(value.elts) == len(tgt.elts)
+                else None
+            )
+            for k, el in enumerate(tgt.elts):
+                if not isinstance(el, ast.Name):
+                    continue
+                if vals is not None:
+                    b.record(el.id, pos, classify(vals[k]))
+                else:
+                    kind = classify(value)
+                    b.record(
+                        el.id, pos,
+                        kind if kind in spread_kinds else "clean",
+                    )
+
+
+# ----------------------------------------------------------- R1 cow-mutation
+
+_MUT_SOURCES_OK = {"seq_get_mut", "get_mut"}
+
+
+def _r1_scan(func, findings: list, path: str) -> None:
+    """Linear (line-ordered) alias dataflow inside one function body.
+
+    taints: NAME <- X.field[i]  /  for NAME in X.field  /
+            for i, NAME in enumerate(X.field)
+    clears: any rebind of NAME (incl. NAME = seq_get_mut(...))
+    flags : NAME.attr = / += ...   and   X.field[i].attr = / += ...
+            X.field = <listcomp>   and   X.field = NAME where NAME's
+            latest binding is list(X.field)
+    """
+    b = _Bindings()
+    record, latest = b.record, b.latest
+
+    def classify(v) -> str:
+        if isinstance(v, ast.Subscript):
+            fld = _is_big_field_access(v.value)
+            if fld and not isinstance(v.slice, ast.Slice):
+                return "shared"
+        elif isinstance(v, ast.Call) and _call_name(v) == "list" and v.args:
+            fld = _is_big_field_access(v.args[0])
+            if fld:
+                return f"listcopy:{fld}"
+        return "clean"
+
+    # pass 1: collect bindings (plain/annotated/walrus/tuple forms)
+    for node in _walk_scope(func):
+        _bind_stmt(node, b, classify)
+        if isinstance(node, ast.For):
+            it = node.iter
+            src = None
+            is_enum = False
+            if _is_big_field_access(it):
+                src = it
+            elif (
+                isinstance(it, ast.Call)
+                and _call_name(it) == "enumerate"
+                and it.args
+                and _is_big_field_access(it.args[0])
+            ):
+                src = it.args[0]
+                is_enum = True
+            tgt = node.target
+
+            def _names_under(n):
+                return [
+                    x.id for x in ast.walk(n) if isinstance(x, ast.Name)
+                ]
+
+            pos = (node.lineno, node.col_offset)
+            if src is None:
+                for n in _names_under(tgt):
+                    record(n, pos, "clean")
+            elif is_enum and isinstance(tgt, ast.Tuple) and len(tgt.elts) == 2:
+                # enumerate yields (index, element): only names bound
+                # to the ELEMENT side are shared; the index stays clean
+                # even when the element side is a nested tuple
+                for n in _names_under(tgt.elts[0]):
+                    record(n, pos, "clean")
+                for n in _names_under(tgt.elts[1]):
+                    record(n, pos, "shared")
+            else:
+                for n in _names_under(tgt):
+                    record(n, pos, "shared")
+
+    # pass 2: flag mutation sites (every target of chained assigns)
+    for node in _walk_scope(func):
+        if isinstance(node, ast.Assign):
+            tgts = node.targets
+        elif isinstance(node, ast.AugAssign):
+            tgts = [node.target]
+        else:
+            continue
+        line = node.lineno
+        pos = (node.lineno, node.col_offset)
+        for tgt in tgts:
+            _r1_check_target(node, tgt, line, pos, latest, findings, path)
+
+
+def _r1_check_target(node, tgt, line, pos, latest, findings, path) -> None:
+    """Flag one assignment target of an Assign/AugAssign (chained
+    `a = b = ...` forms route every target through here)."""
+    if isinstance(tgt, ast.Attribute):
+        # walk down the attribute chain: X.field[i].attr = ... AND the
+        # nested-container form X.field[i].data.amount = ... both root
+        # at a Subscript of a big-seq field
+        base = tgt.value
+        while isinstance(base, ast.Attribute):
+            base = base.value
+        if isinstance(base, ast.Subscript):
+            fld = _is_big_field_access(base.value)
+            if fld:
+                findings.append(
+                    Finding(
+                        path, line, "R1",
+                        f"in-place mutation of `{fld}[...]` element "
+                        "(possibly through a nested container) bypasses "
+                        "the CoW contract",
+                        f"fetch it with seq_get_mut({fld}, i) / "
+                        f"{fld}.get_mut(i) before mutating",
+                    )
+                )
+                return
+        # NAME.attr... = ... where NAME is a shared element (covers
+        # nested chains like v.data.amount = x too)
+        if isinstance(base, ast.Name):
+            if latest(base.id, pos) == "shared":
+                findings.append(
+                    Finding(
+                        path, line, "R1",
+                        f"`{base.id}` was fetched by plain indexing/"
+                        "iteration of a ChunkedSeq-backed field; in-place "
+                        "mutation leaks into sibling copies",
+                        "rebind via seq_get_mut(...) before mutating",
+                    )
+                )
+                return
+    # scalarization writebacks: X.field = <listcomp> / list(comp) / NAME(listcopy)
+    if isinstance(node, ast.Assign) and isinstance(tgt, ast.Attribute):
+        fld = _is_big_field_access(tgt)
+        if not fld:
+            return
+        v = node.value
+        comp = None
+        if isinstance(v, ast.ListComp):
+            comp = v
+        elif (
+            isinstance(v, ast.Call)
+            and _call_name(v) == "list"
+            and v.args
+            and isinstance(v.args[0], (ast.GeneratorExp, ast.ListComp))
+        ):
+            comp = v.args[0]
+        is_map = (
+            isinstance(v, ast.Call)
+            and _call_name(v) == "list"
+            and v.args
+            and isinstance(v.args[0], ast.Call)
+            and _call_name(v.args[0]) == "map"
+        )
+        # scalarization means iterating an EXISTING sequence back
+        # element-by-element; fresh builds over range(...) (stream
+        # deserialization, constant fills) are a legal form
+        is_scalarization = is_map or (
+            comp is not None
+            and any(
+                not (
+                    isinstance(g.iter, ast.Call)
+                    and _call_name(g.iter) == "range"
+                )
+                for g in comp.generators
+            )
+        )
+        if is_scalarization:
+            findings.append(
+                Finding(
+                    path, line, "R1",
+                    f"scalarization writeback rebuilds `{fld}` "
+                    "element-by-element, dropping the spine's chunk "
+                    "sharing and root caches",
+                    f"use seq_assign_array({fld}, arr)",
+                )
+            )
+            return
+        if isinstance(v, ast.Name) and (
+            latest(v.id, pos) == f"listcopy:{fld}"
+        ):
+            findings.append(
+                Finding(
+                    path, line, "R1",
+                    f"`{v.id}` is a full list copy of `{fld}` "
+                    "assigned back whole — an O(n) spine rebuild",
+                    "write back per element via __setitem__ (legal "
+                    f"form) or seq_assign_array({fld}, arr)",
+                )
+            )
+
+
+# --------------------------------------------------------- R2 frozen-column
+
+_COLUMN_SOURCES = {"seq_column", "seq_columns", "columns"}
+_HOLDER_SOURCES = {"EpochColumns"}
+_MUTATING_METHODS = {"sort", "fill", "put", "partition", "resize", "byteswap"}
+
+
+def _r2_scan(func, findings: list, path: str) -> None:
+    b = _Bindings()
+    latest = b.latest
+
+    def value_kind(v) -> str:
+        if isinstance(v, ast.Call):
+            name = _call_name(v)
+            if name in _COLUMN_SOURCES:
+                return "col"
+            if name in _HOLDER_SOURCES:
+                return "holder"
+        if isinstance(v, ast.Subscript) and isinstance(v.value, ast.Call):
+            # seq_columns(...)[0] -> a frozen column
+            if _call_name(v.value) in _COLUMN_SOURCES:
+                return "col"
+        return "clean"
+
+    for node in _walk_scope(func):
+        _bind_stmt(node, b, value_kind, spread_kinds=frozenset({"col"}))
+
+    def is_frozen_expr(e, pos) -> str:
+        """'' or a description of why `e` is a frozen column."""
+        if isinstance(e, ast.Name):
+            if latest(e.id, pos) == "col":
+                return f"`{e.id}` (a seq_column/seq_columns result)"
+        if isinstance(e, ast.Attribute) and isinstance(e.value, ast.Name):
+            if latest(e.value.id, pos) == "holder":
+                return f"`{e.value.id}.{e.attr}` (an EpochColumns column)"
+        if isinstance(e, ast.Subscript):
+            return is_frozen_expr(e.value, pos)
+        return ""
+
+    for node in _walk_scope(func):
+        if isinstance(node, ast.AugAssign):
+            why = is_frozen_expr(node.target, (node.lineno, node.col_offset))
+            if why:
+                findings.append(
+                    Finding(
+                        path, node.lineno, "R2",
+                        f"in-place `{type(node.op).__name__}` on frozen "
+                        f"column {why}",
+                        "copy first: arr = arr.astype(...)/arr.copy()",
+                    )
+                )
+        elif isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Subscript):
+                    why = is_frozen_expr(tgt.value, (node.lineno, node.col_offset))
+                    if why:
+                        findings.append(
+                            Finding(
+                                path, node.lineno, "R2",
+                                f"slice/element assignment into frozen "
+                                f"column {why}",
+                                "copy first: arr = arr.astype(...)/"
+                                "arr.copy()",
+                            )
+                        )
+        elif isinstance(node, ast.Call):
+            for kw in node.keywords:
+                if kw.arg == "out":
+                    why = is_frozen_expr(kw.value, (node.lineno, node.col_offset))
+                    if why:
+                        findings.append(
+                            Finding(
+                                path, node.lineno, "R2",
+                                f"`out=` targets frozen column {why}",
+                                "allocate the output or copy first",
+                            )
+                        )
+            f = node.func
+            if (
+                isinstance(f, ast.Attribute)
+                and f.attr in _MUTATING_METHODS
+            ):
+                why = is_frozen_expr(f.value, (node.lineno, node.col_offset))
+                if why:
+                    findings.append(
+                        Finding(
+                            path, node.lineno, "R2",
+                            f"mutating method `.{f.attr}()` on frozen "
+                            f"column {why}",
+                            "copy first: arr = arr.astype(...)/arr.copy()",
+                        )
+                    )
+
+
+# ----------------------------------------------------------- R4 jit purity
+
+_R4_DIRS = ("lighthouse_tpu/ops",)
+# observatory layer, not kernel code: costs.py patches lax.scan itself
+_R4_EXCLUDE = {"costs.py", "hash_costs.py"}
+
+_IMPURE_ROOTS = {
+    "time", "random", "os", "sys", "io", "socket", "datetime",
+    "urllib", "subprocess", "threading",
+}
+_IMPURE_CALLS = {"open", "print", "input", "exec", "eval", "__import__"}
+# note: `double`/`half` are NOT here — they collide with EC point
+# doubling/halving function names in the curve kernels
+_FLOAT_DTYPES = {
+    "float16", "float32", "float64", "float_", "bfloat16", "longdouble",
+}
+# numpy/jnp submodule with impure semantics under trace
+_IMPURE_NP_SUBMODULES = {"random"}
+
+
+def _r4_scan_module(tree: ast.Module, findings: list, path: str) -> None:
+    # name -> FunctionDef (module + nested; last definition wins is fine
+    # for lint purposes, but keep all for traversal)
+    defs: dict = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, []).append(node)
+
+    roots: list = []  # (callable node, reason)
+
+    def callee_nodes(expr):
+        """Function nodes a jit/scan argument resolves to."""
+        if isinstance(expr, ast.Lambda):
+            return [expr]
+        if isinstance(expr, ast.Name):
+            return defs.get(expr.id, [])
+        if isinstance(expr, ast.Attribute):
+            return defs.get(expr.attr, [])
+        return []
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                dn = _call_name(dec) if isinstance(dec, ast.Call) else (
+                    _attr_chain(dec) or getattr(dec, "id", "")
+                )
+                if dn in ("jit",) or dn.endswith(".jit") or (
+                    isinstance(dec, ast.Call)
+                    and _call_name(dec) == "partial"
+                    and dec.args
+                    and (_attr_chain(dec.args[0]).endswith("jit"))
+                ):
+                    roots.append((node, f"@{dn or 'jit'}"))
+        elif isinstance(node, ast.Call):
+            cn = _attr_chain(node.func)
+            tail = cn.rsplit(".", 1)[-1] if cn else ""
+            if tail == "jit" and node.args:
+                for fn in callee_nodes(node.args[0]):
+                    roots.append((fn, "jax.jit(...)"))
+            elif tail == "scan" and "lax" in cn and node.args:
+                for fn in callee_nodes(node.args[0]):
+                    roots.append((fn, "lax.scan body"))
+            elif tail in ("while_loop", "fori_loop") and "lax" in cn:
+                for arg in node.args[:3]:
+                    for fn in callee_nodes(arg):
+                        roots.append((fn, f"lax.{tail} body"))
+            elif tail == "cond" and "lax" in cn and len(node.args) >= 3:
+                for arg in node.args[1:3]:
+                    for fn in callee_nodes(arg):
+                        roots.append((fn, "lax.cond branch"))
+            elif tail == "switch" and "lax" in cn and len(node.args) >= 2:
+                arg = node.args[1]
+                branches = arg.elts if isinstance(arg, (ast.List, ast.Tuple)) else [arg]
+                for b in branches:
+                    for fn in callee_nodes(b):
+                        roots.append((fn, "lax.switch branch"))
+
+    seen: set = set()
+
+    def check_body(fn, reason) -> None:
+        if id(fn) in seen:
+            return
+        seen.add(id(fn))
+        for node in ast.walk(fn):
+            line = getattr(node, "lineno", fn.lineno)
+            if isinstance(node, ast.Global):
+                findings.append(
+                    Finding(
+                        path, line, "R4",
+                        f"`global` write inside a traced body ({reason}) "
+                        "— global mutable state breaks replay",
+                        "thread the value through carry/args instead",
+                    )
+                )
+            elif isinstance(node, ast.Call):
+                cn = _attr_chain(node.func)
+                root = cn.split(".", 1)[0] if cn else ""
+                name = cn.rsplit(".", 1)[-1] if cn else ""
+                if root in _IMPURE_ROOTS:
+                    findings.append(
+                        Finding(
+                            path, line, "R4",
+                            f"call to `{cn}` inside a traced body "
+                            f"({reason}) — host state breaks "
+                            "bit-identity and eager replay",
+                            "hoist it out of the kernel",
+                        )
+                    )
+                elif name in _IMPURE_CALLS and isinstance(
+                    node.func, ast.Name
+                ):
+                    findings.append(
+                        Finding(
+                            path, line, "R4",
+                            f"host I/O `{name}()` inside a traced body "
+                            f"({reason})",
+                            "hoist it out of the kernel",
+                        )
+                    )
+                elif (
+                    len(cn.split(".")) >= 2
+                    and cn.split(".")[1] in _IMPURE_NP_SUBMODULES
+                ):
+                    findings.append(
+                        Finding(
+                            path, line, "R4",
+                            f"`{cn}` inside a traced body ({reason}) — "
+                            "nondeterministic under replay",
+                            "pass randomness in as an argument",
+                        )
+                    )
+                # one-level in-module call resolution
+                for sub in callee_nodes(node.func):
+                    check_body(sub, reason)
+            elif isinstance(node, ast.Attribute):
+                if node.attr in _FLOAT_DTYPES:
+                    findings.append(
+                        Finding(
+                            path, line, "R4",
+                            f"float dtype `.{node.attr}` inside a traced "
+                            f"body ({reason}) — kernels are integer-exact "
+                            "by contract",
+                            "keep kernel math in int32/int64",
+                        )
+                    )
+            elif isinstance(node, ast.Name):
+                if node.id in _FLOAT_DTYPES:
+                    findings.append(
+                        Finding(
+                            path, line, "R4",
+                            f"float dtype `{node.id}` inside a traced "
+                            f"body ({reason})",
+                            "keep kernel math in int32/int64",
+                        )
+                    )
+
+    for fn, reason in roots:
+        check_body(fn, reason)
+
+
+# ------------------------------------------------------ R5 thread discipline
+
+_SEAM_ATTRS = {"CENSUS", "SANITIZER"}
+# modules allowed to install seam recorders (they hold the install lock /
+# own the Null-guard idiom)
+_SEAM_OWNERS = {
+    os.path.join("lighthouse_tpu", "ops", "hash_costs.py"),
+    os.path.join("lighthouse_tpu", "ops", "costs.py"),
+    os.path.join("lighthouse_tpu", "common", "sanitize.py"),
+}
+
+
+def _r5_scan(tree: ast.Module, findings: list, path: str) -> None:
+    rel = os.path.relpath(path, _REPO) if os.path.isabs(path) else path
+    is_owner = rel in _SEAM_OWNERS
+    is_metrics = rel == os.path.join("lighthouse_tpu", "common", "metrics.py")
+
+    # child-var taint: v = FAM.labels(...), scoped PER FUNCTION like
+    # the R1/R2 dataflow — a same-named variable in another function
+    # must not be flagged
+    child_vars: dict = {}  # id(scope) -> set of names
+    for scope in _iter_functions(tree):
+        names = set()
+        for node in _walk_scope(scope):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                tgt, v = node.targets[0], node.value
+                if (
+                    isinstance(tgt, ast.Name)
+                    and isinstance(v, ast.Call)
+                    and isinstance(v.func, ast.Attribute)
+                    and v.func.attr == "labels"
+                ):
+                    names.add(tgt.id)
+        child_vars[id(scope)] = names
+
+    # seam installs + family-internal access: module-wide (no variable
+    # tracking involved)
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            tgts = (
+                node.targets if isinstance(node, ast.Assign) else [node.target]
+            )
+            for tgt in tgts:
+                # the direct chained form `FAM.labels(...).value = x`
+                # needs no variable taint — flag it here
+                if (
+                    isinstance(tgt, ast.Attribute)
+                    and tgt.attr == "value"
+                    and isinstance(tgt.value, ast.Call)
+                    and isinstance(tgt.value.func, ast.Attribute)
+                    and tgt.value.func.attr == "labels"
+                    and not is_metrics
+                ):
+                    findings.append(
+                        Finding(
+                            path, node.lineno, "R5",
+                            "direct `.value` write on a `.labels(...)` "
+                            "child bypasses the per-family lock",
+                            "use .inc()/.set()/.observe()",
+                        )
+                    )
+                if (
+                    isinstance(tgt, ast.Attribute)
+                    and tgt.attr in _SEAM_ATTRS
+                    and not is_owner
+                ):
+                    # any attribute target counts: `m.CENSUS`,
+                    # `pkg.mod.ssz.CENSUS`, `self.ssz.SANITIZER` — the
+                    # dotted forms are the same discipline violation
+                    findings.append(
+                        Finding(
+                            path, node.lineno, "R5",
+                            f"direct `{_attr_chain(tgt)}` seam install "
+                            "outside the locked owner modules — a "
+                            "cross-thread install garbles attribution",
+                            "go through ops/hash_costs.measure() / "
+                            "common/sanitize.install() (they hold the "
+                            "install lock and the Null guard)",
+                        )
+                    )
+        elif isinstance(node, ast.Attribute):
+            if node.attr == "_children" and not is_metrics:
+                findings.append(
+                    Finding(
+                        path, node.lineno, "R5",
+                        "access to metric family internals `._children` "
+                        "outside common/metrics.py bypasses the "
+                        "per-family lock",
+                        "use .labels(...)/.label_values()",
+                    )
+                )
+        elif isinstance(node, ast.Call) and not is_owner:
+            # span/census recorders constructed OUTSIDE measure() skip
+            # the PR 11 Null-span guard: a non-origin thread would
+            # garble (or silently lose) the htr: span attribution
+            if _call_name(node) in ("HashRecorder", "_emit_spans"):
+                findings.append(
+                    Finding(
+                        path, node.lineno, "R5",
+                        f"direct `{_call_name(node)}` use outside "
+                        "ops/hash_costs.py — span/census recording "
+                        "without the cross-thread Null guard",
+                        "wrap the region in hash_costs.measure(...) "
+                        "(it installs under the lock and Nulls "
+                        "non-origin threads)",
+                    )
+                )
+
+    # writes to a labels(...) child's .value bypass the lock — checked
+    # within the scope that created the child
+    if is_metrics:
+        return
+    for scope in _iter_functions(tree):
+        names = child_vars.get(id(scope), ())
+        if not names:
+            continue
+        for node in _walk_scope(scope):
+            if not isinstance(node, (ast.Assign, ast.AugAssign)):
+                continue
+            tgts = (
+                node.targets if isinstance(node, ast.Assign) else [node.target]
+            )
+            for tgt in tgts:
+                if (
+                    isinstance(tgt, ast.Attribute)
+                    and tgt.attr == "value"
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id in names
+                ):
+                    findings.append(
+                        Finding(
+                            path, node.lineno, "R5",
+                            f"direct `.value` write on metric child "
+                            f"`{tgt.value.id}` bypasses the per-family "
+                            "lock",
+                            "use .inc()/.set()/.observe()",
+                        )
+                    )
+
+
+# ----------------------------------------------------------- R3 fingerprint
+
+
+def kernel_fingerprint() -> str:
+    """Static reimplementation of TB.source_fingerprint() (crypto/bls/
+    backends/tpu.py) — same file set, same hash, no jax import."""
+    import glob
+
+    lane = os.path.join(TREE, "ops", "lane")
+    srcs = sorted(glob.glob(os.path.join(lane, "*.py"))) + [
+        os.path.join(TREE, "crypto", "bls", "backends", "tpu.py"),
+        os.path.join(TREE, "crypto", "bls", "params.py"),
+    ]
+    h = hashlib.sha256()
+    for p in srcs:
+        with open(p, "rb") as f:
+            h.update(f.read())
+    return h.hexdigest()[:16]
+
+
+def r3_check() -> list:
+    """Fingerprint-frozen kernel sources vs the checked-in profile
+    cache: an edit without a budget refresh desyncs every census-based
+    gate (generalizes PR 11's stale-export lint from artifacts to
+    budgets)."""
+    prof_path = os.path.join(_REPO, "tests", "budgets", "kernel_profiles.json")
+    try:
+        with open(prof_path) as f:
+            doc = json.load(f)
+        stored = doc.get("source_fingerprint")
+    except Exception as e:  # missing, truncated, or non-dict JSON: all
+        # must surface as a FINDING, never a linter crash
+        return [
+            Finding(
+                os.path.relpath(prof_path, _REPO), 1, "R3",
+                f"kernel profile cache missing/unreadable "
+                f"({type(e).__name__}: {e})",
+                "re-seed: python tools/kernel_report.py --update-budgets",
+            )
+        ]
+    try:
+        cur = kernel_fingerprint()
+    except Exception as e:  # renamed/missing kernel source: a finding,
+        # never a linter crash
+        return [
+            Finding(
+                os.path.join("lighthouse_tpu", "crypto", "bls", "backends",
+                             "tpu.py"),
+                1, "R3",
+                f"fingerprint-covered kernel sources unreadable "
+                f"({type(e).__name__}: {e})",
+                "the TB.source_fingerprint() file set moved — update "
+                "kernel_fingerprint() in tools/graft_lint.py to match",
+            )
+        ]
+    if stored != cur:
+        return [
+            Finding(
+                os.path.join("lighthouse_tpu", "crypto", "bls", "backends",
+                             "tpu.py"),
+                1, "R3",
+                f"fingerprint-covered kernel sources changed "
+                f"(now {cur}, profiles pinned to {stored}) without a "
+                "kernel_profiles.json refresh — census budgets and "
+                ".graft_export artifacts are stale",
+                "re-seed: python tools/kernel_report.py --update-budgets; "
+                "on the next tunnel window re-seed chip caches "
+                "(tools/tunnel_watch.sh)",
+            )
+        ]
+    return []
+
+
+# ------------------------------------------------------------ per-file lint
+
+
+def _stmt_spans(tree: ast.AST) -> list:
+    """(start, end) line spans of multi-line SIMPLE statements: a
+    pragma anywhere on a formatter-wrapped statement must still cover
+    a finding anchored to an inner line of it. Compound statements
+    (def/class/if/for/try...) are excluded — a pragma inside a
+    function must never suppress findings elsewhere in that function."""
+    spans = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.stmt) and not hasattr(node, "body"):
+            end = getattr(node, "end_lineno", None) or node.lineno
+            if end > node.lineno:
+                spans.append((node.lineno, end))
+    return spans
+
+
+def _apply_pragmas(src: str, findings: list, path: str, spans=()) -> list:
+    """Suppress findings covered by `# graft-lint: ignore[RULE]` on the
+    finding line, the line above, or any line of the enclosing
+    multi-line statement; stale pragmas become R0 findings."""
+    pragmas = {}  # line -> set(rules)
+    # harvest from COMMENT tokens, not raw lines: pragma syntax quoted
+    # inside a string/docstring (e.g. documentation) is not a pragma
+    try:
+        import io
+        import tokenize
+
+        for tok in tokenize.generate_tokens(io.StringIO(src).readline):
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _PRAGMA_RE.search(tok.string)
+            if m:
+                pragmas[tok.start[0]] = {
+                    r.strip() for r in m.group(1).split(",") if r.strip()
+                }
+    except (tokenize.TokenizeError, SyntaxError, IndentationError):
+        for i, text in enumerate(src.splitlines(), start=1):
+            m = _PRAGMA_RE.search(text)
+            if m:
+                pragmas[i] = {
+                    r.strip() for r in m.group(1).split(",") if r.strip()
+                }
+    if not pragmas:
+        return findings
+    used: set = set()  # (line, rule) pairs that suppressed something
+    out = []
+    for f in findings:
+        cover = {f.line, f.line - 1}
+        for s, e in spans:
+            if s <= f.line <= e:
+                cover.update(range(s - 1, e + 1))
+        covered = False
+        for ln in sorted(cover):
+            if f.rule in pragmas.get(ln, ()):  # exact rule id match
+                used.add((ln, f.rule))
+                covered = True
+                break
+        if not covered:
+            out.append(f)
+    # staleness is PER RULE: ignore[R1,R2] where only the R1 ever
+    # fires reports the R2 member as stale (suppressions cannot rot
+    # silently, even partially)
+    for ln, rules in pragmas.items():
+        stale = sorted(r for r in rules if (ln, r) not in used)
+        if stale:
+            out.append(
+                Finding(
+                    path, ln, "R0",
+                    f"stale pragma member ignore[{','.join(stale)}] "
+                    "suppresses nothing",
+                    "delete it (lint-the-linter)",
+                )
+            )
+    return out
+
+
+def lint_file(path: str, src: str = None) -> list:
+    """Static findings (R1/R2/R4/R5, pragma-applied, R0 for stale
+    pragmas) for one file. `path` is reported as given."""
+    if src is None:
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        # E0 is exempt from --only filtering: a file the linter could
+        # not parse must never read as contract-clean
+        return [Finding(path, e.lineno or 1, "E0", f"syntax error: {e.msg}")]
+    findings: list = []
+    for func in _iter_functions(tree):
+        _r1_scan(func, findings, path)
+        _r2_scan(func, findings, path)
+    rel = os.path.relpath(path, _REPO) if os.path.isabs(path) else path
+    rel_posix = rel.replace(os.sep, "/")
+    # R4 covers the kernel tree; any module can opt in with a
+    # `# graft-lint: kernel-module` marker near the top (fixtures and
+    # future kernel code outside ops/ use this)
+    is_kernel = any(rel_posix.startswith(d) for d in _R4_DIRS) and (
+        os.path.basename(path) not in _R4_EXCLUDE
+    )
+    if not is_kernel and "# graft-lint: kernel-module" in "\n".join(
+        src.splitlines()[:10]
+    ):
+        is_kernel = True
+    if is_kernel:
+        _r4_scan_module(tree, findings, path)
+    _r5_scan(tree, findings, path)
+    findings = _apply_pragmas(src, findings, path, _stmt_spans(tree))
+    uniq = {}
+    for f in findings:
+        uniq.setdefault((f.line, f.rule, f.msg), f)
+    findings = sorted(uniq.values(), key=lambda f: (f.line, f.rule))
+    return findings
+
+
+# ------------------------------------------------------------------- caching
+
+
+def _cache_key() -> str:
+    """Cache version key: LINT_VERSION plus a digest of every rule
+    input that lives OUTSIDE the linted file — today the big-seq field
+    schema (a types.py edit must invalidate every cached result, not
+    just its own file's)."""
+    schema = ",".join(sorted(big_seq_fields()))
+    return f"{LINT_VERSION}:{hashlib.sha256(schema.encode()).hexdigest()[:12]}"
+
+
+def _load_cache(enabled: bool) -> dict:
+    if not enabled:
+        return {}
+    try:
+        with open(CACHE_PATH) as f:
+            doc = json.load(f)
+        if doc.get("version") != _cache_key():
+            return {}
+        return doc.get("files", {})
+    except Exception:
+        return {}
+
+
+def _save_cache(files: dict, enabled: bool) -> None:
+    if not enabled:
+        return
+    try:
+        tmp = CACHE_PATH + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"version": _cache_key(), "files": files}, f)
+        os.replace(tmp, CACHE_PATH)
+    except OSError:
+        pass
+
+
+def lint_paths(paths: list, use_cache: bool = True) -> tuple:
+    """(findings, stats) over the given files; per-file results cached
+    by (mtime, sha256, LINT_VERSION)."""
+    cache = _load_cache(use_cache)
+    out: list = []
+    new_cache: dict = {}
+    hits = misses = 0
+    for path in sorted(paths):
+        rel = os.path.relpath(path, _REPO)
+        try:
+            st = os.stat(path)
+            with open(path, "rb") as f:
+                raw = f.read()
+        except OSError:
+            continue
+        digest = hashlib.sha256(raw).hexdigest()
+        ent = cache.get(rel)
+        if ent and ent["mtime"] == st.st_mtime and ent["sha256"] == digest:
+            hits += 1
+            found = [Finding(**d) for d in ent["findings"]]
+        else:
+            misses += 1
+            found = lint_file(rel, raw.decode("utf-8"))
+        new_cache[rel] = {
+            "mtime": st.st_mtime,
+            "sha256": digest,
+            "findings": [asdict(f) for f in found],
+        }
+        out.extend(found)
+    # keep entries for files we did not visit this run (partial lints
+    # must not evict the full-tree cache), but prune vanished files so
+    # test tmp paths don't accrete
+    for rel, ent in cache.items():
+        if rel not in new_cache and os.path.exists(
+            os.path.join(_REPO, rel)
+        ):
+            new_cache[rel] = ent
+    _save_cache(new_cache, use_cache)
+    return out, {"cache_hits": hits, "cache_misses": misses}
+
+
+def tree_files() -> list:
+    out = []
+    for root, dirs, files in os.walk(TREE):
+        dirs[:] = [d for d in dirs if d != "__pycache__"]
+        for name in files:
+            if name.endswith(".py"):
+                out.append(os.path.join(root, name))
+    return out
+
+
+def _changed_files() -> list:
+    try:
+        diff = subprocess.run(
+            ["git", "diff", "--name-only", "HEAD"],
+            cwd=_REPO, capture_output=True, text=True, check=True,
+        ).stdout.splitlines()
+        untracked = subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard"],
+            cwd=_REPO, capture_output=True, text=True, check=True,
+        ).stdout.splitlines()
+    except Exception:
+        return tree_files()
+    names = set(diff) | set(untracked)
+    return [
+        os.path.join(_REPO, n)
+        for n in names
+        if n.endswith(".py") and n.startswith("lighthouse_tpu/")
+        and os.path.exists(os.path.join(_REPO, n))
+    ]
+
+
+# ----------------------------------------------------------------- metrics
+
+
+def metrics_findings() -> list:
+    """Fold tools/metrics_lint.py in (satellite: one CLI, one exit
+    code) — the series contract is unchanged, its problems surface here
+    under rule id METRICS."""
+    tools_dir = os.path.dirname(os.path.abspath(__file__))
+    if tools_dir not in sys.path:
+        sys.path.insert(0, tools_dir)
+    import metrics_lint
+
+    problems = metrics_lint.lint()
+    return [
+        Finding("tools/metrics_lint.py", 1, "METRICS", p)
+        for p in problems
+    ]
+
+
+# --------------------------------------------------------------------- runs
+
+
+def run(
+    paths: list = None,
+    rules: set = None,
+    include_metrics: bool = False,
+    use_cache: bool = True,
+) -> tuple:
+    """Programmatic entry: (findings, stats). `rules` filters by rule
+    id after collection (R0 pragma checking always runs with the static
+    pass it belongs to)."""
+    if paths is None:
+        paths = tree_files()
+    if rules is not None and rules.isdisjoint(STATIC_RULES):
+        # e.g. --only R3 / --only METRICS: skip the whole static pass
+        # (nothing it produces would survive the filter; E0 applies
+        # only to files actually linted)
+        findings, stats = [], {"cache_hits": 0, "cache_misses": 0}
+    else:
+        findings, stats = lint_paths(paths, use_cache=use_cache)
+    if rules is None or "R3" in rules:
+        findings.extend(r3_check())
+    # metrics fold runs under --all, OR when the user explicitly asked
+    # for the METRICS rule via --only (asking for a rule must run it)
+    if (rules is None and include_metrics) or (
+        rules is not None and "METRICS" in rules
+    ):
+        findings.extend(metrics_findings())
+    if rules is not None:
+        findings = [f for f in findings if f.rule in rules or f.rule == "E0"]
+    return findings, stats
+
+
+def counts_per_rule(findings: list) -> dict:
+    out: dict = {}
+    for f in findings:
+        out[f.rule] = out.get(f.rule, 0) + 1
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*", help="files to lint (default: tree)")
+    ap.add_argument("--all", action="store_true",
+                    help="fold in tools/metrics_lint.py (rule METRICS)")
+    ap.add_argument("--only", default=None,
+                    help="comma-separated rule ids (R0..R5, METRICS)")
+    ap.add_argument("--changed", action="store_true",
+                    help="lint only files changed vs git HEAD")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    ap.add_argument("--no-cache", action="store_true")
+    args = ap.parse_args(argv)
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    rules = None
+    if args.only:
+        rules = {r.strip().upper() for r in args.only.split(",") if r.strip()}
+        bad = rules - set(ALL_RULES)
+        if bad:
+            print(f"graft-lint: unknown rules {sorted(bad)}", file=sys.stderr)
+            return 2
+    if args.paths:
+        paths = [os.path.abspath(p) for p in args.paths]
+    elif args.changed:
+        paths = _changed_files()
+    else:
+        paths = None
+    findings, stats = run(
+        paths=paths,
+        rules=rules,
+        include_metrics=args.all,
+        use_cache=not args.no_cache,
+    )
+    if args.as_json:
+        print(json.dumps(
+            {
+                "findings": [asdict(f) for f in findings],
+                "per_rule": counts_per_rule(findings),
+                "stats": stats,
+            },
+            indent=1,
+        ))
+    else:
+        for f in findings:
+            print(f.render(), file=sys.stderr)
+        if not findings:
+            print(
+                f"graft-lint: ok ({stats['cache_hits']} cached, "
+                f"{stats['cache_misses']} analyzed)"
+            )
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
