@@ -718,6 +718,12 @@ def _config_load(detail):
         loadgen.LoadgenConfig(
             vcs=int(os.environ.get("BENCH_LOAD_VCS", "50")),
             slots=int(os.environ.get("BENCH_LOAD_SLOTS", "8")),
+            # ISSUE 13: the seeded 4x-overload fault-fleet phase ships
+            # in detail.load.overload every round (0 disables) — the
+            # graceful-degradation trajectory the ledger gates
+            overload_slots=int(
+                os.environ.get("BENCH_LOAD_OVERLOAD_SLOTS", "4")
+            ),
             seed=7,
         )
     )

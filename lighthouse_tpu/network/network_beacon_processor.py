@@ -19,6 +19,7 @@ from ..consensus import types as T
 from ..node.beacon_chain import AttestationError, AvailabilityPending, BlockError
 from ..node.beacon_processor import Work, WorkType
 from .gossip import (
+    TOPIC_AGGREGATE,
     TOPIC_ATTESTATION_SUBNET,
     TOPIC_BLOB_SIDECAR,
     TOPIC_BLOCK,
@@ -71,6 +72,8 @@ class NetworkBeaconProcessor:
             self._on_gossip_block(peer_id, data)
         elif "/beacon_attestation_" in topic:
             self._on_gossip_attestation(peer_id, data)
+        elif f"/{TOPIC_AGGREGATE}/" in topic:
+            self._on_gossip_aggregate(peer_id, data)
         elif "/blob_sidecar_" in topic:
             self._on_gossip_blob(peer_id, data)
 
@@ -172,6 +175,46 @@ class NetworkBeaconProcessor:
             )
         )
 
+    def _on_gossip_aggregate(self, peer_id: str, data: bytes) -> None:
+        """Aggregate-and-proof gossip → the AGGREGATE priority lane
+        (class 1): one shed aggregate loses ~hundreds of attestations,
+        so the scheduler serves these before any unaggregated work."""
+        GOSSIP_RX.labels(kind="aggregate").inc()
+        try:
+            signed = T.SignedAggregateAndProof.deserialize(data)
+        except Exception:
+            GOSSIP_DECODE_FAIL.labels(kind="aggregate").inc()
+            self.service.report_peer(peer_id, PeerAction.LOW_TOLERANCE)
+            return
+
+        def individual(payload) -> None:
+            try:
+                self.chain.verify_aggregate_for_gossip(payload)
+            except AttestationError:
+                # duplicate aggregators / overlapping bits are the
+                # common benign case on a fanout mesh — no penalty
+                return
+            self.verified_attestations += 1
+
+        def batch(payloads: list) -> bool:
+            for p in payloads:
+                individual(p)
+            return True
+
+        self.processor.submit(
+            Work(
+                kind=WorkType.GOSSIP_AGGREGATE,
+                process_individual=individual,
+                process_batch=batch,
+                payload=signed,
+                slot=int(signed.message.aggregate.data.slot),
+                # aggregates stay profitable through the next proposal
+                # opportunity (~2 slots), unlike single attestations
+                deadline=time.perf_counter()
+                + 2 * self.chain.spec.seconds_per_slot,
+            )
+        )
+
     def _on_gossip_blob(self, peer_id: str, data: bytes) -> None:
         GOSSIP_RX.labels(kind="blob_sidecar").inc()
         try:
@@ -216,6 +259,12 @@ class NetworkBeaconProcessor:
     def publish_attestation(self, attestation, subnet: int = 0) -> None:
         topic = topic_for(TOPIC_ATTESTATION_SUBNET, self.fork_digest, subnet)
         self.service.publish(topic, T.Attestation.serialize(attestation))
+
+    def publish_aggregate(self, signed_aggregate) -> None:
+        topic = topic_for(TOPIC_AGGREGATE, self.fork_digest)
+        self.service.publish(
+            topic, T.SignedAggregateAndProof.serialize(signed_aggregate)
+        )
 
     def publish_blob_sidecar(self, sidecar) -> None:
         topic = topic_for(

@@ -658,19 +658,36 @@ class SyncManager:
                 self.maybe_sample(blocks)
             self._drive_chain(sc)
 
-        # chain segments take the HIGHEST priority lane (lib.rs:1037)
-        if not self.processor.submit(
-            Work(
-                kind=WorkType.CHAIN_SEGMENT,
-                process_individual=process,
-                slot=batch.start_slot,
-            )
-        ):
-            # backpressure drop: the callback will never run. Put the
+        def shed(_w, reason) -> None:
+            if batch.state is not BatchState.PROCESSING:
+                # the handler already advanced the batch's state
+                # machine before failing terminally — don't rewind it
+                return
+            if reason == "failed":
+                # the handler RAN and raised on every allowed attempt
+                # (blocks possibly part-consumed): blame the download
+                # like any unprocessable batch — bounded re-download
+                # from another peer — instead of re-submitting the
+                # same closure forever
+                self._fail_download(sc, batch, peer_id)
+                return
+            # never ran (backpressure past the attempt caps): put the
             # batch back to AWAITING_PROCESSING (blocks still in hand)
             # so the next tick retries — no timeout covers PROCESSING,
             # so leaving it there would wedge the chain forever
             batch.state = BatchState.AWAITING_PROCESSING
+
+        # chain segments take the HIGHEST priority lane (lib.rs:1037);
+        # transient backpressure bounces inside the scheduler
+        # (bounded retry-with-requeue), so no hand-rolled re-queue here
+        self.processor.submit(
+            Work(
+                kind=WorkType.CHAIN_SEGMENT,
+                process_individual=process,
+                slot=batch.start_slot,
+                on_shed=shed,
+            )
+        )
 
     def _after_empty(self, sc: SyncingChain, batch: Batch) -> None:
         """A confirmed-empty batch: a genuine run of skipped slots."""
@@ -791,16 +808,19 @@ class SyncManager:
             finally:
                 self._backfill_inflight = False
 
+        def shed(_w, _reason) -> None:
+            # terminal shed: the callback never clears the in-flight
+            # flag, so clear it here or backfill halts permanently
+            self._backfill_inflight = False
+
         # backfill takes the LOWEST priority lane (lib.rs:1037 ordering)
-        if not self.processor.submit(
+        self.processor.submit(
             Work(
                 kind=WorkType.CHAIN_SEGMENT_BACKFILL,
                 process_individual=process,
+                on_shed=shed,
             )
-        ):
-            # backpressure drop: the callback never clears the in-flight
-            # flag, so clear it here or backfill halts permanently
-            self._backfill_inflight = False
+        )
 
     # ------------------------------------------------------------ sampling
 
@@ -920,12 +940,15 @@ class SyncManager:
             self.maybe_sample([block])
             self._release_children(peer_id, root)
 
-        if not self.processor.submit(
-            Work(kind=WorkType.RPC_BLOCK, process_individual=process)
-        ):
-            # backpressure drop: the callback will never run — release
-            # the slot + children or the lookup path wedges forever
-            self._abandon_lookup(parent_root)
+        self.processor.submit(
+            Work(
+                kind=WorkType.RPC_BLOCK,
+                process_individual=process,
+                # terminal shed: the callback will never run — release
+                # the slot + children or the lookup path wedges forever
+                on_shed=lambda _w, _r: self._abandon_lookup(parent_root),
+            )
+        )
 
     def _abandon_lookup(self, parent_root: bytes) -> None:
         """Terminal lookup failure: release the request slot AND the

@@ -1,10 +1,10 @@
 """The work scheduler — beacon_node/beacon_processor reimagined for a
-TPU-backed verifier.
+TPU-backed verifier, rebuilt overload-first (ISSUE 13).
 
 Reference economics preserved (beacon_processor/src/lib.rs):
   - 20+ typed, bounded queues with an explicit priority chain
-    (lib.rs:1036-1260): chain segments > rpc blocks > gossip blocks >
-    P0 API > aggregates > attestations > ... > P1 API > backfill.
+    (lib.rs:1036-1260) and validator-count-derived queue lengths
+    (BeaconProcessorQueueLengths::from_state, lib.rs:144-210).
   - LIFO for attestations/aggregates — "validator profits rely upon
     getting fresh" (lib.rs:846) — FIFO elsewhere.
   - Bounded queues with drop-and-count backpressure (lib.rs:77-99).
@@ -16,6 +16,51 @@ Reference economics preserved (beacon_processor/src/lib.rs):
     :203-211 defense).
   - A reprocessing queue re-schedules early work
     (work_reprocessing_queue.rs:42-54 delays).
+
+Overload-first additions (the chain's right failure mode under a
+1M-validator gossip burst is graceful degradation — shed stale
+attestations before fresh blocks, never the reverse):
+
+  PRIORITY CHAIN — explicit classes replacing enum-order iteration:
+
+    0 BLOCK_SYNC_CRITICAL  chain segments > rpc blocks > delayed
+                           imports > gossip blocks — losing one forks
+                           or stalls the chain
+    1 AGGREGATE            aggregates + sync contributions — one shed
+                           aggregate loses ~hundreds of attestations
+    2 DUTY_CRITICAL        API P0 (duty pulls — what a million VCs
+                           block on)
+    3 ATTESTATION          unaggregated attestations, sync signatures,
+                           gossip ops, RPC serving — individually cheap
+                           to lose, infinitely replaceable
+    4 BACKFILL             API P1 + backfill segments — pure background
+
+    Scheduling walks classes in order, queues within a class in
+    declaration order; a lower class runs only when every queue above
+    it is empty (or holds only expired work).
+
+  DEADLINE-AWARE SHEDDING — expired work is dropped at enqueue (dead on
+  arrival never occupies capacity) AND re-checked at dequeue (work that
+  aged out while queued is shed, not served late). A full LIFO queue
+  evicts its stale end — already-expired entries first, then the oldest
+  live entry — so the fresh arrival is always admitted.
+  `beacon_processor_sheds_total{queue,reason}` splits every shed:
+    expired       past its slot-relative deadline (enqueue DOA,
+                  enqueue-side eviction scan, or dequeue recheck)
+    capacity      full LIFO queue evicted its oldest live entry
+    backpressure  full FIFO queue rejected the submission terminally
+    failed        the handler raised on every allowed attempt
+  `beacon_processor_deadline_misses_total{queue}` counts the subset of
+  expired sheds that aged out IN-QUEUE (admitted fresh, expired before
+  a worker reached them) — the latency-tail denominator the load
+  curves regress against.
+
+  BOUNDED RETRY-WITH-REQUEUE — transient failures (submit backpressure
+  on a full sync-critical FIFO lane, a raising handler) re-enter via
+  the reprocessing heap with a small backoff, up to a per-queue attempt
+  cap (DEFAULT_ATTEMPT_CAPS); past the cap the work is shed terminally
+  and its `on_shed` callback runs, so callers (network/sync.py) no
+  longer hand-roll re-queue loops around submit().
 
 TPU-first change: max batch size defaults far above the reference's 64
 — the whole point of the TPU backend is that batch cost is sublinear in
@@ -39,7 +84,8 @@ from ..common import metrics, tracing
 
 
 class WorkType(IntEnum):
-    """Priority order: LOWER value = HIGHER priority (lib.rs:1036-1260)."""
+    """Queue identity. Enum VALUE is no longer the scheduling key —
+    WORK_CLASS + _PRIORITY_ORDER are (lib.rs:1036-1260 chain)."""
 
     CHAIN_SEGMENT = 0
     RPC_BLOCK = 1
@@ -59,8 +105,45 @@ class WorkType(IntEnum):
     CHAIN_SEGMENT_BACKFILL = 15
 
 
+class PriorityClass(IntEnum):
+    """The documented priority chain (module docstring): lower value =
+    served first; a class runs only when every class above is drained."""
+
+    BLOCK_SYNC_CRITICAL = 0
+    AGGREGATE = 1
+    DUTY_CRITICAL = 2
+    ATTESTATION = 3
+    BACKFILL = 4
+
+
+WORK_CLASS: dict = {
+    WorkType.CHAIN_SEGMENT: PriorityClass.BLOCK_SYNC_CRITICAL,
+    WorkType.RPC_BLOCK: PriorityClass.BLOCK_SYNC_CRITICAL,
+    WorkType.DELAYED_IMPORT_BLOCK: PriorityClass.BLOCK_SYNC_CRITICAL,
+    WorkType.GOSSIP_BLOCK: PriorityClass.BLOCK_SYNC_CRITICAL,
+    WorkType.GOSSIP_AGGREGATE: PriorityClass.AGGREGATE,
+    WorkType.GOSSIP_SYNC_CONTRIBUTION: PriorityClass.AGGREGATE,
+    WorkType.API_REQUEST_P0: PriorityClass.DUTY_CRITICAL,
+    WorkType.GOSSIP_ATTESTATION: PriorityClass.ATTESTATION,
+    WorkType.GOSSIP_SYNC_SIGNATURE: PriorityClass.ATTESTATION,
+    WorkType.GOSSIP_VOLUNTARY_EXIT: PriorityClass.ATTESTATION,
+    WorkType.GOSSIP_PROPOSER_SLASHING: PriorityClass.ATTESTATION,
+    WorkType.GOSSIP_ATTESTER_SLASHING: PriorityClass.ATTESTATION,
+    WorkType.GOSSIP_BLS_TO_EXECUTION_CHANGE: PriorityClass.ATTESTATION,
+    WorkType.RPC_REQUEST: PriorityClass.ATTESTATION,
+    WorkType.API_REQUEST_P1: PriorityClass.BACKFILL,
+    WorkType.CHAIN_SEGMENT_BACKFILL: PriorityClass.BACKFILL,
+}
+
+# dispatch order: class first, declaration order within a class
+_PRIORITY_ORDER: tuple = tuple(
+    sorted(WorkType, key=lambda t: (int(WORK_CLASS[t]), int(t)))
+)
+
 _LIFO_TYPES = {WorkType.GOSSIP_ATTESTATION, WorkType.GOSSIP_AGGREGATE}
 _BATCH_TYPES = {WorkType.GOSSIP_ATTESTATION, WorkType.GOSSIP_AGGREGATE}
+
+_SHED_REASONS = ("expired", "capacity", "backpressure", "failed")
 
 # Per-queue labeled families (lib.rs registers one *_VEC per queue).
 # tools/metrics_lint.py asserts these names stay registered — renaming
@@ -82,7 +165,8 @@ Q_RECEIVED = metrics.counter(
 )
 Q_DROPPED = metrics.counter(
     "beacon_processor_work_dropped_total",
-    "Work dropped by backpressure, by queue",
+    "Work shed for any reason, by queue (sheds_total's reason split "
+    "sums exactly to this series)",
     labelnames=("queue",),
 )
 Q_PROCESSED = metrics.counter(
@@ -96,13 +180,30 @@ BATCH_SIZE = metrics.histogram(
     buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096),
     labelnames=("queue",),
 )
-# ISSUE 8: work popped AFTER its slot-relative deadline — the
-# denominator the load-shedding curves (ROADMAP item 4) regress
-# against: shed rate says what we refused, this says what we served
-# too late to matter.
+# ISSUE 13: every submitted-but-unprocessed item lands here exactly
+# once, split by why it was refused — the graceful-degradation curve
+# (shed stale attestations, never fresh blocks) reads directly off the
+# {queue, reason} matrix
+Q_SHED = metrics.counter(
+    "beacon_processor_sheds_total",
+    "Work shed without being processed, by queue and reason "
+    "(expired / capacity / backpressure / failed)",
+    labelnames=("queue", "reason"),
+)
+Q_RETRY = metrics.counter(
+    "beacon_processor_work_retries_total",
+    "Bounded retry-with-requeue events (submit backpressure or a "
+    "raising handler re-entering via the reprocess heap), by queue",
+    labelnames=("queue",),
+)
+# ISSUE 8/13: work that aged past its slot-relative deadline IN-QUEUE
+# (admitted fresh, expired before a worker reached it) — the
+# denominator the load-shedding curves (ROADMAP item 2) regress
+# against: shed rate says what we refused at the door, this says what
+# we admitted but could not serve in time.
 Q_DEADLINE_MISS = metrics.counter(
     "beacon_processor_deadline_misses_total",
-    "Work processed after its slot-relative deadline, by queue",
+    "Work that aged past its slot-relative deadline in-queue, by queue",
     labelnames=("queue",),
 )
 
@@ -116,6 +217,12 @@ _Q_DROPPED = {t: Q_DROPPED.labels(queue=t.name) for t in WorkType}
 _Q_PROCESSED = {t: Q_PROCESSED.labels(queue=t.name) for t in WorkType}
 _BATCH_SIZE = {t: BATCH_SIZE.labels(queue=t.name) for t in _BATCH_TYPES}
 _Q_DEADLINE_MISS = {t: Q_DEADLINE_MISS.labels(queue=t.name) for t in WorkType}
+_Q_SHED = {
+    (t, r): Q_SHED.labels(queue=t.name, reason=r)
+    for t in WorkType
+    for r in _SHED_REASONS
+}
+_Q_RETRY = {t: Q_RETRY.labels(queue=t.name) for t in WorkType}
 
 
 @dataclass
@@ -134,6 +241,74 @@ class Work:
     # submitter: an attestation is worthless once its slot's inclusion
     # window closed. None = no deadline (blocks, API work).
     deadline: Optional[float] = None
+    # terminal-shed callback (reason string): runs exactly once when
+    # the scheduler gives up on this work without processing it —
+    # expired, evicted, backpressure past the attempt cap, or a handler
+    # that raised on every allowed attempt. Callers that must release
+    # state a never-run closure holds (sync batches, lookup slots) hook
+    # cleanup here instead of hand-rolling re-queue loops.
+    on_shed: Optional[Callable[["Work", str], None]] = None
+    # consumed admission/execution attempts (bounded retry-with-requeue)
+    attempts: int = 0
+    # received-counter idempotence: a requeued Work counts once
+    counted: bool = field(default=False, repr=False)
+
+
+# per-queue bounded-retry caps (TOTAL attempts per Work, submit
+# backpressure and raising handlers alike): the sync-critical FIFO
+# lanes retry through the reprocess heap so PR 7's callers stop
+# hand-rolling re-queue loops; freshness-sensitive LIFO lanes never
+# retry — a bounced attestation is stale by the time it re-enters
+DEFAULT_ATTEMPT_CAPS = {
+    WorkType.CHAIN_SEGMENT: 4,
+    WorkType.RPC_BLOCK: 3,
+    WorkType.GOSSIP_BLOCK: 3,
+    WorkType.DELAYED_IMPORT_BLOCK: 3,
+    WorkType.CHAIN_SEGMENT_BACKFILL: 2,
+}
+
+
+def derived_queue_capacities(
+    active_validators: int, slots_per_epoch: int = 32
+) -> dict:
+    """Validator-count-derived queue lengths, mirroring the reference's
+    sizing rules (BeaconProcessorQueueLengths::from_state,
+    lib.rs:144-210): traffic that fans out with the validator set
+    scales with it; traffic whose per-slot volume the protocol caps
+    (aggregator counts, block counts) stays fixed.
+
+      GOSSIP_ATTESTATION     av / slots_per_epoch — one slot's worth of
+                             unaggregated fanout under a full-subnet
+                             subscription (every validator attests once
+                             per epoch)
+      GOSSIP_AGGREGATE       4096 — aggregator fanout is validator-
+                             count-independent (64 committees x 16
+                             target aggregators per slot)
+      sync committee lanes   fixed (512-member committee)
+      block/segment lanes    fixed small (one block per slot; segments
+                             are multi-block units)
+      ops lanes              fixed (protocol-capped per block)
+    """
+    av = max(0, int(active_validators))
+    per_slot = av // max(1, int(slots_per_epoch))
+    return {
+        WorkType.GOSSIP_ATTESTATION: max(1024, per_slot),
+        WorkType.GOSSIP_AGGREGATE: 4096,
+        WorkType.GOSSIP_SYNC_SIGNATURE: 2048,
+        WorkType.GOSSIP_SYNC_CONTRIBUTION: 1024,
+        WorkType.GOSSIP_BLOCK: 1024,
+        WorkType.DELAYED_IMPORT_BLOCK: 1024,
+        WorkType.RPC_BLOCK: 1024,
+        WorkType.CHAIN_SEGMENT: 64,
+        WorkType.CHAIN_SEGMENT_BACKFILL: 64,
+        WorkType.GOSSIP_VOLUNTARY_EXIT: 4096,
+        WorkType.GOSSIP_PROPOSER_SLASHING: 4096,
+        WorkType.GOSSIP_ATTESTER_SLASHING: 4096,
+        WorkType.GOSSIP_BLS_TO_EXECUTION_CHANGE: 16384,
+        WorkType.RPC_REQUEST: 1024,
+        WorkType.API_REQUEST_P0: 1024,
+        WorkType.API_REQUEST_P1: 1024,
+    }
 
 
 @dataclass
@@ -145,17 +320,27 @@ class BeaconProcessorConfig:
     max_gossip_aggregate_batch_size: int = 256
     queue_capacities: dict = field(default_factory=dict)
     default_capacity: int = 16384
+    # bounded retry-with-requeue: TOTAL attempts per Work, per queue;
+    # queues absent from the dict fall back to default_max_attempts
+    # (1 = no retry)
+    max_attempts: dict = field(
+        default_factory=lambda: dict(DEFAULT_ATTEMPT_CAPS)
+    )
+    default_max_attempts: int = 1
+    retry_backoff_s: float = 0.05
 
     @classmethod
-    def for_validator_count(cls, active_validators: int, **kw):
-        """Queue sizes partly derived from validator count
-        (lib.rs:144-210)."""
-        cap = max(1024, active_validators // 32)
-        caps = {
-            WorkType.GOSSIP_ATTESTATION: cap,
-            WorkType.GOSSIP_AGGREGATE: max(256, active_validators // 64),
-        }
-        return cls(queue_capacities=caps, **kw)
+    def for_validator_count(
+        cls, active_validators: int, slots_per_epoch: int = 32, **kw
+    ):
+        """Full queue table derived from the validator count
+        (lib.rs:144-210 from_state analog)."""
+        return cls(
+            queue_capacities=derived_queue_capacities(
+                active_validators, slots_per_epoch
+            ),
+            **kw,
+        )
 
 
 class BeaconProcessor:
@@ -166,6 +351,10 @@ class BeaconProcessor:
         }
         self._lock = threading.Lock()
         self._event = threading.Event()
+        # per-queue earliest-deadline watermark: the full-queue eviction
+        # sweep runs only when something enqueued MAY have expired, so
+        # the exact stale-first policy stays amortized-O(1) per submit
+        self._min_deadline: dict = {t: None for t in WorkType}
         self._reprocess: list = []  # heap of (due_time, seq, Work)
         self._seq = 0
         self._shutdown = False
@@ -185,33 +374,136 @@ class BeaconProcessor:
 
     # ---------------------------------------------------------- submission
 
+    def _attempt_cap(self, kind: WorkType) -> int:
+        return max(
+            1,
+            int(
+                self.config.max_attempts.get(
+                    kind, self.config.default_max_attempts
+                )
+            ),
+        )
+
+    def _finalize_shed(
+        self, work: Work, reason: str, aged_in_queue: bool = False
+    ) -> None:
+        """Terminal refusal: count it exactly once and release the
+        caller's state via on_shed. aged_in_queue marks expired work
+        that was ADMITTED fresh and aged out before a worker reached it
+        (the deadline-miss subset)."""
+        self.m_dropped.inc()
+        _Q_DROPPED[work.kind].inc()
+        _Q_SHED[(work.kind, reason)].inc()
+        if aged_in_queue:
+            _Q_DEADLINE_MISS[work.kind].inc()
+            if work.enqueued_at:
+                # the wait series IS the age attribution — the expired
+                # tail must land in it, or congested-queue p99s would
+                # exclude exactly the population that aged out
+                _Q_WAIT[work.kind].observe(
+                    time.perf_counter() - work.enqueued_at
+                )
+        if work.on_shed is not None:
+            try:
+                work.on_shed(work, reason)
+            except Exception:
+                pass  # a raising cleanup must not kill the caller/worker
+
+    def _requeue(self, work: Work, now: float) -> None:
+        """Bounce via the reprocess heap (caller holds NO locks;
+        verified attempts headroom)."""
+        work.attempts += 1
+        _Q_RETRY[work.kind].inc()
+        with self._lock:
+            self._seq += 1
+            heapq.heappush(
+                self._reprocess,
+                (now + self.config.retry_backoff_s, self._seq, work),
+            )
+
     def submit(self, work: Work) -> bool:
-        """Enqueue; returns False when dropped by backpressure."""
-        self.m_received.inc()
-        _Q_RECEIVED[work.kind].inc()
-        work.enqueued_at = time.perf_counter()
+        """Enqueue; returns False when the work was terminally shed
+        (expired on arrival, or backpressure past its attempt cap —
+        on_shed has already run). True means the scheduler owns it:
+        queued, or bouncing through the reprocess heap."""
+        now = time.perf_counter()
+        if not work.counted:
+            work.counted = True
+            work.enqueued_at = now
+            self.m_received.inc()
+            _Q_RECEIVED[work.kind].inc()
+        if work.deadline is not None and now > work.deadline:
+            # dead on arrival: shed at the door instead of occupying
+            # capacity until a worker pops it (ISSUE 13 enqueue check)
+            self._finalize_shed(work, "expired")
+            return False
         cap = self.config.queue_capacities.get(
             work.kind, self.config.default_capacity
         )
+        shed = []  # (Work, reason, aged_in_queue) — finalized outside the lock
+        accepted = True
+        requeue = False
+        appended = False
         with self._lock:
             q = self._queues[work.kind]
             if len(q) >= cap:
                 if work.kind in _LIFO_TYPES:
-                    # LIFO queues drop the OLDEST (stale) item instead
-                    q.popleft()
-                    self.m_dropped.inc()
-                    _Q_DROPPED[work.kind].inc()
+                    # evict the STALE end: expired entries first —
+                    # WHEREVER they sit (they occupy capacity without
+                    # being servable; a live oldest entry must never be
+                    # evicted while an expired one squats mid-queue) —
+                    # then the oldest live entry; the fresh arrival is
+                    # always admitted. The min-deadline watermark keeps
+                    # the sweep amortized: it only runs when something
+                    # enqueued may actually have expired.
+                    md = self._min_deadline[work.kind]
+                    if md is not None and now > md:
+                        kept = []
+                        for item in q:
+                            if (
+                                item.deadline is not None
+                                and now > item.deadline
+                            ):
+                                shed.append((item, "expired", True))
+                            else:
+                                kept.append(item)
+                        q.clear()
+                        q.extend(kept)
+                        self._min_deadline[work.kind] = min(
+                            (
+                                i.deadline
+                                for i in kept
+                                if i.deadline is not None
+                            ),
+                            default=None,
+                        )
+                    if len(q) >= cap:
+                        shed.append((q.popleft(), "capacity", False))
+                    q.append(work)
+                    appended = True
+                elif work.attempts + 1 < self._attempt_cap(work.kind):
+                    # FIFO backpressure: bounded retry-with-requeue
+                    requeue = True
                 else:
-                    self.m_dropped.inc()
-                    _Q_DROPPED[work.kind].inc()
-                    return False
-            q.append(work)
+                    shed.append((work, "backpressure", False))
+                    accepted = False
+            else:
+                q.append(work)
+                appended = True
+            if appended and work.deadline is not None:
+                md = self._min_deadline[work.kind]
+                if md is None or work.deadline < md:
+                    self._min_deadline[work.kind] = work.deadline
             # inside the queue lock: a stale out-of-lock set could pin
             # the gauge at a nonzero depth on a drained queue (metric
             # locks never wrap the queue lock, so no ordering cycle)
             _Q_DEPTH[work.kind].set(len(q))
+        if requeue:
+            self._requeue(work, now)
+        for w, reason, aged in shed:
+            self._finalize_shed(w, reason, aged_in_queue=aged)
         self._event.set()
-        return True
+        return accepted
 
     def submit_delayed(self, work: Work, due_time: float) -> None:
         """Reprocessing queue: early attestations (+12 s), unknown-parent
@@ -222,7 +514,7 @@ class BeaconProcessor:
             heapq.heappush(self._reprocess, (due_time, self._seq, work))
 
     def pump_reprocess(self, now: float) -> int:
-        """Move due delayed work into the live queues."""
+        """Move due delayed/retried work into the live queues."""
         moved = 0
         while True:
             with self._lock:
@@ -236,49 +528,74 @@ class BeaconProcessor:
     # ---------------------------------------------------------- dispatch
 
     def _pop_next(self) -> Optional[list]:
-        """Highest-priority work, batch-formed where applicable. Returns
-        a list of Work sharing one process_batch, or a single-item list."""
+        """Highest-priority LIVE work, batch-formed where applicable:
+        classes in chain order, queues in declaration order within a
+        class, expired work shed (not served) at the dequeue recheck.
+        Returns a list of Work sharing one process_batch, or a
+        single-item list; None only when nothing live remains."""
         batch = None
+        expired = []
+        now = time.perf_counter()
         with self._lock:
-            for kind in WorkType:
+            for kind in _PRIORITY_ORDER:
                 q = self._queues[kind]
                 if not q:
                     continue
-                if kind in _BATCH_TYPES:
-                    limit = (
-                        self.config.max_gossip_attestation_batch_size
-                        if kind == WorkType.GOSSIP_ATTESTATION
-                        else self.config.max_gossip_aggregate_batch_size
-                    )
-                    batch = []
-                    while q and len(batch) < limit:
-                        batch.append(q.pop())  # LIFO: freshest first
-                elif kind in _LIFO_TYPES:
-                    batch = [q.pop()]
+                if kind == WorkType.GOSSIP_ATTESTATION:
+                    limit = self.config.max_gossip_attestation_batch_size
+                elif kind == WorkType.GOSSIP_AGGREGATE:
+                    limit = self.config.max_gossip_aggregate_batch_size
+                elif kind in _BATCH_TYPES:  # pragma: no cover — future lanes
+                    limit = self.config.max_gossip_attestation_batch_size
                 else:
-                    batch = [q.popleft()]
+                    limit = 1
+                got = []
+                lifo = kind in _LIFO_TYPES
+                while q and len(got) < limit:
+                    w = q.pop() if lifo else q.popleft()
+                    if w.deadline is not None and now > w.deadline:
+                        # dequeue-side staleness recheck (ISSUE 13):
+                        # aged out in-queue — shed, never served late
+                        expired.append(w)
+                        continue
+                    got.append(w)
                 # depth gauge inside the lock (see submit): last-writer
                 # races would otherwise pin stale depths on the scrape
                 _Q_DEPTH[kind].set(len(q))
-                break
+                if got:
+                    batch = got
+                    break
+                # everything in this queue had expired: keep walking
+        for w in expired:
+            self._finalize_shed(w, "expired", aged_in_queue=True)
         if batch is None:
             return None
         # per-item observations outside the queue lock — they only
         # touch the popped items, not shared queue state
         kind = batch[0].kind
-        now = time.perf_counter()
         wait = _Q_WAIT[kind]
-        misses = _Q_DEADLINE_MISS[kind]
         for w in batch:
             if w.enqueued_at:
                 # queue age at dequeue (ISSUE 8): the wait series IS the
                 # age attribution — deadline misses are the tail of it
                 wait.observe(now - w.enqueued_at)
-            if w.deadline is not None and now > w.deadline:
-                misses.inc()
         if kind in _BATCH_TYPES:
             _BATCH_SIZE[kind].observe(len(batch))
         return batch
+
+    def _run_individual(self, work: Work) -> int:
+        """Execute one item; a raising handler re-enters via the
+        reprocess heap up to the queue's attempt cap, then sheds
+        terminally (reason=failed). Returns items completed (0/1)."""
+        try:
+            work.process_individual(work.payload)
+        except Exception:
+            if work.attempts + 1 < self._attempt_cap(work.kind):
+                self._requeue(work, time.perf_counter())
+            else:
+                self._finalize_shed(work, "failed")
+            return 0
+        return 1
 
     def step(self) -> bool:
         """Process one work item (or one formed batch). Returns False
@@ -288,6 +605,7 @@ class BeaconProcessor:
             return False
         kind = batch[0].kind
         slot = next((w.slot for w in batch if w.slot is not None), None)
+        done = 0
         # the slot-timeline STAGE span: one per executed work unit
         # (item or formed batch); nested spans (attestation_batch,
         # bls_verify, ...) attribute the inside of this stage
@@ -303,15 +621,19 @@ class BeaconProcessor:
                     # treat it exactly like a poisoned batch
                     ok = False
                 if ok is False:
-                    # poisoned batch: fall back to individual verification
+                    # poisoned batch: fall back to individual
+                    # verification, each item guarded on its own
                     self.m_batch_fallbacks.inc()
                     for w in batch:
-                        w.process_individual(w.payload)
+                        done += self._run_individual(w)
+                else:
+                    done = len(batch)
             else:
                 for w in batch:
-                    w.process_individual(w.payload)
-        self.m_processed.inc(len(batch))
-        _Q_PROCESSED[kind].inc(len(batch))
+                    done += self._run_individual(w)
+        if done:
+            self.m_processed.inc(done)
+            _Q_PROCESSED[kind].inc(done)
         return True
 
     # ---------------------------------------------------------- thread loop
@@ -319,6 +641,7 @@ class BeaconProcessor:
     def run_worker_loop(self, poll_interval: float = 0.01):
         """Blocking worker loop (threaded driver over the sync core)."""
         while not self._shutdown:
+            self.pump_reprocess(time.perf_counter())
             if not self.step():
                 self._event.clear()
                 self._event.wait(timeout=poll_interval)
@@ -338,3 +661,9 @@ class BeaconProcessor:
     def queue_lengths(self) -> dict:
         with self._lock:
             return {t.name: len(q) for t, q in self._queues.items() if q}
+
+    def pending_reprocess(self) -> int:
+        """Delayed + bouncing (retry) work not yet back in a live
+        queue — drain loops flush this before closing accounting."""
+        with self._lock:
+            return len(self._reprocess)

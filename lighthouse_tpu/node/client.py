@@ -17,7 +17,7 @@ from ..common.slot_clock import SlotClock
 from ..consensus import state_transition as st
 from ..consensus.spec import ChainSpec
 from .beacon_chain import BeaconChain
-from .beacon_processor import BeaconProcessor
+from .beacon_processor import BeaconProcessor, BeaconProcessorConfig
 from .http_api import ApiServer, BeaconApi
 from .store import HotColdDB
 
@@ -119,6 +119,12 @@ class Client:
             for ev in self.service.poll():
                 self.nbp.handle_gossip(ev.peer_id, ev.topic, ev.data)
                 n += 1
+        # retried/delayed work re-enters the live queues before the
+        # drain — without this, bounced sync-critical submissions would
+        # sit in the reprocess heap until their on_shed fallback. Moved
+        # items are NOT counted as work done: the step loop below
+        # counts them when (and only when) they actually process.
+        self.processor.pump_reprocess(time.perf_counter())
         while self.processor.step():
             n += 1
         if self.sync is not None:
@@ -228,7 +234,16 @@ class ClientBuilder:
                 kzg=self._kzg,
                 slasher=slasher,
             )
-        processor = BeaconProcessor()
+        # queue capacities derived from the actual validator count
+        # (lib.rs:144-210 from_state analog): a 1M-validator chain gets
+        # a 1M-scale attestation lane, a devnet gets the floors
+        reg_state = chain.head_state()
+        processor = BeaconProcessor(
+            BeaconProcessorConfig.for_validator_count(
+                len(reg_state.validators) if reg_state is not None else 0,
+                slots_per_epoch=self.spec.preset.slots_per_epoch,
+            )
+        )
         service = nbp = sync = subnet_service = None
         if self._hub is not None:
             from ..network import (

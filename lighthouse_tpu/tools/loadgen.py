@@ -17,14 +17,40 @@ against it:
     series have known-nonzero denominators.
 
 Everything randomized is drawn from `random.Random(seed)`, so the
-report SHAPE (request schedule, gossip burst sizes, which items are
-stale, how many submissions overflow the queue) is reproducible
-run-to-run; only the measured latencies vary.
+report SHAPE (request schedule, gossip burst sizes, population split
+into stale/expiring/fresh) is EXACTLY reproducible run-to-run.
+Shed/deadline-miss TOTALS are seeded but tolerance-exact only (~1%):
+the scheduler's expired-sweep eviction clears every expired entry
+whenever the deadline watermark fires, so whether an `expiring` item
+sheds at enqueue, at the sweep, or at dequeue depends on wall-clock
+scheduling — same totals class, slightly different split. Measured
+latencies vary freely.
+
+ISSUE 13 — the scheduler fault fleet. After the steady phase the
+replay runs a seeded OVERLOAD phase driven by `FaultSpell`s:
+
+  burst          multiplies the per-slot gossip burst (default 4x —
+                 the "1M validators all gossiping at once" shape)
+  worker_stall   every attestation batch verification sleeps N ms
+                 (a wedged TPU dispatch / GC pause stand-in)
+  slow_consumer  the scheduler drain is capped at N step() calls per
+                 slot, so backlog carries across slots
+
+During overload the harness also injects block/segment/aggregate work
+AFTER each burst, so the report can prove the priority chain under
+contention: the `overload` section records per-queue sheds by reason,
+per-queue deadline misses, overload-phase duty percentiles, and
+`order_ok` (every block/sync-critical item processed before any
+unaggregated attestation in its slot's drain). The ratcheted tier-1
+gates read off it: zero sheds + zero deadline misses on the
+block/sync-critical queues, nonzero attestation shed rate, duty p99
+<= 250 ms.
 
 The emitted `LoadReport` is the schema-checked contract shared with
 `bench.py` (`detail.load`) and gated in tier-1 by
 `tests/test_loadgen.py`: per-endpoint p50/p95/p99, duty-response SLO
-percentiles, shed rate, deadline-miss rate, SSE delivery counters.
+percentiles, shed rate (split by reason), deadline-miss rate, SSE
+delivery counters, and the overload section.
 
 CLI: `python tools/loadgen.py --vcs 200 --seed 7`.
 """
@@ -40,11 +66,15 @@ import statistics
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor, wait
-from dataclasses import asdict, dataclass
+from dataclasses import asdict, dataclass, field
 
 from ..common import metrics, tracing
 
-SCHEMA = "lighthouse-tpu/load-report/v1"
+# v2: deadline-aware shedding semantics (expired work is shed at
+# enqueue AND dequeue, sheds split by reason) + the mandatory overload
+# section. tools/bench_gate.py only compares load rates between rounds
+# that share this schema string — v1 rows measured a different policy.
+SCHEMA = "lighthouse-tpu/load-report/v2"
 MAINNET_SLOTS_PER_EPOCH = 32  # the simulated network's slot cadence
 
 
@@ -80,6 +110,9 @@ class LoadReport:
     # compressions measured during the run and the read-path share per
     # endpoint (states/{id}/root hashes the whole head state per hit)
     hash: dict  # {compressions, read_path: {endpoint: compressions}}
+    # ISSUE 13: the seeded scheduler-fault-fleet section — graceful
+    # degradation under 4x overload (see module docstring)
+    overload: dict
     schema: str = SCHEMA
 
     def to_dict(self) -> dict:
@@ -88,7 +121,7 @@ class LoadReport:
     _ENDPOINT_KEYS = ("requests", "errors", "p50_ms", "p95_ms", "p99_ms")
     _SECTION_KEYS = {
         "duty_response_ms": ("count", "p50", "p95", "p99"),
-        "shed": ("received", "dropped", "rate"),
+        "shed": ("received", "dropped", "rate", "by_reason"),
         "deadline": ("processed", "misses", "rate"),
         "sse": (
             "subscribers",
@@ -97,6 +130,19 @@ class LoadReport:
             "slow_client_drops",
         ),
         "hash": ("compressions", "read_path"),
+        "overload": (
+            "slots",
+            "burst_multiplier",
+            "spells",
+            "gossip_submitted",
+            "duty_response_ms",
+            "sheds",
+            "deadline_misses",
+            "attestation_shed_rate",
+            "fresh_block_sheds",
+            "critical_deadline_misses",
+            "order_ok",
+        ),
     }
 
     @classmethod
@@ -126,11 +172,40 @@ class LoadReport:
         return problems
 
 
+@dataclass(frozen=True)
+class FaultSpell:
+    """One seeded scheduler fault, active on overload-phase slots
+    [start, end) — the fleet is a list of these (module docstring)."""
+
+    kind: str  # "burst" | "worker_stall" | "slow_consumer"
+    start: int
+    end: int
+    magnitude: float
+
+    def active(self, idx: int) -> bool:
+        return self.start <= idx < self.end
+
+
+def default_overload_spells(slots: int) -> tuple:
+    """The seeded 4x-overload cocktail the acceptance gates run on:
+    a sustained 4x burst, with a worker-stall + slow-consumer spell in
+    the middle slots so backlog provably carries across slots."""
+    mid_end = max(2, slots - 1)
+    return (
+        FaultSpell("burst", 0, slots, 4.0),
+        FaultSpell("worker_stall", 1, mid_end, 2.0),  # ms per batch
+        # 6 steps/slot is BELOW one slot's work (criticals + the batch
+        # former's passes over the attestation cap), so backlog
+        # provably carries into the next slot while the spell holds
+        FaultSpell("slow_consumer", 1, mid_end, 6),
+    )
+
+
 @dataclass
 class LoadgenConfig:
     vcs: int = 200  # simulated validator clients
     seed: int = 7
-    slots: int = 8  # replay horizon (after warmup)
+    slots: int = 8  # steady replay horizon (after warmup)
     slots_per_epoch: int = 4  # dwarf epochs (scenario_spec)
     n_validators: int = 16  # real validators backing the fleet
     warmup_epochs: int = 2  # build finality + warm caches first
@@ -139,13 +214,31 @@ class LoadgenConfig:
     # submitted as Work (1M/32 per slot is ~31k objects — the shape,
     # not the count, is what the observatory measures)
     gossip_scale: float = 1 / 64.0
-    stale_fraction: float = 0.10  # arrive past their slot deadline
+    stale_fraction: float = 0.10  # arrive past their slot deadline (DOA)
+    # admitted fresh but expire before a worker reaches them — the
+    # deterministic in-queue-expiry (deadline-miss) denominator
+    expiring_fraction: float = 0.05
+    expiring_delay_s: float = 1e-4
     attestation_queue_cap: int = 384  # bounded: the burst overflows it
     attestation_batch_cap: int = 256
     http_workers: int = 8
     sse_subscribers: int = 2
     request_timeout_s: float = 10.0
     extra_slow_ms: float = 0.0  # per-batch verify stall (stress shapes)
+    # ISSUE 13: the overload phase (0 disables). Spells default to
+    # default_overload_spells(overload_slots).
+    overload_slots: int = 4
+    overload_spells: tuple = None
+    # critical work injected AFTER each overload burst, proving the
+    # priority chain under contention
+    critical_blocks_per_slot: int = 2
+    critical_segments_per_slot: int = 1
+    critical_aggregates_per_slot: int = 8
+
+    def spells(self) -> tuple:
+        if self.overload_spells is not None:
+            return tuple(self.overload_spells)
+        return default_overload_spells(self.overload_slots)
 
     @property
     def gossip_per_slot(self) -> int:
@@ -219,8 +312,9 @@ class _Fleet:
             )
             self.node = self.sim.nodes[0]
             # bounded, validator-count-flavored queue for the replay:
-            # the burst must overflow it DETERMINISTICALLY so the shed
-            # series has a reproducible denominator
+            # the burst reliably overflows it, so the shed series has a
+            # known-nonzero denominator (counts are tolerance-exact
+            # run-to-run; see the module docstring)
             proc = self.node.processor
             proc.config.queue_capacities[WorkType.GOSSIP_ATTESTATION] = (
                 cfg.attestation_queue_cap
@@ -249,6 +343,11 @@ class _Fleet:
         self._sse_counts: list = []
         self._sse_stop = threading.Event()
         self._sse_threads: list = []
+        # ISSUE 13 fault-fleet state
+        self._phase = "steady"
+        self._duty_overload: list = []  # duty latencies, overload phase
+        self._order_log: list = []  # (kind, slot) in execution order
+        self._stall_s = 0.0  # worker_stall spell, read by batch closures
 
     # ---------------------------------------------------------- http side
 
@@ -276,6 +375,10 @@ class _Fleet:
         dt = time.perf_counter() - t0
         with self._lock:
             self._samples.setdefault(endpoint, []).append(dt)
+            if endpoint in DUTY_ENDPOINTS and self._phase == "overload":
+                # the ratcheted overload SLO (duty p99 <= 250 ms while
+                # the scheduler sheds) reads off this split
+                self._duty_overload.append(dt)
             if not 200 <= status < 300:
                 self._errors[endpoint] = self._errors.get(endpoint, 0) + 1
 
@@ -363,21 +466,36 @@ class _Fleet:
 
     # --------------------------------------------------------- gossip side
 
-    def _inject_gossip(self, rng: random.Random, slot: int) -> int:
+    def _inject_gossip(
+        self, rng: random.Random, slot: int, multiplier: float = 1.0
+    ) -> int:
         """One slot's synthetic attestation burst: Work with
         slot-relative deadlines through the real scheduler + fake-BLS
-        dispatch seam. Returns the number submitted."""
+        dispatch seam. Three seeded populations:
+
+          stale     deadline already past — shed at the door (enqueue
+                    expiry, reason=expired), deterministic count
+          expiring  admitted fresh, deadline ~100us out — provably
+                    expire IN-QUEUE before the drain reaches them
+                    (deterministic dequeue sheds + deadline misses)
+          fresh     deadline far out — processed, or evicted by
+                    capacity pressure when the burst overflows the cap
+
+        Returns the number submitted."""
         from ..crypto import bls
         from ..node.beacon_processor import Work
 
         cfg = self.cfg
         proc = self.node.processor
-        n = cfg.gossip_per_slot
+        n = max(1, int(cfg.gossip_per_slot * multiplier))
         extra = cfg.extra_slow_ms / 1e3
 
         def batch(payloads) -> bool:
-            if extra:
-                time.sleep(extra)
+            stall = self._stall_s + extra
+            if stall:
+                time.sleep(stall)  # worker_stall spell
+            with self._lock:
+                self._order_log.append(("attestation", self.slot))
             return bool(
                 bls.verify_signature_sets(
                     payloads, backend="fake",
@@ -388,9 +506,15 @@ class _Fleet:
         def individual(p) -> None:
             bls.verify_signature_sets([p], backend="fake", rand_scalars=[1])
 
-        now = time.perf_counter()
         for i in range(n):
-            stale = rng.random() < cfg.stale_fraction
+            r = rng.random()
+            now = time.perf_counter()
+            if r < cfg.stale_fraction:
+                deadline = now - 1e-4
+            elif r < cfg.stale_fraction + cfg.expiring_fraction:
+                deadline = now + cfg.expiring_delay_s
+            else:
+                deadline = now + 60.0
             proc.submit(
                 Work(
                     kind=self.WorkType.GOSSIP_ATTESTATION,
@@ -398,12 +522,109 @@ class _Fleet:
                     process_batch=batch,
                     payload=i,
                     slot=slot,
-                    # stale items model arrival AFTER their slot's
-                    # inclusion window — deterministic deadline misses
-                    deadline=now - 1e-4 if stale else now + 60.0,
+                    deadline=deadline,
                 )
             )
         return n
+
+    def _inject_critical(self) -> None:
+        """Block/sync-critical + aggregate work submitted AFTER the
+        burst (plus an order-log mark): the scheduler must serve these
+        ahead of the queued attestation backlog — the priority-chain
+        proof the `order_ok` flag condenses."""
+        from ..node.beacon_processor import Work
+
+        proc = self.node.processor
+        cfg = self.cfg
+
+        def mk(kindname):
+            def run(_p):
+                with self._lock:
+                    self._order_log.append((kindname, self.slot))
+
+            return run
+
+        with self._lock:
+            self._order_log.append(("mark", self.slot))
+        for _ in range(cfg.critical_segments_per_slot):
+            proc.submit(
+                Work(
+                    kind=self.WorkType.CHAIN_SEGMENT,
+                    process_individual=mk("segment"),
+                    slot=self.slot,
+                )
+            )
+        for _ in range(cfg.critical_blocks_per_slot):
+            proc.submit(
+                Work(
+                    kind=self.WorkType.GOSSIP_BLOCK,
+                    process_individual=mk("block"),
+                    slot=self.slot,
+                )
+            )
+        for _ in range(cfg.critical_aggregates_per_slot):
+            proc.submit(
+                Work(
+                    kind=self.WorkType.GOSSIP_AGGREGATE,
+                    process_individual=mk("aggregate"),
+                    slot=self.slot,
+                    deadline=time.perf_counter() + 60.0,
+                )
+            )
+
+    # ----------------------------------------------------- fault seams
+
+    def _install_step_budget(self, budget: int):
+        """slow_consumer spell: cap scheduler step() calls for the rest
+        of this slot (covers the simulator's internal pump AND the
+        explicit drain), so backlog provably carries across slots.
+        Returns a restore callable."""
+        proc = self.node.processor
+        orig_step = proc.step
+        remaining = [int(budget)]
+
+        def budgeted() -> bool:
+            if remaining[0] <= 0:
+                return False  # consumer wedged: leave the backlog
+            if orig_step():
+                remaining[0] -= 1
+                return True
+            return False
+
+        proc.step = budgeted
+
+        def restore():
+            del proc.step  # uncover the class method
+
+        return restore
+
+    def _drain(self) -> None:
+        """One drain pass: flush due retried/delayed work, then step
+        until idle (or until the slow-consumer budget wedges)."""
+        proc = self.node.processor
+        proc.pump_reprocess(time.perf_counter())
+        while proc.step():
+            pass
+
+    def _drain_fully(self) -> None:
+        """Close the books: flush the reprocess heap (future-due
+        retries included) and every queue so received == processed +
+        shed exactly when the counters are read."""
+        proc = self.node.processor
+        for _ in range(1000):  # attempts are bounded; this terminates
+            moved = proc.pump_reprocess(time.perf_counter() + 3600.0)
+            stepped = 0
+            while proc.step():
+                stepped += 1
+            if not moved and not stepped and proc.pending_reprocess() == 0:
+                break
+
+    @staticmethod
+    def _labeled_values(name: str) -> dict:
+        fam = metrics.get(name)
+        if fam is None:
+            return {}
+        return {lv: fam.labels(*lv).value for lv in fam.label_values()}
 
     # ------------------------------------------------------------ sse side
 
@@ -477,6 +698,7 @@ class _Fleet:
             "misses": _counter_value(
                 "beacon_processor_deadline_misses_total", queue=att
             ),
+            "sheds": self._labeled_values("beacon_processor_sheds_total"),
             "sse_sent": self._sse_sent_total(),
             "sse_drops": _counter_value(
                 "http_sse_slow_clients_dropped_total"
@@ -485,6 +707,9 @@ class _Fleet:
             "hash_read": self._read_path_compressions(),
         }
         gossip_submitted = 0
+        overload_submitted = 0
+        spells = cfg.spells() if cfg.overload_slots > 0 else ()
+        over_before = None
         t_start = time.perf_counter()
         self.start_sse()
         pool = ThreadPoolExecutor(max_workers=cfg.http_workers)
@@ -506,12 +731,75 @@ class _Fleet:
                         rng_http, self.slot, first=(i == 0)
                     )
                 ]
-                while self.node.processor.step():
-                    pass
+                self._drain()
                 wait(futures, timeout=cfg.request_timeout_s * 4)
+            # ------- overload phase: the seeded scheduler fault fleet
+            self._phase = "overload"
+            over_before = {
+                "received": _counter_value(
+                    "beacon_processor_work_received_total", queue=att
+                ),
+                "processed": _counter_value(
+                    "beacon_processor_work_processed_total", queue=att
+                ),
+                "sheds": self._labeled_values(
+                    "beacon_processor_sheds_total"
+                ),
+                "misses": self._labeled_values(
+                    "beacon_processor_deadline_misses_total"
+                ),
+            }
+            for j in range(cfg.overload_slots):
+                mult, stall_ms, budget = 1.0, 0.0, None
+                for sp in spells:
+                    if not sp.active(j):
+                        continue
+                    if sp.kind == "burst":
+                        mult *= sp.magnitude
+                    elif sp.kind == "worker_stall":
+                        stall_ms = max(stall_ms, sp.magnitude)
+                    elif sp.kind == "slow_consumer":
+                        budget = (
+                            sp.magnitude
+                            if budget is None
+                            else min(budget, sp.magnitude)
+                        )
+                self._stall_s = stall_ms / 1e3
+                restore = (
+                    self._install_step_budget(budget)
+                    if budget is not None
+                    else None
+                )
+                try:
+                    self.slot += 1
+                    self.sim.run_slot(self.slot)
+                    n = self._inject_gossip(
+                        rng_gossip, self.slot, multiplier=mult
+                    )
+                    gossip_submitted += n
+                    overload_submitted += n
+                    # critical work lands AFTER the burst: the drain
+                    # must serve it first anyway (priority chain)
+                    self._inject_critical()
+                    futures = [
+                        pool.submit(self._do_request, s)
+                        for s in self._slot_schedule(
+                            rng_http, self.slot, first=(j == 0)
+                        )
+                    ]
+                    self._drain()
+                    wait(futures, timeout=cfg.request_timeout_s * 4)
+                finally:
+                    if restore is not None:
+                        restore()
+                    self._stall_s = 0.0
+            # close the books before any counter is read: every
+            # submitted item ends processed or shed, exactly once
+            self._drain_fully()
         finally:
             pool.shutdown(wait=True)
             self.stop_sse()
+            self._phase = "steady"
         wall = time.perf_counter() - t_start
 
         endpoints = {}
@@ -561,6 +849,15 @@ class _Fleet:
             )
             - before["misses"]
         )
+        by_reason = {}
+        for (queue, reason), v in self._labeled_values(
+            "beacon_processor_sheds_total"
+        ).items():
+            if queue != att:
+                continue
+            d = v - before["sheds"].get((queue, reason), 0.0)
+            if d > 0:
+                by_reason[reason] = int(d)
         return LoadReport(
             seed=cfg.seed,
             vcs=cfg.vcs,
@@ -576,6 +873,7 @@ class _Fleet:
                 "received": int(received),
                 "dropped": int(dropped),
                 "rate": round(dropped / received, 6) if received else 0.0,
+                "by_reason": by_reason,
             },
             deadline={
                 "processed": int(processed),
@@ -603,7 +901,123 @@ class _Fleet:
                     if v - before["hash_read"].get(ep, 0.0) > 0
                 },
             },
+            overload=self._overload_section(
+                over_before, overload_submitted, spells
+            ),
         )
+
+    def _overload_section(
+        self, over_before, submitted: int, spells: tuple
+    ) -> dict:
+        """The graceful-degradation scoreboard for the overload phase:
+        per-queue sheds by reason, per-queue in-queue expiries, the
+        overload-phase duty SLO, and the condensed acceptance flags
+        (fresh_block_sheds == 0, critical_deadline_misses == 0,
+        order_ok, attestation_shed_rate > 0)."""
+        from ..node.beacon_processor import (
+            WORK_CLASS,
+            PriorityClass,
+            WorkType,
+        )
+
+        cfg = self.cfg
+        base = {
+            "slots": cfg.overload_slots,
+            "burst_multiplier": max(
+                [sp.magnitude for sp in spells if sp.kind == "burst"],
+                default=1.0,
+            ),
+            "spells": [asdict(sp) for sp in spells],
+            "gossip_submitted": int(submitted),
+        }
+        if over_before is None:  # overload disabled or replay aborted
+            base.update(
+                duty_response_ms=_pcts_ms([]),
+                sheds={},
+                deadline_misses={},
+                attestation_shed_rate=0.0,
+                fresh_block_sheds=0,
+                critical_deadline_misses=0,
+                critical_processed=0,
+                order_ok=False,
+            )
+            return base
+        att = WorkType.GOSSIP_ATTESTATION.name
+        sheds: dict = {}
+        for (queue, reason), v in self._labeled_values(
+            "beacon_processor_sheds_total"
+        ).items():
+            d = v - over_before["sheds"].get((queue, reason), 0.0)
+            if d > 0:
+                sheds.setdefault(queue, {})[reason] = int(d)
+        misses: dict = {}
+        for lv, v in self._labeled_values(
+            "beacon_processor_deadline_misses_total"
+        ).items():
+            d = v - over_before["misses"].get(lv, 0.0)
+            if d > 0:
+                misses[lv[0]] = int(d)
+        critical = {
+            t.name
+            for t, c in WORK_CLASS.items()
+            if c is PriorityClass.BLOCK_SYNC_CRITICAL
+        }
+        received = (
+            _counter_value(
+                "beacon_processor_work_received_total", queue=att
+            )
+            - over_before["received"]
+        )
+        att_shed = sum(sheds.get(att, {}).values())
+        with self._lock:
+            duty = list(self._duty_overload)
+            log = list(self._order_log)
+        base.update(
+            duty_response_ms=_pcts_ms(duty),
+            sheds=sheds,
+            deadline_misses=misses,
+            attestation_shed_rate=(
+                round(att_shed / received, 6) if received else 0.0
+            ),
+            fresh_block_sheds=sum(
+                n
+                for q, rs in sheds.items()
+                if q in critical
+                for n in rs.values()
+            ),
+            critical_deadline_misses=sum(
+                m for q, m in misses.items() if q in critical
+            ),
+            critical_processed=sum(
+                1 for kind, _s in log if kind in ("block", "segment")
+            ),
+            order_ok=self._order_ok(log),
+        )
+        return base
+
+    @staticmethod
+    def _order_ok(log: list) -> bool:
+        """Priority-chain proof from the execution-order log: within
+        each injection window (entries after a 'mark'), once an
+        attestation batch has been served no critical/aggregate item
+        may follow — everything above the attestation class that was
+        queued at injection time was served first."""
+        windows: list = []
+        cur = None
+        for kind, _slot in log:
+            if kind == "mark":
+                cur = []
+                windows.append(cur)
+            elif cur is not None:
+                cur.append(kind)
+        if not windows:
+            return False
+        for w in windows:
+            if "attestation" in w:
+                first_att = w.index("attestation")
+                if any(k != "attestation" for k in w[first_att:]):
+                    return False
+        return True
 
     @staticmethod
     def _sse_sent_total() -> float:
@@ -672,6 +1086,11 @@ def main(argv=None) -> int:
     ap.add_argument("--gossip-scale", type=float, default=1 / 64.0)
     ap.add_argument("--http-workers", type=int, default=8)
     ap.add_argument("--sse-subscribers", type=int, default=2)
+    ap.add_argument(
+        "--overload-slots", type=int, default=4,
+        help="length of the seeded 4x-overload fault-fleet phase "
+        "(0 disables)",
+    )
     args = ap.parse_args(argv)
     try:
         report = run_load(
@@ -684,6 +1103,7 @@ def main(argv=None) -> int:
                 gossip_scale=args.gossip_scale,
                 http_workers=args.http_workers,
                 sse_subscribers=args.sse_subscribers,
+                overload_slots=args.overload_slots,
             )
         )
     except LoadgenError as e:

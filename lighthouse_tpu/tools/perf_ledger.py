@@ -159,9 +159,15 @@ def row_from_bench(doc: dict, source: str = "bench.py") -> dict:
             row["epoch_warm_s"] = warm
     load = detail.get("load", {})
     if isinstance(load, dict):
-        # LoadReport v1 shape (lighthouse_tpu/tools/loadgen.py):
-        # duty_response_ms.{p50,p95,p99}, shed.rate, deadline.rate
+        # LoadReport shape (lighthouse_tpu/tools/loadgen.py):
+        # duty_response_ms.{p50,p95,p99}, shed.rate, deadline.rate,
+        # overload.{duty_response_ms,attestation_shed_rate,...}
         sub = {}
+        # the report schema names the shedding policy generation —
+        # compare() only diffs load rates between same-schema rounds
+        # (a policy change is a new baseline, not a regression)
+        if load.get("schema"):
+            sub["scenario"] = load["schema"]
         duty = load.get("duty_response_ms")
         if isinstance(duty, dict) and duty.get("p99") is not None:
             sub["duty_p99_s"] = round(float(duty["p99"]) / 1000.0, 6)
@@ -171,6 +177,23 @@ def row_from_bench(doc: dict, source: str = "bench.py") -> dict:
         dl = load.get("deadline")
         if isinstance(dl, dict) and dl.get("rate") is not None:
             sub["deadline_miss_rate"] = dl["rate"]
+        over = load.get("overload")
+        if isinstance(over, dict):
+            oduty = over.get("duty_response_ms")
+            if isinstance(oduty, dict) and oduty.get("p99") is not None:
+                sub["overload_duty_p99_s"] = round(
+                    float(oduty["p99"]) / 1000.0, 6
+                )
+            if over.get("attestation_shed_rate") is not None:
+                sub["overload_att_shed_rate"] = over[
+                    "attestation_shed_rate"
+                ]
+            if over.get("fresh_block_sheds") is not None:
+                sub["fresh_block_sheds"] = over["fresh_block_sheds"]
+            if over.get("critical_deadline_misses") is not None:
+                sub["critical_deadline_misses"] = over[
+                    "critical_deadline_misses"
+                ]
         if sub:
             row["load"] = sub
     sc = detail.get("scenarios", {})
@@ -195,7 +218,8 @@ def row_from_bench(doc: dict, source: str = "bench.py") -> dict:
 # ------------------------------------------------------------------ compare
 
 # (dotted path, label, kind): kind "time" = lower is better, "rate" =
-# higher is better, "count" = lower is better and exact (op census)
+# higher is better, "count" = lower is better and exact (op census),
+# "ratio" = lower is better, unitless (shed / deadline-miss rates)
 COMPARE_FIELDS = (
     # absolute floors sized ~2x the warm steady-state values so shared-
     # CI scheduling noise cannot flap the gate; decays at this scale
@@ -203,6 +227,20 @@ COMPARE_FIELDS = (
     ("epoch_warm_s.250k", "epoch warm @250k", "time", 0.08),
     ("epoch_warm_s.500k", "epoch warm @500k", "time", 0.12),
     ("load.duty_p99_s", "load duty p99", "time", 0.05),
+    # ISSUE 13: round-over-round scheduler regressions at the fixed
+    # loadgen seed — shedding more, or aging more work past deadline,
+    # at the same offered load is a scheduler decay. Compared only
+    # between rounds sharing load.scenario (see compare()).
+    ("load.shed_rate", "load shed rate", "ratio", 0.02),
+    ("load.deadline_miss_rate", "load deadline-miss rate", "ratio", 0.02),
+    ("load.overload_duty_p99_s", "overload duty p99", "time", 0.05),
+    ("load.overload_att_shed_rate", "overload attestation shed rate",
+     "ratio", 0.02),
+    # block/sync-critical queues must NEVER shed or age out under the
+    # seeded overload: exact, any increase fails
+    ("load.fresh_block_sheds", "overload fresh-block sheds", "count", 0.0),
+    ("load.critical_deadline_misses",
+     "overload critical deadline misses", "count", 0.0),
     ("kernel.4096.fp_muls_per_set", "fp-muls/set @4096", "count", 0.0),
     ("kernel.1024.fp_muls_per_set", "fp-muls/set @1024", "count", 0.0),
     ("kernel.128.fp_muls_per_set", "fp-muls/set @128", "count", 0.0),
@@ -233,20 +271,36 @@ def compare(prev: dict, cur: dict, rel_tol: float = 0.20) -> list:
     from flapping the gate; op counts are exact — any increase flags).
     Returns human-readable problem strings."""
     problems = []
+    load_scenarios_differ = (prev.get("load") or {}).get("scenario") != (
+        (cur.get("load") or {}).get("scenario")
+    )
     for dotted, label, kind, floor in COMPARE_FIELDS:
         a, b = _dig(prev, dotted), _dig(cur, dotted)
         if a is None or b is None:
             continue
+        # load rates are only comparable within one shedding-policy
+        # generation (load.scenario): a policy change re-baselines the
+        # curves instead of flagging as a regression
+        if dotted.startswith("load.") and load_scenarios_differ:
+            continue
         if kind == "count":
             if b > a:
                 problems.append(
-                    f"{label}: {a} -> {b} (+{b - a} ops; op counts are "
-                    f"exact — this is a kernel regression)"
+                    f"{label}: {a} -> {b} (+{b - a}; op counts are "
+                    f"exact — any increase is a regression)"
                 )
         elif kind == "time":
             if b > a * (1 + rel_tol) and (b - a) > floor:
                 problems.append(
                     f"{label}: {a:.4g}s -> {b:.4g}s "
+                    f"(+{(b / a - 1) * 100:.0f}%)"
+                )
+        elif kind == "ratio":
+            # lower is better; the absolute floor absorbs seeded-but-
+            # timing-adjacent jitter (in-queue expiry counts)
+            if b > a * (1 + rel_tol) and (b - a) > floor:
+                problems.append(
+                    f"{label}: {a:.4g} -> {b:.4g} "
                     f"(+{(b / a - 1) * 100:.0f}%)"
                 )
         elif kind == "rate":

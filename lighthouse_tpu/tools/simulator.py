@@ -47,6 +47,7 @@ tier-1; tests/test_simulator.py keeps the slow mainnet-preset runs.
 from __future__ import annotations
 
 import random
+import time
 from dataclasses import dataclass, field, replace
 from typing import Optional
 
@@ -55,8 +56,9 @@ from ..consensus import types as T
 from ..consensus.spec import MAINNET_PRESET, ChainSpec, mainnet_spec
 from ..crypto.bls.keys import SecretKey
 from ..node.beacon_chain import BeaconChain
-from ..node.beacon_processor import BeaconProcessor
+from ..node.beacon_processor import BeaconProcessor, BeaconProcessorConfig
 from ..network.gossip import (
+    TOPIC_AGGREGATE,
     TOPIC_ATTESTATION_SUBNET,
     TOPIC_BLOCK,
     topic_for,
@@ -112,6 +114,12 @@ class GossipBeaconNode(InProcessBeaconNode):
         self.chain.process_block(signed_block)
         self.nbp.publish_block(signed_block)
 
+    def publish_aggregate(self, signed_aggregate):
+        super().publish_aggregate(signed_aggregate)  # local verify + pools
+        # fan out over the aggregate topic: peers route it through the
+        # AGGREGATE priority lane (class 1) of their schedulers
+        self.nbp.publish_aggregate(signed_aggregate)
+
     def publish_attestation(self, attestation):
         super().publish_attestation(attestation)  # local pipeline
         state = self.chain.head_state()
@@ -150,9 +158,18 @@ class SimNode:
         self.chain = chain if chain is not None else BeaconChain(
             spec, genesis_state, bls_backend="fake"
         )
-        self.processor = BeaconProcessor()
+        # validator-count-derived queue capacities (dwarf fleets land
+        # on the floors; the priority chain is what the scenarios test)
+        self.processor = BeaconProcessor(
+            BeaconProcessorConfig.for_validator_count(
+                len(genesis_state.validators) if genesis_state is not None
+                else 0,
+                slots_per_epoch=spec.preset.slots_per_epoch,
+            )
+        )
         self.service = NetworkService(hub, name)
         self.service.subscribe(topic_for(TOPIC_BLOCK, fork_digest))
+        self.service.subscribe(topic_for(TOPIC_AGGREGATE, fork_digest))
         for subnet in range(ATTESTATION_SUBNET_COUNT):
             self.service.subscribe(
                 topic_for(TOPIC_ATTESTATION_SUBNET, fork_digest, subnet)
@@ -177,6 +194,9 @@ class SimNode:
         for ev in self.service.poll():
             self.nbp.handle_gossip(ev.peer_id, ev.topic, ev.data)
             n += 1
+        # bounced sync-critical work (bounded retry-with-requeue)
+        # re-enters the live queues before the drain
+        n += self.processor.pump_reprocess(time.perf_counter())
         while self.processor.step():
             n += 1
         return n

@@ -1,13 +1,54 @@
 """Scheduler policy tests (beacon_processor analog): priority order,
 LIFO freshness, batch formation, poisoning fallback, backpressure,
-reprocessing — mirroring network_beacon_processor/tests.rs assertions."""
+reprocessing — mirroring network_beacon_processor/tests.rs assertions —
+plus the ISSUE 13 overload-first contract: the explicit priority-class
+chain, validator-scaled capacities, deadline-aware shedding at enqueue
+AND dequeue, bounded retry-with-requeue, and a randomized property
+suite (strict class ordering under contention, no starvation, exact
+shed accounting)."""
 
+import random
+import time
+
+from lighthouse_tpu.common import metrics
 from lighthouse_tpu.node.beacon_processor import (
+    DEFAULT_ATTEMPT_CAPS,
+    WORK_CLASS,
     BeaconProcessor,
     BeaconProcessorConfig,
+    PriorityClass,
     Work,
     WorkType,
+    derived_queue_capacities,
 )
+
+
+def _val(name, **labels):
+    fam = metrics.get(name)
+    if fam is None:
+        return 0.0
+    try:
+        return fam.labels(**labels).value if labels else fam.value
+    except Exception:
+        return 0.0
+
+
+def _queue_deltas(name, before, labelname="queue"):
+    """Per-child deltas of a labeled counter family vs a snapshot."""
+    fam = metrics.get(name)
+    out = {}
+    for lv in fam.label_values():
+        d = fam.labels(*lv).value - before.get(lv, 0.0)
+        if d:
+            out[lv] = d
+    return out
+
+
+def _snapshot(name):
+    fam = metrics.get(name)
+    if fam is None:
+        return {}
+    return {lv: fam.labels(*lv).value for lv in fam.label_values()}
 
 
 def test_priority_order():
@@ -111,3 +152,498 @@ def test_reprocessing_queue():
 def test_validator_count_scaling():
     cfg = BeaconProcessorConfig.for_validator_count(500_000)
     assert cfg.queue_capacities[WorkType.GOSSIP_ATTESTATION] == 500_000 // 32
+
+
+# ------------------------------------------------ ISSUE 13: the chain
+
+
+def test_priority_chain_aggregates_above_duty_api():
+    """The documented chain: block/sync-critical > aggregates >
+    API/duty-critical > unaggregated attestations > backfill — NOT the
+    enum declaration order (API_P0 declares below GOSSIP_BLOCK but
+    above GOSSIP_AGGREGATE)."""
+    bp = BeaconProcessor()
+    log = []
+    for kind in [
+        WorkType.API_REQUEST_P1,
+        WorkType.GOSSIP_ATTESTATION,
+        WorkType.API_REQUEST_P0,
+        WorkType.GOSSIP_AGGREGATE,
+        WorkType.GOSSIP_SYNC_CONTRIBUTION,
+        WorkType.GOSSIP_BLOCK,
+        WorkType.CHAIN_SEGMENT_BACKFILL,
+    ]:
+        bp.submit(
+            Work(kind=kind, process_individual=lambda p, k=kind: log.append(k))
+        )
+    while bp.step():
+        pass
+    assert log == [
+        WorkType.GOSSIP_BLOCK,
+        WorkType.GOSSIP_AGGREGATE,
+        WorkType.GOSSIP_SYNC_CONTRIBUTION,
+        WorkType.API_REQUEST_P0,
+        WorkType.GOSSIP_ATTESTATION,
+        WorkType.API_REQUEST_P1,
+        WorkType.CHAIN_SEGMENT_BACKFILL,
+    ]
+
+
+def test_every_worktype_has_a_class_and_derived_capacity():
+    caps_250k = derived_queue_capacities(250_000)
+    caps_1m = derived_queue_capacities(1_000_000)
+    for t in WorkType:
+        assert t in WORK_CLASS, t
+        assert t in caps_250k and t in caps_1m, t
+    # the validator-scaled lane actually scales; fixed lanes don't
+    assert caps_250k[WorkType.GOSSIP_ATTESTATION] == 250_000 // 32
+    assert caps_1m[WorkType.GOSSIP_ATTESTATION] == 1_000_000 // 32
+    assert caps_250k[WorkType.GOSSIP_AGGREGATE] == caps_1m[
+        WorkType.GOSSIP_AGGREGATE
+    ]
+    # floors hold on dwarf fleets
+    assert derived_queue_capacities(16)[WorkType.GOSSIP_ATTESTATION] == 1024
+
+
+# ----------------------------------- ISSUE 13: deadline-aware shedding
+
+
+def test_expired_work_shed_at_enqueue():
+    """Dead-on-arrival work never occupies queue capacity: shed at the
+    door with reason=expired, on_shed runs, submit returns False."""
+    bp = BeaconProcessor()
+    shed_log = []
+    before = _val(
+        "beacon_processor_sheds_total",
+        queue="GOSSIP_ATTESTATION",
+        reason="expired",
+    )
+    ok = bp.submit(
+        Work(
+            kind=WorkType.GOSSIP_ATTESTATION,
+            process_individual=lambda p: None,
+            deadline=time.perf_counter() - 1.0,
+            on_shed=lambda w, r: shed_log.append(r),
+        )
+    )
+    assert ok is False
+    assert shed_log == ["expired"]
+    assert bp.queue_lengths() == {}
+    assert (
+        _val(
+            "beacon_processor_sheds_total",
+            queue="GOSSIP_ATTESTATION",
+            reason="expired",
+        )
+        == before + 1
+    )
+    # DOA is not a deadline MISS — it never aged in-queue
+    assert not bp.step()
+
+
+def test_full_lifo_queue_evicts_expired_then_oldest_not_the_fresh():
+    """Satellite 2 in isolation: submit() on a full LIFO queue evicts
+    the STALE end — already-expired entries first, then the oldest live
+    entry — and always admits the fresh arrival."""
+    bp = BeaconProcessor(
+        BeaconProcessorConfig(
+            queue_capacities={WorkType.GOSSIP_ATTESTATION: 2},
+            max_gossip_attestation_batch_size=10,
+        )
+    )
+    got = []
+    misses0 = _val(
+        "beacon_processor_deadline_misses_total", queue="GOSSIP_ATTESTATION"
+    )
+    now = time.perf_counter()
+    # an already-expired entry sits at the stale end of a full queue
+    # (admitted fresh, expired while queued)
+    bp.submit(
+        Work(
+            kind=WorkType.GOSSIP_ATTESTATION,
+            payload="stale",
+            process_individual=lambda p: got.append(p),
+            deadline=now + 0.005,
+        )
+    )
+    bp.submit(
+        Work(
+            kind=WorkType.GOSSIP_ATTESTATION,
+            payload="live_old",
+            process_individual=lambda p: got.append(p),
+            deadline=now + 60.0,
+        )
+    )
+    time.sleep(0.01)  # the first entry expires IN-QUEUE
+    assert bp.submit(
+        Work(
+            kind=WorkType.GOSSIP_ATTESTATION,
+            payload="fresh",
+            process_individual=lambda p: got.append(p),
+            deadline=time.perf_counter() + 60.0,
+        )
+    )
+    # the expired entry was evicted (counted as an in-queue miss), the
+    # live-old entry kept, the fresh one admitted
+    assert bp.queue_lengths() == {"GOSSIP_ATTESTATION": 2}
+    assert (
+        _val(
+            "beacon_processor_deadline_misses_total",
+            queue="GOSSIP_ATTESTATION",
+        )
+        == misses0 + 1
+    )
+    while bp.step():
+        pass
+    assert sorted(got) == ["fresh", "live_old"]
+
+
+def test_full_lifo_eviction_sweeps_expired_behind_a_live_front():
+    """The eviction sweep finds expired entries WHEREVER they sit: a
+    live oldest entry must not be shed as 'capacity' while an expired
+    entry squats mid-queue."""
+    bp = BeaconProcessor(
+        BeaconProcessorConfig(
+            queue_capacities={WorkType.GOSSIP_ATTESTATION: 3},
+            max_gossip_attestation_batch_size=10,
+        )
+    )
+    got = []
+    cap_before = _val(
+        "beacon_processor_sheds_total",
+        queue="GOSSIP_ATTESTATION",
+        reason="capacity",
+    )
+    now = time.perf_counter()
+    # front of the queue is LIVE; the expired entry sits behind it
+    for payload, dl in [
+        ("live_front", now + 60.0),
+        ("expiring_mid", now + 0.005),
+        ("live_back", now + 60.0),
+    ]:
+        bp.submit(
+            Work(
+                kind=WorkType.GOSSIP_ATTESTATION,
+                payload=payload,
+                process_individual=lambda p: got.append(p),
+                deadline=dl,
+            )
+        )
+    time.sleep(0.01)  # the mid entry expires in-queue
+    assert bp.submit(
+        Work(
+            kind=WorkType.GOSSIP_ATTESTATION,
+            payload="fresh",
+            process_individual=lambda p: got.append(p),
+            deadline=time.perf_counter() + 60.0,
+        )
+    )
+    # the expired mid entry was swept (reason=expired), NOT the live
+    # front (reason=capacity) — nothing was capacity-evicted at all
+    assert (
+        _val(
+            "beacon_processor_sheds_total",
+            queue="GOSSIP_ATTESTATION",
+            reason="capacity",
+        )
+        == cap_before
+    )
+    while bp.step():
+        pass
+    assert sorted(got) == ["fresh", "live_back", "live_front"]
+
+
+def test_dequeue_recheck_sheds_aged_work():
+    """Work that expires while queued is shed at dequeue (counted as
+    shed expired + deadline miss), never served late; the batch former
+    skips it and still serves the live remainder."""
+    bp = BeaconProcessor(
+        BeaconProcessorConfig(max_gossip_attestation_batch_size=10)
+    )
+    served = []
+    shed_before = _val(
+        "beacon_processor_sheds_total",
+        queue="GOSSIP_ATTESTATION",
+        reason="expired",
+    )
+    miss_before = _val(
+        "beacon_processor_deadline_misses_total", queue="GOSSIP_ATTESTATION"
+    )
+    now = time.perf_counter()
+    for i, dl in enumerate([now + 0.005, now + 60.0, now + 0.005]):
+        bp.submit(
+            Work(
+                kind=WorkType.GOSSIP_ATTESTATION,
+                payload=i,
+                process_individual=lambda p: served.append(p),
+                process_batch=lambda ps: served.extend(ps) or True,
+                deadline=dl,
+            )
+        )
+    time.sleep(0.01)
+    assert bp.step()
+    assert served == [1]
+    assert (
+        _val(
+            "beacon_processor_sheds_total",
+            queue="GOSSIP_ATTESTATION",
+            reason="expired",
+        )
+        == shed_before + 2
+    )
+    assert (
+        _val(
+            "beacon_processor_deadline_misses_total",
+            queue="GOSSIP_ATTESTATION",
+        )
+        == miss_before + 2
+    )
+    assert not bp.step()
+
+
+# ------------------------------ ISSUE 13: bounded retry-with-requeue
+
+
+def test_fifo_backpressure_bounces_through_reprocess_heap():
+    """A full sync-critical FIFO lane no longer makes callers hand-roll
+    re-queue loops: submit() returns True, the work bounces via the
+    reprocess heap, and lands once capacity frees up."""
+    bp = BeaconProcessor(
+        BeaconProcessorConfig(queue_capacities={WorkType.CHAIN_SEGMENT: 1})
+    )
+    log = []
+    assert bp.submit(
+        Work(
+            kind=WorkType.CHAIN_SEGMENT,
+            process_individual=lambda p: log.append("first"),
+        )
+    )
+    retries0 = _val(
+        "beacon_processor_work_retries_total", queue="CHAIN_SEGMENT"
+    )
+    assert bp.submit(  # full: bounces instead of rejecting
+        Work(
+            kind=WorkType.CHAIN_SEGMENT,
+            process_individual=lambda p: log.append("second"),
+        )
+    )
+    assert (
+        _val("beacon_processor_work_retries_total", queue="CHAIN_SEGMENT")
+        == retries0 + 1
+    )
+    assert bp.pending_reprocess() == 1
+    assert bp.step()  # frees the slot
+    assert bp.pump_reprocess(time.perf_counter() + 1.0) == 1
+    assert bp.step()
+    assert log == ["first", "second"]
+    assert bp.pending_reprocess() == 0
+
+
+def test_fifo_backpressure_terminal_shed_past_attempt_cap():
+    """Past the per-queue attempt cap the work sheds terminally
+    (reason=backpressure) and on_shed releases the caller's state."""
+    bp = BeaconProcessor(
+        BeaconProcessorConfig(
+            queue_capacities={WorkType.CHAIN_SEGMENT: 1},
+            max_attempts={WorkType.CHAIN_SEGMENT: 2},
+        )
+    )
+    bp.submit(
+        Work(kind=WorkType.CHAIN_SEGMENT, process_individual=lambda p: None)
+    )
+    shed_log = []
+    w = Work(
+        kind=WorkType.CHAIN_SEGMENT,
+        process_individual=lambda p: None,
+        on_shed=lambda _w, r: shed_log.append(r),
+    )
+    assert bp.submit(w)  # attempt 1 -> bounce
+    assert shed_log == []
+    # the queue is still full when the bounce lands: terminal
+    assert bp.pump_reprocess(time.perf_counter() + 1.0) == 1
+    assert shed_log == ["backpressure"]
+
+
+def test_raising_handler_retries_then_sheds_failed():
+    """A raising handler re-enters via the reprocess heap up to the
+    attempt cap, then sheds terminally (reason=failed) without killing
+    the worker loop."""
+    calls = []
+    shed_log = []
+
+    def flaky_then_ok(p):
+        calls.append("a")
+        if len(calls) < 2:
+            raise RuntimeError("transient")
+
+    bp = BeaconProcessor(
+        BeaconProcessorConfig(max_attempts={WorkType.RPC_BLOCK: 3})
+    )
+    bp.submit(Work(kind=WorkType.RPC_BLOCK, process_individual=flaky_then_ok))
+    assert bp.step()  # raises -> requeued
+    assert not bp.step()
+    assert bp.pump_reprocess(time.perf_counter() + 1.0) == 1
+    assert bp.step()  # succeeds
+    assert len(calls) == 2
+
+    def always_raises(p):
+        raise RuntimeError("permanent")
+
+    failed0 = _val(
+        "beacon_processor_sheds_total", queue="RPC_BLOCK", reason="failed"
+    )
+    bp.submit(
+        Work(
+            kind=WorkType.RPC_BLOCK,
+            process_individual=always_raises,
+            on_shed=lambda _w, r: shed_log.append(r),
+        )
+    )
+    for _ in range(3):
+        bp.pump_reprocess(time.perf_counter() + 10.0)
+        while bp.step():
+            pass
+    assert shed_log == ["failed"]
+    assert (
+        _val(
+            "beacon_processor_sheds_total", queue="RPC_BLOCK", reason="failed"
+        )
+        == failed0 + 1
+    )
+
+
+def test_poisoned_batch_fallback_survives_raising_item():
+    """One raising item inside the individual fallback no longer skips
+    the rest of the batch (or kills the worker): the bad item retries/
+    sheds on its own, the others complete."""
+    bp = BeaconProcessor(BeaconProcessorConfig(default_max_attempts=1))
+    seen = []
+
+    def make_individual(i):
+        def run(p):
+            if i == 1:
+                raise RuntimeError("boom")
+            seen.append(p)
+
+        return run
+
+    for i in range(4):
+        bp.submit(
+            Work(
+                kind=WorkType.GOSSIP_ATTESTATION,
+                payload=i,
+                process_individual=make_individual(i),
+                process_batch=lambda ps: False,  # poisoned
+            )
+        )
+    assert bp.step()
+    assert sorted(seen) == [0, 2, 3]
+
+
+# --------------------------------------- ISSUE 13: the property suite
+
+
+def test_property_class_ordering_starvation_and_shed_accounting():
+    """Randomized arrival orders through the scheduler:
+
+    1. STRICT CLASS ORDERING under contention — every pop serves the
+       first nonempty queue in priority order;
+    2. NO STARVATION — with higher classes below capacity, every
+       admitted item of the lowest class is eventually served;
+    3. EXACT ACCOUNTING — received == processed + shed per queue, with
+       sheds split by reason summing to the per-queue drop counter.
+    """
+    from lighthouse_tpu.node.beacon_processor import _PRIORITY_ORDER
+
+    rng = random.Random(0xC0FFEE)
+    for _trial in range(8):
+        caps = {t: rng.choice([2, 3, 5, 8]) for t in WorkType}
+        # the lowest class stays below capacity: starvation would show
+        # up as submitted-but-never-processed backfill items
+        caps[WorkType.CHAIN_SEGMENT_BACKFILL] = 10_000
+        caps[WorkType.API_REQUEST_P1] = 10_000
+        bp = BeaconProcessor(
+            BeaconProcessorConfig(
+                queue_capacities=caps,
+                max_gossip_attestation_batch_size=4,
+                max_gossip_aggregate_batch_size=4,
+                max_attempts={},  # terminal backpressure, no bouncing
+            )
+        )
+        rec0 = _snapshot("beacon_processor_work_received_total")
+        proc0 = _snapshot("beacon_processor_work_processed_total")
+        drop0 = _snapshot("beacon_processor_work_dropped_total")
+        shed0 = _snapshot("beacon_processor_sheds_total")
+        processed = []
+        kinds = list(WorkType)
+        n_items = rng.randrange(60, 160)
+        submitted = {t: 0 for t in WorkType}
+        for _ in range(n_items):
+            kind = rng.choice(kinds)
+            submitted[kind] += 1
+            is_batch = kind in (
+                WorkType.GOSSIP_ATTESTATION,
+                WorkType.GOSSIP_AGGREGATE,
+            )
+            bp.submit(
+                Work(
+                    kind=kind,
+                    payload=kind,
+                    process_individual=lambda p: processed.append(p),
+                    process_batch=(
+                        (lambda ps: processed.extend(ps) or True)
+                        if is_batch
+                        else None
+                    ),
+                )
+            )
+            # interleave pops with arrivals: contention, not a drain
+            if rng.random() < 0.3:
+                _assert_strict_pop(bp, processed)
+        while _assert_strict_pop(bp, processed):
+            pass
+        assert bp.queue_lengths() == {}
+        assert bp.pending_reprocess() == 0
+        rec = _queue_deltas("beacon_processor_work_received_total", rec0)
+        done = _queue_deltas("beacon_processor_work_processed_total", proc0)
+        drop = _queue_deltas("beacon_processor_work_dropped_total", drop0)
+        shed = _queue_deltas("beacon_processor_sheds_total", shed0)
+        for t in WorkType:
+            lv = (t.name,)
+            assert rec.get(lv, 0) == submitted[t], t
+            # every submitted-but-unprocessed item is accounted a shed
+            assert (
+                done.get(lv, 0) + drop.get(lv, 0) == submitted[t]
+            ), (t, done.get(lv), drop.get(lv))
+            # the reason split sums to the per-queue drop counter
+            assert (
+                sum(v for k, v in shed.items() if k[0] == t.name)
+                == drop.get(lv, 0)
+            ), t
+        # no starvation: the below-capacity lowest class fully served
+        for t in (WorkType.CHAIN_SEGMENT_BACKFILL, WorkType.API_REQUEST_P1):
+            assert done.get((t.name,), 0) == submitted[t], t
+        # sanity: the trial actually exercised priority order
+        assert _PRIORITY_ORDER[0] is WorkType.CHAIN_SEGMENT
+
+
+def _assert_strict_pop(bp, processed) -> bool:
+    """One step(); asserts the served queue was the first nonempty one
+    in priority order at pop time."""
+    from lighthouse_tpu.node.beacon_processor import _PRIORITY_ORDER
+
+    depths = bp.queue_lengths()
+    if not depths:
+        return bp.step()
+    expected = next(
+        (t for t in _PRIORITY_ORDER if t.name in depths), None
+    )
+    mark = len(processed)
+    stepped = bp.step()
+    if not stepped:
+        return False
+    newly = processed[mark:]
+    assert newly, "a step served nothing despite nonempty queues"
+    served_kinds = {w for w in newly}
+    assert served_kinds == {expected}, (served_kinds, expected, depths)
+    return True

@@ -463,6 +463,9 @@ def test_account_validator_exit_cli(api, tmp_path, monkeypatch):
     the chain-verified domain, publish through the REST pool route, and
     land in the op pool."""
     client, base = api
+    # keystore decryption needs the `cryptography` module, absent in
+    # some containers — skip cleanly (the failure class PR 12 noted)
+    pytest.importorskip("cryptography")
     from lighthouse_tpu.cli import main as cli_main
     from lighthouse_tpu.crypto.keystore.keystore import Keystore
 

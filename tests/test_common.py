@@ -8,6 +8,9 @@ import time
 
 import pytest
 
+# this container may lack the `cryptography` module (keystore/
+# discv5 AES-GCM): skip cleanly instead of erroring at collection
+pytest.importorskip("cryptography")
 from lighthouse_tpu.common import logging as clog
 from lighthouse_tpu.common import system_health
 from lighthouse_tpu.common.eth2 import ApiClientError, BeaconNodeHttpClient

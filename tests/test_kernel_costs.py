@@ -247,6 +247,97 @@ def test_ledger_compare_regressions():
     assert L.compare(prev, dead) == []
 
 
+def _overload_load_doc(shed_rate=0.01, miss_rate=0.02, over_p99=40.0,
+                       fresh_sheds=0, crit_misses=0):
+    """A detail.load in the v2 (overload-first scheduler) shape."""
+    return {
+        "schema": "lighthouse-tpu/load-report/v2",
+        "duty_response_ms": {"p50": 5.0, "p99": 50.0},
+        "shed": {"rate": shed_rate},
+        "deadline": {"rate": miss_rate},
+        "overload": {
+            "duty_response_ms": {"p99": over_p99},
+            "attestation_shed_rate": 0.8,
+            "fresh_block_sheds": fresh_sheds,
+            "critical_deadline_misses": crit_misses,
+        },
+    }
+
+
+def test_ledger_shed_and_deadline_regression_gate():
+    """ISSUE 13: round-over-round shed-rate / deadline-miss-rate /
+    critical-shed regressions at the fixed loadgen seed flag exactly
+    like the op-count gate."""
+    doc = _bench_doc(500.0)
+    doc["detail"]["load"] = _overload_load_doc()
+    prev = L.row_from_bench(doc, source="a")
+    assert prev["load"]["scenario"] == "lighthouse-tpu/load-report/v2"
+    assert prev["load"]["overload_duty_p99_s"] == 0.04
+    assert prev["load"]["fresh_block_sheds"] == 0
+    cur = json.loads(json.dumps(prev))
+    assert L.compare(prev, cur) == []
+    # shedding more at the same offered load flags
+    bad = json.loads(json.dumps(prev))
+    bad["load"]["shed_rate"] = 0.10
+    assert any("load shed rate" in p for p in L.compare(prev, bad))
+    # aging more work past deadline flags
+    bad = json.loads(json.dumps(prev))
+    bad["load"]["deadline_miss_rate"] = 0.2
+    assert any("deadline-miss" in p for p in L.compare(prev, bad))
+    # ONE fresh-block shed under overload is exact-gated
+    bad = json.loads(json.dumps(prev))
+    bad["load"]["fresh_block_sheds"] = 1
+    assert any("fresh-block sheds" in p for p in L.compare(prev, bad))
+    bad = json.loads(json.dumps(prev))
+    bad["load"]["critical_deadline_misses"] = 2
+    assert any(
+        "critical deadline misses" in p for p in L.compare(prev, bad)
+    )
+    # sub-floor jitter does not flap the gate (in-queue expiry counts
+    # are seeded but timing-adjacent)
+    noise = json.loads(json.dumps(prev))
+    noise["load"]["shed_rate"] = 0.015  # +50% but +0.005 < 0.02 floor
+    assert not any("shed rate" in p for p in L.compare(prev, noise))
+
+
+def test_ledger_load_rates_not_compared_across_scenarios():
+    """A shedding-policy change re-baselines the curves: load rates
+    are only diffed between rounds sharing load.scenario (the v1 rows
+    in the ledger measured a different policy)."""
+    doc_v2 = _bench_doc(500.0)
+    doc_v2["detail"]["load"] = _overload_load_doc(shed_rate=0.9)
+    cur = L.row_from_bench(doc_v2, source="new")
+    prev = L.row_from_bench(_bench_doc(500.0), source="old")  # v1 shape
+    assert prev["load"].get("scenario") is None
+    assert not any("shed rate" in p for p in L.compare(prev, cur))
+    # non-load fields still compare across the boundary
+    slow = json.loads(json.dumps(cur))
+    slow["epoch_warm_s"]["250k"] = 0.5
+    assert any("epoch warm @250k" in p for p in L.compare(prev, slow))
+
+
+def test_bench_gate_shed_regression_fixture(tmp_path):
+    """The shed gate end to end through tools/bench_gate.py, fixture-
+    driven like the op-count gate."""
+    sys.path.insert(0, os.path.join(_REPO, "tools"))
+    import bench_gate
+
+    path = str(tmp_path / "PERF.jsonl")
+    doc = _bench_doc(500.0)
+    doc["detail"]["load"] = _overload_load_doc()
+    L.append(L.row_from_bench(doc, source="r1"), path)
+    good = _bench_doc(505.0)
+    good["detail"]["load"] = _overload_load_doc()
+    L.append(L.row_from_bench(good, source="r2"), path)
+    assert bench_gate.gate(path) == []
+    bad = _bench_doc(505.0)
+    bad["detail"]["load"] = _overload_load_doc(shed_rate=0.2, fresh_sheds=3)
+    L.append(L.row_from_bench(bad, source="r3"), path)
+    problems = bench_gate.gate(path)
+    assert any("load shed rate" in p for p in problems)
+    assert any("fresh-block sheds" in p for p in problems)
+
+
 def test_bench_gate_fixture(tmp_path):
     sys.path.insert(0, os.path.join(_REPO, "tools"))
     import bench_gate

@@ -31,6 +31,10 @@ def _small_cfg(**kw):
         gossip_scale=1 / 64.0,
         http_workers=6,
         sse_subscribers=2,
+        # 3 overload slots is the smallest shape that exercises every
+        # spell kind (burst on [0,3), stall+slow-consumer on [1,2))
+        # plus a recovery slot — tier-1 wall clock matters
+        overload_slots=3,
     )
     base.update(kw)
     return loadgen.LoadgenConfig(**base)
@@ -56,11 +60,12 @@ def test_report_schema_validates(small_report):
 
 
 def test_slo_p99_duty_response_under_budget(small_report):
-    """The tier-1 SLO smoke gate: duty pulls are what a million VCs
-    block on — p99 must stay under a generous CI-safe budget."""
+    """The tier-1 SLO gate, RATCHETED (ISSUE 13): duty pulls are what a
+    million VCs block on — p99 must hold 250 ms (was 2 s; observed
+    ~25-60 ms) with the overload phase included in the replay."""
     duty = small_report["duty_response_ms"]
     assert duty["count"] > 0, "no duty requests were replayed"
-    assert duty["p99"] is not None and duty["p99"] < 2000.0, duty
+    assert duty["p99"] is not None and duty["p99"] <= 250.0, duty
     # every duty endpoint appears in the per-endpoint table
     for ep in loadgen.DUTY_ENDPOINTS:
         assert ep in small_report["endpoints"], ep
@@ -94,19 +99,75 @@ def test_read_path_hashing_attributed(small_report):
 
 
 def test_shed_and_deadline_rates_have_denominators(small_report):
-    """The burst overflows the bounded attestation queue and a seeded
-    fraction arrives stale: both regression curves get known-nonzero
-    numerators AND denominators."""
+    """The burst overflows the bounded attestation queue, a seeded
+    fraction arrives already expired (shed at the door) and another
+    expires in-queue (dequeue sheds + deadline misses): both regression
+    curves get known-nonzero numerators AND denominators, split by
+    reason."""
     shed = small_report["shed"]
     assert shed["received"] == small_report["gossip_submitted"]
     assert shed["dropped"] > 0
     assert 0.0 < shed["rate"] < 1.0
+    # ISSUE 13: the reason split accounts for every drop — expired
+    # (DOA + in-queue) and capacity evictions both deterministic
+    by_reason = shed["by_reason"]
+    assert by_reason.get("expired", 0) > 0
+    assert by_reason.get("capacity", 0) > 0
+    assert sum(by_reason.values()) == shed["dropped"]
     dl = small_report["deadline"]
     assert dl["processed"] > 0
     assert dl["misses"] > 0
     assert 0.0 < dl["rate"] < 1.0
-    # LIFO shed accounting: everything not dropped was processed
+    # exact accounting after the closing drain: every submitted item
+    # was processed or shed, exactly once
     assert dl["processed"] == shed["received"] - shed["dropped"]
+
+
+def test_overload_graceful_degradation(small_report):
+    """The ISSUE 13 acceptance gates: under the seeded 4x overload with
+    worker-stall + slow-consumer spells, block/sync-critical queues
+    shed NOTHING and age NOTHING past deadline, the attestation lane
+    absorbs the excess (nonzero shed rate), everything above the
+    attestation class is served first (order_ok), and the duty SLO
+    holds the ratcheted 250 ms p99 DURING the overload."""
+    o = small_report["overload"]
+    assert o["slots"] > 0 and o["burst_multiplier"] == 4.0
+    assert {sp["kind"] for sp in o["spells"]} == {
+        "burst", "worker_stall", "slow_consumer"
+    }
+    assert o["gossip_submitted"] > 0
+    # graceful degradation: the attestation lane absorbs the excess...
+    assert o["attestation_shed_rate"] > 0.0
+    att_sheds = o["sheds"].get("GOSSIP_ATTESTATION", {})
+    assert att_sheds.get("capacity", 0) > 0
+    assert att_sheds.get("expired", 0) > 0
+    assert o["deadline_misses"].get("GOSSIP_ATTESTATION", 0) > 0
+    # ...while every block/sync-critical queue stays clean — and not
+    # vacuously: critical work actually flowed through the scheduler
+    assert o["fresh_block_sheds"] == 0
+    assert o["critical_deadline_misses"] == 0
+    assert o["critical_processed"] > 0
+    from lighthouse_tpu.node.beacon_processor import (
+        WORK_CLASS,
+        PriorityClass,
+    )
+
+    critical = {
+        t.name
+        for t, c in WORK_CLASS.items()
+        if c is PriorityClass.BLOCK_SYNC_CRITICAL
+    }
+    for q in critical:
+        assert q not in o["sheds"], (q, o["sheds"])
+        assert q not in o["deadline_misses"]
+    # aggregates (class 1) also rode above the flood
+    assert "GOSSIP_AGGREGATE" not in o["sheds"]
+    # the priority chain held on the execution order log
+    assert o["order_ok"] is True
+    # the ratcheted SLO holds DURING overload
+    duty = o["duty_response_ms"]
+    assert duty["count"] > 0
+    assert duty["p99"] is not None and duty["p99"] <= 250.0, duty
 
 
 def test_http_series_contract_after_replay(small_report):
@@ -142,15 +203,27 @@ def test_request_spans_land_on_slot_timelines(small_report):
 
 
 def test_deterministic_shape_same_seed():
-    """Same seed → same traffic shape: request schedule, gossip burst,
-    and the engineered overflow/stale counts all reproduce."""
-    a = loadgen.run_load(_small_cfg(vcs=4, slots=2, sse_subscribers=1))
-    b = loadgen.run_load(_small_cfg(vcs=4, slots=2, sse_subscribers=1))
+    """Same seed → same traffic shape: request schedule, gossip burst
+    and population split reproduce EXACTLY. Shed/miss totals are
+    seeded too, but the expired-sweep eviction clears ALL expired
+    entries whenever the deadline watermark fires, so counts at the
+    wall-clock expiry boundary may wobble by a few items run-to-run —
+    the gate is a tight tolerance, not bitwise equality (the
+    round-over-round bench gate's ratio floors absorb the same
+    jitter)."""
+    a = loadgen.run_load(
+        _small_cfg(vcs=4, slots=2, sse_subscribers=1, overload_slots=2)
+    )
+    b = loadgen.run_load(
+        _small_cfg(vcs=4, slots=2, sse_subscribers=1, overload_slots=2)
+    )
     for key in ("requests_total", "gossip_submitted"):
         assert getattr(a, key) == getattr(b, key)
     assert a.shed["received"] == b.shed["received"]
-    assert a.shed["dropped"] == b.shed["dropped"]
-    assert a.deadline["misses"] == b.deadline["misses"]
+    assert a.overload["gossip_submitted"] == b.overload["gossip_submitted"]
+    tol = max(8, a.shed["received"] // 100)
+    assert abs(a.shed["dropped"] - b.shed["dropped"]) <= tol
+    assert abs(a.deadline["misses"] - b.deadline["misses"]) <= tol
     assert sorted(a.endpoints) == sorted(b.endpoints)
     for ep in a.endpoints:
         assert a.endpoints[ep]["requests"] == b.endpoints[ep]["requests"]
@@ -164,8 +237,9 @@ def test_heavy_replay_shape():
         _small_cfg(vcs=150, slots=8, http_workers=8)
     ).to_dict()
     assert loadgen.LoadReport.validate(report) == []
-    assert report["duty_response_ms"]["p99"] < 3000.0
+    assert report["duty_response_ms"]["p99"] < 1000.0
     assert report["shed"]["dropped"] > 0
+    assert report["overload"]["fresh_block_sheds"] == 0
 
 
 # ------------------------------------------------- SSE under concurrency

@@ -616,23 +616,58 @@ def test_lookup_decode_failure_releases_children(rig):
     assert parent_root not in rig.sm._awaiting_parent
 
 
-def test_segment_submit_backpressure_requeues_batch(rig):
-    """A processor backpressure drop must NOT wedge the batch in
-    PROCESSING (no timeout covers that state): it returns to
-    AWAITING_PROCESSING and the next tick retries."""
+def test_segment_terminal_shed_requeues_batch(rig):
+    """A TERMINAL scheduler shed (attempt caps exhausted — transient
+    backpressure now bounces inside the processor) must NOT wedge the
+    batch in PROCESSING (no timeout covers that state): the Work's
+    on_shed callback returns it to AWAITING_PROCESSING and the next
+    tick retries."""
     _connect(rig, "p1")
     a = b"\xa1" * 32
     _handshake(rig, "p1", a, 4)
     rig.sm.tick()
     (req1,) = rig.service.pop_requests(Protocol.BLOCKS_BY_RANGE)
     real_submit = rig.sm.processor.submit
-    rig.sm.processor.submit = lambda w: False  # queue full
+
+    def shedding_submit(w):  # queue full past the attempt cap
+        if w.on_shed is not None:
+            w.on_shed(w, "backpressure")
+        return False
+
+    rig.sm.processor.submit = shedding_submit
     _serve(rig, req1, _mk_chain_blocks(1, 4, b"\xa1"))
     (batch,) = rig.sm.chains[a].batches
     assert batch.state is BatchState.AWAITING_PROCESSING
     rig.sm.processor.submit = real_submit
     rig.sm.tick()
     assert batch.state is BatchState.PROCESSED
+
+
+def test_segment_failed_shed_blames_download_not_requeue(rig):
+    """reason='failed' means the handler RAN and raised on every
+    attempt (blocks possibly part-consumed): the batch must go back
+    through the download path (QUEUED, bounded attempts) — re-entering
+    _process_ready with consumed blocks would record a confirmed-empty
+    slot run for a batch that really held blocks."""
+    _connect(rig, "p1")
+    a = b"\xa1" * 32
+    _handshake(rig, "p1", a, 4)
+    rig.sm.tick()
+    (req1,) = rig.service.pop_requests(Protocol.BLOCKS_BY_RANGE)
+    real_submit = rig.sm.processor.submit
+
+    def failing_submit(w):
+        if w.on_shed is not None:
+            w.on_shed(w, "failed")
+        return False
+
+    rig.sm.processor.submit = failing_submit
+    _serve(rig, req1, _mk_chain_blocks(1, 4, b"\xa1"))
+    rig.sm.processor.submit = real_submit
+    (batch,) = rig.sm.chains[a].batches
+    # back through the download path, not AWAITING_PROCESSING
+    assert batch.state in (BatchState.QUEUED, BatchState.DOWNLOADING)
+    assert batch.blocks is None
 
 
 def test_stale_block_response_rejected_as_bad_range(rig):

@@ -46,6 +46,13 @@ REQUIRED_FAMILIES = {
     "beacon_processor_batch_size": ("queue",),
     # deadline attribution (ISSUE 8): shed-rate curves' denominator
     "beacon_processor_deadline_misses_total": ("queue",),
+    # overload-first scheduler (ISSUE 13): every submitted-but-
+    # unprocessed item, split by refusal reason (expired / capacity /
+    # backpressure / failed) — the graceful-degradation contract
+    "beacon_processor_sheds_total": ("queue", "reason"),
+    # bounded retry-with-requeue events (submit backpressure or a
+    # raising handler bouncing through the reprocess heap)
+    "beacon_processor_work_retries_total": ("queue",),
     # HTTP/SSE serving path (node/http_api.py, ISSUE 8): the load
     # observatory's request-side contract — endpoint label is the ROUTE
     # NAME (bounded cardinality), never the raw path
@@ -132,6 +139,13 @@ REQUIRED_BUCKETS = {
     "beacon_processor_batch_size": (
         1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096,
     ),
+    # queue-age layout (ISSUE 13): the deadline-miss tail reads off
+    # these percentiles — a silent relayout would break every recorded
+    # shed/deadline curve's continuity
+    "beacon_processor_queue_wait_seconds": (
+        0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+        1.0, 2.5, 5.0, 10.0,
+    ),
     # compile events are seconds-to-minutes; the request-latency layout
     # would collapse every observation into +Inf
     "jax_compile_seconds": (
@@ -215,6 +229,10 @@ def _check_queues(problems: list) -> None:
         "beacon_processor_work_received_total",
         "beacon_processor_work_processed_total",
         "beacon_processor_deadline_misses_total",
+        # ISSUE 13: shed/retry children pre-resolve at import for every
+        # (queue, reason) — no blind queues on first scrape
+        "beacon_processor_sheds_total",
+        "beacon_processor_work_retries_total",
     ):
         fam = metrics.get(fam_name)
         if fam is None:
