@@ -535,6 +535,21 @@ def _config_lint(detail):
     }
 
 
+def _config_bounds(detail):
+    """detail.bounds (ISSUE 14): the limb-bounds prover's headline
+    numbers every round — certified sites/bodies, min int32 headroom,
+    carry passes trimmed off the Fp-mul pipeline, and whether the
+    checked-in certificate is fingerprint-fresh. Pure host work
+    (abstract interpretation over the kernel bodies, disk-cached by
+    source fingerprint like graft-lint), so the certified-trim
+    trajectory ships tunnel up or down; tools/bench_gate.py fails any
+    round-over-round min-headroom decrease below the 2-bit slack
+    floor."""
+    from lighthouse_tpu.ops import bounds
+
+    detail["bounds"] = bounds.summary()
+
+
 def _seed_artifacts(detail):
     """Record the exported-artifact inventory (bucket, age, source-hash
     match) in detail.backend_init EVEN ON SUCCESS and mirror it into
@@ -910,6 +925,8 @@ def main():
         _run_config("hash", 45, _config_hash_costs)
         # contract-lint counts ride every round (ISSUE 12)
         _run_config("lint", 30, _config_lint)
+        # limb-bounds certificates + headroom ride every round (ISSUE 14)
+        _run_config("bounds", 45, _config_bounds)
         _run_config("replay", 60, _config_replay)
         _emit()
         # a correctness-checked replay measurement IS a result: rc 0
@@ -989,6 +1006,9 @@ def main():
 
     # per-rule contract-lint finding counts ride every round (ISSUE 12)
     _run_config("lint", 30, _config_lint)
+
+    # limb-bounds certificates + headroom ride every round (ISSUE 14)
+    _run_config("bounds", 45, _config_bounds)
 
     # ------------- in-repo CPU control (sanity only, NOT the baseline)
     if _left() > 30:

@@ -51,9 +51,24 @@ _NAME_RE = re.compile(
 
 def artifact_inventory() -> list:
     """Every verify artifact on disk (any backend), with bucket, age,
-    size, backend and source-hash match against the current sources."""
+    size, backend and source-hash match against the current sources.
+    Mesh artifacts (__graft_entry__.dryrun_multichip) key on the
+    fingerprint EXTENDED with parallel/verify.py — comparing them
+    against the plain kernel hash would report every mesh artifact as
+    stale forever."""
     TB = _tb()
     current = TB.source_fingerprint()
+    try:
+        repo = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))))))
+        current_mesh = TB.source_fingerprint(
+            extra_paths=[
+                os.path.join(repo, "lighthouse_tpu", "parallel",
+                             "verify.py")
+            ]
+        )
+    except OSError:
+        current_mesh = current
     out = []
     now = time.time()
     for path in sorted(glob.glob(os.path.join(export_dir(), "verify_*.bin"))):
@@ -69,7 +84,10 @@ def artifact_inventory() -> list:
                 "bucket": int(m.group("bucket")),
                 "backend": m.group("backend"),
                 "source_hash": m.group("srchash"),
-                "source_hash_match": m.group("srchash") == current,
+                "source_hash_match": m.group("srchash") == (
+                    current_mesh if m.group("backend") == "mesh"
+                    else current
+                ),
                 "age_s": round(now - st.st_mtime, 1),
                 "size_bytes": st.st_size,
                 "path": path,

@@ -1,0 +1,1523 @@
+"""Limb-bounds prover — abstract-interpretation carry certificates
+(ISSUE 14 tentpole, layer 1).
+
+The Fp kernels' deepest invariant used to be prose: "sums of at most
+THREE standard elements", "Three fold rounds bound every product"
+(ops/fp.py docstring, pre-PR-14). This module turns it into a machine
+check: an abstract interpreter over the limb-arithmetic dataflow that
+executes the REAL kernel bodies — `_conv`, `_fold`, `_norm1`,
+`_pad_limbs`, the adds/subs and the scan bodies in ops/fp.py,
+ops/lane/fp.py and their callers — on an interval domain (per limb
+position: signed magnitude bounds, exact integer endpoints), and fails
+the moment any interval endpoint reaches 2^31.
+
+Why trace the real bodies instead of a hand-written transfer model?
+The same reason the cost observatory (ops/costs.py) rides the
+`kernel_op` seam instead of an op table: a mirror drifts silently; the
+seam cannot. Bounds mode reuses that exact machinery:
+
+- `fp.CENSUS` routes every kernel_op dispatch to a recorder that runs
+  the body function on interval arrays (`IArr`: elementwise [lo, hi]
+  int64 bounds) with the real fold/topfold constants;
+- the lane modules' `jnp` binding is swapped for a shim that gives the
+  ~20 jnp functions the bodies and their XLA glue use interval
+  semantics (joins at `where`, floor semantics at `right_shift`,
+  block-exact `bitwise_and`, per-step-checked fold/conv accumulation);
+- `jax.lax.scan`/`cond`/`dynamic_index_in_dim` run eagerly (as in
+  census mode), so the 63 Miller doublings, the 381-bit Fermat chains
+  and the canonical ladder are interpreted at their executed
+  multiplicity, with per-(body, input-interval) memoization making the
+  fixpoint cheap once the loop-carried bounds saturate;
+- every `_norm(...)` / `norm3_x(...)` schedule site reports through
+  `fp.BOUNDS` with its literal site id, so the certificate records,
+  per site: input interval, passes applied, output interval, headroom.
+
+The derived certificate (tests/budgets/limb_bounds.json) is keyed by
+the same kernel source fingerprint as the census budgets (graft-lint
+R3): any kernel edit stales every certificate, and graft-lint R6 fails
+until `tools/limb_bounds.py --update` re-proves the tree. The trimmed
+norm schedule itself lives as a literal in ops/lane/fp.py (`_SCHED`),
+so it is covered by the fingerprint and by the Mosaic compilation
+cache keys; this module only PROVES it, it never configures it.
+
+Soundness posture: interval joins at every data-dependent select
+(`where`, cond branches, table gathers) make the interpretation a
+strict over-approximation of any concrete execution reachable from
+the program inputs (canonical-limb field elements, {0,1} scalar bits).
+A pass-depth certificate therefore transfers to every concrete batch.
+The checker itself is soundness-tested both ways in
+tests/test_limb_bounds.py: an overstated certificate is rejected
+statically, and interval-extremal concrete inputs are replayed against
+the python-int oracle at runtime.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+from collections import OrderedDict
+
+import numpy as np
+
+SCHEMA = "lighthouse-tpu/limb-bounds/v1"
+# bump to invalidate the derivation cache when the domain/programs change
+BOUNDS_VERSION = 1
+INT32 = 1 << 31
+
+
+def _bits(v: int) -> int:
+    return int(v).bit_length()
+
+
+def _headroom_bits(max_abs: int) -> float:
+    """Fractional bits of headroom below 2^31 (0.0 when saturated)."""
+    if max_abs <= 0:
+        return 31.0
+    return round(max(0.0, 31.0 - math.log2(max_abs)), 2)
+
+
+class BoundsViolation(Exception):
+    """An interval endpoint reached 2^31 — the concrete kernel could
+    overflow int32 at this operation."""
+
+
+# ------------------------------------------------------------------ context
+
+
+class _Ctx:
+    """One derivation run: attribution frames + per-site/body records."""
+
+    def __init__(self):
+        self.stack = []          # active frame keys, outermost first
+        self.frames = OrderedDict()   # frame key -> max |endpoint|
+        self.sites = OrderedDict()    # site id -> record
+        self.windows = OrderedDict()  # value-window records (canonical)
+        self.max_abs = 0
+
+    def push(self, key):
+        self.stack.append(key)
+        self.frames.setdefault(key, 0)
+
+    def pop(self):
+        self.stack.pop()
+
+    def record(self, m: int, op: str):
+        if m > self.max_abs:
+            self.max_abs = m
+        for k in self.stack:
+            if m > self.frames[k]:
+                self.frames[k] = m
+        if m >= INT32:
+            where = " > ".join(
+                ":".join(str(p) for p in k) for k in self.stack
+            )
+            raise BoundsViolation(
+                f"int32 overflow: |{op}| reaches {m} (2^{_bits(m) - 1}"
+                f".x) at {where or '<top>'}"
+            )
+
+
+_CTX: _Ctx | None = None
+
+
+# ------------------------------------------------------------------ domain
+
+
+def _shape_of(x):
+    if isinstance(x, (IArr, ABool)):
+        return x.shape
+    return np.shape(x)
+
+
+class IArr:
+    """Interval-valued array: elementwise signed bounds [lo, hi].
+
+    Endpoints are int64; the eager per-op check keeps every endpoint's
+    magnitude < 2^31, so single int64 ops can never overflow (products
+    < 2^62, accumulation steps < 2^63).
+
+    `val` optionally carries the interval of the ENCODED value
+    sum(limb_i << 11 i) over all elements, as exact python ints — the
+    lane layout keeps limbs on axis -2, and the four semantic ops
+    (_conv/_fold/_pad_limbs/_norm1, patched during bounds mode) keep
+    it tight where per-limb intervals alone are too coarse: the
+    canonical() subtract-ladder window is a VALUE property."""
+
+    __slots__ = ("lo", "hi", "val")
+    # force ndarray ops to defer to our reflected dunders
+    __array_ufunc__ = None
+    __array_priority__ = 10_000
+
+    def __init__(self, lo, hi, op="iv", val=None):
+        lo = np.asarray(lo, dtype=np.int64)
+        hi = np.asarray(hi, dtype=np.int64)
+        if lo.shape != hi.shape:
+            lo, hi = np.broadcast_arrays(lo, hi)
+        self.lo = lo
+        self.hi = hi
+        self.val = val
+        if _CTX is not None and lo.size:
+            m = max(int(-lo.min()), int(hi.max()), 0)
+            _CTX.record(m, op)
+
+    # ---- structure
+    @property
+    def shape(self):
+        return self.lo.shape
+
+    @property
+    def ndim(self):
+        return self.lo.ndim
+
+    @property
+    def dtype(self):
+        return np.dtype(np.int32)
+
+    def mag(self) -> int:
+        if not self.lo.size:
+            return 0
+        return max(int(-self.lo.min()), int(self.hi.max()), 0)
+
+    def key(self):
+        return (
+            self.lo.shape, self.lo.tobytes(), self.hi.tobytes(), self.val
+        )
+
+    def astype(self, _dt):
+        return self
+
+    @property
+    def at(self):
+        return _At(self)
+
+    def __getitem__(self, idx):
+        # element subsets keep the value hull valid ONLY when the two
+        # trailing axes (limb + lane in lane layout; batch + limb in
+        # base layout) survive intact — slicing into the limb axis
+        # destroys the encoded-value meaning, so the hull is dropped
+        lo = self.lo[idx]
+        hi = self.hi[idx]
+        val = (
+            self.val
+            if (
+                self.val is not None
+                and lo.ndim >= 2
+                and self.lo.ndim >= 2
+                and lo.shape[-2:] == self.lo.shape[-2:]
+            )
+            else None
+        )
+        return IArr(lo, hi, "index", val=val)
+
+    def __len__(self):
+        return self.lo.shape[0]
+
+    # ---- arithmetic
+    def __neg__(self):
+        val = (-self.val[1], -self.val[0]) if self.val else None
+        return IArr(-self.hi, -self.lo, "neg", val=val)
+
+    def __add__(self, o):
+        o = as_iv(o)
+        val = None
+        if self.val and o.val:
+            val = (self.val[0] + o.val[0], self.val[1] + o.val[1])
+        return IArr(self.lo + o.lo, self.hi + o.hi, "add", val=val)
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        o = as_iv(o)
+        val = None
+        if self.val and o.val:
+            val = (self.val[0] - o.val[1], self.val[1] - o.val[0])
+        return IArr(self.lo - o.hi, self.hi - o.lo, "sub", val=val)
+
+    def __rsub__(self, o):
+        return as_iv(o).__sub__(self)
+
+    def __mul__(self, o):
+        o = as_iv(o)
+        p1 = self.lo * o.lo
+        p2 = self.lo * o.hi
+        p3 = self.hi * o.lo
+        p4 = self.hi * o.hi
+        lo = np.minimum(np.minimum(p1, p2), np.minimum(p3, p4))
+        hi = np.maximum(np.maximum(p1, p2), np.maximum(p3, p4))
+        val = None
+        # value transfer for a scalar multiplier (8 * Cv, x * int32(3))
+        for a, b in ((self, o), (o, self)):
+            if (
+                val is None
+                and a.val
+                and b.lo.ndim == 0
+                and int(b.lo) == int(b.hi)
+            ):
+                k = int(b.lo)
+                c = (a.val[0] * k, a.val[1] * k)
+                val = (min(c), max(c))
+        return IArr(lo, hi, "mul", val=val)
+
+    __rmul__ = __mul__
+
+    # ---- bitwise (used only on non-negative flag/limb values)
+    def _bitjoin(self, o, op):
+        o = as_iv(o)
+        if self.lo.size and o.lo.size and (
+            int(self.lo.min()) >= 0 and int(o.lo.min()) >= 0
+        ):
+            if op == "and":  # x & y <= min(x, y)
+                return IArr(
+                    np.zeros_like(self.lo + o.lo),
+                    np.minimum(
+                        np.broadcast_arrays(self.hi + 0 * o.hi, o.hi)[0],
+                        np.broadcast_arrays(o.hi + 0 * self.hi, self.hi)[0],
+                    ),
+                    "and",
+                )
+            m = max(self.mag(), o.mag())
+            cap = (1 << _bits(m)) - 1 if m else 0
+            return IArr(
+                np.zeros_like(self.lo + o.lo),
+                np.full_like(self.hi + o.hi, cap),
+                "or",
+            )
+        m = max(self.mag(), o.mag())
+        z = self.lo + o.lo  # broadcast shape
+        return IArr(np.full_like(z, -m), np.full_like(z, m), op)
+
+    def __and__(self, o):
+        return self._bitjoin(o, "and")
+
+    __rand__ = __and__
+
+    def __or__(self, o):
+        return self._bitjoin(o, "or")
+
+    __ror__ = __or__
+
+    # ---- comparisons: truth value unknown -> ABool
+    def _cmp(self, o):
+        return ABool(np.broadcast_shapes(self.shape, _shape_of(o)))
+
+    __eq__ = _cmp
+    __ne__ = _cmp
+    __lt__ = _cmp
+    __le__ = _cmp
+    __gt__ = _cmp
+    __ge__ = _cmp
+    __hash__ = None
+
+
+class ABool:
+    """Abstract boolean array: shape-tracked, value unknown."""
+
+    __slots__ = ("shape",)
+    __array_ufunc__ = None
+    __array_priority__ = 10_000
+
+    def __init__(self, shape):
+        self.shape = tuple(shape)
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    @property
+    def dtype(self):
+        return np.dtype(np.bool_)
+
+    def __getitem__(self, idx):
+        return ABool(np.empty(self.shape, np.bool_)[idx].shape)
+
+    def _join(self, o):
+        return ABool(np.broadcast_shapes(self.shape, _shape_of(o)))
+
+    __and__ = _join
+    __rand__ = _join
+    __or__ = _join
+    __ror__ = _join
+    __xor__ = _join
+    __rxor__ = _join
+    __ne__ = _join
+    __eq__ = _join
+    __hash__ = None
+
+    def __invert__(self):
+        return self
+
+    def astype(self, dt):
+        if np.dtype(dt) == np.bool_:
+            return self
+        return IArr(
+            np.zeros(self.shape, np.int64), np.ones(self.shape, np.int64)
+        )
+
+
+class _At:
+    """jnp-style .at[idx].add(v) accumulation (ops/fp._conv): each
+    scatter-add materializes a checked partial sum, mirroring the
+    kernel's own int32 accumulation order."""
+
+    __slots__ = ("arr",)
+
+    def __init__(self, arr):
+        self.arr = arr
+
+    def __getitem__(self, idx):
+        arr = self.arr
+
+        class _Upd:
+            @staticmethod
+            def add(v):
+                vi = as_iv(v)
+                lo = arr.lo.copy()
+                hi = arr.hi.copy()
+                lo[idx] = lo[idx] + vi.lo
+                hi[idx] = hi[idx] + vi.hi
+                return IArr(lo, hi, "acc")
+
+        return _Upd
+
+
+def as_iv(x) -> IArr:
+    """Coerce any operand (IArr, ABool, jax/numpy array, scalar) to an
+    interval array; concrete values become exact point intervals."""
+    if isinstance(x, IArr):
+        return x
+    if isinstance(x, ABool):
+        return x.astype(np.int64)
+    a = np.asarray(x)
+    if a.dtype == np.bool_:
+        a = a.astype(np.int64)
+    return IArr(a, a)
+
+
+def _join_iv(a, b):
+    ai, bi = as_iv(a), as_iv(b)
+    val = None
+    if ai.val is not None and bi.val is not None:
+        val = (min(ai.val[0], bi.val[0]), max(ai.val[1], bi.val[1]))
+    return IArr(
+        np.minimum(
+            np.broadcast_arrays(ai.lo, bi.lo)[0],
+            np.broadcast_arrays(bi.lo, ai.lo)[0],
+        ),
+        np.maximum(
+            np.broadcast_arrays(ai.hi, bi.hi)[0],
+            np.broadcast_arrays(bi.hi, ai.hi)[0],
+        ),
+        "join",
+        val=val,
+    )
+
+
+# ------------------------------------------------- value-interval transfer
+#
+# Per-limb intervals alone cannot certify canonical()'s subtract-ladder
+# window: any 36-limb array with ~2^12 limb bounds has a value hull of
+# ~2^397 regardless of how small the actual value is — modular fold
+# reduction is invisible at the limb level. So IArr optionally carries
+# an exact python-int interval of the ENCODED value sum(limb_i << 11 i)
+# (over axis -2 in the lane layout, axis -1 in the base layout; linear
+# ops in IArr transfer it layout-agnostically), and the four semantic
+# seams (_conv / _fold / _pad / norm passes — patched while bounds mode
+# is active) apply exact transfer rules:
+#   _conv:  value(out) = value(a) * value(b)            (no reduction)
+#   _fold:  value(out) = value(lo part) + sum_k c_k * F_k, with each
+#           folded coefficient c_k tightened by the value constraint
+#           (c_k <= (vhi - rest_lo) >> weight_k) — this is where "big
+#           value => big top limb => big fold step" becomes derivable
+#   topfold norm pass: value(out) = value - c_top * (2^(B*w) - topf)
+#   open norm pass:    value unchanged (no topfold event)
+# Every transferred interval is intersected with the limb hull of the
+# result, so the tracked value can never be looser than the limbs
+# imply; an EMPTY intersection means the prover itself is unsound and
+# raises immediately.
+
+_B = 11  # limb width; pinned (== ops/fp.B) by tests/test_limb_bounds.py
+
+
+def _isect(a, b, what="value interval"):
+    lo, hi = max(a[0], b[0]), min(a[1], b[1])
+    if lo > hi:
+        raise BoundsViolation(
+            f"internal: empty {what} intersecting {a} with {b} — "
+            "prover transfer-rule bug, not a kernel problem"
+        )
+    return (lo, hi)
+
+
+def _limb_hulls(x: IArr, axis: int):
+    """Per-limb-position [lo, hi] hulls along `axis`, as python ints."""
+    lo = np.moveaxis(x.lo, axis, -1).reshape(-1, x.lo.shape[axis])
+    hi = np.moveaxis(x.hi, axis, -1).reshape(-1, x.hi.shape[axis])
+    return (
+        [int(v) for v in lo.min(axis=0)],
+        [int(v) for v in hi.max(axis=0)],
+    )
+
+
+def _hull_value(plo, phi):
+    vlo = sum(v << (_B * i) for i, v in enumerate(plo))
+    vhi = sum(v << (_B * i) for i, v in enumerate(phi))
+    return vlo, vhi
+
+
+def _val_hull(x: IArr, axis: int):
+    return _hull_value(*_limb_hulls(x, axis))
+
+
+def _value_of(x: IArr, axis: int):
+    """Best known value interval: tracked value ∩ limb hull."""
+    hull = _val_hull(x, axis)
+    if x.val is None:
+        return hull
+    return _isect(x.val, hull)
+
+
+def _row_value(row) -> int:
+    return sum(int(v) << (_B * j) for j, v in enumerate(row))
+
+
+def _fold_val(x: IArr, axis: int, rows, fold_at: int):
+    """Exact value interval of a fold: out = lo_part + sum_k c_k * F_k
+    where F_k = value(rows[k]) ≡ 2^(B*(fold_at+k)) (mod p). Each c_k is
+    the limb interval at fold_at+k, tightened by the value constraint
+    v = rest + c_k * 2^(B*(fold_at+k))."""
+    plo, phi = _limb_hulls(x, axis)
+    tlo, thi = _hull_value(plo, phi)
+    vlo, vhi = (tlo, thi) if x.val is None else _isect(x.val, (tlo, thi))
+    out_lo, out_hi = _hull_value(plo[:fold_at], phi[:fold_at])
+    for k in range(len(plo) - fold_at):
+        pos = fold_at + k
+        wk = _B * pos
+        rest_lo = tlo - (plo[pos] << wk)
+        rest_hi = thi - (phi[pos] << wk)
+        c_lo = max(plo[pos], -((rest_hi - vlo) >> wk))
+        c_hi = min(phi[pos], (vhi - rest_lo) >> wk)
+        if c_lo > c_hi:
+            raise BoundsViolation(
+                "internal: empty fold-coefficient interval at limb "
+                f"{pos} — prover transfer-rule bug"
+            )
+        fk = _row_value(rows[k])
+        out_lo += c_lo * fk
+        out_hi += c_hi * fk
+    return out_lo, out_hi
+
+
+def _topfold_val(x: IArr, axis: int, topf_row):
+    """Exact value interval across one topfold carry pass: the top
+    carry c (tightened by the value constraint) swaps weight 2^(B*w)
+    for topf ≡ 2^(B*w) (mod p): value -= c * (2^(B*w) - topf)."""
+    plo, phi = _limb_hulls(x, axis)
+    tlo, thi = _hull_value(plo, phi)
+    vlo, vhi = (tlo, thi) if x.val is None else _isect(x.val, (tlo, thi))
+    w = len(plo)
+    pos = w - 1
+    wk = _B * pos
+    rest_lo = tlo - (plo[pos] << wk)
+    rest_hi = thi - (phi[pos] << wk)
+    t_lo = max(plo[pos], -((rest_hi - vlo) >> wk))
+    t_hi = min(phi[pos], (vhi - rest_lo) >> wk)
+    if t_lo > t_hi:
+        raise BoundsViolation(
+            "internal: empty top-limb interval — prover transfer bug"
+        )
+    c_lo, c_hi = t_lo >> _B, t_hi >> _B
+    d = (1 << (_B * w)) - _row_value(topf_row)  # m*p >= 0
+    return (vlo - c_hi * d, vhi - c_lo * d)
+
+
+def _attach_val(r: IArr, axis: int, val) -> IArr:
+    """Set r.val = val ∩ limb-hull(r); hull alone when val is None."""
+    hull = _val_hull(r, axis)
+    r.val = hull if val is None else _isect(val, hull)
+    return r
+
+
+class _SeamPatches:
+    """Value-transfer wrappers over the four semantic seams of one fp
+    module (lane: limbs on axis -2, matrices limb-major columns; base:
+    limbs on axis -1, matrices row-major). Installed by bounds_mode,
+    always restored."""
+
+    def __init__(self, mod, axis: int, lane: bool):
+        self.mod = mod
+        self.axis = axis
+        self.lane = lane
+        self.saved = {}
+
+    def _wrap(self, name, wrapper):
+        orig = getattr(self.mod, name)
+        self.saved[name] = orig
+
+        def wrapped(*args, **kw):
+            return wrapper(orig, *args, **kw)
+
+        setattr(self.mod, name, wrapped)
+
+    def install(self):
+        axis = self.axis
+        mod = self.mod
+
+        def conv(orig, a, b):
+            r = orig(a, b)
+            if isinstance(r, IArr) and isinstance(a, IArr) \
+                    and isinstance(b, IArr):
+                val = None
+                if a.val is not None and b.val is not None:
+                    ps = [x * y for x in a.val for y in b.val]
+                    val = (min(ps), max(ps))
+                _attach_val(r, axis, val)
+            return r
+
+        def fold(orig, x, mt):
+            r = orig(x, mt)
+            if isinstance(r, IArr) and isinstance(x, IArr):
+                m = np.asarray(mt)
+                rows = m.T if self.lane else m
+                _attach_val(
+                    r, axis, _fold_val(x, axis, rows, int(mod.FOLD_AT))
+                )
+            return r
+
+        def pad(orig, x, width):
+            r = orig(x, width)
+            if isinstance(r, IArr) and isinstance(x, IArr):
+                _attach_val(r, axis, x.val)  # zero limbs: value kept
+            return r
+
+        if self.lane:
+            def norm1(orig, x, topf):
+                r = orig(x, topf)
+                if isinstance(r, IArr) and isinstance(x, IArr):
+                    w = x.shape[axis]
+                    row = np.asarray(topf)[mod._TROW[w], :w]
+                    _attach_val(r, axis, _topfold_val(x, axis, row))
+                return r
+
+            def norm1_open(orig, x, topf):
+                r = orig(x, topf)
+                if isinstance(r, IArr) and isinstance(x, IArr):
+                    _attach_val(r, axis, _value_of(x, axis))
+                return r
+
+            self._wrap("_conv", conv)
+            self._wrap("_fold", fold)
+            self._wrap("_pad_limbs", pad)
+            self._wrap("_norm1", norm1)
+            self._wrap("_norm1_open", norm1_open)
+        else:
+            def norm1(orig, x):
+                r = orig(x)
+                if isinstance(r, IArr) and isinstance(x, IArr):
+                    row = mod._topfold(x.shape[axis])
+                    _attach_val(r, axis, _topfold_val(x, axis, row))
+                return r
+
+            def norm1_open(orig, x):
+                r = orig(x)
+                if isinstance(r, IArr) and isinstance(x, IArr):
+                    _attach_val(r, axis, _value_of(x, axis))
+                return r
+
+            self._wrap("_conv", conv)
+            self._wrap("_fold", fold)
+            self._wrap("_pad_to", pad)
+            self._wrap("norm1", norm1)
+            self._wrap("norm1_open", norm1_open)
+
+    def restore(self):
+        for name, orig in self.saved.items():
+            setattr(self.mod, name, orig)
+        self.saved.clear()
+
+
+# ------------------------------------------------------------------ jnp shim
+
+
+def _reduce_shape(shape, axis):
+    return np.empty(shape, np.bool_).all(axis=axis).shape
+
+
+def _is_abs(x):
+    return isinstance(x, (IArr, ABool))
+
+
+class _Shim:
+    """The jnp surface the kernel bodies and their glue touch, with
+    interval semantics. Anything concrete stays concrete (numpy)."""
+
+    int32 = np.int32
+    int64 = np.int64
+    bool_ = np.bool_
+    ndarray = np.ndarray
+
+    @staticmethod
+    def asarray(x, dtype=None):
+        if _is_abs(x):
+            return x
+        a = np.asarray(x)
+        return a if dtype is None else a.astype(dtype)
+
+    @staticmethod
+    def zeros(shape, dtype=None):
+        z = np.zeros(shape, np.int64)
+        return IArr(z, z, "zeros")
+
+    @staticmethod
+    def zeros_like(x):
+        if _is_abs(x):
+            return np.zeros(x.shape, np.int64)
+        return np.zeros_like(np.asarray(x))
+
+    @staticmethod
+    def arange(*a, **kw):
+        return np.arange(*a, **kw)
+
+    broadcast_shapes = staticmethod(np.broadcast_shapes)
+
+    @staticmethod
+    def broadcast_to(x, shape):
+        if isinstance(x, IArr):
+            return IArr(
+                np.broadcast_to(x.lo, shape), np.broadcast_to(x.hi, shape)
+            )
+        if isinstance(x, ABool):
+            return ABool(shape)
+        return np.broadcast_to(np.asarray(x), shape)
+
+    @staticmethod
+    def pad(x, padw, **kw):
+        if isinstance(x, IArr):
+            return IArr(np.pad(x.lo, padw), np.pad(x.hi, padw), "pad")
+        return np.pad(np.asarray(x), padw, **kw)
+
+    @staticmethod
+    def roll(x, shift, axis=None):
+        if isinstance(x, IArr):
+            return IArr(
+                np.roll(x.lo, shift, axis=axis),
+                np.roll(x.hi, shift, axis=axis),
+            )
+        return np.roll(np.asarray(x), shift, axis=axis)
+
+    @staticmethod
+    def moveaxis(x, src, dst):
+        if isinstance(x, IArr):
+            return IArr(
+                np.moveaxis(x.lo, src, dst), np.moveaxis(x.hi, src, dst)
+            )
+        return np.moveaxis(np.asarray(x), src, dst)
+
+    @staticmethod
+    def _val_join(ivs, out_ndim, axis):
+        """Value hull across stacked/concatenated parts, kept only when
+        the combination axis does not touch the two trailing axes (the
+        encoded-value layout), and every part carries a value."""
+        ax = axis if axis >= 0 else axis + out_ndim
+        if ax >= out_ndim - 2:
+            return None
+        vals = [v.val for v in ivs]
+        if any(v is None for v in vals):
+            return None
+        return (min(v[0] for v in vals), max(v[1] for v in vals))
+
+    @staticmethod
+    def stack(xs, axis=0):
+        xs = list(xs)
+        if any(_is_abs(x) for x in xs):
+            ivs = [as_iv(x) for x in xs]
+            shape = np.broadcast_shapes(*(v.shape for v in ivs))
+            los = [np.broadcast_to(v.lo, shape) for v in ivs]
+            his = [np.broadcast_to(v.hi, shape) for v in ivs]
+            lo = np.stack(los, axis=axis)
+            return IArr(
+                lo, np.stack(his, axis=axis), "stack",
+                val=_Shim._val_join(ivs, lo.ndim, axis),
+            )
+        return np.stack(xs, axis=axis)
+
+    @staticmethod
+    def concatenate(xs, axis=0):
+        xs = list(xs)
+        if any(_is_abs(x) for x in xs):
+            ivs = [as_iv(x) for x in xs]
+            lo = np.concatenate([v.lo for v in ivs], axis=axis)
+            return IArr(
+                lo,
+                np.concatenate([v.hi for v in ivs], axis=axis),
+                "concat",
+                val=_Shim._val_join(ivs, lo.ndim, axis),
+            )
+        return np.concatenate(xs, axis=axis)
+
+    @staticmethod
+    def where(c, a, b):
+        if isinstance(a, ABool) or isinstance(b, ABool):
+            return ABool(
+                np.broadcast_shapes(
+                    _shape_of(c), _shape_of(a), _shape_of(b)
+                )
+            )
+        if _is_abs(c) or _is_abs(a) or _is_abs(b):
+            # data-dependent select: join both branches (sound for any
+            # condition value, concrete or abstract)
+            return _join_iv(a, b)
+        return np.where(c, a, b)
+
+    @staticmethod
+    def all(x, axis=None, **kw):
+        if isinstance(x, ABool):
+            return ABool(_reduce_shape(x.shape, axis))
+        if isinstance(x, IArr):
+            return ABool(_reduce_shape(x.shape, axis))
+        return np.all(x, axis=axis, **kw)
+
+    @staticmethod
+    def any(x, axis=None, **kw):
+        if isinstance(x, (ABool, IArr)):
+            return ABool(_reduce_shape(x.shape, axis))
+        return np.any(x, axis=axis, **kw)
+
+    @staticmethod
+    def right_shift(x, n):
+        if isinstance(x, IArr):
+            # arithmetic shift = floor division by 2^n: monotone
+            return IArr(x.lo >> n, x.hi >> n, "shr")
+        return np.right_shift(np.asarray(x), n)
+
+    @staticmethod
+    def bitwise_and(x, m):
+        if isinstance(x, IArr):
+            m = int(m)
+            k = _bits(m)
+            assert m == (1 << k) - 1, "bitwise_and shim needs a low mask"
+            blk_lo = x.lo >> k
+            exact = blk_lo == (x.hi >> k)
+            lo = np.where(exact, x.lo & m, 0)
+            hi = np.where(exact, x.hi & m, m)
+            return IArr(lo, hi, "mask")
+        return np.bitwise_and(np.asarray(x), m)
+
+    @staticmethod
+    def einsum(subscripts, a, b, preferred_element_type=None):
+        # ops/fp._fold's "...k,kw->...w" contraction, accumulated
+        # per-term so each partial sum is int32-checked like the
+        # kernel's own accumulation order
+        assert subscripts == "...k,kw->...w", subscripts
+        a = as_iv(a)
+        m = np.asarray(b)
+        acc = None
+        for k in range(m.shape[0]):
+            term = a[..., k : k + 1] * m[k][None]
+            acc = term if acc is None else acc + term
+        return acc
+
+    @staticmethod
+    def take_along_axis(t, idx, axis):
+        if isinstance(t, IArr):
+            lo = t.lo.min(axis=axis, keepdims=True)
+            hi = t.hi.max(axis=axis, keepdims=True)
+            shape = list(t.shape)
+            shape[axis] = np.shape(idx)[axis]
+            return IArr(
+                np.broadcast_to(lo, shape), np.broadcast_to(hi, shape)
+            )
+        return np.take_along_axis(np.asarray(t), idx, axis)
+
+
+# ------------------------------------------------------- eager control flow
+
+
+def _tree_map(f, *trees):
+    import jax
+
+    return jax.tree_util.tree_map(
+        f, *trees, is_leaf=lambda x: _is_abs(x)
+    )
+
+
+def _eager_scan(f, init, xs, length=None, reverse=False, unroll=1, **_kw):
+    import jax
+
+    leaves = jax.tree_util.tree_leaves(
+        xs, is_leaf=lambda x: _is_abs(x)
+    )
+    n = int(length) if length is not None else int(leaves[0].shape[0])
+    idx = range(n - 1, -1, -1) if reverse else range(n)
+    carry = init
+    ys = []
+    for i in idx:
+        xi = (
+            None
+            if xs is None
+            else _tree_map(lambda a: a[i], xs)
+        )
+        carry, y = f(carry, xi)
+        ys.append(y)
+    if reverse:
+        ys = ys[::-1]
+    if ys and jax.tree_util.tree_leaves(
+        ys[0], is_leaf=lambda x: _is_abs(x)
+    ):
+        stacked = _tree_map(lambda *a: _Shim.stack(a, axis=0), *ys)
+    else:
+        stacked = ys[0] if ys else None
+    return carry, stacked
+
+
+def _eager_cond(pred, true_fun, false_fun, *operands, **_kw):
+    if isinstance(pred, (ABool, IArr)):
+        a = true_fun(*operands)
+        b = false_fun(*operands)
+        return _tree_map(_join_iv, a, b)
+    return (
+        true_fun(*operands)
+        if bool(np.asarray(pred))
+        else false_fun(*operands)
+    )
+
+
+def _eager_dynamic_index(t, i, axis=0, keepdims=True):
+    if isinstance(i, (ABool, IArr)):
+        # unknown index: join every entry along the axis
+        ti = as_iv(t)
+        lo = ti.lo.min(axis=axis, keepdims=keepdims)
+        hi = ti.hi.max(axis=axis, keepdims=keepdims)
+        return IArr(lo, hi, "gather")
+    ii = int(np.asarray(i))
+    if isinstance(t, IArr):
+        out = IArr(
+            np.take(t.lo, ii, axis=axis), np.take(t.hi, ii, axis=axis)
+        )
+        return out
+    out = np.take(np.asarray(t), ii, axis=axis)
+    if keepdims:
+        out = np.expand_dims(out, axis)
+    return out
+
+
+# ------------------------------------------------------------------ recorder
+
+
+class _BoundsRecorder:
+    """fp.CENSUS hook for bounds mode: runs each kernel body on
+    interval arrays inside an attribution frame, memoized by
+    (name, kwargs, input intervals). Also the fp.BOUNDS hook that
+    norm-schedule sites report through."""
+
+    def __init__(self):
+        self.memo = {}
+        self.bodies = OrderedDict()   # name -> {entry_bound, calls}
+
+    def __call__(self, name, fn, arrays, kw):
+        from .lane import fp
+
+        ivs = tuple(as_iv(a) for a in arrays)
+        kwk = tuple(sorted((k, bool(v)) for k, v in kw.items()))
+        key = (name, kwk, tuple(a.key() for a in ivs))
+        hit = self.memo.get(key)
+        if hit is not None:
+            return hit
+        st = self.bodies.setdefault(
+            name, {"entry_bound": 0, "calls": 0}
+        )
+        st["entry_bound"] = max(
+            st["entry_bound"], max(a.mag() for a in ivs)
+        )
+        st["calls"] += 1
+        _CTX.push(("body", name))
+        try:
+            res = fn(fp.FOLDS_NP, fp.TOPFM_NP, *ivs, **kw)
+        finally:
+            _CTX.pop()
+        self.memo[key] = res
+        return res
+
+    # fp.BOUNDS seam: every `_norm`/`norm3_x` site reports here
+    def norm_site(self, site, passes, x, topf, norm1):
+        from .lane import fp
+
+        x = as_iv(x)
+        body = next(
+            (k[1] for k in reversed(_CTX.stack) if k[0] == "body"), None
+        )
+        rec = _CTX.sites.setdefault(
+            site,
+            {
+                "passes": passes,
+                "open": site in fp._OPEN_SITES,
+                "input_bound": 0,
+                "output_bound": 0,
+                "bodies": set(),
+            },
+        )
+        rec["input_bound"] = max(rec["input_bound"], x.mag())
+        rec["passes"] = passes
+        if body:
+            rec["bodies"].add(body)
+        _CTX.push(("site", site))
+        try:
+            for _ in range(passes):
+                x = norm1(x, topf)
+        finally:
+            _CTX.pop()
+        rec["output_bound"] = max(rec["output_bound"], x.mag())
+        return x
+
+    # fp.BOUNDS seam: canonical()'s subtract ladder only reduces
+    # values v with v + KP in (0, p*2^7) — a VALUE property that no
+    # limb-level int32 check can see (any ~2^12-limb array has a
+    # ~2^397 limb hull regardless of its actual value). The tracked
+    # value intervals (exact through the open-pass canon chain) bound
+    # it; a trimmed schedule that loosens the pre-ripple value past
+    # this window is rejected here.
+    def canonical_window(self, xk, axis=-2):
+        from ..crypto.bls.params import P
+        from . import fp as basefp
+
+        xk = as_iv(xk)
+        vlo, vhi = _value_of(xk, axis)
+        kp = basefp._KP
+        win = P << 7
+        lo_off = vlo + kp
+        hi_off = vhi + kp
+        margin = round(
+            math.log2(win) - math.log2(max(hi_off, 1)), 2
+        )
+        key = "canonical.ripple" + (".base" if axis == -1 else ".lane")
+        rec = _CTX.windows.setdefault(
+            key,
+            {
+                "offset_lo_bits": _bits(max(lo_off, 0)),
+                "offset_hi_bits": _bits(max(hi_off, 0)),
+                "window_bits": _bits(win),
+                "margin_bits": margin,
+            },
+        )
+        rec["offset_lo_bits"] = min(
+            rec["offset_lo_bits"], _bits(max(lo_off, 0))
+        )
+        rec["offset_hi_bits"] = max(
+            rec["offset_hi_bits"], _bits(max(hi_off, 0))
+        )
+        rec["margin_bits"] = min(rec["margin_bits"], margin)
+        if lo_off <= 0 or hi_off >= win:
+            raise BoundsViolation(
+                "canonical ripple value window violated: offset value "
+                f"v+KP in [2^{_bits(max(lo_off, 0))}, "
+                f"2^{_bits(max(hi_off, 0))}] must sit inside "
+                f"(0, p*2^7 = 2^{_bits(win)}) — the norm schedule "
+                "feeding canonical() is too shallow"
+            )
+
+
+# ------------------------------------------------------------------ mode
+
+
+class bounds_mode:
+    """Swap the lane modules into the interval world (under the census
+    lock — bounds mode and census mode share the kernel_op seam and
+    must never overlap with real execution)."""
+
+    def __enter__(self):
+        import jax
+
+        from . import costs
+        from . import fp as basefp
+        from .lane import chains, fp, htc, jacobian, pairing, tower
+        from ..crypto.bls.backends import tpu as TB
+
+        costs._CENSUS_LOCK.acquire()
+        self._jax = jax
+        self._mods = [basefp, fp, tower, jacobian, htc, chains, pairing, TB]
+        self._saved_jnp = [(m, m.jnp) for m in self._mods]
+        self._saved_lax = (
+            jax.lax.scan,
+            jax.lax.cond,
+            jax.lax.dynamic_index_in_dim,
+        )
+        shim = _Shim()
+        for m in self._mods:
+            m.jnp = shim
+        jax.lax.scan = _eager_scan
+        jax.lax.cond = _eager_cond
+        jax.lax.dynamic_index_in_dim = _eager_dynamic_index
+        self._fp = fp
+        self._basefp = basefp
+        self._patches = [
+            _SeamPatches(fp, axis=-2, lane=True),
+            _SeamPatches(basefp, axis=-1, lane=False),
+        ]
+        for p in self._patches:
+            p.install()
+        self.recorder = _BoundsRecorder()
+        fp.CENSUS = self.recorder
+        fp.BOUNDS = self.recorder
+        basefp.BOUNDS = self.recorder
+        global _CTX
+        _CTX = self.ctx = _Ctx()
+        return self
+
+    def __exit__(self, *exc):
+        global _CTX
+        _CTX = None
+        self._fp.CENSUS = None
+        self._fp.BOUNDS = None
+        self._basefp.BOUNDS = None
+        for p in self._patches:
+            p.restore()
+        jax = self._jax
+        jax.lax.scan, jax.lax.cond, jax.lax.dynamic_index_in_dim = (
+            self._saved_lax
+        )
+        for m, j in self._saved_jnp:
+            m.jnp = j
+        from . import costs
+
+        costs._CENSUS_LOCK.release()
+        return False
+
+
+# ------------------------------------------------------------------ programs
+#
+# Abstract inputs: canonical field elements (limbs in [0, MASK]),
+# {0,1} scalar bits, concrete pad masks. Together the programs visit
+# every kernel_op body and every schedule site in ops/.
+
+
+def _canon1(S):
+    from .lane import fp
+    from ..crypto.bls.params import P
+
+    z = np.zeros((fp.W, S), np.int64)
+    return IArr(z, z + fp.MASK, val=(0, P - 1))
+
+
+def _canon2(S):
+    from .lane import fp
+    from ..crypto.bls.params import P
+
+    z = np.zeros((2, fp.W, S), np.int64)
+    return IArr(z, z + fp.MASK, val=(0, P - 1))
+
+
+def _bits_iv(n, S):
+    z = np.zeros((n, S), np.int64)
+    return IArr(z, z + 1)
+
+
+def _prog_verify():
+    """The whole batch-verification kernel at S=2 — local_phase +
+    finish_phase end-to-end, exactly the program the census prices."""
+    from ..crypto.bls.backends import tpu as TB
+
+    S = 2
+    pad = np.zeros(S, bool)
+    f_local, s_local, sub_ok = TB.local_phase(
+        _canon1(S), _canon1(S), _canon2(S), _canon2(S),
+        _canon2(S), _canon2(S), _bits_iv(64, S), pad,
+    )
+    TB.finish_phase(f_local, s_local, sub_ok)
+
+
+def _prog_dyn_ladder():
+    """Per-element dynamic ladders (ladder_step_f1/f2 bodies) — used by
+    the KZG/MSM workloads, not the verify kernel."""
+    from .lane import fp, jacobian as J
+
+    S = 2
+    bits = _bits_iv(8, S)
+    base1 = (_canon1(S), _canon1(S), _canon1(S))
+    base2 = (_canon2(S), _canon2(S), _canon2(S))
+    J.scalar_mul(J.FP1, base1, bits)
+    J.scalar_mul(J.FP2, base2, bits)
+    # exact add / jac_eq glue (lane_sum path uses exact=True)
+    J.add(J.FP1, base1, base1, exact=True)
+
+
+def _prog_norm3_kernel():
+    """The standalone norm3 kernel + normalize glue at the documented
+    12-standard-element add-chain depth."""
+    from .lane import fp
+
+    S = 2
+    acc = _canon1(S)
+    for _ in range(11):
+        acc = acc + _canon1(S)
+    fp.norm3(acc)
+    fp.normalize(acc)
+    fp.reduce_light(acc)
+    fp.canonical(-acc)
+
+
+def _prog_base_fp():
+    """ops/fp.py (the XLA oracle core): mul on 3-term lazy sums, sqr,
+    normalize on a 12-term chain, reduce_light, canonical on negated
+    lazy values, pow_const — the scan bodies included."""
+    from . import fp as B
+    from ..crypto.bls.params import P
+
+    def canon(n):
+        z = np.zeros((n, B.W), np.int64)
+        return IArr(z, z + B.MASK, val=(0, P - 1))
+
+    a = canon(2)
+    b = canon(2)
+    c = canon(2)
+    tri = a + b - c
+    B.mul(tri, tri)
+    B.sqr(tri)
+    acc = canon(2)
+    for _ in range(11):
+        acc = acc + canon(2)
+    B.normalize(acc)
+    B.reduce_light(acc)
+    B.canonical(-acc)
+    B.eq(a, b)
+    B.pow_const(tri, 0xD201000000010000)
+
+
+def _prog_f12_standalone():
+    """The two standalone tower kernels the fused Miller bodies inline
+    (f12sqr, f12mul_034) at their DOCUMENTED contract inputs (f lazy
+    <=4u, line coefficients standard) — registered kernel_ops must all
+    carry certificates (graft-lint R6), reached or not by the fused
+    verify path."""
+    from .lane import tower
+    from ..crypto.bls.params import P
+
+    S = 2
+
+    def lazy4(shape_prefix):
+        from .lane import fp
+
+        z = np.zeros((*shape_prefix, fp.W, S), np.int64)
+        return IArr(z, z + 4 * fp.MASK, val=(0, 4 * (P - 1)))
+
+    f = lazy4((2, 3, 2))
+    tower.f12sqr(f)
+    tower.f12mul_034(f, _canon2(S), _canon2(S), _canon2(S))
+
+
+PROGRAMS = (
+    ("lane.verify", _prog_verify),
+    ("lane.dyn_ladder", _prog_dyn_ladder),
+    ("lane.norm_chain", _prog_norm3_kernel),
+    ("lane.f12_standalone", _prog_f12_standalone),
+    ("base.fp", _prog_base_fp),
+)
+
+
+# ------------------------------------------------------------------ derive
+
+
+def derive(programs=None) -> dict:
+    """Run the abstract interpretation and assemble the certificate
+    payload. Raises BoundsViolation if any program can overflow int32
+    under the current norm schedule."""
+    from .lane import fp
+
+    with bounds_mode() as bm:
+        ran = []
+        for name, prog in PROGRAMS:
+            if programs is not None and name not in programs:
+                continue
+            _CTX.push(("program", name))
+            try:
+                prog()
+            finally:
+                _CTX.pop()
+            ran.append(name)
+        ctx = bm.ctx
+        rec = bm.recorder
+        sites = OrderedDict()
+        body_max = {
+            k[1]: v for k, v in ctx.frames.items() if k[0] == "body"
+        }
+        for site, r in sorted(ctx.sites.items()):
+            bodies = sorted(r["bodies"])
+            # headroom of the tightest enclosing body (glue sites use
+            # their own frame): how close the site's schedule lets the
+            # surrounding arithmetic get to 2^31
+            if bodies:
+                m = max(body_max.get(b, 0) for b in bodies)
+            else:
+                m = ctx.frames.get(("site", site), 0)
+            sites[site] = {
+                "passes": r["passes"],
+                "open": bool(r.get("open")),
+                "input_bound": int(r["input_bound"]),
+                "output_bound": int(r["output_bound"]),
+                "max_abs": int(m),
+                "headroom_bits": _headroom_bits(m),
+                "bodies": bodies,
+            }
+        bodies = OrderedDict()
+        for name in sorted(rec.bodies):
+            m = int(body_max.get(name, 0))
+            bodies[name] = {
+                "entry_bound": int(rec.bodies[name]["entry_bound"]),
+                "calls": int(rec.bodies[name]["calls"]),
+                "max_abs": m,
+                "headroom_bits": _headroom_bits(m),
+            }
+        gmax = int(ctx.max_abs)
+        windows = {k: dict(v) for k, v in ctx.windows.items()}
+    return {
+        "schema": SCHEMA,
+        "schedule": dict(fp._SCHED),
+        "open_sites": sorted(fp._OPEN_SITES),
+        "programs": ran,
+        "sites": dict(sites),
+        "bodies": dict(bodies),
+        "windows": windows,
+        "max_abs": gmax,
+        "min_headroom_bits": _headroom_bits(gmax),
+    }
+
+
+# ------------------------------------------------------------------ cache
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def certificate_path() -> str:
+    return os.path.join(
+        _repo_root(), "tests", "budgets", "limb_bounds.json"
+    )
+
+
+def cache_path() -> str:
+    return os.path.join(_repo_root(), ".limb_bounds_cache.json")
+
+
+def _fingerprint() -> str:
+    """Certificate key: the R3 kernel-source set EXTENDED with the base
+    XLA core (ops/fp.py — the base.fp program and the base ripple
+    window certify it) and this module (a transfer-rule edit must
+    stale every certificate too). graft-lint R6 mirrors this exact
+    computation statically (limb_bounds_fingerprint)."""
+    from ..crypto.bls.backends import tpu as TB
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    return TB.source_fingerprint(
+        extra_paths=[
+            os.path.join(here, "fp.py"),
+            os.path.join(here, "bounds.py"),
+        ]
+    )
+
+
+def derive_cached(use_cache: bool = True) -> dict:
+    """derive(), memoized on disk by (BOUNDS_VERSION, kernel source
+    fingerprint) — the same warm-run trick as graft-lint's result
+    cache, keeping the tier-1 --check well under its 20 s budget."""
+    fpr = _fingerprint()
+    if use_cache:
+        try:
+            with open(cache_path()) as f:
+                doc = json.load(f)
+            if (
+                doc.get("version") == BOUNDS_VERSION
+                and doc.get("source_fingerprint") == fpr
+            ):
+                return doc["derived"]
+        except Exception:
+            pass
+    derived = derive()
+    derived["source_fingerprint"] = fpr
+    if use_cache:
+        try:
+            tmp = cache_path() + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(
+                    {
+                        "version": BOUNDS_VERSION,
+                        "source_fingerprint": fpr,
+                        "derived": derived,
+                    },
+                    f,
+                )
+            os.replace(tmp, cache_path())
+        except OSError:
+            pass
+    return derived
+
+
+# ------------------------------------------------------------------ validate
+
+
+def load_certificate(path: str | None = None) -> dict:
+    with open(path or certificate_path()) as f:
+        return json.load(f)
+
+
+def check_certificate(cert: dict, derived: dict | None = None) -> list:
+    """Problems between the checked-in certificate and a fresh
+    derivation ([] = certified). The comparison is exact on interval
+    endpoints and pass depths — a certificate that OVERSTATES headroom
+    (or understates an input bound) is rejected, never trusted."""
+    problems = []
+    if cert.get("schema") != SCHEMA:
+        return [f"certificate schema {cert.get('schema')!r} != {SCHEMA}"]
+    fpr = _fingerprint()
+    if cert.get("source_fingerprint") != fpr:
+        problems.append(
+            f"certificate fingerprint {cert.get('source_fingerprint')} is "
+            f"stale (kernel sources are {fpr}) — re-prove: "
+            "python tools/limb_bounds.py --update"
+        )
+    if derived is None:
+        derived = derive_cached()
+    from .lane import fp
+
+    if cert.get("schedule") != dict(fp._SCHED):
+        problems.append(
+            "certificate schedule differs from ops/lane/fp.py _SCHED — "
+            "re-prove: python tools/limb_bounds.py --update"
+        )
+    if cert.get("open_sites") != sorted(fp._OPEN_SITES):
+        problems.append(
+            "certificate open-site set differs from ops/lane/fp.py "
+            "_OPEN_SITES — re-prove: python tools/limb_bounds.py --update"
+        )
+    for kind in ("sites", "bodies"):
+        got = derived.get(kind, {})
+        pinned = cert.get(kind, {})
+        for name in got:
+            if name not in pinned:
+                problems.append(
+                    f"{kind[:-1]} {name!r} has no certificate entry — "
+                    "re-prove: python tools/limb_bounds.py --update"
+                )
+                continue
+            g, p = got[name], pinned[name]
+            for field in ("passes", "open", "input_bound", "output_bound",
+                          "max_abs", "entry_bound"):
+                if field not in g:
+                    continue
+                if int(p.get(field, -1)) != int(g[field]):
+                    direction = (
+                        "overstates soundness"
+                        if (
+                            (field in ("input_bound", "entry_bound",
+                                       "max_abs")
+                             and int(p.get(field, -1)) < int(g[field]))
+                            or (field == "passes"
+                                and int(p.get(field, -1)) > int(g[field]))
+                        )
+                        else "is stale"
+                    )
+                    problems.append(
+                        f"{kind[:-1]} {name!r}: certified {field}="
+                        f"{p.get(field)} but the prover derives "
+                        f"{g[field]} — the certificate {direction}"
+                    )
+            gh = _headroom_bits(int(g.get("max_abs", 0)))
+            ph = p.get("headroom_bits")
+            if ph is not None and float(ph) - gh > 0.01:
+                problems.append(
+                    f"{kind[:-1]} {name!r}: certified headroom "
+                    f"{ph} bits overstates the derived {gh} bits"
+                )
+        for name in pinned:
+            if name not in got:
+                problems.append(
+                    f"{kind[:-1]} {name!r} is certified but no longer "
+                    "reached by any prover program — re-prove: "
+                    "python tools/limb_bounds.py --update"
+                )
+    for name, g in derived.get("windows", {}).items():
+        p = cert.get("windows", {}).get(name)
+        if p != g:
+            problems.append(
+                f"value window {name!r}: certified {p} != derived {g}"
+            )
+    if int(cert.get("max_abs", -1)) != int(derived["max_abs"]):
+        problems.append(
+            f"certified global max_abs {cert.get('max_abs')} != derived "
+            f"{derived['max_abs']}"
+        )
+    return problems
+
+
+def build_certificate(derived: dict | None = None) -> dict:
+    if derived is None:
+        derived = derive_cached(use_cache=False)
+    doc = {
+        "schema": SCHEMA,
+        "comment": "Per-site limb-bounds certificates for the Fp "
+        "kernels (ops/bounds.py abstract interpreter). Proves "
+        "int32-overflow freedom for every ops/ kernel body under the "
+        "norm schedule baked into ops/lane/fp.py _SCHED. Stale or "
+        "hand-edited entries fail tools/limb_bounds.py --check and "
+        "graft-lint R6; refresh with: python tools/limb_bounds.py "
+        "--update",
+        "source": "ops/bounds.py derive()",
+        "source_fingerprint": derived.get(
+            "source_fingerprint", _fingerprint()
+        ),
+        "schedule": derived["schedule"],
+        "open_sites": derived["open_sites"],
+        "programs": derived["programs"],
+        "max_abs": derived["max_abs"],
+        "min_headroom_bits": derived["min_headroom_bits"],
+        "windows": derived.get("windows", {}),
+        "sites": derived["sites"],
+        "bodies": derived["bodies"],
+    }
+    return doc
+
+
+# ------------------------------------------------------------------ summary
+
+
+def trimmed_passes_per_mul(sched: dict | None = None) -> int:
+    """Carry passes removed from the Fp-mul pipeline vs the untrimmed
+    3-pass schedule (the bench `detail.bounds` headline)."""
+    from .lane import fp
+
+    sched = sched if sched is not None else fp._SCHED
+    return sum(3 - int(sched[s]) for s in fp.MUL_SITES)
+
+
+def summary(use_cache: bool = True) -> dict:
+    """The bench/report payload: certificate status + headline numbers.
+    Never raises — a violation or a stale certificate is reported as a
+    payload, exactly like the census's dead-tunnel sections."""
+    from .lane import fp
+
+    out = {
+        "schema": SCHEMA,
+        "trimmed_passes_per_mul": trimmed_passes_per_mul(),
+    }
+    try:
+        derived = derive_cached(use_cache=use_cache)
+        out["certified_sites"] = len(derived["sites"])
+        out["certified_bodies"] = len(derived["bodies"])
+        out["min_headroom_bits"] = derived["min_headroom_bits"]
+        out["source_fingerprint"] = derived.get("source_fingerprint")
+        try:
+            problems = check_certificate(
+                load_certificate(), derived
+            )
+        except Exception as e:
+            problems = [f"certificate unreadable: {e}"]
+        out["certificate_ok"] = not problems
+        if problems:
+            out["problems"] = problems[:8]
+    except BoundsViolation as e:
+        out["certificate_ok"] = False
+        out["violation"] = str(e)
+    except Exception as e:  # pragma: no cover - defensive bench path
+        out["certificate_ok"] = False
+        out["error"] = f"{type(e).__name__}: {e}"
+    return out

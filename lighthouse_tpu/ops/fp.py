@@ -21,14 +21,26 @@ FOLD[i] = limbs(2^(11*(35+i)) mod p). Folding is a single dense
 where Montgomery REDC would be W serially-dependent carry steps. Three
 fold rounds bound every product at value < 2^392.2 ("standard").
 
-Contract (the only rules callers must respect)
-----------------------------------------------
-- `mul`/`sqr` inputs: sums/differences of at most THREE standard
-  elements (limb bound 3*(2^11+2) keeps conv coefficients < 2^31).
-- `normalize` accepts any |limbs| < 2^30 with |value| < capacity and
-  returns standard-limbed output; use it to reset deeper add chains
-  (sums of up to 12 standard elements).
-- Exact compare/serialize only via `canonical` (boundary op).
+Contract (machine-checked — see tests/budgets/limb_bounds.json)
+---------------------------------------------------------------
+The limb/value bounds that used to live here as prose ("sums of at
+most THREE standard elements", "Three fold rounds bound every
+product") are now DERIVED, per call site, by the abstract interpreter
+in ops/bounds.py and pinned as certificates in
+tests/budgets/limb_bounds.json (refresh: `python tools/limb_bounds.py
+--update`; checked in tier-1 and by graft-lint R6). The operational
+rules that remain for callers:
+
+- `mul`/`sqr` accept lazy sums/differences whose limbs stay inside
+  the certified `mul.entry_*` input interval (the certificate file is
+  the authoritative bound, not this docstring).
+- `normalize` resets deeper add chains; its certified input interval
+  is the `normalize` site entry (derived for 12-standard-element
+  chains — NOT "any |limbs| < 2^30": the prover refuted that older
+  claim, see BASELINE.md §Bounds contract).
+- Exact compare/serialize only via `canonical` (boundary op). Its
+  pre-ripple reduction uses VALUE-PRESERVING top-open carry passes
+  (`norm1_open`) so the subtract-ladder window is certifiable.
 
 All ops broadcast over arbitrary leading batch dims.
 """
@@ -137,6 +149,25 @@ def norm3(x):
     carry-fold stays in int32 (true everywhere in this codebase: conv
     outputs are zero-padded on top; add-chain norms see small sums)."""
     return norm1(norm1(norm1(x)))
+
+
+def norm1_open(x):
+    """One VALUE-PRESERVING carry pass: like `norm1`, but the top limb
+    re-absorbs its own carry (top = lo + 2^B*carry = unchanged) instead
+    of folding it mod p. Used on canonical()'s pre-ripple chain, where
+    the limb-bounds prover certifies a VALUE window: topfold passes
+    make that window uncertifiable (a -1 top carry re-inflates the
+    value by ~2^396 and interval joins keep the branch alive) and cost
+    a W-wide multiply-add more per pass."""
+    lo = jnp.bitwise_and(x, MASK)
+    hi = jnp.right_shift(x, B)
+    out = lo + jnp.pad(hi[..., :-1], [(0, 0)] * (x.ndim - 1) + [(1, 0)])
+    top = hi[..., -1:] * (MASK + 1)
+    return out + jnp.pad(top, [(0, 0)] * (x.ndim - 1) + [(x.shape[-1] - 1, 0)])
+
+
+def norm3_open(x):
+    return norm1_open(norm1_open(norm1_open(x)))
 
 
 def _pad_to(x, width):
@@ -250,18 +281,40 @@ def _geq(x, y):
     return ~lt
 
 
+# Limb-bounds seam (ops/bounds.py): installed only by bounds_mode,
+# under the census lock, always restored to None — same discipline as
+# the lane module's CENSUS/BOUNDS seams.
+BOUNDS = None
+
+
+def _canon_reduce(x):
+    """canonical()'s pre-ripple reduction: one value-preserving
+    normalization + four mod-p fold rounds. Open (topfold-free) passes
+    keep the encoded value shrinking MONOTONICALLY through the folds —
+    each fold's top-limb coefficient is bounded by the incoming value —
+    which is what lets ops/bounds.py certify the ripple window below.
+    The per-round value bounds that used to annotate these lines are
+    derived exactly by the prover (tests/budgets/limb_bounds.json)."""
+    x = norm3_open(x)
+    x = norm3_open(_fold(x, FOLD_1))
+    x = norm3_open(_fold(x, FOLD_1))
+    x = norm3_open(_fold(x, FOLD_1))
+    x = norm3_open(_fold(x, FOLD_1))
+    return x
+
+
 def canonical(x):
     """Unique representative in [0, p), canonical limbs [..., W].
 
-    Boundary-only op (compare/serialize). Fold rounds first shrink the
-    value into (-2^385.6, 2^385.6) ⊂ (-32p, 32p), so the binary
-    conditional-subtract ladder needs only 6 rounds (vs ~20 from raw
-    lazy range) — this op sits inside every exact point-add, so its HLO
-    footprint matters.
+    Boundary-only op (compare/serialize). The open-pass fold chain
+    shrinks the value into the certified ripple window (v + KP in
+    (0, p*2^7)), so the binary conditional-subtract ladder needs only
+    _LADDER_ROUNDS rounds (vs ~20 from raw lazy range) — this op sits
+    inside every exact point-add, so its HLO footprint matters.
     """
-    x = reduce_light(x)                      # |value| < 2^390.3
-    x = norm3(_fold(x, FOLD_1))              # |value| < 2^387.5
-    x = norm3(_fold(x, FOLD_1))              # |value| < 2^385.6
+    x = _canon_reduce(x)
+    if BOUNDS is not None:
+        BOUNDS.canonical_window(x, axis=-1)
     x = _ripple(_pad_to(x, 37) + KP_37)      # value in (0, p*2^7), canonical
     for k in reversed(range(_LADDER_ROUNDS)):
         # subtract p*2^k when it doesn't underflow: detect via the

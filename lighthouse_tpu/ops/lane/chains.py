@@ -52,7 +52,7 @@ def _pow_table(a):
     one = jnp.broadcast_to(jnp.asarray(fp.ONE)[:, None], a.shape).astype(
         jnp.int32
     )
-    a1 = fp.norm3_x(a)
+    a1 = fp.norm3_x(a, site="chains.pow_table.entry")
     a2 = fp.sqr(a1)
     p34 = fp.mul(jnp.stack([a2, a2]), jnp.stack([a1, a2]))
     a3, a4 = p34[0], p34[1]
@@ -92,7 +92,7 @@ def inv(a):
 
 def f2inv(a):
     """1/(a0 + a1 u) via one windowed Fp inversion of the norm."""
-    a = fp.norm3_x(a)
+    a = fp.norm3_x(a, site="chains.f2inv.entry")
     a0, a1 = a[..., 0, :, :], a[..., 1, :, :]
     sq = fp.mul(jnp.stack([a0, a1], -3), jnp.stack([a0, a1], -3))
     norm = sq[..., 0, :, :] + sq[..., 1, :, :]

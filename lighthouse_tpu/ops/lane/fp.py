@@ -114,8 +114,110 @@ def _norm1(x, topf):
     return out + hi[..., -1:, :] * tf[:, None]
 
 
-def _norm3(x, topf):
-    return _norm1(_norm1(_norm1(x, topf), topf), topf)
+def _norm1_open(x, topf):
+    """One VALUE-PRESERVING carry pass: limbs below the top are masked
+    and their carries shifted up as usual, but the top limb re-absorbs
+    its own carry (top = lo_top + 2^B * carry_top = unchanged) instead
+    of folding it mod p. No topfold event means the encoded value is
+    EXACTLY preserved — the property that makes canonical()'s ripple
+    window certifiable by the limb-bounds prover (ops/bounds.py): a
+    topfold with a negative top carry re-inflates the value by
+    ~2^396, which a sound interval join can never rule out. Cheaper
+    than `_norm1` too (no W-wide topfold multiply-add). `topf` is
+    accepted and ignored to keep the schedule-site signature uniform."""
+    del topf
+    lo = jnp.bitwise_and(x, MASK)
+    hi = jnp.right_shift(x, B)
+    pad = [(0, 0)] * (x.ndim - 2) + [(1, 0), (0, 0)]
+    out = lo + jnp.pad(hi[..., :-1, :], pad)
+    toppad = [(0, 0)] * (x.ndim - 2) + [(x.shape[-2] - 1, 0), (0, 0)]
+    return out + jnp.pad(hi[..., -1:, :] * (MASK + 1), toppad)
+
+
+# Carry-pass schedule (ISSUE 14): per-site norm depths, proven sound by
+# the limb-bounds certificate (tests/budgets/limb_bounds.json, derived
+# by ops/bounds.py abstract-interpreting THIS source). The dict is a
+# literal on purpose: the kernel source fingerprint (graft-lint R3) and
+# the Mosaic compilation-cache keys both cover it, so a depth edit
+# invalidates profiles, budgets and device caches like any kernel edit.
+# 3 = the historical worst-case norm3; trimmed sites carry the prover's
+# certified depth. Edit only together with
+# `python tools/limb_bounds.py --update` (graft-lint R6 fails otherwise).
+_SCHED = {
+    # Fp-mul pipeline: entries keep 2 passes (lazy 3-term sums), the
+    # first two fold contractions need NO carry pass (the fold matrix
+    # absorbs the conv-sized limbs within int32 — certificate
+    # mul.fold37/fold36), one pass re-standardizes after the last fold
+    "mul.entry_a": 2,
+    "mul.entry_b": 2,
+    "mul.wide": 2,
+    "mul.fold37": 0,
+    "mul.fold36": 0,
+    "mul.fold35": 1,
+    "sqr.entry": 1,
+    "rl.entry": 0,
+    "rl.fold_a": 0,
+    "rl.fold_b": 1,
+    # public reset points: the prover certifies 0 passes inside the
+    # traced programs (every mul re-normalizes at entry), but the
+    # norm3/normalize API contract is "returns standard limbs" for
+    # ANY caller — pinned at the 2 passes that re-standardize the
+    # documented 12-element chain, never trimmed further
+    "norm3.kernel": 2,
+    "normalize": 2,
+    # canonical pre-ripple chain (open passes): the VALUE window
+    # v+KP in (0, p*2^7) is what binds here, not int32 — fold_b/fold_c
+    # must keep a pass or the window proof fails
+    "canon.entry": 0,
+    "canon.fold_a": 0,
+    "canon.fold_b": 1,
+    "canon.fold_c": 1,
+    "canon.fold_d": 0,
+    # glue entries ahead of kernels that re-normalize anyway: elided
+    "fp.pow_const.entry": 0,
+    "tower.f2inv.entry": 0,
+    "tower.f6inv.entry": 0,
+    "chains.pow_table.entry": 0,
+    "chains.f2inv.entry": 0,
+    "htc.ratio_chain.entry": 0,
+    "pairing.cyc_mul": 0,
+}
+
+# Sites whose passes are VALUE-PRESERVING (`_norm1_open`, no topfold):
+# the pre-ripple canonical chain, where the prover certifies a VALUE
+# window, not just limb-level int32 freedom. Everything else keeps the
+# topfold pass (`_norm1`) — mod-p re-absorption of the top carry.
+_OPEN_SITES = frozenset({
+    "canon.entry", "canon.fold_a", "canon.fold_b",
+    "canon.fold_c", "canon.fold_d",
+})
+
+# the norm sites on the Fp-mul pipeline (bench reports passes trimmed
+# off this path as `detail.bounds.trimmed_passes_per_mul`)
+MUL_SITES = (
+    "mul.entry_a", "mul.entry_b", "mul.wide",
+    "mul.fold37", "mul.fold36", "mul.fold35",
+)
+
+# tests force the untrimmed 3-pass schedule to differentially compare
+# trimmed vs full pipelines (bit-identical canonical outputs)
+_FORCE_FULL = False
+
+
+def _norm(x, topf, site: str):
+    """Schedule-parameterized carry normalization: `site` is a literal
+    id into _SCHED whose depth the limb-bounds certificate proves
+    sufficient for every input interval reaching this site. Unknown
+    sites run the full 3-pass schedule (safe; graft-lint R6 rejects
+    uncertified sites in ops/)."""
+    passes = 3 if _FORCE_FULL else _SCHED.get(site, 3)
+    body = _norm1_open if site in _OPEN_SITES else _norm1
+    h = BOUNDS
+    if h is not None:  # ops/bounds.py interval mode (census lock held)
+        return h.norm_site(site, passes, x, topf, body)
+    for _ in range(passes):
+        x = body(x, topf)
+    return x
 
 
 def _pad_limbs(x, width):
@@ -149,20 +251,39 @@ def _conv(a, b):
 
 def _mul_body(a, b, folds, topf, norm_a=True, norm_b=True):
     if norm_a:
-        a = _norm3(a, topf)
+        a = _norm(a, topf, "mul.entry_a")
     if norm_b:
-        b = _norm3(b, topf)
-    wide = _norm3(_conv(a, b), topf)
-    x = _norm3(_pad_limbs(_fold(wide, folds[:, :38]), 37), topf)
-    x = _norm3(_fold(x, folds[:, 38:40]), topf)
-    x = _norm3(_fold(x, folds[:, 40:41]), topf)
+        b = _norm(b, topf, "mul.entry_b")
+    wide = _norm(_conv(a, b), topf, "mul.wide")
+    x = _norm(_pad_limbs(_fold(wide, folds[:, :38]), 37), topf, "mul.fold37")
+    x = _norm(_fold(x, folds[:, 38:40]), topf, "mul.fold36")
+    x = _norm(_fold(x, folds[:, 40:41]), topf, "mul.fold35")
     return x
 
 
 def _reduce_light_body(x, folds, topf):
-    x = _norm3(x, topf)
-    x = _norm3(_fold(x, folds[:, 40:41]), topf)
-    x = _norm3(_fold(x, folds[:, 40:41]), topf)
+    x = _norm(x, topf, "rl.entry")
+    x = _norm(_fold(x, folds[:, 40:41]), topf, "rl.fold_a")
+    x = _norm(_fold(x, folds[:, 40:41]), topf, "rl.fold_b")
+    return x
+
+
+def _canon_reduce_body(x, folds, topf):
+    """canonical()'s pre-ripple reduction: value-preserving (top-open)
+    carry passes + four mod-p fold rounds, fused in one kernel.
+
+    Replaces the old reduce_light + two glue folds. The open passes
+    never topfold, so the encoded value shrinks MONOTONICALLY through
+    the folds (each fold's top-limb coefficient is bounded by the
+    incoming value) — the property the limb-bounds prover needs to
+    certify the ripple window value in (-KP, p*2^7 - KP). With topfold
+    passes the certificate is impossible: a -1 top carry re-inflates
+    the value by ~2^396 and interval joins keep that branch alive."""
+    x = _norm(x, topf, "canon.entry")
+    x = _norm(_fold(x, folds[:, 40:41]), topf, "canon.fold_a")
+    x = _norm(_fold(x, folds[:, 40:41]), topf, "canon.fold_b")
+    x = _norm(_fold(x, folds[:, 40:41]), topf, "canon.fold_c")
+    x = _norm(_fold(x, folds[:, 40:41]), topf, "canon.fold_d")
     return x
 
 
@@ -194,6 +315,13 @@ def _lane_tile(n_elems_per_lane: int) -> int:
 # (vs minutes of jax tracing). None in production; only ops/costs.py
 # census contexts set it, under a lock, and always restore None.
 CENSUS = None
+
+# Limb-bounds seam (ops/bounds.py): when a prover is installed, every
+# `_norm`/`norm3_x` schedule site routes through it with its literal
+# site id, so the abstract interpreter attributes interval bounds and
+# headroom per site. Same discipline as CENSUS: None in production,
+# installed only by ops/bounds.py under the census lock.
+BOUNDS = None
 
 
 def kernel_op(fn, name: str):
@@ -293,7 +421,7 @@ def _mul_fn(folds, topf, a, b, norm_a=True, norm_b=True):
 
 
 def _sqr_fn(folds, topf, a, norm=True):
-    a2 = _norm3(a, topf) if norm else a
+    a2 = _norm(a, topf, "sqr.entry") if norm else a
     return _mul_body(a2, a2, folds, topf, norm_a=False, norm_b=False)
 
 
@@ -302,22 +430,40 @@ def _reduce_light_fn(folds, topf, x):
 
 
 def _norm3_fn(folds, topf, x):
-    return _norm3(x, topf)
+    return _norm(x, topf, "norm3.kernel")
+
+
+def _canon_reduce_fn(folds, topf, x):
+    return _canon_reduce_body(x, folds, topf)
 
 
 mul = kernel_op(_mul_fn, "mul")
 sqr = kernel_op(_sqr_fn, "sqr")
 reduce_light = kernel_op(_reduce_light_fn, "reduce_light")
 norm3 = kernel_op(_norm3_fn, "norm3")
+canon_reduce = kernel_op(_canon_reduce_fn, "canon_reduce")
 
 
-def norm3_x(x):
-    """XLA-side norm3 (no kernel launch) for cheap glue normalization."""
-    return _norm3(x, _TOPFM)
+def norm3_x(x, site: str = None):
+    """XLA-side carry normalization (no kernel launch) for cheap glue.
+
+    `site` names a certified depth in _SCHED (required for callers
+    inside ops/ — graft-lint R6); None runs the full 3-pass schedule."""
+    if site is None:
+        h = BOUNDS
+        if h is not None:
+            return h.norm_site("norm3_x.anon", 3, x, _TOPFM, _norm1)
+        return _norm1(_norm1(_norm1(x, _TOPFM), _TOPFM), _TOPFM)
+    return _norm(x, _TOPFM, site)
 
 
 def normalize(x, width: int = W):
-    return _norm3(_pad_limbs(x, width), _TOPFM)
+    """Pad to `width` then carry-normalize at the certified `normalize`
+    site depth (_SCHED — pinned at the 2 passes that re-standardize
+    the documented 12-standard-element add chain). Certified input
+    bound: see the `normalize` site in tests/budgets/limb_bounds.json;
+    deeper chains need a re-proof, not a comment edit."""
+    return _norm(_pad_limbs(x, width), _TOPFM, "normalize")
 
 
 # ---------------------------------------------------------------- canonical
@@ -343,9 +489,13 @@ def _ripple_carry(v):
 
 def canonical(x):
     """Unique representative in [0, p); canonical limbs [..., W, S]."""
-    x = reduce_light(x)
-    x = norm3_x(_fold(x, _FOLDS[:, 40:41]))
-    x = norm3_x(_fold(x, _FOLDS[:, 40:41]))
+    x = canon_reduce(x)
+    if BOUNDS is not None:
+        # the binary subtract ladder below only reduces values v with
+        # v + KP in (0, p*2^7): the prover checks that VALUE window
+        # here from its tracked value intervals (the limb-level int32
+        # checks can't see it)
+        BOUNDS.canonical_window(x, axis=-2)
     x = _ripple_carry(_pad_limbs(x, 37) + KP_37[:, None])[0]
     for k in reversed(range(_LADDER_ROUNDS)):
         d, borrow = _ripple_carry(x - PK_LADDER[k][:, None])
@@ -381,7 +531,9 @@ def pow_const(a, exponent: int):
         base = sqr(base)
         return (acc, base), None
 
-    (acc, _), _ = jax.lax.scan(step, (one, norm3_x(a)), bits)
+    (acc, _), _ = jax.lax.scan(
+        step, (one, norm3_x(a, site="fp.pow_const.entry")), bits
+    )
     return acc
 
 

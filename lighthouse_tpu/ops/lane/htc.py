@@ -103,7 +103,7 @@ def ratio_chain(w):
     one stacked f2mul). The window digits are compile-time constants;
     the table gather is one dynamic-slice per step."""
     S = w.shape[-1]
-    w1 = fp.norm3_x(w)
+    w1 = fp.norm3_x(w, site="htc.ratio_chain.entry")
     w2 = f2sqr(w1)
     w3 = f2mul(w2, w1)
     cw1, cw2, cw3 = (tower.f2conj(v) for v in (w1, w2, w3))

@@ -269,7 +269,10 @@ def cyc_pow_abs_u(f):
     def step(acc, bit):
         acc = cyclotomic_sqr(acc)
         acc = jax.lax.cond(
-            bit, lambda a: fp.norm3_x(f12mul(a, f)), lambda a: a, acc
+            bit,
+            lambda a: fp.norm3_x(f12mul(a, f), site="pairing.cyc_mul"),
+            lambda a: a,
+            acc,
         )
         return acc, None
 

@@ -236,7 +236,7 @@ def f2smul_fp(a, s):
 
 def f2inv(a):
     """1/(a0 + a1 u) = (a0 - a1 u)/(a0^2 + a1^2). One Fermat inversion."""
-    a = fp.norm3_x(a)
+    a = fp.norm3_x(a, site="tower.f2inv.entry")
     a0, a1 = _c(a, 0), _c(a, 1)
     sq = fp.mul(jnp.stack([a0, a1], -3), jnp.stack([a0, a1], -3))
     norm = _c(sq, 0) + _c(sq, 1)
@@ -261,7 +261,7 @@ def f6neg(a):
 
 
 def f6inv(a):
-    a = fp.norm3_x(a)
+    a = fp.norm3_x(a, site="tower.f6inv.entry")
     a0, a1, a2 = _c2(a, 0), _c2(a, 1), _c2(a, 2)
     sq = f2sqr(jnp.stack([a0, a2, a1], -4))
     s0, s2, s1 = _c2(sq, 0), _c2(sq, 1), _c2(sq, 2)

@@ -25,6 +25,10 @@ except schema/source/recorded_at; compare only what both rows carry:
                     steady_slot / epoch_boundary / block_import /
                     cold_root @250k validators — exact counts)
   epoch_warm_s      {"250k": s, "500k": s}
+  bounds            {certified_sites, min_headroom_bits,
+                    trimmed_passes_per_mul, certificate_ok} (ISSUE 14
+                    limb-bounds certificates: int32 headroom must
+                    never decay below the 2-bit slack floor)
   load              {duty_p99_s, shed_rate, deadline_miss_rate}
   scenarios_pass    bool
   artifacts         export-artifact inventory summary
@@ -148,6 +152,22 @@ def row_from_bench(doc: dict, source: str = "bench.py") -> dict:
         }
         if sub:
             row["hash"] = sub
+    bd = detail.get("bounds", {})
+    if isinstance(bd, dict) and (
+        "min_headroom_bits" in bd or "certificate_ok" in bd
+    ):
+        # keep certificate_ok even when the prover failed outright and
+        # carries no numbers — compare() fails a fresh->broken
+        # transition explicitly (a collapse must not skip the gate
+        # just because min_headroom_bits went missing)
+        row["bounds"] = {
+            k: bd.get(k)
+            for k in (
+                "certified_sites", "min_headroom_bits",
+                "trimmed_passes_per_mul", "certificate_ok",
+            )
+            if bd.get(k) is not None
+        }
     ep = detail.get("epoch", {})
     if isinstance(ep, dict):
         warm = {
@@ -219,7 +239,12 @@ def row_from_bench(doc: dict, source: str = "bench.py") -> dict:
 
 # (dotted path, label, kind): kind "time" = lower is better, "rate" =
 # higher is better, "count" = lower is better and exact (op census),
-# "ratio" = lower is better, unitless (shed / deadline-miss rates)
+# "ratio" = lower is better, unitless (shed / deadline-miss rates),
+# "headroom" = higher is better with an absolute slack floor: any
+# round-over-round decrease that lands BELOW the floor fails (the
+# ISSUE 14 rule — trims may spend headroom, but never below the slack
+# the trim search itself preserves), "flag" = a truthy->falsy
+# transition fails (certificate freshness)
 COMPARE_FIELDS = (
     # absolute floors sized ~2x the warm steady-state values so shared-
     # CI scheduling noise cannot flap the gate; decays at this scale
@@ -251,6 +276,15 @@ COMPARE_FIELDS = (
      "count", 0.0),
     ("hash.block_import", "sha256 compressions @block-import",
      "count", 0.0),
+    # ISSUE 14: certified int32 headroom of the limb-bounds prover —
+    # a decrease below the 2-bit slack floor means a norm-schedule or
+    # kernel edit spent the safety margin the trim search preserves
+    ("bounds.min_headroom_bits", "limb-bounds min headroom (bits)",
+     "headroom", 2.0),
+    # ...and a fresh->broken certificate transition must fail in its
+    # own right: when the prover errors out min_headroom_bits goes
+    # missing entirely and the numeric gate above would silently skip
+    ("bounds.certificate_ok", "limb-bounds certificate", "flag", 0.0),
     ("value_sets_per_s", "driver-verified sets/s", "rate", 0.0),
     ("replay.sets_per_s", "cpu-replay sets/s", "rate", 0.0),
 )
@@ -302,6 +336,25 @@ def compare(prev: dict, cur: dict, rel_tol: float = 0.20) -> list:
                 problems.append(
                     f"{label}: {a:.4g} -> {b:.4g} "
                     f"(+{(b / a - 1) * 100:.0f}%)"
+                )
+        elif kind == "flag":
+            # truthy -> falsy is the only failing transition (ISSUE
+            # 14 certificate_ok: a round whose certificate went
+            # stale/unproven must fail even with no numbers to diff)
+            if a and not b:
+                problems.append(
+                    f"{label}: went stale/unproven (ok -> broken) — "
+                    "re-prove: python tools/limb_bounds.py --update"
+                )
+        elif kind == "headroom":
+            # higher is better; decreases are tolerated while the
+            # value stays at/above the absolute slack floor — dropping
+            # below it round-over-round fails (ISSUE 14)
+            if b < a and b < floor:
+                problems.append(
+                    f"{label}: {a:.4g} -> {b:.4g} (below the "
+                    f"{floor:.4g}-bit slack floor — a kernel or "
+                    "schedule edit spent the certified safety margin)"
                 )
         elif kind == "rate":
             # a dead round (0.0) is not a measurement; only compare

@@ -1,0 +1,490 @@
+"""Limb-bounds prover (ISSUE 14): certificate freshness in tier-1,
+adversarial boundary tests for the carry primitives at interval-
+extremal inputs vs the python-int oracle, soundness of the checker
+both ways (an overstated certificate is rejected), the graft-lint R6
+wiring, the trimmed-vs-untrimmed differential, and the bench-gate
+headroom floor fixture."""
+
+import copy
+import json
+import os
+import sys
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from lighthouse_tpu.crypto.bls.params import P
+from lighthouse_tpu.ops import bounds
+from lighthouse_tpu.ops import fp as bfp
+from lighthouse_tpu.ops.lane import fp as lfp
+from lighthouse_tpu.tools import perf_ledger as L
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_REPO, "tools"))
+
+
+def _lane_val(x, s=0):
+    """Python-int value of lane-layout limbs [..., W', S] at lane s."""
+    a = np.asarray(x)
+    return sum(int(v) << (bfp.B * i) for i, v in enumerate(a[..., :, s]))
+
+
+def _base_val(row):
+    return sum(int(v) << (bfp.B * i) for i, v in enumerate(np.asarray(row)))
+
+
+@pytest.fixture(scope="module")
+def derived():
+    """One (disk-cached) derivation for the whole module — the same
+    warm path the tier-1 CLI check uses."""
+    return bounds.derive_cached()
+
+
+@pytest.fixture(scope="module")
+def cert():
+    return bounds.load_certificate()
+
+
+# ------------------------------------------------------------ tier-1 gate
+
+
+def test_prover_proves_tree_and_certificate_is_fresh(derived, cert):
+    """The tier-1 contract: the abstract interpretation proves int32
+    freedom for every kernel body end-to-end under the live schedule,
+    and the checked-in certificate matches the derivation exactly."""
+    assert derived["max_abs"] < 2**31
+    assert derived["min_headroom_bits"] > 0
+    assert bounds.check_certificate(cert, derived) == []
+
+
+def test_limb_width_pin():
+    """The prover's value encoding must match the kernel's limb width
+    (a B change without a prover update would silently unsound it)."""
+    assert bounds._B == bfp.B
+
+
+def test_every_schedule_site_certified(derived):
+    """Every _SCHED site is reached by the prover programs and every
+    reached site is scheduled — no dead or uncertified entries."""
+    assert set(derived["sites"]) == set(lfp._SCHED)
+    assert derived["schedule"] == dict(lfp._SCHED)
+
+
+def test_every_kernel_op_body_certified(derived, cert):
+    """Every kernel_op registration in ops/lane/ has a certificate
+    entry (the R6 contract, asserted against the live registry)."""
+    import ast
+
+    lane_dir = os.path.join(_REPO, "lighthouse_tpu", "ops", "lane")
+    names = set()
+    for fname in os.listdir(lane_dir):
+        if not fname.endswith(".py"):
+            continue
+        tree = ast.parse(open(os.path.join(lane_dir, fname)).read())
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.Call)
+                and getattr(node.func, "attr", getattr(node.func, "id", ""))
+                == "kernel_op"
+                and len(node.args) >= 2
+                and isinstance(node.args[1], ast.Constant)
+            ):
+                names.add(node.args[1].value)
+    assert names and names <= set(derived["bodies"])
+    assert names <= set(cert["bodies"])
+
+
+def test_certificate_headroom_respects_gate_floor(cert):
+    """The shipped schedule keeps the bench-gate slack floor: the trim
+    search refuses candidates below 2 bits, so the certificate it
+    emitted must sit at/above it."""
+    assert cert["min_headroom_bits"] >= 2.0
+
+
+# ------------------------------------- adversarial carry-primitive tests
+
+
+def test_lane_norm1_negative_top_carry_preserves_value_mod_p():
+    """_norm1 at interval-extremal NEGATIVE lazy values: the top limb's
+    carry is negative (-1 and deeper), the topfold path re-absorbs it
+    mod p — checked against the python-int oracle."""
+    for top in (-(1 << bfp.B), -(1 << 14), -1 - (1 << 11)):
+        x = np.zeros((lfp.W, 2), np.int32)
+        x[:, 0] = -(1 << 13)
+        x[-1, 0] = top
+        x[:, 1] = (1 << 14) - 1
+        x[-1, 1] = top  # positive body, negative top
+        before = [_lane_val(x, s) for s in range(2)]
+        out = np.asarray(lfp._norm1(jnp.asarray(x), lfp._TOPFM))
+        for s in range(2):
+            assert _lane_val(out, s) % P == before[s] % P
+        # one pass keeps every limb far inside int32
+        assert np.abs(out).max() < 2**31
+
+
+def test_base_norm1_negative_top_carry_preserves_value_mod_p():
+    for top in (-(1 << bfp.B), -(1 << 14)):
+        x = np.zeros((2, bfp.W), np.int32)
+        x[0] = -(1 << 13)
+        x[0, -1] = top
+        x[1] = (1 << 14) - 1
+        x[1, -1] = top
+        before = [_base_val(r) for r in x]
+        out = np.asarray(bfp.norm1(jnp.asarray(x)))
+        for i in range(2):
+            assert _base_val(out[i]) % P == before[i] % P
+
+
+def test_norm1_open_preserves_value_exactly():
+    """The open (topfold-free) pass must preserve the encoded value
+    EXACTLY — the property the canonical ripple window proof rests
+    on — including at negative top carries."""
+    x = np.zeros((lfp.W, 2), np.int32)
+    x[:, 0] = (1 << 14) - 3
+    x[:, 1] = -(1 << 13)
+    x[-1, 1] = -(1 << 14)
+    before = [_lane_val(x, s) for s in range(2)]
+    out = np.asarray(lfp._norm1_open(jnp.asarray(x), lfp._TOPFM))
+    assert [_lane_val(out, s) for s in range(2)] == before
+    xb = np.zeros((2, bfp.W), np.int32)
+    xb[0] = (1 << 14) - 3
+    xb[1] = -(1 << 13)
+    xb[1, -1] = -(1 << 14)
+    outb = np.asarray(bfp.norm1_open(jnp.asarray(xb)))
+    assert [_base_val(r) for r in outb] == [_base_val(r) for r in xb]
+
+
+def test_norm_sites_at_certified_input_bound(cert):
+    """Runtime soundness half of the acceptance criterion: concrete
+    inputs with every limb AT the certified input bound (and bound-1),
+    pushed through the certified pass depth, must match the python-int
+    oracle — an understated certificate would wrap int32 here."""
+    for site in ("norm3.kernel", "normalize"):
+        bound = int(cert["sites"][site]["input_bound"])
+        passes = int(cert["sites"][site]["passes"])
+        for mag in (bound, bound - 1):
+            for sign in (1, -1):
+                x = np.full((lfp.W, 2), sign * mag, np.int32)
+                before = _lane_val(x, 0)
+                out = np.asarray(
+                    lfp._norm(jnp.asarray(x), lfp._TOPFM, site)
+                )
+                assert _lane_val(out, 0) % P == before % P
+                assert np.abs(out).max() < 2**31
+                # certified pass depth really is what ran
+                assert passes == lfp._SCHED[site]
+
+
+def test_ripple_carry_at_window_bounds():
+    """_ripple_carry at the certified subtract-ladder window bounds
+    +-1: exact value decomposition at v=1 and v=p*2^7-1, and the
+    borrow flip exactly at v=P (the ladder's conditional-subtract
+    detection)."""
+    for v in (1, P, P - 1, (P << 7) - 1):
+        raw = bfp._limbs_raw(v, 37).astype(np.int32)[:, None]
+        out, carry = lfp._ripple_carry(jnp.asarray(raw))
+        out = np.asarray(out)
+        assert int(np.asarray(carry)[0]) == 0
+        assert _lane_val(out, 0) == v
+        assert out.min() >= 0 and out.max() <= bfp.MASK
+    # borrow flip at exactly P: (v - P) ripples to borrow < 0 iff v < P
+    pl = bfp._limbs_raw(P, 37).astype(np.int32)[:, None]
+    for v, expect_borrow in ((P, False), (P - 1, True)):
+        raw = bfp._limbs_raw(v, 37).astype(np.int32)[:, None]
+        _, borrow = lfp._ripple_carry(jnp.asarray(raw) - jnp.asarray(pl))
+        assert (int(np.asarray(borrow)[0]) < 0) == expect_borrow
+
+
+def test_mul_at_documented_lazy_extremes_matches_oracle():
+    """The documented mul contract at its limb extremes, both signs:
+    3-term lazy sums with every limb at the canonical max."""
+    x = np.full((lfp.W, 2), bfp.MASK, np.int32)
+    val = _lane_val(x, 0)
+    a = jnp.asarray(3 * x)
+    b = jnp.asarray(-3 * x)
+    got = np.asarray(lfp.mul(a, b))
+    want = (3 * val) * (-3 * val) % P
+    assert _lane_val(got, 0) % P == want
+    assert _lane_val(got, 1) % P == want
+
+
+# ------------------------------------------------- checker soundness (R6)
+
+
+def test_overstated_certificate_is_rejected(derived):
+    """Soundness of the checker itself: a certificate that OVERSTATES
+    soundness — tighter input bound, more headroom, or deeper claimed
+    passes than derived — must be rejected statically."""
+    good = bounds.build_certificate(derived)
+    assert bounds.check_certificate(good, derived) == []
+
+    site = next(iter(good["sites"]))
+    for mutate in (
+        lambda c: c["sites"][site].__setitem__(
+            "input_bound", c["sites"][site]["input_bound"] // 2
+        ),
+        lambda c: c["sites"][site].__setitem__(
+            "headroom_bits", c["sites"][site]["headroom_bits"] + 3.0
+        ),
+        lambda c: c["sites"][site].__setitem__(
+            "passes", c["sites"][site]["passes"] + 1
+        ),
+        lambda c: c.__setitem__("max_abs", c["max_abs"] // 2),
+    ):
+        bad = copy.deepcopy(good)
+        mutate(bad)
+        problems = bounds.check_certificate(bad, derived)
+        assert problems, "overstated certificate accepted"
+    # the overstating direction is named as such
+    bad = copy.deepcopy(good)
+    bad["sites"][site]["input_bound"] //= 2
+    assert any("overstates" in p for p in
+               bounds.check_certificate(bad, derived))
+
+
+def test_stale_fingerprint_rejected(derived):
+    doc = bounds.build_certificate(derived)
+    doc["source_fingerprint"] = "0" * 16
+    problems = bounds.check_certificate(doc, derived)
+    assert any("stale" in p and "limb_bounds.py --update" in p
+               for p in problems)
+
+
+# --------------------------------------------------------- graft-lint R6
+
+
+def _r6(cert_path=None, lane_dir=None):
+    import graft_lint
+
+    return [
+        f for f in graft_lint.r6_check(
+            cert_path=cert_path, lane_dir=lane_dir
+        )
+        if f.rule == "R6"
+    ]
+
+
+def test_r6_clean_on_shipped_tree():
+    assert _r6() == []
+
+
+def test_r6_fires_on_missing_certificate(tmp_path):
+    findings = _r6(cert_path=str(tmp_path / "absent.json"))
+    assert findings and "missing/unreadable" in findings[0].msg
+    assert "limb_bounds.py --update" in findings[0].hint
+
+
+def test_r6_fires_on_stale_fingerprint(tmp_path, cert):
+    doc = dict(cert)
+    doc["source_fingerprint"] = "f" * 16
+    p = tmp_path / "limb_bounds.json"
+    p.write_text(json.dumps(doc))
+    findings = _r6(cert_path=str(p))
+    assert any("stale" in f.msg for f in findings)
+
+
+def test_r6_fires_on_uncertified_sites(tmp_path):
+    lane = tmp_path / "lane"
+    lane.mkdir()
+    (lane / "glue.py").write_text(
+        "from . import fp\n"
+        "def a(x):\n"
+        "    return fp.norm3_x(x)\n"
+        "def b(x):\n"
+        "    return fp.norm3_x(x, site='no.such.site')\n"
+        "def c(x, topf):\n"
+        "    return fp._norm1(x, topf)\n"
+        "op = fp.kernel_op(a, 'never_registered_kernel')\n"
+    )
+    msgs = [f.msg for f in _r6(lane_dir=str(lane))]
+    assert any("without a site id" in m for m in msgs)
+    assert any("'no.such.site'" in m for m in msgs)
+    assert any("raw _norm1() call bypasses" in m for m in msgs)
+    assert any("'never_registered_kernel'" in m for m in msgs)
+
+
+def test_r6_schedule_drift_detected(tmp_path, cert):
+    doc = copy.deepcopy(cert)
+    site = next(iter(doc["schedule"]))
+    doc["schedule"][site] = int(doc["schedule"][site]) + 1
+    p = tmp_path / "limb_bounds.json"
+    p.write_text(json.dumps(doc))
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    findings = _r6(cert_path=str(p), lane_dir=str(empty))
+    assert any("_SCHED differs" in f.msg for f in findings)
+
+
+def test_r6_counts_in_all_rules():
+    import graft_lint
+
+    assert "R6" in graft_lint.ALL_RULES
+
+
+def test_static_limb_fingerprint_matches_prover():
+    """graft-lint R6's static fingerprint must be byte-identical to the
+    prover's (same file set INCLUDING ops/fp.py + ops/bounds.py —
+    base-kernel and transfer-rule edits must stale certificates)."""
+    import graft_lint
+
+    assert graft_lint.limb_bounds_fingerprint() == bounds._fingerprint()
+
+
+def test_unreached_sched_site_flagged(tmp_path, cert):
+    """A _SCHED site no prover program reaches must NOT count as
+    certified — its pass depth is unproven (R6)."""
+    doc = copy.deepcopy(cert)
+    doc["schedule"]["ghost.entry"] = 0
+    p = tmp_path / "limb_bounds.json"
+    p.write_text(json.dumps(doc))
+    lane = tmp_path / "lane"
+    lane.mkdir()
+    (lane / "glue.py").write_text(
+        "from . import fp\n"
+        "def g(x):\n"
+        "    return fp.norm3_x(x, site='ghost.entry')\n"
+    )
+    msgs = [f.msg for f in _r6(cert_path=str(p), lane_dir=str(lane))]
+    assert any("'ghost.entry'" in m and "unproven" in m for m in msgs)
+    # and the caller naming the unreached site is flagged too
+    assert any("no certificate entry" in m for m in msgs)
+
+
+# ------------------------------------------- trimmed vs full differential
+
+
+def test_trimmed_schedule_bit_identical_to_full():
+    """The certified trim must be invisible: canonical outputs (and
+    values mod p at every stage) bit-identical between the trimmed
+    schedule and the forced untrimmed 3-pass schedule."""
+    rng = np.random.default_rng(14)
+    elems = [int.from_bytes(rng.bytes(48), "big") % P for _ in range(6)]
+    a = jnp.asarray(lfp.pack(elems[:2]))
+    b = jnp.asarray(lfp.pack(elems[2:4]))
+    c = jnp.asarray(lfp.pack(elems[4:]))
+
+    def pipeline():
+        m = lfp.mul(a + b - c, b)
+        m2 = lfp.sqr(m, norm=True)
+        acc = m2
+        for _ in range(11):
+            acc = acc + m2
+        n = lfp.normalize(acc)
+        return (
+            np.asarray(lfp.canonical(m2 - n)),
+            np.asarray(lfp.canonical(lfp.reduce_light(acc))),
+        )
+
+    assert not lfp._FORCE_FULL
+    trimmed = pipeline()
+    lfp._FORCE_FULL = True
+    try:
+        full = pipeline()
+    finally:
+        lfp._FORCE_FULL = False
+    for t, f in zip(trimmed, full):
+        np.testing.assert_array_equal(t, f)
+    # and the first canonical agrees with the python-int oracle
+    m2v = pow(
+        (elems[0] + elems[2] - elems[4]) * elems[2] % P, 2, P
+    )
+    nv = 12 * m2v % P
+    assert _lane_val(trimmed[0], 0) == (m2v - nv) % P
+
+
+def test_trim_moved_mul_pipeline():
+    """The headline: the certified schedule actually trims carry
+    passes off the Fp-mul pipeline (the measured op-count drop in
+    kernel_costs budgets comes from exactly this number)."""
+    assert bounds.trimmed_passes_per_mul() > 0
+
+
+# ------------------------------------------------ bench gate + ledger
+
+
+def _bounds_row(source, headroom):
+    return {
+        "schema": L.SCHEMA,
+        "source": source,
+        "recorded_at": "2026-08-04T00:00:00Z",
+        "bounds": {
+            "certified_sites": 24,
+            "min_headroom_bits": headroom,
+            "trimmed_passes_per_mul": 7,
+            "certificate_ok": True,
+        },
+    }
+
+
+def test_bench_gate_headroom_floor_fixture(tmp_path):
+    """Round-over-round min-headroom decreases are tolerated while at/
+    above the 2-bit slack floor; a decrease BELOW it fails the gate —
+    fixture-tested end to end through tools/bench_gate.py like the
+    op-count gate."""
+    import bench_gate
+
+    path = str(tmp_path / "PERF.jsonl")
+    L.append(_bounds_row("r1", 2.91), path)
+    L.append(_bounds_row("r2", 2.17), path)  # decrease, >= floor: ok
+    assert bench_gate.gate(path) == []
+    L.append(_bounds_row("r3", 1.4), path)  # below the floor: fails
+    problems = bench_gate.gate(path)
+    assert problems and "slack floor" in problems[0]
+    # an increase from below the floor never fails
+    L.append(_bounds_row("r4", 1.6), path)
+    assert bench_gate.gate(path) == []
+
+
+def test_certificate_collapse_fails_gate(tmp_path):
+    """A fresh->broken certificate transition (prover raises, so no
+    min_headroom_bits at all) must FAIL the gate, not skip the
+    headroom comparison."""
+    import bench_gate
+
+    path = str(tmp_path / "PERF.jsonl")
+    L.append(_bounds_row("r1", 2.17), path)
+    broken = {
+        "schema": L.SCHEMA,
+        "source": "r2",
+        "recorded_at": "2026-08-04T00:00:01Z",
+        "bounds": {"certificate_ok": False},
+    }
+    L.append(broken, path)
+    problems = bench_gate.gate(path)
+    assert problems and any("stale/unproven" in p for p in problems)
+    # a broken row still projects from a bench doc (no numbers needed)
+    row = L.row_from_bench(
+        {"value": 0.0, "detail": {"bounds": {"certificate_ok": False,
+                                             "violation": "boom"}}}
+    )
+    assert row["bounds"] == {"certificate_ok": False}
+
+
+def test_ledger_projects_detail_bounds():
+    doc = {
+        "value": 0.0,
+        "detail": {
+            "bounds": {
+                "schema": bounds.SCHEMA,
+                "certified_sites": 24,
+                "certified_bodies": 22,
+                "min_headroom_bits": 2.17,
+                "trimmed_passes_per_mul": 7,
+                "certificate_ok": True,
+            }
+        },
+    }
+    row = L.row_from_bench(doc)
+    assert row["bounds"]["min_headroom_bits"] == 2.17
+    assert row["bounds"]["trimmed_passes_per_mul"] == 7
+    assert row["bounds"]["certificate_ok"] is True
+    assert "certified_bodies" not in row["bounds"]
+
+
+def test_bounds_summary_shape():
+    s = bounds.summary()
+    assert s["certificate_ok"] is True
+    assert s["certified_sites"] > 0
+    assert s["min_headroom_bits"] >= 2.0
+    assert s["trimmed_passes_per_mul"] >= 0

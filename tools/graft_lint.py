@@ -43,6 +43,16 @@ the whole `lighthouse_tpu/` tree:
                        labeled-metric-family internal access
                        (`._children`, `.labels(...).value` writes) that
                        bypasses the per-family lock idiom.
+  R6 limb-bounds     — every `kernel_op` registration and every norm
+                       schedule site (`_norm(..., "site")`,
+                       `norm3_x(..., site=...)`) in ops/lane/ must
+                       carry a fingerprint-fresh certificate entry in
+                       tests/budgets/limb_bounds.json (the ISSUE 14
+                       abstract-interpretation carry certificates);
+                       raw `_norm1`/`_norm3` calls that bypass the
+                       schedule seam are flagged too. Names the
+                       `python tools/limb_bounds.py --update` re-prove
+                       command.
   R0 stale-pragma    — a `# graft-lint: ignore[RULE]` pragma that
                        suppresses nothing (lint-the-linter).
 
@@ -66,7 +76,7 @@ CLI:
   python tools/graft_lint.py [paths...]   static rules + R3
   --all        also fold in tools/metrics_lint.py (rule id METRICS) —
                the single tier-1 entry point, one exit code
-  --only R1,R2 run only the named rules (R0..R5, METRICS)
+  --only R1,R2 run only the named rules (R0..R6, METRICS)
   --changed    lint only files changed vs git HEAD (plus untracked)
   --json       machine-readable findings
   --no-cache   ignore and do not write the mtime+hash result cache
@@ -93,11 +103,12 @@ if _REPO not in sys.path:
     sys.path.insert(0, _REPO)
 
 # bump to invalidate cached per-file results when rules change
-LINT_VERSION = 2
+LINT_VERSION = 3
 
 STATIC_RULES = ("R0", "R1", "R2", "R4", "R5")
 # E0 (parse failure) always reports and is exempt from --only filtering
-ALL_RULES = ("R0", "R1", "R2", "R3", "R4", "R5", "METRICS", "E0")
+ALL_RULES = ("R0", "R1", "R2", "R3", "R4", "R5", "R6", "METRICS",
+             "E0")
 
 CACHE_PATH = os.path.join(_REPO, ".graft_lint_cache.json")
 TREE = os.path.join(_REPO, "lighthouse_tpu")
@@ -736,6 +747,9 @@ _SEAM_OWNERS = {
     os.path.join("lighthouse_tpu", "ops", "hash_costs.py"),
     os.path.join("lighthouse_tpu", "ops", "costs.py"),
     os.path.join("lighthouse_tpu", "common", "sanitize.py"),
+    # bounds_mode installs the CENSUS/BOUNDS seams under the census
+    # lock (ISSUE 14) — same discipline as costs.py census contexts
+    os.path.join("lighthouse_tpu", "ops", "bounds.py"),
 }
 
 
@@ -938,6 +952,257 @@ def r3_check() -> list:
             )
         ]
     return []
+
+
+# ----------------------------------------------------------- R6 limb bounds
+
+_R6_HINT = "re-prove: python tools/limb_bounds.py --update"
+# raw carry-pass calls are legal only inside the schedule seam itself
+# (ops/lane/fp.py `_norm` and the site-less `norm3_x` fallback)
+_R6_RAW_NORM = ("_norm1", "_norm1_open", "_norm3")
+_R6_SEAM_DEFS = ("_norm", "norm3_x")
+
+
+def _limb_cert_path() -> str:
+    return os.path.join(_REPO, "tests", "budgets", "limb_bounds.json")
+
+
+def limb_bounds_fingerprint() -> str:
+    """Static mirror of ops/bounds.py _fingerprint(): the R3 kernel
+    set extended with the base XLA core (ops/fp.py) and the prover
+    itself (ops/bounds.py) — same files, same order, same hash.
+    tests/test_limb_bounds.py pins the two implementations equal."""
+    import glob
+
+    lane = os.path.join(TREE, "ops", "lane")
+    srcs = sorted(glob.glob(os.path.join(lane, "*.py"))) + [
+        os.path.join(TREE, "crypto", "bls", "backends", "tpu.py"),
+        os.path.join(TREE, "crypto", "bls", "params.py"),
+    ]
+    extra = sorted(
+        [
+            os.path.join(TREE, "ops", "fp.py"),
+            os.path.join(TREE, "ops", "bounds.py"),
+        ]
+    )
+    h = hashlib.sha256()
+    for p in srcs + extra:
+        with open(p, "rb") as f:
+            h.update(f.read())
+    return h.hexdigest()[:16]
+
+
+def _r6_site_of(call: ast.Call):
+    """The site id a `_norm(...)`/`norm3_x(...)` call names: a string
+    literal, None for an explicit/implicit site=None, or the sentinel
+    'dynamic' for anything non-literal."""
+    args = list(call.args)
+    kw = {k.arg: k.value for k in call.keywords if k.arg}
+    node = kw.get("site")
+    if node is None and _call_name(call) == "_norm" and len(args) >= 3:
+        node = args[2]
+    if node is None and _call_name(call) == "norm3_x" and len(args) >= 2:
+        node = args[1]
+    if node is None:
+        return None
+    if isinstance(node, ast.Constant):
+        return node.value  # str, or None for site=None
+    return "dynamic"
+
+
+def _r6_enclosing_def(tree: ast.AST, call: ast.Call) -> str:
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            end = getattr(node, "end_lineno", node.lineno)
+            if node.lineno <= call.lineno <= end:
+                return node.name
+    return ""
+
+
+def r6_check(cert_path: str = None, lane_dir: str = None) -> list:
+    """Limb-bounds certification (ISSUE 14): every `kernel_op`
+    registration and every norm schedule site in ops/lane/ must carry
+    a certificate entry in tests/budgets/limb_bounds.json, the
+    certificate must be pinned to the current kernel source
+    fingerprint, and the certified schedule must match the `_SCHED`
+    literal in ops/lane/fp.py. Raw `_norm1`/`_norm3` calls outside the
+    schedule seam bypass certification entirely and are flagged.
+    `cert_path`/`lane_dir` are injectable for the soundness fixtures in
+    tests/test_limb_bounds.py."""
+    cert_path = cert_path or _limb_cert_path()
+    cert_rel = os.path.relpath(cert_path, _REPO)
+    try:
+        with open(cert_path) as f:
+            cert = json.load(f)
+        sites = set(cert.get("sites", {}))
+        sched = cert.get("schedule", {}) or {}
+        bodies = set(cert.get("bodies", {}))
+        stored = cert.get("source_fingerprint")
+    except Exception as e:
+        return [
+            Finding(
+                cert_rel, 1, "R6",
+                f"limb-bounds certificate missing/unreadable "
+                f"({type(e).__name__}: {e})",
+                _R6_HINT,
+            )
+        ]
+    findings = []
+    try:
+        cur = limb_bounds_fingerprint()
+    except Exception:
+        cur = None  # R3 already reports unreadable kernel sources
+    if cur is not None and stored != cur:
+        findings.append(
+            Finding(
+                cert_rel, 1, "R6",
+                f"limb-bounds certificate fingerprint {stored} is stale "
+                f"(kernel sources are {cur}) — every carry certificate "
+                "is unproven against the current kernels",
+                _R6_HINT,
+            )
+        )
+    lane_dir = lane_dir or os.path.join(TREE, "ops", "lane")
+    # a site is certified ONLY if the prover actually reached it —
+    # presence in the schedule dict alone means an unproven pass depth
+    known = sites
+    for extra in sorted(set(sched) - sites):
+        findings.append(
+            Finding(
+                os.path.join("lighthouse_tpu", "ops", "lane", "fp.py"),
+                1, "R6",
+                f"_SCHED site {extra!r} is scheduled but not reached "
+                "by any prover program — its pass depth is unproven "
+                "(add a program in ops/bounds.py or drop the site)",
+                _R6_HINT,
+            )
+        )
+    for fname in sorted(os.listdir(lane_dir)):
+        if not fname.endswith(".py"):
+            continue
+        path = os.path.join(lane_dir, fname)
+        rel = os.path.relpath(path, _REPO)
+        try:
+            with open(path) as f:
+                tree = ast.parse(f.read())
+        except (OSError, SyntaxError):
+            continue  # E0 owns parse failures
+        is_fp = fname == "fp.py"
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _call_name(node)
+            if name == "kernel_op":
+                if len(node.args) >= 2 and isinstance(
+                    node.args[1], ast.Constant
+                ):
+                    kname = node.args[1].value
+                    if kname not in bodies:
+                        findings.append(
+                            Finding(
+                                rel, node.lineno, "R6",
+                                f"kernel_op {kname!r} has no limb-bounds "
+                                f"certificate entry in {cert_rel} — its "
+                                "body is unproven against int32 overflow",
+                                _R6_HINT,
+                            )
+                        )
+                else:
+                    findings.append(
+                        Finding(
+                            rel, node.lineno, "R6",
+                            "kernel_op registration without a literal "
+                            "name cannot be matched to a limb-bounds "
+                            "certificate",
+                            _R6_HINT,
+                        )
+                    )
+            elif name in ("_norm", "norm3_x"):
+                encl = _r6_enclosing_def(tree, node)
+                if is_fp and encl in _R6_SEAM_DEFS:
+                    continue  # the seam's own pass-through
+                site = _r6_site_of(node)
+                if site is None:
+                    findings.append(
+                        Finding(
+                            rel, node.lineno, "R6",
+                            f"{name}() call without a site id runs the "
+                            "uncertified fallback schedule — name a "
+                            "certified site from _SCHED",
+                            _R6_HINT,
+                        )
+                    )
+                elif site == "dynamic" or not isinstance(site, str):
+                    findings.append(
+                        Finding(
+                            rel, node.lineno, "R6",
+                            f"{name}() site id must be a string literal "
+                            "(certificates are keyed by literal site id)",
+                            _R6_HINT,
+                        )
+                    )
+                elif site not in known:
+                    findings.append(
+                        Finding(
+                            rel, node.lineno, "R6",
+                            f"norm site {site!r} has no certificate "
+                            f"entry in {cert_rel}",
+                            _R6_HINT,
+                        )
+                    )
+            elif name in _R6_RAW_NORM:
+                encl = _r6_enclosing_def(tree, node)
+                if is_fp and encl in _R6_SEAM_DEFS:
+                    continue
+                findings.append(
+                    Finding(
+                        rel, node.lineno, "R6",
+                        f"raw {name}() call bypasses the certified norm "
+                        "schedule seam — route through _norm/norm3_x "
+                        "with a site id",
+                        _R6_HINT,
+                    )
+                )
+    sched_lit = _fp_sched_literal()
+    if sched_lit is not None and sched_lit != {
+        k: int(v) for k, v in sched.items()
+    }:
+        findings.append(
+            Finding(
+                os.path.join("lighthouse_tpu", "ops", "lane", "fp.py"),
+                1, "R6",
+                "ops/lane/fp.py _SCHED differs from the certified "
+                f"schedule in {cert_rel} — the running pass depths are "
+                "unproven",
+                _R6_HINT,
+            )
+        )
+    return findings
+
+
+def _fp_sched_literal():
+    """The `_SCHED = {...}` dict literal in ops/lane/fp.py, parsed
+    statically (no jax import); None when absent/non-literal."""
+    path = os.path.join(TREE, "ops", "lane", "fp.py")
+    try:
+        with open(path) as f:
+            tree = ast.parse(f.read())
+    except (OSError, SyntaxError):
+        return None
+    for node in tree.body:
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and node.targets[0].id == "_SCHED"
+        ):
+            try:
+                val = ast.literal_eval(node.value)
+            except ValueError:
+                return None
+            if isinstance(val, dict):
+                return {str(k): int(v) for k, v in val.items()}
+    return None
 
 
 # ------------------------------------------------------------ per-file lint
@@ -1208,6 +1473,8 @@ def run(
         findings, stats = lint_paths(paths, use_cache=use_cache)
     if rules is None or "R3" in rules:
         findings.extend(r3_check())
+    if rules is None or "R6" in rules:
+        findings.extend(r6_check())
     # metrics fold runs under --all, OR when the user explicitly asked
     # for the METRICS rule via --only (asking for a rule must run it)
     if (rules is None and include_metrics) or (
@@ -1232,7 +1499,7 @@ def main(argv=None) -> int:
     ap.add_argument("--all", action="store_true",
                     help="fold in tools/metrics_lint.py (rule METRICS)")
     ap.add_argument("--only", default=None,
-                    help="comma-separated rule ids (R0..R5, METRICS)")
+                    help="comma-separated rule ids (R0..R6, METRICS)")
     ap.add_argument("--changed", action="store_true",
                     help="lint only files changed vs git HEAD")
     ap.add_argument("--json", action="store_true", dest="as_json")

@@ -37,14 +37,24 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 def _render(report: dict) -> str:
     lines = []
+    bounds = report.get("bounds") or {}
     lines.append(
         f"kernel cost census — sources {report['source_fingerprint']}, "
         f"chip model {report['chip_model']['name']}"
     )
+    if bounds:
+        ok = "fresh" if bounds.get("certificate_ok") else "STALE/UNPROVEN"
+        lines.append(
+            f"limb-bounds: {bounds.get('certified_sites', '?')} certified "
+            f"sites, {bounds.get('certified_bodies', '?')} bodies, "
+            f"-{bounds.get('trimmed_passes_per_mul', 0)} carry passes/mul "
+            f"vs untrimmed, certificate {ok}"
+        )
     hdr = (f"{'bucket':>7} {'fp-mul/set':>11} {'Melem/set':>10} "
            f"{'dispatches':>10} {'bound':>8} {'roofline sets/s':>16} "
-           f"{'incl ovh':>9}")
+           f"{'incl ovh':>9} {'headroom':>9}")
     lines.append(hdr)
+    hb = bounds.get("min_headroom_bits")
     for b, e in sorted(report["buckets"].items(), key=lambda kv: int(kv[0])):
         r = e["roofline"]
         lines.append(
@@ -52,7 +62,8 @@ def _render(report: dict) -> str:
             f"{e['elem_ops_per_set'] / 1e6:>10.1f} "
             f"{e['kernel_dispatches']:>10} {r['bound']:>8} "
             f"{r['est_sets_per_s']:>16.1f} "
-            f"{r['est_sets_per_s_incl_overhead']:>9.1f}"
+            f"{r['est_sets_per_s_incl_overhead']:>9.1f} "
+            f"{'' if hb is None else f'{hb:.2f}b':>9}"
         )
         stages = e.get("stages")
         if stages:
@@ -120,6 +131,12 @@ def main() -> int:
     report = costs.kernel_costs(
         buckets, stages=not args.no_stages, epoch=not args.no_epoch
     )
+    try:
+        from lighthouse_tpu.ops import bounds as _bounds
+
+        report["bounds"] = _bounds.summary()
+    except Exception as e:  # the census must render without the prover
+        report["bounds"] = {"error": f"{type(e).__name__}: {e}"}
     if args.json:
         print(json.dumps(report, indent=1, sort_keys=True))
     else:
@@ -152,6 +169,45 @@ def main() -> int:
         with open(costs.budgets_path(), "w") as f:
             json.dump(budgets, f, indent=1)
         print(f"budgets written: {costs.budgets_path()}")
+        # a deliberate op cut re-derives the roofline: append it to the
+        # PERF.jsonl trajectory so the gate compares the next bench
+        # round against the post-cut baseline, not the stale one
+        try:
+            from lighthouse_tpu.tools import perf_ledger
+
+            row = {
+                "schema": perf_ledger.SCHEMA,
+                "source": "kernel_report.py --update-budgets",
+                "mode": "census",
+                "note": "re-derived roofline after a deliberate op cut",
+                "kernel": {
+                    b: {
+                        "fp_muls_per_set": e["fp_muls_per_set"],
+                        "elem_ops_per_set": e["elem_ops_per_set"],
+                        "roofline_est_sets_per_s": (
+                            e["roofline"]["est_sets_per_s"]
+                        ),
+                    }
+                    for b, e in report["buckets"].items()
+                },
+            }
+            if isinstance(report.get("bounds"), dict) and (
+                "min_headroom_bits" in report["bounds"]
+            ):
+                bd = report["bounds"]
+                row["bounds"] = {
+                    k: bd.get(k)
+                    for k in (
+                        "certified_sites", "min_headroom_bits",
+                        "trimmed_passes_per_mul", "certificate_ok",
+                    )
+                    if bd.get(k) is not None
+                }
+            if perf_ledger.append(row):
+                print(f"roofline row appended: {perf_ledger.default_path()}")
+        except Exception as e:
+            print(f"ledger append failed: {type(e).__name__}: {e}",
+                  file=sys.stderr)
 
     if args.check:
         problems = costs.check_budgets(report["buckets"])
