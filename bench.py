@@ -496,14 +496,17 @@ def _config_kernel_costs(detail):
 
 
 def _config_hash_costs(detail):
-    """detail.hash (ISSUE 11 tentpole): the SHA-256 compression census
-    of the pinned state-hashing scenarios (cold root / epoch boundary /
-    steady slot / block import @250k validators) with per-field and
-    per-cause attribution, dirty-chunk counts, and the v5e lane-kernel
-    roofline — the "what would ROADMAP item 4 buy us" column. Pure
-    host work and exact counts, so the hashing trajectory ships every
-    round, tunnel up or down, and tools/bench_gate.py fails any
-    round-over-round compression increase exactly like op counts."""
+    """detail.hash (ISSUE 11 tentpole; ISSUE 15 kernel half): the
+    SHA-256 compression census of the pinned state-hashing scenarios
+    (cold root / epoch boundary / steady slot / block import @250k
+    validators) with per-field and per-cause attribution, dirty-chunk
+    counts, the v5e lane-kernel roofline, AND the measured batched
+    lane-kernel wall clock next to the model prediction (the kernel
+    runs CPU-JAX on this host, so the measured column ships tunnel up
+    or down). Exact counts, so the hashing trajectory ships every
+    round and tools/bench_gate.py fails any round-over-round
+    compression increase exactly like op counts — plus measured
+    boundary/import hash-wall decay."""
     from lighthouse_tpu.ops import hash_costs
 
     detail["hash"] = hash_costs.hash_costs()
@@ -921,8 +924,10 @@ def main():
         _run_config("load", 60, _config_load)
         _run_config("kernel_costs", 60, _config_kernel_costs)
         # the merkleization census rides dead-tunnel rounds too
-        # (ISSUE 11): exact compression counts + roofline, host-only
-        _run_config("hash", 45, _config_hash_costs)
+        # (ISSUE 11/15): exact compression counts, the batched-kernel
+        # measured wall + model roofline columns (the kernel runs
+        # CPU-JAX here, so chipless rounds measure it too)
+        _run_config("hash", 75, _config_hash_costs)
         # contract-lint counts ride every round (ISSUE 12)
         _run_config("lint", 30, _config_lint)
         # limb-bounds certificates + headroom ride every round (ISSUE 14)
@@ -992,8 +997,9 @@ def main():
     # the kernel cost census + roofline rides every round (ISSUE 10)
     _run_config("kernel_costs", 60, _config_kernel_costs)
 
-    # the merkleization cost census rides every round too (ISSUE 11)
-    _run_config("hash", 45, _config_hash_costs)
+    # the merkleization cost census rides every round too (ISSUE 11;
+    # ISSUE 15 adds the batched-kernel measured-vs-roofline columns)
+    _run_config("hash", 75, _config_hash_costs)
 
     # per-stage epoch-boundary attribution rides every round (ISSUE 6)
     _run_config("epoch", 60, _config_epoch)

@@ -937,15 +937,36 @@ def _seq_root_plain(elem: SSZType, values, limit_chunks) -> bytes:
 
 # ---------------------------------------------------------------- containers
 
+# Content-keyed container root cache (ISSUE 15 satellite): serves
+# repeat roots of opted-in containers (Container(cache_root=True)) at
+# zero compressions. Keys retain their field values (a SyncCommittee
+# key holds its 512 pubkey bytes, ~25 KB), so the FIFO bound is small;
+# the live working set is a handful of committees.
+_CONTAINER_ROOT_CACHE: dict = {}
+_CONTAINER_ROOT_CACHE_MAX = 32
+
 
 class Container(SSZType):
     """A named, ordered set of typed fields. Subclass-free: built from a
-    field spec, producing lightweight value objects (SSZValue)."""
+    field spec, producing lightweight value objects (SSZValue).
 
-    def __init__(self, name: str, fields: Sequence[tuple]):
+    `cache_root=True` opts the container into the content-keyed root
+    cache below (ISSUE 15 satellite): hash_tree_root builds a content
+    tuple from the field values (immutable leaves / tuples of leaves /
+    ChunkedSeq tokens) and serves repeats from the cache — ZERO SHA-256
+    compressions for an unchanged value. Content keys make this safe
+    under any mutation pattern (a changed value is a different key, the
+    _cached_merkleize posture); values whose content cannot be cheaply
+    keyed fall through to the normal walk. Used by SyncCommittee: the
+    two 512-pubkey lists cost 1,028 compressions per root otherwise —
+    the largest steady-slot line in the PR 11 census."""
+
+    def __init__(self, name: str, fields: Sequence[tuple],
+                 cache_root: bool = False):
         self.name = name
         self.fields = list(fields)  # [(name, SSZType), ...]
         self.fmap = dict(self.fields)
+        self._cache_root = cache_root
         # field names whose values auto-wrap into a ChunkedSeq when a
         # big plain list is stored (List/Vector container fields)
         self._seq_fields = {
@@ -1020,7 +1041,52 @@ class Container(SSZType):
             fixed_vals[fname] = ftype.deserialize(data[start:end])
         return SSZValue(self, fixed_vals)
 
+    def _content_key(self, value):
+        """Hashable content tuple for the root cache, or None when a
+        field value is not cheaply keyable. Building the key costs
+        C-speed tuple/bytes hashing — zero SHA-256 compressions (the
+        census cache_key column pins that)."""
+        parts = [self.name]
+        for fname, _ftype in self.fields:
+            v = object.__getattribute__(value, "_vals")[fname]
+            if isinstance(v, (bytes, int, bool)):
+                parts.append(v)
+            elif isinstance(v, ChunkedSeq):
+                # equal tokens imply identical content (CoW contract)
+                parts.append(("cs", v._token))
+            elif type(v) is list:
+                # EVERY element must be an immutable leaf — one
+                # identity-hashed mutable element anywhere would make
+                # the key blind to its in-place mutation
+                if not all(isinstance(x, (bytes, int, bool)) for x in v):
+                    return None
+                parts.append(tuple(v))
+            else:
+                return None
+        return tuple(parts)
+
     def hash_tree_root(self, value) -> bytes:
+        if self._cache_root:
+            key = self._content_key(value)
+            if key is not None:
+                c = CENSUS
+                root = _CONTAINER_ROOT_CACHE.get(key)
+                if root is not None:
+                    if c is not None:
+                        c.cache_event("container", True)
+                    return root
+                if c is not None:
+                    c.cache_event("container", False)
+                root = self._hash_tree_root(value)
+                if len(_CONTAINER_ROOT_CACHE) >= _CONTAINER_ROOT_CACHE_MAX:
+                    _CONTAINER_ROOT_CACHE.pop(
+                        next(iter(_CONTAINER_ROOT_CACHE))
+                    )
+                _CONTAINER_ROOT_CACHE[key] = root
+                return root
+        return self._hash_tree_root(value)
+
+    def _hash_tree_root(self, value) -> bytes:
         c = CENSUS
         if c is None or not c.wants_fields():
             # nested containers keep the enclosing top-level field label:

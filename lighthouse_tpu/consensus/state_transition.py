@@ -37,6 +37,7 @@ from ..common import metrics as _metrics
 from ..common import tracing as _tracing
 from ..ops import epoch as _epoch_ops
 from ..ops import hash_costs as _hash_costs
+from ..ops.lane import merkle as _merkle
 from ..crypto import bls
 from ..crypto.bls.keys import PublicKey, Signature, SignatureSet
 from . import types as T
@@ -464,8 +465,14 @@ def process_slots(spec: ChainSpec, state, slot: int) -> None:
 def _process_slot(spec: ChainSpec, state) -> None:
     # the dominant pre-advance cost since the columnar epoch transition
     # (ROADMAP item 4): measured always, so every slot lands htr:<field>
-    # spans on the timelines and the state_hash_* series move in prod
+    # spans on the timelines and the state_hash_* series move in prod.
+    # prewarm (ISSUE 15) batches the dirty chunk subtrees through the
+    # lane SHA-256 kernel when the estimate crosses the launch-overhead
+    # threshold — epoch-boundary roots (incl. the on_slot_tail overlap,
+    # which runs process_slots) and cold roots after a checkpoint join
+    # batch in one pass; steady slots stay on the host path
     with _hash_costs.measure("slot_root", slot=int(state.slot)):
+        _merkle.prewarm(state, op="slot_root")
         previous_state_root = state.hash_tree_root()
     state.state_roots[state.slot % spec.preset.slots_per_historical_root] = (
         previous_state_root
@@ -518,6 +525,7 @@ def state_transition(
             raise BlockProcessingError("invalid block signature")
     process_block(spec, state, block, verify_signatures=verify_signatures)
     with _hash_costs.measure("state_root_check", slot=int(block.slot)):
+        _merkle.prewarm(state, op="state_root_check")
         root = state.hash_tree_root()
     if bytes(block.state_root) != root:
         raise BlockProcessingError("state root mismatch")

@@ -550,12 +550,18 @@ SyncAggregatorSelectionData = Container(
     [("slot", uint64), ("subcommittee_index", uint64)],
 )
 
+# cache_root: the two state committees are re-rooted EVERY slot at
+# 1,028 compressions each (512 per-pubkey Bytes48 roots + combines) —
+# the largest steady-slot line in the PR 11 census — yet rotate once
+# per ~256 epochs. The content-keyed cache makes an unchanged
+# committee cost 0 compressions (ISSUE 15 satellite).
 SyncCommittee = Container(
     "SyncCommittee",
     [
         ("pubkeys", Vector(Bytes48, _P.sync_committee_size)),
         ("aggregate_pubkey", Bytes48),
     ],
+    cache_root=True,
 )
 
 # ---------------------------------------------------------------- blobs / DA

@@ -24,6 +24,7 @@ from typing import Optional
 from ..common import metrics, tracing
 from ..consensus import state_transition as st
 from ..ops import hash_costs
+from ..ops.lane import merkle as _merkle
 from ..consensus import types as T
 from ..consensus.fork_choice import ForkChoice, ForkChoiceError
 from ..consensus.pubkey_cache import ValidatorPubkeyCache
@@ -314,7 +315,14 @@ class BeaconChain:
         chain serves and extends forward immediately."""
         anchor_block = signed_anchor_block.message
         anchor_root = anchor_block.hash_tree_root()
-        if bytes(anchor_block.state_root) != anchor_state.hash_tree_root():
+        # ISSUE 15: a restored state arrives without its per-chunk
+        # caches — this first (cold) root batches through the lane
+        # kernel and warms them in one pass, so the join's first epoch
+        # boundary prices like a boundary, not a second cold root
+        with hash_costs.measure("checkpoint_join_root", slot=None):
+            _merkle.prewarm(anchor_state, op="checkpoint_join_root")
+            anchor_state_root = anchor_state.hash_tree_root()
+        if bytes(anchor_block.state_root) != anchor_state_root:
             raise ValueError("anchor state does not match anchor block")
 
         self = cls.__new__(cls)
@@ -660,6 +668,10 @@ class BeaconChain:
                     self.spec, state, block, verify_signatures=False
                 )
                 with hash_costs.measure("block_import_root", slot=slot):
+                    # ISSUE 15: a block's worth of dirty chunks crosses
+                    # the batch threshold — one fused kernel pass, then
+                    # the root runs on warm caches
+                    _merkle.prewarm(state, op="block_import_root")
                     root = state.hash_tree_root()
                 if bytes(block.state_root) != root:
                     raise BlockError("state root mismatch")
@@ -1716,12 +1728,14 @@ class BeaconChain:
                         self.spec, bstate, blinded, verify_signatures=False
                     )
                     with hash_costs.measure("produce_block_root", slot=slot):
+                        _merkle.prewarm(bstate, op="produce_block_root")
                         blinded.state_root = bstate.hash_tree_root()
                     return blinded
                 except st.BlockProcessingError:
                     pass  # consensus-invalid header: fall back to local
             st.process_block(self.spec, state, block, verify_signatures=False)
             with hash_costs.measure("produce_block_root", slot=slot):
+                _merkle.prewarm(state, op="produce_block_root")
                 block.state_root = state.hash_tree_root()
             return block
 

@@ -84,6 +84,7 @@ from ..common import metrics, tracing
 from ..consensus import state_transition as st
 from ..consensus import types as T
 from ..ops import hash_costs
+from ..ops.lane import merkle as _merkle
 
 VERSION = "lighthouse-tpu/0.2.0"
 
@@ -287,6 +288,13 @@ class BeaconApi:
 
     def state_root(self, state_id: str):
         state = self._head_state(state_id)
+        # ISSUE 15: the read path hashes too (the census prices this
+        # route in http_request_hash_compressions_total). A warm head
+        # costs ~0 either way; serving a state whose caches are cold
+        # (first poll after a checkpoint join / restart) crosses the
+        # threshold and batches through the lane kernel inside the
+        # request's measure() — the dispatch wrapper attributes it
+        _merkle.prewarm(state, op="http:state_root")
         return 200, {"data": {"root": "0x" + state.hash_tree_root().hex()}}
 
     @staticmethod

@@ -1,0 +1,505 @@
+"""Level-synchronous batched merkleization scheduler (ISSUE 15).
+
+The host half of device-resident state hashing: walk every ChunkedSeq
+field of a state, gather ALL dirty chunks (cached subtree root
+invalidated — or never computed: a checkpoint-join restore) into
+uniform leaf batches, and merkleize them bottom-up with ONE
+`sha256.compress_pairs` dispatch per tree level — instead of the
+per-chunk Python `_hash` walk the scalar path pays. The computed
+per-chunk subtree roots are written back into the ChunkedSeq caches,
+so the subsequent `hash_tree_root()` runs entirely on the warm host
+residue (spine combines + small containers), bit-identical by
+construction to the scalar result.
+
+What batches, per element type:
+  basic (uintN/bool)  leaf words packed straight from the cached numpy
+                      identity column (ssz.seq_column) — no per-element
+                      int.to_bytes
+  Bytes32             chunk values ARE the leaves
+  flat containers     (all fixed-size leaf fields — Validator,
+                      PendingDeposit, ...): per-element serialized
+                      bytes are column-cached per chunk, field roots
+                      and the per-element tree batch as pre-levels, and
+                      the element roots become the chunk leaves
+  anything else       left to the scalar path (stays a dirty chunk)
+
+Routing: `prewarm(state)` is threshold-gated
+(ops/hash_costs.device_threshold(), the census launch-overhead
+crossover) so steady slots — already O(dirty chunks) at 99.8%
+chunk-cache hits — never pay a dispatch; epoch-boundary, cold-root
+(checkpoint join) and block-import roots cross it. Call sites:
+consensus/state_transition._process_slot + the state-root check,
+node/beacon_chain block import / from_checkpoint, and the
+states/{id}/root read path in node/http_api.
+
+Census: batched compressions report at the ssz.CENSUS seam under the
+new `device_batch` cause with the same per-field dirty-chunk counts
+the scalar path would record — scenario totals in
+tests/budgets/hash_costs.json cannot increase when routing flips.
+`LIGHTHOUSE_SHA256_DEVICE=0` disables routing (the census records the
+skip so `tools/hash_report.py --check` can fail a silently-skipped
+scenario).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import numpy as np
+
+from ...common import metrics
+from ...consensus import ssz
+from . import sha256
+
+M_DEVICE_BATCHES = metrics.counter(
+    "state_hash_device_batches_total",
+    "Batched SHA-256 tree-level dispatches by the merkleization "
+    "scheduler, by tree level (eN = flat-container element-tree "
+    "pre-levels, N = chunk-subtree levels counted from the leaves)",
+    labelnames=("level",),
+)
+M_DEVICE_COMPRESSIONS = metrics.counter(
+    "state_hash_device_compressions_total",
+    "SHA-256 compressions executed by the batched lane kernel "
+    "(field/cause attribution lands in state_hash_compressions_total "
+    "under cause=device_batch)",
+)
+
+_ZERO_WORDS = [
+    np.frombuffer(c, dtype=">u4").astype(np.uint32)
+    for c in ssz._ZERO_CHUNKS
+]
+
+
+def device_enabled() -> bool:
+    return os.environ.get("LIGHTHOUSE_SHA256_DEVICE", "1") not in (
+        "0", "false", ""
+    )
+
+
+# ------------------------------------------------------------------ plans
+
+
+class _FlatPlan:
+    """Per-container-type recipe for batching element roots: byte
+    offsets/sizes of every field in the serialized form, per-field
+    chunk counts, and the element-tree width. Valid only when every
+    field is a fixed-size leaf (Uint/Boolean/ByteVector) — then the
+    element root is a fixed dag over the serialized bytes."""
+
+    __slots__ = ("size", "fields", "names", "width", "per_elem_nodes",
+                 "fast")
+
+    def __init__(self, ctype: ssz.Container):
+        off = 0
+        self.fields = []  # (offset, nbytes, chunk_count)
+        self.names = []   # (fname, is_numeric) aligned with fields
+        self.fast = True  # vectorized serializer applies
+        for fname, ftype in ctype.fields:
+            n = ftype.fixed_size()
+            self.fields.append((off, n, max(1, (n + 31) // 32)))
+            numeric = isinstance(ftype, (ssz.Uint, ssz.Boolean))
+            self.names.append((fname, numeric))
+            if numeric and n not in (1, 2, 4, 8):
+                self.fast = False  # Uint(128+): per-element to_bytes
+            off += n
+        self.size = off
+        self.width = ssz._next_pow2(len(ctype.fields))
+        nodes = _tree_nodes(len(ctype.fields), self.width.bit_length() - 1)
+        for _o, _n, cf in self.fields:
+            if cf > 1:
+                nodes += _tree_nodes(cf, ssz._next_pow2(cf).bit_length() - 1)
+        self.per_elem_nodes = nodes
+
+
+# keyed by the descriptor OBJECT (identity hash — keeps it alive), not
+# id(): a collected type's reused address must never serve another
+# type's byte offsets
+_FLAT_PLANS: dict = {}
+
+
+def _flat_plan(elem) -> "_FlatPlan | None":
+    try:
+        plan = _FLAT_PLANS.get(elem)
+    except TypeError:  # unhashable descriptor: no plan
+        return None
+    if plan is not None:
+        return plan if isinstance(plan, _FlatPlan) else None
+    ok = isinstance(elem, ssz.Container) and all(
+        isinstance(ft, (ssz.Uint, ssz.Boolean, ssz.ByteVector))
+        for _f, ft in elem.fields
+    )
+    plan = _FlatPlan(elem) if ok else False
+    _FLAT_PLANS[elem] = plan
+    return plan if ok else None
+
+
+def _tree_nodes(leaves: int, depth: int) -> int:
+    """Hash-node count of ssz.merkleize over `leaves` chunks padded to
+    2**depth — the layer-by-layer zero-padding arithmetic, exactly."""
+    total = 0
+    layer = leaves
+    for _ in range(depth):
+        if layer % 2:
+            layer += 1
+        total += layer // 2
+        layer //= 2
+    return total
+
+
+class _FieldScan:
+    __slots__ = ("field", "seq", "elem", "kind", "depth", "dirty",
+                 "nodes", "plan")
+
+    def __init__(self, field, seq, elem, kind, depth, dirty, nodes, plan):
+        self.field = field
+        self.seq = seq
+        self.elem = elem
+        self.kind = kind          # "basic" | "bytes32" | "flat"
+        self.depth = depth        # per-chunk subtree depth (k)
+        self.dirty = dirty        # chunk indices to recompute
+        self.nodes = nodes        # hash nodes the batch will execute
+        self.plan = plan
+
+
+def _chunk_leaf_count(elem, n_elems: int) -> int:
+    if isinstance(elem, (ssz.Uint, ssz.Boolean)):
+        return (n_elems * elem.fixed_size() + 31) // 32
+    return n_elems
+
+
+def _scan_value(value, top_field, out) -> None:
+    ctype = value._type
+    for fname, ftype in ctype.fields:
+        v = value._vals.get(fname)
+        label = top_field or fname
+        if isinstance(v, ssz.SSZValue):
+            _scan_value(v, label, out)
+            continue
+        if not isinstance(v, ssz.ChunkedSeq) or not v._chunks:
+            continue
+        elem = ftype.elem
+        # mirror _chunked_seq_root's fallback condition: when the whole
+        # tree is shallower than one chunk's subtree, the scalar path
+        # never consults the per-chunk caches — nothing to prewarm
+        if isinstance(elem, (ssz.Uint, ssz.Boolean)):
+            actual = (len(v) * elem.fixed_size() + 31) // ssz.BYTES_PER_CHUNK
+        else:
+            actual = len(v)
+        if type(ftype) is ssz.List:
+            if isinstance(elem, (ssz.Uint, ssz.Boolean)):
+                total = (ftype.limit * elem.fixed_size() + 31) // 32
+            else:
+                total = ftype.limit
+        else:
+            total = actual
+        depth = ssz._next_pow2(total).bit_length() - 1
+        k = ssz._chunk_depth(elem)
+        if depth < k:
+            continue
+        if isinstance(elem, (ssz.Uint, ssz.Boolean)):
+            if elem.fixed_size() not in (1, 2, 4, 8):
+                continue
+            kind, plan = "basic", None
+        elif isinstance(elem, ssz.ByteVector) and elem.length == 32:
+            kind, plan = "bytes32", None
+        else:
+            plan = _flat_plan(elem)
+            if plan is None:
+                continue
+            kind = "flat"
+        if v._root_elem is not elem:
+            dirty = list(range(len(v._chunks)))
+        else:
+            roots = v._roots
+            dirty = [ci for ci in range(len(v._chunks)) if roots[ci] is None]
+        if not dirty:
+            continue
+        nodes = 0
+        for ci in dirty:
+            m = len(v._chunks[ci])
+            nodes += _tree_nodes(_chunk_leaf_count(elem, m), k)
+            if kind == "flat":
+                nodes += m * plan.per_elem_nodes
+        out.append(_FieldScan(label, v, elem, kind, k, dirty, nodes, plan))
+
+
+def scan(value) -> list:
+    """Every ChunkedSeq field of `value` (recursing through nested
+    containers, labeled by top-level field) with a batchable dirty set,
+    plus the exact hash-node count the batch would execute."""
+    out: list = []
+    _scan_value(value, None, out)
+    return out
+
+
+def estimate(value) -> int:
+    """SHA-256 compressions the batched path would absorb for the next
+    hash_tree_root of `value` — the threshold input (2 per node)."""
+    return 2 * sum(f.nodes for f in scan(value))
+
+
+# ------------------------------------------------------------------ leaves
+
+
+def _basic_leaves(seq, elem, ci: int) -> np.ndarray:
+    """Packed leaf words of one basic-element chunk, from the cached
+    identity column: vectorized little-endian packing, zero-padded to
+    whole 32-byte chunks, as (n_leaves, 8) big-endian words."""
+    size = elem.fixed_size()
+    col = ssz.seq_column(seq, np.dtype(f"<u{size}"))
+    lo = ci * ssz.CHUNK_ELEMS
+    data = col[lo: lo + len(seq._chunks[ci])].tobytes()
+    if len(data) % 32:
+        data += b"\x00" * (32 - len(data) % 32)
+    return np.frombuffer(data, dtype=">u4").astype(np.uint32).reshape(-1, 8)
+
+
+def _bytes32_leaves(seq, ci: int) -> np.ndarray:
+    data = b"".join(bytes(v) for v in seq._chunks[ci])
+    return np.frombuffer(data, dtype=">u4").astype(np.uint32).reshape(-1, 8)
+
+
+def _flat_serialize(vals: list, elem, plan: _FlatPlan) -> np.ndarray:
+    """(n, size) uint8 serialization matrix of flat-container values.
+    Fast path: one pass per FIELD (np.fromiter over attribute reads /
+    one bytes join), assembled by column slices — ~15x cheaper than
+    n Container.serialize calls at registry scale."""
+    n = len(vals)
+    if not plan.fast:
+        buf = b"".join(elem.serialize(v) for v in vals)
+        return np.frombuffer(buf, dtype=np.uint8).reshape(n, plan.size)
+    out = np.empty((n, plan.size), dtype=np.uint8)
+    for (off, nbytes, _cf), (fname, numeric) in zip(plan.fields, plan.names):
+        if numeric:
+            col = np.fromiter(
+                (v._vals[fname] for v in vals),
+                dtype=f"<u{nbytes}", count=n,
+            )
+            out[:, off: off + nbytes] = col.view(np.uint8).reshape(n, nbytes)
+        else:
+            buf = b"".join(v._vals[fname] for v in vals)
+            out[:, off: off + nbytes] = np.frombuffer(
+                buf, dtype=np.uint8
+            ).reshape(n, nbytes)
+    return out
+
+
+def _serialized_column(seq, elem, plan: _FlatPlan) -> np.ndarray:
+    """Per-element serialized bytes of a flat-container sequence as a
+    (len, size) uint8 matrix, column-cached per dirty chunk (the
+    epoch-columns machinery: refresh cost is O(dirty chunks))."""
+    s = plan.size
+
+    def build(vals, _elem=elem, _plan=plan, _s=s):
+        mat = _flat_serialize(vals, _elem, _plan)
+        return (np.ascontiguousarray(mat).view(f"V{_s}").reshape(-1),)
+
+    col = seq.columns(f"ser:{elem.name}", build)[0]
+    return col.view(np.uint8).reshape(len(seq), s)
+
+
+class _Level:
+    """One kernel dispatch batch being assembled for a tree level."""
+
+    __slots__ = ("lefts", "rights", "claims")
+
+    def __init__(self):
+        self.lefts: list = []
+        self.rights: list = []
+        self.claims: list = []  # (consumer, n_pairs) in order
+
+    def add(self, layer: np.ndarray, pad_level: int, claim) -> int:
+        """Queue one layer's pairs (padding an odd layer with the
+        level-`pad_level` zero subtree); returns the pair count."""
+        n = layer.shape[-2]
+        if n % 2:
+            z = np.broadcast_to(
+                _ZERO_WORDS[pad_level], layer.shape[:-2] + (1, 8)
+            )
+            layer = np.concatenate([layer, z], axis=-2)
+            n += 1
+        flat = layer.reshape(-1, 8)
+        self.lefts.append(flat[0::2])
+        self.rights.append(flat[1::2])
+        pairs = flat.shape[0] // 2
+        self.claims.append((claim, pairs))
+        return pairs
+
+
+def _dispatch(level: _Level, label: str, rec) -> dict:
+    """Run one fused level batch; returns {claim: parent rows}."""
+    left = np.concatenate(level.lefts, axis=0)
+    right = np.concatenate(level.rights, axis=0)
+    t0 = time.perf_counter()
+    parents = sha256.compress_pairs(left, right)
+    dt = time.perf_counter() - t0
+    n = parents.shape[0]
+    M_DEVICE_BATCHES.labels(level=label).inc()
+    M_DEVICE_COMPRESSIONS.inc(2 * n)
+    if rec is not None:
+        rec.on_device_batch(label, n, dt)
+    out = {}
+    pos = 0
+    for claim, pairs in level.claims:
+        out[claim] = parents[pos: pos + pairs]
+        pos += pairs
+    return out
+
+
+def _reduce_layers(layer: np.ndarray, label: str, rec) -> np.ndarray:
+    """Merkleize (M, width, 8) subtrees level-by-level with ssz's
+    odd-layer zero padding — value- AND count-identical to
+    ssz.merkleize per lane. Returns (M, 8)."""
+    m = layer.shape[0]
+    d = 0
+    while layer.shape[1] > 1:
+        lvl = _Level()
+        lvl.add(layer, d, "x")
+        layer = _dispatch(lvl, f"{label}{d}", rec)["x"].reshape(m, -1, 8)
+        d += 1
+    return layer[:, 0]
+
+
+def _element_roots(ser: np.ndarray, plan: _FlatPlan, rec) -> np.ndarray:
+    """Batched element hash_tree_roots of M flat-container elements
+    from their serialized bytes: per-field roots (multi-chunk fields
+    merkleize as pre-levels), then the element tree — all lanes of all
+    elements per level in one dispatch. Returns (M, 8) root words."""
+    m = ser.shape[0]
+    nfields = len(plan.fields)
+    field_roots = np.empty((m, nfields, 8), dtype=np.uint32)
+    for fi, (off, nbytes, cf) in enumerate(plan.fields):
+        chunks = np.zeros((m, cf * 32), dtype=np.uint8)
+        chunks[:, :nbytes] = ser[:, off: off + nbytes]
+        layer = chunks.view(">u4").astype(np.uint32).reshape(m, cf, 8)
+        if cf > 1:
+            field_roots[:, fi] = _reduce_layers(layer, f"ef{fi}_", rec)
+        else:
+            field_roots[:, fi] = layer[:, 0]
+    if nfields == 1:
+        return field_roots[:, 0]
+    return _reduce_layers(field_roots, "e", rec)
+
+
+# ------------------------------------------------------------------ prewarm
+
+
+def prewarm(value, threshold=None, op: str = "prewarm") -> "dict | None":
+    """Batch-compute every dirty ChunkedSeq chunk subtree root of
+    `value` and write them back into the per-chunk caches (the host
+    residue), so the following hash_tree_root() is all cache hits plus
+    spine/small-container work.
+
+    Returns a summary dict when the batch ran, None when the estimated
+    work sat below the threshold (steady slots: the host path is
+    already O(dirty chunks) and a dispatch would cost more than it
+    saves — the census crossover in ops/hash_costs.device_threshold).
+    Pass threshold=0 to force the device path (tests), or a large
+    value to force the host path."""
+    fields = scan(value)
+    est = 2 * sum(f.nodes for f in fields)
+    if est == 0:
+        return None
+    if threshold is None:
+        from .. import hash_costs
+
+        threshold = hash_costs.device_threshold()
+    if est < threshold:
+        return None
+    rec = ssz.CENSUS
+    if not device_enabled():
+        if rec is not None:
+            rec.on_device_skip(est)
+        return None
+
+    san = ssz.SANITIZER
+    # flat-container element roots first: they are the deepest levels
+    # of the batch and produce the chunk leaves for their fields
+    elem_roots: dict = {}
+    for f in fields:
+        if f.kind != "flat":
+            continue
+        ser = _serialized_column(f.seq, f.elem, f.plan)
+        rows = [
+            ser[ci * ssz.CHUNK_ELEMS: ci * ssz.CHUNK_ELEMS
+                + len(f.seq._chunks[ci])]
+            for ci in f.dirty
+        ]
+        roots = _element_roots(np.concatenate(rows, axis=0), f.plan, rec)
+        pos = 0
+        for ci in f.dirty:
+            n = len(f.seq._chunks[ci])
+            elem_roots[(id(f.seq), ci)] = roots[pos: pos + n]
+            pos += n
+
+    # chunk subtrees, level-synchronous across all fields: group jobs
+    # of identical (leaf count, depth) so a full-chunk field is ONE
+    # stacked array per level, not hundreds of python-level jobs
+    layers: dict = {}   # f -> {ci: current layer (n, 8)}
+    for f in fields:
+        per = {}
+        for ci in f.dirty:
+            if f.kind == "basic":
+                per[ci] = _basic_leaves(f.seq, f.elem, ci)
+            elif f.kind == "bytes32":
+                per[ci] = _bytes32_leaves(f.seq, ci)
+            else:
+                per[ci] = elem_roots[(id(f.seq), ci)]
+        layers[f] = per
+
+    max_depth = max(f.depth for f in fields)
+    for d in range(max_depth):
+        lvl = _Level()
+        stacked = {}  # claim -> list of cis (uniform-width groups)
+        for f in fields:
+            if d >= f.depth:
+                continue
+            per = layers[f]
+            by_width: dict = {}
+            # every job runs to its FULL subtree depth: a partial
+            # chunk that narrows to width 1 early keeps combining
+            # with the level-d zero subtree, exactly like
+            # merkleize(leaves, 1 << k) does
+            for ci, layer in per.items():
+                by_width.setdefault(layer.shape[0], []).append(ci)
+            for width, cis in by_width.items():
+                group = np.stack([per[ci] for ci in cis], axis=0)
+                claim = (f, width)
+                lvl.add(group, d, claim)
+                stacked[claim] = cis
+        if not lvl.claims:
+            continue
+        results = _dispatch(lvl, str(d), rec)
+        for claim, cis in stacked.items():
+            f, _w = claim
+            parents = results[claim].reshape(len(cis), -1, 8)
+            for j, ci in enumerate(cis):
+                layers[f][ci] = parents[j]
+
+    # write the computed subtree roots back as the host-side residue
+    total_nodes = 0
+    for f in fields:
+        seq = f.seq
+        if seq._root_elem is not f.elem:
+            seq._roots = [None] * len(seq._chunks)
+            seq._root_elem = f.elem
+        for ci in f.dirty:
+            if san is not None and seq._san:
+                san.on_chunk_root(seq, ci)
+            layer = layers[f][ci]
+            root = layer[0].astype(">u4").tobytes()
+            seq._roots[ci] = root
+        total_nodes += f.nodes
+        if rec is not None:
+            rec.on_device(f.field, 2 * f.nodes, len(f.dirty))
+    return {
+        "backend": sha256.active_backend(),
+        "compressions": 2 * total_nodes,
+        "fields": {
+            f.field: {"dirty_chunks": len(f.dirty), "nodes": f.nodes}
+            for f in fields
+        },
+        "op": op,
+    }

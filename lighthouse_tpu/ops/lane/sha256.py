@@ -1,0 +1,289 @@
+"""Lane-major batched SHA-256 compression kernel (ISSUE 15 tentpole).
+
+PR 11 priced state merkleization at the ssz.CENSUS seam: cold roots
+cost 4.95M SHA-256 compressions (~138x on the v5e lane model), epoch
+boundaries 156,544 (~25-30x), block imports 42,808 — all pure 32-bit
+ALU, the ideal lane-major workload next to the Fp kernels. This module
+is the kernel half: the SHA-256 compression function over N
+independent 64-byte messages (merkle tree nodes: two 32-byte child
+roots), words on the leading axis and the batch riding the trailing
+lane axis — the ops/lane layout contract ([stack..., W, S]).
+
+Backends (the PR 6 recipe, ops/epoch.py precedent)
+--------------------------------------------------
+numpy   — always available; uint32 wraparound arithmetic, the
+          reference implementation.
+jax     — the same `_rounds` body under `jax.jit`, one compiled
+          program per power-of-two lane bucket (pad + slice), pinned
+          to the CPU backend for the same reason the epoch program is:
+          production roots are host-critical-path work and a dead
+          tunnel must never hang them (the chip flip ships with a
+          tunnel window; the v5e roofline in ops/hash_costs.py says
+          what it buys). Selected only when a build-time self-check
+          reproduces the `hashlib` oracle BIT-IDENTICALLY on
+          randomized messages; any failure falls back to numpy.
+
+`LIGHTHOUSE_SHA256_JAX=0` forces numpy; `=1` makes a jax build/check
+failure raise (CI for the jit path). `LIGHTHOUSE_SHA256_BACKEND`
+overrides the pinned jax platform (default cpu).
+
+Cost shape: one merkle node = SHA-256 over 64 bytes = exactly 2
+compression invocations (data block + constant padding block). The
+padding block's message schedule is input-independent, so its 48
+schedule steps fold into per-round constants (`_KW_PAD`) — ~2,950
+elementwise ops per compression, the SHA256_LANE_MODEL figure.
+
+The module is fingerprint-frozen like the Fp kernels: it lives in the
+`TB.source_fingerprint()` glob (ops/lane/*.py), and `source_
+fingerprint()` below pins the sha256+merkle pair specifically into
+tests/budgets/hash_costs.json — graft-lint fails a kernel edit that
+forgets the budget refresh (tools/hash_report.py --update-budgets).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+
+import numpy as np
+
+# SHA-256 round constants / initial state (FIPS 180-4)
+K = np.array([
+    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b,
+    0x59f111f1, 0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01,
+    0x243185be, 0x550c7dc3, 0x72be5d74, 0x80deb1fe, 0x9bdc06a7,
+    0xc19bf174, 0xe49b69c1, 0xefbe4786, 0x0fc19dc6, 0x240ca1cc,
+    0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da, 0x983e5152,
+    0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+    0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc,
+    0x53380d13, 0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85,
+    0xa2bfe8a1, 0xa81a664b, 0xc24b8b70, 0xc76c51a3, 0xd192e819,
+    0xd6990624, 0xf40e3585, 0x106aa070, 0x19a4c116, 0x1e376c08,
+    0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a, 0x5b9cca4f,
+    0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2,
+], dtype=np.uint32)
+
+IV = np.array([
+    0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+    0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19,
+], dtype=np.uint32)
+
+_M32 = 0xFFFFFFFF
+
+
+def _pad_schedule() -> np.ndarray:
+    """K[t] + W[t] for the CONSTANT second block of a 64-byte message
+    (0x80 delimiter + zeros + bit length 512): the whole message
+    schedule is input-independent, so block 2 runs without its 48
+    schedule steps. Python-int arithmetic — exact, no numpy scalar
+    overflow warnings at import."""
+    w = [0x80000000] + [0] * 14 + [512]
+    for t in range(16, 64):
+        x15, x2 = w[t - 15], w[t - 2]
+        s0 = (((x15 >> 7) | (x15 << 25)) ^ ((x15 >> 18) | (x15 << 14))
+              ^ (x15 >> 3)) & _M32
+        s1 = (((x2 >> 17) | (x2 << 15)) ^ ((x2 >> 19) | (x2 << 13))
+              ^ (x2 >> 10)) & _M32
+        w.append((w[t - 16] + s0 + w[t - 7] + s1) & _M32)
+    return np.array(
+        [(int(K[t]) + w[t]) & _M32 for t in range(64)], dtype=np.uint32
+    )
+
+
+_KW_PAD = _pad_schedule()
+
+# lane buckets: every dispatch pads its pair count to one of these, so
+# the jit cache holds at most len(_BUCKETS) programs per process (the
+# AOT-bucket posture of the BLS lanes). Levels larger than MAX_LANES
+# loop in FULL MAX_LANES dispatches — padding waste then applies only
+# to the final remainder, so per-lane cost stays within ~2% of the
+# largest bucket's (~0.48 us/lane measured CPU-JAX) at any batch size.
+# Four shapes keep the per-process first-use cost (jaxpr trace +
+# compile-cache load, ~2 s/shape for the unrolled 64-round graph)
+# bounded; the compiled programs persist in .jax_cache.
+_BUCKETS = (512, 2048, 8192, 32768)
+MAX_LANES = _BUCKETS[-1]
+
+
+def bucket(n: int) -> int:
+    for b in _BUCKETS:
+        if n <= b:
+            return b
+    return MAX_LANES
+
+
+def _rotr(x, n: int):
+    return (x >> n) | (x << (32 - n))
+
+
+def _rounds(xp, h, w):
+    """The 64 compression rounds against `xp` = numpy | jax.numpy.
+    `h` is the running state (8 lane arrays); `w` is either the 16
+    message words (schedule computed here) or None for the constant
+    padding block (`_KW_PAD` folds K+W per round)."""
+    kw = None
+    if w is None:
+        kw = _KW_PAD
+    else:
+        w = list(w)
+        for t in range(16, 64):
+            x15, x2 = w[t - 15], w[t - 2]
+            s0 = _rotr(x15, 7) ^ _rotr(x15, 18) ^ (x15 >> 3)
+            s1 = _rotr(x2, 17) ^ _rotr(x2, 19) ^ (x2 >> 10)
+            w.append(w[t - 16] + s0 + w[t - 7] + s1)
+    a, b, c, d, e, f, g, hh = h
+    for t in range(64):
+        s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+        ch = (e & f) ^ (~e & g)
+        if kw is None:
+            t1 = hh + s1 + ch + K[t] + w[t]
+        else:
+            t1 = hh + s1 + ch + kw[t]
+        s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        t2 = s0 + maj
+        hh, g, f, e, d, c, b, a = g, f, e, d + t1, c, b, a, t1 + t2
+    return [x + y for x, y in zip(h, (a, b, c, d, e, f, g, hh))]
+
+
+def _digest_pairs(xp, left, right):
+    """Merkle-node digests: SHA-256 over the 64-byte concatenation of
+    two 32-byte children. left/right: (8, N) big-endian uint32 words
+    (lane-major); returns (8, N)."""
+    w16 = [left[i] for i in range(8)] + [right[i] for i in range(8)]
+    h = [xp.broadcast_to(IV[i], left[0].shape) for i in range(8)]
+    h = _rounds(xp, h, w16)     # block 1: the two child roots
+    h = _rounds(xp, h, None)    # block 2: constant SHA padding
+    return xp.stack(h)
+
+
+def _numpy_pairs(left: np.ndarray, right: np.ndarray) -> np.ndarray:
+    return _digest_pairs(np, left, right)
+
+
+def _build_jax_backend():
+    """Build (and oracle-check) the jitted per-bucket programs; raises
+    on any mismatch so the dispatcher falls back to numpy. CPU-pinned
+    by default (see module doc); compiled programs persist in
+    .jax_cache, so warm processes pay a trace+cache-load (~1.5 s per
+    bucket used), not a compile."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from ... import enable_compilation_cache
+
+    # every consumer (census, node, tools) must hit the persistent
+    # cache — an unseeded process would otherwise pay ~10 s of XLA
+    # compile per bucket ON the measured path
+    enable_compilation_cache()
+    platform = os.environ.get("LIGHTHOUSE_SHA256_BACKEND", "cpu")
+    device = jax.devices(platform)[0]
+
+    @functools.lru_cache(maxsize=None)
+    def _jitted(nb: int):
+        del nb  # shape-keyed cache entry; jit re-specializes per shape
+        return jax.jit(lambda l, r: _digest_pairs(jnp, l, r))
+
+    def call(left: np.ndarray, right: np.ndarray) -> np.ndarray:
+        n = left.shape[1]
+        nb = bucket(n)
+        if n < nb:
+            pad = np.zeros((8, nb - n), dtype=np.uint32)
+            left = np.concatenate([left, pad], axis=1)
+            right = np.concatenate([right, pad], axis=1)
+        with jax.default_device(device):
+            out = _jitted(nb)(left, right)
+        return np.asarray(out)[:, :n]
+
+    # build-time self-check: bit-identity vs the hashlib oracle on
+    # randomized lanes, exercising the padding path (odd lane count)
+    rng = np.random.default_rng(15)
+    n = 261
+    left = rng.integers(0, 1 << 32, (8, n), dtype=np.uint32)
+    right = rng.integers(0, 1 << 32, (8, n), dtype=np.uint32)
+    want = oracle_pairs(left, right)
+    got = call(left, right)
+    if not np.array_equal(want, got):
+        raise RuntimeError("jax sha256 kernel diverges from hashlib")
+    return call
+
+
+def oracle_pairs(left: np.ndarray, right: np.ndarray) -> np.ndarray:
+    """The hashlib reference the backends are checked against."""
+    lb = np.ascontiguousarray(left.T).astype(">u4").tobytes()
+    rb = np.ascontiguousarray(right.T).astype(">u4").tobytes()
+    out = b"".join(
+        hashlib.sha256(
+            lb[32 * i: 32 * i + 32] + rb[32 * i: 32 * i + 32]
+        ).digest()
+        for i in range(left.shape[1])
+    )
+    return np.frombuffer(out, dtype=">u4").reshape(-1, 8).T.astype(
+        np.uint32
+    )
+
+
+_BACKEND = None
+_BACKEND_NAME = None
+
+
+def _resolve_backend():
+    global _BACKEND, _BACKEND_NAME
+    if _BACKEND is not None:
+        return _BACKEND
+    mode = os.environ.get("LIGHTHOUSE_SHA256_JAX", "")
+    if mode == "0":
+        _BACKEND, _BACKEND_NAME = _numpy_pairs, "numpy"
+        return _BACKEND
+    try:
+        _BACKEND = _build_jax_backend()
+        _BACKEND_NAME = "jax"
+    except Exception:
+        if mode == "1":
+            raise
+        _BACKEND, _BACKEND_NAME = _numpy_pairs, "numpy"
+    return _BACKEND
+
+
+def active_backend() -> str:
+    """'jax' or 'numpy' — resolved on first use, for bench/census."""
+    _resolve_backend()
+    return _BACKEND_NAME
+
+
+def compress_pairs(left: np.ndarray, right: np.ndarray) -> np.ndarray:
+    """Hash N merkle nodes in one batch: left/right are (N, 8) uint32
+    big-endian child-root words (node-major at the API so the tree
+    scheduler slices layers naturally); compute runs lane-major.
+    Returns (N, 8) parent words — bit-identical to
+    sha256(left||right) per lane on every backend."""
+    n = left.shape[0]
+    if n == 0:
+        return np.empty((0, 8), dtype=np.uint32)
+    out = np.empty((n, 8), dtype=np.uint32)
+    fn = _resolve_backend()
+    for lo in range(0, n, MAX_LANES):
+        hi = min(n, lo + MAX_LANES)
+        out[lo:hi] = fn(
+            np.ascontiguousarray(left[lo:hi].T),
+            np.ascontiguousarray(right[lo:hi].T),
+        ).T
+    return out
+
+
+def source_fingerprint() -> str:
+    """Hash of the sha256 kernel + tree-scheduler sources, pinned in
+    tests/budgets/hash_costs.json (the R3 posture for the hashing
+    kernel: an edit without `tools/hash_report.py --update-budgets`
+    fails graft-lint and the budget gate). The files also sit in the
+    broader `TB.source_fingerprint()` glob, so BLS profile caches and
+    export artifacts stale on the same edits."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    h = hashlib.sha256()
+    for name in ("merkle.py", "sha256.py"):
+        with open(os.path.join(here, name), "rb") as f:
+            h.update(f.read())
+    return h.hexdigest()[:16]

@@ -24,6 +24,10 @@ except schema/source/recorded_at; compare only what both rows carry:
   hash              {scenario: sha256 compressions} (ISSUE 11 census:
                     steady_slot / epoch_boundary / block_import /
                     cold_root @250k validators — exact counts)
+  hash_wall_s       {scenario: measured hash seconds} (ISSUE 15: host
+                    + batched-kernel wall per scenario; boundary and
+                    import gate round-over-round)
+  hash_device_wall_s {scenario: batched-kernel-only seconds}
   epoch_warm_s      {"250k": s, "500k": s}
   bounds            {certified_sites, min_headroom_bits,
                     trimmed_passes_per_mul, certificate_ok} (ISSUE 14
@@ -152,6 +156,29 @@ def row_from_bench(doc: dict, source: str = "bench.py") -> dict:
         }
         if sub:
             row["hash"] = sub
+        # ISSUE 15: measured hash wall clock per scenario (host +
+        # batched kernel) and the kernel-only wall — the bench gate
+        # fails round-over-round decay on boundary/import like the
+        # epoch stage seconds
+        wall = {
+            name: float(e["wall_s"])
+            for name, e in scen.items()
+            if isinstance(e, dict)
+            and isinstance(e.get("wall_s"), (int, float))
+            and e["wall_s"] > 0
+        }
+        if wall:
+            row["hash_wall_s"] = wall
+        dev = {
+            name: float(e["device"]["wall_s"])
+            for name, e in scen.items()
+            if isinstance(e, dict)
+            and isinstance((e.get("device") or {}).get("wall_s"),
+                           (int, float))
+            and e["device"]["wall_s"] > 0
+        }
+        if dev:
+            row["hash_device_wall_s"] = dev
     bd = detail.get("bounds", {})
     if isinstance(bd, dict) and (
         "min_headroom_bits" in bd or "certificate_ok" in bd
@@ -276,6 +303,15 @@ COMPARE_FIELDS = (
      "count", 0.0),
     ("hash.block_import", "sha256 compressions @block-import",
      "count", 0.0),
+    # ISSUE 15: measured hash wall clock of the batched boundary /
+    # import scenarios — the kernel's win must not silently decay.
+    # Floors ~2x the warm CPU-JAX measurements (boundary ~0.1 s,
+    # import ~0.05 s) so shared-CI scheduling noise cannot flap the
+    # gate; the census count gates above catch work-shape regressions
+    # at exact precision either way
+    ("hash_wall_s.epoch_boundary", "hash wall @epoch-boundary", "time",
+     0.2),
+    ("hash_wall_s.block_import", "hash wall @block-import", "time", 0.1),
     # ISSUE 14: certified int32 headroom of the limb-bounds prover —
     # a decrease below the 2-bit slack floor means a norm-schedule or
     # kernel edit spent the safety margin the trim search preserves

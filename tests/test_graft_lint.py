@@ -346,6 +346,26 @@ def test_static_fingerprint_matches_backend():
     assert graft_lint.kernel_fingerprint() == TB.source_fingerprint()
 
 
+def test_static_sha256_fingerprint_matches_kernel():
+    """Same pin for the batched-merkleization pair (ISSUE 15): the
+    linter's static hash must equal ops/lane/sha256.source_
+    fingerprint(), or the hash-budget R3 check is disarmed."""
+    from lighthouse_tpu.ops.lane import sha256
+
+    assert graft_lint.sha256_fingerprint() == sha256.source_fingerprint()
+
+
+def test_r3_fires_on_sha256_fingerprint_drift(monkeypatch):
+    """A sha256/merkle kernel edit without a hash_costs.json refresh
+    is an R3 finding naming the hash_report refresh command."""
+    monkeypatch.setattr(
+        graft_lint, "sha256_fingerprint", lambda: "feedfacefeedface"
+    )
+    findings = graft_lint._r3_sha256_check()
+    assert findings and findings[0].rule == "R3"
+    assert "hash_report.py --update-budgets" in findings[0].hint
+
+
 # -------------------------------------------------------- bench integration
 
 

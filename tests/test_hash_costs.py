@@ -59,20 +59,88 @@ def test_census_structure(scenarios):
     assert cold["compressions"] > 1_000_000
     # the validator registry dominates a cold root
     assert max(cold["by_field"], key=cold["by_field"].get) == "validators"
+    # ISSUE 15: a cold root batches through the lane kernel (the
+    # checkpoint-join path) — the dirty-chunk work runs as
+    # device_batch, none of it as a scalar re-walk
+    assert cold["by_cause"]["device_batch"] > 0
+    assert cold["by_cause"]["dirty_chunk"] == 0
+    assert cold["device"]["batches"] > 0
     # epoch boundary: the balance writeback dirties every balances
     # chunk (250k / 1024 elems per chunk), and the dirty-set machinery
-    # must re-hash exactly those — not the whole field tree
+    # must re-hash exactly those — not the whole field tree. The
+    # boundary root crosses the device threshold, so the in-chunk
+    # work lands under device_batch
     boundary = scenarios["epoch_boundary"]
     assert boundary["dirty_by_field"]["balances"] == 245
-    assert boundary["by_cause"]["dirty_chunk"] > 0
+    assert boundary["by_cause"]["device_batch"] > 0
+    assert boundary["by_cause"]["dirty_chunk"] == 0
+    assert boundary["device"]["wall_s"] >= 0.0
     # steady slot: chunk caches must make hashing O(dirty chunks) —
-    # a couple of root-vector chunks, >99% chunk-cache hit rate
+    # a couple of root-vector chunks, >99% chunk-cache hit rate —
+    # and the device path must NOT engage (launch overhead dominates
+    # below the threshold: zero batches, the acceptance assertion)
     steady = scenarios["steady_slot"]
     hits = steady["cache"]["hits"].get("chunk", 0)
     misses = steady["cache"]["misses"].get("chunk", 0)
     assert misses <= 4
     assert hits / (hits + misses) > 0.99
     assert steady["compressions"] < cold["compressions"] / 100
+    assert steady["by_cause"]["device_batch"] == 0
+    assert steady["device"]["batches"] == 0
+    assert steady["device"]["skipped_est"] == 0
+    # block import: the root checks cross the threshold (the two
+    # steady-shaped slot advances inside the scenario stay host-side)
+    imp = scenarios["block_import"]
+    assert imp["by_cause"]["device_batch"] > 0
+    assert imp["device"]["batches"] > 0
+    # ISSUE 15 satellite: the sync-committee root caches removed the
+    # two 1,028-compression lines from EVERY slot root — the steady
+    # budget moved strictly DOWN (9,208 before the satellite)
+    assert steady["compressions"] < 9_208
+    assert "current_sync_committee" not in steady["by_field"]
+    assert "next_sync_committee" not in steady["by_field"]
+
+
+def test_budget_device_coverage_checks(scenarios):
+    """ISSUE 15: the budget file pins WHICH scenarios the routing
+    threshold must cover — a silently-skipped device path (or a
+    steady path that started batching) fails --check."""
+    boundary = scenarios["epoch_boundary"]
+    budgets = {
+        "slack_ratio": 0.02,
+        "scenarios": {"epoch_boundary": {
+            "compressions": boundary["compressions"],
+            "device_batched": True,
+        }},
+    }
+    assert hc.check_budgets(scenarios, budgets) == []
+    # claim the boundary must NOT batch -> coverage problem
+    budgets["scenarios"]["epoch_boundary"]["device_batched"] = False
+    problems = hc.check_budgets(scenarios, budgets)
+    assert problems and "host-side" in problems[0]
+    # a scenario that should batch but ran 0 dispatches
+    steady = scenarios["steady_slot"]
+    budgets = {
+        "slack_ratio": 0.02,
+        "scenarios": {"steady_slot": {
+            "compressions": steady["compressions"],
+            "device_batched": True,
+        }},
+    }
+    problems = hc.check_budgets(scenarios, budgets)
+    assert problems and "silently skipped" in problems[0]
+
+
+def test_budget_kernel_fingerprint_check(scenarios):
+    budgets = {
+        "slack_ratio": 0.02,
+        "kernel_fingerprint": "0" * 16,
+        "scenarios": {},
+    }
+    problems = hc.check_budgets(scenarios, budgets)
+    assert problems and "--update-budgets" in problems[0]
+    budgets["kernel_fingerprint"] = hc.kernel_fingerprint()
+    assert hc.check_budgets(scenarios, budgets) == []
 
 
 def test_budget_regression_detected(scenarios):
@@ -293,7 +361,8 @@ def test_concurrent_measure_does_not_garble():
 # ------------------------------------------------- bench gate fixtures
 
 
-def _bench_doc(steady=9208, boundary=156544, imp=42808):
+def _bench_doc(steady=7152, boundary=152432, imp=34584,
+               boundary_wall=0.95, imp_wall=0.45, boundary_dev=0.065):
     return {
         "value": 0.0,
         "detail": {
@@ -302,8 +371,16 @@ def _bench_doc(steady=9208, boundary=156544, imp=42808):
                 "schema": hc.SCHEMA,
                 "scenarios": {
                     "steady_slot": {"compressions": steady},
-                    "epoch_boundary": {"compressions": boundary},
-                    "block_import": {"compressions": imp},
+                    "epoch_boundary": {
+                        "compressions": boundary,
+                        "wall_s": boundary_wall,
+                        "device": {"wall_s": boundary_dev, "batches": 9},
+                    },
+                    "block_import": {
+                        "compressions": imp,
+                        "wall_s": imp_wall,
+                        "device": {"wall_s": 0.012, "batches": 22},
+                    },
                 },
             },
         },
@@ -313,9 +390,19 @@ def _bench_doc(steady=9208, boundary=156544, imp=42808):
 def test_ledger_row_hash_projection():
     row = L.row_from_bench(_bench_doc(), source="t")
     assert row["hash"] == {
-        "steady_slot": 9208,
-        "epoch_boundary": 156544,
-        "block_import": 42808,
+        "steady_slot": 7152,
+        "epoch_boundary": 152432,
+        "block_import": 34584,
+    }
+    # ISSUE 15: measured hash wall clocks project too (the bench-gate
+    # decay inputs), device-kernel wall separately
+    assert row["hash_wall_s"] == {
+        "epoch_boundary": 0.95,
+        "block_import": 0.45,
+    }
+    assert row["hash_device_wall_s"] == {
+        "epoch_boundary": 0.065,
+        "block_import": 0.012,
     }
 
 
@@ -340,7 +427,36 @@ def test_bench_gate_hash_fixture(tmp_path):
     # a decrease (deliberate cut) passes the gate — the budget file
     # staleness check is what forces the same-diff budget update
     better = L.row_from_bench(
-        _bench_doc(steady=9000, boundary=150000), source="r4"
+        _bench_doc(steady=7000, boundary=150000), source="r4"
     )
     L.append(better, path)
     assert bench_gate.gate(path) == []
+
+
+def test_bench_gate_hash_wall_fixture(tmp_path):
+    """ISSUE 15 satellite: round-over-round decay in the MEASURED
+    boundary/import hash wall clock fails the gate (ratio + absolute
+    noise floor, like the epoch stage seconds)."""
+    sys.path.insert(0, os.path.join(_REPO, "tools"))
+    import bench_gate
+
+    path = str(tmp_path / "PERF.jsonl")
+    L.append(L.row_from_bench(_bench_doc(), source="r1"), path)
+    # small jitter inside ratio+floor: passes
+    ok = L.row_from_bench(_bench_doc(boundary_wall=1.05), source="r2")
+    L.append(ok, path)
+    assert bench_gate.gate(path) == []
+    # a 2x boundary hash-wall blowup (past tolerance AND floor) fails
+    worse = L.row_from_bench(_bench_doc(boundary_wall=2.2), source="r3")
+    L.append(worse, path)
+    problems = bench_gate.gate(path)
+    assert problems and "hash wall @epoch-boundary" in problems[0]
+    # import wall decay flags on its own field
+    L.append(L.row_from_bench(_bench_doc(boundary_wall=2.2), source="r4"),
+             path)
+    worse2 = L.row_from_bench(
+        _bench_doc(boundary_wall=2.2, imp_wall=1.4), source="r5"
+    )
+    L.append(worse2, path)
+    problems = bench_gate.gate(path)
+    assert problems and "hash wall @block-import" in problems[0]

@@ -1,0 +1,384 @@
+"""Batched merkleization differential suite (ISSUE 15, tier-1).
+
+Layers under test:
+  1. ops/lane/sha256.py — the lane-major SHA-256 compression kernel
+     vs the hashlib oracle, bit-identical on BOTH backends (numpy
+     always; the jit path must be active under CPU-JAX or the
+     build-time self-check is broken).
+  2. ops/lane/merkle.py — batched-tree hash_tree_root bit-identical to
+     the scalar path across randomized states: odd chunk tails,
+     single-chunk fields, empty lists, flat-container elements
+     (multi-chunk + non-power-of-two field counts), mixed dirty sets
+     after CoW copies — with EQUAL census compression counts (the
+     budgets cannot move when routing flips) and the property that the
+     scheduler visits exactly the census-reported dirty set.
+  3. Routing: below the launch-overhead threshold prewarm is a no-op
+     (steady slots never batch); the per-chunk caches are the host
+     residue (post-prewarm roots are all chunk hits).
+  4. Checkpoint-join satellite: a state restored without its caches
+     cold-roots through the batch in ONE pass, and the next boundary
+     prices like a boundary, not a second cold root.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from lighthouse_tpu.common import metrics  # noqa: E402
+from lighthouse_tpu.consensus import ssz  # noqa: E402
+from lighthouse_tpu.ops import hash_costs as hc  # noqa: E402
+from lighthouse_tpu.ops.lane import merkle, sha256  # noqa: E402
+
+
+# ------------------------------------------------------------------ kernel
+
+
+def test_kernel_bit_identical_to_hashlib():
+    rng = np.random.default_rng(1501)
+    for n in (1, 2, 257, 1030):  # odd sizes force lane padding
+        left = rng.integers(0, 1 << 32, (n, 8), dtype=np.uint32)
+        right = rng.integers(0, 1 << 32, (n, 8), dtype=np.uint32)
+        got = sha256.compress_pairs(left, right)
+        want = sha256.oracle_pairs(left.T, right.T).T
+        assert np.array_equal(got, want), f"n={n} backend mismatch"
+
+
+def test_numpy_backend_bit_identical():
+    rng = np.random.default_rng(1502)
+    left = rng.integers(0, 1 << 32, (8, 517), dtype=np.uint32)
+    right = rng.integers(0, 1 << 32, (8, 517), dtype=np.uint32)
+    got = sha256._numpy_pairs(left, right)
+    want = sha256.oracle_pairs(left, right)
+    assert np.array_equal(got, want)
+
+
+def test_jit_backend_active_under_cpu_jax():
+    """The PR 6 recipe: jax.jit is selected only when the build-time
+    self-check reproduces hashlib bit-identically — and under the
+    tier-1 CPU-JAX environment it MUST succeed (a silent numpy
+    fallback here would hide a broken jit path)."""
+    pytest.importorskip("jax")
+    if os.environ.get("LIGHTHOUSE_SHA256_JAX", "") == "0":
+        pytest.skip("numpy forced by env")
+    assert sha256.active_backend() == "jax"
+
+
+def test_fingerprint_matches_budget_pin():
+    budgets = hc.load_budgets()
+    assert budgets.get("kernel_fingerprint") == sha256.source_fingerprint(), (
+        "ops/lane/sha256.py or merkle.py changed without a budget "
+        "refresh — python tools/hash_report.py --update-budgets"
+    )
+
+
+# ------------------------------------------------------------ differential
+
+
+_VAL = ssz.Container(
+    "DiffVal",
+    [
+        ("pubkey", ssz.Bytes48),
+        ("wc", ssz.Bytes32),
+        ("eff", ssz.uint64),
+        ("slashed", ssz.boolean),
+        ("a", ssz.uint64),
+        ("b", ssz.uint64),
+        ("c", ssz.uint64),
+        ("d", ssz.uint64),
+    ],
+)
+# 5 fields: a non-power-of-two element tree; Bytes96 packs to 3 chunks
+_ODD = ssz.Container(
+    "DiffOdd",
+    [
+        ("pk", ssz.Bytes48),
+        ("amt", ssz.uint64),
+        ("sig", ssz.Bytes96),
+        ("slot", ssz.uint64),
+        ("flag", ssz.boolean),
+    ],
+)
+_STATE = ssz.Container(
+    "DiffState",
+    [
+        ("bal", ssz.List(ssz.uint64, 1 << 24)),
+        ("flags", ssz.List(ssz.uint8, 1 << 24)),
+        ("roots", ssz.Vector(ssz.Bytes32, 4096)),
+        ("vals", ssz.List(_VAL, 1 << 20)),
+        ("odds", ssz.List(_ODD, 1 << 20)),
+        ("empty", ssz.List(ssz.uint64, 1 << 24)),
+        ("single", ssz.List(ssz.uint64, 1 << 24)),
+        ("bits", ssz.List(ssz.boolean, 1 << 24)),
+        ("slot", ssz.uint64),
+    ],
+)
+
+
+def _mk_val(rng, i):
+    return _VAL.make(
+        pubkey=bytes(rng.integers(0, 256, 48, dtype=np.uint8)),
+        wc=bytes(rng.integers(0, 256, 32, dtype=np.uint8)),
+        eff=int(rng.integers(0, 1 << 62)),
+        slashed=bool(i % 3 == 0),
+        a=i, b=i * 2, c=i * 3, d=i * 5,
+    )
+
+
+def _mk_odd(rng, i):
+    return _ODD.make(
+        pk=bytes(rng.integers(0, 256, 48, dtype=np.uint8)),
+        amt=i,
+        sig=bytes(rng.integers(0, 256, 96, dtype=np.uint8)),
+        slot=i,
+        flag=bool(i % 2),
+    )
+
+
+def _mk_state(rng):
+    v = _STATE.make(
+        bal=[int(x) for x in rng.integers(0, 1 << 62, 5003)],
+        flags=[int(x) for x in rng.integers(0, 256, 3001)],
+        roots=[
+            bytes(rng.integers(0, 256, 32, dtype=np.uint8))
+            for _ in range(4096)
+        ],
+        vals=[_mk_val(rng, i) for i in range(2113)],
+        odds=[_mk_odd(rng, i) for i in range(2500)],
+        empty=[],
+        single=[],
+        bits=[bool(i % 7 == 0) for i in range(2600)],
+        slot=99,
+    )
+    # a single-chunk ChunkedSeq (below the auto-wrap threshold, so
+    # constructed directly): partial lone chunk, deep limit tree
+    v.single = ssz.ChunkedSeq(
+        [int(x) for x in rng.integers(0, 1 << 62, 700)], elem=ssz.uint64
+    )
+    return v
+
+
+def _mutate(rng, v):
+    """One randomized round of mixed mutations, CoW-safe forms only."""
+    n_bal = len(v.bal)
+    for i in rng.integers(0, n_bal, 7):
+        v.bal[int(i)] = int(v.bal[int(i)]) + 1
+    v.flags[int(rng.integers(0, len(v.flags)))] = int(rng.integers(0, 256))
+    v.roots[int(rng.integers(0, 4096))] = bytes(
+        rng.integers(0, 256, 32, dtype=np.uint8)
+    )
+    mv = ssz.seq_get_mut(v.vals, int(rng.integers(0, len(v.vals))))
+    mv.eff = int(rng.integers(0, 1 << 62))
+    v.odds.append(_mk_odd(rng, int(rng.integers(0, 1 << 30))))
+    v.bal.append(int(rng.integers(0, 1 << 62)))
+    v.single[int(rng.integers(0, 700))] = int(rng.integers(0, 1 << 62))
+
+
+def test_batched_roots_bit_identical_randomized():
+    """The core differential: scalar vs forced-batch roots and census
+    totals, across cold state, mutation rounds, and CoW copies."""
+    rng = np.random.default_rng(1503)
+    a = _mk_state(rng)
+    rng2 = np.random.default_rng(1503)
+    b = _mk_state(rng2)
+
+    for round_no in range(4):
+        with hc.measure("scalar", spans=False) as rs:
+            root_a = _STATE.hash_tree_root(a)
+        with hc.measure("batched", spans=False) as rb:
+            info = merkle.prewarm(b, threshold=0)
+            root_b = _STATE.hash_tree_root(b)
+        assert root_a == root_b, f"round {round_no}"
+        assert rs.compressions == rb.compressions, f"round {round_no}"
+        assert rs.dirty == rb.dirty, f"round {round_no}"
+        # everything the scalar path would re-hash per dirty chunk ran
+        # on the kernel instead
+        if round_no == 0:
+            assert info is not None
+            assert rb.by_cause()["device_batch"] > 0
+            assert rb.by_cause()["dirty_chunk"] == 0
+        # next round: same mutations on both sides, through copies so
+        # shared-chunk CoW shapes the dirty sets
+        a = a.copy()
+        b = b.copy()
+        mrng_a = np.random.default_rng(1600 + round_no)
+        mrng_b = np.random.default_rng(1600 + round_no)
+        _mutate(mrng_a, a)
+        _mutate(mrng_b, b)
+
+
+def test_scheduler_visits_exactly_the_dirty_set():
+    """Property (ISSUE 15 satellite): the level scheduler's visited
+    chunk set == the census-reported dirty set == the ChunkedSeq
+    version counters' answer."""
+    rng = np.random.default_rng(1504)
+    v = _mk_state(rng)
+    merkle.prewarm(v, threshold=0)
+    v.hash_tree_root()  # caches fully warm
+
+    snaps = {
+        name: v._vals[name].versions()
+        for name in ("bal", "flags", "roots", "vals", "odds", "single")
+    }
+    _mutate(np.random.default_rng(1505), v)
+
+    with hc.measure("visit", spans=False) as rec:
+        info = merkle.prewarm(v, threshold=0)
+    assert info is not None
+    for name, snap in snaps.items():
+        seq = v._vals[name]
+        expected = set(seq.dirty_chunks_since(snap))
+        visited = info["fields"].get(name, {}).get("dirty_chunks", 0)
+        assert visited == len(expected), (
+            f"{name}: scheduler visited {visited} chunks, "
+            f"dirty set has {len(expected)}"
+        )
+        assert rec.dirty.get(name, 0) == len(expected)
+    # and nothing else was scheduled
+    assert set(info["fields"]) == {
+        name for name, snap in snaps.items()
+        if v._vals[name].dirty_chunks_since(snap)
+    }
+
+
+def test_prewarm_leaves_host_residue():
+    """After a prewarm, the per-chunk subtree caches are warm: the
+    following root pays ZERO chunk misses — the scalar path runs on
+    the residue exactly as today."""
+    rng = np.random.default_rng(1506)
+    v = _mk_state(rng)
+    merkle.prewarm(v, threshold=0)
+    with hc.measure("residue", spans=False) as rec:
+        v.hash_tree_root()
+    assert rec.misses.get("chunk", 0) == 0
+    assert rec.by_cause()["dirty_chunk"] == 0
+    assert rec.by_cause()["device_batch"] == 0
+
+
+def test_threshold_keeps_small_dirty_sets_on_host():
+    """Steady-slot shape: a couple of dirty chunks sit far below the
+    launch-overhead crossover — prewarm is a no-op and the device
+    batch counters do not move."""
+    rng = np.random.default_rng(1507)
+    v = _mk_state(rng)
+    merkle.prewarm(v, threshold=0)
+    v.hash_tree_root()
+    v.bal[123] = 1  # one dirty chunk
+    fam = metrics.get("state_hash_device_batches_total")
+
+    def batches():
+        return sum(fam.labels(*lv).value for lv in fam.label_values())
+
+    before = batches()
+    assert merkle.prewarm(v) is None  # default threshold
+    assert batches() == before
+    with hc.measure("host", spans=False) as rec:
+        v.hash_tree_root()
+    assert rec.by_cause()["device_batch"] == 0
+    assert rec.by_cause()["dirty_chunk"] > 0
+
+
+def test_estimate_matches_executed_compressions():
+    """The threshold input is exact: the scan's estimate equals what
+    the batch then executes (2 compressions per hash node)."""
+    rng = np.random.default_rng(1508)
+    v = _mk_state(rng)
+    est = merkle.estimate(v)
+    info = merkle.prewarm(v, threshold=0)
+    assert est == info["compressions"]
+
+
+def test_device_disabled_records_skip():
+    rng = np.random.default_rng(1509)
+    v = _mk_state(rng)
+    os.environ["LIGHTHOUSE_SHA256_DEVICE"] = "0"
+    try:
+        with hc.measure("skip", spans=False) as rec:
+            assert merkle.prewarm(v, threshold=0) is None
+        assert rec.device_skipped_est > 0
+        assert rec.report()["device"]["skipped_est"] > 0
+    finally:
+        del os.environ["LIGHTHOUSE_SHA256_DEVICE"]
+
+
+# --------------------------------------------------- checkpoint join
+
+
+def test_checkpoint_join_cold_root_then_boundary_prices_like_boundary():
+    """ISSUE 15 small fix, census-asserted: a state restored without
+    its caches (serialize -> deserialize, the checkpoint-join shape)
+    pays ONE batched cold root that warms every per-chunk cache; the
+    first epoch boundary after it prices like a boundary (O(dirty
+    chunks)), not a second cold re-walk of clean subtrees."""
+    from lighthouse_tpu.consensus import state_transition as st
+    from lighthouse_tpu.tools.scale_probe import build_state
+
+    spec, state = build_state(20_000)
+    restored = state._type.deserialize(state.serialize())
+    assert isinstance(restored.validators, ssz.ChunkedSeq)
+    assert restored.validators._roots == [None] * len(
+        restored.validators._chunks
+    )
+
+    with hc.measure("join_cold", spans=False) as cold:
+        merkle.prewarm(restored)  # default threshold: a cold root batches
+        restored.hash_tree_root()
+    assert cold.by_cause()["device_batch"] > 0
+    # the registry dominates a cold root and it all ran batched
+    assert cold.by_cause()["device_batch"] > 0.8 * cold.compressions
+    assert cold.by_cause()["dirty_chunk"] == 0
+
+    # boundary after the join: process_slots routes through the same
+    # prewarm; the cost is the epoch's dirty set, not the registry
+    with hc.measure("join_boundary", spans=False) as boundary:
+        st.process_slots(spec, restored, int(restored.slot) + 2)
+    assert boundary.compressions < 0.10 * cold.compressions, (
+        f"first boundary after a checkpoint join re-walked clean "
+        f"subtrees: {boundary.compressions} vs cold "
+        f"{cold.compressions}"
+    )
+
+
+def test_sync_committee_root_cache():
+    """ISSUE 15 satellite: an unchanged sync committee costs ZERO
+    compressions (content-keyed container root cache); a changed one
+    misses and re-roots correctly."""
+    from lighthouse_tpu.consensus import types as T
+
+    ssz._CONTAINER_ROOT_CACHE.clear()
+    pubkeys = [bytes([i % 256]) * 48 for i in range(512)]
+    sc = T.SyncCommittee.make(pubkeys=pubkeys, aggregate_pubkey=b"\xaa" * 48)
+    with hc.measure("sc_cold", spans=False) as cold:
+        root0 = T.SyncCommittee.hash_tree_root(sc)
+    with hc.measure("sc_warm", spans=False) as warm:
+        root1 = T.SyncCommittee.hash_tree_root(sc)
+    assert root0 == root1
+    assert warm.compressions == 0
+    assert warm.hits.get("container", 0) == 1
+    # content change -> different key -> correct recompute
+    sc2 = T.SyncCommittee.make(
+        pubkeys=[b"\x77" * 48] + pubkeys[1:], aggregate_pubkey=b"\xaa" * 48
+    )
+    with hc.measure("sc_changed", spans=False) as changed:
+        root2 = T.SyncCommittee.hash_tree_root(sc2)
+    assert root2 != root0
+    assert changed.compressions > 0
+    # in-place mutation changes the content key too (no stale hit)
+    sc3 = T.SyncCommittee.make(
+        pubkeys=list(pubkeys), aggregate_pubkey=b"\xaa" * 48
+    )
+    T.SyncCommittee.hash_tree_root(sc3)
+    sc3.pubkeys[0] = b"\x99" * 48
+    root4 = T.SyncCommittee.hash_tree_root(sc3)
+    assert root4 != root0
+    # scalar oracle for the mutated value
+    fresh = T.SyncCommittee.make(
+        pubkeys=[b"\x99" * 48] + pubkeys[1:], aggregate_pubkey=b"\xaa" * 48
+    )
+    ssz._CONTAINER_ROOT_CACHE.clear()
+    assert T.SyncCommittee.hash_tree_root(fresh) == root4

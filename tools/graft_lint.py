@@ -902,10 +902,17 @@ def kernel_fingerprint() -> str:
 
 
 def r3_check() -> list:
-    """Fingerprint-frozen kernel sources vs the checked-in profile
-    cache: an edit without a budget refresh desyncs every census-based
-    gate (generalizes PR 11's stale-export lint from artifacts to
-    budgets)."""
+    """Fingerprint-frozen kernel sources vs the checked-in budget pins
+    — BOTH families: the BLS profile cache and the sha256 hash budgets
+    (an ops/lane edit can stale either or both; findings accumulate so
+    neither masks the other)."""
+    return _r3_bls_check() + _r3_sha256_check()
+
+
+def _r3_bls_check() -> list:
+    """The BLS-kernel half: an edit without a kernel_profiles.json
+    refresh desyncs every census-based gate (generalizes PR 11's
+    stale-export lint from artifacts to budgets)."""
     prof_path = os.path.join(_REPO, "tests", "budgets", "kernel_profiles.json")
     try:
         with open(prof_path) as f:
@@ -949,6 +956,63 @@ def r3_check() -> list:
                 "re-seed: python tools/kernel_report.py --update-budgets; "
                 "on the next tunnel window re-seed chip caches "
                 "(tools/tunnel_watch.sh)",
+            )
+        ]
+    return []
+
+
+def sha256_fingerprint() -> str:
+    """Static mirror of ops/lane/sha256.py source_fingerprint() (the
+    batched merkleization kernel + scheduler pair) — same files, same
+    order, same hash; tests/test_graft_lint.py pins the two
+    implementations equal."""
+    lane = os.path.join(TREE, "ops", "lane")
+    h = hashlib.sha256()
+    for name in ("merkle.py", "sha256.py"):
+        with open(os.path.join(lane, name), "rb") as f:
+            h.update(f.read())
+    return h.hexdigest()[:16]
+
+
+def _r3_sha256_check() -> list:
+    """ISSUE 15: the sha256 kernel fingerprint pinned in the HASH
+    budgets (tests/budgets/hash_costs.json) — a kernel/scheduler edit
+    without a hash_report --update-budgets stales every compression
+    budget and the measured-vs-roofline trajectory."""
+    path = os.path.join(_REPO, "tests", "budgets", "hash_costs.json")
+    hint = "re-measure: python tools/hash_report.py --update-budgets"
+    try:
+        with open(path) as f:
+            stored = json.load(f).get("kernel_fingerprint")
+    except Exception as e:
+        return [
+            Finding(
+                os.path.relpath(path, _REPO), 1, "R3",
+                f"hash budgets missing/unreadable "
+                f"({type(e).__name__}: {e})", hint,
+            )
+        ]
+    try:
+        cur = sha256_fingerprint()
+    except Exception as e:
+        return [
+            Finding(
+                os.path.join("lighthouse_tpu", "ops", "lane", "sha256.py"),
+                1, "R3",
+                f"sha256 kernel sources unreadable "
+                f"({type(e).__name__}: {e})",
+                "the sha256 fingerprint file set moved — update "
+                "sha256_fingerprint() in tools/graft_lint.py to match",
+            )
+        ]
+    if stored != cur:
+        return [
+            Finding(
+                os.path.join("lighthouse_tpu", "ops", "lane", "sha256.py"),
+                1, "R3",
+                f"batched-merkleization kernel sources changed "
+                f"(now {cur}, hash budgets pinned to {stored}) without "
+                "a hash_costs.json refresh", hint,
             )
         ]
     return []

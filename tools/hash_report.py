@@ -14,6 +14,14 @@ work, no chip required, ~15 s at 250k validators.
                                                 # change: rewrite the
                                                 # budget file in this diff
 
+ISSUE 15: the table carries the measured batched-kernel wall clock
+(`meas s`, this host's lane backend) next to the model prediction for
+the same compressions (`model s`, v5e + local launch), and `--check`
+fails when a scenario the routing threshold says should batch ran
+0 dispatches (device path silently skipped), when the batched-kernel
+source fingerprint drifted from the budget pin, or when a host-pinned
+scenario (steady slot) batched.
+
 The census mechanism (the ssz.CENSUS seam and the cause taxonomy) is
 documented in lighthouse_tpu/ops/hash_costs.py.
 """
@@ -37,11 +45,15 @@ def _render(report: dict) -> str:
     chip = report["chip_model"]
     lines.append(
         f"merkleization cost census — {report['validators']} validators, "
-        f"chip model {chip['name']} ({report['sha256_model']['name']})"
+        f"chip model {chip['name']} ({report['sha256_model']['name']}), "
+        f"lane kernel backend {report.get('kernel_backend', '?')} "
+        f"(fingerprint {report.get('kernel_fingerprint', '?')}), "
+        f"device threshold {report.get('device_threshold', '?')} "
+        f"compressions"
     )
     hdr = (f"{'scenario':>15} {'compressions':>13} {'dirty':>6} "
            f"{'chunk hit%':>10} {'host s':>8} {'v5e est s':>10} "
-           f"{'speedup':>8}")
+           f"{'speedup':>8} {'batched':>8} {'meas s':>8} {'model s':>8}")
     lines.append(hdr)
     for name, e in report["scenarios"].items():
         cache = e.get("cache", {})
@@ -53,17 +65,22 @@ def _render(report: dict) -> str:
         )
         r = e.get("roofline", {})
         speed = r.get("speedup_vs_host")
+        dev = e.get("device") or {}
         lines.append(
             f"{name:>15} {e['compressions']:>13} {e['dirty_chunks']:>6} "
             f"{hit_pct:>10} {e['wall_s']:>8.3f} "
             f"{r.get('device_est_s_incl_overhead', 0.0):>10.4f} "
-            f"{(f'{speed}x' if speed is not None else '-'):>8}"
+            f"{(f'{speed}x' if speed is not None else '-'):>8} "
+            f"{dev.get('compressions', 0):>8} "
+            f"{dev.get('wall_s', 0.0):>8.3f} "
+            f"{dev.get('model_est_s', 0.0):>8.4f}"
         )
         cause = e["by_cause"]
         lines.append(
             f"{'':>15}   cause: dirty_chunk {cause['dirty_chunk']} / "
             f"subtree {cause['subtree']} / cache_key {cause['cache_key']} "
-            f"/ small_container {cause['small_container']}"
+            f"/ small_container {cause['small_container']} / "
+            f"device_batch {cause.get('device_batch', 0)}"
         )
     # per-field census for the scenarios the ISSUE names
     for name in ("steady_slot", "epoch_boundary"):
@@ -110,15 +127,24 @@ def main() -> int:
             "state hash_tree_root (ops/hash_costs.py census). An "
             "accidental increase fails tests/test_hash_costs.py; a "
             "deliberate hashing change updates this file in the same "
-            "diff (tools/hash_report.py --update-budgets).",
+            "diff (tools/hash_report.py --update-budgets). "
+            "kernel_fingerprint pins the batched-kernel sources "
+            "(ops/lane/sha256.py + merkle.py — the R3 family); "
+            "device_batched pins which scenarios the routing "
+            "threshold must cover (false = must stay host-side).",
             "source": "ops/hash_costs.py state_scenarios()",
             "validators": n,
             "slack_ratio": 0.02,
+            "kernel_fingerprint": report["kernel_fingerprint"],
+            "device_threshold": report["device_threshold"],
             "scenarios": {
                 name: {
                     "compressions": e["compressions"],
                     "dirty_chunks": e["dirty_chunks"],
                     "by_cause": e["by_cause"],
+                    "device_batched": bool(
+                        (e.get("device") or {}).get("batches")
+                    ),
                 }
                 for name, e in report["scenarios"].items()
             },

@@ -72,6 +72,12 @@ REQUIRED_FAMILIES = {
     "state_merkle_cache_hits_total": ("level",),
     "state_merkle_cache_misses_total": ("level",),
     "http_request_hash_compressions_total": ("endpoint",),
+    # batched merkleization scheduler (ISSUE 15, ops/lane/merkle.py):
+    # per-tree-level kernel dispatches + total batched compressions —
+    # "census shows zero device batches below the threshold" is an
+    # assertable series fact
+    "state_hash_device_batches_total": ("level",),
+    "state_hash_device_compressions_total": (),
     # legacy unlabeled aggregates (kept for continuity)
     "beacon_processor_work_events_received_total": (),
     "beacon_processor_work_events_dropped_total": (),
@@ -180,6 +186,7 @@ def _import_surface(problems: list) -> None:
     # jax-heavy tpu module cannot import
     import lighthouse_tpu.crypto.bls.backends.device_metrics  # noqa: F401
     import lighthouse_tpu.ops.hash_costs  # noqa: F401
+    import lighthouse_tpu.ops.lane.merkle  # noqa: F401
 
     try:
         import lighthouse_tpu.crypto.bls.backends.tpu  # noqa: F401
