@@ -553,6 +553,48 @@ def _config_bounds(detail):
     detail["bounds"] = bounds.summary()
 
 
+def _config_suite(detail):
+    """detail.suite (ISSUE 16): the verification pipeline's own cost
+    every round — the census-predicted tier-1 fast-tier wall (from the
+    pinned tests/budgets/suite_costs.json), the last measured census on
+    this box (.suite_census.json, written by the tests/conftest.py
+    plugin) and whether that census was SIGTERM-truncated. Pure disk
+    reads, milliseconds; tools/bench_gate.py fails a round-over-round
+    growth of either wall and ANY truncated round — the correctness
+    gate must keep fitting its 870 s driver timeout."""
+    import sys as _sys
+
+    tools_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "tools")
+    if tools_dir not in _sys.path:
+        _sys.path.insert(0, tools_dir)
+    import suite_costs as _sc
+
+    sub = {}
+    budgets = None
+    try:
+        budgets = _sc.load_budgets()
+        sub["fast_tier_pred_s"] = _sc.predicted_fast_tier_s(budgets)
+        sub["fast_tier_budget_s"] = budgets.get("fast_tier_budget_s")
+        sub["budget_check"] = _sc.check_fast_tier(budgets)
+    except OSError:
+        sub["budgets"] = "missing (tests/budgets/suite_costs.json)"
+    try:
+        census = _sc.load_census()
+        sub["fast_tier_wall_s"] = census.get("wall_s")
+        sub["truncated"] = 1 if census.get("truncated_at") else 0
+        sub["truncated_at"] = census.get("truncated_at")
+        sub["census_markers_expr"] = census.get("markers_expr")
+        sub["census_modules"] = len(census.get("modules") or {})
+        sub["census_recorded_at"] = census.get("recorded_at")
+        if budgets is not None:
+            sub["module_check"] = _sc.check_budgets(census, budgets)
+    except OSError:
+        sub["census"] = "missing (.suite_census.json — no pytest " \
+                        "session on this box yet)"
+    detail["suite"] = sub
+
+
 def _seed_artifacts(detail):
     """Record the exported-artifact inventory (bucket, age, source-hash
     match) in detail.backend_init EVEN ON SUCCESS and mirror it into
@@ -932,6 +974,8 @@ def main():
         _run_config("lint", 30, _config_lint)
         # limb-bounds certificates + headroom ride every round (ISSUE 14)
         _run_config("bounds", 45, _config_bounds)
+        # the suite's own cost rides every round (ISSUE 16)
+        _run_config("suite", 10, _config_suite)
         _run_config("replay", 60, _config_replay)
         _emit()
         # a correctness-checked replay measurement IS a result: rc 0
@@ -1015,6 +1059,10 @@ def main():
 
     # limb-bounds certificates + headroom ride every round (ISSUE 14)
     _run_config("bounds", 45, _config_bounds)
+
+    # the fast tier's own predicted/measured wall rides every round
+    # (ISSUE 16) — the correctness gate's cost is a gated series too
+    _run_config("suite", 10, _config_suite)
 
     # ------------- in-repo CPU control (sanity only, NOT the baseline)
     if _left() > 30:

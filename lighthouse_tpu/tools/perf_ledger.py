@@ -34,6 +34,12 @@ except schema/source/recorded_at; compare only what both rows carry:
                     limb-bounds certificates: int32 headroom must
                     never decay below the 2-bit slack floor)
   load              {duty_p99_s, shed_rate, deadline_miss_rate}
+  suite             {fast_tier_pred_s, fast_tier_wall_s, truncated}
+                    (ISSUE 16 suite cost observatory: the census-
+                    predicted tier-1 fast-tier wall, the last measured
+                    one, and whether that census was SIGTERM-truncated
+                    — the correctness gate's own cost rides the same
+                    ratchet as epoch seconds)
   scenarios_pass    bool
   artifacts         export-artifact inventory summary
   note              free text
@@ -243,6 +249,20 @@ def row_from_bench(doc: dict, source: str = "bench.py") -> dict:
                 ]
         if sub:
             row["load"] = sub
+    suite = detail.get("suite", {})
+    if isinstance(suite, dict) and (
+        suite.get("fast_tier_pred_s") is not None
+        or suite.get("fast_tier_wall_s") is not None
+    ):
+        sub = {}
+        for k in ("fast_tier_pred_s", "fast_tier_wall_s"):
+            if isinstance(suite.get(k), (int, float)):
+                sub[k] = float(suite[k])
+        # truncation is count-gated (one is one too many): always
+        # present when the section is, defaulting to 0 so a later
+        # truncated round has a baseline to fail against
+        sub["truncated"] = int(suite.get("truncated") or 0)
+        row["suite"] = sub
     sc = detail.get("scenarios", {})
     if isinstance(sc, dict) and "pass_all" in sc:
         row["scenarios_pass"] = bool(sc["pass_all"])
@@ -321,6 +341,17 @@ COMPARE_FIELDS = (
     # own right: when the prover errors out min_headroom_bits goes
     # missing entirely and the numeric gate above would silently skip
     ("bounds.certificate_ok", "limb-bounds certificate", "flag", 0.0),
+    # ISSUE 16: the fast tier's own wall — the correctness gate must
+    # keep fitting its 870 s driver timeout, so a round-over-round
+    # growth of the census-predicted (or last measured) tier-1 wall
+    # fails like an epoch-seconds decay. Floors absorb box jitter
+    # (~30 s prediction re-pin noise, ~2 min measured-wall noise on a
+    # loaded 1-core box); a truncated census is exact — one rc-124 is
+    # one too many
+    ("suite.fast_tier_pred_s", "fast-tier predicted wall", "time", 30.0),
+    ("suite.fast_tier_wall_s", "fast-tier measured wall", "time", 120.0),
+    ("suite.truncated", "fast-tier truncation (timeout killed the "
+     "suite)", "count", 0.0),
     ("value_sets_per_s", "driver-verified sets/s", "rate", 0.0),
     ("replay.sets_per_s", "cpu-replay sets/s", "rate", 0.0),
 )

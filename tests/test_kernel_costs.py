@@ -34,11 +34,23 @@ from lighthouse_tpu.ops import costs  # noqa: E402
 from lighthouse_tpu.tools import perf_ledger as L  # noqa: E402
 
 
+# ISSUE 16 suite restructure: the live 128-bucket census (an XLA trace
+# of the whole AOT kernel, ~15 s warm / ~2 min after a kernel edit) and
+# everything keyed on it runs in the slow tier (-m crypto_heavy). The
+# fast tier keeps the jaxpr-walker unit test, the ledger/bench-gate
+# fixtures below, and the fingerprint-keyed twin
+# (tests/test_smoke_twins.py): a kernel edit drifts the budget pin and
+# fails tier-1 in milliseconds; the re-derived census then runs with
+# the slow tier.
+_CENSUS = pytest.mark.crypto_heavy
+
+
 @pytest.fixture(scope="module")
 def census128():
     return costs.census_stage(costs._whole_kernel, 128)
 
 
+@_CENSUS
 def test_census_within_budget_128(census128):
     budgets = costs.load_budgets()
     sub = {
@@ -49,6 +61,7 @@ def test_census_within_budget_128(census128):
     assert not problems, "\n".join(problems)
 
 
+@_CENSUS
 def test_census_structure(census128):
     # the census must actually see the kernel: every heavy op family
     # present, Miller structure at its static multiplicity
@@ -62,6 +75,7 @@ def test_census_structure(census128):
     assert census128["hbm_bytes"] > 0
 
 
+@_CENSUS
 def test_stage_attribution_sums_to_whole(census128):
     stages = {
         name: costs.census_stage(fn, 128)
@@ -77,6 +91,7 @@ def test_stage_attribution_sums_to_whole(census128):
     assert stages["ladders_subgroup"]["fp_muls"] > 0
 
 
+@_CENSUS
 def test_budget_regression_detected(census128):
     budgets = {
         "slack_ratio": 0.02,
@@ -93,6 +108,7 @@ def test_budget_regression_detected(census128):
     assert problems and "below budget" in problems[0]
 
 
+@_CENSUS
 def test_roofline_columns(census128):
     r = costs.roofline(
         census128["elem_ops"], census128["hbm_bytes"], 128
@@ -122,6 +138,7 @@ def test_census_large_buckets_within_budget():
     assert not problems, "\n".join(problems)
 
 
+@_CENSUS
 def test_per_set_counts_structurally_consistent(census128):
     """Per-set Fp-muls at larger buckets differ from bucket 128 only
     by the lane-product tree + finish amortization: the budgets file

@@ -79,6 +79,14 @@ def test_fingerprint_matches_budget_pin():
 
 # ------------------------------------------------------------ differential
 
+# ISSUE 16 suite restructure: the randomized big-state differentials
+# below cost tens of seconds each on the 1-core tier-1 box — they run
+# in the slow tier (-m crypto_heavy). The fast tier keeps the kernel
+# bit-identity + backend-selection tests above and the fingerprint-
+# keyed smoke twin (tests/test_smoke_twins.py), so a kernel edit still
+# fails tier-1 the round it lands.
+_DIFFERENTIAL = pytest.mark.crypto_heavy
+
 
 _VAL = ssz.Container(
     "DiffVal",
@@ -179,6 +187,7 @@ def _mutate(rng, v):
     v.single[int(rng.integers(0, 700))] = int(rng.integers(0, 1 << 62))
 
 
+@_DIFFERENTIAL
 def test_batched_roots_bit_identical_randomized():
     """The core differential: scalar vs forced-batch roots and census
     totals, across cold state, mutation rounds, and CoW copies."""
@@ -212,6 +221,7 @@ def test_batched_roots_bit_identical_randomized():
         _mutate(mrng_b, b)
 
 
+@_DIFFERENTIAL
 def test_scheduler_visits_exactly_the_dirty_set():
     """Property (ISSUE 15 satellite): the level scheduler's visited
     chunk set == the census-reported dirty set == the ChunkedSeq
@@ -246,6 +256,7 @@ def test_scheduler_visits_exactly_the_dirty_set():
     }
 
 
+@_DIFFERENTIAL
 def test_prewarm_leaves_host_residue():
     """After a prewarm, the per-chunk subtree caches are warm: the
     following root pays ZERO chunk misses — the scalar path runs on
@@ -260,6 +271,7 @@ def test_prewarm_leaves_host_residue():
     assert rec.by_cause()["device_batch"] == 0
 
 
+@_DIFFERENTIAL
 def test_threshold_keeps_small_dirty_sets_on_host():
     """Steady-slot shape: a couple of dirty chunks sit far below the
     launch-overhead crossover — prewarm is a no-op and the device
@@ -283,6 +295,7 @@ def test_threshold_keeps_small_dirty_sets_on_host():
     assert rec.by_cause()["dirty_chunk"] > 0
 
 
+@_DIFFERENTIAL
 def test_estimate_matches_executed_compressions():
     """The threshold input is exact: the scan's estimate equals what
     the batch then executes (2 compressions per hash node)."""
@@ -293,6 +306,7 @@ def test_estimate_matches_executed_compressions():
     assert est == info["compressions"]
 
 
+@_DIFFERENTIAL
 def test_device_disabled_records_skip():
     rng = np.random.default_rng(1509)
     v = _mk_state(rng)
@@ -309,6 +323,7 @@ def test_device_disabled_records_skip():
 # --------------------------------------------------- checkpoint join
 
 
+@_DIFFERENTIAL
 def test_checkpoint_join_cold_root_then_boundary_prices_like_boundary():
     """ISSUE 15 small fix, census-asserted: a state restored without
     its caches (serialize -> deserialize, the checkpoint-join shape)

@@ -1,0 +1,196 @@
+"""Fingerprint-keyed smoke twins for the demoted crypto-heavy suites
+(ISSUE 16).
+
+The suite restructure moved the expensive differential suites — the
+crypto-kernel modules (conftest _CRYPTO_HEAVY), the randomized
+sha256-lane differentials, the kernel-costs full census, the
+export-replay jit paths and the limb-bounds adversarial sets — behind
+the `slow` marker, out of the tier-1 fast tier. Each gets a twin here:
+
+  * the relevant budget-file FINGERPRINT PIN, recomputed statically
+    (graft_lint's jax-free mirrors) against the live kernel sources —
+    a kernel edit drifts the pin and fails the fast tier in
+    milliseconds, the round it lands, exactly like the demoted suite
+    would have failed in minutes;
+  * plus ONE representative fixed case per family (no randomization —
+    the breadth lives in the slow tier; the twin proves the kernel is
+    not obviously dead, e.g. a broken backend selection or a
+    value-corrupting refactor that happens to keep sources unhashed).
+
+The pin-check primitive itself is fixture-tested (a doctored pin must
+flag) so the twins cannot silently rot; tools/suite_report.py --check
+runs the same pins outside pytest.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+sys.path.insert(0, os.path.join(_REPO, "tools"))
+
+import suite_costs as sc  # noqa: E402
+
+
+# --------------------------------------------------- the fingerprint keys
+
+
+def test_fingerprint_pins_fresh():
+    """All four budget-family pins (BLS kernel census, BLS profile
+    cache, sha256/merkle hash budgets, limb-bounds certificate) match
+    the live sources — the demoted differential suites' budgets are
+    not stale. Static file hashing, no jax."""
+    problems = sc.check_fingerprint_pins()
+    assert not problems, "\n".join(problems)
+
+
+def test_pin_drift_detected_fixture():
+    """Soundness of the twin key: a drifted pin MUST flag (and name
+    the refresh command), a fresh one must not."""
+    pins = {
+        "sha256": {
+            "budget_file": "tests/budgets/hash_costs.json",
+            "pinned": "0" * 16,
+            "live": "1b158c436c33e224",
+            "refresh": "python tools/hash_report.py --update-budgets",
+        },
+        "fresh": {
+            "budget_file": "x.json", "pinned": "abc", "live": "abc",
+            "refresh": "-",
+        },
+    }
+    problems = sc.check_fingerprint_pins(pins)
+    assert len(problems) == 1
+    assert "hash_costs.json" in problems[0]
+    assert "--update-budgets" in problems[0]
+    assert sc.check_fingerprint_pins(
+        {"fresh": pins["fresh"]}
+    ) == []
+
+
+def test_static_pins_equal_runtime_fingerprints():
+    """The static mirrors the twins key on equal the runtime
+    implementations the demoted suites key on (the graft_lint pinning
+    contract, re-asserted at the twin seam: if these diverge the twin
+    would watch the wrong hash)."""
+    import graft_lint
+
+    from lighthouse_tpu.ops.lane import sha256
+
+    assert graft_lint.sha256_fingerprint() == sha256.source_fingerprint()
+
+
+# ------------------------------------------- representative cases, fixed
+
+
+def test_sha256_lane_twin_fixed_case():
+    """Twin of the demoted randomized sha256-lane differentials: the
+    numpy compression backend vs the hashlib oracle on one fixed
+    batch, and the jit backend still selected under CPU-JAX (a silent
+    numpy fallback is exactly the failure the demoted suite would
+    catch at breadth)."""
+    from lighthouse_tpu.ops.lane import sha256
+
+    rng = np.random.default_rng(1601)  # fixed seed, fixed shape
+    left = rng.integers(0, 1 << 32, (8, 5), dtype=np.uint32)
+    right = rng.integers(0, 1 << 32, (8, 5), dtype=np.uint32)
+    got = sha256._numpy_pairs(left, right)
+    want = sha256.oracle_pairs(left, right)
+    assert np.array_equal(got, want)
+    if os.environ.get("LIGHTHOUSE_SHA256_JAX", "") != "0":
+        assert sha256.active_backend() == "jax"
+
+
+def test_bls_lane_twin_fixed_case():
+    """Twin of the demoted crypto-kernel differentials (test_fp /
+    test_lane / ladders / pairing): one lane Fp multiplication at the
+    canonical limb maximum vs the python-int oracle — the cheapest op
+    that still traverses the real mul + norm pipeline the certified
+    trim rewrote."""
+    import jax.numpy as jnp
+
+    from lighthouse_tpu.crypto.bls.params import P
+    from lighthouse_tpu.ops import fp as bfp
+    from lighthouse_tpu.ops.lane import fp as lfp
+
+    x = np.full((lfp.W, 2), bfp.MASK, np.int32)
+    val = sum(int(v) << (bfp.B * i) for i, v in enumerate(x[:, 0]))
+    got = np.asarray(lfp.mul(jnp.asarray(x), jnp.asarray(x)))
+    want = val * val % P
+    for s in range(2):
+        lane_val = sum(
+            int(v) << (bfp.B * i) for i, v in enumerate(got[:, s])
+        )
+        assert lane_val % P == want
+
+
+def test_limb_bounds_twin_fixed_case():
+    """Twin of the demoted limb-bounds adversarial sets: the ripple
+    carry at the certified subtract-ladder window bound (exact value
+    decomposition at v = p*2^7 - 1), plus the checked-in certificate
+    being fingerprint-fresh is already covered by the pin test above."""
+    import jax.numpy as jnp
+
+    from lighthouse_tpu.crypto.bls.params import P
+    from lighthouse_tpu.ops import fp as bfp
+    from lighthouse_tpu.ops.lane import fp as lfp
+
+    v = (P << 7) - 1
+    raw = bfp._limbs_raw(v, 37).astype(np.int32)[:, None]
+    out, carry = lfp._ripple_carry(jnp.asarray(raw))
+    out = np.asarray(out)
+    assert int(np.asarray(carry)[0]) == 0
+    assert sum(
+        int(x) << (bfp.B * i) for i, x in enumerate(out[:, 0])
+    ) == v
+    assert out.min() >= 0 and out.max() <= bfp.MASK
+
+
+def test_kernel_costs_twin_budget_structure():
+    """Twin of the demoted full kernel-cost census: the checked-in
+    budgets are structurally live (every AOT bucket priced, positive
+    exact counts) — with the pin test guaranteeing they describe the
+    CURRENT sources. The 15 s census re-derivation runs in the slow
+    tier."""
+    import json
+
+    with open(os.path.join(_REPO, "tests", "budgets",
+                           "kernel_costs.json")) as f:
+        budgets = json.load(f)
+    buckets = budgets.get("buckets") or {}
+    assert {"128", "1024", "4096"} <= set(buckets)
+    for name, e in buckets.items():
+        assert e.get("fp_muls_per_set", 0) > 0, name
+        assert e.get("elem_ops", 0) > 0, name
+        assert e.get("roofline_est_sets_per_s", 0) > 0, name
+
+
+def test_export_replay_twin_artifacts_not_stale():
+    """Twin of the demoted export-replay jit paths — reuses the PR 11
+    bls_export_artifact_info staleness seam (ISSUE 16 satellite): a
+    chipless fast tier still catches a stale .graft_export bucket in
+    under a second, naming the re-seed command."""
+    from lighthouse_tpu.common import metrics
+    from lighthouse_tpu.crypto.bls.backends import (
+        device_metrics as dm,
+        export_store,
+    )
+
+    inventory = export_store.artifact_inventory()
+    dm.record_artifact_inventory(inventory)
+    gauge = metrics.get("bls_export_artifact_info")
+    stale = sorted(
+        lv[0]
+        for lv in gauge.label_values()
+        if lv[1] == "stale_hash" and gauge.labels(*lv).value > 0.0
+    )
+    assert not stale, (
+        f"stale .graft_export artifacts for bucket(s) {stale} — the "
+        f"kernel source fingerprint changed since export; re-seed via "
+        f"tools/tunnel_watch.sh (chip window) or "
+        f"`python tools/seed_cache.py --exports-only` (CPU replay)"
+    )

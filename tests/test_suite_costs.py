@@ -1,0 +1,636 @@
+"""Suite cost observatory gates (ISSUE 16).
+
+Layers under test:
+  1. tools/suite_costs.py check primitives — budget overrun / stale /
+     unpriced / deleted-module detection, env-skip exemption, the
+     fast-tier fit gate, marker registration, truncation — all
+     fixture-driven (the kernel_costs recipe).
+  2. The ordering hook: deterministic cheap-first order from the
+     pinned budgets (pure key + a subprocess proof on a synthetic
+     suite: two collections order identically, cheapest first, the
+     self-gate module last).
+  3. The truncation flush: SIGTERM mid-run -> a valid partial census
+     with `truncated_at` naming the test the budget died in (the
+     rc-124 postmortem artifact).
+  4. skipped_env accounting: a module-level importorskip of a missing
+     module lands in the census as skipped_env instead of silently
+     vanishing (budgets stay comparable across boxes).
+  5. The LIVE tier-1 gates: the pinned fast-tier prediction fits the
+     600 s budget, every budgeted module exists, the demotion is
+     effective under `-m 'not slow'`, and (ordered last in the
+     session) the measured census of THIS run sits within the pinned
+     per-module budgets.
+  6. tools/bench_gate.py — a round-over-round fast-tier wall increase
+     fails like an op-count increase (fixture-driven, via the
+     perf_ledger detail.suite projection).
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+sys.path.insert(0, os.path.join(_REPO, "tools"))
+
+import suite_costs as sc  # noqa: E402
+import suite_report  # noqa: E402
+
+from lighthouse_tpu.tools import perf_ledger as L  # noqa: E402
+
+
+# ------------------------------------------------- check primitives
+
+
+def _census(modules, truncated_at=None, args=("tests/",),
+            markers="not slow"):
+    return {
+        "schema": sc.SCHEMA,
+        "pytest_args": list(args),
+        "markers_expr": markers,
+        "collection_s": 10.0,
+        "wall_s": sum(m.get("wall_s", 0.0) for m in modules.values()),
+        "truncated_at": truncated_at,
+        "exit": "truncated" if truncated_at else "ok",
+        "modules": modules,
+    }
+
+
+def _budgets(modules, **kw):
+    doc = {
+        "schema": sc.BUDGET_SCHEMA,
+        "fast_tier_timeout_s": 870,
+        "fast_tier_budget_s": 600,
+        "overrun_ratio": 0.4,
+        "stale_ratio": 0.2,
+        "overrun_floor_s": 3.0,
+        "stale_floor_s": 5.0,
+        "collection_s": 10.0,
+        "modules": modules,
+    }
+    doc.update(kw)
+    return doc
+
+
+def test_budget_overrun_detected():
+    budgets = _budgets({"test_x.py": {"wall_s": 10.0}})
+    census = _census({"test_x.py": {"wall_s": 10.5, "tests": 3}})
+    assert sc.check_budgets(census, budgets) == []  # inside ratio
+    census = _census({"test_x.py": {"wall_s": 20.0, "tests": 3}})
+    problems = sc.check_budgets(census, budgets)
+    assert problems and "exceeds budget" in problems[0]
+    assert "--update-budgets" in problems[0]
+    # within the absolute floor: a tiny module can't flap the gate
+    budgets = _budgets({"test_y.py": {"wall_s": 0.2}})
+    census = _census({"test_y.py": {"wall_s": 1.1, "tests": 1}})
+    assert sc.check_budgets(census, budgets) == []
+
+
+def test_stale_budget_detected():
+    budgets = _budgets({"test_x.py": {"wall_s": 60.0}})
+    census = _census({"test_x.py": {"wall_s": 20.0, "tests": 3}})
+    problems = sc.check_budgets(census, budgets)
+    assert problems and "stale budget" in problems[0]
+    # >stale_ratio under but inside the absolute floor: no flap
+    budgets = _budgets({"test_y.py": {"wall_s": 6.0}})
+    census = _census({"test_y.py": {"wall_s": 2.0, "tests": 1}})
+    assert sc.check_budgets(census, budgets) == []
+
+
+def test_unpriced_module_detected():
+    budgets = _budgets({})
+    census = _census({"test_new.py": {"wall_s": 1.0, "tests": 2}})
+    problems = sc.check_budgets(census, budgets)
+    assert problems and "not in the suite budgets" in problems[0]
+
+
+def test_deleted_module_detected_only_on_complete_census():
+    budgets = _budgets({"test_gone.py": {"wall_s": 5.0}})
+    census = _census({})
+    assert sc.check_budgets(census, budgets) == []  # subset run: fine
+    problems = sc.check_budgets(census, budgets, require_complete=True)
+    assert problems and "absent from the census" in problems[0]
+
+
+def test_env_skipped_module_exempt_from_wall_comparison():
+    """The cryptography-less box: the module is PRESENT in the census
+    as skipped_env (the satellite contract), pinned wall_s null, and
+    neither overrun nor stale fires."""
+    budgets = _budgets({
+        "test_keystore.py": {"wall_s": None, "skipped_env": True},
+    })
+    census = _census({
+        "test_keystore.py": {"wall_s": 0.01, "tests": 0,
+                             "skipped_env": 1},
+    })
+    assert sc.check_budgets(census, budgets) == []
+    # a box WITH the module measures real wall against a null pin:
+    # still exempt (the pin says "box-dependent")
+    census = _census({
+        "test_keystore.py": {"wall_s": 12.0, "tests": 40,
+                             "skipped_env": 0},
+    })
+    assert sc.check_budgets(census, budgets) == []
+
+
+def test_fast_tier_fit_gate():
+    budgets = _budgets({"test_a.py": {"wall_s": 400.0},
+                        "test_b.py": {"wall_s": 100.0}})
+    assert sc.predicted_fast_tier_s(budgets) == pytest.approx(510.0)
+    assert sc.check_fast_tier(budgets) == []
+    budgets["modules"]["test_c.py"] = {"wall_s": 200.0}
+    problems = sc.check_fast_tier(budgets)
+    assert problems and "exceeds" in problems[0]
+    assert "demote" in problems[0]
+    # env-skipped (null) entries contribute zero
+    budgets = _budgets({"test_a.py": {"wall_s": None,
+                                      "skipped_env": True}})
+    assert sc.predicted_fast_tier_s(budgets) == pytest.approx(10.0)
+
+
+def test_truncation_check():
+    census = _census({}, truncated_at="tests/test_fp.py::test_mul")
+    problems = sc.check_truncation(census)
+    assert problems and "test_fp.py::test_mul" in problems[0]
+    assert sc.check_truncation(_census({})) == []
+
+
+def test_marker_registration_check(tmp_path):
+    ini = tmp_path / "pytest.ini"
+    ini.write_text("[pytest]\nmarkers =\n    slow: x\n")
+    census = _census({
+        "test_a.py": {"wall_s": 1.0, "markers": ["slow", "parametrize"]},
+        "test_b.py": {"wall_s": 1.0, "markers": ["mystery_tier"]},
+    })
+    problems = sc.check_markers(census, str(ini))
+    assert len(problems) == 1
+    assert "mystery_tier" in problems[0]
+    assert "test_b.py" in problems[0]
+
+
+def test_real_pytest_ini_registers_tier_markers():
+    registered = sc.registered_markers()
+    assert {"crypto_heavy", "slow"} <= registered
+
+
+# ---------------------------------------------------------- ordering
+
+
+def test_order_key_cheap_first_property():
+    budgets = _budgets({
+        "test_cheap.py": {"wall_s": 0.5},
+        "test_mid.py": {"wall_s": 30.0},
+        "test_dear.py": {"wall_s": 120.0},
+    })
+    keys = [sc.order_key(m, budgets) for m in
+            ("test_cheap.py", "test_mid.py", "test_dear.py")]
+    assert keys == sorted(keys)
+    # unpriced modules slot at the UNKNOWN default, after the known-
+    # cheap but before the known-expensive
+    unk = sc.order_key("test_new.py", budgets)
+    assert sc.order_key("test_cheap.py", budgets) < unk < sc.order_key(
+        "test_mid.py", budgets)
+    # the self-gate module is always last, whatever the budgets say
+    budgets["modules"][sc.SELF_GATE_MODULE] = {"wall_s": 0.0}
+    assert sc.order_key(sc.SELF_GATE_MODULE, budgets) > sc.order_key(
+        "test_dear.py", budgets)
+    # no budgets at all: still deterministic (name-ordered)
+    assert sc.order_key("test_a.py", None) < sc.order_key(
+        "test_b.py", None)
+
+
+class _FakeItem:
+    def __init__(self, nodeid):
+        self.nodeid = nodeid
+
+
+def test_order_items_stable_and_module_order_preserved():
+    budgets = _budgets({
+        "test_a.py": {"wall_s": 50.0},
+        "test_b.py": {"wall_s": 1.0},
+    })
+    items = [_FakeItem(n) for n in (
+        "tests/test_a.py::test_1", "tests/test_a.py::test_2",
+        "tests/test_b.py::test_9", "tests/test_b.py::test_1",
+    )]
+    out = sc.order_items(items, budgets)
+    got = [it.nodeid for it in out]
+    # cheap module first; WITHIN a module, collection order intact
+    # (test_9 stays before test_1 — no alphabetical reshuffle)
+    assert got == [
+        "tests/test_b.py::test_9", "tests/test_b.py::test_1",
+        "tests/test_a.py::test_1", "tests/test_a.py::test_2",
+    ]
+    # deterministic: same input, same output, every time
+    assert [it.nodeid for it in sc.order_items(items, budgets)] == got
+
+
+# ------------------------------------------- subprocess proofs (mini suite)
+
+
+_MINI_CONFTEST = textwrap.dedent("""
+    import json, os, sys
+    sys.path.insert(0, os.environ["LH_SC_TOOLS"])
+    import suite_costs
+    PLUGIN = suite_costs.install()
+    try:
+        with open(os.environ.get("LH_SC_BUDGETS", "")) as f:
+            BUDGETS = json.load(f)
+    except OSError:
+        BUDGETS = None
+
+    def pytest_configure(config):
+        PLUGIN.on_configure(config)
+
+    def pytest_collection_modifyitems(config, items):
+        items[:] = suite_costs.order_items(items, BUDGETS)
+
+    def pytest_collection_finish(session):
+        PLUGIN.on_collection_finish(session)
+
+    def pytest_collectreport(report):
+        PLUGIN.on_collectreport(report)
+
+    def pytest_runtest_logstart(nodeid, location):
+        PLUGIN.on_logstart(nodeid)
+
+    def pytest_runtest_logreport(report):
+        PLUGIN.on_logreport(report)
+
+    def pytest_runtest_logfinish(nodeid, location):
+        PLUGIN.on_logfinish(nodeid)
+
+    def pytest_sessionfinish(session, exitstatus):
+        PLUGIN.on_sessionfinish()
+""")
+
+
+def _mini_suite(tmp_path, files, budgets=None):
+    suite = tmp_path / "minisuite"
+    suite.mkdir()
+    (suite / "conftest.py").write_text(_MINI_CONFTEST)
+    for name, body in files.items():
+        (suite / name).write_text(textwrap.dedent(body))
+    env = dict(os.environ)
+    env["LH_SC_TOOLS"] = os.path.join(_REPO, "tools")
+    env["LH_SUITE_CENSUS_OUT"] = str(tmp_path / "census.json")
+    if budgets is not None:
+        bp = tmp_path / "budgets.json"
+        bp.write_text(json.dumps(budgets))
+        env["LH_SC_BUDGETS"] = str(bp)
+    return suite, env
+
+
+def _run_pytest(suite, env, *extra, check=True, timeout=120):
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", str(suite), "-q",
+         "-p", "no:cacheprovider", "-p", "no:randomly", *extra],
+        env=env, capture_output=True, text=True, timeout=timeout,
+    )
+    if check:
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+    return proc
+
+
+def test_ordering_deterministic_and_cheap_first_subprocess(tmp_path):
+    """The real hook, run twice through a real pytest: identical
+    order both times, cheapest-budgeted module first, unpriced in the
+    middle, test_suite_costs.py (the self-gate) last."""
+    files = {
+        "test_aa_dear.py": "def test_d(): pass\n",
+        "test_mm_new.py": "def test_n(): pass\n",
+        "test_zz_cheap.py": "def test_c(): pass\n",
+        "test_suite_costs.py": "def test_gate(): pass\n",
+    }
+    budgets = _budgets({
+        "test_aa_dear.py": {"wall_s": 50.0},
+        "test_zz_cheap.py": {"wall_s": 0.1},
+    })
+    suite, env = _mini_suite(tmp_path, files, budgets)
+    orders = []
+    for _ in range(2):
+        proc = _run_pytest(suite, env, "--collect-only")
+        orders.append([
+            line.strip() for line in proc.stdout.splitlines()
+            if "::" in line
+        ])
+    assert orders[0] == orders[1], "ordering is not run-stable"
+    mods = [n.split("::")[0].split("/")[-1] for n in orders[0]]
+    assert mods == [
+        "test_zz_cheap.py",   # pinned 0.1 s
+        "test_mm_new.py",     # unpriced -> UNKNOWN_MODULE_COST_S
+        "test_aa_dear.py",    # pinned 50 s
+        "test_suite_costs.py",  # self-gate pinned last
+    ]
+
+
+def test_census_written_with_phase_split_subprocess(tmp_path):
+    files = {
+        "test_timed.py": """
+            import time
+            import pytest
+
+            @pytest.fixture
+            def slow_setup():
+                time.sleep(0.15)
+                yield None
+
+            def test_sleeps(slow_setup):
+                time.sleep(0.25)
+
+            @pytest.mark.skipif(True, reason="always")
+            def test_skipped():
+                pass
+        """,
+    }
+    suite, env = _mini_suite(tmp_path, files)
+    _run_pytest(suite, env)
+    census = json.load(open(env["LH_SUITE_CENSUS_OUT"]))
+    assert census["schema"] == sc.SCHEMA
+    assert census["truncated_at"] is None
+    assert census["collection_s"] is not None
+    mod = census["modules"]["test_timed.py"]
+    assert mod["tests"] == 2
+    assert mod["outcomes"]["passed"] == 1
+    assert mod["outcomes"]["skipped"] == 1
+    assert mod["skipped_env"] == 0  # a skipif is NOT an env skip
+    assert mod["call_s"] >= 0.25
+    assert mod["setup_s"] >= 0.15
+    assert mod["wall_s"] >= mod["call_s"] + mod["setup_s"]
+    assert mod["slowest"][0][0] == "test_sleeps"
+
+
+def test_importorskip_counted_as_skipped_env_subprocess(tmp_path):
+    """ISSUE 16 satellite (bugfix): a module-level importorskip of a
+    missing dependency must land in the census as skipped_env — not
+    silently vanish — so budgets compare across boxes with and
+    without the optional module."""
+    files = {
+        "test_needs_missing_dep.py": """
+            import pytest
+            pytest.importorskip("lighthouse_tpu_no_such_module_xyz")
+
+            def test_never_runs():
+                raise AssertionError
+        """,
+        "test_plain.py": "def test_p(): pass\n",
+    }
+    suite, env = _mini_suite(tmp_path, files)
+    _run_pytest(suite, env)
+    census = json.load(open(env["LH_SUITE_CENSUS_OUT"]))
+    mod = census["modules"]["test_needs_missing_dep.py"]
+    assert mod["skipped_env"] >= 1
+    assert "could not import" in mod.get("collect_skip_reason", "")
+    assert census["modules"]["test_plain.py"]["tests"] == 1
+
+
+def test_sigterm_flushes_partial_census_with_truncated_at(tmp_path):
+    """The rc-124 postmortem contract: SIGTERM mid-test -> a VALID
+    partial census naming the in-flight test in truncated_at, with the
+    already-finished modules' timings present."""
+    files = {
+        "test_a_quick.py": "def test_q(): pass\n",
+        "test_z_hang.py": """
+            import os, time
+
+            def test_hangs():
+                open(os.environ["LH_SC_READY"], "w").write("up")
+                time.sleep(60)
+        """,
+    }
+    suite, env = _mini_suite(tmp_path, files)
+    ready = tmp_path / "ready"
+    env["LH_SC_READY"] = str(ready)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "pytest", str(suite), "-q",
+         "-p", "no:cacheprovider", "-p", "no:randomly"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+    )
+    try:
+        deadline = time.monotonic() + 60
+        while not ready.exists():
+            assert time.monotonic() < deadline, "hang test never started"
+            assert proc.poll() is None, proc.stdout.read().decode()
+            time.sleep(0.05)
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+    census = json.load(open(env["LH_SUITE_CENSUS_OUT"]))
+    assert census["exit"] == "truncated"
+    assert census["truncated_at"].endswith(
+        "test_z_hang.py::test_hangs"
+    )
+    quick = census["modules"]["test_a_quick.py"]
+    assert quick["outcomes"]["passed"] == 1
+    # the in-flight module is present too (its setup already ran)
+    assert "test_z_hang.py" in census["modules"]
+
+
+# ------------------------------------------------ the LIVE tier-1 gates
+
+
+def _real_budgets():
+    try:
+        return sc.load_budgets()
+    except OSError:
+        pytest.fail(
+            "tests/budgets/suite_costs.json missing — price the suite: "
+            "run the fast tier, then tools/suite_report.py "
+            "--update-budgets"
+        )
+
+
+def test_pinned_prediction_fits_fast_tier():
+    """THE acceptance gate: the census-predicted fast-tier total must
+    fit 600 s (~70% of the 870 s driver timeout) so tier-1 is a real
+    oracle again, not a box-speed measurement."""
+    budgets = _real_budgets()
+    assert float(budgets["fast_tier_budget_s"]) <= 0.7 * float(
+        budgets["fast_tier_timeout_s"]) + 1e-9
+    problems = sc.check_fast_tier(budgets)
+    assert not problems, "\n".join(problems)
+
+
+def test_budgeted_modules_exist_on_disk():
+    problems = sc.check_budget_files_exist(_real_budgets())
+    assert not problems, "\n".join(problems)
+
+
+def test_demotion_effective_under_tier1_filter(request):
+    """ISSUE 16 satellite: under the EXISTING tier-1 command
+    (-m 'not slow'), no crypto_heavy item survives collection — the
+    conftest stacks `slow` onto the crypto-heavy modules, so the
+    demotion needs no command change."""
+    expr = request.config.getoption("markexpr") or ""
+    if "not slow" not in expr:
+        pytest.skip("not running under the tier-1 filter")
+    heavy = [
+        item.nodeid
+        for item in request.session.items
+        if item.get_closest_marker("crypto_heavy") is not None
+    ]
+    assert not heavy, (
+        f"crypto_heavy items in the fast tier: {heavy[:5]} — the "
+        f"demotion must stack `slow` on every crypto_heavy module"
+    )
+
+
+def test_self_gate_session_census_within_budgets(request):
+    """Ordered LAST in the session (order_key pins this module to the
+    end): the measured census of THIS tier-1 run must sit within the
+    pinned per-module budgets — the suite gates its own cost, the way
+    the kernels gate theirs. Only enforced for the tier-1 shape (full
+    tests/ run under -m 'not slow'); subset/dev invocations measure
+    but don't judge."""
+    if sc.ACTIVE is None:
+        pytest.skip("census plugin not active")
+    expr = request.config.getoption("markexpr") or ""
+    args = " ".join(str(a) for a in request.config.invocation_params.args)
+    if "not slow" not in expr or "tests" not in args:
+        pytest.skip("not a full fast-tier run")
+    census = sc.ACTIVE.census()
+    # this module is still mid-flight — its wall is incomplete
+    census["modules"].pop(sc.SELF_GATE_MODULE, None)
+    budgets = _real_budgets()
+    problems = sc.check_budgets(census, budgets)
+    problems += sc.check_markers(census)
+    assert not problems, "\n".join(problems)
+
+
+def test_census_flush_schema():
+    """A mid-session flush writes a schema-valid census containing
+    this module (the sessionfinish path uses the same writer; the
+    SIGTERM path is subprocess-proven above)."""
+    if sc.ACTIVE is None:
+        pytest.skip("census plugin not active")
+    doc = sc.ACTIVE.flush()
+    assert doc["schema"] == sc.SCHEMA
+    assert os.path.exists(sc.ACTIVE.out_path)
+    on_disk = json.load(open(sc.ACTIVE.out_path))
+    assert on_disk["schema"] == sc.SCHEMA
+    assert sc.SELF_GATE_MODULE in on_disk["modules"]
+
+
+def test_suite_report_check_single_entry(tmp_path):
+    """tools/suite_report.py check(): one problem list folding every
+    sub-check (the graft_lint --all pattern) — clean on a healthy
+    census+budgets pair, and each failure class surfaces."""
+    budgets = _budgets({"test_a.py": {"wall_s": 5.0}})
+    census = _census({"test_a.py": {"wall_s": 5.0, "tests": 2,
+                                    "markers": ["slow"]}},
+                     args=("tests/",))
+    # a doctored pytest.ini is not injectable here; rely on the real
+    # one (slow IS registered), and on-disk existence via tests dir
+    budgets_ok = dict(budgets)
+    budgets_ok["modules"] = {"test_ssz.py": {"wall_s": 5.0}}
+    census_ok = _census({"test_ssz.py": {"wall_s": 5.0, "tests": 2,
+                                         "markers": ["slow"]}})
+    problems = suite_report.check(census_ok, budgets_ok)
+    assert problems == []
+    # missing budgets file
+    assert "missing" in suite_report.check(census_ok, None)[0]
+    # truncated census fails
+    trunc = dict(census_ok)
+    trunc["truncated_at"] = "tests/test_x.py::test_y"
+    assert any("TRUNCATED" in p
+               for p in suite_report.check(trunc, budgets_ok))
+    # prediction overrun fails through the same entry point
+    over = dict(budgets_ok)
+    over["modules"] = {"test_ssz.py": {"wall_s": 700.0}}
+    assert any("exceeds" in p for p in suite_report.check(census_ok, over))
+
+
+def test_update_budgets_roundtrip(tmp_path, monkeypatch):
+    """--update-budgets pins measured walls (with headroom), nulls
+    env-skipped modules, and the result passes its own checks."""
+    census = _census({
+        "test_a.py": {"wall_s": 10.0, "tests": 4, "markers": [],
+                      "skipped_env": 0},
+        "test_keystore.py": {"wall_s": 0.0, "tests": 0, "markers": [],
+                             "skipped_env": 1},
+    })
+    out = tmp_path / "suite_costs.json"
+    monkeypatch.setattr(sc, "budgets_path", lambda: str(out))
+    budgets = suite_report.update_budgets(census)
+    assert budgets["modules"]["test_a.py"]["wall_s"] == pytest.approx(
+        10.55)
+    assert budgets["modules"]["test_keystore.py"]["wall_s"] is None
+    assert budgets["modules"]["test_keystore.py"]["skipped_env"] is True
+    assert json.load(open(out))["schema"] == sc.BUDGET_SCHEMA
+    assert sc.check_budgets(census, budgets) == []
+    assert sc.check_fast_tier(budgets) == []
+
+
+# ------------------------------------------------ bench gate ratchet
+
+
+def _bench_doc(pred=540.0, wall=520.0, truncated=0):
+    return {
+        "value": 0.0,
+        "detail": {
+            "replay": {"bucket": 128, "sets_per_s": 11.5,
+                       "checked": True},
+            "suite": {
+                "fast_tier_pred_s": pred,
+                "fast_tier_wall_s": wall,
+                "truncated": truncated,
+            },
+        },
+    }
+
+
+def test_ledger_row_suite_projection():
+    row = L.row_from_bench(_bench_doc(), source="t")
+    assert row["suite"] == {
+        "fast_tier_pred_s": 540.0,
+        "fast_tier_wall_s": 520.0,
+        "truncated": 0,
+    }
+
+
+def test_bench_gate_fast_tier_ratchet_fixture(tmp_path):
+    """ISSUE 16: a round-over-round fast-tier wall regression fails
+    the bench gate (ratio + absolute floor, like epoch seconds), and
+    a truncated round fails EXACTLY (count semantics — one truncation
+    is one too many)."""
+    import bench_gate
+
+    path = str(tmp_path / "PERF.jsonl")
+    L.append(L.row_from_bench(_bench_doc(), source="r1"), path)
+    # jitter inside ratio+floor: passes
+    ok = L.row_from_bench(_bench_doc(pred=560.0, wall=555.0),
+                          source="r2")
+    L.append(ok, path)
+    assert bench_gate.gate(path) == []
+    # prediction blowing past tolerance AND floor fails
+    worse = L.row_from_bench(_bench_doc(pred=840.0), source="r3")
+    L.append(worse, path)
+    problems = bench_gate.gate(path)
+    assert problems and any(
+        "fast-tier predicted wall" in p for p in problems)
+    # measured wall decay flags on its own field
+    L.append(L.row_from_bench(_bench_doc(pred=840.0), source="r4"), path)
+    worse2 = L.row_from_bench(_bench_doc(pred=840.0, wall=850.0),
+                              source="r5")
+    L.append(worse2, path)
+    problems = bench_gate.gate(path)
+    assert problems and any(
+        "fast-tier measured wall" in p for p in problems)
+    # a truncated round fails exactly
+    L.append(L.row_from_bench(_bench_doc(pred=840.0, wall=850.0),
+                              source="r6"), path)
+    trunc = L.row_from_bench(
+        _bench_doc(pred=840.0, wall=850.0, truncated=1), source="r7")
+    L.append(trunc, path)
+    problems = bench_gate.gate(path)
+    assert problems and any("truncat" in p for p in problems)

@@ -1,0 +1,592 @@
+"""Suite cost observatory (ISSUE 16 tentpole): census + budgets for the
+verification pipeline itself.
+
+The tier-1 gate was broken as an oracle: PR 15 measured the fast tier
+overrunning its 870 s timeout on a 1-core box even at BASE (rc 124,
+dead ~45% through in alphabetical order), so PRs were judged by the
+DOTS_PASSED workaround instead of a real pass/fail. This module applies
+the kernel_costs/hash_costs recipe to the suite: a pytest plugin
+(wired in tests/conftest.py) records per-test and per-module wall time,
+collection time, the setup/call/teardown split and marker class into a
+schema-checked census; tests/budgets/suite_costs.json pins per-module
+budgets and the fast-tier total; tools/suite_report.py renders/checks;
+tests/test_suite_costs.py gates in tier-1.
+
+Layers:
+  * SuiteCostPlugin — pytest hooks collect timings; a SIGTERM handler
+    flushes a PARTIAL census with `truncated_at` naming the test the
+    timeout died in (an rc-124 run still says exactly where the budget
+    went, instead of a bare timeout).
+  * order_key() — deterministic cheap-first ordering from the pinned
+    budgets (stable across runs under -p no:randomly: the key is pure
+    in (module, budgets); within-module collection order is preserved).
+    tests/test_suite_costs.py is forced LAST so its self-gate sees the
+    whole session's measured census.
+  * check_budgets()/check_fast_tier()/check_markers()/
+    check_fingerprint_pins() — the gate primitives, fixture-tested and
+    shared between the tier-1 tests and `tools/suite_report.py --check`.
+
+Census schema "lighthouse-tpu/suite-costs/v1" (one JSON doc, written
+atomically to .suite_census.json at the repo root — gitignored, the
+artifact of the last pytest session on this box):
+
+  schema, recorded_at, pytest_args, markers_expr
+  collection_s      session start -> collection finished
+  wall_s            session start -> flush
+  truncated_at      null, or the nodeid running when SIGTERM landed
+  exit              "ok" | "truncated" | "running" — "running" is the
+                    periodic in-flight flush (every ~30 s at test
+                    boundaries); a census left in that state means the
+                    session died without even the SIGTERM flush
+                    (SIGKILL, or the signal landed inside a native XLA
+                    call that never returned) and `in_flight` names
+                    the last test that started
+  modules: { "test_x.py": {
+      wall_s, setup_s, call_s, teardown_s,
+      tests, outcomes: {passed, failed, skipped},
+      skipped_env,   # skips for MISSING ENVIRONMENT MODULES (module-
+                     # level importorskip => the whole file counts here
+                     # instead of silently vanishing from the census —
+                     # budgets stay comparable across boxes with and
+                     # without the optional deps)
+      markers: [...], slowest: [[test, wall_s], ...] } }
+
+Budget schema "lighthouse-tpu/suite-budgets/v1"
+(tests/budgets/suite_costs.json): per-module pinned wall_s (null for
+env-skipped modules), fast_tier_budget_s (the 600 s ≈ 70% of the 870 s
+driver timeout), collection_s, overrun/stale ratios + absolute floors
+(wall time is noisy where op counts are exact — the floors keep small
+modules from flapping), and the budget-file fingerprint pins the smoke
+twins key on (tests/test_smoke_twins.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import time
+
+SCHEMA = "lighthouse-tpu/suite-costs/v1"
+BUDGET_SCHEMA = "lighthouse-tpu/suite-budgets/v1"
+
+# builtin / pytest-owned marks that never need pytest.ini registration
+BUILTIN_MARKS = {
+    "parametrize", "skip", "skipif", "xfail", "usefixtures",
+    "filterwarnings", "tryfirst", "trylast", "no_cover",
+}
+
+# a module with no pinned budget sorts as if it cost this much (new
+# modules are typically small; the unpriced-module gate fails tier-1
+# anyway, naming `tools/suite_report.py --update-budgets`)
+UNKNOWN_MODULE_COST_S = 1.0
+
+# the self-gating module: ordered last so its in-session check sees
+# every other module's measured wall
+SELF_GATE_MODULE = "test_suite_costs.py"
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# the live plugin of the current pytest session (set by install();
+# tests/test_suite_costs.py's self-gate reads it, None outside pytest)
+ACTIVE = None
+
+
+def census_path() -> str:
+    return os.environ.get(
+        "LH_SUITE_CENSUS_OUT", os.path.join(_REPO, ".suite_census.json")
+    )
+
+
+def budgets_path() -> str:
+    return os.path.join(_REPO, "tests", "budgets", "suite_costs.json")
+
+
+def load_budgets(path: str | None = None) -> dict:
+    with open(path or budgets_path()) as f:
+        return json.load(f)
+
+
+def load_census(path: str | None = None) -> dict:
+    with open(path or census_path()) as f:
+        return json.load(f)
+
+
+def module_of(nodeid: str) -> str:
+    """tests/test_x.py::TestC::test_y[case] -> test_x.py"""
+    return os.path.basename(nodeid.split("::", 1)[0])
+
+
+# ------------------------------------------------------------- ordering
+
+
+def order_key(module: str, budgets: dict | None) -> tuple:
+    """Deterministic cheap-first sort key for a test module. Pure in
+    (module, budgets) — two collections of the same tree under the same
+    budget file order identically (the suite runs -p no:randomly, and
+    this key adds no other entropy source). Cheapest modules first, so
+    a timeout kills the EXPENSIVE tail and the truncation flush names
+    the culprit after the bulk of the suite already passed; unpriced
+    modules sort at UNKNOWN_MODULE_COST_S; the self-gate module is
+    pinned last."""
+    if module == SELF_GATE_MODULE:
+        return (1, 0.0, module)
+    entry = ((budgets or {}).get("modules") or {}).get(module)
+    wall = entry.get("wall_s") if isinstance(entry, dict) else None
+    cost = float(wall) if wall is not None else UNKNOWN_MODULE_COST_S
+    return (0, cost, module)
+
+
+def order_items(items: list, budgets: dict | None) -> list:
+    """Reorder pytest items cheap-first by module (stable: preserves
+    within-module collection order)."""
+    indexed = list(enumerate(items))
+    indexed.sort(
+        key=lambda pair: order_key(
+            module_of(getattr(pair[1], "nodeid", str(pair[1]))), budgets
+        ) + (pair[0],)
+    )
+    return [it for _, it in indexed]
+
+
+# ------------------------------------------------------------ the plugin
+
+
+def _is_env_skip(reason: str) -> bool:
+    """importorskip-style skips (missing optional module) — counted as
+    skipped_env so budgets stay comparable across boxes with and
+    without the dep."""
+    return "could not import" in (reason or "")
+
+
+class SuiteCostPlugin:
+    """Pytest plugin: per-test phase timings -> schema-checked census,
+    flushed at sessionfinish AND from a SIGTERM handler (the `timeout`
+    command's first signal) with `truncated_at` set."""
+
+    def __init__(self, out_path: str | None = None):
+        self.out_path = out_path or census_path()
+        self.t0 = time.monotonic()
+        self.collection_s = None
+        self.tests = {}  # nodeid -> {setup_s, call_s, teardown_s,
+        #                             outcome, env_skip}
+        self.markers = {}  # nodeid -> [marker names]
+        self.collect_skips = {}  # module -> {"env": bool, "reason": str}
+        self.current = None  # nodeid in flight (truncation attribution)
+        self.args = None
+        self.markers_expr = None
+        self.flushed_final = False
+        self._prev_term = None
+        self._last_flush = time.monotonic()
+
+    # -- wiring ------------------------------------------------------
+
+    def install_signal_handler(self):
+        """Arm the truncation flush. Chains to the previously-installed
+        SIGTERM disposition, then re-raises with the default handler so
+        the process still dies with the signal (the census write costs
+        milliseconds; `timeout -k 10` allows 10 s)."""
+
+        def _on_term(signum, frame):
+            try:
+                self.flush(truncated_at=self.current or "<between tests>")
+            finally:
+                signal.signal(signum, signal.SIG_DFL)
+                os.kill(os.getpid(), signum)
+
+        self._prev_term = signal.signal(signal.SIGTERM, _on_term)
+
+    # -- pytest hooks (called from tests/conftest.py) ----------------
+
+    def on_configure(self, config):
+        self.args = list(getattr(config, "invocation_params").args)
+        try:
+            self.markers_expr = config.getoption("markexpr") or ""
+        except Exception:
+            self.markers_expr = ""
+
+    def on_collection_finish(self, session):
+        self.collection_s = round(time.monotonic() - self.t0, 3)
+        for item in session.items:
+            self.markers[item.nodeid] = sorted(
+                {m.name for m in item.iter_markers()}
+            )
+
+    def on_collectreport(self, report):
+        # a module-level importorskip skips the whole FILE at
+        # collection: record it so the census never silently drops it
+        if not getattr(report, "skipped", False):
+            return
+        mod = module_of(getattr(report, "nodeid", "") or "")
+        if not mod.endswith(".py"):
+            return
+        reason = ""
+        lr = getattr(report, "longrepr", None)
+        if isinstance(lr, tuple) and len(lr) == 3:
+            reason = str(lr[2])
+        elif lr is not None:
+            reason = str(lr)
+        self.collect_skips[mod] = {
+            "env": _is_env_skip(reason),
+            "reason": reason[:200],
+        }
+
+    def on_logstart(self, nodeid):
+        self.current = nodeid
+        # periodic in-flight flush: a SIGKILL (timeout -k's second
+        # shot) or a SIGTERM swallowed inside a native XLA call can
+        # never lose more than ~30 s of census — the on-disk doc says
+        # exit "running" with `in_flight` naming this test
+        if time.monotonic() - self._last_flush > 30.0:
+            try:
+                self.flush(running=True)
+            except OSError:
+                pass
+
+    def on_logreport(self, report):
+        rec = self.tests.setdefault(
+            report.nodeid,
+            {"setup_s": 0.0, "call_s": 0.0, "teardown_s": 0.0,
+             "outcome": "passed", "env_skip": False},
+        )
+        rec[report.when + "_s"] = round(
+            rec[report.when + "_s"] + float(report.duration or 0.0), 4
+        )
+        if report.when == "call" or report.outcome != "passed":
+            if rec["outcome"] != "failed":  # failed is sticky
+                rec["outcome"] = report.outcome
+        if report.skipped:
+            lr = getattr(report, "longrepr", None)
+            reason = str(lr[2]) if isinstance(lr, tuple) and len(lr) == 3 \
+                else str(lr or "")
+            if _is_env_skip(reason):
+                rec["env_skip"] = True
+
+    def on_logfinish(self, nodeid):
+        self.current = None
+
+    def on_sessionfinish(self):
+        self.flushed_final = True
+        self.flush(truncated_at=None)
+
+    # -- census ------------------------------------------------------
+
+    def census(self, truncated_at: str | None = None) -> dict:
+        modules = {}
+        for nodeid, rec in self.tests.items():
+            mod = module_of(nodeid)
+            m = modules.setdefault(mod, {
+                "wall_s": 0.0, "setup_s": 0.0, "call_s": 0.0,
+                "teardown_s": 0.0, "tests": 0,
+                "outcomes": {"passed": 0, "failed": 0, "skipped": 0},
+                "skipped_env": 0, "markers": set(), "slowest": [],
+            })
+            wall = rec["setup_s"] + rec["call_s"] + rec["teardown_s"]
+            m["wall_s"] = round(m["wall_s"] + wall, 4)
+            for phase in ("setup_s", "call_s", "teardown_s"):
+                m[phase] = round(m[phase] + rec[phase], 4)
+            m["tests"] += 1
+            m["outcomes"][rec["outcome"]] = (
+                m["outcomes"].get(rec["outcome"], 0) + 1
+            )
+            if rec["env_skip"]:
+                m["skipped_env"] += 1
+            m["markers"].update(self.markers.get(nodeid, ()))
+            m["slowest"].append((nodeid.split("::", 1)[-1], round(wall, 4)))
+        for mod, skip in self.collect_skips.items():
+            m = modules.setdefault(mod, {
+                "wall_s": 0.0, "setup_s": 0.0, "call_s": 0.0,
+                "teardown_s": 0.0, "tests": 0,
+                "outcomes": {"passed": 0, "failed": 0, "skipped": 0},
+                "skipped_env": 0, "markers": set(), "slowest": [],
+            })
+            if skip["env"]:
+                m["skipped_env"] += 1
+            m["collect_skip_reason"] = skip["reason"]
+        for m in modules.values():
+            m["markers"] = sorted(m["markers"])
+            m["slowest"] = sorted(
+                m["slowest"], key=lambda kv: (-kv[1], kv[0])
+            )[:5]
+        return {
+            "schema": SCHEMA,
+            "recorded_at": time.strftime(
+                "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+            ),
+            "pytest_args": self.args,
+            "markers_expr": self.markers_expr,
+            "collection_s": self.collection_s,
+            "wall_s": round(time.monotonic() - self.t0, 3),
+            "truncated_at": truncated_at,
+            "exit": "truncated" if truncated_at else "ok",
+            "modules": modules,
+        }
+
+    def flush(self, truncated_at: str | None = None,
+              running: bool = False):
+        doc = self.census(truncated_at=truncated_at)
+        if running:
+            doc["exit"] = "running"
+            doc["in_flight"] = self.current
+        tmp = self.out_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+        os.replace(tmp, self.out_path)
+        self._last_flush = time.monotonic()
+        return doc
+
+
+def install(out_path: str | None = None) -> SuiteCostPlugin:
+    """Create + arm the session plugin (called once from conftest).
+
+    A NESTED pytest session (a test that subprocess-runs pytest, e.g.
+    test_sanitize.py's sanitizer acceptance run) must not clobber the
+    outer session's census: the outermost session exports
+    LH_SUITE_CENSUS_SESSION, and any child session that didn't get an
+    explicit LH_SUITE_CENSUS_OUT writes to <census>.nested instead."""
+    global ACTIVE
+    if (
+        out_path is None
+        and os.environ.get("LH_SUITE_CENSUS_SESSION")
+        and "LH_SUITE_CENSUS_OUT" not in os.environ
+    ):
+        out_path = census_path() + ".nested"
+    os.environ["LH_SUITE_CENSUS_SESSION"] = str(os.getpid())
+    ACTIVE = SuiteCostPlugin(out_path)
+    ACTIVE.install_signal_handler()
+    return ACTIVE
+
+
+# ------------------------------------------------------------- budgets
+
+
+def predicted_fast_tier_s(budgets: dict) -> float:
+    """Census-predicted fast-tier wall: pinned collection time + the
+    sum of every pinned module wall (env-skipped modules pin null and
+    contribute 0 — the census records them so the prediction's basis
+    is visible, not silently box-dependent)."""
+    total = float(budgets.get("collection_s") or 0.0)
+    for entry in (budgets.get("modules") or {}).values():
+        if isinstance(entry, dict) and entry.get("wall_s") is not None:
+            total += float(entry["wall_s"])
+    return round(total, 3)
+
+
+def check_fast_tier(budgets: dict) -> list:
+    """The tier-1 fit gate: the predicted fast-tier total must stay
+    within fast_tier_budget_s (~70% of the driver's 870 s timeout, so
+    box jitter + a cold .jax_cache can't push a correct tree into
+    rc 124)."""
+    cap = float(budgets.get("fast_tier_budget_s") or 0.0)
+    pred = predicted_fast_tier_s(budgets)
+    if cap and pred > cap:
+        return [
+            f"predicted fast-tier wall {pred:.0f}s exceeds the "
+            f"{cap:.0f}s budget (timeout "
+            f"{budgets.get('fast_tier_timeout_s')}s) — demote suites "
+            f"behind crypto_heavy/slow (with a smoke twin) or re-price: "
+            f"python tools/suite_report.py --update-budgets"
+        ]
+    return []
+
+
+def check_budgets(census: dict, budgets: dict | None = None,
+                  require_complete: bool = False) -> list:
+    """Measured census vs pinned budgets, the kernel_costs recipe with
+    wall-clock slack: exceeding a module budget past overrun_ratio AND
+    overrun_floor_s fails; sitting more than stale_ratio below it (past
+    stale_floor_s) is a stale-budget fail (a demotion/deletion forgot
+    `tools/suite_report.py --update-budgets`); a census module with no
+    budget entry is unpriced and fails. Env-skipped modules (census
+    skipped_env with ~no wall) are exempt from wall comparison — the
+    budget pins wall_s null for them, keeping the file comparable
+    across boxes with and without the optional deps.
+
+    require_complete additionally fails budget entries missing from the
+    census (only meaningful for a census of the FULL fast tier — the
+    in-session self-gate passes False because a subset run is not
+    evidence of deletion; it checks on-disk existence instead)."""
+    budgets = budgets or load_budgets()
+    problems = []
+    over_ratio = float(budgets.get("overrun_ratio", 0.4))
+    stale_ratio = float(budgets.get("stale_ratio", 0.2))
+    over_floor = float(budgets.get("overrun_floor_s", 3.0))
+    stale_floor = float(budgets.get("stale_floor_s", 5.0))
+    pinned = budgets.get("modules") or {}
+    measured = census.get("modules") or {}
+    for mod, got in sorted(measured.items()):
+        entry = pinned.get(mod)
+        if entry is None:
+            problems.append(
+                f"module {mod}: not in the suite budgets — every "
+                f"fast-tier module must be priced (python "
+                f"tools/suite_report.py --update-budgets)"
+            )
+            continue
+        env_skipped = (
+            got.get("skipped_env", 0) > 0 and not got.get("tests")
+        ) or (
+            got.get("skipped_env", 0) > 0
+            and got.get("skipped_env") == got.get("tests")
+        )
+        cap = entry.get("wall_s")
+        if cap is None or env_skipped:
+            continue  # env-dependent module: presence is the contract
+        wall = float(got.get("wall_s") or 0.0)
+        cap = float(cap)
+        if wall > cap * (1 + over_ratio) and wall - cap > over_floor:
+            problems.append(
+                f"module {mod}: measured {wall:.1f}s exceeds budget "
+                f"{cap:.1f}s (+{(wall / cap - 1) * 100:.0f}%) — a test "
+                f"got expensive; demote it behind crypto_heavy/slow "
+                f"with a smoke twin, or re-price deliberately "
+                f"(tools/suite_report.py --update-budgets)"
+            )
+        elif wall < cap * (1 - stale_ratio) and cap - wall > stale_floor:
+            problems.append(
+                f"module {mod}: measured {wall:.1f}s is "
+                f">{stale_ratio:.0%} below budget {cap:.1f}s — stale "
+                f"budget; refresh it so the fast-tier prediction stays "
+                f"honest (tools/suite_report.py --update-budgets)"
+            )
+    if require_complete:
+        for mod in sorted(pinned):
+            if mod not in measured:
+                problems.append(
+                    f"module {mod}: pinned in the suite budgets but "
+                    f"absent from the census — deleted or demoted "
+                    f"without tools/suite_report.py --update-budgets"
+                )
+    return problems
+
+
+def check_budget_files_exist(budgets: dict | None = None,
+                             tests_dir: str | None = None) -> list:
+    """Subset-run-proof staleness check: every budgeted module must
+    still exist on disk (the self-gate can't tell a deleted module from
+    a deselected one by census absence alone)."""
+    budgets = budgets or load_budgets()
+    tests_dir = tests_dir or os.path.join(_REPO, "tests")
+    return [
+        f"module {mod}: pinned in the suite budgets but "
+        f"tests/{mod} does not exist — stale entry "
+        f"(tools/suite_report.py --update-budgets)"
+        for mod in sorted(budgets.get("modules") or {})
+        if not os.path.exists(os.path.join(tests_dir, mod))
+    ]
+
+
+def registered_markers(pytest_ini: str | None = None) -> set:
+    """Marker names registered in pytest.ini's [pytest] markers list."""
+    path = pytest_ini or os.path.join(_REPO, "pytest.ini")
+    names, in_markers = set(), False
+    with open(path) as f:
+        for line in f:
+            stripped = line.strip()
+            if stripped.startswith("markers"):
+                in_markers = True
+                continue
+            if in_markers:
+                if line[:1] not in (" ", "\t") or not stripped:
+                    in_markers = False
+                    continue
+                names.add(stripped.split(":", 1)[0].strip())
+    return names
+
+
+def check_markers(census: dict, pytest_ini: str | None = None) -> list:
+    """Every marker class the census observed must be registered —
+    an unregistered marker silently escapes -m tier filtering."""
+    registered = registered_markers(pytest_ini)
+    problems = []
+    for mod, entry in sorted((census.get("modules") or {}).items()):
+        for mark in entry.get("markers", ()):
+            if mark not in registered and mark not in BUILTIN_MARKS:
+                problems.append(
+                    f"module {mod}: marker '{mark}' is not registered "
+                    f"in pytest.ini — register it or the tier filter "
+                    f"(-m 'not slow') can't see it"
+                )
+    return problems
+
+
+def check_truncation(census: dict) -> list:
+    if census.get("truncated_at"):
+        return [
+            f"census is TRUNCATED at {census['truncated_at']} "
+            f"(wall {census.get('wall_s')}s) — the run was killed "
+            f"mid-suite; the budget died there"
+        ]
+    if census.get("exit") == "running":
+        return [
+            f"census is a mid-run flush (killed without the SIGTERM "
+            f"flush — SIGKILL, or the signal landed in native code); "
+            f"in flight: {census.get('in_flight')} at wall "
+            f"{census.get('wall_s')}s"
+        ]
+    return []
+
+
+# --------------------------------------------------- fingerprint pins
+
+
+def fingerprint_pins() -> dict:
+    """The budget-file fingerprint pins the smoke twins key on: each
+    maps a demoted crypto-heavy suite to the budget file whose pin must
+    track the live kernel sources. Static recompute (graft_lint's
+    jax-free mirrors) vs the checked-in pin — a kernel edit without the
+    matching --update-budgets drifts the pin and the twin fails fast,
+    in the fast tier, in milliseconds."""
+    import sys
+
+    tools_dir = os.path.dirname(os.path.abspath(__file__))
+    if tools_dir not in sys.path:
+        sys.path.insert(0, tools_dir)
+    import graft_lint
+
+    def _load(name):
+        with open(os.path.join(_REPO, "tests", "budgets", name)) as f:
+            return json.load(f)
+
+    return {
+        "bls_kernel": {
+            "budget_file": "tests/budgets/kernel_costs.json",
+            "pinned": _load("kernel_costs.json").get("source_fingerprint"),
+            "live": graft_lint.kernel_fingerprint(),
+            "refresh": "python tools/kernel_report.py --update-budgets",
+        },
+        "bls_profiles": {
+            "budget_file": "tests/budgets/kernel_profiles.json",
+            "pinned": _load("kernel_profiles.json").get(
+                "source_fingerprint"),
+            "live": graft_lint.kernel_fingerprint(),
+            "refresh": "python tools/kernel_report.py --update-budgets",
+        },
+        "sha256": {
+            "budget_file": "tests/budgets/hash_costs.json",
+            "pinned": _load("hash_costs.json").get("kernel_fingerprint"),
+            "live": graft_lint.sha256_fingerprint(),
+            "refresh": "python tools/hash_report.py --update-budgets",
+        },
+        "limb_bounds": {
+            "budget_file": "tests/budgets/limb_bounds.json",
+            "pinned": _load("limb_bounds.json").get("source_fingerprint"),
+            "live": graft_lint.limb_bounds_fingerprint(),
+            "refresh": "python tools/limb_bounds.py --update",
+        },
+    }
+
+
+def check_fingerprint_pins(pins: dict | None = None) -> list:
+    """Drifted pins (live kernel sources vs the budget files the
+    demoted differential suites gate against). pins defaults to the
+    live fingerprint_pins(); tests feed doctored dicts."""
+    pins = pins if pins is not None else fingerprint_pins()
+    return [
+        f"{name}: {e['budget_file']} pins {e['pinned']} but the live "
+        f"sources fingerprint {e['live']} — the demoted differential "
+        f"suite would run against stale budgets; refresh in the same "
+        f"diff: {e['refresh']}"
+        for name, e in sorted(pins.items())
+        if e.get("pinned") != e.get("live")
+    ]

@@ -1,0 +1,233 @@
+#!/usr/bin/env python
+"""Suite cost report CLI (ISSUE 16): render the census of what the
+verification pipeline itself costs — per-module wall, the
+setup/call/teardown split, marker class, collection time — and gate it
+against the pinned budgets, the kernel_report/hash_report recipe
+applied to the suite.
+
+  python tools/suite_report.py                  # census + prediction
+  python tools/suite_report.py --json           # machine-readable
+  python tools/suite_report.py --check          # single CI entry point
+                                                # (graft_lint --all
+                                                # pattern): budget
+                                                # overruns, stale
+                                                # budgets, unpriced or
+                                                # deleted modules,
+                                                # unregistered markers,
+                                                # drifted smoke-twin
+                                                # fingerprint pins, a
+                                                # truncated census, or
+                                                # a fast-tier
+                                                # prediction over the
+                                                # 600 s budget -> exit 1
+  python tools/suite_report.py --update-budgets # deliberate suite
+                                                # change: re-pin
+                                                # budgets from the
+                                                # latest census in the
+                                                # same diff
+
+The census is the artifact of the last pytest session on this box
+(.suite_census.json, written by the tests/conftest.py plugin — including
+a SIGTERM-truncated partial one with `truncated_at`). Run the fast tier
+first if it is missing or stale:
+
+  JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+      -p no:cacheprovider -p no:xdist -p no:randomly
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import suite_costs as sc  # noqa: E402
+
+
+def _render(census: dict, budgets: dict | None) -> str:
+    lines = []
+    lines.append(
+        f"suite cost census — markers '{census.get('markers_expr')}', "
+        f"collection {census.get('collection_s')}s, wall "
+        f"{census.get('wall_s')}s, exit {census.get('exit')}"
+        + (
+            f", TRUNCATED at {census['truncated_at']}"
+            if census.get("truncated_at") else ""
+        )
+    )
+    pinned = (budgets or {}).get("modules") or {}
+    hdr = (f"{'module':<34} {'wall s':>8} {'setup':>7} {'call':>7} "
+           f"{'tear':>6} {'tests':>6} {'env-skip':>8} {'budget':>8} "
+           f"markers")
+    lines.append(hdr)
+    mods = sorted(
+        (census.get("modules") or {}).items(),
+        key=lambda kv: -float(kv[1].get("wall_s") or 0.0),
+    )
+    for mod, e in mods:
+        cap = (pinned.get(mod) or {}).get("wall_s")
+        lines.append(
+            f"{mod:<34} {e.get('wall_s', 0.0):>8.2f} "
+            f"{e.get('setup_s', 0.0):>7.2f} {e.get('call_s', 0.0):>7.2f} "
+            f"{e.get('teardown_s', 0.0):>6.2f} {e.get('tests', 0):>6} "
+            f"{e.get('skipped_env', 0):>8} "
+            f"{(f'{cap:.1f}' if cap is not None else '-'):>8} "
+            f"{','.join(e.get('markers', [])) or '-'}"
+        )
+    if budgets:
+        pred = sc.predicted_fast_tier_s(budgets)
+        lines.append(
+            f"fast-tier prediction: {pred:.0f}s pinned vs "
+            f"{budgets.get('fast_tier_budget_s')}s budget "
+            f"(driver timeout {budgets.get('fast_tier_timeout_s')}s)"
+        )
+    return "\n".join(lines)
+
+
+def check(census: dict | None, budgets: dict | None) -> list:
+    """The single entry point's problem list (graft_lint --all
+    pattern: every sub-check folded under one exit code)."""
+    problems = []
+    if budgets is None:
+        return ["suite budgets missing: tests/budgets/suite_costs.json "
+                "(python tools/suite_report.py --update-budgets after a "
+                "fast-tier run)"]
+    problems += sc.check_fast_tier(budgets)
+    problems += sc.check_budget_files_exist(budgets)
+    try:
+        problems += sc.check_fingerprint_pins()
+    except Exception as e:  # a missing budget file IS a finding
+        problems.append(
+            f"fingerprint pins unreadable: {type(e).__name__}: {e}"
+        )
+    if census is None:
+        problems.append(
+            "no suite census (.suite_census.json) — run the fast tier "
+            "once to measure, then --check again"
+        )
+        return problems
+    problems += sc.check_truncation(census)
+    problems += sc.check_markers(census)
+    # only a full fast-tier census can prove budget entries live/stale;
+    # a subset run (pytest tests/test_x.py) is not deletion evidence
+    full = "tests/" in " ".join(census.get("pytest_args") or []) or any(
+        a.endswith("tests") for a in (census.get("pytest_args") or [])
+    )
+    problems += sc.check_budgets(census, budgets, require_complete=full)
+    return problems
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--census", default=None, help="census JSON path")
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument("--check", action="store_true")
+    ap.add_argument("--update-budgets", action="store_true")
+    args = ap.parse_args()
+
+    census = None
+    try:
+        census = sc.load_census(args.census)
+    except OSError:
+        pass
+    budgets = None
+    try:
+        budgets = sc.load_budgets()
+    except OSError:
+        pass
+
+    if args.update_budgets:
+        if census is None:
+            print("no census to pin budgets from — run the fast tier "
+                  "first (see --help)", file=sys.stderr)
+            return 2
+        if census.get("truncated_at") or census.get("exit") != "ok":
+            print(f"refusing to pin budgets from a partial census "
+                  f"(exit {census.get('exit')}, died at "
+                  f"{census.get('truncated_at') or census.get('in_flight')})",
+                  file=sys.stderr)
+            return 2
+        budgets = update_budgets(census, budgets)
+        print(f"budgets written: {sc.budgets_path()} (fast-tier "
+              f"prediction {sc.predicted_fast_tier_s(budgets):.0f}s)")
+
+    if census is not None:
+        if args.json:
+            print(json.dumps(census, indent=1, sort_keys=True))
+        else:
+            print(_render(census, budgets))
+
+    if args.check:
+        problems = check(census, budgets)
+        for p in problems:
+            print(f"suite-report: {p}", file=sys.stderr)
+        if problems:
+            return 1
+        print("suite-report: suite census within budgets, markers "
+              "registered, fingerprint pins fresh")
+    return 0
+
+
+def update_budgets(census: dict, prior: dict | None = None) -> dict:
+    """Pin per-module budgets from a (complete) census: measured wall
+    plus mild headroom, env-skipped modules pinned null (their wall is
+    a property of the box, not the suite). Keeps the gate knobs from
+    the prior file when present."""
+    prior = prior or {}
+    modules = {}
+    for mod, e in sorted((census.get("modules") or {}).items()):
+        env_only = e.get("skipped_env", 0) > 0 and (
+            not e.get("tests") or e["skipped_env"] == e.get("tests")
+        )
+        entry = {
+            "tests": e.get("tests", 0),
+            "markers": e.get("markers", []),
+        }
+        if env_only:
+            entry["wall_s"] = None
+            entry["skipped_env"] = True
+        else:
+            entry["wall_s"] = round(
+                float(e.get("wall_s") or 0.0) * 1.05 + 0.05, 2
+            )
+        modules[mod] = entry
+    budgets = {
+        "comment": (
+            "Per-module wall-clock budgets for the tier-1 fast tier "
+            "(tools/suite_costs.py census). Exceeding a budget fails "
+            "tests/test_suite_costs.py and tools/suite_report.py "
+            "--check; sitting >stale_ratio below it is a stale-budget "
+            "fail; a deliberate suite change re-pins in the same diff "
+            "(tools/suite_report.py --update-budgets). wall_s null = "
+            "module env-skipped on the pricing box (skipped_env in the "
+            "census — present, comparable, contributing 0 to the "
+            "prediction). fast_tier_budget_s is ~70% of the driver's "
+            "870 s timeout so jitter + a cold .jax_cache cannot push a "
+            "correct tree into rc 124."
+        ),
+        "schema": sc.BUDGET_SCHEMA,
+        "source": "tools/suite_report.py --update-budgets",
+        "fast_tier_timeout_s": prior.get("fast_tier_timeout_s", 870),
+        "fast_tier_budget_s": prior.get("fast_tier_budget_s", 600),
+        "overrun_ratio": prior.get("overrun_ratio", 0.4),
+        "stale_ratio": prior.get("stale_ratio", 0.2),
+        "overrun_floor_s": prior.get("overrun_floor_s", 3.0),
+        "stale_floor_s": prior.get("stale_floor_s", 5.0),
+        "collection_s": round(float(census.get("collection_s") or 0.0)
+                              * 1.05 + 0.05, 2),
+        "markers_expr": census.get("markers_expr"),
+        "modules": modules,
+    }
+    with open(sc.budgets_path(), "w") as f:
+        json.dump(budgets, f, indent=1, sort_keys=True)
+    return budgets
+
+
+if __name__ == "__main__":
+    sys.exit(main())
